@@ -1,0 +1,45 @@
+"""kubetpu — a TPU-native batch scheduling framework.
+
+A from-scratch re-design of the Kubernetes scheduling stack (reference:
+kube-scheduler, /root/reference/pkg/scheduler) for TPU hardware: the in-tree
+Filter plugins become boolean-mask kernels and the Score plugins become
+vectorized JAX/XLA kernels over a device-resident ``(pods, nodes)`` tensor;
+the per-pod greedy ``scheduleOne`` loop becomes a single device-resident
+``lax.scan`` (greedy-parity mode) or a capacity-coupled batched assignment
+(Sinkhorn mode), sharded over a TPU mesh with ``shard_map``/``pjit``.
+
+Subpackages
+-----------
+- ``api``:       typed cluster objects (Pod, Node, selectors, taints, affinity)
+                 — the scheduling-relevant envelope of ``staging/src/k8s.io/api``.
+- ``state``:     host snapshot store + string interning + device tensorization
+                 — the analog of ``pkg/scheduler/backend/cache``.
+- ``ops``:       filter/score kernels — the analog of
+                 ``pkg/scheduler/framework/plugins``.
+- ``assign``:    assignment engines (greedy scan, Sinkhorn bin-pack) — replaces
+                 ``pkg/scheduler/schedule_one.go``'s argmax-per-pod.
+- ``parallel``:  mesh construction + sharding rules (node/pod axis over ICI).
+- ``framework``: plugin registry, profiles, KubeSchedulerConfiguration subset —
+                 the analog of ``pkg/scheduler/framework/runtime``.
+- ``sched``:     scheduling queue + batch scheduling/binding cycles.
+- ``bridge``:    extender-webhook wire protocol server (the integration seam
+                 with a real kube-scheduler, ``pkg/scheduler/extender.go``).
+- ``perf``:      scheduler_perf-style workload harness.
+- ``utils``:     metrics, feature gates, logging.
+
+Integer-exact score parity with the reference requires 64-bit resource
+arithmetic (quantities are int64 in the reference, and memory-bytes overflow
+int32), so importing this package enables jax x64 mode. kubetpu is an
+application framework — the process is expected to be a scheduler. If you are
+embedding the host-side API types into a process whose JAX numerics must stay
+32-bit, set ``KUBETPU_NO_X64=1`` before import and avoid the device kernels.
+"""
+
+import os
+
+import jax
+
+if not os.environ.get("KUBETPU_NO_X64"):
+    jax.config.update("jax_enable_x64", True)
+
+__version__ = "0.1.0"

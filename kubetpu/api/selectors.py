@@ -1,0 +1,139 @@
+"""Host-side selector evaluation.
+
+Pure-Python (numpy-free) predicate evaluation used by the tensorization layer
+to precompute boolean match matrices; the device kernels only ever see the
+resulting masks. Semantics mirror the reference helpers:
+
+- metav1 LabelSelector matching: apimachinery ``labels.Requirement.Matches``
+  (NotIn/DoesNotExist match when the key is absent).
+- NodeSelector matching: ``component-helpers/scheduling/corev1/nodeaffinity``
+  (terms are ORed; expressions within a term are ANDed; a term with no
+  expressions and no fields matches nothing; Gt/Lt parse integers).
+- Taint toleration: ``component-helpers/scheduling/corev1``
+  ``Toleration.ToleratesTaint``.
+"""
+
+from __future__ import annotations
+
+from typing import Mapping
+
+from .types import (
+    LabelSelector,
+    NodeSelector,
+    NodeSelectorTerm,
+    Operator,
+    Requirement,
+    Taint,
+    TaintEffect,
+    Toleration,
+)
+
+
+def requirement_matches(req: Requirement, labels: Mapping[str, str]) -> bool:
+    has = req.key in labels
+    val = labels.get(req.key)
+    op = req.operator
+    if op == Operator.IN:
+        return has and val in req.values
+    if op == Operator.NOT_IN:
+        return (not has) or val not in req.values
+    if op == Operator.EXISTS:
+        return has
+    if op == Operator.DOES_NOT_EXIST:
+        return not has
+    if op in (Operator.GT, Operator.LT):
+        if not has or len(req.values) != 1:
+            return False
+        try:
+            lhs = int(val)  # type: ignore[arg-type]
+            rhs = int(req.values[0])
+        except ValueError:
+            return False
+        return lhs > rhs if op == Operator.GT else lhs < rhs
+    raise ValueError(f"unknown operator {op}")
+
+
+def label_selector_matches(sel: LabelSelector, labels: Mapping[str, str]) -> bool:
+    """Empty selector matches everything (metav1 semantics)."""
+    for k, v in sel.match_labels:
+        if labels.get(k) != v:
+            return False
+    for req in sel.match_expressions:
+        if req.operator in (Operator.GT, Operator.LT):
+            # metav1 LabelSelector does not allow Gt/Lt; treat as no match.
+            return False
+        if not requirement_matches(req, labels):
+            return False
+    return True
+
+
+def node_selector_term_matches(
+    term: NodeSelectorTerm, labels: Mapping[str, str], node_name: str
+) -> bool:
+    if not term.match_expressions and not term.match_fields:
+        return False  # nil/empty term selects no objects
+    for req in term.match_expressions:
+        if not requirement_matches(req, labels):
+            return False
+    for req in term.match_fields:
+        if req.key != "metadata.name":
+            return False
+        if not requirement_matches(req, {"metadata.name": node_name}):
+            return False
+    return True
+
+
+def node_selector_matches(
+    sel: NodeSelector, labels: Mapping[str, str], node_name: str
+) -> bool:
+    """OR over terms. An empty term list matches nothing."""
+    return any(
+        node_selector_term_matches(t, labels, node_name) for t in sel.terms
+    )
+
+
+def tolerates(tol: Toleration, taint: Taint) -> bool:
+    """staging/src/k8s.io/api/core/v1/toleration.go ToleratesTaint: the key
+    check is skipped entirely for an empty key (so empty-key+Equal compares
+    values, and empty-key+Exists tolerates everything)."""
+    if tol.effect is not None and tol.effect != taint.effect:
+        return False
+    if tol.key != "" and tol.key != taint.key:
+        return False
+    if tol.operator.value == "Exists":
+        return True
+    return tol.value == taint.value
+
+
+def find_untolerated_taint(
+    taints: tuple[Taint, ...],
+    tolerations: tuple[Toleration, ...],
+    effects: tuple[TaintEffect, ...] = (TaintEffect.NO_SCHEDULE, TaintEffect.NO_EXECUTE),
+) -> Taint | None:
+    """First taint with one of ``effects`` that no toleration tolerates
+    (v1helper.FindMatchingUntoleratedTaint, as the TaintToleration filter uses)."""
+    for taint in taints:
+        if taint.effect not in effects:
+            continue
+        if not any(tolerates(t, taint) for t in tolerations):
+            return taint
+    return None
+
+
+def count_intolerable_prefer_no_schedule(
+    taints: tuple[Taint, ...], tolerations: tuple[Toleration, ...]
+) -> int:
+    """TaintToleration Score raw value
+    (tainttoleration/taint_toleration.go:163): count PreferNoSchedule taints
+    not tolerated by the pod's PreferNoSchedule-or-effectless tolerations."""
+    prefer_tols = tuple(
+        t for t in tolerations
+        if t.effect is None or t.effect == TaintEffect.PREFER_NO_SCHEDULE
+    )
+    n = 0
+    for taint in taints:
+        if taint.effect != TaintEffect.PREFER_NO_SCHEDULE:
+            continue
+        if not any(tolerates(t, taint) for t in prefer_tols):
+            n += 1
+    return n

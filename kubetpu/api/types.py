@@ -1,0 +1,281 @@
+"""Typed cluster objects — the scheduling-relevant envelope of the reference's
+``staging/src/k8s.io/api/core/v1`` types.
+
+These are plain Python dataclasses, deliberately flat (no nested Container
+lists on the hot path): a Pod carries its *aggregated* resource request, which
+the reference computes in ``computePodResourceRequest``
+(pkg/scheduler/framework/plugins/noderesources/fit.go:317) as
+``max(sum(containers), max(initContainers)) + overhead``. Use
+``kubetpu.api.requests.pod_requests`` to aggregate from containers when
+constructing pods from full specs.
+
+Canonical resource units (reference: apimachinery resource.Quantity, reduced
+to int64 canonical form exactly as NodeInfo.Resource does):
+  - cpu:               millicores (int)
+  - memory:            bytes (int)
+  - ephemeral-storage: bytes (int)
+  - pods:              count (int, node allocatable only)
+  - any other name:    extended/scalar resource, opaque int quantity
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from dataclasses import dataclass, field
+from typing import Mapping, Sequence
+
+# Canonical resource names (reference: k8s.io/api/core/v1/types.go ResourceName).
+CPU = "cpu"
+MEMORY = "memory"
+EPHEMERAL_STORAGE = "ephemeral-storage"
+PODS = "pods"
+
+# Defaults the reference applies for scoring when a pod does not specify a
+# request (pkg/scheduler/util/pod_resources.go:28-31). Used only by the
+# NonZeroRequested view, never by the Fit filter.
+DEFAULT_MILLI_CPU_REQUEST = 100
+DEFAULT_MEMORY_REQUEST = 200 * 1024 * 1024
+
+# Score bounds (staging/src/k8s.io/kube-scheduler/framework: MaxNodeScore=100).
+MAX_NODE_SCORE = 100
+MIN_NODE_SCORE = 0
+MAX_TOTAL_SCORE = (1 << 63) - 1
+
+ResourceList = Mapping[str, int]
+
+
+class Operator(str, enum.Enum):
+    """Label/node-selector requirement operator
+    (reference: k8s.io/api/core/v1 NodeSelectorOperator + metav1 LabelSelectorOperator)."""
+
+    IN = "In"
+    NOT_IN = "NotIn"
+    EXISTS = "Exists"
+    DOES_NOT_EXIST = "DoesNotExist"
+    GT = "Gt"
+    LT = "Lt"
+
+
+@dataclass(frozen=True)
+class Requirement:
+    """One match expression: ``key op values``."""
+
+    key: str
+    operator: Operator
+    values: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class LabelSelector:
+    """metav1.LabelSelector: match_labels AND all match_expressions.
+
+    An empty selector matches everything; ``None`` (where allowed) matches
+    nothing — callers encode that distinction, as the reference does.
+    """
+
+    match_labels: tuple[tuple[str, str], ...] = ()
+    match_expressions: tuple[Requirement, ...] = ()
+
+    @staticmethod
+    def of(labels: Mapping[str, str] | None = None,
+           exprs: Sequence[Requirement] = ()) -> "LabelSelector":
+        return LabelSelector(
+            match_labels=tuple(sorted((labels or {}).items())),
+            match_expressions=tuple(exprs),
+        )
+
+
+@dataclass(frozen=True)
+class NodeSelectorTerm:
+    """One term of a NodeSelector: AND of its expressions (+ match_fields on
+    metadata.name). Terms are ORed."""
+
+    match_expressions: tuple[Requirement, ...] = ()
+    match_fields: tuple[Requirement, ...] = ()  # only metadata.name supported
+
+
+@dataclass(frozen=True)
+class NodeSelector:
+    """OR of terms (reference: k8s.io/api/core/v1 NodeSelector)."""
+
+    terms: tuple[NodeSelectorTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class PreferredSchedulingTerm:
+    weight: int  # 1..100
+    term: NodeSelectorTerm = NodeSelectorTerm()
+
+
+class TaintEffect(str, enum.Enum):
+    NO_SCHEDULE = "NoSchedule"
+    PREFER_NO_SCHEDULE = "PreferNoSchedule"
+    NO_EXECUTE = "NoExecute"
+
+
+@dataclass(frozen=True)
+class Taint:
+    key: str
+    value: str = ""
+    effect: TaintEffect = TaintEffect.NO_SCHEDULE
+
+
+class TolerationOperator(str, enum.Enum):
+    EXISTS = "Exists"
+    EQUAL = "Equal"
+
+
+@dataclass(frozen=True)
+class Toleration:
+    """Reference semantics (component-helpers/scheduling/corev1/helpers.go
+    Toleration.ToleratesTaint): empty key + Exists tolerates everything;
+    empty effect matches all effects."""
+
+    key: str = ""
+    operator: TolerationOperator = TolerationOperator.EQUAL
+    value: str = ""
+    effect: TaintEffect | None = None  # None = all effects
+
+
+@dataclass(frozen=True)
+class PodAffinityTerm:
+    """Reference: k8s.io/api/core/v1 PodAffinityTerm. The selector matches
+    labels of candidate (existing) pods; namespaces + namespace_selector pick
+    which namespaces those pods may live in (empty namespaces + None selector
+    = the incoming pod's own namespace)."""
+
+    topology_key: str
+    selector: LabelSelector | None = None
+    namespaces: tuple[str, ...] = ()
+    namespace_selector: LabelSelector | None = None  # None = no selector
+
+
+@dataclass(frozen=True)
+class WeightedPodAffinityTerm:
+    weight: int  # 1..100
+    term: PodAffinityTerm = None  # type: ignore[assignment]
+
+
+@dataclass(frozen=True)
+class PodAffinity:
+    required: tuple[PodAffinityTerm, ...] = ()
+    preferred: tuple[WeightedPodAffinityTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class NodeAffinity:
+    required: NodeSelector | None = None
+    preferred: tuple[PreferredSchedulingTerm, ...] = ()
+
+
+@dataclass(frozen=True)
+class Affinity:
+    node_affinity: NodeAffinity | None = None
+    pod_affinity: PodAffinity | None = None
+    pod_anti_affinity: PodAffinity | None = None
+
+
+class UnsatisfiableConstraintAction(str, enum.Enum):
+    DO_NOT_SCHEDULE = "DoNotSchedule"
+    SCHEDULE_ANYWAY = "ScheduleAnyway"
+
+
+@dataclass(frozen=True)
+class TopologySpreadConstraint:
+    """Reference: k8s.io/api/core/v1 TopologySpreadConstraint."""
+
+    max_skew: int
+    topology_key: str
+    when_unsatisfiable: UnsatisfiableConstraintAction
+    selector: LabelSelector | None = None
+    min_domains: int | None = None
+    # Honor|Ignore; reference defaults: nodeAffinityPolicy=Honor, nodeTaintsPolicy=Ignore
+    node_affinity_policy: str = "Honor"
+    node_taints_policy: str = "Ignore"
+    match_label_keys: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class ContainerPort:
+    host_port: int
+    protocol: str = "TCP"
+    host_ip: str = ""
+
+
+@dataclass(frozen=True)
+class Pod:
+    """A pod as the scheduler sees it. ``requests`` is the aggregated resource
+    request (fit.go:317 semantics — aggregate with api.requests.pod_requests
+    if building from containers)."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    labels: tuple[tuple[str, str], ...] = ()
+    requests: tuple[tuple[str, int], ...] = ()  # canonical units, sorted
+    # NonZeroRequested scoring view (types.go:1035 CalculateResource). The
+    # 100mCPU/200MiB defaults are PER CONTAINER, so this must be aggregated
+    # from containers (api.requests.pod_nonzero_requests). None = derive from
+    # ``requests`` assuming a single container.
+    nonzero: tuple[tuple[str, int], ...] | None = None
+    node_name: str = ""          # assigned node ("" = pending)
+    node_selector: tuple[tuple[str, str], ...] = ()  # spec.nodeSelector (ANDed equality)
+    affinity: Affinity | None = None
+    tolerations: tuple[Toleration, ...] = ()
+    topology_spread_constraints: tuple[TopologySpreadConstraint, ...] = ()
+    priority: int = 0
+    ports: tuple[ContainerPort, ...] = ()
+    scheduling_gates: tuple[str, ...] = ()
+    images: tuple[str, ...] = ()          # container images, for ImageLocality
+    preemption_policy: str = "PreemptLowerPriority"  # or "Never"
+    creation_index: int = 0  # monotonic stand-in for creationTimestamp
+
+    def labels_dict(self) -> dict[str, str]:
+        return dict(self.labels)
+
+    def requests_dict(self) -> dict[str, int]:
+        return dict(self.requests)
+
+    def nonzero_requests(self) -> dict[str, int]:
+        """The NonZeroRequested view used by resource *scoring* only
+        (pkg/scheduler/framework/types.go:1035, util/pod_resources.go)."""
+        if self.nonzero is not None:
+            return dict(self.nonzero)
+        out = dict(self.requests)
+        if out.get(CPU, 0) == 0:
+            out[CPU] = DEFAULT_MILLI_CPU_REQUEST
+        if out.get(MEMORY, 0) == 0:
+            out[MEMORY] = DEFAULT_MEMORY_REQUEST
+        return out
+
+    def with_node(self, node_name: str) -> "Pod":
+        return dataclasses.replace(self, node_name=node_name)
+
+
+@dataclass(frozen=True)
+class ImageState:
+    """Summary of one image on a node (fwk.ImageStateSummary)."""
+
+    size_bytes: int
+    num_nodes: int = 1
+
+
+@dataclass(frozen=True)
+class Node:
+    name: str
+    labels: tuple[tuple[str, str], ...] = ()
+    allocatable: tuple[tuple[str, int], ...] = ()  # includes "pods" count
+    taints: tuple[Taint, ...] = ()
+    unschedulable: bool = False
+    images: tuple[tuple[str, ImageState], ...] = ()
+
+    def labels_dict(self) -> dict[str, str]:
+        return dict(self.labels)
+
+    def allocatable_dict(self) -> dict[str, int]:
+        return dict(self.allocatable)
+
+
+def freeze_map(m: Mapping[str, int] | Mapping[str, str] | None):
+    return tuple(sorted((m or {}).items()))

@@ -1,0 +1,1 @@
+from . import filters, scores  # noqa: F401

@@ -1,0 +1,51 @@
+"""Filter kernels — boolean masks over ``(pods, nodes)``.
+
+The reference runs Filter plugins per (pod, node) inside a chunked
+parallel-for (``findNodesThatPassFilters``, pkg/scheduler/schedule_one.go:771,
+``parallelize/parallelism.go:68``). Here every predicate is a vectorized
+tensor op producing the full ``(P, N)`` mask in one XLA program; the
+label/taint/port predicates were already folded into ``PodBatch.static_mask``
+by the encoder, so the only *dynamic* filter (one that depends on evolving
+node usage) is NodeResourcesFit.
+
+All kernels are shape-polymorphic in P and N and contain no Python control
+flow on traced values, so they jit/vmap/shard_map cleanly.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def resource_fit_mask(
+    pod_requests: jnp.ndarray,    # (P, R) int64, exact requests (not NonZero)
+    alloc: jnp.ndarray,           # (N, R) int64
+    requested: jnp.ndarray,       # (N, R) int64, exact requested on node
+    pod_count: jnp.ndarray,       # (N,) int32
+    allowed_pods: jnp.ndarray,    # (N,) int32
+) -> jnp.ndarray:
+    """NodeResourcesFit Filter (noderesources/fit.go:647 fitsRequest):
+
+    - per resource: infeasible when ``req > 0 and req > allocatable - used``
+    - pod count: infeasible when ``len(pods) + 1 > allowedPodNumber``
+    Returns (P, N) bool.
+    """
+    free = alloc - requested                                  # (N, R)
+    req = pod_requests[:, None, :]                            # (P, 1, R)
+    ok = (req == 0) | (req <= free[None, :, :])               # (P, N, R)
+    mask = jnp.all(ok, axis=-1)                               # (P, N)
+    room = (pod_count + 1) <= allowed_pods                    # (N,)
+    return mask & room[None, :]
+
+
+def resource_fit_mask_single(
+    pod_request: jnp.ndarray,     # (R,) int64
+    alloc: jnp.ndarray,           # (N, R)
+    requested: jnp.ndarray,       # (N, R)
+    pod_count: jnp.ndarray,       # (N,)
+    allowed_pods: jnp.ndarray,    # (N,)
+) -> jnp.ndarray:
+    """(N,) variant used inside the greedy scan (one pod per step)."""
+    free = alloc - requested
+    ok = (pod_request[None, :] == 0) | (pod_request[None, :] <= free)
+    return jnp.all(ok, axis=-1) & ((pod_count + 1) <= allowed_pods)

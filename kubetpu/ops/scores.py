@@ -1,0 +1,240 @@
+"""Score kernels — int64 scores in [0, 100] over ``(pods, nodes)``.
+
+The reference computes scores per node inside ``RunScorePlugins``
+(framework/runtime/framework.go:1351): parallel per-node Score, then
+NormalizeScore, then multiply by plugin weight and sum. Each kernel here
+produces the *raw* per-plugin score tensor; normalization and weighting live
+in ``normalize`` / the framework runtime so the composition order matches the
+reference exactly.
+
+Integer arithmetic is int64 end-to-end where the reference uses int64 —
+truncating (floor, since values are non-negative) division included.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100
+
+
+def _weighted_mean(
+    per_res: jnp.ndarray,      # (P, N, R) int64 per-resource scores
+    pod_req: jnp.ndarray,      # (P, R) int64 — pod's request (participation rule)
+    cap: jnp.ndarray,          # (1, N, R) int64 allocatable
+    weights: jnp.ndarray,      # (R,) int64
+    is_scalar: jnp.ndarray,    # (R,) bool
+    require_positive_score: bool = False,
+    round_half_up: bool = False,
+) -> jnp.ndarray:
+    """The shared weight-accumulation rule of the resource strategies
+    (resource_allocation.go:180 skip rules + each strategy's weightSum loop):
+    a resource participates when weight > 0, node allocatable > 0, and — for
+    extended/scalar resources — the pod requests it. RequestedToCapacityRatio
+    additionally requires the per-resource score to be > 0 and rounds the
+    final mean half-up (math.Round) instead of truncating."""
+    participate = (
+        (weights[None, None, :] > 0)
+        & (cap > 0)
+        & (~is_scalar[None, None, :] | (pod_req[:, None, :] > 0))
+    )
+    if require_positive_score:
+        participate = participate & (per_res > 0)
+    w = jnp.where(participate, weights[None, None, :], 0)
+    num = jnp.sum(per_res * w, axis=-1)
+    den = jnp.sum(w, axis=-1)
+    if round_half_up:
+        out = (2 * num + den) // jnp.maximum(2 * den, 1)
+    else:
+        out = num // jnp.maximum(den, 1)
+    return jnp.where(den > 0, out, 0)
+
+
+def least_allocated_score(
+    pod_nonzero: jnp.ndarray,     # (P, R) int64 — NonZero view (100mCPU/200MiB defaults)
+    node_nonzero: jnp.ndarray,    # (N, R) int64 — sum of NonZero requests on node
+    alloc: jnp.ndarray,           # (N, R) int64
+    weights: jnp.ndarray,         # (R,) int64 — 0 for resources not scored
+    is_scalar: jnp.ndarray,       # (R,) bool — extended resources (skip when pod req 0)
+) -> jnp.ndarray:
+    """LeastAllocated strategy (noderesources/least_allocated.go:31):
+
+        per-resource: ((capacity - requested) * 100) // capacity,
+                      0 if capacity == 0 or requested > capacity
+        node score:   Σ(score_i * w_i) // Σ(w_i)   over participating resources
+
+    A resource participates when its weight > 0, node allocatable > 0, and —
+    for extended/scalar resources — the pod actually requests it
+    (resource_allocation.go:180 calculateNodeAllocatableRequest skip rules).
+    Returns (P, N) int64.
+    """
+    cap = alloc[None, :, :]                                   # (1, N, R)
+    requested = node_nonzero[None, :, :] + pod_nonzero[:, None, :]  # (P, N, R)
+    safe_cap = jnp.maximum(cap, 1)
+    per_res = jnp.where(
+        (cap > 0) & (requested <= cap),
+        ((cap - requested) * MAX_NODE_SCORE) // safe_cap,
+        0,
+    )                                                         # (P, N, R)
+    return _weighted_mean(per_res, pod_nonzero, cap, weights, is_scalar)
+
+
+def most_allocated_score(
+    pod_nonzero: jnp.ndarray,
+    node_nonzero: jnp.ndarray,
+    alloc: jnp.ndarray,
+    weights: jnp.ndarray,
+    is_scalar: jnp.ndarray,
+) -> jnp.ndarray:
+    """MostAllocated strategy (noderesources/most_allocated.go):
+    per-resource ``(min(requested, capacity) * 100) // capacity`` (requests can
+    exceed capacity because of NonZero defaults), 0 when capacity == 0.
+    Weighted mean as in LeastAllocated."""
+    cap = alloc[None, :, :]
+    requested = node_nonzero[None, :, :] + pod_nonzero[:, None, :]
+    safe_cap = jnp.maximum(cap, 1)
+    clamped = jnp.minimum(requested, cap)  # requested > capacity clamps to max score
+    per_res = jnp.where(cap > 0, (clamped * MAX_NODE_SCORE) // safe_cap, 0)
+    return _weighted_mean(per_res, pod_nonzero, cap, weights, is_scalar)
+
+
+def _trunc_div(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Go's int64 division truncates toward zero; Python's // floors. Segment
+    slopes in a decreasing shape make the numerator negative, so match Go."""
+    q = jnp.abs(a) // jnp.maximum(jnp.abs(b), 1)
+    return jnp.where((a < 0) ^ (b < 0), -q, q)
+
+
+def broken_linear(p: jnp.ndarray, xs: jnp.ndarray, ys: jnp.ndarray) -> jnp.ndarray:
+    """helper.BuildBrokenLinearFunction (plugins/helper/shape_score.go):
+    exact int64 piecewise-linear bracket. ``xs`` strictly increasing."""
+    b = xs.shape[0]
+    idx = jnp.searchsorted(xs, p, side="left")        # first i with xs[i] >= p
+    hi = jnp.clip(idx, 0, b - 1)
+    lo = jnp.clip(idx - 1, 0, b - 1)
+    x0, y0, x1, y1 = xs[lo], ys[lo], xs[hi], ys[hi]
+    interp = y0 + _trunc_div((y1 - y0) * (p - x0), x1 - x0)
+    out = jnp.where(idx == 0, ys[0], interp)
+    return jnp.where(idx >= b, ys[-1], out)
+
+
+def requested_to_capacity_ratio_score(
+    pod_nonzero: jnp.ndarray,
+    node_nonzero: jnp.ndarray,
+    alloc: jnp.ndarray,
+    weights: jnp.ndarray,
+    is_scalar: jnp.ndarray,
+    shape_utilization: jnp.ndarray,  # (B,) int64 — bracket x points, 0..100, increasing
+    shape_score: jnp.ndarray,        # (B,) int64 — bracket y, PRE-SCALED ×10 to 0..100
+) -> jnp.ndarray:
+    """RequestedToCapacityRatio strategy (noderesources/requested_to_capacity_ratio.go
+    buildRequestedToCapacityRatioScorerFunction), exact int64 semantics:
+
+    - utilization = requested*100//capacity; capacity==0 or overflow → 100
+    - per-resource score = broken-linear(shape) at that utilization
+    - a resource's weight counts only when its score > 0
+    - node score = round(Σ(score·w) / Σw), half away from zero (math.Round)
+
+    Shape y-values arrive pre-scaled ×(MaxNodeScore/MaxCustomPriorityScore)=×10
+    by the config layer, as the reference's New() does.
+    """
+    cap = alloc[None, :, :]
+    requested = node_nonzero[None, :, :] + pod_nonzero[:, None, :]
+    safe_cap = jnp.maximum(cap, 1)
+    util = jnp.where(
+        (cap > 0) & (requested <= cap),
+        (requested * MAX_NODE_SCORE) // safe_cap,
+        MAX_NODE_SCORE,
+    )
+    per_res = broken_linear(util, shape_utilization, shape_score)
+    return _weighted_mean(
+        per_res, pod_nonzero, cap, weights, is_scalar,
+        require_positive_score=True, round_half_up=True,
+    )
+
+
+def _balanced_std(frac: jnp.ndarray, present: jnp.ndarray) -> jnp.ndarray:
+    """std over the participating fractions, with the reference's case split
+    (balanced_allocation.go): exactly 2 → |f1-f2|/2; >2 → population std;
+    <2 → 0. ``frac`` (..., R) float, ``present`` (..., R) bool."""
+    n = jnp.sum(present, axis=-1)
+    total = jnp.sum(jnp.where(present, frac, 0.0), axis=-1)
+    mean = total / jnp.maximum(n, 1)
+    var = jnp.sum(
+        jnp.where(present, (frac - mean[..., None]) ** 2, 0.0), axis=-1
+    ) / jnp.maximum(n, 1)
+    std_many = jnp.sqrt(var)
+    # two-resource shortcut: |f1 - f2| / 2 over the two present entries.
+    # sum of |f_i - mean| over 2 entries == |f1 - f2|; /2 matches.
+    absdev = jnp.sum(jnp.where(present, jnp.abs(frac - mean[..., None]), 0.0), axis=-1)
+    std_two = absdev / 2.0
+    return jnp.where(n == 2, std_two, jnp.where(n > 2, std_many, 0.0))
+
+
+def balanced_allocation_score(
+    pod_requests: jnp.ndarray,    # (P, R) int64 — exact requests (useRequested=true)
+    node_requested: jnp.ndarray,  # (N, R) int64 — exact requested on node
+    alloc: jnp.ndarray,           # (N, R) int64
+    weights: jnp.ndarray,         # (R,) int64 — which resources participate (>0)
+    is_scalar: jnp.ndarray,       # (R,) bool
+    float_dtype=jnp.float64,
+) -> jnp.ndarray:
+    """NodeResourcesBalancedAllocation (balanced_allocation.go:248
+    balancedResourceScorer):
+
+        score = 50 + (50 + score_with_pod - score_without_pod) / 2
+
+    where each side is ``int64((1 - std(fractions)) * 100)`` and fractions are
+    ``min(requested/allocatable, 1)`` over participating resources. Best-effort
+    pods (all participating requests zero) are skipped (→ 0) by PreScore.
+    Returns (P, N) int64.
+    """
+    cap = alloc[None, :, :].astype(float_dtype)
+    present = (
+        (weights[None, None, :] > 0)
+        & (alloc[None, :, :] > 0)
+        & (~is_scalar[None, None, :] | (pod_requests[:, None, :] > 0))
+    )                                                          # (P, N, R)
+    with_pod = (node_requested[None, :, :] + pod_requests[:, None, :]).astype(float_dtype)
+    without_pod = jnp.broadcast_to(
+        node_requested[None, :, :].astype(float_dtype), with_pod.shape
+    )
+    safe_cap = jnp.maximum(cap, 1.0)
+    f_with = jnp.minimum(with_pod / safe_cap, 1.0)
+    f_without = jnp.minimum(without_pod / safe_cap, 1.0)
+    score_with = ((1.0 - _balanced_std(f_with, present)) * MAX_NODE_SCORE).astype(jnp.int64)
+    score_without = ((1.0 - _balanced_std(f_without, present)) * MAX_NODE_SCORE).astype(jnp.int64)
+    score = MAX_NODE_SCORE // 2 + (MAX_NODE_SCORE // 2 + score_with - score_without) // 2
+    # best-effort skip: all participating pod requests are zero
+    best_effort = jnp.all(
+        (pod_requests == 0) | (weights[None, :] == 0), axis=-1
+    )                                                          # (P,)
+    return jnp.where(best_effort[:, None], 0, score)
+
+
+def default_normalize(raw: jnp.ndarray, reverse: bool = False) -> jnp.ndarray:
+    """helper.DefaultNormalizeScore (plugins/helper/normalize_score.go:27),
+    vectorized over the pod axis: per pod, scale [0, max] → [0, 100]
+    (integer division), optionally reversed. raw: (P, N) int64."""
+    mx = jnp.max(raw, axis=-1, keepdims=True)                 # (P, 1)
+    scaled = jnp.where(mx > 0, (MAX_NODE_SCORE * raw) // jnp.maximum(mx, 1), 0)
+    if reverse:
+        # maxCount == 0 with reverse=true → all scores become maxPriority.
+        scaled = MAX_NODE_SCORE - scaled
+    return scaled
+
+
+def image_locality_score(
+    sum_scores: jnp.ndarray,      # (P, N) int64 — Σ scaled image sizes present on node
+    image_count: jnp.ndarray,     # (P,) int32 — number of image sources in pod spec
+) -> jnp.ndarray:
+    """ImageLocality (imagelocality/image_locality.go:96 calculatePriority):
+    clamp sumScores to [minThreshold, maxContainerThreshold*imageCount] and
+    scale to [0, 100]. minThreshold = 23 MiB, maxContainerThreshold = 1000 MiB
+    (image_locality.go:34-35)."""
+    min_threshold = 23 * 1024 * 1024
+    max_container_threshold = 1000 * 1024 * 1024
+    max_threshold = max_container_threshold * image_count.astype(jnp.int64)[:, None]
+    s = jnp.clip(sum_scores, min_threshold, jnp.maximum(max_threshold, min_threshold))
+    denom = jnp.maximum(max_threshold - min_threshold, 1)
+    return MAX_NODE_SCORE * (s - min_threshold) // denom

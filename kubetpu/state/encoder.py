@@ -1,0 +1,411 @@
+"""Snapshot → tensor encoding (the tensorization layer, SURVEY §7.2).
+
+Replaces the reference's per-node object walks with a two-step scheme:
+
+1. **Host (numpy)**: label keys/values, taints, ports and selectors are
+   interned (``Vocab``); every *distinct* selector/toleration/port signature
+   among the pending pods is evaluated once against all N nodes, vectorized
+   over nodes, yielding per-signature ``(N,)`` masks. Pods gather their
+   signature's mask — O(distinct_signatures × N), not O(pods × N) Python.
+2. **Device (jnp)**: only integer/bool tensors cross the host↔device
+   boundary: ``(N, R)`` allocatable/requested, ``(P, R)`` requests, ``(P, N)``
+   static masks and static score addends. The dynamic kernels (resource fit,
+   spread, inter-pod affinity) run entirely on device.
+
+This file covers the *static* per-pod-per-node facts:
+  - NodeName        (schedule_one's trivial predicate)
+  - NodeUnschedulable (plugins/nodeunschedulable — toleration-aware)
+  - TaintToleration Filter + Score raw counts (plugins/tainttoleration)
+  - NodeAffinity Filter (required) + Score raw weights (plugins/nodeaffinity)
+  - spec.nodeSelector (part of NodeAffinity plugin's Filter)
+  - NodePorts        (plugins/nodeports)
+Resource tensors for NodeResourcesFit/LeastAllocated/BalancedAllocation are
+encoded here too; their kernels live in ``kubetpu.ops``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+import numpy as np
+
+from ..api import types as t
+from ..api.selectors import (
+    count_intolerable_prefer_no_schedule,
+    find_untolerated_taint,
+    node_selector_term_matches,
+    requirement_matches,
+    tolerates,
+)
+from .snapshot import NodeInfo, Snapshot
+from .vocab import Vocab
+
+BASE_RESOURCES = (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
+
+_UNSCHEDULABLE_TAINT = t.Taint(
+    key="node.kubernetes.io/unschedulable", effect=t.TaintEffect.NO_SCHEDULE
+)
+
+
+def round_up(n: int, minimum: int = 8) -> int:
+    """Pad to the next power of two (compile-cache bucketing for XLA's static
+    shapes; SURVEY §7 'Hard parts: dynamic shapes')."""
+    v = minimum
+    while v < n:
+        v <<= 1
+    return v
+
+
+def resource_axis(snapshot: Snapshot, pods: Sequence[t.Pod]) -> list[str]:
+    """Fixed resource vocabulary: base resources then sorted scalars seen in
+    node allocatable or pod requests."""
+    scalars: set[str] = set()
+    for info in snapshot.nodes.values():
+        for k, _ in info.node.allocatable:
+            if k not in BASE_RESOURCES and k != t.PODS:
+                scalars.add(k)
+    for p in pods:
+        for k, _ in p.requests:
+            if k not in BASE_RESOURCES and k != t.PODS:
+                scalars.add(k)
+    return list(BASE_RESOURCES) + sorted(scalars)
+
+
+@dataclass
+class NodeTensors:
+    """Numpy-side encoded snapshot. ``to_device()`` pads + uploads."""
+
+    resource_names: list[str]
+    node_names: list[str]
+    alloc: np.ndarray              # (N, R) int64
+    requested: np.ndarray          # (N, R) int64 (exact, Fit filter view)
+    nonzero_requested: np.ndarray  # (N, R) int64 (scoring view)
+    pod_count: np.ndarray          # (N,) int32
+    allowed_pods: np.ndarray       # (N,) int32
+    # host-side helpers for signature evaluation
+    infos: list[NodeInfo] = field(repr=False, default_factory=list)
+    key_vocab: Vocab = field(repr=False, default_factory=Vocab)
+    val_vocab: Vocab = field(repr=False, default_factory=Vocab)
+    node_label: np.ndarray | None = field(repr=False, default=None)  # (N, K) int32
+
+    @property
+    def num_nodes(self) -> int:
+        return len(self.node_names)
+
+    @property
+    def num_resources(self) -> int:
+        return len(self.resource_names)
+
+    # ---- label machinery -------------------------------------------------
+    def _ensure_label_matrix(self) -> np.ndarray:
+        if self.node_label is None or self.node_label.shape[1] < len(self.key_vocab):
+            K = len(self.key_vocab)
+            mat = np.full((self.num_nodes, K), -1, dtype=np.int32)
+            for i, info in enumerate(self.infos):
+                for k, v in info.node.labels:
+                    mat[i, self.key_vocab.get(k)] = self.val_vocab.intern(v)
+            self.node_label = mat
+        return self.node_label
+
+    def requirement_mask(self, req: t.Requirement) -> np.ndarray:
+        """(N,) bool — vectorized over nodes via interned label ids."""
+        kid = self.key_vocab.get(req.key)
+        if kid < 0:
+            # Key never appears on any node: In/Exists/Gt/Lt fail everywhere,
+            # NotIn/DoesNotExist succeed everywhere.
+            ok = req.operator in (t.Operator.NOT_IN, t.Operator.DOES_NOT_EXIST)
+            return np.full(self.num_nodes, ok, dtype=bool)
+        col = self._ensure_label_matrix()[:, kid]
+        op = req.operator
+        if op == t.Operator.EXISTS:
+            return col >= 0
+        if op == t.Operator.DOES_NOT_EXIST:
+            return col < 0
+        if op == t.Operator.IN:
+            vids = [self.val_vocab.get(v) for v in req.values]
+            vids = np.array([v for v in vids if v >= 0], dtype=np.int32)
+            return np.isin(col, vids) & (col >= 0)
+        if op == t.Operator.NOT_IN:
+            vids = [self.val_vocab.get(v) for v in req.values]
+            vids = np.array([v for v in vids if v >= 0], dtype=np.int32)
+            return ~np.isin(col, vids) | (col < 0)
+        # Gt/Lt: rare — fall back to scalar evaluation per node.
+        out = np.zeros(self.num_nodes, dtype=bool)
+        for i, info in enumerate(self.infos):
+            out[i] = requirement_matches(req, info.node.labels_dict())
+        return out
+
+    def term_mask(self, term: t.NodeSelectorTerm) -> np.ndarray:
+        if not term.match_expressions and not term.match_fields:
+            return np.zeros(self.num_nodes, dtype=bool)
+        m = np.ones(self.num_nodes, dtype=bool)
+        for req in term.match_expressions:
+            m &= self.requirement_mask(req)
+        if term.match_fields:
+            names = np.array(
+                [
+                    node_selector_term_matches(
+                        t.NodeSelectorTerm(match_fields=term.match_fields),
+                        {},
+                        n,
+                    )
+                    for n in self.node_names
+                ],
+                dtype=bool,
+            )
+            m &= names
+        return m
+
+    def node_selector_mask(self, sel: t.NodeSelector) -> np.ndarray:
+        m = np.zeros(self.num_nodes, dtype=bool)
+        for term in sel.terms:
+            m |= self.term_mask(term)
+        return m
+
+    def topology_values(self, topo_key: str) -> np.ndarray:
+        """(N,) int32 domain id per node for a topology label key; -1 absent."""
+        kid = self.key_vocab.get(topo_key)
+        if kid < 0:
+            return np.full(self.num_nodes, -1, dtype=np.int32)
+        return self._ensure_label_matrix()[:, kid].copy()
+
+
+def encode_snapshot(
+    snapshot: Snapshot, resource_names: Sequence[str] | None = None,
+    pods: Sequence[t.Pod] = (),
+) -> NodeTensors:
+    rnames = list(resource_names) if resource_names else resource_axis(snapshot, pods)
+    ridx = {r: i for i, r in enumerate(rnames)}
+    infos = snapshot.node_infos()
+    N, R = len(infos), len(rnames)
+    alloc = np.zeros((N, R), dtype=np.int64)
+    requested = np.zeros((N, R), dtype=np.int64)
+    nonzero = np.zeros((N, R), dtype=np.int64)
+    pod_count = np.zeros(N, dtype=np.int32)
+    allowed = np.zeros(N, dtype=np.int32)
+    key_vocab, val_vocab = Vocab(), Vocab()
+    for i, info in enumerate(infos):
+        for k, v in info.node.allocatable:
+            if k == t.PODS:
+                allowed[i] = v
+            else:
+                j = ridx.get(k)
+                if j is not None:
+                    alloc[i, j] = v
+        for k, v in info.requested.items():
+            j = ridx.get(k)
+            if j is not None:
+                requested[i, j] = v
+        for k, v in info.nonzero_requested.items():
+            j = ridx.get(k)
+            if j is not None:
+                nonzero[i, j] = v
+        pod_count[i] = len(info.pods)
+        for k, v in info.node.labels:
+            key_vocab.intern(k)
+            val_vocab.intern(v)
+    return NodeTensors(
+        resource_names=rnames,
+        node_names=[info.node.name for info in infos],
+        alloc=alloc,
+        requested=requested,
+        nonzero_requested=nonzero,
+        pod_count=pod_count,
+        allowed_pods=allowed,
+        infos=infos,
+        key_vocab=key_vocab,
+        val_vocab=val_vocab,
+    )
+
+
+# --------------------------------------------------------------------------
+# Pod batch encoding
+# --------------------------------------------------------------------------
+
+def _static_filter_signature(pod: t.Pod):
+    """Everything that determines the pod's static (P,N) feasibility mask."""
+    na = pod.affinity.node_affinity if pod.affinity else None
+    return (
+        pod.node_selector,
+        na.required if na else None,
+        pod.tolerations,
+        # normalized exactly like _node_port_sets so both sides of the
+        # conflict check use ("TCP", "0.0.0.0") for unset protocol/hostIP
+        tuple(
+            sorted(
+                (p.host_port, p.protocol or "TCP", p.host_ip or "0.0.0.0")
+                for p in pod.ports
+                if p.host_port > 0
+            )
+        ),
+    )
+
+
+def _static_score_signature(pod: t.Pod):
+    na = pod.affinity.node_affinity if pod.affinity else None
+    return (na.preferred if na else (), pod.tolerations)
+
+
+@dataclass
+class PodBatch:
+    """Numpy-side encoded pending-pod batch."""
+
+    pods: list[t.Pod]
+    requests: np.ndarray            # (P, R) int64
+    nonzero_requests: np.ndarray    # (P, R) int64
+    priority: np.ndarray            # (P,) int32
+    static_mask: np.ndarray         # (P, N) bool — all static filters ANDed
+    node_affinity_raw: np.ndarray   # (P, N) int64 — sum of matched preferred weights
+    taint_prefer_raw: np.ndarray    # (P, N) int64 — intolerable PreferNoSchedule count
+
+    @property
+    def num_pods(self) -> int:
+        return len(self.pods)
+
+
+def _node_port_sets(nt: NodeTensors) -> list[set[tuple[int, str, str]]]:
+    out: list[set[tuple[int, str, str]]] = []
+    for info in nt.infos:
+        s: set[tuple[int, str, str]] = set()
+        for pod in info.pods.values():
+            for cp in pod.ports:
+                if cp.host_port > 0:
+                    s.add((cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0"))
+        out.append(s)
+    return out
+
+
+def _ports_conflict(
+    want: tuple[tuple[int, str, str], ...], used: set[tuple[int, str, str]]
+) -> bool:
+    """plugins/nodeports Fits: conflict when port+protocol equal and hostIP
+    equal or either side is the wildcard."""
+    for port, proto, ip in want:
+        ip = ip or "0.0.0.0"
+        for uport, uproto, uip in used:
+            if port == uport and proto == uproto:
+                if ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip:
+                    return True
+    return False
+
+
+def encode_pod_batch(nt: NodeTensors, pods: Sequence[t.Pod]) -> PodBatch:
+    ridx = {r: i for i, r in enumerate(nt.resource_names)}
+    P, N, R = len(pods), nt.num_nodes, nt.num_resources
+    requests = np.zeros((P, R), dtype=np.int64)
+    nonzero = np.zeros((P, R), dtype=np.int64)
+    priority = np.zeros(P, dtype=np.int32)
+    # Pods requesting a resource absent from the snapshot's axis can fit
+    # nowhere (no node advertises it: request > 0 - 0); mark them infeasible
+    # everywhere instead of silently dropping the request.
+    unknown_resource = np.zeros(P, dtype=bool)
+    for i, p in enumerate(pods):
+        for k, v in p.requests:
+            j = ridx.get(k)
+            if j is not None:
+                requests[i, j] = v
+            elif v > 0 and k != t.PODS:
+                unknown_resource[i] = True
+        for k, v in p.nonzero_requests().items():
+            j = ridx.get(k)
+            if j is not None:
+                nonzero[i, j] = v
+        priority[i] = p.priority
+
+    # distinct static-filter signatures → (N,) masks
+    node_taints = [info.node.taints for info in nt.infos]
+    node_unsched = np.array(
+        [info.node.unschedulable for info in nt.infos], dtype=bool
+    )
+    node_ports = _node_port_sets(nt)
+    sig_cache: dict = {}
+    static_mask = np.ones((P, N), dtype=bool)
+    for i, p in enumerate(pods):
+        sig = _static_filter_signature(p)
+        m = sig_cache.get(sig)
+        if m is None:
+            m = np.ones(N, dtype=bool)
+            # spec.nodeSelector — ANDed equality terms (NodeAffinity plugin Filter)
+            for k, v in p.node_selector:
+                m &= nt.requirement_mask(
+                    t.Requirement(k, t.Operator.IN, (v,))
+                )
+            # required node affinity
+            na = p.affinity.node_affinity if p.affinity else None
+            if na and na.required is not None:
+                m &= nt.node_selector_mask(na.required)
+            # taints (NoSchedule/NoExecute) — dedupe by node taint tuple
+            taint_ok: dict[tuple, bool] = {}
+            tvec = np.ones(N, dtype=bool)
+            for n_i, taints in enumerate(node_taints):
+                if not taints:
+                    continue
+                ok = taint_ok.get(taints)
+                if ok is None:
+                    ok = find_untolerated_taint(taints, p.tolerations) is None
+                    taint_ok[taints] = ok
+                tvec[n_i] = ok
+            m &= tvec
+            # NodeUnschedulable — unschedulable nodes pass only if the pod
+            # tolerates the unschedulable taint
+            if node_unsched.any():
+                tolerated = any(
+                    tolerates(tol, _UNSCHEDULABLE_TAINT) for tol in p.tolerations
+                )
+                if not tolerated:
+                    m &= ~node_unsched
+            # NodePorts
+            want = sig[3]
+            if want:
+                pvec = np.array(
+                    [not _ports_conflict(want, node_ports[n_i]) for n_i in range(N)],
+                    dtype=bool,
+                )
+                m &= pvec
+            sig_cache[sig] = m
+        static_mask[i] = m
+        # NodeName (spec.nodeName pre-assignment) — exact match only
+        if p.node_name:
+            nn = np.array([n == p.node_name for n in nt.node_names], dtype=bool)
+            static_mask[i] &= nn
+        if unknown_resource[i]:
+            static_mask[i] = False
+
+    # distinct static-score signatures → (N,) raw scores
+    score_cache: dict = {}
+    na_raw = np.zeros((P, N), dtype=np.int64)
+    tt_raw = np.zeros((P, N), dtype=np.int64)
+    for i, p in enumerate(pods):
+        sig = _static_score_signature(p)
+        entry = score_cache.get(sig)
+        if entry is None:
+            na_vec = np.zeros(N, dtype=np.int64)
+            na = p.affinity.node_affinity if p.affinity else None
+            if na:
+                for pref in na.preferred:
+                    tm = nt.term_mask(pref.term)
+                    na_vec += pref.weight * tm.astype(np.int64)
+            tt_vec = np.zeros(N, dtype=np.int64)
+            prefer_cache: dict[tuple, int] = {}
+            for n_i, taints in enumerate(node_taints):
+                if not taints:
+                    continue
+                c = prefer_cache.get(taints)
+                if c is None:
+                    c = count_intolerable_prefer_no_schedule(taints, p.tolerations)
+                    prefer_cache[taints] = c
+                tt_vec[n_i] = c
+            entry = (na_vec, tt_vec)
+            score_cache[sig] = entry
+        na_raw[i], tt_raw[i] = entry
+
+    return PodBatch(
+        pods=list(pods),
+        requests=requests,
+        nonzero_requests=nonzero,
+        priority=priority,
+        static_mask=static_mask,
+        node_affinity_raw=na_raw,
+        taint_prefer_raw=tt_raw,
+    )

@@ -1,0 +1,237 @@
+"""Host-side scheduler cache + snapshot.
+
+The analog of ``pkg/scheduler/backend/cache`` (cache.go:59 cacheImpl,
+snapshot.go Snapshot): a mutable cache of nodes and assigned/assumed pods with
+per-node aggregates, and an immutable point-in-time snapshot the scoring
+kernels are generated from.
+
+Semantics mirrored from the reference:
+- ``assume_pod`` (cache.go:397 AssumePod): optimistically add the pod to its
+  nominated node before the bind API call lands; ``finish_binding`` starts the
+  expiry clock; ``forget_pod`` rolls back.
+- ``update_snapshot`` (cache.go:190): incremental — only nodes whose
+  generation advanced since the last snapshot are re-copied.
+- NodeInfo aggregates: ``requested`` (exact) and ``nonzero_requested``
+  (scoring view with 100 mCPU / 200 MiB defaults,
+  pkg/scheduler/util/pod_resources.go) are maintained on add/remove.
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from dataclasses import dataclass, field
+
+from ..api import types as t
+
+
+@dataclass
+class NodeInfo:
+    """Mutable per-node accounting — the analog of fwk.NodeInfo."""
+
+    node: t.Node
+    pods: dict[str, t.Pod] = field(default_factory=dict)  # uid -> pod
+    requested: dict[str, int] = field(default_factory=dict)
+    nonzero_requested: dict[str, int] = field(default_factory=dict)
+    generation: int = 0
+
+    def add_pod(self, pod: t.Pod) -> None:
+        self.pods[pod.uid] = pod
+        for k, v in pod.requests:
+            self.requested[k] = self.requested.get(k, 0) + v
+        for k, v in pod.nonzero_requests().items():
+            self.nonzero_requested[k] = self.nonzero_requested.get(k, 0) + v
+
+    def remove_pod(self, pod: t.Pod) -> None:
+        if pod.uid not in self.pods:
+            return
+        del self.pods[pod.uid]
+        for k, v in pod.requests:
+            self.requested[k] = self.requested.get(k, 0) - v
+        for k, v in pod.nonzero_requests().items():
+            self.nonzero_requested[k] = self.nonzero_requested.get(k, 0) - v
+
+    def clone(self) -> "NodeInfo":
+        return NodeInfo(
+            node=self.node,
+            pods=dict(self.pods),
+            requested=dict(self.requested),
+            nonzero_requested=dict(self.nonzero_requested),
+            generation=self.generation,
+        )
+
+
+@dataclass
+class Snapshot:
+    """Immutable point-in-time view handed to the tensorizer.
+
+    ``node_order`` is the stable iteration order (insertion order, as the
+    reference's nodeTree/snapshot list is) — node *index* in every device
+    tensor is the position in this list.
+    """
+
+    nodes: dict[str, NodeInfo] = field(default_factory=dict)
+    node_order: list[str] = field(default_factory=list)
+    generation: int = 0
+    # per-node cache generation this snapshot last copied (owned by this
+    # snapshot so several snapshots can be refreshed independently)
+    node_generation: dict[str, int] = field(default_factory=dict)
+
+    def node_infos(self) -> list[NodeInfo]:
+        return [self.nodes[n] for n in self.node_order]
+
+    def num_nodes(self) -> int:
+        return len(self.node_order)
+
+    def all_pods(self) -> list[t.Pod]:
+        return [p for n in self.node_order for p in self.nodes[n].pods.values()]
+
+
+class Cache:
+    """The scheduler cache (cache.go:59). Thread-safety is the caller's
+    problem in this framework: the scheduling loop owns the cache and applies
+    informer deltas between batch cycles (single-writer, like the reference's
+    serialized scheduling cycle)."""
+
+    def __init__(self, ttl_seconds: float = 30.0, clock=time.monotonic) -> None:
+        self._nodes: dict[str, NodeInfo] = {}
+        self._node_order: list[str] = []
+        self._pods: dict[str, t.Pod] = {}       # uid -> pod (assigned or assumed)
+        self._assumed: dict[str, float | None] = {}  # uid -> bind-finished deadline
+        self._gen = itertools.count(1)
+        self._ttl = ttl_seconds
+        self._clock = clock
+        self._deleted_nodes: dict[str, NodeInfo] = {}
+
+    # --- nodes -----------------------------------------------------------
+    def add_node(self, node: t.Node) -> None:
+        info = self._nodes.get(node.name)
+        if info is None:
+            # A node deleted while its pods were still assigned keeps its
+            # accounting in _deleted_nodes; a re-add (node flap) restores it.
+            info = self._deleted_nodes.pop(node.name, None)
+            if info is None:
+                info = NodeInfo(node=node)
+            self._nodes[node.name] = info
+            self._node_order.append(node.name)
+        info.node = node
+        info.generation = next(self._gen)
+
+    def update_node(self, node: t.Node) -> None:
+        self.add_node(node)
+
+    def remove_node(self, name: str) -> None:
+        """cache.go RemoveNode semantics: the NodeInfo must survive while pods
+        are still assigned to it (pod deletes arrive on a different watch);
+        it is kept out of the snapshot but retains its accounting until the
+        last pod drains."""
+        info = self._nodes.pop(name, None)
+        if info is None:
+            return
+        self._node_order.remove(name)
+        if info.pods:
+            self._deleted_nodes[name] = info
+
+    # --- pods ------------------------------------------------------------
+    def add_pod(self, pod: t.Pod) -> None:
+        """An assigned pod observed from the watch (AddPod). Idempotent: a
+        relisted duplicate Add replaces the previous accounting instead of
+        double-counting (the reference cache errors on duplicate adds;
+        replace-on-add keeps aggregates correct under informer resyncs)."""
+        if pod.uid in self._pods:
+            # Confirmation of an assumed pod, or a duplicate/resynced Add:
+            # replace the previous view.
+            self._remove_pod_internal(self._pods[pod.uid])
+            self._assumed.pop(pod.uid, None)
+        self._add_pod_internal(pod)
+
+    def update_pod(self, old: t.Pod, new: t.Pod) -> None:
+        self._remove_pod_internal(old)
+        self._add_pod_internal(new)
+
+    def remove_pod(self, pod: t.Pod) -> None:
+        self._assumed.pop(pod.uid, None)
+        self._remove_pod_internal(pod)
+
+    def assume_pod(self, pod: t.Pod) -> None:
+        """cache.go:397 AssumePod — pod must carry node_name."""
+        if not pod.node_name:
+            raise ValueError("assumed pod must have node_name set")
+        if pod.uid in self._pods:
+            raise KeyError(f"pod {pod.uid} already in cache")
+        self._add_pod_internal(pod)
+        self._assumed[pod.uid] = None  # no expiry until binding finishes
+
+    def finish_binding(self, uid: str) -> None:
+        if uid in self._assumed:
+            self._assumed[uid] = self._clock() + self._ttl
+
+    def forget_pod(self, pod: t.Pod) -> None:
+        if pod.uid in self._assumed:
+            del self._assumed[pod.uid]
+            self._remove_pod_internal(pod)
+
+    def is_assumed(self, uid: str) -> bool:
+        return uid in self._assumed
+
+    def cleanup_expired(self) -> list[str]:
+        """Expire assumed pods whose bind never confirmed (cache.go expiry
+        goroutine). Returns expired uids."""
+        now = self._clock()
+        expired = [
+            uid for uid, dl in self._assumed.items() if dl is not None and dl < now
+        ]
+        for uid in expired:
+            pod = self._pods[uid]
+            del self._assumed[uid]
+            self._remove_pod_internal(pod)
+        return expired
+
+    def _add_pod_internal(self, pod: t.Pod) -> None:
+        if not pod.node_name:
+            raise ValueError(f"cached pod {pod.uid} must have node_name set")
+        self._pods[pod.uid] = pod
+        info = self._nodes.get(pod.node_name)
+        if info is None and pod.node_name in self._deleted_nodes:
+            info = self._deleted_nodes[pod.node_name]
+        if info is None:
+            # Pod on an unknown node: create a placeholder (the reference
+            # keeps such pods in an imaginary nodeInfo too).
+            info = NodeInfo(node=t.Node(name=pod.node_name))
+            self._nodes[pod.node_name] = info
+            self._node_order.append(pod.node_name)
+        info.add_pod(pod)
+        info.generation = next(self._gen)
+
+    def _remove_pod_internal(self, pod: t.Pod) -> None:
+        self._pods.pop(pod.uid, None)
+        info = self._nodes.get(pod.node_name)
+        if info is None:
+            info = self._deleted_nodes.get(pod.node_name)
+        if info is not None:
+            info.remove_pod(pod)
+            info.generation = next(self._gen)
+            if not info.pods and pod.node_name in self._deleted_nodes:
+                del self._deleted_nodes[pod.node_name]
+
+    # --- snapshot --------------------------------------------------------
+    def update_snapshot(self, snapshot: Snapshot | None = None) -> Snapshot:
+        """Incremental snapshot refresh (cache.go:190): clone only nodes whose
+        generation moved; preserve node order."""
+        if snapshot is None:
+            snapshot = Snapshot()
+        new_nodes: dict[str, NodeInfo] = {}
+        new_gens: dict[str, int] = {}
+        for name in self._node_order:
+            info = self._nodes[name]
+            prev = snapshot.nodes.get(name)
+            if prev is not None and snapshot.node_generation.get(name) == info.generation:
+                new_nodes[name] = prev
+            else:
+                new_nodes[name] = info.clone()
+            new_gens[name] = info.generation
+        snapshot.nodes = new_nodes
+        snapshot.node_generation = new_gens
+        snapshot.node_order = list(self._node_order)
+        snapshot.generation = next(self._gen)
+        return snapshot

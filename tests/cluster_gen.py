@@ -1,0 +1,97 @@
+"""Randomized cluster generators shared by parity tests."""
+
+from __future__ import annotations
+
+import numpy as np
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.state.snapshot import Cache
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+REGIONS = ["r1", "r2"]
+
+
+def random_cluster(
+    rng: np.random.Generator,
+    num_nodes: int = 40,
+    num_existing: int = 60,
+    num_pending: int = 30,
+    with_extended: bool = False,
+    with_taints: bool = False,
+):
+    """Build a cache with nodes + assigned pods, and a pending-pod list."""
+    cache = Cache()
+    nodes = []
+    for i in range(num_nodes):
+        labels = {
+            "kubernetes.io/hostname": f"node-{i}",
+            "topology.kubernetes.io/zone": ZONES[i % len(ZONES)],
+            "topology.kubernetes.io/region": REGIONS[i % len(REGIONS)],
+        }
+        if rng.random() < 0.3:
+            labels["disktype"] = rng.choice(["ssd", "hdd"])
+        taints = ()
+        if with_taints and rng.random() < 0.3:
+            effect = rng.choice(
+                [t.TaintEffect.NO_SCHEDULE, t.TaintEffect.PREFER_NO_SCHEDULE]
+            )
+            taints = (t.Taint(key="dedicated", value="gpu", effect=effect),)
+        extended = {"example.com/foo": int(rng.integers(0, 8))} if with_extended else None
+        node = make_node(
+            f"node-{i}",
+            cpu_milli=int(rng.integers(1000, 16001)),
+            memory=int(rng.integers(2, 64)) * 1024**3,
+            pods=int(rng.integers(4, 110)),
+            labels=labels,
+            taints=taints,
+            extended=extended,
+            unschedulable=bool(rng.random() < 0.05),
+        )
+        nodes.append(node)
+        cache.add_node(node)
+
+    for j in range(num_existing):
+        node = nodes[int(rng.integers(0, num_nodes))]
+        pod = make_pod(
+            f"existing-{j}",
+            cpu_milli=int(rng.integers(0, 2001)),
+            memory=int(rng.integers(0, 4)) * 512 * 1024**2,
+            labels={"app": rng.choice(["web", "db", "cache"])},
+            node_name=node.name,
+            host_ports=[int(rng.integers(8000, 8004))] if rng.random() < 0.2 else [],
+        )
+        cache.add_pod(pod)
+
+    pending = []
+    for j in range(num_pending):
+        kwargs = {}
+        if rng.random() < 0.3:
+            kwargs["node_selector"] = {"disktype": "ssd"}
+        if with_taints and rng.random() < 0.5:
+            kwargs["tolerations"] = [
+                t.Toleration(
+                    key="dedicated",
+                    operator=t.TolerationOperator.EQUAL,
+                    value="gpu",
+                    effect=None,
+                )
+            ]
+        req = {}
+        if rng.random() < 0.9:
+            req[t.CPU] = int(rng.integers(0, 3001))
+        if rng.random() < 0.9:
+            req[t.MEMORY] = int(rng.integers(0, 8)) * 256 * 1024**2
+        if with_extended and rng.random() < 0.4:
+            req["example.com/foo"] = int(rng.integers(1, 4))
+        pending.append(
+            make_pod(
+                f"pending-{j}",
+                requests=req,
+                labels={"app": rng.choice(["web", "db", "cache"])},
+                host_ports=[int(rng.integers(8000, 8004))] if rng.random() < 0.2 else [],
+                creation_index=j,
+                **kwargs,
+            )
+        )
+    return cache, pending

@@ -1,0 +1,26 @@
+"""Test environment: force a pure-CPU JAX with an 8-device virtual mesh.
+
+Gotcha this guards against: the axon TPU plugin's ``sitecustomize`` imports
+jax at interpreter startup with ambient ``JAX_PLATFORMS=axon`` — env vars set
+here are too late, and any backend touch would dial the TPU relay (hanging
+the whole suite if the relay is down). ``jax.config.update`` works after
+import as long as no backend has been initialized yet, which is the case when
+conftest runs. Tests must never depend on the TPU tunnel.
+
+``xla_force_host_platform_device_count=8``: multi-chip hardware is not
+available, so shardings are validated on a virtual 8-device CPU mesh (same
+scheme as the driver's dryrun).
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

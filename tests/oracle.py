@@ -1,0 +1,220 @@
+"""Reference-semantics oracle for parity tests.
+
+A deliberately naive, per-(pod, node) scalar-Python implementation of the
+reference scheduler's Filter/Score math (cited per function). The JAX kernels
+are tested against this oracle on randomized clusters — the same role the
+reference's golden table-driven unit tests play (SURVEY §4).
+"""
+
+from __future__ import annotations
+
+import math
+
+from kubetpu.api import selectors as sel
+from kubetpu.api import types as t
+from kubetpu.state.snapshot import NodeInfo
+
+MAX = 100
+
+
+# --- NodeResourcesFit Filter (fit.go:647) ---------------------------------
+
+def fits(pod: t.Pod, info: NodeInfo) -> bool:
+    alloc = info.node.allocatable_dict()
+    if len(info.pods) + 1 > alloc.get(t.PODS, 0):
+        return False
+    req = pod.requests_dict()
+    for k, v in req.items():
+        if v <= 0:
+            continue
+        if v > alloc.get(k, 0) - info.requested.get(k, 0):
+            return False
+    return True
+
+
+# --- LeastAllocated (least_allocated.go:31) -------------------------------
+
+def least_requested_score(requested: int, capacity: int) -> int:
+    if capacity == 0 or requested > capacity:
+        return 0
+    return ((capacity - requested) * MAX) // capacity
+
+
+def least_allocated(pod: t.Pod, info: NodeInfo, resources: list[tuple[str, int]]) -> int:
+    pod_nz = pod.nonzero_requests()
+    score_sum = 0
+    weight_sum = 0
+    for name, weight in resources:
+        pod_req = pod_nz.get(name, 0)
+        is_scalar = name not in (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
+        if is_scalar and pod_req == 0:
+            continue
+        cap = info.node.allocatable_dict().get(name, 0)
+        if cap == 0:
+            continue
+        requested = info.nonzero_requested.get(name, 0) + pod_req
+        score_sum += least_requested_score(requested, cap) * weight
+        weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    return score_sum // weight_sum
+
+
+def most_requested_score(requested: int, capacity: int) -> int:
+    if capacity == 0:
+        return 0
+    requested = min(requested, capacity)
+    return (requested * MAX) // capacity
+
+
+def most_allocated(pod: t.Pod, info: NodeInfo, resources: list[tuple[str, int]]) -> int:
+    pod_nz = pod.nonzero_requests()
+    score_sum = 0
+    weight_sum = 0
+    for name, weight in resources:
+        pod_req = pod_nz.get(name, 0)
+        is_scalar = name not in (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
+        if is_scalar and pod_req == 0:
+            continue
+        cap = info.node.allocatable_dict().get(name, 0)
+        if cap == 0:
+            continue
+        requested = info.nonzero_requested.get(name, 0) + pod_req
+        score_sum += most_requested_score(requested, cap) * weight
+        weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    return score_sum // weight_sum
+
+
+# --- RequestedToCapacityRatio (requested_to_capacity_ratio.go) ------------
+
+def broken_linear(shape: list[tuple[int, int]], p: int) -> int:
+    for i, (x, y) in enumerate(shape):
+        if p <= x:
+            if i == 0:
+                return shape[0][1]
+            x0, y0 = shape[i - 1]
+            num = (y - y0) * (p - x0)
+            den = x - x0
+            q = abs(num) // den
+            return y0 + (-q if num < 0 else q)  # Go truncating division
+    return shape[-1][1]
+
+
+def requested_to_capacity_ratio(
+    pod: t.Pod, info: NodeInfo, resources: list[tuple[str, int]],
+    shape: list[tuple[int, int]],
+) -> int:
+    pod_nz = pod.nonzero_requests()
+    score_sum = 0
+    weight_sum = 0
+    for name, weight in resources:
+        pod_req = pod_nz.get(name, 0)
+        is_scalar = name not in (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
+        if is_scalar and pod_req == 0:
+            continue
+        cap = info.node.allocatable_dict().get(name, 0)
+        if cap == 0:
+            continue
+        requested = info.nonzero_requested.get(name, 0) + pod_req
+        if requested > cap:
+            rs = broken_linear(shape, MAX)
+        else:
+            rs = broken_linear(shape, requested * MAX // cap)
+        if rs > 0:
+            score_sum += rs * weight
+            weight_sum += weight
+    if weight_sum == 0:
+        return 0
+    # math.Round on non-negative
+    return (2 * score_sum + weight_sum) // (2 * weight_sum)
+
+
+# --- ImageLocality (image_locality.go:96) ---------------------------------
+
+def image_locality(sum_scores: int, image_count: int) -> int:
+    min_threshold = 23 * 1024 * 1024
+    max_threshold = 1000 * 1024 * 1024 * image_count
+    s = max(sum_scores, min_threshold)
+    s = min(s, max(max_threshold, min_threshold))
+    denom = max(max_threshold - min_threshold, 1)
+    return MAX * (s - min_threshold) // denom
+
+
+# --- BalancedAllocation (balanced_allocation.go:248) ----------------------
+
+def _balanced_resource_score(fractions: list[float]) -> int:
+    std = 0.0
+    if len(fractions) == 2:
+        std = abs((fractions[0] - fractions[1]) / 2)
+    elif len(fractions) > 2:
+        mean = sum(fractions) / len(fractions)
+        std = math.sqrt(sum((f - mean) ** 2 for f in fractions) / len(fractions))
+    return int((1 - std) * MAX)
+
+
+def balanced_allocation(pod: t.Pod, info: NodeInfo, resources: list[tuple[str, int]]) -> int:
+    pod_req = pod.requests_dict()
+    # best-effort skip (PreScore Skip)
+    if all(pod_req.get(name, 0) == 0 for name, _ in resources):
+        return 0
+    f_with, f_without = [], []
+    for name, _w in resources:
+        preq = pod_req.get(name, 0)
+        is_scalar = name not in (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
+        if is_scalar and preq == 0:
+            continue
+        cap = info.node.allocatable_dict().get(name, 0)
+        if cap == 0:
+            continue
+        have = info.requested.get(name, 0)
+        f_with.append(min((have + preq) / cap, 1.0))
+        f_without.append(min(have / cap, 1.0))
+    sw = _balanced_resource_score(f_with)
+    swo = _balanced_resource_score(f_without)
+    return MAX // 2 + (MAX // 2 + sw - swo) // 2
+
+
+# --- TaintToleration / NodeAffinity / normalize ---------------------------
+
+def taint_filter(pod: t.Pod, info: NodeInfo) -> bool:
+    return sel.find_untolerated_taint(info.node.taints, pod.tolerations) is None
+
+
+def taint_score_raw(pod: t.Pod, info: NodeInfo) -> int:
+    return sel.count_intolerable_prefer_no_schedule(info.node.taints, pod.tolerations)
+
+
+def node_affinity_filter(pod: t.Pod, info: NodeInfo) -> bool:
+    labels = info.node.labels_dict()
+    for k, v in pod.node_selector:
+        if labels.get(k) != v:
+            return False
+    na = pod.affinity.node_affinity if pod.affinity else None
+    if na and na.required is not None:
+        if not sel.node_selector_matches(na.required, labels, info.node.name):
+            return False
+    return True
+
+
+def node_affinity_score_raw(pod: t.Pod, info: NodeInfo) -> int:
+    na = pod.affinity.node_affinity if pod.affinity else None
+    if not na:
+        return 0
+    labels = info.node.labels_dict()
+    count = 0
+    for pref in na.preferred:
+        if sel.node_selector_term_matches(pref.term, labels, info.node.name):
+            count += pref.weight
+    return count
+
+
+def default_normalize(scores: list[int], reverse: bool = False) -> list[int]:
+    mx = max(scores) if scores else 0
+    if mx == 0:
+        return [MAX] * len(scores) if reverse else list(scores)
+    out = [MAX * s // mx for s in scores]
+    if reverse:
+        out = [MAX - s for s in out]
+    return out

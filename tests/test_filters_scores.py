@@ -1,0 +1,298 @@
+"""Parity tests: JAX filter/score kernels vs the scalar oracle
+(the analog of the reference's table-driven plugin unit tests, SURVEY §4)."""
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.ops import filters, scores
+from kubetpu.state import Cache, encode_pod_batch, encode_snapshot
+
+from .cluster_gen import random_cluster
+from . import oracle
+
+RESOURCES = [(t.CPU, 1), (t.MEMORY, 1)]
+
+
+def encode(cache, pending):
+    snap = cache.update_snapshot()
+    nt = encode_snapshot(snap, pods=pending)
+    pb = encode_pod_batch(nt, pending)
+    return snap, nt, pb
+
+
+def weights_arrays(nt, resources=RESOURCES):
+    w = np.zeros(nt.num_resources, dtype=np.int64)
+    for name, weight in resources:
+        if name in nt.resource_names:
+            w[nt.resource_names.index(name)] = weight
+    is_scalar = np.array(
+        [r not in (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE) for r in nt.resource_names]
+    )
+    return jnp.asarray(w), jnp.asarray(is_scalar)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("with_extended", [False, True])
+def test_resource_fit_parity(seed, with_extended):
+    rng = np.random.default_rng(seed)
+    cache, pending = random_cluster(rng, with_extended=with_extended)
+    snap, nt, pb = encode(cache, pending)
+    mask = np.asarray(
+        filters.resource_fit_mask(
+            jnp.asarray(pb.requests),
+            jnp.asarray(nt.alloc),
+            jnp.asarray(nt.requested),
+            jnp.asarray(nt.pod_count),
+            jnp.asarray(nt.allowed_pods),
+        )
+    )
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert mask[i, j] == oracle.fits(pod, info), (pod.name, info.node.name)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+@pytest.mark.parametrize("with_extended", [False, True])
+def test_least_allocated_parity(seed, with_extended):
+    rng = np.random.default_rng(seed + 10)
+    cache, pending = random_cluster(rng, with_extended=with_extended)
+    snap, nt, pb = encode(cache, pending)
+    resources = RESOURCES + ([("example.com/foo", 2)] if with_extended else [])
+    w, is_scalar = weights_arrays(nt, resources)
+    got = np.asarray(
+        scores.least_allocated_score(
+            jnp.asarray(pb.nonzero_requests),
+            jnp.asarray(nt.nonzero_requested),
+            jnp.asarray(nt.alloc),
+            w,
+            is_scalar,
+        )
+    )
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert got[i, j] == oracle.least_allocated(pod, info, resources)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_most_allocated_parity(seed):
+    rng = np.random.default_rng(seed + 20)
+    cache, pending = random_cluster(rng)
+    snap, nt, pb = encode(cache, pending)
+    w, is_scalar = weights_arrays(nt)
+    got = np.asarray(
+        scores.most_allocated_score(
+            jnp.asarray(pb.nonzero_requests),
+            jnp.asarray(nt.nonzero_requested),
+            jnp.asarray(nt.alloc),
+            w,
+            is_scalar,
+        )
+    )
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert got[i, j] == oracle.most_allocated(pod, info, RESOURCES)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_balanced_allocation_parity(seed):
+    rng = np.random.default_rng(seed + 30)
+    cache, pending = random_cluster(rng)
+    snap, nt, pb = encode(cache, pending)
+    w, is_scalar = weights_arrays(nt)
+    got = np.asarray(
+        scores.balanced_allocation_score(
+            jnp.asarray(pb.requests),
+            jnp.asarray(nt.requested),
+            jnp.asarray(nt.alloc),
+            w,
+            is_scalar,
+        )
+    )
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert got[i, j] == oracle.balanced_allocation(pod, info, RESOURCES), (
+                pod.name,
+                info.node.name,
+            )
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_static_mask_taints_affinity_ports(seed):
+    rng = np.random.default_rng(seed + 40)
+    cache, pending = random_cluster(rng, with_taints=True)
+    snap, nt, pb = encode(cache, pending)
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            want = (
+                oracle.taint_filter(pod, info)
+                and oracle.node_affinity_filter(pod, info)
+                and not info.node.unschedulable
+            )
+            # port conflicts
+            used = {
+                (cp.host_port, cp.protocol, cp.host_ip or "0.0.0.0")
+                for p in info.pods.values()
+                for cp in p.ports
+            }
+            for cp in pod.ports:
+                if any(
+                    cp.host_port == up and cp.protocol == uproto
+                    for up, uproto, _ in used
+                ):
+                    want = False
+            assert pb.static_mask[i, j] == want, (pod.name, info.node.name)
+
+
+def test_taint_prefer_and_node_affinity_raw_scores():
+    rng = np.random.default_rng(7)
+    cache, pending = random_cluster(rng, with_taints=True)
+    # add a pod with preferred node affinity
+    pref = t.Affinity(
+        node_affinity=t.NodeAffinity(
+            preferred=(
+                t.PreferredSchedulingTerm(
+                    weight=5,
+                    term=t.NodeSelectorTerm(
+                        match_expressions=(
+                            t.Requirement(
+                                "disktype", t.Operator.IN, ("ssd",)
+                            ),
+                        )
+                    ),
+                ),
+                t.PreferredSchedulingTerm(
+                    weight=3,
+                    term=t.NodeSelectorTerm(
+                        match_expressions=(
+                            t.Requirement(
+                                "topology.kubernetes.io/zone",
+                                t.Operator.IN,
+                                ("zone-a",),
+                            ),
+                        )
+                    ),
+                ),
+            )
+        )
+    )
+    pending = pending[:5] + [make_pod("aff-pod", cpu_milli=100, affinity=pref)]
+    snap, nt, pb = encode(cache, pending)
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert pb.node_affinity_raw[i, j] == oracle.node_affinity_score_raw(pod, info)
+            assert pb.taint_prefer_raw[i, j] == oracle.taint_score_raw(pod, info)
+
+
+def test_default_normalize_matches_oracle():
+    rng = np.random.default_rng(3)
+    raw = rng.integers(0, 50, size=(4, 9)).astype(np.int64)
+    raw[2] = 0  # all-zero row
+    for reverse in (False, True):
+        got = np.asarray(scores.default_normalize(jnp.asarray(raw), reverse=reverse))
+        for i in range(raw.shape[0]):
+            assert list(got[i]) == oracle.default_normalize(list(raw[i]), reverse)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_requested_to_capacity_ratio_parity(seed):
+    rng = np.random.default_rng(seed + 50)
+    cache, pending = random_cluster(rng)
+    snap, nt, pb = encode(cache, pending)
+    w, is_scalar = weights_arrays(nt)
+    # decreasing shape (bin-packing default-ish): (0,100),(100,0) pre-scaled
+    shape = [(0, 100), (40, 60), (100, 0)]
+    xs = jnp.asarray(np.array([x for x, _ in shape], dtype=np.int64))
+    ys = jnp.asarray(np.array([y for _, y in shape], dtype=np.int64))
+    got = np.asarray(
+        scores.requested_to_capacity_ratio_score(
+            jnp.asarray(pb.nonzero_requests),
+            jnp.asarray(nt.nonzero_requested),
+            jnp.asarray(nt.alloc),
+            w,
+            is_scalar,
+            xs,
+            ys,
+        )
+    )
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert got[i, j] == oracle.requested_to_capacity_ratio(
+                pod, info, RESOURCES, shape
+            ), (pod.name, info.node.name)
+
+
+def test_broken_linear_exact_integer_points():
+    # (0,0),(70,10): utilization 7 -> 10*7//70 = 1 exactly (float32 interp
+    # would truncate to 0)
+    xs = jnp.asarray(np.array([0, 70], dtype=np.int64))
+    ys = jnp.asarray(np.array([0, 10], dtype=np.int64))
+    p = jnp.asarray(np.array([0, 7, 35, 70, 90], dtype=np.int64))
+    got = list(np.asarray(scores.broken_linear(p, xs, ys)))
+    want = [oracle.broken_linear([(0, 0), (70, 10)], int(v)) for v in [0, 7, 35, 70, 90]]
+    assert got == want == [0, 1, 5, 10, 10]
+
+
+def test_image_locality_parity():
+    rng = np.random.default_rng(9)
+    sums = rng.integers(0, 3 * 1024**3, size=(5, 7)).astype(np.int64)
+    counts = rng.integers(1, 5, size=5).astype(np.int32)
+    got = np.asarray(
+        scores.image_locality_score(jnp.asarray(sums), jnp.asarray(counts))
+    )
+    for i in range(5):
+        for j in range(7):
+            assert got[i, j] == oracle.image_locality(int(sums[i, j]), int(counts[i]))
+
+
+def test_unknown_resource_request_is_infeasible_everywhere():
+    cache = Cache()
+    cache.add_node(make_node("n0"))
+    pending = [make_pod("p", requests={"example.com/fpga": 1}), make_pod("q", cpu_milli=1)]
+    snap = cache.update_snapshot()
+    # encode WITHOUT passing pods: the axis omits the fpga resource
+    nt = encode_snapshot(snap)
+    pb = encode_pod_batch(nt, pending)
+    assert not pb.static_mask[0].any()
+    assert pb.static_mask[1].all()
+
+
+def test_second_snapshot_not_stale():
+    cache = Cache()
+    cache.add_node(make_node("n1"))
+    snap_a = cache.update_snapshot()
+    snap_b = cache.update_snapshot()
+    cache.add_pod(make_pod("p", cpu_milli=100, node_name="n1"))
+    snap_a = cache.update_snapshot(snap_a)
+    snap_b = cache.update_snapshot(snap_b)
+    assert snap_b.nodes["n1"].requested[t.CPU] == 100
+
+
+def test_pod_count_filter():
+    cache, _ = random_cluster(np.random.default_rng(0), num_nodes=1, num_existing=0, num_pending=0)
+    node = make_node("tiny", pods=1)
+    cache.add_node(node)
+    cache.add_pod(make_pod("p0", cpu_milli=1, node_name="tiny"))
+    pending = [make_pod("p1", cpu_milli=1)]
+    snap, nt, pb = encode(cache, pending)
+    j = snap.node_order.index("tiny")
+    mask = np.asarray(
+        filters.resource_fit_mask(
+            jnp.asarray(pb.requests),
+            jnp.asarray(nt.alloc),
+            jnp.asarray(nt.requested),
+            jnp.asarray(nt.pod_count),
+            jnp.asarray(nt.allowed_pods),
+        )
+    )
+    assert not mask[0, j]

@@ -1,0 +1,166 @@
+"""Cache/snapshot semantics tests (analog of backend/cache tests)."""
+
+import numpy as np
+
+from kubetpu.api import types as t
+from kubetpu.api.requests import pod_requests
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.state import Cache, encode_snapshot
+
+
+def test_pod_requests_aggregation():
+    # max(sum(containers), max(init)) + overhead (fit.go:317)
+    req = pod_requests(
+        containers=[{t.CPU: 100, t.MEMORY: 200}, {t.CPU: 300}],
+        init_containers=[{t.CPU: 700}, {t.MEMORY: 100}],
+        overhead={t.CPU: 10},
+    )
+    assert req[t.CPU] == 700 + 10  # init container dominates cpu
+    assert req[t.MEMORY] == 200
+
+
+def test_nonzero_defaults_per_container():
+    # types.go:1035 CalculateResource: defaults fill PER CONTAINER.
+    # containers [{cpu:500m}, {memory:1GiB}] -> Non0CPU=600m, Non0Mem=1GiB+200MiB
+    p = make_pod("p", containers=[{t.CPU: 500}, {t.MEMORY: 1024**3}])
+    nz = p.nonzero_requests()
+    assert nz[t.CPU] == 500 + 100
+    assert nz[t.MEMORY] == 1024**3 + 200 * 1024 * 1024
+    # exact requests unchanged
+    assert p.requests_dict() == {t.CPU: 500, t.MEMORY: 1024**3}
+
+
+def test_duplicate_add_pod_does_not_double_count():
+    cache = Cache()
+    cache.add_node(make_node("n1"))
+    pod = make_pod("p", cpu_milli=500, node_name="n1")
+    cache.add_pod(pod)
+    cache.add_pod(pod)  # informer relist duplicate
+    snap = cache.update_snapshot()
+    assert snap.nodes["n1"].requested[t.CPU] == 500
+
+
+def test_empty_key_equal_toleration_matches_value():
+    # toleration.go ToleratesTaint: empty key skips the key check entirely
+    from kubetpu.api.selectors import tolerates
+    tol = t.Toleration(key="", operator=t.TolerationOperator.EQUAL, value="v")
+    assert tolerates(tol, t.Taint(key="anything", value="v"))
+    assert not tolerates(tol, t.Taint(key="anything", value="other"))
+
+
+def test_nonzero_defaults():
+    p = make_pod("p", requests={})
+    nz = p.nonzero_requests()
+    assert nz[t.CPU] == 100
+    assert nz[t.MEMORY] == 200 * 1024 * 1024
+    p2 = make_pod("p2", cpu_milli=50)
+    assert p2.nonzero_requests()[t.CPU] == 50
+
+
+def test_assume_forget_expire():
+    clock = [0.0]
+    cache = Cache(ttl_seconds=10.0, clock=lambda: clock[0])
+    cache.add_node(make_node("n1"))
+    pod = make_pod("p", cpu_milli=500, node_name="n1")
+    cache.assume_pod(pod)
+    snap = cache.update_snapshot()
+    assert snap.nodes["n1"].requested[t.CPU] == 500
+
+    # forget rolls back
+    cache.forget_pod(pod)
+    snap = cache.update_snapshot(snap)
+    assert snap.nodes["n1"].requested.get(t.CPU, 0) == 0
+
+    # assume + finish binding + expiry
+    cache.assume_pod(pod)
+    cache.finish_binding(pod.uid)
+    clock[0] = 5.0
+    assert cache.cleanup_expired() == []
+    clock[0] = 11.0
+    assert cache.cleanup_expired() == [pod.uid]
+    snap = cache.update_snapshot(snap)
+    assert snap.nodes["n1"].requested.get(t.CPU, 0) == 0
+
+
+def test_add_pod_confirms_assumed():
+    cache = Cache()
+    cache.add_node(make_node("n1"))
+    pod = make_pod("p", cpu_milli=500, node_name="n1")
+    cache.assume_pod(pod)
+    cache.add_pod(pod)  # informer confirmation
+    assert not cache.is_assumed(pod.uid)
+    snap = cache.update_snapshot()
+    assert snap.nodes["n1"].requested[t.CPU] == 500  # not double-counted
+
+
+def test_incremental_snapshot_reuses_unchanged_nodes():
+    cache = Cache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}"))
+    snap = cache.update_snapshot()
+    before = {n: id(info) for n, info in snap.nodes.items()}
+    cache.add_pod(make_pod("p", cpu_milli=100, node_name="n2"))
+    snap = cache.update_snapshot(snap)
+    after = {n: id(info) for n, info in snap.nodes.items()}
+    assert before["n0"] == after["n0"]  # untouched nodes not re-cloned
+    assert before["n2"] != after["n2"]  # updated node re-cloned
+
+
+def test_encode_snapshot_resource_axes():
+    cache = Cache()
+    cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30, pods=10,
+                             extended={"example.com/gpu": 4}))
+    cache.add_pod(make_pod("e0", cpu_milli=250, node_name="n0"))
+    snap = cache.update_snapshot()
+    nt = encode_snapshot(snap)
+    assert nt.resource_names[:3] == [t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE]
+    assert "example.com/gpu" in nt.resource_names
+    i = nt.resource_names.index(t.CPU)
+    assert nt.alloc[0, i] == 1000
+    assert nt.requested[0, i] == 250
+    # NonZero view adds the 200MiB default for the memory-less pod
+    j = nt.resource_names.index(t.MEMORY)
+    assert nt.nonzero_requested[0, j] == 200 * 1024 * 1024
+    assert nt.pod_count[0] == 1
+    assert nt.allowed_pods[0] == 10
+
+
+def test_remove_node_keeps_pod_accounting():
+    # cache.go RemoveNode: accounting survives while pods remain (node flap)
+    cache = Cache()
+    cache.add_node(make_node("n1"))
+    cache.add_pod(make_pod("p", cpu_milli=500, node_name="n1"))
+    cache.remove_node("n1")
+    snap = cache.update_snapshot()
+    assert "n1" not in snap.nodes
+    cache.add_node(make_node("n1"))  # node comes back before pod delete
+    snap = cache.update_snapshot(snap)
+    assert snap.nodes["n1"].requested[t.CPU] == 500
+    # pod delete drains the accounting
+    cache.remove_pod(make_pod("p", cpu_milli=500, node_name="n1"))
+    snap = cache.update_snapshot(snap)
+    assert snap.nodes["n1"].requested.get(t.CPU, 0) == 0
+
+
+def test_add_pod_without_node_name_rejected():
+    cache = Cache()
+    try:
+        cache.add_pod(make_pod("pending"))
+    except ValueError:
+        pass
+    else:
+        raise AssertionError("expected ValueError for empty node_name")
+    snap = cache.update_snapshot()
+    assert snap.node_order == []  # no phantom "" node
+
+
+def test_topology_values():
+    cache = Cache()
+    cache.add_node(make_node("a", labels={"zone": "z1"}))
+    cache.add_node(make_node("b", labels={"zone": "z2"}))
+    cache.add_node(make_node("c", labels={}))
+    nt = encode_snapshot(cache.update_snapshot())
+    vals = nt.topology_values("zone")
+    assert vals[0] != vals[1]
+    assert vals[2] == -1
+    assert (nt.topology_values("nope") == -1).all()
