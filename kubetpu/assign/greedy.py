@@ -1,0 +1,107 @@
+"""Greedy assignment as one device-resident ``lax.scan``.
+
+The reference schedules pods strictly one at a time: ``scheduleOne`` pops a
+pod, filters + scores all nodes against the *current* cache (which includes
+all previously assumed pods), picks the best node (``selectHost``,
+schedule_one.go:605), and assumes the pod onto it (cache.AssumePod,
+backend/cache/cache.go:397) before the next pod starts. That serialization is
+what makes greedy results well-defined on saturated clusters.
+
+Here the same semantics run as a single XLA program: ``lax.scan`` over the
+pod axis, carrying ``(requested, nonzero_requested, pod_count)`` node-state
+tensors; each step re-runs the full Filter+Score composition for one pod
+against the running state and updates it with a one-hot scatter. No
+host↔device round-trips inside the batch.
+
+Tie-breaking: the reference picks uniformly at random among max-score nodes
+(schedule_one.go:1037 reservoir sample). We take the FIRST max-score node in
+snapshot order — deterministic, replayable, and within the documented parity
+budget (ties are score-equivalent by definition).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import runtime as rt
+
+
+def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
+    """P=1 view of pod ``i`` (traced index) over the same nodes."""
+    return rt.DeviceBatch(
+        alloc=b.alloc,
+        requested=b.requested,
+        nonzero_requested=b.nonzero_requested,
+        pod_count=b.pod_count,
+        allowed_pods=b.allowed_pods,
+        node_valid=b.node_valid,
+        requests=b.requests[i][None],
+        nonzero_requests=b.nonzero_requests[i][None],
+        pod_valid=b.pod_valid[i][None],
+        static_mask=b.static_mask[i][None],
+        node_affinity_raw=b.node_affinity_raw[i][None],
+        taint_prefer_raw=b.taint_prefer_raw[i][None],
+        image_sum_scores=b.image_sum_scores[i][None],
+        image_count=b.image_count[i][None],
+        pod_ports=b.pod_ports[i][None],
+        node_ports=b.node_ports,
+        port_conflict=b.port_conflict,
+    )
+
+
+@partial(jax.jit, static_argnames=("params",))
+def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
+    """Run the greedy scan. Returns ``(assignments (P,) int32 node index or
+    -1, final_state)`` where final_state is the post-batch
+    ``(requested, nonzero_requested, pod_count)`` — the cache applies it as
+    the batch's assume step."""
+
+    n = b.alloc.shape[0]
+    node_iota = jnp.arange(n, dtype=jnp.int32)
+
+    def step(state, i):
+        requested, nonzero, pod_count, node_ports = state
+        view = _pod_view(b, i)
+        mask, score = rt.feasible_and_scores(
+            view, params,
+            requested=requested, nonzero_requested=nonzero,
+            pod_count=pod_count, node_ports=node_ports,
+        )
+        mask, score = mask[0], score[0]
+        feasible = jnp.any(mask)
+        best = jnp.argmax(jnp.where(mask, score, -1)).astype(jnp.int32)
+        chosen = jnp.where(feasible, best, jnp.int32(-1))
+        onehot = (node_iota == chosen) & feasible           # (N,) bool
+        oh64 = onehot.astype(jnp.int64)[:, None]
+        requested = requested + oh64 * view.requests[0][None, :]
+        nonzero = nonzero + oh64 * view.nonzero_requests[0][None, :]
+        pod_count = pod_count + onehot.astype(pod_count.dtype)
+        node_ports = node_ports | (onehot[:, None] & view.pod_ports[0][None, :])
+        return (requested, nonzero, pod_count, node_ports), chosen
+
+    p = b.requests.shape[0]
+    init = (b.requested, b.nonzero_requested, b.pod_count, b.node_ports)
+    final_state, assignments = jax.lax.scan(
+        step, init, jnp.arange(p, dtype=jnp.int32)
+    )
+    return assignments, final_state
+
+
+def greedy_assign(
+    batch: rt.EncodedBatch, profile=None, params: rt.ScoreParams | None = None
+) -> list[str | None]:
+    """Host wrapper: run the scan and map node indices back to names.
+    Unschedulable (and padded) pods map to ``None``."""
+    if params is None:
+        from ..framework import config as C
+        params = rt.score_params(profile or C.Profile(), batch.resource_names)
+    assignments, _ = greedy_assign_device(batch.device, params)
+    out: list[str | None] = []
+    idx = jax.device_get(assignments)
+    for i in range(batch.num_pods):
+        j = int(idx[i])
+        out.append(batch.node_names[j] if 0 <= j < len(batch.node_names) else None)
+    return out

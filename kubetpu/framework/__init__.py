@@ -1,0 +1,15 @@
+"""Framework — plugin composition, profiles, configuration.
+
+The analog of ``pkg/scheduler/framework/runtime`` + ``pkg/scheduler/apis/config``.
+"""
+
+from . import config  # noqa: F401
+from .config import Profile, SchedulerConfiguration, minimal_profile  # noqa: F401
+from .runtime import (  # noqa: F401
+    DeviceBatch,
+    EncodedBatch,
+    ScoreParams,
+    encode_batch,
+    filter_score_batch,
+    score_params,
+)

@@ -1,0 +1,330 @@
+"""Framework runtime — compose filter/score kernels per profile.
+
+The analog of ``pkg/scheduler/framework/runtime/framework.go``: the reference
+runs, per pod, PreFilter → parallel per-node Filter → PreScore → parallel
+per-node Score → NormalizeScore → weight multiply → sum
+(``RunScorePlugins``, framework.go:1351). Here the whole batch is one tensor
+program: every enabled plugin contributes a ``(P, N)`` raw score tensor, the
+runtime applies each plugin's NormalizeScore rule (masked to feasible nodes —
+the reference only ever scores nodes that passed Filter), multiplies by the
+profile weight, and sums into the total ``(P, N)`` score used for selection.
+
+The encoded, padded device batch is a pytree (``DeviceBatch``) so it can flow
+through jit/scan/shard_map unchanged.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as t
+from ..ops import filters as F
+from ..ops import scores as S
+from ..state import encoder as enc
+from ..state.snapshot import Snapshot
+from . import config as C
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class DeviceBatch:
+    """Padded device-resident scheduling problem: P pods × N nodes × R
+    resources. Padding rows/cols are masked out (``node_valid``/``pod_valid``
+    False, ``static_mask`` False on pads) so kernels need no special cases."""
+
+    # nodes
+    alloc: jnp.ndarray              # (N, R) int64
+    requested: jnp.ndarray          # (N, R) int64 exact
+    nonzero_requested: jnp.ndarray  # (N, R) int64 scoring view
+    pod_count: jnp.ndarray          # (N,) int32
+    allowed_pods: jnp.ndarray       # (N,) int32
+    node_valid: jnp.ndarray         # (N,) bool
+    # pods
+    requests: jnp.ndarray           # (P, R) int64 exact
+    nonzero_requests: jnp.ndarray   # (P, R) int64
+    pod_valid: jnp.ndarray          # (P,) bool
+    # static per-(pod,node) facts from the encoder
+    static_mask: jnp.ndarray        # (P, N) bool
+    node_affinity_raw: jnp.ndarray  # (P, N) int64
+    taint_prefer_raw: jnp.ndarray   # (P, N) int64
+    image_sum_scores: jnp.ndarray   # (P, N) int64
+    image_count: jnp.ndarray        # (P,) int32
+    # NodePorts dynamic filter (interned triples, see encoder._encode_ports)
+    pod_ports: jnp.ndarray          # (P, K) bool
+    node_ports: jnp.ndarray         # (N, K) bool
+    port_conflict: jnp.ndarray      # (K, K) bool
+
+
+@dataclass
+class EncodedBatch:
+    """Host-side handle pairing the device pytree with name lookups."""
+
+    device: DeviceBatch
+    node_names: list[str]
+    pods: list[t.Pod]
+    resource_names: list[str]
+    num_nodes: int                  # real (unpadded) N
+    num_pods: int                   # real (unpadded) P
+
+
+def _resource_weights(
+    resource_names: Sequence[str], spec: Sequence[tuple[str, int]]
+) -> np.ndarray:
+    w = np.zeros(len(resource_names), dtype=np.int64)
+    idx = {r: i for i, r in enumerate(resource_names)}
+    for name, weight in spec:
+        j = idx.get(name)
+        if j is not None:
+            w[j] = weight
+    return w
+
+
+def _is_scalar(resource_names: Sequence[str]) -> np.ndarray:
+    return np.array(
+        [r not in enc.BASE_RESOURCES for r in resource_names], dtype=bool
+    )
+
+
+def _image_tensors(
+    nt: enc.NodeTensors, pods: Sequence[t.Pod]
+) -> tuple[np.ndarray, np.ndarray]:
+    """ImageLocality host encoding (imagelocality/image_locality.go:60
+    sumImageScores + :118 scaledImageScore): per (pod, node) the sum over the
+    pod's container images present on the node of
+    ``size * numNodesWithImage // totalNumNodes``."""
+    N = nt.num_nodes
+    P = len(pods)
+    total = max(N, 1)
+    sums = np.zeros((P, N), dtype=np.int64)
+    counts = np.zeros(P, dtype=np.int32)
+    if not any(p.images for p in pods):
+        return sums, counts
+    node_images: list[dict[str, t.ImageState]] = [
+        dict(info.node.images) for info in nt.infos
+    ]
+    cache: dict[tuple[str, ...], np.ndarray] = {}
+    for i, p in enumerate(pods):
+        counts[i] = len(p.images)
+        if not p.images:
+            continue
+        key = p.images
+        v = cache.get(key)
+        if v is None:
+            v = np.zeros(N, dtype=np.int64)
+            for n_i, imgs in enumerate(node_images):
+                s = 0
+                for name in key:
+                    st = imgs.get(name)
+                    if st is not None:
+                        s += st.size_bytes * st.num_nodes // total
+                v[n_i] = s
+            cache[key] = v
+        sums[i] = v
+    return sums, counts
+
+
+def _pad_axis(a: np.ndarray, n: int, axis: int = 0, fill=0) -> np.ndarray:
+    if a.shape[axis] == n:
+        return a
+    widths = [(0, 0)] * a.ndim
+    widths[axis] = (0, n - a.shape[axis])
+    return np.pad(a, widths, constant_values=fill)
+
+
+def encode_batch(
+    snapshot: Snapshot,
+    pods: Sequence[t.Pod],
+    profile: C.Profile | None = None,
+    pad: bool = True,
+    resource_names: Sequence[str] | None = None,
+) -> EncodedBatch:
+    """Snapshot + pending pods → padded device batch.
+
+    Padding buckets P and N to powers of two so churning clusters reuse the
+    XLA compile cache (SURVEY §7 'dynamic shapes'): padded nodes have zero
+    allocatable and ``allowed_pods``=0 (infeasible for every pod), padded pods
+    have an all-False static mask.
+    """
+    nt = enc.encode_snapshot(snapshot, resource_names=resource_names, pods=pods)
+    enabled = (
+        frozenset(profile.filters.names()) if profile is not None else None
+    )
+    pb = enc.encode_pod_batch(nt, pods, enabled_filters=enabled)
+    img_sums, img_counts = _image_tensors(nt, pods)
+    N, P = nt.num_nodes, pb.num_pods
+    NP = enc.round_up(N) if pad else N
+    PP = enc.round_up(P) if pad else P
+
+    dev = DeviceBatch(
+        alloc=jnp.asarray(_pad_axis(nt.alloc, NP)),
+        requested=jnp.asarray(_pad_axis(nt.requested, NP)),
+        nonzero_requested=jnp.asarray(_pad_axis(nt.nonzero_requested, NP)),
+        pod_count=jnp.asarray(_pad_axis(nt.pod_count, NP)),
+        allowed_pods=jnp.asarray(_pad_axis(nt.allowed_pods, NP)),
+        node_valid=jnp.asarray(
+            _pad_axis(np.ones(N, dtype=bool), NP, fill=False)
+        ),
+        requests=jnp.asarray(_pad_axis(pb.requests, PP)),
+        nonzero_requests=jnp.asarray(_pad_axis(pb.nonzero_requests, PP)),
+        pod_valid=jnp.asarray(_pad_axis(np.ones(P, dtype=bool), PP, fill=False)),
+        static_mask=jnp.asarray(
+            _pad_axis(_pad_axis(pb.static_mask, NP, axis=1, fill=False), PP, fill=False)
+        ),
+        node_affinity_raw=jnp.asarray(
+            _pad_axis(_pad_axis(pb.node_affinity_raw, NP, axis=1), PP)
+        ),
+        taint_prefer_raw=jnp.asarray(
+            _pad_axis(_pad_axis(pb.taint_prefer_raw, NP, axis=1), PP)
+        ),
+        image_sum_scores=jnp.asarray(
+            _pad_axis(_pad_axis(img_sums, NP, axis=1), PP)
+        ),
+        image_count=jnp.asarray(_pad_axis(img_counts, PP)),
+        pod_ports=jnp.asarray(_pad_axis(pb.pod_ports, PP, fill=False)),
+        node_ports=jnp.asarray(_pad_axis(pb.node_ports, NP, fill=False)),
+        port_conflict=jnp.asarray(pb.port_conflict),
+    )
+    return EncodedBatch(
+        device=dev,
+        node_names=nt.node_names,
+        pods=list(pods),
+        resource_names=nt.resource_names,
+        num_nodes=N,
+        num_pods=P,
+    )
+
+
+@dataclass(frozen=True)
+class ScoreParams:
+    """Static numeric config handed to the jitted program (weights aligned to
+    the batch's resource axis)."""
+
+    fit_weights: tuple[int, ...]
+    balanced_weights: tuple[int, ...]
+    is_scalar: tuple[bool, ...]
+    strategy: str
+    shape_x: tuple[int, ...]
+    shape_y: tuple[int, ...]          # pre-scaled ×10 (MaxNodeScore/MaxCustomPriorityScore)
+    w_fit: int
+    w_balanced: int
+    w_node_affinity: int
+    w_taint: int
+    w_image: int
+    filter_fit: bool
+    filter_ports: bool
+
+
+def score_params(profile: C.Profile, resource_names: Sequence[str]) -> ScoreParams:
+    ss = profile.scoring_strategy
+    shape = ss.shape or ((0, 0), (100, 10))
+    return ScoreParams(
+        fit_weights=tuple(_resource_weights(resource_names, ss.resources).tolist()),
+        balanced_weights=tuple(
+            _resource_weights(resource_names, profile.balanced_resources).tolist()
+        ),
+        is_scalar=tuple(_is_scalar(resource_names).tolist()),
+        strategy=ss.type,
+        shape_x=tuple(x for x, _ in shape),
+        shape_y=tuple(y * 10 for _, y in shape),
+        w_fit=profile.score_weight(C.NODE_RESOURCES_FIT),
+        w_balanced=profile.score_weight(C.NODE_RESOURCES_BALANCED),
+        w_node_affinity=profile.score_weight(C.NODE_AFFINITY),
+        w_taint=profile.score_weight(C.TAINT_TOLERATION),
+        w_image=profile.score_weight(C.IMAGE_LOCALITY),
+        filter_fit=profile.has_filter(C.NODE_RESOURCES_FIT),
+        filter_ports=profile.has_filter(C.NODE_PORTS),
+    )
+
+
+def masked_normalize(raw: jnp.ndarray, mask: jnp.ndarray, reverse: bool = False) -> jnp.ndarray:
+    """DefaultNormalizeScore over feasible nodes only (the reference's
+    nodeScoreList contains only nodes that passed Filter)."""
+    masked = jnp.where(mask, raw, 0)
+    return S.default_normalize(masked, reverse=reverse)
+
+
+def feasible_and_scores(
+    b: DeviceBatch,
+    p: ScoreParams,
+    requested: jnp.ndarray | None = None,
+    nonzero_requested: jnp.ndarray | None = None,
+    pod_count: jnp.ndarray | None = None,
+    node_ports: jnp.ndarray | None = None,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """The full Filter + Score composition for a batch against ONE snapshot
+    state (no inter-pod capacity coupling — that is the assignment engine's
+    job). Returns ``(mask (P,N) bool, total (P,N) int64)``.
+
+    Optional ``requested``/``nonzero_requested``/``pod_count`` override the
+    batch's node usage — the greedy scan threads its running state through
+    here so this one function is both the one-shot and the stepped semantics.
+    """
+    req = b.requested if requested is None else requested
+    nz = b.nonzero_requested if nonzero_requested is None else nonzero_requested
+    pc = b.pod_count if pod_count is None else pod_count
+    ports = b.node_ports if node_ports is None else node_ports
+
+    w_fit = jnp.asarray(p.fit_weights, dtype=jnp.int64)
+    w_bal = jnp.asarray(p.balanced_weights, dtype=jnp.int64)
+    scal = jnp.asarray(p.is_scalar, dtype=bool)
+
+    # --- Filter ----------------------------------------------------------
+    mask = b.static_mask & b.node_valid[None, :] & b.pod_valid[:, None]
+    if p.filter_fit:
+        mask = mask & F.resource_fit_mask(
+            b.requests, b.alloc, req, pc, b.allowed_pods
+        )
+    if p.filter_ports:
+        # conflict[p, n] = any pod triple k conflicting with in-use triple l
+        wants_conf = jnp.einsum(
+            "pk,kl->pl", b.pod_ports.astype(jnp.int32),
+            b.port_conflict.astype(jnp.int32),
+        )                                                     # (P, K)
+        conflict = jnp.einsum(
+            "pl,nl->pn", wants_conf, ports.astype(jnp.int32)
+        ) > 0                                                 # (P, N)
+        mask = mask & ~conflict
+
+    # --- Score -----------------------------------------------------------
+    total = jnp.zeros(mask.shape, dtype=jnp.int64)
+    if p.w_fit:
+        if p.strategy == C.LEAST_ALLOCATED:
+            raw = S.least_allocated_score(b.nonzero_requests, nz, b.alloc, w_fit, scal)
+        elif p.strategy == C.MOST_ALLOCATED:
+            raw = S.most_allocated_score(b.nonzero_requests, nz, b.alloc, w_fit, scal)
+        else:
+            raw = S.requested_to_capacity_ratio_score(
+                b.nonzero_requests, nz, b.alloc, w_fit, scal,
+                jnp.asarray(p.shape_x, dtype=jnp.int64),
+                jnp.asarray(p.shape_y, dtype=jnp.int64),
+            )
+        total = total + p.w_fit * raw          # no NormalizeScore (already 0..100)
+    if p.w_balanced:
+        raw = S.balanced_allocation_score(b.requests, req, b.alloc, w_bal, scal)
+        total = total + p.w_balanced * raw
+    if p.w_node_affinity:
+        total = total + p.w_node_affinity * masked_normalize(
+            b.node_affinity_raw, mask
+        )
+    if p.w_taint:
+        total = total + p.w_taint * masked_normalize(
+            b.taint_prefer_raw, mask, reverse=True
+        )
+    if p.w_image:
+        total = total + p.w_image * S.image_locality_score(
+            b.image_sum_scores, b.image_count
+        )
+    return mask, total
+
+
+@partial(jax.jit, static_argnames=("params",))
+def filter_score_batch(b: DeviceBatch, params: ScoreParams):
+    """One-shot batch Filter+Score (all pods vs. the same snapshot) — the
+    extender Prioritize path and the first half of batched assignment."""
+    return feasible_and_scores(b, params)

@@ -1,0 +1,32 @@
+"""Canonical plugin names (pkg/scheduler/framework/plugins/names/names.go:19-42).
+
+Shared by the config layer (framework.config) and the tensorization layer
+(state.encoder) — the encoder gates its static predicates on the enabled
+filter set without importing the framework package.
+"""
+
+NODE_RESOURCES_FIT = "NodeResourcesFit"
+NODE_RESOURCES_BALANCED = "NodeResourcesBalancedAllocation"
+NODE_AFFINITY = "NodeAffinity"
+TAINT_TOLERATION = "TaintToleration"
+NODE_NAME = "NodeName"
+NODE_PORTS = "NodePorts"
+NODE_UNSCHEDULABLE = "NodeUnschedulable"
+POD_TOPOLOGY_SPREAD = "PodTopologySpread"
+INTER_POD_AFFINITY = "InterPodAffinity"
+IMAGE_LOCALITY = "ImageLocality"
+DEFAULT_PREEMPTION = "DefaultPreemption"
+DEFAULT_BINDER = "DefaultBinder"
+PRIORITY_SORT = "PrioritySort"
+SCHEDULING_GATES = "SchedulingGates"
+
+ALL_FILTERS = frozenset({
+    NODE_RESOURCES_FIT,
+    NODE_AFFINITY,
+    TAINT_TOLERATION,
+    NODE_NAME,
+    NODE_PORTS,
+    NODE_UNSCHEDULABLE,
+    POD_TOPOLOGY_SPREAD,
+    INTER_POD_AFFINITY,
+})

@@ -4,9 +4,11 @@ The reference runs Filter plugins per (pod, node) inside a chunked
 parallel-for (``findNodesThatPassFilters``, pkg/scheduler/schedule_one.go:771,
 ``parallelize/parallelism.go:68``). Here every predicate is a vectorized
 tensor op producing the full ``(P, N)`` mask in one XLA program; the
-label/taint/port predicates were already folded into ``PodBatch.static_mask``
-by the encoder, so the only *dynamic* filter (one that depends on evolving
-node usage) is NodeResourcesFit.
+label/taint predicates were already folded into ``PodBatch.static_mask`` by
+the encoder. The *dynamic* filters — ones that depend on state that evolves
+as the batch assigns pods — are NodeResourcesFit (below) and NodePorts
+(interned port triples × conflict matrix, evaluated in
+``framework.runtime.feasible_and_scores``).
 
 All kernels are shape-polymorphic in P and N and contain no Python control
 flow on traced values, so they jit/vmap/shard_map cleanly.

@@ -18,9 +18,10 @@ This file covers the *static* per-pod-per-node facts:
   - TaintToleration Filter + Score raw counts (plugins/tainttoleration)
   - NodeAffinity Filter (required) + Score raw weights (plugins/nodeaffinity)
   - spec.nodeSelector (part of NodeAffinity plugin's Filter)
-  - NodePorts        (plugins/nodeports)
-Resource tensors for NodeResourcesFit/LeastAllocated/BalancedAllocation are
-encoded here too; their kernels live in ``kubetpu.ops``.
+plus the NodePorts *dynamic*-filter tensors (interned port triples + conflict
+matrix — usage evolves as the batch assigns pods, so the conflict check runs
+on device, not here). Resource tensors for NodeResourcesFit/LeastAllocated/
+BalancedAllocation are encoded here too; their kernels live in ``kubetpu.ops``.
 """
 
 from __future__ import annotations
@@ -30,6 +31,7 @@ from typing import Sequence
 
 import numpy as np
 
+from .. import names
 from ..api import types as t
 from ..api.selectors import (
     count_intolerable_prefer_no_schedule,
@@ -224,21 +226,14 @@ def encode_snapshot(
 # --------------------------------------------------------------------------
 
 def _static_filter_signature(pod: t.Pod):
-    """Everything that determines the pod's static (P,N) feasibility mask."""
+    """Everything that determines the pod's static (P,N) feasibility mask.
+    NodePorts is NOT here: port usage changes as the batch assigns pods, so
+    it is a dynamic filter (interned triples + conflict matrix below)."""
     na = pod.affinity.node_affinity if pod.affinity else None
     return (
         pod.node_selector,
         na.required if na else None,
         pod.tolerations,
-        # normalized exactly like _node_port_sets so both sides of the
-        # conflict check use ("TCP", "0.0.0.0") for unset protocol/hostIP
-        tuple(
-            sorted(
-                (p.host_port, p.protocol or "TCP", p.host_ip or "0.0.0.0")
-                for p in pod.ports
-                if p.host_port > 0
-            )
-        ),
     )
 
 
@@ -249,7 +244,17 @@ def _static_score_signature(pod: t.Pod):
 
 @dataclass
 class PodBatch:
-    """Numpy-side encoded pending-pod batch."""
+    """Numpy-side encoded pending-pod batch.
+
+    Port tensors (NodePorts, plugins/nodeports — a *dynamic* filter because
+    assignments during the batch occupy ports): distinct
+    ``(hostPort, protocol, hostIP)`` triples across pending pods and node
+    usage are interned to ids 0..K-1; ``port_conflict[k, l]`` says triple k
+    conflicts with an in-use triple l (same port+protocol, and equal hostIP
+    or either side the 0.0.0.0 wildcard). A pod fits a node iff
+    ``~any(pod_ports @ port_conflict @ node_ports^T)``; the greedy scan ORs
+    the winner's ``pod_ports`` row into the node's usage row.
+    """
 
     pods: list[t.Pod]
     requests: np.ndarray            # (P, R) int64
@@ -258,39 +263,70 @@ class PodBatch:
     static_mask: np.ndarray         # (P, N) bool — all static filters ANDed
     node_affinity_raw: np.ndarray   # (P, N) int64 — sum of matched preferred weights
     taint_prefer_raw: np.ndarray    # (P, N) int64 — intolerable PreferNoSchedule count
+    pod_ports: np.ndarray           # (P, K) bool — triples the pod wants
+    node_ports: np.ndarray          # (N, K) bool — triples in use on the node
+    port_conflict: np.ndarray       # (K, K) bool
 
     @property
     def num_pods(self) -> int:
         return len(self.pods)
 
 
-def _node_port_sets(nt: NodeTensors) -> list[set[tuple[int, str, str]]]:
-    out: list[set[tuple[int, str, str]]] = []
+def _pod_port_triples(pod: t.Pod) -> list[tuple[int, str, str]]:
+    return [
+        (cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0")
+        for cp in pod.ports
+        if cp.host_port > 0
+    ]
+
+
+def _encode_ports(
+    nt: NodeTensors, pods: Sequence[t.Pod]
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Intern port triples → (pod_ports (P,K), node_ports (N,K),
+    port_conflict (K,K)). K is at least 1 (all-False dummy) so downstream
+    einsums never see a zero axis."""
+    vocab = Vocab()
+    P, N = len(pods), nt.num_nodes
+    pod_rows: list[list[int]] = []
+    for p in pods:
+        pod_rows.append(vocab.intern_all(_pod_port_triples(p)))
+    node_rows: list[list[int]] = []
     for info in nt.infos:
-        s: set[tuple[int, str, str]] = set()
+        row: set[int] = set()
         for pod in info.pods.values():
-            for cp in pod.ports:
-                if cp.host_port > 0:
-                    s.add((cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0"))
-        out.append(s)
-    return out
+            for tr in _pod_port_triples(pod):
+                row.add(vocab.intern(tr))
+        node_rows.append(sorted(row))
+
+    K = max(len(vocab), 1)
+    pod_ports = np.zeros((P, K), dtype=bool)
+    node_ports = np.zeros((N, K), dtype=bool)
+    for i, row in enumerate(pod_rows):
+        pod_ports[i, row] = True
+    for i, row in enumerate(node_rows):
+        node_ports[i, row] = True
+    conflict = np.zeros((K, K), dtype=bool)
+    items = [(vocab.lookup(k), k) for k in range(len(vocab))]
+    for (pa, ra, ia), ka in items:
+        for (pb, rb, ib), kb in items:
+            if pa == pb and ra == rb and (
+                ia == "0.0.0.0" or ib == "0.0.0.0" or ia == ib
+            ):
+                conflict[ka, kb] = True
+    return pod_ports, node_ports, conflict
 
 
-def _ports_conflict(
-    want: tuple[tuple[int, str, str], ...], used: set[tuple[int, str, str]]
-) -> bool:
-    """plugins/nodeports Fits: conflict when port+protocol equal and hostIP
-    equal or either side is the wildcard."""
-    for port, proto, ip in want:
-        ip = ip or "0.0.0.0"
-        for uport, uproto, uip in used:
-            if port == uport and proto == uproto:
-                if ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip:
-                    return True
-    return False
-
-
-def encode_pod_batch(nt: NodeTensors, pods: Sequence[t.Pod]) -> PodBatch:
+def encode_pod_batch(
+    nt: NodeTensors,
+    pods: Sequence[t.Pod],
+    enabled_filters: frozenset[str] | None = None,
+) -> PodBatch:
+    """``enabled_filters`` is the profile's Filter plugin set (names from
+    ``kubetpu.names``); None enables everything. Disabled static predicates
+    are left out of ``static_mask``, mirroring a KubeSchedulerConfiguration
+    that disables the plugin."""
+    f = names.ALL_FILTERS if enabled_filters is None else enabled_filters
     ridx = {r: i for i, r in enumerate(nt.resource_names)}
     P, N, R = len(pods), nt.num_nodes, nt.num_resources
     requests = np.zeros((P, R), dtype=np.int64)
@@ -318,7 +354,6 @@ def encode_pod_batch(nt: NodeTensors, pods: Sequence[t.Pod]) -> PodBatch:
     node_unsched = np.array(
         [info.node.unschedulable for info in nt.infos], dtype=bool
     )
-    node_ports = _node_port_sets(nt)
     sig_cache: dict = {}
     static_mask = np.ones((P, N), dtype=bool)
     for i, p in enumerate(pods):
@@ -326,50 +361,43 @@ def encode_pod_batch(nt: NodeTensors, pods: Sequence[t.Pod]) -> PodBatch:
         m = sig_cache.get(sig)
         if m is None:
             m = np.ones(N, dtype=bool)
-            # spec.nodeSelector — ANDed equality terms (NodeAffinity plugin Filter)
-            for k, v in p.node_selector:
-                m &= nt.requirement_mask(
-                    t.Requirement(k, t.Operator.IN, (v,))
-                )
-            # required node affinity
-            na = p.affinity.node_affinity if p.affinity else None
-            if na and na.required is not None:
-                m &= nt.node_selector_mask(na.required)
-            # taints (NoSchedule/NoExecute) — dedupe by node taint tuple
-            taint_ok: dict[tuple, bool] = {}
-            tvec = np.ones(N, dtype=bool)
-            for n_i, taints in enumerate(node_taints):
-                if not taints:
-                    continue
-                ok = taint_ok.get(taints)
-                if ok is None:
-                    ok = find_untolerated_taint(taints, p.tolerations) is None
-                    taint_ok[taints] = ok
-                tvec[n_i] = ok
-            m &= tvec
-            # NodeUnschedulable — unschedulable nodes pass only if the pod
-            # tolerates the unschedulable taint
-            if node_unsched.any():
+            if names.NODE_AFFINITY in f:
+                # spec.nodeSelector — ANDed equality terms (NodeAffinity Filter)
+                for k, v in p.node_selector:
+                    m &= nt.requirement_mask(
+                        t.Requirement(k, t.Operator.IN, (v,))
+                    )
+                # required node affinity
+                na = p.affinity.node_affinity if p.affinity else None
+                if na and na.required is not None:
+                    m &= nt.node_selector_mask(na.required)
+            if names.TAINT_TOLERATION in f:
+                # taints (NoSchedule/NoExecute) — dedupe by node taint tuple
+                taint_ok: dict[tuple, bool] = {}
+                tvec = np.ones(N, dtype=bool)
+                for n_i, taints in enumerate(node_taints):
+                    if not taints:
+                        continue
+                    ok = taint_ok.get(taints)
+                    if ok is None:
+                        ok = find_untolerated_taint(taints, p.tolerations) is None
+                        taint_ok[taints] = ok
+                    tvec[n_i] = ok
+                m &= tvec
+            if names.NODE_UNSCHEDULABLE in f and node_unsched.any():
+                # unschedulable nodes pass only if the pod tolerates the taint
                 tolerated = any(
                     tolerates(tol, _UNSCHEDULABLE_TAINT) for tol in p.tolerations
                 )
                 if not tolerated:
                     m &= ~node_unsched
-            # NodePorts
-            want = sig[3]
-            if want:
-                pvec = np.array(
-                    [not _ports_conflict(want, node_ports[n_i]) for n_i in range(N)],
-                    dtype=bool,
-                )
-                m &= pvec
             sig_cache[sig] = m
         static_mask[i] = m
         # NodeName (spec.nodeName pre-assignment) — exact match only
-        if p.node_name:
+        if p.node_name and names.NODE_NAME in f:
             nn = np.array([n == p.node_name for n in nt.node_names], dtype=bool)
             static_mask[i] &= nn
-        if unknown_resource[i]:
+        if unknown_resource[i] and names.NODE_RESOURCES_FIT in f:
             static_mask[i] = False
 
     # distinct static-score signatures → (N,) raw scores
@@ -400,6 +428,7 @@ def encode_pod_batch(nt: NodeTensors, pods: Sequence[t.Pod]) -> PodBatch:
             score_cache[sig] = entry
         na_raw[i], tt_raw[i] = entry
 
+    pod_ports, node_ports, port_conflict = _encode_ports(nt, pods)
     return PodBatch(
         pods=list(pods),
         requests=requests,
@@ -408,4 +437,7 @@ def encode_pod_batch(nt: NodeTensors, pods: Sequence[t.Pod]) -> PodBatch:
         static_mask=static_mask,
         node_affinity_raw=na_raw,
         taint_prefer_raw=tt_raw,
+        pod_ports=pod_ports,
+        node_ports=node_ports,
+        port_conflict=port_conflict,
     )

@@ -146,12 +146,20 @@ class Cache:
         self._add_pod_internal(pod)
 
     def update_pod(self, old: t.Pod, new: t.Pod) -> None:
-        self._remove_pod_internal(old)
+        """The cached state, not the caller's ``old``, is what gets removed
+        (cache.go:560 UpdatePod uses currState) — informer deltas can carry a
+        stale view whose node/requests diverge from what we accounted."""
+        cached = self._pods.get(old.uid, old)
+        self._remove_pod_internal(cached)
         self._add_pod_internal(new)
 
     def remove_pod(self, pod: t.Pod) -> None:
+        """cache.go:583 RemovePod: remove the CACHED pod — a Delete event may
+        arrive with node_name unset (bind never observed) and must still drop
+        the accounting from whichever node we assumed it onto."""
         self._assumed.pop(pod.uid, None)
-        self._remove_pod_internal(pod)
+        cached = self._pods.get(pod.uid, pod)
+        self._remove_pod_internal(cached)
 
     def assume_pod(self, pod: t.Pod) -> None:
         """cache.go:397 AssumePod — pod must carry node_name."""

@@ -218,3 +218,107 @@ def default_normalize(scores: list[int], reverse: bool = False) -> list[int]:
     if reverse:
         out = [MAX - s for s in out]
     return out
+
+
+# --- static filters + greedy loop (schedule_one.go ScheduleOne) ------------
+
+_UNSCHED_TAINT = t.Taint(
+    key="node.kubernetes.io/unschedulable", effect=t.TaintEffect.NO_SCHEDULE
+)
+
+
+def _ports_of(info: NodeInfo) -> set:
+    used = set()
+    for pod in info.pods.values():
+        for cp in pod.ports:
+            if cp.host_port > 0:
+                used.add((cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0"))
+    return used
+
+
+def ports_ok(pod: t.Pod, info: NodeInfo) -> bool:
+    want = [
+        (p.host_port, p.protocol or "TCP", p.host_ip or "0.0.0.0")
+        for p in pod.ports
+        if p.host_port > 0
+    ]
+    if not want:
+        return True
+    used = _ports_of(info)
+    for port, proto, ip in want:
+        for uport, uproto, uip in used:
+            if port == uport and proto == uproto:
+                if ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip:
+                    return False
+    return True
+
+
+def static_feasible(pod: t.Pod, info: NodeInfo) -> bool:
+    """NodeName + NodeUnschedulable + TaintToleration + NodeAffinity.
+    NodePorts is dynamic (in-batch assignments occupy ports) — checked
+    separately via ``ports_ok`` under ``greedy(check_ports=True)``."""
+    if pod.node_name and pod.node_name != info.node.name:
+        return False
+    if info.node.unschedulable:
+        if not any(sel.tolerates(tol, _UNSCHED_TAINT) for tol in pod.tolerations):
+            return False
+    if not taint_filter(pod, info):
+        return False
+    if not node_affinity_filter(pod, info):
+        return False
+    return True
+
+
+def greedy(
+    infos: list[NodeInfo],
+    pods: list[t.Pod],
+    resources: list[tuple[str, int]] | None = None,
+    w_fit: int = 1,
+    w_balanced: int = 0,
+    w_node_affinity: int = 0,
+    w_taint: int = 0,
+    strategy: str = "least",
+    check_ports: bool = True,
+    check_static: bool = True,
+) -> list[str | None]:
+    """The per-pod greedy loop: Filter → Score → Normalize → weighted sum →
+    first-max selectHost → assume (NodeInfo.add_pod). Mutates ``infos``."""
+    resources = resources or [(t.CPU, 1), (t.MEMORY, 1)]
+    out: list[str | None] = []
+    for pod in pods:
+        feas = [
+            (not check_static or static_feasible(pod, info))
+            and fits(pod, info)
+            and (not check_ports or ports_ok(pod, info))
+            for info in infos
+        ]
+        if not any(feas):
+            out.append(None)
+            continue
+        totals = [0] * len(infos)
+        if w_fit:
+            fn = least_allocated if strategy == "least" else most_allocated
+            for j, info in enumerate(infos):
+                totals[j] += w_fit * fn(pod, info, resources)
+        if w_balanced:
+            for j, info in enumerate(infos):
+                totals[j] += w_balanced * balanced_allocation(pod, info, resources)
+        if w_node_affinity:
+            raw = [node_affinity_score_raw(pod, info) if feas[j] else 0
+                   for j, info in enumerate(infos)]
+            norm = default_normalize(raw)
+            for j in range(len(infos)):
+                totals[j] += w_node_affinity * norm[j]
+        if w_taint:
+            raw = [taint_score_raw(pod, info) if feas[j] else 0
+                   for j, info in enumerate(infos)]
+            norm = default_normalize(raw, reverse=True)
+            for j in range(len(infos)):
+                totals[j] += w_taint * norm[j]
+        best, best_score = -1, -1
+        for j in range(len(infos)):
+            if feas[j] and totals[j] > best_score:
+                best, best_score = j, totals[j]
+        infos[best].add_pod(pod.with_node(infos[best].node.name))
+        out.append(infos[best].node.name)
+    return out

@@ -125,7 +125,7 @@ def test_balanced_allocation_parity(seed):
 
 
 @pytest.mark.parametrize("seed", [0, 1])
-def test_static_mask_taints_affinity_ports(seed):
+def test_static_mask_taints_affinity(seed):
     rng = np.random.default_rng(seed + 40)
     cache, pending = random_cluster(rng, with_taints=True)
     snap, nt, pb = encode(cache, pending)
@@ -137,19 +137,25 @@ def test_static_mask_taints_affinity_ports(seed):
                 and oracle.node_affinity_filter(pod, info)
                 and not info.node.unschedulable
             )
-            # port conflicts
-            used = {
-                (cp.host_port, cp.protocol, cp.host_ip or "0.0.0.0")
-                for p in info.pods.values()
-                for cp in p.ports
-            }
-            for cp in pod.ports:
-                if any(
-                    cp.host_port == up and cp.protocol == uproto
-                    for up, uproto, _ in used
-                ):
-                    want = False
             assert pb.static_mask[i, j] == want, (pod.name, info.node.name)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_port_tensors_match_oracle(seed):
+    """NodePorts as a dynamic filter: pod_ports @ conflict @ node_ports^T
+    reproduces the per-(pod, node) conflict predicate."""
+    rng = np.random.default_rng(seed + 50)
+    cache, pending = random_cluster(rng)
+    snap, nt, pb = encode(cache, pending)
+    infos = snap.node_infos()
+    want_conf = pb.pod_ports.astype(np.int64) @ pb.port_conflict.astype(np.int64)
+    conflict = (want_conf @ pb.node_ports.astype(np.int64).T) > 0
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            assert conflict[i, j] == (not oracle.ports_ok(pod, info)), (
+                pod.name,
+                info.node.name,
+            )
 
 
 def test_taint_prefer_and_node_affinity_raw_scores():

@@ -1,5 +1,6 @@
 """Cache/snapshot semantics tests (analog of backend/cache tests)."""
 
+import dataclasses
 import numpy as np
 
 from kubetpu.api import types as t
@@ -164,3 +165,35 @@ def test_topology_values():
     assert vals[0] != vals[1]
     assert vals[2] == -1
     assert (nt.topology_values("nope") == -1).all()
+
+
+def test_remove_pod_with_stale_delete_event():
+    """cache.go:583 RemovePod semantics: a Delete whose object lost its
+    node_name (bind never observed by the watcher) must still drop the
+    accounting from the node the pod was assumed onto."""
+    cache = Cache()
+    cache.add_node(make_node("n1", cpu_milli=4000))
+    pod = make_pod("p1", cpu_milli=1000).with_node("n1")
+    cache.assume_pod(pod)
+    stale = dataclasses.replace(pod, node_name="")
+    cache.remove_pod(stale)
+    snap = cache.update_snapshot()
+    info = snap.nodes["n1"]
+    assert not info.pods
+    assert info.requested.get("cpu", 0) == 0
+
+
+def test_update_pod_uses_cached_state():
+    """cache.go:560 UpdatePod removes currState, not the caller's old view."""
+    cache = Cache()
+    cache.add_node(make_node("n1", cpu_milli=4000))
+    cache.add_node(make_node("n2", cpu_milli=4000))
+    pod = make_pod("p1", cpu_milli=1000).with_node("n1")
+    cache.add_pod(pod)
+    # informer delivers an update whose "old" claims the wrong node
+    stale_old = dataclasses.replace(pod, node_name="n2")
+    new = dataclasses.replace(pod, node_name="n1", requests=(("cpu", 2000),))
+    cache.update_pod(stale_old, new)
+    snap = cache.update_snapshot()
+    assert snap.nodes["n1"].requested.get("cpu", 0) == 2000
+    assert snap.nodes["n2"].requested.get("cpu", 0) == 0
