@@ -1,0 +1,106 @@
+"""Headline benchmark: SchedulingBasic 5000 nodes / 10000 pods.
+
+Mirrors the reference's scheduler_perf workload
+(test/integration/scheduler_perf/misc/performance-config.yaml:54-63,
+SchedulingBasic 5000Nodes_10000Pods: threshold 680 pods/s average
+SchedulingThroughput) with the same shape: 5000 pre-existing nodes, an
+initial load of assigned pods, then 10000 measure pods scheduled with
+NodeResourcesFit(LeastAllocated) — the reference's default scoring path for
+plain resource pods.
+
+Throughput definition matches the reference's: measured pods / wall time of
+the scheduling phase (encode + device greedy scan + readback), steady-state
+(after one compile warmup on identical shapes).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import time
+
+import numpy as np
+
+import kubetpu  # noqa: F401  (enables x64)
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.assign.greedy import greedy_assign_device
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, score_params
+from kubetpu.state import Cache
+
+BASELINE_PODS_PER_SEC = 680.0  # misc/performance-config.yaml:59
+NUM_NODES = 5000
+NUM_INIT_PODS = 1000
+NUM_MEASURE_PODS = 10000
+
+
+def build_cluster() -> tuple[Cache, list]:
+    rng = np.random.default_rng(42)
+    cache = Cache()
+    for i in range(NUM_NODES):
+        cache.add_node(
+            make_node(
+                f"node-{i}",
+                cpu_milli=4000,
+                memory=16 * 1024**3,
+                pods=110,
+                labels={"kubernetes.io/hostname": f"node-{i}"},
+            )
+        )
+    for j in range(NUM_INIT_PODS):
+        cache.add_pod(
+            make_pod(
+                f"init-{j}",
+                cpu_milli=int(rng.integers(100, 1000)),
+                memory=int(rng.integers(1, 4)) * 256 * 1024**2,
+                node_name=f"node-{int(rng.integers(0, NUM_NODES))}",
+            )
+        )
+    pending = [
+        make_pod(
+            f"measure-{j}",
+            cpu_milli=int(rng.integers(100, 700)),
+            memory=int(rng.integers(1, 4)) * 128 * 1024**2,
+            creation_index=j,
+        )
+        for j in range(NUM_MEASURE_PODS)
+    ]
+    return cache, pending
+
+
+def run_once(cache: Cache, pending, profile, params) -> tuple[float, int]:
+    t0 = time.perf_counter()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pending, profile)
+    assignments, _ = greedy_assign_device(batch.device, params)
+    assignments = np.asarray(assignments)  # block until device done
+    t1 = time.perf_counter()
+    scheduled = int((assignments[: batch.num_pods] >= 0).sum())
+    return t1 - t0, scheduled
+
+
+def main() -> None:
+    profile = C.minimal_profile()
+    cache, pending = build_cluster()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pending, profile)
+    params = score_params(profile, batch.resource_names)
+    # warmup: compile the scan for these shapes
+    a, _ = greedy_assign_device(batch.device, params)
+    np.asarray(a)
+    # steady-state run, full pipeline (snapshot → encode → device → readback)
+    elapsed, scheduled = run_once(cache, pending, profile, params)
+    throughput = scheduled / elapsed
+    print(
+        json.dumps(
+            {
+                "metric": "SchedulingBasic_5000Nodes_10000Pods_throughput",
+                "value": round(throughput, 1),
+                "unit": "pods/s",
+                "vs_baseline": round(throughput / BASELINE_PODS_PER_SEC, 2),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

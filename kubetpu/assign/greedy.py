@@ -31,6 +31,10 @@ from ..framework import runtime as rt
 
 def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
     """P=1 view of pod ``i`` (traced index) over the same nodes."""
+
+    def row(a):
+        return None if a is None else a[i][None]
+
     return rt.DeviceBatch(
         alloc=b.alloc,
         requested=b.requested,
@@ -41,11 +45,11 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         requests=b.requests[i][None],
         nonzero_requests=b.nonzero_requests[i][None],
         pod_valid=b.pod_valid[i][None],
-        static_mask=b.static_mask[i][None],
-        node_affinity_raw=b.node_affinity_raw[i][None],
-        taint_prefer_raw=b.taint_prefer_raw[i][None],
-        image_sum_scores=b.image_sum_scores[i][None],
-        image_count=b.image_count[i][None],
+        static_mask=row(b.static_mask),
+        node_affinity_raw=row(b.node_affinity_raw),
+        taint_prefer_raw=row(b.taint_prefer_raw),
+        image_sum_scores=row(b.image_sum_scores),
+        image_count=row(b.image_count),
         pod_ports=b.pod_ports[i][None],
         node_ports=b.node_ports,
         port_conflict=b.port_conflict,

@@ -49,12 +49,17 @@ class DeviceBatch:
     requests: jnp.ndarray           # (P, R) int64 exact
     nonzero_requests: jnp.ndarray   # (P, R) int64
     pod_valid: jnp.ndarray          # (P,) bool
-    # static per-(pod,node) facts from the encoder
-    static_mask: jnp.ndarray        # (P, N) bool
-    node_affinity_raw: jnp.ndarray  # (P, N) int64
-    taint_prefer_raw: jnp.ndarray   # (P, N) int64
-    image_sum_scores: jnp.ndarray   # (P, N) int64
-    image_count: jnp.ndarray        # (P,) int32
+    # static per-(pod,node) facts from the encoder. The int64 (P, N) raw
+    # score tensors are ~N*P*8 bytes each — None (an empty pytree leaf) when
+    # the profile does not score that plugin, so a resources-only workload at
+    # 5k nodes × 10k pods does not hold gigabytes of zeros in HBM. The bool
+    # mask is None when no pod has a static constraint (all-True over valid
+    # rows).
+    static_mask: jnp.ndarray | None        # (P, N) bool
+    node_affinity_raw: jnp.ndarray | None  # (P, N) int64
+    taint_prefer_raw: jnp.ndarray | None   # (P, N) int64
+    image_sum_scores: jnp.ndarray | None   # (P, N) int64
+    image_count: jnp.ndarray | None        # (P,) int32
     # NodePorts dynamic filter (interned triples, see encoder._encode_ports)
     pod_ports: jnp.ndarray          # (P, K) bool
     node_ports: jnp.ndarray         # (N, K) bool
@@ -92,17 +97,19 @@ def _is_scalar(resource_names: Sequence[str]) -> np.ndarray:
 
 
 def _image_tensors(
-    nt: enc.NodeTensors, pods: Sequence[t.Pod]
+    nt: enc.NodeTensors, pods: Sequence[t.Pod], pad_pods: int | None = None
 ) -> tuple[np.ndarray, np.ndarray]:
     """ImageLocality host encoding (imagelocality/image_locality.go:60
     sumImageScores + :118 scaledImageScore): per (pod, node) the sum over the
     pod's container images present on the node of
     ``size * numNodesWithImage // totalNumNodes``."""
     N = nt.num_nodes
+    NC = nt.alloc.shape[0]
     P = len(pods)
+    PP = max(pad_pods or P, P)
     total = max(N, 1)
-    sums = np.zeros((P, N), dtype=np.int64)
-    counts = np.zeros(P, dtype=np.int32)
+    sums = np.zeros((PP, NC), dtype=np.int64)
+    counts = np.zeros(PP, dtype=np.int32)
     if not any(p.images for p in pods):
         return sums, counts
     node_images: list[dict[str, t.ImageState]] = [
@@ -125,16 +132,8 @@ def _image_tensors(
                         s += st.size_bytes * st.num_nodes // total
                 v[n_i] = s
             cache[key] = v
-        sums[i] = v
+        sums[i, :N] = v
     return sums, counts
-
-
-def _pad_axis(a: np.ndarray, n: int, axis: int = 0, fill=0) -> np.ndarray:
-    if a.shape[axis] == n:
-        return a
-    widths = [(0, 0)] * a.ndim
-    widths[axis] = (0, n - a.shape[axis])
-    return np.pad(a, widths, constant_values=fill)
 
 
 def encode_batch(
@@ -151,43 +150,57 @@ def encode_batch(
     allocatable and ``allowed_pods``=0 (infeasible for every pod), padded pods
     have an all-False static mask.
     """
-    nt = enc.encode_snapshot(snapshot, resource_names=resource_names, pods=pods)
+    N, P = snapshot.num_nodes(), len(pods)
+    NP = enc.round_up(N) if pad else N
+    PP = enc.round_up(P) if pad else P
+    nt = enc.encode_snapshot(
+        snapshot, resource_names=resource_names, pods=pods, pad_nodes=NP
+    )
     enabled = (
         frozenset(profile.filters.names()) if profile is not None else None
     )
-    pb = enc.encode_pod_batch(nt, pods, enabled_filters=enabled)
-    img_sums, img_counts = _image_tensors(nt, pods)
-    N, P = nt.num_nodes, pb.num_pods
-    NP = enc.round_up(N) if pad else N
-    PP = enc.round_up(P) if pad else P
+    enabled_sc = (
+        frozenset(profile.scores.names()) if profile is not None else None
+    )
+    pb = enc.encode_pod_batch(
+        nt, pods, enabled_filters=enabled, pad_pods=PP, enabled_scores=enabled_sc
+    )
+    want_na = profile is None or profile.has_score(C.NODE_AFFINITY)
+    want_tt = profile is None or profile.has_score(C.TAINT_TOLERATION)
+    want_img = profile is None or profile.has_score(C.IMAGE_LOCALITY)
+    img_sums, img_counts = (
+        _image_tensors(nt, pods, pad_pods=PP) if want_img else (None, None)
+    )
+    node_valid = np.zeros(NP, dtype=bool)
+    node_valid[:N] = True
+    pod_valid = np.zeros(PP, dtype=bool)
+    pod_valid[:P] = True
 
     dev = DeviceBatch(
-        alloc=jnp.asarray(_pad_axis(nt.alloc, NP)),
-        requested=jnp.asarray(_pad_axis(nt.requested, NP)),
-        nonzero_requested=jnp.asarray(_pad_axis(nt.nonzero_requested, NP)),
-        pod_count=jnp.asarray(_pad_axis(nt.pod_count, NP)),
-        allowed_pods=jnp.asarray(_pad_axis(nt.allowed_pods, NP)),
-        node_valid=jnp.asarray(
-            _pad_axis(np.ones(N, dtype=bool), NP, fill=False)
+        alloc=jnp.asarray(nt.alloc),
+        requested=jnp.asarray(nt.requested),
+        nonzero_requested=jnp.asarray(nt.nonzero_requested),
+        pod_count=jnp.asarray(nt.pod_count),
+        allowed_pods=jnp.asarray(nt.allowed_pods),
+        node_valid=jnp.asarray(node_valid),
+        requests=jnp.asarray(pb.requests),
+        nonzero_requests=jnp.asarray(pb.nonzero_requests),
+        pod_valid=jnp.asarray(pod_valid),
+        static_mask=(
+            jnp.asarray(pb.static_mask) if pb.static_mask is not None else None
         ),
-        requests=jnp.asarray(_pad_axis(pb.requests, PP)),
-        nonzero_requests=jnp.asarray(_pad_axis(pb.nonzero_requests, PP)),
-        pod_valid=jnp.asarray(_pad_axis(np.ones(P, dtype=bool), PP, fill=False)),
-        static_mask=jnp.asarray(
-            _pad_axis(_pad_axis(pb.static_mask, NP, axis=1, fill=False), PP, fill=False)
+        node_affinity_raw=(
+            jnp.asarray(pb.node_affinity_raw)
+            if want_na and pb.node_affinity_raw is not None else None
         ),
-        node_affinity_raw=jnp.asarray(
-            _pad_axis(_pad_axis(pb.node_affinity_raw, NP, axis=1), PP)
+        taint_prefer_raw=(
+            jnp.asarray(pb.taint_prefer_raw)
+            if want_tt and pb.taint_prefer_raw is not None else None
         ),
-        taint_prefer_raw=jnp.asarray(
-            _pad_axis(_pad_axis(pb.taint_prefer_raw, NP, axis=1), PP)
-        ),
-        image_sum_scores=jnp.asarray(
-            _pad_axis(_pad_axis(img_sums, NP, axis=1), PP)
-        ),
-        image_count=jnp.asarray(_pad_axis(img_counts, PP)),
-        pod_ports=jnp.asarray(_pad_axis(pb.pod_ports, PP, fill=False)),
-        node_ports=jnp.asarray(_pad_axis(pb.node_ports, NP, fill=False)),
+        image_sum_scores=jnp.asarray(img_sums) if want_img else None,
+        image_count=jnp.asarray(img_counts) if want_img else None,
+        pod_ports=jnp.asarray(pb.pod_ports),
+        node_ports=jnp.asarray(pb.node_ports),
         port_conflict=jnp.asarray(pb.port_conflict),
     )
     return EncodedBatch(
@@ -275,7 +288,9 @@ def feasible_and_scores(
     scal = jnp.asarray(p.is_scalar, dtype=bool)
 
     # --- Filter ----------------------------------------------------------
-    mask = b.static_mask & b.node_valid[None, :] & b.pod_valid[:, None]
+    mask = b.node_valid[None, :] & b.pod_valid[:, None]
+    if b.static_mask is not None:
+        mask = mask & b.static_mask
     if p.filter_fit:
         mask = mask & F.resource_fit_mask(
             b.requests, b.alloc, req, pc, b.allowed_pods
@@ -308,15 +323,15 @@ def feasible_and_scores(
     if p.w_balanced:
         raw = S.balanced_allocation_score(b.requests, req, b.alloc, w_bal, scal)
         total = total + p.w_balanced * raw
-    if p.w_node_affinity:
+    if p.w_node_affinity and b.node_affinity_raw is not None:
         total = total + p.w_node_affinity * masked_normalize(
             b.node_affinity_raw, mask
         )
-    if p.w_taint:
+    if p.w_taint and b.taint_prefer_raw is not None:
         total = total + p.w_taint * masked_normalize(
             b.taint_prefer_raw, mask, reverse=True
         )
-    if p.w_image:
+    if p.w_image and b.image_sum_scores is not None:
         total = total + p.w_image * S.image_locality_score(
             b.image_sum_scores, b.image_count
         )

@@ -1,0 +1,12 @@
+"""Mesh construction + sharding rules.
+
+The reference scales its hot loop with a chunked parallel-for over nodes
+(pkg/scheduler/framework/parallelize/parallelism.go:68, 16 goroutines) and
+active/passive replicas via leader election. The TPU-native equivalent shards
+the NODE axis of every per-node tensor across a ``jax.sharding.Mesh`` —
+filter masks, score tensors, and the greedy scan's carried node state are all
+node-sharded; per-pod tensors are replicated. XLA inserts the collectives
+(the per-pod argmax becomes a cross-shard max reduction over ICI).
+"""
+
+from .mesh import make_mesh, shard_batch, sharded_greedy  # noqa: F401

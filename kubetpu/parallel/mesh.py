@@ -1,0 +1,78 @@
+"""Node-axis sharding over a device mesh.
+
+Sharding layout (the "tensor parallel" analog for a scheduling problem —
+SURVEY §2.10):
+
+- ``(N, …)`` node tensors (alloc, requested, node_ports, …): sharded on axis
+  0 over mesh axis ``"nodes"``.
+- ``(P, N)`` pod×node tensors (static_mask, raw scores): sharded on axis 1.
+- ``(P, …)`` pod tensors and the tiny ``(K, K)`` port-conflict matrix:
+  replicated.
+
+With these placements ``greedy_assign_device`` runs unchanged: each step's
+filter+score work is local to a node shard, and XLA turns the
+``argmax``/``any`` reductions into ICI collectives. The carried scan state
+(requested/nonzero/pod_count/node_ports) stays node-sharded across steps, so
+per-step communication is O(1) scalars, not O(N) tensors — the same reason
+the reference keeps binding async and its cycle serialized
+(schedule_one.go:141): the sequential dependency is on a tiny decision, not
+on bulk state.
+
+Multi-slice (DCN) note: a second mesh axis over slices shards nodes
+hierarchically; the layout below is axis-count agnostic (everything shards
+over ALL axes named in ``axis``).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..framework import runtime as rt
+
+
+def make_mesh(devices: Sequence[jax.Device] | None = None, axis: str = "nodes") -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    return Mesh(np.array(devs), (axis,))
+
+
+def _spec_for(field: str, axis: str) -> P:
+    # (N, ...) node-major tensors
+    if field in ("alloc", "requested", "nonzero_requested", "pod_count",
+                 "allowed_pods", "node_valid", "node_ports"):
+        return P(axis)
+    # (P, N) pod × node tensors — shard the node axis
+    if field in ("static_mask", "node_affinity_raw", "taint_prefer_raw",
+                 "image_sum_scores"):
+        return P(None, axis)
+    # per-pod tensors + port conflict matrix — replicated
+    return P()
+
+
+def shard_batch(b: rt.DeviceBatch, mesh: Mesh, axis: str = "nodes") -> rt.DeviceBatch:
+    """Place every leaf with its node-axis sharding. The padded node count
+    must divide the mesh size (encode_batch pads to ≥8)."""
+    kwargs = {}
+    for field in rt.DeviceBatch.__dataclass_fields__:
+        leaf = getattr(b, field)
+        if leaf is None:
+            kwargs[field] = None
+            continue
+        kwargs[field] = jax.device_put(
+            leaf, NamedSharding(mesh, _spec_for(field, axis))
+        )
+    return rt.DeviceBatch(**kwargs)
+
+
+def sharded_greedy(
+    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: str = "nodes"
+):
+    """Shard the batch and run the greedy scan under the mesh; XLA inserts
+    the cross-shard reductions."""
+    from ..assign.greedy import greedy_assign_device
+
+    sb = shard_batch(b, mesh, axis)
+    return greedy_assign_device(sb, params)
