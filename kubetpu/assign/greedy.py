@@ -53,6 +53,24 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         pod_ports=b.pod_ports[i][None],
         node_ports=b.node_ports,
         port_conflict=b.port_conflict,
+        spread=_spread_view(b.spread, i),
+    )
+
+
+def _spread_view(sp, i):
+    if sp is None:
+        return None
+    import dataclasses
+
+    return dataclasses.replace(
+        sp,
+        sig_idx=sp.sig_idx[i][None],
+        action=sp.action[i][None],
+        max_skew=sp.max_skew[i][None],
+        min_domains=sp.min_domains[i][None],
+        self_match=sp.self_match[i][None],
+        pod_match_sig=sp.pod_match_sig[i][None],
+        ignored=sp.ignored[i][None],
     )
 
 
@@ -67,12 +85,13 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
     node_iota = jnp.arange(n, dtype=jnp.int32)
 
     def step(state, i):
-        requested, nonzero, pod_count, node_ports = state
+        requested, nonzero, pod_count, node_ports, spread_counts = state
         view = _pod_view(b, i)
         mask, score = rt.feasible_and_scores(
             view, params,
             requested=requested, nonzero_requested=nonzero,
             pod_count=pod_count, node_ports=node_ports,
+            spread_counts=spread_counts,
         )
         mask, score = mask[0], score[0]
         feasible = jnp.any(mask)
@@ -84,10 +103,23 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
         nonzero = nonzero + oh64 * view.nonzero_requests[0][None, :]
         pod_count = pod_count + onehot.astype(pod_count.dtype)
         node_ports = node_ports | (onehot[:, None] & view.pod_ports[0][None, :])
-        return (requested, nonzero, pod_count, node_ports), chosen
+        if spread_counts is not None:
+            # updateWithPod (podtopologyspread/filtering.go:181): +1 in every
+            # signature whose selector+namespace the assigned pod matches, on
+            # the chosen node, when that node is eligible for the signature.
+            upd = (
+                b.spread.pod_match_sig[i][:, None]
+                & b.spread.eligible
+                & onehot[None, :]
+            )
+            spread_counts = spread_counts + upd.astype(spread_counts.dtype)
+        return (requested, nonzero, pod_count, node_ports, spread_counts), chosen
 
     p = b.requests.shape[0]
-    init = (b.requested, b.nonzero_requested, b.pod_count, b.node_ports)
+    init = (
+        b.requested, b.nonzero_requested, b.pod_count, b.node_ports,
+        None if b.spread is None else b.spread.node_count,
+    )
     final_state, assignments = jax.lax.scan(
         step, init, jnp.arange(p, dtype=jnp.int32)
     )

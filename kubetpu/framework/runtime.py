@@ -26,6 +26,8 @@ import numpy as np
 from ..api import types as t
 from ..ops import filters as F
 from ..ops import scores as S
+from ..ops import spread as SP
+from ..state import spread as enc_spread
 from ..state import encoder as enc
 from ..state.snapshot import Snapshot
 from . import config as C
@@ -64,6 +66,31 @@ class DeviceBatch:
     pod_ports: jnp.ndarray          # (P, K) bool
     node_ports: jnp.ndarray         # (N, K) bool
     port_conflict: jnp.ndarray      # (K, K) bool
+    # PodTopologySpread (None when no pod has constraints)
+    spread: "SpreadDevice | None" = None
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class SpreadDevice:
+    """Device-side spread tensors (see state.spread.SpreadTensors)."""
+
+    eligible: jnp.ndarray        # (S, N) bool
+    node_domain: jnp.ndarray     # (S, N) int32
+    node_count: jnp.ndarray      # (S, N) int32 — base counts (scan state init)
+    has_key: jnp.ndarray         # (S, N) bool
+    domain_present: jnp.ndarray  # (S, D) bool
+    num_domains: jnp.ndarray     # (S,) int32
+    is_hostname: jnp.ndarray     # (S,) bool
+    sig_idx: jnp.ndarray         # (P, C) int32
+    action: jnp.ndarray          # (P, C) int8
+    max_skew: jnp.ndarray        # (P, C) int32
+    min_domains: jnp.ndarray     # (P, C) int32
+    self_match: jnp.ndarray      # (P, C) int32
+    pod_match_sig: jnp.ndarray   # (P, S) bool
+    ignored: jnp.ndarray         # (P, N) bool
+    has_hard: bool = field(metadata=dict(static=True), default=False)
+    has_soft: bool = field(metadata=dict(static=True), default=False)
 
 
 @dataclass
@@ -168,6 +195,32 @@ def encode_batch(
     want_na = profile is None or profile.has_score(C.NODE_AFFINITY)
     want_tt = profile is None or profile.has_score(C.TAINT_TOLERATION)
     want_img = profile is None or profile.has_score(C.IMAGE_LOCALITY)
+    want_spread = profile is None or (
+        profile.has_filter(C.POD_TOPOLOGY_SPREAD)
+        or profile.has_score(C.POD_TOPOLOGY_SPREAD)
+    )
+    spread_dev = None
+    if want_spread:
+        sp = enc_spread.encode_spread(nt, pods, pad_pods=PP)
+        if sp is not None:
+            spread_dev = SpreadDevice(
+                eligible=jnp.asarray(sp.eligible),
+                node_domain=jnp.asarray(sp.node_domain),
+                node_count=jnp.asarray(sp.node_count),
+                has_key=jnp.asarray(sp.has_key),
+                domain_present=jnp.asarray(sp.domain_present),
+                num_domains=jnp.asarray(sp.num_domains),
+                is_hostname=jnp.asarray(sp.is_hostname),
+                sig_idx=jnp.asarray(sp.sig_idx),
+                action=jnp.asarray(sp.action),
+                max_skew=jnp.asarray(sp.max_skew),
+                min_domains=jnp.asarray(sp.min_domains),
+                self_match=jnp.asarray(sp.self_match),
+                pod_match_sig=jnp.asarray(sp.pod_match_sig),
+                ignored=jnp.asarray(sp.ignored),
+                has_hard=sp.has_hard,
+                has_soft=sp.has_soft,
+            )
     img_sums, img_counts = (
         _image_tensors(nt, pods, pad_pods=PP) if want_img else (None, None)
     )
@@ -202,6 +255,7 @@ def encode_batch(
         pod_ports=jnp.asarray(pb.pod_ports),
         node_ports=jnp.asarray(pb.node_ports),
         port_conflict=jnp.asarray(pb.port_conflict),
+        spread=spread_dev,
     )
     return EncodedBatch(
         device=dev,
@@ -229,8 +283,10 @@ class ScoreParams:
     w_node_affinity: int
     w_taint: int
     w_image: int
+    w_spread: int
     filter_fit: bool
     filter_ports: bool
+    filter_spread: bool
 
 
 def score_params(profile: C.Profile, resource_names: Sequence[str]) -> ScoreParams:
@@ -250,8 +306,10 @@ def score_params(profile: C.Profile, resource_names: Sequence[str]) -> ScorePara
         w_node_affinity=profile.score_weight(C.NODE_AFFINITY),
         w_taint=profile.score_weight(C.TAINT_TOLERATION),
         w_image=profile.score_weight(C.IMAGE_LOCALITY),
+        w_spread=profile.score_weight(C.POD_TOPOLOGY_SPREAD),
         filter_fit=profile.has_filter(C.NODE_RESOURCES_FIT),
         filter_ports=profile.has_filter(C.NODE_PORTS),
+        filter_spread=profile.has_filter(C.POD_TOPOLOGY_SPREAD),
     )
 
 
@@ -269,6 +327,7 @@ def feasible_and_scores(
     nonzero_requested: jnp.ndarray | None = None,
     pod_count: jnp.ndarray | None = None,
     node_ports: jnp.ndarray | None = None,
+    spread_counts: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The full Filter + Score composition for a batch against ONE snapshot
     state (no inter-pod capacity coupling — that is the assignment engine's
@@ -305,6 +364,17 @@ def feasible_and_scores(
             "pl,nl->pn", wants_conf, ports.astype(jnp.int32)
         ) > 0                                                 # (P, N)
         mask = mask & ~conflict
+    sp = b.spread
+    sp_counts = None
+    if sp is not None:
+        sp_counts = sp.node_count if spread_counts is None else spread_counts
+        if p.filter_spread and sp.has_hard:
+            spread_ok = jax.vmap(
+                lambda si, ac, ms, md, sm: SP.spread_filter_pod(
+                    sp, sp_counts, si, ac, ms, md, sm
+                )
+            )(sp.sig_idx, sp.action, sp.max_skew, sp.min_domains, sp.self_match)
+            mask = mask & spread_ok
 
     # --- Score -----------------------------------------------------------
     total = jnp.zeros(mask.shape, dtype=jnp.int64)
@@ -335,6 +405,13 @@ def feasible_and_scores(
         total = total + p.w_image * S.image_locality_score(
             b.image_sum_scores, b.image_count
         )
+    if sp is not None and p.w_spread and sp.has_soft:
+        spread_sc = jax.vmap(
+            lambda si, ac, ms, ig, m: SP.spread_score_pod(
+                sp, sp_counts, si, ac, ms, ig, m
+            )
+        )(sp.sig_idx, sp.action, sp.max_skew, sp.ignored, mask)
+        total = total + p.w_spread * spread_sc
     return mask, total
 
 

@@ -1,0 +1,128 @@
+"""PodTopologySpread device kernels.
+
+Reference semantics (pkg/scheduler/framework/plugins/podtopologyspread/):
+- Filter (filtering.go:314): per DoNotSchedule constraint,
+  ``matchNum + selfMatch − minMatch > maxSkew`` → infeasible; nodes missing
+  the topology key are infeasible outright. ``minMatch`` is the minimum
+  per-domain match count over counted domains, treated as 0 when
+  ``len(domains) < minDomains`` (filtering.go:55 minMatchNum).
+- Score (scoring.go:199): per ScheduleAnyway constraint,
+  ``cnt·log(size+2) + (maxSkew−1)`` summed over constraints, rounded; then
+  the plugin's own NormalizeScore (scoring.go:229):
+  ``MaxNodeScore·(max+min−s)/max`` over scored nodes, ignored → 0,
+  max==0 → MaxNodeScore.
+
+All kernels take the carried per-(signature, node) match-count state
+(``counts``) so in-batch assignments (greedy scan) reproduce the reference's
+updateWithPod (filtering.go:181) exactly. Per-domain sums are segment-sums of
+``counts`` over the interned domain ids; domain id −1 (node ineligible /
+value not counted) routes to a scratch segment and reads back matchNum 0 via
+the Go-map-miss convention.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MAX_NODE_SCORE = 100
+_BIG = jnp.iinfo(jnp.int32).max
+
+
+def _domain_sums(counts_s, eligible_s, node_domain_s, num_domains_total):
+    """(D+1,) per-domain match sums for one signature; slot D is the −1
+    scratch bucket."""
+    seg = jnp.where(node_domain_s >= 0, node_domain_s, num_domains_total)
+    vals = jnp.where(eligible_s, counts_s, 0)
+    return jax.ops.segment_sum(vals, seg, num_segments=num_domains_total + 1)
+
+
+def spread_filter_pod(st, counts, sig_idx, action, max_skew, min_domains, self_match):
+    """(N,) bool feasibility for ONE pod's hard constraints.
+
+    ``st`` is the device SpreadTensors pytree; ``counts`` the (S, N) carried
+    state; the remaining args are the pod's (C,) constraint-slot rows.
+    """
+    n = st.eligible.shape[1]
+    d = st.domain_present.shape[1]
+    ok = jnp.ones(n, dtype=bool)
+    C = sig_idx.shape[0]
+    for c in range(C):  # C is a small static bound; unrolled
+        sid = sig_idx[c]
+        valid = (sid >= 0) & (action[c] == 0)
+        s = jnp.maximum(sid, 0)
+        elig = st.eligible[s]
+        dom = st.node_domain[s]
+        sums = _domain_sums(counts[s], elig, dom, d)          # (D+1,)
+        present = st.domain_present[s]
+        min_match = jnp.min(jnp.where(present, sums[:d], _BIG))
+        min_match = jnp.where(
+            st.num_domains[s] < min_domains[c], 0, min_match
+        )
+        match_num = jnp.where(dom >= 0, sums[jnp.where(dom >= 0, dom, d)], 0)
+        skew_ok = (match_num + self_match[c] - min_match) <= max_skew[c]
+        ok_c = st.has_key[s] & skew_ok
+        ok = ok & jnp.where(valid, ok_c, True)
+    return ok
+
+
+def spread_score_pod(
+    st, counts, sig_idx, action, max_skew, ignored, mask
+):
+    """(N,) int64 normalized spread score for ONE pod.
+
+    ``mask`` is the pod's final feasibility row (the reference scores only
+    nodes that passed Filter); ``ignored`` its soft-ignored row.
+    """
+    n = st.eligible.shape[1]
+    d = st.domain_present.shape[1]
+    scored = mask & ~ignored
+    raw = jnp.zeros(n, dtype=jnp.float64)
+    C = sig_idx.shape[0]
+    for c in range(C):
+        sid = sig_idx[c]
+        valid = (sid >= 0) & (action[c] == 1)
+        s = jnp.maximum(sid, 0)
+        elig = st.eligible[s]
+        dom = st.node_domain[s]
+        sums = _domain_sums(counts[s], elig, dom, d)
+        # per-node count: hostname constraints read the node's own count
+        # (scoring.go:217), others the node's domain sum
+        cnt_node = jnp.where(
+            st.is_hostname[s],
+            counts[s].astype(jnp.int64),
+            jnp.where(dom >= 0, sums[jnp.where(dom >= 0, dom, d)], 0),
+        )
+        # topology size over *scored* nodes (initPreScoreState topoSize /
+        # filteredNodes−ignored for hostname)
+        seg = jnp.where(dom >= 0, dom, d)
+        present_scored = (
+            jax.ops.segment_max(
+                scored.astype(jnp.int32), seg, num_segments=d + 1
+            )[:d]
+            > 0
+        )
+        size = jnp.where(
+            st.is_hostname[s],
+            jnp.sum(scored),
+            jnp.sum(present_scored),
+        )
+        weight = jnp.log(size.astype(jnp.float64) + 2.0)
+        contrib = cnt_node.astype(jnp.float64) * weight + (
+            max_skew[c].astype(jnp.float64) - 1.0
+        )
+        raw = raw + jnp.where(valid & st.has_key[s], contrib, 0.0)
+    score = jnp.round(raw).astype(jnp.int64)                  # (N,)
+
+    # NormalizeScore (scoring.go:229) over scored nodes
+    min_s = jnp.min(jnp.where(scored, score, jnp.iinfo(jnp.int64).max))
+    max_s = jnp.max(jnp.where(scored, score, 0))
+    normalized = jnp.where(
+        max_s == 0,
+        jnp.int64(MAX_NODE_SCORE),
+        MAX_NODE_SCORE * (max_s + min_s - score) // jnp.maximum(max_s, 1),
+    )
+    # A pod with no soft constraints Skips the plugin entirely
+    # (scoring.go:149 PreScore returns Skip) — 0, not the max==0 branch.
+    any_soft = jnp.any((sig_idx >= 0) & (action == 1))
+    return jnp.where(any_soft & scored, normalized, 0)

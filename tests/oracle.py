@@ -277,9 +277,11 @@ def greedy(
     w_balanced: int = 0,
     w_node_affinity: int = 0,
     w_taint: int = 0,
+    w_spread: int = 0,
     strategy: str = "least",
     check_ports: bool = True,
     check_static: bool = True,
+    check_spread: bool = False,
 ) -> list[str | None]:
     """The per-pod greedy loop: Filter → Score → Normalize → weighted sum →
     first-max selectHost → assume (NodeInfo.add_pod). Mutates ``infos``."""
@@ -290,6 +292,7 @@ def greedy(
             (not check_static or static_feasible(pod, info))
             and fits(pod, info)
             and (not check_ports or ports_ok(pod, info))
+            and (not check_spread or spread_filter(pod, infos, info))
             for info in infos
         ]
         if not any(feas):
@@ -315,10 +318,154 @@ def greedy(
             norm = default_normalize(raw, reverse=True)
             for j in range(len(infos)):
                 totals[j] += w_taint * norm[j]
+        if w_spread:
+            sp = spread_scores(pod, infos, feas)
+            for j in range(len(infos)):
+                totals[j] += w_spread * sp[j]
         best, best_score = -1, -1
         for j in range(len(infos)):
             if feas[j] and totals[j] > best_score:
                 best, best_score = j, totals[j]
         infos[best].add_pod(pod.with_node(infos[best].node.name))
         out.append(infos[best].node.name)
+    return out
+
+
+# --- PodTopologySpread (plugins/podtopologyspread) -------------------------
+
+def _sel_matches(selector, labels):
+    """Selector.Matches: None = Nothing, empty = Everything."""
+    if selector is None:
+        return False
+    return sel.label_selector_matches(selector, labels)
+
+
+def _sel_counts(selector, labels):
+    """countPodsMatchSelector (common.go:145): empty selector counts nothing."""
+    if selector is None:
+        return False
+    if not selector.match_labels and not selector.match_expressions:
+        return False
+    return sel.label_selector_matches(selector, labels)
+
+
+def _spread_node_eligible(pod: t.Pod, info: NodeInfo, key_set, c) -> bool:
+    """calPreFilterState processNode guards + matchNodeInclusionPolicies."""
+    labels = info.node.labels_dict()
+    for k in key_set:
+        if k not in labels:
+            return False
+    if c.node_affinity_policy == "Honor":
+        if not node_affinity_filter(pod, info):
+            return False
+    if c.node_taints_policy == "Honor":
+        if sel.find_untolerated_taint(info.node.taints, pod.tolerations) is not None:
+            return False
+    return True
+
+
+def _spread_counts(pod: t.Pod, infos, c, key_set):
+    """{topology value: matching pod count} over eligible nodes."""
+    m: dict[str, int] = {}
+    for info in infos:
+        if not _spread_node_eligible(pod, info, key_set, c):
+            continue
+        v = info.node.labels_dict()[c.topology_key]
+        n = 0
+        for ex in info.pods.values():
+            if ex.namespace != pod.namespace:
+                continue
+            if _sel_counts(c.selector, ex.labels_dict()):
+                n += 1
+        m[v] = m.get(v, 0) + n
+    return m
+
+
+def spread_filter(pod: t.Pod, infos, info_j: NodeInfo) -> bool:
+    """filtering.go:314 Filter for one candidate node."""
+    hard = [
+        c for c in pod.topology_spread_constraints
+        if c.when_unsatisfiable == t.UnsatisfiableConstraintAction.DO_NOT_SCHEDULE
+    ]
+    if not hard:
+        return True
+    key_set = frozenset(c.topology_key for c in hard)
+    labels_j = info_j.node.labels_dict()
+    for c in hard:
+        if c.topology_key not in labels_j:
+            return False
+        m = _spread_counts(pod, infos, c, key_set)
+        min_domains = c.min_domains if c.min_domains is not None else 1
+        if len(m) < min_domains:
+            min_match = 0
+        else:
+            min_match = min(m.values()) if m else 0
+        self_match = 1 if _sel_matches(c.selector, pod.labels_dict()) else 0
+        match_num = m.get(labels_j[c.topology_key], 0)
+        if match_num + self_match - min_match > c.max_skew:
+            return False
+    return True
+
+
+def spread_scores(pod: t.Pod, infos, feasible: list[bool]) -> list[int]:
+    """scoring.go Score + NormalizeScore over the feasible set. Returns a
+    per-node normalized score (0 for infeasible/ignored nodes)."""
+    soft = [
+        c for c in pod.topology_spread_constraints
+        if c.when_unsatisfiable == t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY
+    ]
+    n = len(infos)
+    if not soft:
+        return [0] * n
+    key_set = frozenset(c.topology_key for c in soft)
+    ignored = []
+    for info in infos:
+        labels = info.node.labels_dict()
+        ignored.append(any(k not in labels for k in key_set))
+    scored = [feasible[j] and not ignored[j] for j in range(n)]
+
+    raw = [0.0] * n
+    for c in soft:
+        m = _spread_counts(pod, infos, c, key_set)
+        hostname = c.topology_key == "kubernetes.io/hostname"
+        # topoSize over scored nodes
+        if hostname:
+            size = sum(scored)
+        else:
+            vals = {
+                infos[j].node.labels_dict().get(c.topology_key)
+                for j in range(n) if scored[j]
+            }
+            size = len(vals)
+        weight = math.log(size + 2)
+        for j in range(n):
+            labels = infos[j].node.labels_dict()
+            if c.topology_key not in labels:
+                continue
+            if hostname:
+                cnt = 0
+                for ex in infos[j].pods.values():
+                    if ex.namespace == pod.namespace and _sel_counts(
+                        c.selector, ex.labels_dict()
+                    ):
+                        cnt += 1
+                # hostname counting is still gated on node eligibility in our
+                # batch model (counts state zeroed on ineligible nodes)
+                if not _spread_node_eligible(pod, infos[j], key_set, c):
+                    cnt = 0
+            else:
+                cnt = m.get(labels[c.topology_key], 0)
+            raw[j] += cnt * weight + (c.max_skew - 1)
+    score = [round(raw[j]) for j in range(n)]
+
+    smin = min((score[j] for j in range(n) if scored[j]), default=0)
+    smax = max((score[j] for j in range(n) if scored[j]), default=0)
+    out = [0] * n
+    for j in range(n):
+        if not scored[j]:
+            out[j] = 0
+        elif smax == 0:
+            out[j] = MAX
+        else:
+            out[j] = MAX * (smax + smin - score[j]) // smax
     return out

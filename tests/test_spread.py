@@ -1,0 +1,173 @@
+"""PodTopologySpread parity tests: device kernels (ops/spread.py via the
+framework runtime and greedy scan) vs. the scalar oracle implementing
+filtering.go / scoring.go semantics."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod, spread_constraint
+from kubetpu.assign import greedy_assign
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, score_params
+from kubetpu.framework import runtime as rt
+from kubetpu.state import Cache
+
+from . import oracle
+from .cluster_gen import ZONES, random_cluster
+
+ANYWAY = t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY
+DO_NOT = t.UnsatisfiableConstraintAction.DO_NOT_SCHEDULE
+
+
+def spread_profile(with_score: bool = True):
+    return C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.POD_TOPOLOGY_SPREAD, 1),
+        )),
+        scores=C.PluginSet(enabled=(
+            ((C.POD_TOPOLOGY_SPREAD, 2),) if with_score else ()
+        ) + ((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+
+
+def add_spread_pods(rng, pending, hard_ratio=0.5):
+    """Give a subset of pending pods zone/hostname spread constraints whose
+    selector matches their app label."""
+    out = []
+    for i, p in enumerate(pending):
+        if rng.random() < 0.7:
+            app = dict(p.labels).get("app", "web")
+            when = DO_NOT if rng.random() < hard_ratio else ANYWAY
+            cons = [
+                spread_constraint(
+                    int(rng.integers(1, 4)),
+                    "topology.kubernetes.io/zone",
+                    when=when,
+                    match_labels={"app": app},
+                )
+            ]
+            if rng.random() < 0.4:
+                cons.append(
+                    spread_constraint(
+                        int(rng.integers(1, 6)),
+                        "kubernetes.io/hostname",
+                        when=ANYWAY if rng.random() < 0.5 else DO_NOT,
+                        match_labels={"app": app},
+                    )
+                )
+            import dataclasses
+            p = dataclasses.replace(p, topology_spread_constraints=tuple(cons))
+        out.append(p)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_spread_filter_one_shot_parity(seed):
+    rng = np.random.default_rng(seed + 300)
+    cache, pending = random_cluster(rng, num_nodes=24, num_existing=50, num_pending=20)
+    pending = add_spread_pods(rng, pending, hard_ratio=1.0)
+    snap = cache.update_snapshot()
+    profile = spread_profile(with_score=False)
+    batch = encode_batch(snap, pending, profile, pad=False)
+    params = score_params(profile, batch.resource_names)
+    mask, _ = rt.filter_score_batch(batch.device, params)
+    mask = np.asarray(mask)
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            want = oracle.fits(pod, info) and oracle.spread_filter(pod, infos, info)
+            assert mask[i, j] == want, (pod.name, info.node.name)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_spread_score_one_shot_parity(seed):
+    rng = np.random.default_rng(seed + 400)
+    cache, pending = random_cluster(rng, num_nodes=18, num_existing=40, num_pending=15)
+    pending = add_spread_pods(rng, pending, hard_ratio=0.0)   # soft only
+    snap = cache.update_snapshot()
+    profile = spread_profile()
+    batch = encode_batch(snap, pending, profile, pad=False)
+    params = score_params(profile, batch.resource_names)
+    mask, total = rt.filter_score_batch(batch.device, params)
+    mask, total = np.asarray(mask), np.asarray(total)
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        feas = [bool(mask[i, j]) for j in range(len(infos))]
+        want_spread = oracle.spread_scores(pod, infos, feas)
+        for j, info in enumerate(infos):
+            want = oracle.least_allocated(
+                pod, info, [(t.CPU, 1), (t.MEMORY, 1)]
+            ) + 2 * want_spread[j]
+            assert total[i, j] == want, (pod.name, info.node.name, i, j)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+@pytest.mark.parametrize("hard_ratio", [1.0, 0.4])
+def test_spread_greedy_parity(seed, hard_ratio):
+    """End-to-end: in-batch assignments must update domain counts exactly as
+    sequential scheduling cycles recompute them."""
+    rng = np.random.default_rng(seed + 500)
+    cache, pending = random_cluster(rng, num_nodes=20, num_existing=30, num_pending=25)
+    pending = add_spread_pods(rng, pending, hard_ratio=hard_ratio)
+    snap = cache.update_snapshot()
+    profile = spread_profile()
+    batch = encode_batch(snap, pending, profile)
+    got = greedy_assign(batch, profile)
+    infos = [info.clone() for info in snap.node_infos()]
+    want = oracle.greedy(
+        infos, pending,
+        w_fit=1, w_spread=2,
+        check_ports=False, check_static=False, check_spread=True,
+    )
+    assert got == want
+
+
+def test_hard_zone_spread_round_robins():
+    """maxSkew=1 zone constraint forces strict round-robin across zones."""
+    cache = Cache()
+    for i in range(6):
+        cache.add_node(make_node(
+            f"n{i}", cpu_milli=100000,
+            labels={"kubernetes.io/hostname": f"n{i}",
+                    "topology.kubernetes.io/zone": ZONES[i % 3]},
+        ))
+    pods = [
+        make_pod(
+            f"p{i}", cpu_milli=100, labels={"app": "web"},
+            spread=[spread_constraint(1, "topology.kubernetes.io/zone",
+                                      when=DO_NOT, match_labels={"app": "web"})],
+        )
+        for i in range(9)
+    ]
+    profile = spread_profile()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pods, profile)
+    got = greedy_assign(batch, profile)
+    zone_of = {f"n{i}": ZONES[i % 3] for i in range(6)}
+    counts = {z: 0 for z in ZONES}
+    for i, a in enumerate(got):
+        assert a is not None
+        counts[zone_of[a]] += 1
+        # after each assignment the zone counts may differ by at most 1
+        assert max(counts.values()) - min(counts.values()) <= 1, (i, counts)
+
+
+def test_missing_topology_key_is_infeasible():
+    cache = Cache()
+    cache.add_node(make_node("zoned", cpu_milli=1000,
+                             labels={"topology.kubernetes.io/zone": "z1"}))
+    cache.add_node(make_node("bare", cpu_milli=100000))
+    pod = make_pod(
+        "p", cpu_milli=100, labels={"app": "web"},
+        spread=[spread_constraint(1, "topology.kubernetes.io/zone",
+                                  when=DO_NOT, match_labels={"app": "web"})],
+    )
+    profile = spread_profile()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], profile)
+    got = greedy_assign(batch, profile)
+    assert got == ["zoned"]
