@@ -54,6 +54,24 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         node_ports=b.node_ports,
         port_conflict=b.port_conflict,
         spread=_spread_view(b.spread, i),
+        podaffinity=_pa_view(b.podaffinity, i),
+    )
+
+
+def _pa_view(pa, i):
+    if pa is None:
+        return None
+    import dataclasses
+
+    return dataclasses.replace(
+        pa,
+        update=pa.update[i][None],
+        fa_rows=pa.fa_rows[i][None],
+        fa_self=pa.fa_self[i][None],
+        ra_rows=pa.ra_rows[i][None],
+        ea_rows=pa.ea_rows[i][None],
+        score_rows=pa.score_rows[i][None],
+        score_vals=pa.score_vals[i][None],
     )
 
 
@@ -85,13 +103,13 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
     node_iota = jnp.arange(n, dtype=jnp.int32)
 
     def step(state, i):
-        requested, nonzero, pod_count, node_ports, spread_counts = state
+        requested, nonzero, pod_count, node_ports, spread_counts, pa_sums = state
         view = _pod_view(b, i)
         mask, score = rt.feasible_and_scores(
             view, params,
             requested=requested, nonzero_requested=nonzero,
             pod_count=pod_count, node_ports=node_ports,
-            spread_counts=spread_counts,
+            spread_counts=spread_counts, pa_sums=pa_sums,
         )
         mask, score = mask[0], score[0]
         feasible = jnp.any(mask)
@@ -113,12 +131,28 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
                 & onehot[None, :]
             )
             spread_counts = spread_counts + upd.astype(spread_counts.dtype)
-        return (requested, nonzero, pod_count, node_ports, spread_counts), chosen
+        if pa_sums is not None:
+            # interpodaffinity updateWithPod (filtering.go:75): scatter the
+            # assigned pod's increments into each row at the chosen node's
+            # domain (no-op when the node lacks the row's topology key).
+            pa = b.podaffinity
+            r = pa_sums.shape[0]
+            dcol = jnp.where(
+                chosen >= 0, pa.node_domain[:, jnp.maximum(chosen, 0)], -1
+            )                                                   # (R,)
+            inc = jnp.where(dcol >= 0, pa.update[i], 0)
+            pa_sums = pa_sums.at[
+                jnp.arange(r), jnp.maximum(dcol, 0)
+            ].add(inc)
+        return (
+            requested, nonzero, pod_count, node_ports, spread_counts, pa_sums
+        ), chosen
 
     p = b.requests.shape[0]
     init = (
         b.requested, b.nonzero_requested, b.pod_count, b.node_ports,
         None if b.spread is None else b.spread.node_count,
+        None if b.podaffinity is None else b.podaffinity.base_sums,
     )
     final_state, assignments = jax.lax.scan(
         step, init, jnp.arange(p, dtype=jnp.int32)

@@ -107,6 +107,8 @@ class Profile:
     scores: PluginSet = DEFAULT_SCORES
     scoring_strategy: ScoringStrategy = ScoringStrategy()
     balanced_resources: tuple[tuple[str, int], ...] = ((t.CPU, 1), (t.MEMORY, 1))
+    # InterPodAffinityArgs.HardPodAffinityWeight (types_pluginargs.go, default 1)
+    hard_pod_affinity_weight: int = 1
     # Cluster-level default spread constraints applied to pods without their
     # own (pkg/scheduler/framework/plugins/podtopologyspread defaults:
     # zone maxSkew 3 ScheduleAnyway + hostname maxSkew 5 ScheduleAnyway,

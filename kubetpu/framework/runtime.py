@@ -26,7 +26,9 @@ import numpy as np
 from ..api import types as t
 from ..ops import filters as F
 from ..ops import scores as S
+from ..ops import podaffinity as PA
 from ..ops import spread as SP
+from ..state import podaffinity as enc_podaffinity
 from ..state import spread as enc_spread
 from ..state import encoder as enc
 from ..state.snapshot import Snapshot
@@ -68,6 +70,27 @@ class DeviceBatch:
     port_conflict: jnp.ndarray      # (K, K) bool
     # PodTopologySpread (None when no pod has constraints)
     spread: "SpreadDevice | None" = None
+    # InterPodAffinity (None when no pod carries (anti)affinity)
+    podaffinity: "PodAffinityDevice | None" = None
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class PodAffinityDevice:
+    """Device-side InterPodAffinity rows (see state.podaffinity)."""
+
+    node_domain: jnp.ndarray  # (R, N) int32
+    has_key: jnp.ndarray      # (R, N) bool
+    base_sums: jnp.ndarray    # (R, D) int64 — scan state init
+    update: jnp.ndarray       # (P, R) int64
+    fa_rows: jnp.ndarray      # (P, CA) int32
+    fa_self: jnp.ndarray      # (P,) bool
+    ra_rows: jnp.ndarray      # (P, CR) int32
+    ea_rows: jnp.ndarray      # (P, CE) int32
+    score_rows: jnp.ndarray   # (P, CS) int32
+    score_vals: jnp.ndarray   # (P, CS) int64
+    has_filter_work: bool = field(metadata=dict(static=True), default=False)
+    has_score_work: bool = field(metadata=dict(static=True), default=False)
 
 
 @jax.tree_util.register_dataclass
@@ -199,6 +222,34 @@ def encode_batch(
         profile.has_filter(C.POD_TOPOLOGY_SPREAD)
         or profile.has_score(C.POD_TOPOLOGY_SPREAD)
     )
+    want_interpod = profile is None or (
+        profile.has_filter(C.INTER_POD_AFFINITY)
+        or profile.has_score(C.INTER_POD_AFFINITY)
+    )
+    pa_dev = None
+    if want_interpod:
+        pa = enc_podaffinity.encode_pod_affinity(
+            nt, pods,
+            hard_pod_affinity_weight=(
+                profile.hard_pod_affinity_weight if profile is not None else 1
+            ),
+            pad_pods=PP,
+        )
+        if pa is not None:
+            pa_dev = PodAffinityDevice(
+                node_domain=jnp.asarray(pa.node_domain),
+                has_key=jnp.asarray(pa.has_key),
+                base_sums=jnp.asarray(pa.base_sums),
+                update=jnp.asarray(pa.update),
+                fa_rows=jnp.asarray(pa.fa_rows),
+                fa_self=jnp.asarray(pa.fa_self),
+                ra_rows=jnp.asarray(pa.ra_rows),
+                ea_rows=jnp.asarray(pa.ea_rows),
+                score_rows=jnp.asarray(pa.score_rows),
+                score_vals=jnp.asarray(pa.score_vals),
+                has_filter_work=pa.has_filter_work,
+                has_score_work=pa.has_score_work,
+            )
     spread_dev = None
     if want_spread:
         sp = enc_spread.encode_spread(nt, pods, pad_pods=PP)
@@ -256,6 +307,7 @@ def encode_batch(
         node_ports=jnp.asarray(pb.node_ports),
         port_conflict=jnp.asarray(pb.port_conflict),
         spread=spread_dev,
+        podaffinity=pa_dev,
     )
     return EncodedBatch(
         device=dev,
@@ -284,9 +336,11 @@ class ScoreParams:
     w_taint: int
     w_image: int
     w_spread: int
+    w_interpod: int
     filter_fit: bool
     filter_ports: bool
     filter_spread: bool
+    filter_interpod: bool
 
 
 def score_params(profile: C.Profile, resource_names: Sequence[str]) -> ScoreParams:
@@ -307,9 +361,11 @@ def score_params(profile: C.Profile, resource_names: Sequence[str]) -> ScorePara
         w_taint=profile.score_weight(C.TAINT_TOLERATION),
         w_image=profile.score_weight(C.IMAGE_LOCALITY),
         w_spread=profile.score_weight(C.POD_TOPOLOGY_SPREAD),
+        w_interpod=profile.score_weight(C.INTER_POD_AFFINITY),
         filter_fit=profile.has_filter(C.NODE_RESOURCES_FIT),
         filter_ports=profile.has_filter(C.NODE_PORTS),
         filter_spread=profile.has_filter(C.POD_TOPOLOGY_SPREAD),
+        filter_interpod=profile.has_filter(C.INTER_POD_AFFINITY),
     )
 
 
@@ -328,6 +384,7 @@ def feasible_and_scores(
     pod_count: jnp.ndarray | None = None,
     node_ports: jnp.ndarray | None = None,
     spread_counts: jnp.ndarray | None = None,
+    pa_sums: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The full Filter + Score composition for a batch against ONE snapshot
     state (no inter-pod capacity coupling — that is the assignment engine's
@@ -375,6 +432,17 @@ def feasible_and_scores(
                 )
             )(sp.sig_idx, sp.action, sp.max_skew, sp.min_domains, sp.self_match)
             mask = mask & spread_ok
+    pa = b.podaffinity
+    pa_state = None
+    if pa is not None:
+        pa_state = pa.base_sums if pa_sums is None else pa_sums
+        if p.filter_interpod and pa.has_filter_work:
+            pa_ok = jax.vmap(
+                lambda fr, fs, rr, er: PA.affinity_filter_pod(
+                    pa, pa_state, fr, fs, rr, er
+                )
+            )(pa.fa_rows, pa.fa_self, pa.ra_rows, pa.ea_rows)
+            mask = mask & pa_ok
 
     # --- Score -----------------------------------------------------------
     total = jnp.zeros(mask.shape, dtype=jnp.int64)
@@ -412,6 +480,11 @@ def feasible_and_scores(
             )
         )(sp.sig_idx, sp.action, sp.max_skew, sp.ignored, mask)
         total = total + p.w_spread * spread_sc
+    if pa is not None and p.w_interpod and pa.has_score_work:
+        pa_sc = jax.vmap(
+            lambda sr, sv, m: PA.affinity_score_pod(pa, pa_state, sr, sv, m)
+        )(pa.score_rows, pa.score_vals, mask)
+        total = total + p.w_interpod * pa_sc
     return mask, total
 
 

@@ -278,10 +278,13 @@ def greedy(
     w_node_affinity: int = 0,
     w_taint: int = 0,
     w_spread: int = 0,
+    w_interpod: int = 0,
     strategy: str = "least",
     check_ports: bool = True,
     check_static: bool = True,
     check_spread: bool = False,
+    check_interpod: bool = False,
+    hard_weight: int = 1,
 ) -> list[str | None]:
     """The per-pod greedy loop: Filter → Score → Normalize → weighted sum →
     first-max selectHost → assume (NodeInfo.add_pod). Mutates ``infos``."""
@@ -293,6 +296,7 @@ def greedy(
             and fits(pod, info)
             and (not check_ports or ports_ok(pod, info))
             and (not check_spread or spread_filter(pod, infos, info))
+            and (not check_interpod or interpod_filter(pod, infos, info))
             for info in infos
         ]
         if not any(feas):
@@ -322,6 +326,10 @@ def greedy(
             sp = spread_scores(pod, infos, feas)
             for j in range(len(infos)):
                 totals[j] += w_spread * sp[j]
+        if w_interpod:
+            ip = interpod_scores(pod, infos, feas, hard_weight=hard_weight)
+            for j in range(len(infos)):
+                totals[j] += w_interpod * ip[j]
         best, best_score = -1, -1
         for j in range(len(infos)):
             if feas[j] and totals[j] > best_score:
@@ -468,4 +476,156 @@ def spread_scores(pod: t.Pod, infos, feasible: list[bool]) -> list[int]:
             out[j] = MAX
         else:
             out[j] = MAX * (smax + smin - score[j]) // smax
+    return out
+
+
+# --- InterPodAffinity (plugins/interpodaffinity) ---------------------------
+
+def _term_matches(term: t.PodAffinityTerm, owner_ns: str, pod: t.Pod) -> bool:
+    namespaces = term.namespaces or (owner_ns,)
+    ns_ok = pod.namespace in namespaces
+    if not ns_ok and term.namespace_selector is not None:
+        ns_ok = sel.label_selector_matches(term.namespace_selector, {})
+    if not ns_ok:
+        return False
+    if term.selector is None:
+        return False
+    return sel.label_selector_matches(term.selector, pod.labels_dict())
+
+
+def _req_aff(pod):
+    a = pod.affinity.pod_affinity if pod.affinity else None
+    return a.required if a else ()
+
+
+def _req_anti(pod):
+    a = pod.affinity.pod_anti_affinity if pod.affinity else None
+    return a.required if a else ()
+
+
+def _pref_aff(pod):
+    a = pod.affinity.pod_affinity if pod.affinity else None
+    return a.preferred if a else ()
+
+
+def _pref_anti(pod):
+    a = pod.affinity.pod_anti_affinity if pod.affinity else None
+    return a.preferred if a else ()
+
+
+def interpod_filter(pod: t.Pod, infos, info_j: NodeInfo) -> bool:
+    """filtering.go:364-419 with maps built from scratch (calPreFilterState)."""
+    aff_terms = _req_aff(pod)
+    anti_terms = _req_anti(pod)
+    # existingAntiAffinityCounts
+    existing_anti: dict[tuple, int] = {}
+    for info in infos:
+        labels_n = info.node.labels_dict()
+        for ex in info.pods.values():
+            for term in _req_anti(ex):
+                if _term_matches(term, ex.namespace, pod):
+                    v = labels_n.get(term.topology_key)
+                    if v is not None:
+                        existing_anti[(term.topology_key, v)] = (
+                            existing_anti.get((term.topology_key, v), 0) + 1
+                        )
+    labels_j = info_j.node.labels_dict()
+    for k, v in labels_j.items():
+        if existing_anti.get((k, v), 0) > 0:
+            return False
+    # incoming anti-affinity
+    if anti_terms:
+        anti_counts: dict[tuple, int] = {}
+        for info in infos:
+            labels_n = info.node.labels_dict()
+            for ex in info.pods.values():
+                for term in anti_terms:
+                    if _term_matches(term, pod.namespace, ex):
+                        v = labels_n.get(term.topology_key)
+                        if v is not None:
+                            anti_counts[(term.topology_key, v)] = (
+                                anti_counts.get((term.topology_key, v), 0) + 1
+                            )
+        for term in anti_terms:
+            v = labels_j.get(term.topology_key)
+            if v is not None and anti_counts.get((term.topology_key, v), 0) > 0:
+                return False
+    # incoming affinity
+    if aff_terms:
+        aff_counts: dict[tuple, int] = {}
+        for info in infos:
+            labels_n = info.node.labels_dict()
+            for ex in info.pods.values():
+                if all(_term_matches(tm, pod.namespace, ex) for tm in aff_terms):
+                    for term in aff_terms:
+                        v = labels_n.get(term.topology_key)
+                        if v is not None:
+                            aff_counts[(term.topology_key, v)] = (
+                                aff_counts.get((term.topology_key, v), 0) + 1
+                            )
+        pods_exist = True
+        for term in aff_terms:
+            v = labels_j.get(term.topology_key)
+            if v is None:
+                return False
+            if aff_counts.get((term.topology_key, v), 0) <= 0:
+                pods_exist = False
+        if not pods_exist:
+            if len(aff_counts) == 0 and all(
+                _term_matches(tm, pod.namespace, pod) for tm in aff_terms
+            ):
+                return True
+            return False
+    return True
+
+
+def interpod_scores(
+    pod: t.Pod, infos, feasible: list[bool], hard_weight: int = 1
+) -> list[int]:
+    """scoring.go processExistingPod + Score + NormalizeScore."""
+    topo: dict[tuple, int] = {}
+
+    def add(term, weight, target, owner_ns, node_labels, mult):
+        if _term_matches(term, owner_ns, target):
+            v = node_labels.get(term.topology_key)
+            if v is not None:
+                key = (term.topology_key, v)
+                topo[key] = topo.get(key, 0) + weight * mult
+
+    for info in infos:
+        labels_n = info.node.labels_dict()
+        if not labels_n:
+            continue
+        for ex in info.pods.values():
+            for wt in _pref_aff(pod):
+                add(wt.term, wt.weight, ex, pod.namespace, labels_n, 1)
+            for wt in _pref_anti(pod):
+                add(wt.term, wt.weight, ex, pod.namespace, labels_n, -1)
+            if hard_weight > 0:
+                for term in _req_aff(ex):
+                    add(term, hard_weight, pod, ex.namespace, labels_n, 1)
+            for wt in _pref_aff(ex):
+                add(wt.term, wt.weight, pod, ex.namespace, labels_n, 1)
+            for wt in _pref_anti(ex):
+                add(wt.term, wt.weight, pod, ex.namespace, labels_n, -1)
+
+    n = len(infos)
+    raw = [0] * n
+    for j, info in enumerate(infos):
+        labels_j = info.node.labels_dict()
+        s = 0
+        for (k, v), w in topo.items():
+            if labels_j.get(k) == v:
+                s += w
+        raw[j] = s
+    if not topo:
+        return [0] * n
+    feas_scores = [raw[j] for j in range(n) if feasible[j]]
+    if not feas_scores:
+        return [0] * n
+    mn, mx = min(feas_scores), max(feas_scores)
+    out = [0] * n
+    for j in range(n):
+        if feasible[j] and mx > mn:
+            out[j] = int(MAX * (raw[j] - mn) / (mx - mn))
     return out

@@ -1,0 +1,174 @@
+"""InterPodAffinity parity tests: device kernels vs the scalar oracle
+implementing interpodaffinity/filtering.go and scoring.go."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod, pod_affinity_term
+from kubetpu.assign import greedy_assign
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, score_params
+from kubetpu.framework import runtime as rt
+from kubetpu.state import Cache
+
+from . import oracle
+from .cluster_gen import random_cluster
+
+ZONE = "topology.kubernetes.io/zone"
+HOST = "kubernetes.io/hostname"
+APPS = ["web", "db", "cache"]
+
+
+def affinity_profile():
+    return C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.INTER_POD_AFFINITY, 1),
+        )),
+        scores=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.INTER_POD_AFFINITY, 2),
+        )),
+        default_spread_constraints=(),
+    )
+
+
+def rand_affinity(rng) -> t.Affinity | None:
+    """Random mix of required/preferred pod (anti)affinity terms."""
+    kind = rng.random()
+    app = str(rng.choice(APPS))
+    key = ZONE if rng.random() < 0.6 else HOST
+    term = pod_affinity_term(key, match_labels={"app": app})
+    if kind < 0.25:
+        return t.Affinity(pod_affinity=t.PodAffinity(required=(term,)))
+    if kind < 0.5:
+        return t.Affinity(pod_anti_affinity=t.PodAffinity(required=(term,)))
+    if kind < 0.75:
+        return t.Affinity(pod_affinity=t.PodAffinity(
+            preferred=(t.WeightedPodAffinityTerm(int(rng.integers(1, 101)), term),)
+        ))
+    return t.Affinity(pod_anti_affinity=t.PodAffinity(
+        preferred=(t.WeightedPodAffinityTerm(int(rng.integers(1, 101)), term),)
+    ))
+
+
+def add_affinity(rng, pods, ratio=0.6):
+    out = []
+    for p in pods:
+        if rng.random() < ratio:
+            p = dataclasses.replace(p, affinity=rand_affinity(rng))
+        out.append(p)
+    return out
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_interpod_filter_one_shot_parity(seed):
+    rng = np.random.default_rng(seed + 600)
+    cache, pending = random_cluster(rng, num_nodes=16, num_existing=40, num_pending=15)
+    pending = add_affinity(rng, pending)
+    snap = cache.update_snapshot()
+    profile = affinity_profile()
+    batch = encode_batch(snap, pending, profile, pad=False)
+    params = score_params(profile, batch.resource_names)
+    mask, _ = rt.filter_score_batch(batch.device, params)
+    mask = np.asarray(mask)
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        for j, info in enumerate(infos):
+            want = oracle.fits(pod, info) and oracle.interpod_filter(pod, infos, info)
+            assert mask[i, j] == want, (pod.name, info.node.name)
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_interpod_score_one_shot_parity(seed):
+    rng = np.random.default_rng(seed + 700)
+    cache, pending = random_cluster(rng, num_nodes=14, num_existing=35, num_pending=12)
+    pending = add_affinity(rng, pending)
+    snap = cache.update_snapshot()
+    profile = affinity_profile()
+    batch = encode_batch(snap, pending, profile, pad=False)
+    params = score_params(profile, batch.resource_names)
+    mask, total = rt.filter_score_batch(batch.device, params)
+    mask, total = np.asarray(mask), np.asarray(total)
+    infos = snap.node_infos()
+    for i, pod in enumerate(pending):
+        feas = [bool(mask[i, j]) for j in range(len(infos))]
+        want_ip = oracle.interpod_scores(pod, infos, feas)
+        for j, info in enumerate(infos):
+            want = oracle.least_allocated(
+                pod, info, [(t.CPU, 1), (t.MEMORY, 1)]
+            ) + 2 * want_ip[j]
+            assert total[i, j] == want, (pod.name, info.node.name)
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_interpod_greedy_parity(seed):
+    """End-to-end: assigned pods' terms take effect for later pods in the
+    same batch (anti-affinity from assigned pods, affinity targets)."""
+    rng = np.random.default_rng(seed + 800)
+    cache, pending = random_cluster(rng, num_nodes=12, num_existing=25, num_pending=18)
+    pending = add_affinity(rng, pending)
+    snap = cache.update_snapshot()
+    profile = affinity_profile()
+    batch = encode_batch(snap, pending, profile)
+    got = greedy_assign(batch, profile)
+    infos = [info.clone() for info in snap.node_infos()]
+    want = oracle.greedy(
+        infos, pending,
+        w_fit=1, w_interpod=2,
+        check_ports=False, check_static=False, check_interpod=True,
+    )
+    assert got == want
+
+
+def test_anti_affinity_excludes_one_per_host():
+    """Hostname anti-affinity: at most one matching pod per node, including
+    pods assigned earlier in the batch."""
+    cache = Cache()
+    for i in range(3):
+        cache.add_node(make_node(f"n{i}", cpu_milli=100000,
+                                 labels={HOST: f"n{i}"}))
+    anti = t.Affinity(pod_anti_affinity=t.PodAffinity(
+        required=(pod_affinity_term(HOST, match_labels={"app": "db"}),)
+    ))
+    pods = [
+        make_pod(f"p{i}", cpu_milli=10, labels={"app": "db"}, affinity=anti)
+        for i in range(4)
+    ]
+    profile = affinity_profile()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pods, profile)
+    got = greedy_assign(batch, profile)
+    assert sorted(got[:3]) == ["n0", "n1", "n2"]
+    assert got[3] is None      # nowhere left
+
+
+def test_affinity_self_escape_then_colocate():
+    """First pod of a self-affine series passes via the escape clause; later
+    pods must land in the same zone (counting the in-batch assignment)."""
+    cache = Cache()
+    for i in range(4):
+        cache.add_node(make_node(
+            f"n{i}", cpu_milli=1000,
+            labels={HOST: f"n{i}", ZONE: f"z{i % 2}"},
+        ))
+    aff = t.Affinity(pod_affinity=t.PodAffinity(
+        required=(pod_affinity_term(ZONE, match_labels={"app": "web"}),)
+    ))
+    pods = [
+        make_pod(f"p{i}", cpu_milli=600, labels={"app": "web"}, affinity=aff)
+        for i in range(3)
+    ]
+    profile = affinity_profile()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pods, profile)
+    got = greedy_assign(batch, profile)
+    assert got[0] is not None
+    zone_of = {f"n{i}": f"z{i % 2}" for i in range(4)}
+    z0 = zone_of[got[0]]
+    # cpu 600/1000 → one pod per node; same zone has exactly 2 nodes
+    assert zone_of[got[1]] == z0
+    assert got[2] is None or zone_of[got[2]] == z0
