@@ -19,6 +19,13 @@ DEFAULT_PREEMPTION = "DefaultPreemption"
 DEFAULT_BINDER = "DefaultBinder"
 PRIORITY_SORT = "PrioritySort"
 SCHEDULING_GATES = "SchedulingGates"
+VOLUME_RESTRICTIONS = "VolumeRestrictions"
+VOLUME_ZONE = "VolumeZone"
+NODE_VOLUME_LIMITS = "NodeVolumeLimits"
+VOLUME_BINDING = "VolumeBinding"
+DYNAMIC_RESOURCES = "DynamicResources"
+GANG_SCHEDULING = "GangScheduling"
+POD_GROUP_PODS_COUNT = "PodGroupPodsCount"
 
 ALL_FILTERS = frozenset({
     NODE_RESOURCES_FIT,

@@ -1,0 +1,22 @@
+"""The scheduler runtime: event loop, batched scheduling cycle, async
+binding — the analog of ``pkg/scheduler`` (scheduler.go, schedule_one.go,
+eventhandlers.go, backend/api_dispatcher/).
+
+The reference's shape — serialized scheduling cycle + async per-pod binding
+cycle (schedule_one.go:141) — survives, re-proportioned for a device-batched
+scheduler: one *batch* of pods per cycle runs through the device
+Filter+Score+assign program, assume lands synchronously in the cache, and
+binds stream out through the API dispatcher off the hot loop.
+"""
+
+from .api_dispatcher import APICall, APIDispatcher, BindCall, StatusPatchCall
+from .scheduler import Scheduler, SchedulerMetrics
+
+__all__ = [
+    "APICall",
+    "APIDispatcher",
+    "BindCall",
+    "StatusPatchCall",
+    "Scheduler",
+    "SchedulerMetrics",
+]

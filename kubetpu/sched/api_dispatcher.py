@@ -1,0 +1,182 @@
+"""Async API dispatcher — mergeable call queue off the scheduling hot loop.
+
+Analog of ``pkg/scheduler/backend/api_dispatcher/`` (api_dispatcher.go:32
+``APIDispatcher``, call_queue.go:71 mergeable queue): API writes (binds,
+status patches) are enqueued by the scheduling loop and executed by worker
+threads against a client, so the device-batched hot loop never blocks on I/O.
+Two calls for the same (object, call type) merge — the newer call absorbs the
+older, which is resolved as skipped (the reference's ``merge``/relevance
+machinery).
+
+``workers=0`` runs calls inline at ``add`` time — deterministic mode for
+tests and single-threaded harnesses.
+"""
+
+from __future__ import annotations
+
+import queue as _queue
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Callable, Protocol
+
+from ..api import types as t
+
+
+class CallSkipped(Exception):
+    """Passed to a superseded call's ``on_done``: the call never executed
+    because a newer call for the same (object, type) absorbed it — distinct
+    from success (None) and from an execution error."""
+
+
+class APICall(Protocol):
+    """One queued API write (the reference's fwk.APICall)."""
+
+    call_type: str
+    object_key: str
+
+    def execute(self, client: Any) -> None: ...
+
+    def merge(self, older: "APICall") -> None: ...
+
+
+@dataclass
+class BindCall:
+    """POST pods/<name>/binding (DefaultBinder,
+    framework/plugins/defaultbinder/default_binder.go). ``on_done(err)`` fires
+    after execution — the scheduler's binding-cycle epilogue (finish_binding
+    on success, forget+requeue on failure)."""
+
+    pod: t.Pod
+    node_name: str
+    on_done: Callable[[Exception | None], None] | None = None
+    call_type: str = field(default="bind", init=False)
+
+    @property
+    def object_key(self) -> str:
+        return f"{self.pod.namespace}/{self.pod.name}"
+
+    def execute(self, client: Any) -> None:
+        client.bind(self.pod, self.node_name)
+
+    def merge(self, older: "BindCall") -> None:
+        # a second bind for the same pod supersedes the first
+        if older.on_done is not None:
+            older.on_done(CallSkipped())
+
+
+@dataclass
+class StatusPatchCall:
+    """PATCH pod status (condition PodScheduled=False with the failure
+    message — framework/api_calls/ pod_status_patch)."""
+
+    pod: t.Pod
+    reason: str
+    message: str = ""
+    on_done: Callable[[Exception | None], None] | None = None
+    call_type: str = field(default="status_patch", init=False)
+
+    @property
+    def object_key(self) -> str:
+        return f"{self.pod.namespace}/{self.pod.name}"
+
+    def execute(self, client: Any) -> None:
+        client.patch_status(self.pod, self.reason, self.message)
+
+    def merge(self, older: "StatusPatchCall") -> None:
+        if older.on_done is not None:
+            older.on_done(CallSkipped())
+
+
+_CLOSE = object()
+
+
+class APIDispatcher:
+    """See module docstring."""
+
+    def __init__(self, client: Any, workers: int = 2) -> None:
+        self._client = client
+        self._workers = workers
+        self._pending: dict[tuple[str, str], APICall] = {}
+        self._lock = threading.Lock()
+        self._q: _queue.Queue = _queue.Queue()
+        self._threads: list[threading.Thread] = []
+        self._added = 0
+        self._executed = 0
+        self._errors = 0
+        self._closed = False
+        if workers > 0:
+            for i in range(workers):
+                th = threading.Thread(
+                    target=self._worker, name=f"api-dispatcher-{i}", daemon=True
+                )
+                th.start()
+                self._threads.append(th)
+
+    def add(self, call: APICall) -> None:
+        if self._workers == 0 or self._closed:
+            self._execute(call)  # inline: no pool, or pool already drained
+            return
+        with self._lock:
+            key = (call.call_type, call.object_key)
+            older = self._pending.get(key)
+            if older is not None:
+                call.merge(older)
+                older_skipped = True
+            else:
+                older_skipped = False
+            self._pending[key] = call
+            self._added += 1
+            if not older_skipped:
+                self._q.put(key)
+
+    def _pop(self, key: tuple[str, str]) -> APICall | None:
+        with self._lock:
+            return self._pending.pop(key, None)
+
+    def _execute(self, call: APICall) -> None:
+        err: Exception | None = None
+        try:
+            call.execute(self._client)
+        except Exception as e:  # noqa: BLE001 — surfaced via on_done
+            err = e
+            self._errors += 1
+        self._executed += 1
+        on_done = getattr(call, "on_done", None)
+        if on_done is not None:
+            try:
+                on_done(err)
+            except Exception:
+                pass
+
+    def _worker(self) -> None:
+        while True:
+            key = self._q.get()
+            if key is _CLOSE:
+                self._q.task_done()  # keep join() balanced after close
+                return
+            call = self._pop(key)
+            if call is not None:
+                self._execute(call)
+            self._q.task_done()
+
+    def sync(self) -> None:
+        """Barrier: wait until every queued call has executed (tests and
+        harness measurement boundaries)."""
+        if self._workers > 0:
+            self._q.join()
+
+    def close(self) -> None:
+        if self._workers > 0 and not self._closed:
+            self.sync()
+            self._closed = True
+            for _ in self._threads:  # one sentinel per worker, each acked
+                self._q.put(_CLOSE)
+            for th in self._threads:
+                th.join(timeout=5)
+
+    def stats(self) -> dict[str, int]:
+        return {
+            "added": self._added,
+            "executed": self._executed,
+            "errors": self._errors,
+        }

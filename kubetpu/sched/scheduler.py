@@ -1,0 +1,325 @@
+"""The batched scheduler loop.
+
+Analog of ``pkg/scheduler/scheduler.go`` (struct Scheduler :68, Run :524) +
+``schedule_one.go``, re-proportioned for device batches:
+
+- the reference pops ONE pod per cycle (``ScheduleOne`` :67) and runs
+  parallel-for Filter/Score over nodes; we pop a BATCH (``pop_batch``) and
+  run the whole Filter+Score+greedy-assign composition as one XLA program
+  (``assign.greedy.greedy_assign_device``) — sequential assume semantics are
+  preserved *inside* the program by the lax.scan carry, so binding parity
+  with the per-pod loop holds even on saturated clusters.
+- the scheduling cycle is serialized; binding is async per pod through the
+  API dispatcher (the reference's ``go sched.runBindingCycle``,
+  schedule_one.go:141).
+- informer deliveries go through ``on_*`` handlers that update cache + queue
+  (eventhandlers.go:455 ``addAllEventHandlers``).
+
+Failure handling mirrors ``handleSchedulingFailure``: unschedulable pods go
+back to the queue with their rejector plugins recorded (driving the queueing
+hints); bind errors forget the assumed pod and requeue as error-status.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+from ..api import types as t
+from ..framework import config as C
+from ..framework import runtime as rt
+from ..assign.greedy import greedy_assign_device
+from ..state.snapshot import Cache, Snapshot
+from ..queue import PriorityQueue, QueuedPodInfo
+from ..queue.events import (
+    ActionType,
+    ClusterEvent,
+    EventResource,
+    default_queueing_hints,
+    node_update_event,
+)
+from .. import names as N
+from .api_dispatcher import APIDispatcher, BindCall, CallSkipped, StatusPatchCall
+
+import jax
+import numpy as np
+
+
+@dataclass
+class SchedulerMetrics:
+    """Counters the full metrics registry (kubetpu.metrics) wraps later;
+    names mirror pkg/scheduler/metrics/metrics.go."""
+
+    schedule_attempts: int = 0          # scheduling_attempts_total
+    scheduled: int = 0                  # result "scheduled"
+    unschedulable: int = 0              # result "unschedulable"
+    errors: int = 0                     # result "error"
+    bind_errors: int = 0
+    cycles: int = 0
+    scheduling_seconds: float = 0.0     # scheduling_algorithm_duration sum
+    # bounded reservoir of recent e2e attempt latencies (p99 estimation);
+    # the metrics registry keeps the full histogram
+    attempt_latencies: collections.deque = field(
+        default_factory=lambda: collections.deque(maxlen=10000)
+    )
+
+
+class Scheduler:
+    """See module docstring. Single-owner object: informer callbacks and the
+    scheduling loop run on the owner's thread (the reference serializes the
+    scheduling cycle the same way); only API-dispatcher completions hop
+    threads, and they re-enter through a completion queue drained by the
+    loop."""
+
+    def __init__(
+        self,
+        client: Any,
+        profile: C.Profile | None = None,
+        cfg: C.SchedulerConfiguration | None = None,
+        max_batch: int = 1024,
+        dispatcher_workers: int = 2,
+        clock: Callable[[], float] = time.monotonic,
+    ) -> None:
+        self.cfg = cfg or C.SchedulerConfiguration()
+        self.profile = profile or self.cfg.profile()
+        self.cache = Cache(clock=clock)
+        self.clock = clock
+        self.max_batch = max_batch
+        filters = self.profile.filters.names()
+        self.queue = PriorityQueue(
+            hints=default_queueing_hints(filters),
+            pre_enqueue=[self._scheduling_gates],
+            clock=clock,
+            initial_backoff_seconds=self.cfg.pod_initial_backoff_seconds,
+            max_backoff_seconds=self.cfg.pod_max_backoff_seconds,
+        )
+        self.dispatcher = APIDispatcher(client, workers=dispatcher_workers)
+        self.metrics = SchedulerMetrics()
+        self._snapshot = Snapshot()
+        # deque: append/popleft are atomic, so dispatcher worker threads can
+        # complete into it while the loop thread drains
+        self._bind_completions: collections.deque = collections.deque()
+        self._post_filter: Callable[..., Any] | None = None  # set by preemption
+        self._last_flush = 0.0
+
+    # ------------------------------------------------------ event handlers
+    # The informer seam (eventhandlers.go:455): assigned pods maintain the
+    # cache; unscheduled pods maintain the queue; every event also feeds the
+    # queueing hints so parked pods wake up.
+
+    @staticmethod
+    def _scheduling_gates(pod: t.Pod) -> str | None:
+        """SchedulingGates PreEnqueue (plugins/schedulinggates): any
+        non-empty spec.schedulingGates holds the pod out of the queue."""
+        return N.SCHEDULING_GATES if pod.scheduling_gates else None
+
+    def on_node_add(self, node: t.Node) -> None:
+        self.cache.add_node(node)
+        self.queue.on_event(
+            ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
+        )
+
+    def on_node_update(self, old: t.Node | None, new: t.Node) -> None:
+        self.cache.update_node(new)
+        ev = node_update_event(old, new)
+        if ev.action:
+            self.queue.on_event(ev, old, new)
+
+    def on_node_delete(self, node: t.Node) -> None:
+        self.cache.remove_node(node.name)
+        self.queue.on_event(
+            ClusterEvent(EventResource.NODE, ActionType.DELETE), node, None
+        )
+
+    def on_pod_add(self, pod: t.Pod) -> None:
+        if pod.node_name:
+            self.cache.add_pod(pod)
+            self.queue.on_event(
+                ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
+                None, pod,
+            )
+        else:
+            self.queue.add(pod)
+
+    def on_pod_update(self, old: t.Pod | None, new: t.Pod) -> None:
+        if new.node_name:
+            if old is not None and old.node_name:
+                self.cache.update_pod(old, new)
+                from ..queue.events import pod_update_event
+
+                ev = pod_update_event(old, new)
+                if ev.action:
+                    self.queue.on_event(
+                        ClusterEvent(EventResource.ASSIGNED_POD, ev.action),
+                        old, new,
+                    )
+            else:
+                # pending → assigned transition (bind confirmation, possibly
+                # by another actor): drop any unscheduled queue incarnation
+                # and fire AssignedPod/Add — the wake-up parked affinity/
+                # spread pods registered for (the reference's filtered
+                # informers deliver exactly this Delete+Add pair)
+                self.cache.add_pod(new)
+                self.queue.delete(new)
+                self.queue.on_event(
+                    ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
+                    None, new,
+                )
+        else:
+            self.queue.update(old, new)
+
+    def on_pod_delete(self, pod: t.Pod) -> None:
+        if pod.node_name or self.cache.is_assumed(pod.uid):
+            self.cache.remove_pod(pod)
+            # an assumed pod also lives in the queue's in-flight set until
+            # its bind completes — drop it so a failing bind cannot
+            # resurrect a deleted pod
+            self.queue.delete(pod)
+            self.queue.on_event(
+                ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
+                pod, None,
+            )
+        else:
+            self.queue.delete(pod)
+
+    # --------------------------------------------------------- batch cycle
+
+    def schedule_batch(self, max_batch: int | None = None) -> dict[str, int]:
+        """One scheduling cycle over up to ``max_batch`` pods. Returns result
+        counts. The cycle: drain bind completions → pop batch → snapshot →
+        encode → device assign → assume + dispatch binds → requeue failures."""
+        self._drain_bind_completions()
+        self._flush_timers()
+        batch_infos = self.queue.pop_batch(max_batch or self.max_batch)
+        self.metrics.cycles += 1
+        if not batch_infos:
+            return {"scheduled": 0, "unschedulable": 0}
+        t0 = self.clock()
+
+        try:
+            self._snapshot = self.cache.update_snapshot(self._snapshot)
+            pods = [info.pod for info in batch_infos]
+            batch = rt.encode_batch(self._snapshot, pods, self.profile)
+            params = rt.score_params(self.profile, batch.resource_names)
+            assignments, _ = greedy_assign_device(batch.device, params)
+            idx = np.asarray(jax.device_get(assignments))
+        except Exception:
+            # a cycle-level failure must not strand the popped batch in the
+            # in-flight set: requeue everything as error status (the
+            # reference's handleSchedulingFailure), then surface the bug
+            self.metrics.errors += len(batch_infos)
+            for info in batch_infos:
+                self.queue.add_unschedulable(info, error=True)
+            raise
+
+        scheduled = 0
+        failed: list[QueuedPodInfo] = []
+        for k, info in enumerate(batch_infos):
+            j = int(idx[k])
+            self.metrics.schedule_attempts += 1
+            if 0 <= j < len(batch.node_names):
+                self._assume_and_bind(info, batch.node_names[j])
+                scheduled += 1
+            else:
+                failed.append(info)
+        self.metrics.scheduled += scheduled
+        self.metrics.unschedulable += len(failed)
+        self.metrics.scheduling_seconds += self.clock() - t0
+
+        for info in failed:
+            self._handle_unschedulable(info)
+        return {"scheduled": scheduled, "unschedulable": len(failed)}
+
+    def _assume_and_bind(self, info: QueuedPodInfo, node_name: str) -> None:
+        """assumeAndReserve + async binding cycle (schedule_one.go:307,:391)."""
+        assumed = info.pod.with_node(node_name)
+        self.cache.assume_pod(assumed)
+        # the pod stays in flight through the binding cycle — queue.done only
+        # after the bind lands, so events during binding replay on failure
+        if info.initial_attempt_timestamp is not None:
+            self.metrics.attempt_latencies.append(
+                self.clock() - info.initial_attempt_timestamp
+            )
+
+        def on_done(err: Exception | None, info=info, assumed=assumed) -> None:
+            self._bind_completions.append((info, assumed, err))
+
+        self.dispatcher.add(BindCall(info.pod, node_name, on_done=on_done))
+
+    def _drain_bind_completions(self) -> None:
+        """Bind results re-enter the loop thread here (the reference handles
+        this in the per-pod binding goroutine; we serialize into the cycle)."""
+        while True:
+            try:
+                info, assumed, err = self._bind_completions.popleft()
+            except IndexError:
+                break
+            if isinstance(err, CallSkipped):
+                continue  # superseded bind: the newer call's completion rules
+            if err is None:
+                self.cache.finish_binding(assumed.uid)
+                self.queue.done(info.key)
+            else:
+                # bind failed: roll back the assume and retry as error status
+                # (handleSchedulingFailure, schedule_one.go:1190 analog)
+                self.metrics.bind_errors += 1
+                self.metrics.errors += 1
+                self.cache.forget_pod(assumed)
+                self.queue.add_unschedulable(info, error=True)
+
+    def _handle_unschedulable(self, info: QueuedPodInfo) -> None:
+        """No feasible node. Run PostFilter (preemption) if wired, then
+        requeue with rejector plugins for the queueing hints.
+
+        Rejector attribution is conservative: every enabled Filter plugin is
+        recorded (the reference records the plugins that actually rejected
+        per node, schedule_one.go FitError) — over-eager wake-ups are safe;
+        the leftover flush bounds staleness either way."""
+        if self._post_filter is not None:
+            nominated = self._post_filter(self, info)
+            if nominated is not None:
+                # preemption nominated a node: victims' deletes will fire
+                # hints; pod waits in backoff for the room to open
+                self.queue.add_unschedulable(
+                    info, [N.DEFAULT_PREEMPTION]
+                )
+                return
+        where = self.queue.add_unschedulable(
+            info, self.profile.filters.names()
+        )
+        if where not in ("deleted", "already-queued"):
+            # only patch status for pods that still exist and we own
+            self.dispatcher.add(
+                StatusPatchCall(info.pod, reason="Unschedulable")
+            )
+
+    # ------------------------------------------------------------- running
+
+    def _flush_timers(self) -> None:
+        """The reference's flush goroutines (scheduling_queue.go:442: backoff
+        every 1 s, unschedulable leftover every 30 s) folded into the loop."""
+        now = self.clock()
+        if now - self._last_flush >= 30.0:
+            self.queue.flush_unschedulable_leftover()
+            self.cache.cleanup_expired()
+            self._last_flush = now
+        self.queue.flush_backoff_completed()
+
+    def run_until_idle(self, max_cycles: int = 10000) -> int:
+        """Drive cycles until no pod is ready (harness/test mode). Returns
+        total scheduled."""
+        total = 0
+        for _ in range(max_cycles):
+            res = self.schedule_batch()
+            total += res["scheduled"]
+            if res["scheduled"] == 0 and res["unschedulable"] == 0:
+                break
+        self.dispatcher.sync()
+        self._drain_bind_completions()
+        return total
+
+    def close(self) -> None:
+        self.dispatcher.close()
+        self._drain_bind_completions()

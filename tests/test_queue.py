@@ -1,0 +1,394 @@
+"""Scheduling queue behavior — mirrors the reference's queue unit tests
+(pkg/scheduler/backend/queue/scheduling_queue_test.go, backoff_queue_test.go):
+sort order, backoff math, hint-driven requeue, in-flight event replay,
+leftover flush, gating."""
+
+import pytest
+
+from kubetpu.api.wrappers import make_pod
+from kubetpu.queue import (
+    ActionType,
+    ClusterEvent,
+    EventResource,
+    PriorityQueue,
+    QueueingHint,
+)
+from kubetpu.queue.events import HintRegistration, default_queueing_hints
+from kubetpu import names as N
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 100.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+NODE_ADD = ClusterEvent(EventResource.NODE, ActionType.ADD)
+POD_DELETE = ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE)
+
+
+def make_queue(hints=None, **kw):
+    clock = FakeClock()
+    q = PriorityQueue(hints=hints, clock=clock, **kw)
+    return q, clock
+
+
+def test_pop_order_priority_then_fifo():
+    # PrioritySort (queuesort/priority_sort.go): priority desc, timestamp asc
+    q, clock = make_queue()
+    q.add(make_pod("low-1", priority=0, creation_index=0))
+    clock.tick(1)
+    q.add(make_pod("high", priority=10, creation_index=1))
+    clock.tick(1)
+    q.add(make_pod("low-2", priority=0, creation_index=2))
+    batch = q.pop_batch(10)
+    assert [i.pod.name for i in batch] == ["high", "low-1", "low-2"]
+
+
+def test_pop_batch_limit_and_in_flight():
+    q, _ = make_queue()
+    for i in range(5):
+        q.add(make_pod(f"p{i}", creation_index=i))
+    first = q.pop_batch(3)
+    assert len(first) == 3 and q.stats()["in_flight"] == 3
+    second = q.pop_batch(3)
+    assert [i.pod.name for i in second] == ["p3", "p4"]
+
+
+def test_unschedulable_parks_without_matching_event():
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD)]}
+    q, clock = make_queue(hints=hints)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    where = q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert where == "unschedulable"
+    assert q.pop_batch(1) == []
+    # an event the hint map doesn't cover for this plugin: no move
+    q.on_event(ClusterEvent(EventResource.NODE, ActionType.UPDATE_NODE_LABEL))
+    assert q.stats()["unschedulable"] == 1
+    # a covered event: requeued (backoff — one failed attempt)
+    moved = q.on_event(NODE_ADD)
+    assert moved == 1
+    assert q.stats()["backoff"] == 1
+    clock.tick(1.0)  # initial backoff 1 s << (1-1)
+    assert [i.pod.name for i in q.pop_batch(1)] == ["p"]
+
+
+def test_backoff_is_exponential_and_capped():
+    # backoff_queue.go:247 — initial << (count-1), capped at max
+    q, clock = make_queue(initial_backoff_seconds=1.0, max_backoff_seconds=10.0)
+    q.add(make_pod("p"))
+    for expected in [1.0, 2.0, 4.0, 8.0, 10.0, 10.0]:
+        (info,) = q.pop_batch(1)
+        q.add_unschedulable(info, [N.NODE_NAME])
+        assert q.is_backing_off(info)
+        assert info.backoff_expiration - info.timestamp == pytest.approx(expected)
+        # park expires after 300 s; backoff has long passed → straight to active
+        clock.tick(300.0)
+        assert q.flush_unschedulable_leftover() == 1
+        assert q.stats()["active"] == 1
+
+
+def test_gang_entity_backoff_cap_scales_with_sqrt_size():
+    # backoff_queue.go:252 — maxBackoff *= sqrt(entitySize) for pod groups
+    q, _ = make_queue(initial_backoff_seconds=1.0, max_backoff_seconds=10.0)
+    assert q._backoff_duration(10, entity_size=1) == pytest.approx(10.0)
+    assert q._backoff_duration(10, entity_size=4) == pytest.approx(20.0)
+
+
+def test_error_backoff_uses_consecutive_errors():
+    # backoff_queue.go:223 — error count wins over unschedulable count
+    q, clock = make_queue()
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [], error=True)
+    assert info.consecutive_errors == 1 and info.unschedulable_count == 0
+    clock.tick(300)
+    q.flush_unschedulable_leftover()
+    clock.tick(1.0)
+    (info,) = q.pop_batch(1)
+    # success path resets consecutive errors
+    q.add_unschedulable(info, [N.NODE_NAME])
+    assert info.consecutive_errors == 0 and info.unschedulable_count == 1
+
+
+def test_in_flight_event_replay():
+    """Events firing while a pod is being scheduled are not lost
+    (the reference's inFlightEvents list)."""
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD)]}
+    q, clock = make_queue(hints=hints)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    # node added WHILE the pod is in flight
+    q.on_event(NODE_ADD)
+    where = q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert where == "backoff"  # replayed event → straight back to backoff
+
+
+def test_hint_fn_skip_and_queue():
+    calls = []
+
+    def hint(pod, old, new):
+        calls.append(pod.name)
+        return QueueingHint.QUEUE if new == "good" else QueueingHint.SKIP
+
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD, hint)]}
+    q, _ = make_queue(hints=hints)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert q.on_event(NODE_ADD, new="bad") == 0
+    assert q.on_event(NODE_ADD, new="good") == 1
+    assert calls == ["p", "p"]
+
+
+def test_hint_exception_is_queue():
+    def bad_hint(pod, old, new):
+        raise RuntimeError("boom")
+
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD, bad_hint)]}
+    q, _ = make_queue(hints=hints)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert q.on_event(NODE_ADD) == 1  # exception treated as QUEUE
+
+
+def test_flush_unschedulable_leftover():
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD)]}
+    q, clock = make_queue(hints=hints, max_in_unschedulable_seconds=300.0)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    clock.tick(299)
+    assert q.flush_unschedulable_leftover() == 0
+    clock.tick(2)
+    assert q.flush_unschedulable_leftover() == 1
+
+
+def test_scheduling_gates_pre_enqueue():
+    """SchedulingGates (PreEnqueue, interface.go:445): gated pods never reach
+    activeQ; clearing the gates admits them."""
+
+    def gates(pod):
+        return N.SCHEDULING_GATES if pod.scheduling_gates else None
+
+    q, _ = make_queue(pre_enqueue=[gates])
+    gated = make_pod("g", gates=("wait",))
+    q.add(gated)
+    q.add(make_pod("free"))
+    assert [i.pod.name for i in q.pop_batch(10)] == ["free"]
+    assert q.stats()["gated"] == 1
+    q.update(gated, make_pod("g"))  # gates removed
+    assert [i.pod.name for i in q.pop_batch(10)] == ["g"]
+
+
+def test_update_and_delete():
+    q, _ = make_queue()
+    p = make_pod("p", priority=0)
+    q.add(p)
+    q.update(p, make_pod("p", priority=5))
+    q.add(make_pod("other", priority=1))
+    # updated object is returned (identity by namespace/name)
+    batch = q.pop_batch(10)
+    got = {i.pod.name: i.pod.priority for i in batch}
+    assert got == {"p": 5, "other": 1}
+    q2, _ = make_queue()
+    q2.add(make_pod("x"))
+    q2.delete(make_pod("x"))
+    assert q2.pop_batch(10) == []
+
+
+def test_activate_moves_parked_pods():
+    q, clock = make_queue()
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert q.stats()["unschedulable"] == 1
+    assert q.activate([info.pod]) == 1
+    assert [i.pod.name for i in q.pop_batch(1)] == ["p"]
+
+
+def test_wildcard_event_requeues_everything():
+    # a fired WildCardEvent matches every registration (forced full requeue)
+    from kubetpu.queue import EVENT_ALL
+
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD)]}
+    q, _ = make_queue(hints=hints)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert q.on_event(EVENT_ALL) == 1
+
+
+def test_error_pod_requeues_after_backoff_not_park():
+    # empty rejector set (transient error) → retry after backoff, not a
+    # 300 s park (determineSchedulingHintForInFlightPod empty-rejector case)
+    q, clock = make_queue()
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    assert q.add_unschedulable(info, [], error=True) == "backoff"
+    clock.tick(1.0)
+    assert [i.pod.name for i in q.pop_batch(1)] == ["p"]
+
+
+def test_deleted_in_flight_pod_is_not_resurrected():
+    q, _ = make_queue()
+    p = make_pod("p")
+    q.add(p)
+    (info,) = q.pop_batch(1)
+    q.delete(p)  # informer delete delivered mid-attempt
+    assert q.add_unschedulable(info, [N.NODE_RESOURCES_FIT]) == "deleted"
+    assert len(q) == 0
+
+
+def test_stale_backoff_entry_does_not_release_early():
+    q, clock = make_queue(initial_backoff_seconds=1.0)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [], error=True)  # backoff, expiry t+1
+    assert q.activate([info.pod]) == 1          # leaves stale heap entry
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [], error=True)  # backoff again, expiry t+2
+    clock.tick(1.5)  # past the stale entry's expiry, before the real one
+    assert q.pop_batch(1) == []
+    assert q.stats()["backoff"] == 1
+    clock.tick(1.0)
+    assert [i.pod.name for i in q.pop_batch(1)] == ["p"]
+
+
+def test_pending_plugin_hint_skips_backoff():
+    # a QUEUE from a pending (Permit/gang) plugin goes straight to activeQ
+    # (the reference's queueImmediately)
+    hints = {N.GANG_SCHEDULING: [HintRegistration(NODE_ADD)]}
+    q, _ = make_queue(hints=hints)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, pending_plugins=[N.GANG_SCHEDULING])
+    assert q.on_event(NODE_ADD) == 1
+    # no clock tick: would still be backing off, but lands in active anyway
+    assert [i.pod.name for i in q.pop_batch(1)] == ["p"]
+
+
+def test_irrelevant_pod_update_keeps_pod_parked():
+    # annotation-ish updates (nothing classified) must not yank parked pods
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(
+        ClusterEvent(EventResource.POD, ActionType.UPDATE_POD_SCALE_DOWN))]}
+    q, _ = make_queue(hints=hints)
+    p = make_pod("p", cpu_milli=500)
+    q.add(p)
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    q.update(p, make_pod("p", cpu_milli=500, priority=0))  # no relevant change
+    assert q.stats()["unschedulable"] == 1
+    # a genuine scale-down fires the fit hint
+    q.update(p, make_pod("p", cpu_milli=100))
+    assert q.stats()["unschedulable"] == 0
+
+
+def test_event_log_truncation_is_conservative():
+    q, _ = make_queue(hints={N.NODE_RESOURCES_FIT: [HintRegistration(NODE_ADD)]},
+                      max_event_log=2)
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    # the QUEUE-worthy event is truncated away by later irrelevant events
+    q.on_event(NODE_ADD)
+    for _ in range(3):
+        q.on_event(ClusterEvent(EventResource.NODE, ActionType.UPDATE_NODE_LABEL))
+    assert q.add_unschedulable(info, [N.NODE_RESOURCES_FIT]) in ("active", "backoff")
+
+
+def test_readd_while_in_flight_no_double_tracking():
+    q, _ = make_queue()
+    p = make_pod("p")
+    q.add(p)
+    (info,) = q.pop_batch(1)
+    q.add(make_pod("p", priority=2))  # informer re-delivers Add mid-attempt
+    assert len(q) == 0 and q.stats()["in_flight"] == 1
+    # the in-flight info carries the refreshed object
+    assert info.pod.priority == 2
+    q.add_unschedulable(info, [N.NODE_RESOURCES_FIT])
+    assert len(q) == 1  # exactly one entry, not two
+
+
+def test_activate_respects_gates():
+    def gates(pod):
+        return N.SCHEDULING_GATES if pod.scheduling_gates else None
+
+    q, _ = make_queue(pre_enqueue=[gates])
+    g = make_pod("g", gates=("wait",))
+    q.add(g)
+    assert q.activate([g]) == 0  # still gated: stays parked
+    assert q.stats()["gated"] == 1 and q.pop_batch(1) == []
+
+
+def test_priority_decrease_reorders_active_heap():
+    q, _ = make_queue()
+    p = make_pod("p", priority=10)
+    q.add(p)
+    q.add(make_pod("mid", priority=5))
+    q.update(p, make_pod("p", priority=0))
+    assert [i.pod.name for i in q.pop_batch(2)] == ["mid", "p"]
+
+
+def test_request_increase_is_not_scale_down():
+    from kubetpu.queue.events import pod_update_event
+
+    old = make_pod("p", cpu_milli=100)
+    new = make_pod("p", requests={"cpu": 100, "example.com/gpu": 1})
+    ev = pod_update_event(old, new)
+    assert not (ev.action & ActionType.UPDATE_POD_SCALE_DOWN)
+
+
+def test_in_flight_pod_update_is_replayed():
+    """A pod shrunk mid-attempt fires its scale-down hint on requeue."""
+    hints = {N.NODE_RESOURCES_FIT: [HintRegistration(
+        ClusterEvent(EventResource.POD, ActionType.UPDATE_POD_SCALE_DOWN))]}
+    q, _ = make_queue(hints=hints)
+    p = make_pod("p", cpu_milli=4000)
+    q.add(p)
+    (info,) = q.pop_batch(1)
+    q.update(p, make_pod("p", cpu_milli=100))  # shrink while in flight
+    assert q.add_unschedulable(info, [N.NODE_RESOURCES_FIT]) == "backoff"
+
+
+def test_preemption_nominated_pod_wakes_on_victim_delete():
+    from kubetpu.queue.events import default_queueing_hints as dqh
+
+    q, _ = make_queue(hints=dqh([N.NODE_RESOURCES_FIT]))
+    q.add(make_pod("preemptor"))
+    (info,) = q.pop_batch(1)
+    q.add_unschedulable(info, [N.DEFAULT_PREEMPTION])
+    assert q.on_event(ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE)) == 1
+
+
+def test_event_log_pruned_when_no_in_flight():
+    q, _ = make_queue()
+    q.add(make_pod("p"))
+    (info,) = q.pop_batch(1)
+    q.on_event(NODE_ADD)
+    assert len(q._events) == 1
+    q.done(info.key)
+    assert q._events == []
+
+
+def test_default_hint_map_covers_enabled_filters():
+    reg = default_queueing_hints([
+        N.NODE_RESOURCES_FIT, N.TAINT_TOLERATION, N.POD_TOPOLOGY_SPREAD,
+    ])
+    assert set(reg) == {
+        N.NODE_RESOURCES_FIT, N.TAINT_TOLERATION, N.POD_TOPOLOGY_SPREAD,
+        N.DEFAULT_PREEMPTION,  # always registered (PostFilter wake-ups)
+    }
+    # fit reacts to node-add but not node-label-only updates
+    fit_events = [r.event for r in reg[N.NODE_RESOURCES_FIT]]
+    assert any(e.matches(NODE_ADD) for e in fit_events)
+    assert not any(
+        e.matches(ClusterEvent(EventResource.NODE, ActionType.UPDATE_NODE_LABEL))
+        for e in fit_events
+    )

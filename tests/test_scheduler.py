@@ -1,0 +1,274 @@
+"""Scheduler loop end-to-end — the analog of schedule_one_test.go's
+scheduler-level tests: batch cycles, assume/bind flow, failure requeue with
+hint-driven wake-up, bind-error rollback, gated pods."""
+
+import pytest
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.framework import config as C
+from kubetpu.sched import Scheduler
+from kubetpu import names as N
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def tick(self, dt):
+        self.t += dt
+
+
+class FakeClient:
+    """In-process API server stand-in (the integration tests' clientset)."""
+
+    def __init__(self, fail_binds_for=()):
+        self.bound = {}           # pod key -> node
+        self.patches = []
+        self.fail_binds_for = set(fail_binds_for)
+        self.bind_calls = 0
+
+    def bind(self, pod, node_name):
+        self.bind_calls += 1
+        key = f"{pod.namespace}/{pod.name}"
+        if key in self.fail_binds_for:
+            self.fail_binds_for.discard(key)  # fail once, then succeed
+            raise RuntimeError(f"bind conflict for {key}")
+        self.bound[key] = node_name
+
+    def patch_status(self, pod, reason, message=""):
+        self.patches.append((f"{pod.namespace}/{pod.name}", reason))
+
+
+def make_sched(client=None, profile=None, **kw):
+    clock = FakeClock()
+    s = Scheduler(
+        client=client or FakeClient(),
+        profile=profile or C.minimal_profile(),
+        dispatcher_workers=0,  # inline, deterministic
+        clock=clock,
+        **kw,
+    )
+    return s, clock
+
+
+def test_batch_schedules_all_when_capacity_fits():
+    client = FakeClient()
+    s, _ = make_sched(client)
+    for i in range(4):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=2000, memory=4 * 1024**3))
+    for j in range(8):
+        s.on_pod_add(make_pod(f"p{j}", cpu_milli=500, memory=256 * 1024**2,
+                              creation_index=j))
+    res = s.schedule_batch()
+    assert res == {"scheduled": 8, "unschedulable": 0}
+    s.dispatcher.sync()
+    assert len(client.bound) == 8
+    # capacity coupling: 2000m / 500m = 4 pods per node max
+    from collections import Counter
+
+    per_node = Counter(client.bound.values())
+    assert max(per_node.values()) <= 4
+
+
+def test_capacity_respected_across_batch():
+    """In-batch assume: pods later in the batch see earlier pods' usage."""
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=1000, memory=4 * 1024**3))
+    for j in range(3):
+        s.on_pod_add(make_pod(f"p{j}", cpu_milli=400, creation_index=j))
+    res = s.schedule_batch()
+    assert res == {"scheduled": 2, "unschedulable": 1}
+    assert len(client.bound) == 2
+
+
+def test_unschedulable_wakes_on_node_add():
+    client = FakeClient()
+    s, clock = make_sched(client)
+    s.on_node_add(make_node("small", cpu_milli=100))
+    s.on_pod_add(make_pod("big", cpu_milli=4000))
+    res = s.schedule_batch()
+    assert res["unschedulable"] == 1
+    assert client.patches == [("default/big", "Unschedulable")]
+    assert s.queue.stats()["unschedulable"] == 1
+    # an irrelevant event does not wake it
+    s.on_node_update(make_node("small", cpu_milli=100),
+                     make_node("small", cpu_milli=100, labels={"a": "b"}))
+    assert s.queue.stats()["unschedulable"] == 1
+    # a big node arrives → NodeResourcesFit hint fires → backoff → scheduled
+    s.on_node_add(make_node("huge", cpu_milli=8000))
+    clock.tick(2.0)
+    res = s.schedule_batch()
+    assert res["scheduled"] == 1
+    s.dispatcher.sync()
+    assert client.bound["default/big"] == "huge"
+
+
+def test_bind_failure_forgets_and_retries():
+    client = FakeClient(fail_binds_for=["default/p0"])
+    s, clock = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p0", cpu_milli=100))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    assert client.bound == {}  # first bind failed
+    # next cycle drains the completion: forget + error requeue (backoff 1 s)
+    s.schedule_batch()
+    assert s.metrics.bind_errors == 1
+    assert s.queue.stats()["backoff"] == 1
+    clock.tick(1.5)
+    s.schedule_batch()
+    s.dispatcher.sync()
+    assert client.bound == {"default/p0": "n0"}
+    # the cache holds exactly one copy of the pod
+    snap = s.cache.update_snapshot()
+    assert len(snap.nodes["n0"].pods) == 1
+
+
+def test_gated_pod_not_scheduled_until_gates_clear():
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0"))
+    gated = make_pod("g", cpu_milli=100, gates=("hold",))
+    s.on_pod_add(gated)
+    assert s.schedule_batch()["scheduled"] == 0
+    s.on_pod_update(gated, make_pod("g", cpu_milli=100))
+    assert s.schedule_batch()["scheduled"] == 1
+
+
+def test_assigned_pod_delete_frees_capacity_and_wakes():
+    client = FakeClient()
+    s, clock = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    blocker = make_pod("blocker", cpu_milli=900, node_name="n0")
+    s.on_pod_add(blocker)
+    s.on_pod_add(make_pod("want", cpu_milli=500))
+    assert s.schedule_batch()["unschedulable"] == 1
+    s.on_pod_delete(blocker)  # AssignedPod/Delete fires the fit hint
+    clock.tick(2.0)
+    assert s.schedule_batch()["scheduled"] == 1
+
+
+def test_bind_confirmation_replaces_assumed():
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    p = make_pod("p", cpu_milli=100)
+    s.on_pod_add(p)
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s.schedule_batch()  # drain completion → finish_binding
+    assert s.cache.is_assumed(p.uid)
+    # the watch delivers the bound pod → assumed entry confirmed
+    s.on_pod_update(p, p.with_node("n0"))
+    assert not s.cache.is_assumed(p.uid)
+    snap = s.cache.update_snapshot()
+    assert snap.nodes["n0"].requested.get("cpu", 0) == 100
+
+
+def test_priority_order_under_scarcity():
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    s.on_pod_add(make_pod("low", cpu_milli=800, priority=0, creation_index=0))
+    s.on_pod_add(make_pod("high", cpu_milli=800, priority=100, creation_index=1))
+    res = s.schedule_batch()
+    s.dispatcher.sync()
+    assert res == {"scheduled": 1, "unschedulable": 1}
+    assert "default/high" in client.bound
+
+
+def test_delete_while_binding_not_resurrected():
+    """A pod deleted during its (failing) bind must not come back."""
+    client = FakeClient(fail_binds_for=["default/p0"])
+    s, clock = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    p = make_pod("p0", cpu_milli=100)
+    s.on_pod_add(p)
+    s.schedule_batch()       # assume + bind (fails inline)
+    s.on_pod_delete(p)       # informer delete before completion drains
+    s.schedule_batch()       # drains the failed-bind completion
+    assert len(s.queue) == 0 and s.queue.stats()["in_flight"] == 0
+    clock.tick(5.0)
+    assert s.schedule_batch()["scheduled"] == 0
+    snap = s.cache.update_snapshot()
+    assert snap.nodes["n0"].pods == {}
+
+
+def test_pending_to_assigned_update_wakes_affinity_waiters():
+    """The pending→assigned transition fires AssignedPod/Add so parked
+    spread/affinity pods wake (reference: filtered informer Add)."""
+    from kubetpu.api.wrappers import pod_affinity_term
+    from kubetpu.api import types as t
+
+    client = FakeClient()
+    s, clock = make_sched(client, profile=C.Profile())
+    for i in range(2):
+        s.on_node_add(make_node(
+            f"n{i}", labels={"kubernetes.io/hostname": f"n{i}",
+                             "topology.kubernetes.io/zone": "z0"}))
+    follower = make_pod(
+        "follower", cpu_milli=100,
+        affinity=t.Affinity(pod_affinity=t.PodAffinity(
+            required=(pod_affinity_term("topology.kubernetes.io/zone",
+                                        {"app": "web"}),))),
+    )
+    s.on_pod_add(follower)
+    assert s.schedule_batch()["unschedulable"] == 1
+    # another actor binds a web pod; watch delivers pending→assigned update
+    web = make_pod("web", cpu_milli=100, labels={"app": "web"})
+    s.on_pod_update(web, web.with_node("n0"))
+    assert s.queue.stats()["unschedulable"] == 0  # woke up
+    clock.tick(2.0)
+    assert s.schedule_batch()["scheduled"] == 1
+
+
+def test_externally_bound_pod_leaves_queue():
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=100))
+    p = make_pod("p", cpu_milli=4000)
+    s.on_pod_add(p)
+    s.schedule_batch()  # unschedulable, parked
+    # external binder assigns it anyway
+    s.on_pod_update(p, p.with_node("n0"))
+    assert len(s.queue) == 0
+    assert s.schedule_batch()["scheduled"] == 0  # nothing left to schedule
+
+
+def test_dispatcher_close_then_sync_no_deadlock():
+    from kubetpu.sched import APIDispatcher, BindCall
+
+    client = FakeClient()
+    d = APIDispatcher(client, workers=2)
+    d.add(BindCall(make_pod("a"), "n0"))
+    d.close()
+    d.sync()   # must not deadlock
+    d.close()  # idempotent
+    d.add(BindCall(make_pod("b"), "n1"))  # executes inline after close
+    assert client.bound == {"default/a": "n0", "default/b": "n1"}
+
+
+def test_default_profile_full_cycle():
+    """Default plugin set (spread + affinity + taints enabled) runs a cycle."""
+    client = FakeClient()
+    s, _ = make_sched(client, profile=C.Profile())
+    for i in range(8):
+        s.on_node_add(make_node(
+            f"n{i}", cpu_milli=4000, memory=8 * 1024**3,
+            labels={"kubernetes.io/hostname": f"n{i}",
+                    "topology.kubernetes.io/zone": f"z{i % 2}"},
+        ))
+    for j in range(16):
+        s.on_pod_add(make_pod(f"p{j}", cpu_milli=200, memory=128 * 1024**2,
+                              labels={"app": "web"}, creation_index=j))
+    total = s.run_until_idle()
+    assert total == 16
+    # default spread constraints keep zones balanced within maxSkew=3+tie
+    from collections import Counter
+
+    zones = Counter(int(n[1]) % 2 for n in client.bound.values())
+    assert abs(zones[0] - zones[1]) <= 4
