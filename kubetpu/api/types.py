@@ -254,6 +254,21 @@ class Pod:
 
 
 @dataclass(frozen=True)
+class PodDisruptionBudget:
+    """The slice of policy/v1 PodDisruptionBudget preemption consumes
+    (framework/plugins/defaultpreemption/default_preemption.go:406
+    filterPodsWithPDBViolation): namespace-scoped label selector,
+    ``status.disruptionsAllowed``, and ``status.disruptedPods`` (victims
+    already processed by the API server don't double-count)."""
+
+    name: str
+    namespace: str = "default"
+    selector: LabelSelector | None = None
+    disruptions_allowed: int = 0
+    disrupted_pods: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
 class ImageState:
     """Summary of one image on a node (fwk.ImageStateSummary)."""
 

@@ -57,6 +57,7 @@ def make_pod(
     gates: Sequence[str] = (),
     images: Sequence[str] = (),
     creation_index: int = 0,
+    preemption_policy: str = "PreemptLowerPriority",
 ) -> t.Pod:
     nonzero = None
     if containers is not None:
@@ -91,6 +92,7 @@ def make_pod(
         scheduling_gates=tuple(gates),
         images=tuple(images),
         creation_index=creation_index,
+        preemption_policy=preemption_policy,
     )
 
 

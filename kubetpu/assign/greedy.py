@@ -53,6 +53,9 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         pod_ports=b.pod_ports[i][None],
         node_ports=b.node_ports,
         port_conflict=b.port_conflict,
+        nominated_node=b.nominated_node,
+        nominated_req=b.nominated_req,
+        nominated_gate=row(b.nominated_gate),
         spread=_spread_view(b.spread, i),
         podaffinity=_pa_view(b.podaffinity, i),
     )

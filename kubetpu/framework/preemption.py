@@ -1,0 +1,193 @@
+"""Preemption evaluator — the host orchestration around the victim-search
+kernel.
+
+Analog of ``pkg/scheduler/framework/preemption/preemption.go`` Evaluator
+(:65, Preempt :103) + the DefaultPreemption plugin's policy pieces
+(defaultpreemption/default_preemption.go): eligibility (:364
+PodEligibleToPreemptOthers), candidate discovery, victim selection, node
+choice, and the sequencing of several preemptors in one batch.
+
+Differences from the reference, by design:
+- the dry run is exhaustive over ALL resolvable-failure nodes in one device
+  program (the reference samples ``calculateNumCandidates`` nodes from a
+  random offset, default_preemption.go:219 — a CPU-cost concession the
+  vmapped kernel doesn't need);
+- several preemptors in one batch run back-to-back against a host-updated
+  victim state (the reference reaches the same serialization through one
+  scheduling cycle per pod), so two preemptors never claim the same victim.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..api import types as t
+from ..ops import preemption as OP
+from ..state.preemption import VictimTensors, encode_victims
+from . import runtime as rt
+
+
+@dataclass
+class PreemptionResult:
+    """Mirror of PostFilterResult + Status (preemption.go:87 contract)."""
+
+    status: str                       # "success" | "unschedulable" | "not_eligible"
+    node_name: str | None = None      # nominatedNodeName on success
+    victim_uids: list[str] = field(default_factory=list)
+    victim_pods: list[t.Pod] = field(default_factory=list)
+    num_pdb_violations: int = 0
+    message: str = ""
+
+
+class PreemptionEvaluator:
+    """Per-batch evaluator. Build once after a failed assignment pass; call
+    ``preempt(pod_index)`` for each unschedulable pod, in queue order."""
+
+    def __init__(
+        self,
+        batch: rt.EncodedBatch,
+        params: rt.ScoreParams,
+        pdbs: tuple[t.PodDisruptionBudget, ...] = (),
+        requested: np.ndarray | None = None,
+        pod_count: np.ndarray | None = None,
+        node_ports_counts: np.ndarray | None = None,
+        spread_counts=None,
+        pa_sums=None,
+    ):
+        if batch.node_tensors is None:
+            raise ValueError("batch was encoded without node_tensors")
+        self.batch = batch
+        self.params = params
+        nt = batch.node_tensors
+        kp = int(batch.device.port_conflict.shape[0])
+        self.victims: VictimTensors = encode_victims(
+            nt, kp, batch.port_vocab, pdbs=pdbs
+        )
+        # Mutable node usage state (post-assignment view if provided). The
+        # victim tensors describe only pods present in the SNAPSHOT; pods the
+        # current batch just assumed are part of `requested` but are not
+        # preemptable this cycle (their bind is in flight) — same window the
+        # reference has between assume and the next informer update.
+        self.requested = np.array(
+            requested if requested is not None else np.asarray(batch.device.requested)
+        )
+        self.pod_count = np.array(
+            pod_count if pod_count is not None else np.asarray(batch.device.pod_count)
+        )
+        self.port_counts = np.array(
+            node_ports_counts
+            if node_ports_counts is not None
+            else self.victims.port_counts
+        )
+        self.pdb_allowed = self.victims.pdb_allowed.copy()
+        # Post-batch spread/affinity state (the greedy scan's final carry):
+        # the potential mask must see the batch's OWN assignments, or a node
+        # the batch just tipped past max_skew could be nominated.
+        self.spread_counts = spread_counts
+        self.pa_sums = pa_sums
+
+    def _potential_mask(self, i: int) -> jnp.ndarray:
+        """(N,) — nodes whose failure is the resolvable kind: all
+        victim-independent filters pass, fit/ports fail (preemption.go:180
+        NodesForStatusCode(Unschedulable))."""
+        b = self.batch.device
+        view = _one_pod_view(b, i)
+        static, fit, ports_ok, spread_ok, pa_ok, _, _ = rt.filter_components(
+            view, self.params,
+            requested=jnp.asarray(self.requested),
+            pod_count=jnp.asarray(self.pod_count),
+            node_ports=jnp.asarray(self.port_counts > 0),
+            spread_counts=self.spread_counts,
+            pa_sums=self.pa_sums,
+        )
+        ok_independent = static[0]
+        for part in (spread_ok, pa_ok):
+            if part is not None:
+                ok_independent = ok_independent & part[0]
+        failed_dep = jnp.zeros_like(ok_independent)
+        for part in (fit, ports_ok):
+            if part is not None:
+                failed_dep = failed_dep | ~part[0]
+        return ok_independent & failed_dep
+
+    def preempt(self, i: int) -> PreemptionResult:
+        """Run preemption for pending pod ``i`` of the batch."""
+        pod = self.batch.pods[i]
+        # PodEligibleToPreemptOthers (default_preemption.go:364): policy gate.
+        # (Terminating-victims-on-nominated-node check needs pod deletion
+        # timestamps — not modeled yet; informer-level requeue covers it.)
+        if pod.preemption_policy == "Never":
+            return PreemptionResult(
+                "not_eligible", message="not eligible due to preemptionPolicy=Never."
+            )
+
+        b = self.batch.device
+        v = self.victims
+        wants_conf = (
+            jnp.einsum(
+                "k,kl->l",
+                b.pod_ports[i].astype(jnp.int32),
+                b.port_conflict.astype(jnp.int32),
+            ) > 0
+        )
+        node_idx, victims = OP.dry_run_preemption(
+            b.requests[i],
+            jnp.asarray(np.int64(pod.priority)),
+            wants_conf,
+            self._potential_mask(i),
+            b.alloc,
+            jnp.asarray(self.requested),
+            jnp.asarray(self.pod_count),
+            b.allowed_pods,
+            jnp.asarray(self.port_counts),
+            jnp.asarray(v.valid),
+            jnp.asarray(v.priority),
+            jnp.asarray(v.start),
+            jnp.asarray(v.requests),
+            jnp.asarray(v.victim_ports),
+            jnp.asarray(v.pdb),
+            jnp.asarray(self.pdb_allowed),
+        )
+        n = int(jax.device_get(node_idx))
+        if n < 0:
+            return PreemptionResult(
+                "unschedulable",
+                message="preemption: 0/%d nodes are available" % self.batch.num_nodes,
+            )
+        vrow = np.asarray(jax.device_get(victims[n]))
+        uids = [
+            v.uids[n][k] for k in np.flatnonzero(vrow) if v.uids[n][k] is not None
+        ]
+        info = self.batch.node_tensors.infos[n]
+        pods = [info.pods[u] for u in uids if u in info.pods]
+        self._apply(n, vrow)
+        return PreemptionResult(
+            "success",
+            node_name=self.batch.node_names[n],
+            victim_uids=uids,
+            victim_pods=pods,
+        )
+
+    def _apply(self, n: int, victim_row: np.ndarray) -> None:
+        """Commit one preemption to the host state so the NEXT preemptor in
+        this batch sees the victims gone (and the PDB budget spent)."""
+        v = self.victims
+        ks = np.flatnonzero(victim_row)
+        for k in ks:
+            self.requested[n] -= v.requests[n, k]
+            self.pod_count[n] -= 1
+            self.port_counts[n] -= v.victim_ports[n, k]
+            self.pdb_allowed -= v.pdb[n, k].astype(np.int64)
+            v.valid[n, k] = False
+
+
+def _one_pod_view(b: rt.DeviceBatch, i: int) -> rt.DeviceBatch:
+    """P=1 view of pod ``i`` (concrete index) — like assign.greedy._pod_view
+    but for a host-chosen pod, so filter_components sees (1, N) shapes."""
+    from ..assign.greedy import _pod_view
+
+    return _pod_view(b, i)

@@ -68,6 +68,10 @@ class DeviceBatch:
     pod_ports: jnp.ndarray          # (P, K) bool
     node_ports: jnp.ndarray         # (N, K) bool
     port_conflict: jnp.ndarray      # (K, K) bool
+    # Nominator reservations (queue/nominator.py) — None when no nominations
+    nominated_node: jnp.ndarray | None = None  # (G,) int32 node idx (-1 none)
+    nominated_req: jnp.ndarray | None = None   # (G, R) int64
+    nominated_gate: jnp.ndarray | None = None  # (P, G) bool
     # PodTopologySpread (None when no pod has constraints)
     spread: "SpreadDevice | None" = None
     # InterPodAffinity (None when no pod carries (anti)affinity)
@@ -126,6 +130,9 @@ class EncodedBatch:
     resource_names: list[str]
     num_nodes: int                  # real (unpadded) N
     num_pods: int                   # real (unpadded) P
+    # host-side references preemption/extender paths reuse (not device data)
+    node_tensors: "enc.NodeTensors | None" = None
+    port_vocab: object | None = None
 
 
 def _resource_weights(
@@ -192,6 +199,7 @@ def encode_batch(
     profile: C.Profile | None = None,
     pad: bool = True,
     resource_names: Sequence[str] | None = None,
+    nominated: Sequence = (),
 ) -> EncodedBatch:
     """Snapshot + pending pods → padded device batch.
 
@@ -280,6 +288,26 @@ def encode_batch(
     pod_valid = np.zeros(PP, dtype=bool)
     pod_valid[:P] = True
 
+    # Nominator reservations (queue/nominator.py): the gate row for pod p
+    # enables nomination g iff g's priority >= p's and g is not p itself
+    # (framework/runtime's RunFilterPluginsWithNominatedPods rule).
+    nom_node = nom_req = nom_gate = None
+    if nominated:
+        name_to_idx = {n: j for j, n in enumerate(nt.node_names)}
+        G = len(nominated)
+        nom_node = np.full(G, -1, dtype=np.int32)
+        nom_req = np.zeros((G, len(nt.resource_names)), dtype=np.int64)
+        nom_gate = np.zeros((PP, G), dtype=bool)
+        ridx = {r: j for j, r in enumerate(nt.resource_names)}
+        for g, e in enumerate(nominated):
+            nom_node[g] = name_to_idx.get(e.node_name, -1)
+            for k, val in e.requests:
+                j = ridx.get(k)
+                if j is not None:
+                    nom_req[g, j] = val
+            for i, p_ in enumerate(pods):
+                nom_gate[i, g] = e.priority >= p_.priority and e.uid != p_.uid
+
     dev = DeviceBatch(
         alloc=jnp.asarray(nt.alloc),
         requested=jnp.asarray(nt.requested),
@@ -306,6 +334,9 @@ def encode_batch(
         pod_ports=jnp.asarray(pb.pod_ports),
         node_ports=jnp.asarray(pb.node_ports),
         port_conflict=jnp.asarray(pb.port_conflict),
+        nominated_node=jnp.asarray(nom_node) if nom_node is not None else None,
+        nominated_req=jnp.asarray(nom_req) if nom_req is not None else None,
+        nominated_gate=jnp.asarray(nom_gate) if nom_gate is not None else None,
         spread=spread_dev,
         podaffinity=pa_dev,
     )
@@ -316,6 +347,8 @@ def encode_batch(
         resource_names=nt.resource_names,
         num_nodes=N,
         num_pods=P,
+        node_tensors=nt,
+        port_vocab=pb.port_vocab,
     )
 
 
@@ -376,6 +409,80 @@ def masked_normalize(raw: jnp.ndarray, mask: jnp.ndarray, reverse: bool = False)
     return S.default_normalize(masked, reverse=reverse)
 
 
+def filter_components(
+    b: DeviceBatch,
+    p: ScoreParams,
+    requested: jnp.ndarray | None = None,
+    pod_count: jnp.ndarray | None = None,
+    node_ports: jnp.ndarray | None = None,
+    spread_counts: jnp.ndarray | None = None,
+    pa_sums: jnp.ndarray | None = None,
+):
+    """Per-plugin Filter masks, un-ANDed — the split preemption needs:
+    failures of ``static`` / ``spread_ok`` / ``pa_ok`` are
+    UnschedulableAndUnresolvable for the victim-search (removing pods can't
+    fix node labels; spread/affinity removal effects are conservatively out
+    of kernel scope, ops/preemption.py docstring), while ``fit``/``ports_ok``
+    failures are the resolvable kind (preemption.go:180 NodesForStatusCode).
+
+    Returns ``(static, fit, ports_ok, spread_ok, pa_ok, sp_counts,
+    pa_state)``; mask entries are None when the plugin is disabled or has no
+    work.
+    """
+    req = b.requested if requested is None else requested
+    pc = b.pod_count if pod_count is None else pod_count
+    ports = b.node_ports if node_ports is None else node_ports
+
+    static = b.node_valid[None, :] & b.pod_valid[:, None]
+    if b.static_mask is not None:
+        static = static & b.static_mask
+    fit = None
+    if p.filter_fit:
+        if b.nominated_node is not None:
+            fit = F.resource_fit_mask_nominated(
+                b.requests, b.alloc, req, pc, b.allowed_pods,
+                b.nominated_gate, b.nominated_node, b.nominated_req,
+            )
+        else:
+            fit = F.resource_fit_mask(
+                b.requests, b.alloc, req, pc, b.allowed_pods
+            )
+    ports_ok = None
+    if p.filter_ports:
+        # conflict[p, n] = any pod triple k conflicting with in-use triple l
+        wants_conf = jnp.einsum(
+            "pk,kl->pl", b.pod_ports.astype(jnp.int32),
+            b.port_conflict.astype(jnp.int32),
+        )                                                     # (P, K)
+        conflict = jnp.einsum(
+            "pl,nl->pn", wants_conf, ports.astype(jnp.int32)
+        ) > 0                                                 # (P, N)
+        ports_ok = ~conflict
+    sp = b.spread
+    sp_counts = None
+    spread_ok = None
+    if sp is not None:
+        sp_counts = sp.node_count if spread_counts is None else spread_counts
+        if p.filter_spread and sp.has_hard:
+            spread_ok = jax.vmap(
+                lambda si, ac, ms, md, sm: SP.spread_filter_pod(
+                    sp, sp_counts, si, ac, ms, md, sm
+                )
+            )(sp.sig_idx, sp.action, sp.max_skew, sp.min_domains, sp.self_match)
+    pa = b.podaffinity
+    pa_state = None
+    pa_ok = None
+    if pa is not None:
+        pa_state = pa.base_sums if pa_sums is None else pa_sums
+        if p.filter_interpod and pa.has_filter_work:
+            pa_ok = jax.vmap(
+                lambda fr, fs, rr, er: PA.affinity_filter_pod(
+                    pa, pa_state, fr, fs, rr, er
+                )
+            )(pa.fa_rows, pa.fa_self, pa.ra_rows, pa.ea_rows)
+    return static, fit, ports_ok, spread_ok, pa_ok, sp_counts, pa_state
+
+
 def feasible_and_scores(
     b: DeviceBatch,
     p: ScoreParams,
@@ -396,53 +503,25 @@ def feasible_and_scores(
     """
     req = b.requested if requested is None else requested
     nz = b.nonzero_requested if nonzero_requested is None else nonzero_requested
-    pc = b.pod_count if pod_count is None else pod_count
-    ports = b.node_ports if node_ports is None else node_ports
 
     w_fit = jnp.asarray(p.fit_weights, dtype=jnp.int64)
     w_bal = jnp.asarray(p.balanced_weights, dtype=jnp.int64)
     scal = jnp.asarray(p.is_scalar, dtype=bool)
 
     # --- Filter ----------------------------------------------------------
-    mask = b.node_valid[None, :] & b.pod_valid[:, None]
-    if b.static_mask is not None:
-        mask = mask & b.static_mask
-    if p.filter_fit:
-        mask = mask & F.resource_fit_mask(
-            b.requests, b.alloc, req, pc, b.allowed_pods
+    static, fit, ports_ok, spread_ok, pa_ok, sp_counts, pa_state = (
+        filter_components(
+            b, p, requested=requested, pod_count=pod_count,
+            node_ports=node_ports, spread_counts=spread_counts,
+            pa_sums=pa_sums,
         )
-    if p.filter_ports:
-        # conflict[p, n] = any pod triple k conflicting with in-use triple l
-        wants_conf = jnp.einsum(
-            "pk,kl->pl", b.pod_ports.astype(jnp.int32),
-            b.port_conflict.astype(jnp.int32),
-        )                                                     # (P, K)
-        conflict = jnp.einsum(
-            "pl,nl->pn", wants_conf, ports.astype(jnp.int32)
-        ) > 0                                                 # (P, N)
-        mask = mask & ~conflict
+    )
+    mask = static
+    for part in (fit, ports_ok, spread_ok, pa_ok):
+        if part is not None:
+            mask = mask & part
     sp = b.spread
-    sp_counts = None
-    if sp is not None:
-        sp_counts = sp.node_count if spread_counts is None else spread_counts
-        if p.filter_spread and sp.has_hard:
-            spread_ok = jax.vmap(
-                lambda si, ac, ms, md, sm: SP.spread_filter_pod(
-                    sp, sp_counts, si, ac, ms, md, sm
-                )
-            )(sp.sig_idx, sp.action, sp.max_skew, sp.min_domains, sp.self_match)
-            mask = mask & spread_ok
     pa = b.podaffinity
-    pa_state = None
-    if pa is not None:
-        pa_state = pa.base_sums if pa_sums is None else pa_sums
-        if p.filter_interpod and pa.has_filter_work:
-            pa_ok = jax.vmap(
-                lambda fr, fs, rr, er: PA.affinity_filter_pod(
-                    pa, pa_state, fr, fs, rr, er
-                )
-            )(pa.fa_rows, pa.fa_self, pa.ra_rows, pa.ea_rows)
-            mask = mask & pa_ok
 
     # --- Score -----------------------------------------------------------
     total = jnp.zeros(mask.shape, dtype=jnp.int64)

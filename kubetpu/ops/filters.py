@@ -40,6 +40,36 @@ def resource_fit_mask(
     return mask & room[None, :]
 
 
+def resource_fit_mask_nominated(
+    pod_requests: jnp.ndarray,    # (P, R) int64
+    alloc: jnp.ndarray,           # (N, R)
+    requested: jnp.ndarray,       # (N, R)
+    pod_count: jnp.ndarray,       # (N,)
+    allowed_pods: jnp.ndarray,    # (N,)
+    gate: jnp.ndarray,            # (P, G) bool — nomination applies to pod p
+    g_node: jnp.ndarray,          # (G,) int32 nominated node index (-1 none)
+    g_req: jnp.ndarray,           # (G, R) int64 nominated pod requests
+) -> jnp.ndarray:
+    """NodeResourcesFit with nominator reservations
+    (RunFilterPluginsWithNominatedPods' fit dimension): pod p additionally
+    sees ``Σ_g gate[p,g]·requests[g]`` charged to g's nominated node. The
+    (P,N,R) intermediate is never materialized — one (P,N) plane per
+    resource (R is a small static constant)."""
+    n = alloc.shape[0]
+    onehot = (g_node[:, None] == jnp.arange(n, dtype=g_node.dtype))  # (G, N)
+    gate64 = gate.astype(jnp.int64)
+    extra_cnt = jnp.einsum("pg,gn->pn", gate.astype(jnp.int32),
+                           onehot.astype(jnp.int32))
+    mask = (pod_count[None, :] + 1 + extra_cnt) <= allowed_pods[None, :]
+    free = alloc - requested                                         # (N, R)
+    for r in range(alloc.shape[1]):
+        plane = (onehot * g_req[:, r][:, None]).astype(jnp.int64)    # (G, N)
+        extra_r = jnp.einsum("pg,gn->pn", gate64, plane)
+        req_r = pod_requests[:, r][:, None]                          # (P, 1)
+        mask = mask & ((req_r == 0) | (req_r <= free[None, :, r] - extra_r))
+    return mask
+
+
 def resource_fit_mask_single(
     pod_request: jnp.ndarray,     # (R,) int64
     alloc: jnp.ndarray,           # (N, R)

@@ -1,0 +1,195 @@
+"""Preemption victim-search kernels.
+
+TPU re-expression of the reference's dry-run preemption
+(pkg/scheduler/framework/preemption/preemption.go:404 DryRunPreemption +
+pkg/scheduler/framework/plugins/defaultpreemption/default_preemption.go:252
+SelectVictimsOnNode): instead of sampling a random candidate subset and
+simulating nodes one goroutine at a time, every node's victim selection runs
+as one vmapped program — exhaustive over all candidate nodes, which can only
+improve on the reference's sampled search (same per-node semantics, strictly
+larger candidate pool).
+
+Per-node semantics mirrored exactly:
+
+1. potential victims = pods with priority < preemptor's
+   (default_preemption.go:396 isPreemptionAllowed)
+2. preemptor must fit with ALL of them removed (:302) — fit here covers the
+   victim-*dependent* filters (NodeResourcesFit, NodePorts, pod count);
+   victim-independent filters are the caller-supplied ``potential`` mask
+3. PDB violation marking walks victims in MoreImportantPod order
+   (:315 filterPodsWithPDBViolation; util.MoreImportantPod = higher
+   priority first, earlier start time breaks ties)
+4. reprieve: violating victims first, then non-violating, each in importance
+   order; a victim is reprieved iff the preemptor still fits with it back
+   (:316-343)
+5. node choice = pickOneNodeForPreemption's lexicographic refinement
+   (preemption.go:311): fewest PDB violations → lowest highest-victim
+   priority → lowest summed priority (+2^31 per victim) → fewest victims →
+   latest earliest-start-time among highest-priority victims → first node.
+
+Scope note (documented divergence): like the reference — which refuses to
+resolve inter-pod-affinity-to-victims for performance (:297-301) — the
+in-kernel re-check covers resources/count/ports. Nodes whose failure
+involved hard spread/inter-pod-affinity are conservatively excluded by the
+caller's ``potential`` mask (never nominates an invalid node; may miss
+nodes that victim removal would have fixed).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+I64_MIN = jnp.int64(-(2**62))
+I64_MAX = jnp.int64(2**62)
+PRIO_OFFSET = jnp.int64(2**31)  # preemption.go:339 MaxInt32+1 shift
+
+
+def _fits(pod_req, alloc, req_state, count_state, allowed, wants_conf, port_counts):
+    """Does the preemptor fit this node state? NodeResourcesFit semantics
+    (req==0 passes; fit.go fitsRequest) + pod count + NodePorts conflict
+    against live port-usage counts."""
+    ok_r = jnp.all((pod_req == 0) | (pod_req <= alloc - req_state))
+    ok_c = (count_state + 1) <= allowed
+    ok_p = ~jnp.any(wants_conf & (port_counts > 0))
+    return ok_r & ok_c & ok_p
+
+
+def select_victims_node(
+    pod_req,        # (R,) int64 — preemptor exact requests
+    pod_prio,       # () int64
+    wants_conf,     # (Kp,) bool — preemptor port triples × conflict matrix
+    alloc,          # (R,) int64
+    requested,      # (R,) int64
+    pod_count,      # () int32
+    allowed,        # () int32
+    v_valid,        # (K,) bool
+    v_prio,         # (K,) int64
+    v_start,        # (K,) int64
+    v_req,          # (K, R) int64
+    v_ports,        # (K, Kp) int8
+    v_pdb,          # (K, D) bool
+    port_counts,    # (Kp,) int32
+    pdb_allowed,    # (D,) int64
+):
+    """One node's SelectVictimsOnNode. Returns
+    ``(ok, victims (K,) bool, n_pdb_viol, max_prio, sum_prio, n_victims,
+    earliest_start)`` — stats feed pick_node. vmap over the node axis."""
+    K = v_valid.shape[0]
+    eligible = v_valid & (v_prio < pod_prio)
+    has_eligible = jnp.any(eligible)
+    e64 = eligible.astype(jnp.int64)
+
+    # state with every eligible victim removed
+    base_req = requested - jnp.sum(e64[:, None] * v_req, axis=0)
+    base_count = pod_count - jnp.sum(eligible)
+    base_ports = port_counts - jnp.sum(
+        e64[:, None] * v_ports.astype(jnp.int64), axis=0
+    ).astype(port_counts.dtype)
+    fits_base = _fits(
+        pod_req, alloc, base_req, base_count, allowed, wants_conf, base_ports
+    )
+
+    # importance order: priority desc, start asc; ineligible slots last
+    imp_key = jnp.where(eligible, -v_prio, I64_MAX)
+    slot = jnp.arange(K, dtype=jnp.int32)
+    _, _, by_importance = jax.lax.sort(
+        (imp_key, v_start, slot), num_keys=2
+    )
+
+    # PDB violation flags, walking importance order
+    def pdb_step(allowed_d, k):
+        matched = v_pdb[k] & eligible[k]
+        allowed_d = allowed_d - matched.astype(jnp.int64)
+        violating = jnp.any(matched & (allowed_d < 0))
+        return allowed_d, (k, violating)
+
+    _, (order_k, order_viol) = jax.lax.scan(pdb_step, pdb_allowed, by_importance)
+    violating = jnp.zeros(K, dtype=bool).at[order_k].set(order_viol)
+
+    # reprieve order: violating group first, then importance within group
+    grp_key = jnp.where(violating, jnp.int64(0), jnp.int64(1))
+    grp_key = jnp.where(eligible, grp_key, jnp.int64(2))
+    _, _, _, reprieve_order = jax.lax.sort(
+        (grp_key, imp_key, v_start, slot), num_keys=3
+    )
+
+    def reprieve_step(carry, k):
+        req_s, cnt_s, ports_s, victims, n_viol = carry
+        try_req = req_s + v_req[k]
+        try_cnt = cnt_s + 1
+        try_ports = ports_s + v_ports[k].astype(ports_s.dtype)
+        fits = _fits(
+            pod_req, alloc, try_req, try_cnt, allowed, wants_conf, try_ports
+        )
+        take = eligible[k] & fits          # reprieved: stays on the node
+        req_s = jnp.where(take, try_req, req_s)
+        cnt_s = jnp.where(take, try_cnt, cnt_s)
+        ports_s = jnp.where(take, try_ports, ports_s)
+        is_victim = eligible[k] & ~fits
+        victims = victims.at[k].set(is_victim)
+        n_viol = n_viol + (is_victim & violating[k]).astype(jnp.int64)
+        return (req_s, cnt_s, ports_s, victims, n_viol), None
+
+    init = (
+        base_req, base_count, base_ports,
+        jnp.zeros(K, dtype=bool), jnp.int64(0),
+    )
+    (_, _, _, victims, n_pdb_viol), _ = jax.lax.scan(
+        reprieve_step, init, reprieve_order
+    )
+
+    n_victims = jnp.sum(victims).astype(jnp.int64)
+    ok = has_eligible & fits_base & (n_victims > 0)
+    max_prio = jnp.max(jnp.where(victims, v_prio, I64_MIN))
+    sum_prio = jnp.sum(jnp.where(victims, v_prio + PRIO_OFFSET, 0))
+    highest = victims & (v_prio == max_prio)
+    earliest_start = jnp.min(jnp.where(highest, v_start, I64_MAX))
+    return ok, victims, n_pdb_viol, max_prio, sum_prio, n_victims, earliest_start
+
+
+def pick_node(ok, n_pdb_viol, max_prio, sum_prio, n_victims, earliest_start):
+    """pickOneNodeForPreemption (preemption.go:311): iterative lexicographic
+    refinement over score functions, first node breaking any remaining tie.
+    Returns chosen node index (int32) or -1 when no candidate."""
+    any_ok = jnp.any(ok)
+    cands = ok
+    # maximize each score in turn, keeping only argmax ties
+    for score in (
+        -n_pdb_viol,            # fewest PDB violations
+        -max_prio,              # lowest highest-victim priority
+        -sum_prio,              # lowest summed (shifted) priorities
+        -n_victims,             # fewest victims
+        earliest_start,         # latest earliest-start of highest-prio victims
+    ):
+        best = jnp.max(jnp.where(cands, score, I64_MIN))
+        cands = cands & (score == best)
+    idx = jnp.argmax(cands).astype(jnp.int32)   # first remaining candidate
+    return jnp.where(any_ok, idx, jnp.int32(-1))
+
+
+@partial(jax.jit, donate_argnums=())
+def dry_run_preemption(
+    pod_req, pod_prio, wants_conf, potential,
+    alloc, requested, pod_count, allowed, port_counts,
+    v_valid, v_prio, v_start, v_req, v_ports, v_pdb, pdb_allowed,
+):
+    """All nodes at once: vmapped SelectVictimsOnNode gated by the caller's
+    ``potential`` (N,) mask (nodes whose failure preemption could resolve —
+    preemption.go:180 NodesForStatusCode(Unschedulable)), then pick_node.
+
+    Returns ``(node_idx, victims (N, K) bool)`` — victims row of the chosen
+    node is the preemption plan; host maps slots back to pod uids.
+    """
+    ok, victims, n_pdb, max_p, sum_p, n_v, early = jax.vmap(
+        lambda a, r, c, al, vv, vp, vs, vr, vpo, vpd, pc: select_victims_node(
+            pod_req, pod_prio, wants_conf,
+            a, r, c, al, vv, vp, vs, vr, vpo, vpd, pc, pdb_allowed,
+        )
+    )(alloc, requested, pod_count, allowed,
+      v_valid, v_prio, v_start, v_req, v_ports, v_pdb, port_counts)
+    ok = ok & potential
+    node_idx = pick_node(ok, n_pdb, max_p, sum_p, n_v, early)
+    return node_idx, victims

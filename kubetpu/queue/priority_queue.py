@@ -66,6 +66,9 @@ class QueuedPodInfo:
     gated: bool = False
     entity_size: int = 1              # >1 for pod groups (gang entities)
     events_seq: int = 0               # event sequence number when popped
+    # preemption nominated this node; victims are terminating (the
+    # reference's pod.Status.NominatedNodeName + nominator view)
+    nominated_node_name: str | None = None
 
     @property
     def key(self) -> str:

@@ -87,6 +87,54 @@ class StatusPatchCall:
             older.on_done(CallSkipped())
 
 
+@dataclass
+class DeleteVictimCall:
+    """DELETE a preemption victim (preemption Executor's
+    ``actuatePodPreemption`` — framework/preemption/executor.go issues the
+    victim deletions, optionally clearing competing nominations first)."""
+
+    pod: t.Pod
+    preemptor_key: str = ""
+    on_done: Callable[[Exception | None], None] | None = None
+    call_type: str = field(default="delete_victim", init=False)
+
+    @property
+    def object_key(self) -> str:
+        return f"{self.pod.namespace}/{self.pod.name}"
+
+    def execute(self, client: Any) -> None:
+        client.delete_pod(self.pod, reason="preempted by " + self.preemptor_key)
+
+    def merge(self, older: "DeleteVictimCall") -> None:
+        if older.on_done is not None:
+            older.on_done(CallSkipped())
+
+
+@dataclass
+class NominateCall:
+    """PATCH the preemptor's status.nominatedNodeName. Distinct call_type
+    from StatusPatchCall: the dispatcher merges by (call_type, object_key)
+    and each call executes only its own write, so sharing the type would let
+    a later condition patch silently cancel a pending nomination (the
+    reference's pod_status_patch instead merges both fields into one patch)."""
+
+    pod: t.Pod
+    node_name: str
+    on_done: Callable[[Exception | None], None] | None = None
+    call_type: str = field(default="nominate", init=False)
+
+    @property
+    def object_key(self) -> str:
+        return f"{self.pod.namespace}/{self.pod.name}"
+
+    def execute(self, client: Any) -> None:
+        client.nominate(self.pod, self.node_name)
+
+    def merge(self, older: "NominateCall") -> None:
+        if older.on_done is not None:
+            older.on_done(CallSkipped())
+
+
 _CLOSE = object()
 
 

@@ -58,6 +58,8 @@ class SchedulerMetrics:
     errors: int = 0                     # result "error"
     bind_errors: int = 0
     cycles: int = 0
+    preemption_attempts: int = 0        # preemption_attempts_total
+    preemption_victims: int = 0         # preemption_victims histogram feed
     scheduling_seconds: float = 0.0     # scheduling_algorithm_duration sum
     # bounded reservoir of recent e2e attempt latencies (p99 estimation);
     # the metrics registry keeps the full histogram
@@ -103,6 +105,36 @@ class Scheduler:
         self._bind_completions: collections.deque = collections.deque()
         self._post_filter: Callable[..., Any] | None = None  # set by preemption
         self._last_flush = 0.0
+        self.pdbs: dict[str, t.PodDisruptionBudget] = {}  # "ns/name" -> PDB
+        # per-cycle context the PostFilter consumes: (batch, params,
+        # final_state, key->batch-index). None outside a cycle.
+        self._cycle_ctx: tuple | None = None
+        # preemptor key -> victim uids awaiting their informer delete; while
+        # any victim is still in the cache the pod is not eligible to
+        # preempt again (PodEligibleToPreemptOthers' terminating-victims
+        # check, default_preemption.go:364)
+        self._preempting: dict[str, set[str]] = {}
+        # nominated pods' reservations, fed into the fit filter so lower-
+        # priority pods can't steal the room the victims freed
+        from ..queue.nominator import Nominator
+
+        self.nominator = Nominator()
+
+    def enable_preemption(self) -> None:
+        """Wire the DefaultPreemption PostFilter
+        (plugins/defaultpreemption/default_preemption.go:136)."""
+        from .preemption import DefaultPreemptionPostFilter
+
+        self._post_filter = DefaultPreemptionPostFilter()
+
+    # ------------------------------------------------------- PDB informers
+    def on_pdb_add(self, pdb: t.PodDisruptionBudget) -> None:
+        self.pdbs[f"{pdb.namespace}/{pdb.name}"] = pdb
+
+    on_pdb_update = on_pdb_add
+
+    def on_pdb_delete(self, pdb: t.PodDisruptionBudget) -> None:
+        self.pdbs.pop(f"{pdb.namespace}/{pdb.name}", None)
 
     # ------------------------------------------------------ event handlers
     # The informer seam (eventhandlers.go:455): assigned pods maintain the
@@ -171,6 +203,7 @@ class Scheduler:
             self.queue.update(old, new)
 
     def on_pod_delete(self, pod: t.Pod) -> None:
+        self.nominator.remove(pod.uid)
         if pod.node_name or self.cache.is_assumed(pod.uid):
             self.cache.remove_pod(pod)
             # an assumed pod also lives in the queue's in-flight set until
@@ -201,10 +234,17 @@ class Scheduler:
         try:
             self._snapshot = self.cache.update_snapshot(self._snapshot)
             pods = [info.pod for info in batch_infos]
-            batch = rt.encode_batch(self._snapshot, pods, self.profile)
+            batch = rt.encode_batch(
+                self._snapshot, pods, self.profile,
+                nominated=self.nominator.entries(),
+            )
             params = rt.score_params(self.profile, batch.resource_names)
-            assignments, _ = greedy_assign_device(batch.device, params)
+            assignments, final_state = greedy_assign_device(batch.device, params)
             idx = np.asarray(jax.device_get(assignments))
+            self._cycle_ctx = (
+                batch, params, final_state,
+                {info.key: k for k, info in enumerate(batch_infos)},
+            )
         except Exception:
             # a cycle-level failure must not strand the popped batch in the
             # in-flight set: requeue everything as error status (the
@@ -228,14 +268,26 @@ class Scheduler:
         self.metrics.unschedulable += len(failed)
         self.metrics.scheduling_seconds += self.clock() - t0
 
-        for info in failed:
-            self._handle_unschedulable(info)
+        try:
+            for info in failed:
+                self._handle_unschedulable(info)
+        finally:
+            # drop the cycle's batch (device tensors + host snapshot
+            # encoding) so it doesn't pin memory across cycles
+            self._cycle_ctx = None
+            if self._post_filter is not None:
+                reset = getattr(self._post_filter, "reset", None)
+                if reset is not None:
+                    reset()
         return {"scheduled": scheduled, "unschedulable": len(failed)}
 
     def _assume_and_bind(self, info: QueuedPodInfo, node_name: str) -> None:
         """assumeAndReserve + async binding cycle (schedule_one.go:307,:391)."""
         assumed = info.pod.with_node(node_name)
         self.cache.assume_pod(assumed)
+        # a scheduled pod's nomination (if any) is spent
+        self.nominator.remove(info.pod.uid)
+        self._preempting.pop(info.key, None)
         # the pod stays in flight through the binding cycle — queue.done only
         # after the bind lands, so events during binding replay on failure
         if info.initial_attempt_timestamp is not None:
@@ -282,8 +334,9 @@ class Scheduler:
             if nominated is not None:
                 # preemption nominated a node: victims' deletes will fire
                 # hints; pod waits in backoff for the room to open
+                info.nominated_node_name = nominated
                 self.queue.add_unschedulable(
-                    info, [N.DEFAULT_PREEMPTION]
+                    info, self.profile.filters.names()
                 )
                 return
         where = self.queue.add_unschedulable(

@@ -282,6 +282,7 @@ class PodBatch:
     pod_ports: np.ndarray           # (P, K) bool — triples the pod wants
     node_ports: np.ndarray          # (N, K) bool — triples in use on the node
     port_conflict: np.ndarray       # (K, K) bool
+    port_vocab: Vocab | None = None  # triple→id table (shared w/ preemption)
 
     @property
     def num_pods(self) -> int:
@@ -299,10 +300,10 @@ def _pod_port_triples(pod: t.Pod) -> list[tuple[int, str, str]]:
 def _encode_ports(
     nt: NodeTensors, pods: Sequence[t.Pod],
     pad_pods: int | None = None, pad_nodes: int | None = None,
-) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray, Vocab]:
     """Intern port triples → (pod_ports (P,K), node_ports (N,K),
-    port_conflict (K,K)). K is at least 1 (all-False dummy) so downstream
-    einsums never see a zero axis."""
+    port_conflict (K,K), vocab). K is at least 1 (all-False dummy) so
+    downstream einsums never see a zero axis."""
     vocab = Vocab()
     P, N = len(pods), nt.num_nodes
     pod_rows: list[list[int]] = []
@@ -331,7 +332,7 @@ def _encode_ports(
                 ia == "0.0.0.0" or ib == "0.0.0.0" or ia == ib
             ):
                 conflict[ka, kb] = True
-    return pod_ports, node_ports, conflict
+    return pod_ports, node_ports, conflict, vocab
 
 
 def encode_pod_batch(
@@ -493,7 +494,7 @@ def encode_pod_batch(
             if want_tt:
                 tt_raw[i, :N] = entry[1]
 
-    pod_ports, node_ports, port_conflict = _encode_ports(
+    pod_ports, node_ports, port_conflict, port_vocab = _encode_ports(
         nt, pods, pad_pods=PP, pad_nodes=NC
     )
     return PodBatch(
@@ -507,4 +508,5 @@ def encode_pod_batch(
         pod_ports=pod_ports,
         node_ports=node_ports,
         port_conflict=port_conflict,
+        port_vocab=port_vocab,
     )

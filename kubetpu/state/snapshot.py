@@ -179,6 +179,12 @@ class Cache:
             del self._assumed[pod.uid]
             self._remove_pod_internal(pod)
 
+    def has_pod(self, uid: str) -> bool:
+        """Is the pod (assigned or assumed) still present? Preemption's
+        eligibility gate polls this: a victim whose informer delete hasn't
+        arrived is 'terminating' (default_preemption.go:364)."""
+        return uid in self._pods
+
     def is_assumed(self, uid: str) -> bool:
         return uid in self._assumed
 

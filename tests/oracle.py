@@ -629,3 +629,169 @@ def interpod_scores(
         if feasible[j] and mx > mn:
             out[j] = int(MAX * (raw[j] - mn) / (mx - mn))
     return out
+
+
+# --- Preemption (framework/preemption/preemption.go +
+#     defaultpreemption/default_preemption.go) ------------------------------
+
+PRIO_SHIFT = 2**31  # preemption.go:339
+
+
+def _more_important(a: t.Pod, b: t.Pod) -> bool:
+    """util.MoreImportantPod: higher priority, then earlier start."""
+    if a.priority != b.priority:
+        return a.priority > b.priority
+    return a.creation_index < b.creation_index
+
+
+def _imp_sorted(pods: list[t.Pod]) -> list[t.Pod]:
+    import functools
+
+    return sorted(
+        pods,
+        key=functools.cmp_to_key(
+            lambda a, b: -1 if _more_important(a, b) else 1
+        ),
+    )
+
+
+def _pdb_matches(pdb: t.PodDisruptionBudget, pod: t.Pod) -> bool:
+    if pdb.namespace != pod.namespace or not pod.labels:
+        return False
+    if pdb.selector is None or (
+        not pdb.selector.match_labels and not pdb.selector.match_expressions
+    ):
+        return False
+    if pod.name in pdb.disrupted_pods:
+        return False
+    return sel.label_selector_matches(pdb.selector, pod.labels_dict())
+
+
+def _fits_state(pod: t.Pod, info: NodeInfo, present: list[t.Pod]) -> bool:
+    """Preemptor fit against an explicit pod set (fit + count + ports)."""
+    alloc = info.node.allocatable_dict()
+    if len(present) + 1 > alloc.get(t.PODS, 0):
+        return False
+    used: dict[str, int] = {}
+    for p in present:
+        for k, v in p.requests:
+            used[k] = used.get(k, 0) + v
+    for k, v in pod.requests_dict().items():
+        if v > 0 and v > alloc.get(k, 0) - used.get(k, 0):
+            return False
+    want = [
+        (p.host_port, p.protocol or "TCP", p.host_ip or "0.0.0.0")
+        for p in pod.ports if p.host_port > 0
+    ]
+    if want:
+        in_use = set()
+        for p in present:
+            for cp in p.ports:
+                if cp.host_port > 0:
+                    in_use.add(
+                        (cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0")
+                    )
+        for port, proto, ip in want:
+            for uport, uproto, uip in in_use:
+                if port == uport and proto == uproto and (
+                    ip == "0.0.0.0" or uip == "0.0.0.0" or ip == uip
+                ):
+                    return False
+    return True
+
+
+def select_victims_on_node(
+    pod: t.Pod, info: NodeInfo, pdbs: list[t.PodDisruptionBudget]
+):
+    """default_preemption.go:252 SelectVictimsOnNode →
+    (victims list, num_pdb_violations) or None."""
+    potential = [p for p in info.pods.values() if p.priority < pod.priority]
+    if not potential:
+        return None
+    keep = [p for p in info.pods.values() if p.priority >= pod.priority]
+    if not _fits_state(pod, info, keep):
+        return None
+    ordered = _imp_sorted(potential)
+    # PDB violation marking (default_preemption.go:406)
+    allowed = [p.disruptions_allowed for p in pdbs]
+    violating_set = set()
+    for p in ordered:
+        hit = False
+        for i, b in enumerate(pdbs):
+            if _pdb_matches(b, p):
+                allowed[i] -= 1
+                if allowed[i] < 0:
+                    hit = True
+        if hit:
+            violating_set.add(p.uid)
+    violating = [p for p in ordered if p.uid in violating_set]
+    nonviolating = [p for p in ordered if p.uid not in violating_set]
+    victims: list[t.Pod] = []
+    n_viol = 0
+    present = list(keep)
+    for group, count_violations in ((violating, True), (nonviolating, False)):
+        for p in group:
+            if _fits_state(pod, info, present + [p]):
+                present.append(p)       # reprieved
+            else:
+                victims.append(p)
+                if count_violations:
+                    n_viol += 1
+    if not victims:
+        return None
+    return victims, n_viol
+
+
+def preempt(
+    pod: t.Pod,
+    infos: list[NodeInfo],
+    pdbs: list[t.PodDisruptionBudget] | None = None,
+    check_spread: bool = False,
+    check_interpod: bool = False,
+):
+    """Exhaustive dry run + pickOneNodeForPreemption (preemption.go:311).
+    Returns (node_name, victim uid list) or (None, [])."""
+    pdbs = pdbs or []
+    if pod.preemption_policy == "Never":
+        return None, []
+    candidates = {}
+    for info in infos:
+        # potential = victim-independent filters pass, fit/ports fail
+        if not static_feasible(pod, info):
+            continue
+        if check_spread and not spread_filter(pod, infos, info):
+            continue
+        if check_interpod and not interpod_filter(pod, infos, info):
+            continue
+        if fits(pod, info) and ports_ok(pod, info):
+            continue  # feasible — not a preemption target
+        res = select_victims_on_node(pod, info, pdbs)
+        if res is not None:
+            candidates[info.node.name] = res
+    if not candidates:
+        return None, []
+    names = [info.node.name for info in infos if info.node.name in candidates]
+
+    def stats(name):
+        victims, n_viol = candidates[name]
+        max_prio = max(v.priority for v in victims)
+        sum_prio = sum(v.priority + PRIO_SHIFT for v in victims)
+        earliest = min(
+            v.creation_index for v in victims if v.priority == max_prio
+        )
+        return n_viol, max_prio, sum_prio, len(victims), earliest
+
+    remaining = list(names)
+    for key_fn in (
+        lambda n: -stats(n)[0],
+        lambda n: -stats(n)[1],
+        lambda n: -stats(n)[2],
+        lambda n: -stats(n)[3],
+        lambda n: stats(n)[4],
+    ):
+        best = max(key_fn(n) for n in remaining)
+        remaining = [n for n in remaining if key_fn(n) == best]
+        if len(remaining) == 1:
+            break
+    chosen = remaining[0]
+    return chosen, [v.uid for v in candidates[chosen][0]]

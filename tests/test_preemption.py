@@ -1,0 +1,512 @@
+"""Preemption: device victim-search kernel vs. the reference-semantics
+oracle, plus scheduler-loop integration (PostFilter → nominate → victim
+deletion → requeue → scheduled).
+
+Reference behaviors covered (citations in kubetpu/ops/preemption.py):
+- minimal victim set via reprieve (SelectVictimsOnNode)
+- node choice criteria incl. PDB violations and victim priorities
+  (pickOneNodeForPreemption)
+- PodEligibleToPreemptOthers: preemptionPolicy=Never
+- candidate gating: only resolvable failures (fit/ports) are candidates
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import kubetpu  # noqa: F401
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, score_params
+from kubetpu.framework.preemption import PreemptionEvaluator
+from kubetpu.state import Cache
+
+from . import oracle
+
+
+def default_profile() -> C.Profile:
+    return C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_UNSCHEDULABLE, 1), (C.NODE_NAME, 1),
+            (C.TAINT_TOLERATION, 1), (C.NODE_AFFINITY, 1),
+            (C.NODE_PORTS, 1), (C.NODE_RESOURCES_FIT, 1),
+        )),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+
+
+def run_preempt(cache: Cache, pod: t.Pod, pdbs=(), profile=None):
+    profile = profile or default_profile()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], profile)
+    params = score_params(profile, batch.resource_names)
+    ev = PreemptionEvaluator(batch, params, pdbs=tuple(pdbs))
+    return ev, ev.preempt(0)
+
+
+def oracle_preempt(cache: Cache, pod: t.Pod, pdbs=()):
+    snap = cache.update_snapshot()
+    return oracle.preempt(pod, snap.node_infos(), list(pdbs))
+
+
+class TestKernelVsOracle:
+    def test_basic_single_victim(self):
+        cache = Cache()
+        for i in range(4):
+            cache.add_node(make_node(f"n{i}", cpu_milli=1000, memory=2**30))
+        # every node full with one low-prio pod
+        for i in range(4):
+            cache.add_pod(make_pod(
+                f"low-{i}", cpu_milli=900, priority=0, node_name=f"n{i}",
+                creation_index=i,
+            ))
+        high = make_pod("high", cpu_milli=800, priority=100)
+        ev, res = run_preempt(cache, high)
+        assert res.status == "success"
+        node, victims = oracle_preempt(cache, high)
+        assert res.node_name == node
+        assert sorted(res.victim_uids) == sorted(victims)
+        assert len(res.victim_uids) == 1
+
+    def test_reprieve_minimizes_victims(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        # two pods of 400m each; preemptor needs 500m → one victim suffices
+        cache.add_pod(make_pod("a", cpu_milli=400, priority=0, node_name="n0",
+                               creation_index=0))
+        cache.add_pod(make_pod("b", cpu_milli=400, priority=5, node_name="n0",
+                               creation_index=1))
+        high = make_pod("high", cpu_milli=500, priority=100)
+        ev, res = run_preempt(cache, high)
+        assert res.status == "success"
+        # reprieve keeps the more important (higher prio) pod → victim is "a"
+        assert res.victim_uids == ["default/a"]
+        node, victims = oracle_preempt(cache, high)
+        assert (res.node_name, res.victim_uids) == (node, victims)
+
+    def test_prefers_lowest_priority_victims(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_node(make_node("n1", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod("lo", cpu_milli=900, priority=1, node_name="n0"))
+        cache.add_pod(make_pod("mid", cpu_milli=900, priority=50, node_name="n1"))
+        high = make_pod("high", cpu_milli=800, priority=100)
+        ev, res = run_preempt(cache, high)
+        assert res.status == "success"
+        assert res.node_name == "n0"          # lower highest-victim priority
+        assert res.victim_uids == ["default/lo"]
+
+    def test_pdb_violation_avoidance(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_node(make_node("n1", cpu_milli=1000, memory=2**30))
+        # n0's victim is PDB-protected (0 disruptions allowed); n1's is not.
+        # n0's victim has LOWER priority — without the PDB it would win.
+        cache.add_pod(make_pod(
+            "guarded", cpu_milli=900, priority=0, node_name="n0",
+            labels={"app": "web"},
+        ))
+        cache.add_pod(make_pod("free", cpu_milli=900, priority=10, node_name="n1"))
+        pdb = t.PodDisruptionBudget(
+            name="web-pdb",
+            selector=t.LabelSelector.of({"app": "web"}),
+            disruptions_allowed=0,
+        )
+        high = make_pod("high", cpu_milli=800, priority=100)
+        ev, res = run_preempt(cache, high, pdbs=[pdb])
+        assert res.status == "success"
+        assert res.node_name == "n1"
+        node, victims = oracle_preempt(cache, high, pdbs=[pdb])
+        assert (res.node_name, res.victim_uids) == (node, victims)
+
+    def test_preemption_policy_never(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod("low", cpu_milli=900, priority=0, node_name="n0"))
+        never = make_pod(
+            "never", cpu_milli=800, priority=100, preemption_policy="Never"
+        )
+        ev, res = run_preempt(cache, never)
+        assert res.status == "not_eligible"
+
+    def test_no_lower_priority_no_candidates(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod("peer", cpu_milli=900, priority=100, node_name="n0"))
+        high = make_pod("high", cpu_milli=800, priority=100)
+        ev, res = run_preempt(cache, high)
+        assert res.status == "unschedulable"
+
+    def test_static_failure_not_a_candidate(self):
+        """A node failing NodeAffinity is UnschedulableAndUnresolvable —
+        preemption must not nominate it (preemption.go:180)."""
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_node(make_node(
+            "n1", cpu_milli=1000, memory=2**30, labels={"zone": "a"}
+        ))
+        cache.add_pod(make_pod("v0", cpu_milli=900, priority=0, node_name="n0"))
+        cache.add_pod(make_pod("v1", cpu_milli=900, priority=0, node_name="n1"))
+        high = make_pod(
+            "high", cpu_milli=800, priority=100,
+            node_selector={"zone": "a"},
+        )
+        ev, res = run_preempt(cache, high)
+        assert res.status == "success"
+        assert res.node_name == "n1"
+
+    def test_host_port_conflict_preemption(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=4000, memory=2**32))
+        cache.add_pod(make_pod(
+            "holder", cpu_milli=100, priority=0, node_name="n0",
+            host_ports=[8080],
+        ))
+        high = make_pod("high", cpu_milli=100, priority=10, host_ports=[8080])
+        ev, res = run_preempt(cache, high)
+        assert res.status == "success"
+        assert res.victim_uids == ["default/holder"]
+        node, victims = oracle_preempt(cache, high)
+        assert (res.node_name, res.victim_uids) == (node, victims)
+
+    def test_port_not_freed_if_shared(self):
+        """Removing a victim must not free a port a higher-priority pod on
+        the same node still claims (multiset port accounting)."""
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=4000, memory=2**32))
+        # same triple held by a non-victim (priority above the preemptor)
+        cache.add_pod(make_pod(
+            "keeper", cpu_milli=100, priority=200, node_name="n0",
+            host_ports=[8080],
+        ))
+        cache.add_pod(make_pod(
+            "victim", cpu_milli=100, priority=0, node_name="n0",
+        ))
+        high = make_pod("high", cpu_milli=100, priority=10, host_ports=[8080])
+        ev, res = run_preempt(cache, high)
+        assert res.status == "unschedulable"
+
+    def test_multi_preemptor_disjoint_victims(self):
+        cache = Cache()
+        for i in range(2):
+            cache.add_node(make_node(f"n{i}", cpu_milli=1000, memory=2**30))
+            cache.add_pod(make_pod(
+                f"low-{i}", cpu_milli=900, priority=0, node_name=f"n{i}",
+            ))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        highs = [
+            make_pod("h0", cpu_milli=800, priority=100),
+            make_pod("h1", cpu_milli=800, priority=100),
+        ]
+        batch = encode_batch(snap, highs, profile)
+        params = score_params(profile, batch.resource_names)
+        ev = PreemptionEvaluator(batch, params)
+        r0, r1 = ev.preempt(0), ev.preempt(1)
+        assert r0.status == r1.status == "success"
+        assert r0.node_name != r1.node_name
+        assert set(r0.victim_uids).isdisjoint(r1.victim_uids)
+
+    @pytest.mark.parametrize("seed", range(6))
+    def test_randomized_parity(self, seed):
+        rng = np.random.default_rng(seed)
+        cache = Cache()
+        n_nodes = int(rng.integers(3, 10))
+        for i in range(n_nodes):
+            cache.add_node(make_node(
+                f"n{i}", cpu_milli=1000, memory=4 * 2**30, pods=20
+            ))
+        ci = 0
+        for i in range(n_nodes):
+            for _ in range(int(rng.integers(1, 5))):
+                cache.add_pod(make_pod(
+                    f"p{ci}",
+                    cpu_milli=int(rng.integers(100, 500)),
+                    memory=int(rng.integers(1, 8)) * 2**28,
+                    priority=int(rng.integers(0, 4)) * 10,
+                    node_name=f"n{i}",
+                    creation_index=ci,
+                    labels={"grp": f"g{ci % 3}"},
+                ))
+                ci += 1
+        pdbs = [
+            t.PodDisruptionBudget(
+                name="pdb0",
+                selector=t.LabelSelector.of({"grp": "g0"}),
+                disruptions_allowed=int(rng.integers(0, 2)),
+            )
+        ]
+        high = make_pod(
+            "high",
+            cpu_milli=int(rng.integers(600, 1000)),
+            memory=2**30,
+            priority=35,
+        )
+        ev, res = run_preempt(cache, high, pdbs=pdbs)
+        node, victims = oracle_preempt(cache, high, pdbs=pdbs)
+        if node is None:
+            assert res.status != "success"
+        else:
+            assert res.status == "success"
+            assert res.node_name == node
+            assert sorted(res.victim_uids) == sorted(victims)
+
+
+class TestSchedulerIntegration:
+    def test_end_to_end_preempt_then_schedule(self):
+        from kubetpu.sched.scheduler import Scheduler
+
+        deleted: list[t.Pod] = []
+        nominated: list[tuple[str, str]] = []
+
+        class Client:
+            def __init__(self):
+                self.sched = None
+
+            def bind(self, pod, node_name):
+                self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+            def patch_status(self, pod, reason, message=""):
+                pass
+
+            def delete_pod(self, pod, reason=""):
+                deleted.append(pod)
+                self.sched.on_pod_delete(pod)
+
+            def nominate(self, pod, node_name):
+                nominated.append((pod.name, node_name))
+
+        client = Client()
+        now = [0.0]
+        sched = Scheduler(
+            client, profile=default_profile(), clock=lambda: now[0]
+        )
+        client.sched = sched
+        sched.enable_preemption()
+        for i in range(2):
+            sched.on_node_add(make_node(f"n{i}", cpu_milli=1000, memory=2**30))
+            sched.on_pod_add(make_pod(
+                f"low-{i}", cpu_milli=900, priority=0, node_name=f"n{i}",
+                creation_index=i,
+            ))
+        sched.on_pod_add(make_pod("high", cpu_milli=800, priority=100,
+                                  creation_index=10))
+        res = sched.schedule_batch()
+        assert res == {"scheduled": 0, "unschedulable": 1}
+        sched.dispatcher.sync()
+        assert len(deleted) == 1 and deleted[0].name.startswith("low-")
+        assert nominated == [("high", deleted[0].node_name)]
+        assert sched.metrics.preemption_attempts == 1
+        assert sched.metrics.preemption_victims == 1
+
+        # victim delete event fired queueing hints → pod reactivates after
+        # backoff; force the flushes and run more cycles
+        total = 0
+        for _ in range(5):
+            now[0] += 31.0          # past backoff + leftover-flush windows
+            sched._flush_timers()
+            r = sched.schedule_batch()
+            total += r["scheduled"]
+            if total:
+                break
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        assert total == 1
+        sched.close()
+
+    def test_no_repeat_preemption_while_victims_terminating(self):
+        """PodEligibleToPreemptOthers: while a previous victim is still in
+        the cache (its informer delete pending = terminating), a re-woken
+        preemptor keeps its nomination and does NOT pick more victims
+        (default_preemption.go:364)."""
+        from kubetpu.sched.scheduler import Scheduler
+
+        deleted: list[t.Pod] = []
+
+        class Client:
+            sched = None
+
+            def bind(self, pod, node_name):
+                self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+            def patch_status(self, pod, reason, message=""):
+                pass
+
+            def delete_pod(self, pod, reason=""):
+                # informer delete deliberately NOT delivered — the victim
+                # stays "terminating" in the cache
+                deleted.append(pod)
+
+            def nominate(self, pod, node_name):
+                pass
+
+        client = Client()
+        now = [0.0]
+        sched = Scheduler(
+            client, profile=default_profile(), clock=lambda: now[0]
+        )
+        client.sched = sched
+        sched.enable_preemption()
+        for i in range(2):
+            sched.on_node_add(make_node(f"n{i}", cpu_milli=1000, memory=2**30))
+            sched.on_pod_add(make_pod(
+                f"low-{i}", cpu_milli=900, priority=0, node_name=f"n{i}",
+                creation_index=i,
+            ))
+        # a small unrelated pod whose later deletion wakes the preemptor
+        # without freeing enough room to schedule it
+        sched.on_pod_add(make_pod(
+            "other", cpu_milli=50, priority=0, node_name="n0",
+            creation_index=5,
+        ))
+        sched.on_pod_add(make_pod("high", cpu_milli=800, priority=100,
+                                  creation_index=10))
+        sched.schedule_batch()
+        sched.dispatcher.sync()
+        assert len(deleted) == 1
+        assert sched.metrics.preemption_attempts == 1
+        victim_name = deleted[0].name
+
+        # wake the preemptor via an unrelated assigned-pod delete; victim
+        # still in cache → the gate must hold (no second victim)
+        sched.on_pod_delete(make_pod(
+            "other", cpu_milli=50, priority=0, node_name="n0",
+            creation_index=5,
+        ))
+        now[0] += 31.0
+        sched._flush_timers()
+        r2 = sched.schedule_batch()
+        sched.dispatcher.sync()
+        assert r2["unschedulable"] == 1          # popped and failed again
+        assert len(deleted) == 1, "second victim chosen during grace period"
+        assert sched.metrics.preemption_attempts == 1  # gate short-circuited
+
+        # deliver the victim's informer delete → pod schedules next cycle
+        sched.on_pod_delete(deleted[0])
+        got = 0
+        for _ in range(4):
+            now[0] += 31.0
+            sched._flush_timers()
+            got += sched.schedule_batch()["scheduled"]
+            if got:
+                break
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        assert got == 1
+        assert not sched._preempting
+        assert len(sched.nominator) == 0         # nomination spent on assume
+        sched.close()
+
+    def test_nominator_reserves_freed_room(self):
+        """A lower-priority pod arriving while the preemptor waits in
+        backoff must NOT take the room the victims freed; the preemptor
+        gets it (nominator.go semantics via the reservation tensor)."""
+        from kubetpu.sched.scheduler import Scheduler
+
+        bound: list[tuple[str, str]] = []
+
+        class Client:
+            sched = None
+
+            def bind(self, pod, node_name):
+                bound.append((pod.name, node_name))
+                self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+            def patch_status(self, pod, reason, message=""):
+                pass
+
+            def delete_pod(self, pod, reason=""):
+                self.sched.on_pod_delete(pod)
+
+            def nominate(self, pod, node_name):
+                pass
+
+        client = Client()
+        now = [0.0]
+        sched = Scheduler(
+            client, profile=default_profile(), clock=lambda: now[0]
+        )
+        client.sched = sched
+        sched.enable_preemption()
+        sched.on_node_add(make_node("n0", cpu_milli=1000, memory=2**30))
+        sched.on_pod_add(make_pod(
+            "low", cpu_milli=900, priority=0, node_name="n0", creation_index=0
+        ))
+        sched.on_pod_add(make_pod("high", cpu_milli=800, priority=100,
+                                  creation_index=1))
+        sched.schedule_batch()
+        sched.dispatcher.sync()       # victim deleted + informer delivered
+        assert len(sched.nominator) == 1
+
+        # lower-priority contender arrives while high is in backoff: the
+        # reservation must keep it out of n0
+        sched.on_pod_add(make_pod("medium", cpu_milli=800, priority=50,
+                                  creation_index=2))
+        r = sched.schedule_batch()
+        sched.dispatcher.sync()
+        assert r["scheduled"] == 0, "medium stole the nominated room"
+        assert ("medium", "n0") not in bound
+
+        # high wakes and takes its reserved room (its own reservation does
+        # not block it — the gate excludes self)
+        got = 0
+        for _ in range(4):
+            now[0] += 31.0
+            sched._flush_timers()
+            got += sched.schedule_batch()["scheduled"]
+            if ("high", "n0") in bound:
+                break
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        assert ("high", "n0") in bound
+        assert len(sched.nominator) == 0
+        sched.close()
+
+    def test_higher_priority_ignores_reservation(self):
+        """A HIGHER-priority pod may take the freed room (the reference only
+        adds nominated pods with priority >= the filtered pod's)."""
+        from kubetpu.sched.scheduler import Scheduler
+
+        bound: list[tuple[str, str]] = []
+
+        class Client:
+            sched = None
+
+            def bind(self, pod, node_name):
+                bound.append((pod.name, node_name))
+                self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+            def patch_status(self, pod, reason, message=""):
+                pass
+
+            def delete_pod(self, pod, reason=""):
+                self.sched.on_pod_delete(pod)
+
+            def nominate(self, pod, node_name):
+                pass
+
+        client = Client()
+        now = [0.0]
+        sched = Scheduler(
+            client, profile=default_profile(), clock=lambda: now[0]
+        )
+        client.sched = sched
+        sched.enable_preemption()
+        sched.on_node_add(make_node("n0", cpu_milli=1000, memory=2**30))
+        sched.on_pod_add(make_pod(
+            "low", cpu_milli=900, priority=0, node_name="n0", creation_index=0
+        ))
+        sched.on_pod_add(make_pod("high", cpu_milli=800, priority=100,
+                                  creation_index=1))
+        sched.schedule_batch()
+        sched.dispatcher.sync()
+        sched.on_pod_add(make_pod("vip", cpu_milli=800, priority=200,
+                                  creation_index=2))
+        r = sched.schedule_batch()
+        sched.dispatcher.sync()
+        assert r["scheduled"] == 1
+        assert ("vip", "n0") in bound
+        sched.close()
