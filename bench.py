@@ -12,7 +12,8 @@ Throughput definition matches the reference's: measured pods / wall time of
 the scheduling phase (encode + device greedy scan + readback), steady-state
 (after one compile warmup on identical shapes).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"} — plus an
+"error" key (value 0.0) when the backend is unreachable or the run fails.
 """
 
 import json
@@ -78,7 +79,19 @@ def run_once(cache: Cache, pending, profile, params) -> tuple[float, int]:
     return t1 - t0, scheduled
 
 
-def main() -> None:
+def _result(throughput: float, error: str | None = None) -> dict:
+    out = {
+        "metric": "SchedulingBasic_5000Nodes_10000Pods_throughput",
+        "value": round(throughput, 1),
+        "unit": "pods/s",
+        "vs_baseline": round(throughput / BASELINE_PODS_PER_SEC, 2),
+    }
+    if error is not None:
+        out["error"] = error
+    return out
+
+
+def measure() -> dict:
     profile = C.minimal_profile()
     cache, pending = build_cluster()
     snap = cache.update_snapshot()
@@ -89,17 +102,56 @@ def main() -> None:
     np.asarray(a)
     # steady-state run, full pipeline (snapshot → encode → device → readback)
     elapsed, scheduled = run_once(cache, pending, profile, params)
-    throughput = scheduled / elapsed
-    print(
-        json.dumps(
-            {
-                "metric": "SchedulingBasic_5000Nodes_10000Pods_throughput",
-                "value": round(throughput, 1),
-                "unit": "pods/s",
-                "vs_baseline": round(throughput / BASELINE_PODS_PER_SEC, 2),
-            }
-        )
-    )
+    return _result(scheduled / elapsed)
+
+
+def _probe_backend(timeout_s: float = 180.0) -> str:
+    """Probe backend init in a daemon thread. If the TPU relay is down, init
+    hangs forever in make_c_api_client — a bare retry never returns, so a
+    hang must be detected here to emit a structured artifact before the
+    driver's kill timeout. Returns "ok", "timeout", or "error" (a fast
+    backend-init raise — retryable, unlike a hang)."""
+    import threading
+
+    outcome: list[str] = []
+
+    def probe() -> None:
+        try:
+            import jax
+
+            jax.devices()
+            outcome.append("ok")
+        except Exception:
+            outcome.append("error")
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    return outcome[0] if outcome else "timeout"
+
+
+def main() -> None:
+    """Run the measurement with one retry on backend flake.
+
+    Round-1 postmortem: a transient ``Unable to initialize backend`` killed
+    the whole round's evidence. A hung backend init (relay down) emits a
+    structured timeout line; a fast backend-init raise falls through to the
+    retry loop; persistent failure still prints ONE structured JSON line
+    (value 0.0) so the driver records an artifact instead of a raw traceback.
+    """
+    if _probe_backend() == "timeout":
+        print(json.dumps(_result(0.0, "backend init timed out (TPU relay unreachable)")))
+        return
+    last_err = None
+    for attempt in range(2):
+        try:
+            print(json.dumps(measure()))
+            return
+        except Exception as e:  # backend init flake, OOM, anything fatal
+            last_err = e
+            if attempt == 0:
+                time.sleep(10)
+    print(json.dumps(_result(0.0, f"{type(last_err).__name__}: {last_err}")))
 
 
 if __name__ == "__main__":
