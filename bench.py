@@ -86,6 +86,15 @@ def _result(throughput: float, error: str | None = None) -> dict:
         "unit": "pods/s",
         "vs_baseline": round(throughput / BASELINE_PODS_PER_SEC, 2),
     }
+    try:
+        import jax
+
+        # make a silent CPU fallback visible in the artifact: a cached
+        # partial backend init can leave jax on cpu after an accelerator
+        # flake, and that would otherwise be recorded as TPU evidence
+        out["backend"] = jax.default_backend()
+    except Exception:
+        pass
     if error is not None:
         out["error"] = error
     return out

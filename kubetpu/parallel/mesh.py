@@ -52,19 +52,40 @@ def _spec_for(field: str, axis: str) -> P:
     return P()
 
 
+# Quadratic-kernel pytrees (the tensors the TPU story scales on): every
+# ``(…, N)`` leaf shards its node axis; per-pod / per-domain leaves are small
+# and replicated. SpreadDevice: eligible/node_domain/node_count/has_key are
+# (S, N), ignored is (P, N). PodAffinityDevice: node_domain/has_key are
+# (R, N); base_sums (R, D) stays replicated — domain counts are the
+# cross-shard reduction target, XLA materializes them via psum-style
+# collectives when the segment sums run.
+_NESTED_NODE_LAST = {
+    "spread": ("eligible", "node_domain", "node_count", "has_key", "ignored"),
+    "podaffinity": ("node_domain", "has_key"),
+}
+
+
 def shard_batch(b: rt.DeviceBatch, mesh: Mesh, axis: str = "nodes") -> rt.DeviceBatch:
     """Place every leaf with its node-axis sharding. The padded node count
-    must divide the mesh size (encode_batch pads to ≥8)."""
-    kwargs = {}
-    for field in rt.DeviceBatch.__dataclass_fields__:
-        leaf = getattr(b, field)
-        if leaf is None:
-            kwargs[field] = None
-            continue
-        kwargs[field] = jax.device_put(
-            leaf, NamedSharding(mesh, _spec_for(field, axis))
-        )
-    return rt.DeviceBatch(**kwargs)
+    must divide the mesh size (encode_batch pads to ≥8).
+
+    Registered-dataclass pytree flattening already excludes ``None`` leaves
+    and static metadata fields, so one sharding pytree + one ``device_put``
+    covers the whole batch, nested quadratic-kernel pytrees included.
+    """
+
+    def spec(path, leaf) -> NamedSharding:
+        names = [p.name for p in path if hasattr(p, "name")]
+        field = names[-1]
+        parent = names[-2] if len(names) > 1 else None
+        if parent in _NESTED_NODE_LAST:
+            s = P(None, axis) if field in _NESTED_NODE_LAST[parent] else P()
+        else:
+            s = _spec_for(field, axis)
+        return NamedSharding(mesh, s)
+
+    shardings = jax.tree_util.tree_map_with_path(spec, b)
+    return jax.device_put(b, shardings)
 
 
 def sharded_greedy(
