@@ -56,6 +56,8 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         nominated_node=b.nominated_node,
         nominated_req=b.nominated_req,
         nominated_gate=row(b.nominated_gate),
+        nominated_ports=b.nominated_ports,
+        nominated_pod_idx=b.nominated_pod_idx,
         spread=_spread_view(b.spread, i),
         podaffinity=_pa_view(b.podaffinity, i),
     )
@@ -106,13 +108,15 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
     node_iota = jnp.arange(n, dtype=jnp.int32)
 
     def step(state, i):
-        requested, nonzero, pod_count, node_ports, spread_counts, pa_sums = state
+        (requested, nonzero, pod_count, node_ports, spread_counts, pa_sums,
+         nom_active) = state
         view = _pod_view(b, i)
         mask, score = rt.feasible_and_scores(
             view, params,
             requested=requested, nonzero_requested=nonzero,
             pod_count=pod_count, node_ports=node_ports,
             spread_counts=spread_counts, pa_sums=pa_sums,
+            nominated_active=nom_active,
         )
         mask, score = mask[0], score[0]
         feasible = jnp.any(mask)
@@ -147,8 +151,15 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
             pa_sums = pa_sums.at[
                 jnp.arange(r), jnp.maximum(dcol, 0)
             ].add(inc)
+        if nom_active is not None:
+            # assume deletes the nomination (schedule_one.go:307): once the
+            # scan assigns a nomination's own pod, stop charging it
+            nom_active = nom_active & ~(
+                (b.nominated_pod_idx == i) & feasible
+            )
         return (
-            requested, nonzero, pod_count, node_ports, spread_counts, pa_sums
+            requested, nonzero, pod_count, node_ports, spread_counts, pa_sums,
+            nom_active,
         ), chosen
 
     p = b.requests.shape[0]
@@ -156,6 +167,8 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
         b.requested, b.nonzero_requested, b.pod_count, b.node_ports,
         None if b.spread is None else b.spread.node_count,
         None if b.podaffinity is None else b.podaffinity.base_sums,
+        None if b.nominated_pod_idx is None
+        else jnp.ones(b.nominated_pod_idx.shape[0], dtype=bool),
     )
     final_state, assignments = jax.lax.scan(
         step, init, jnp.arange(p, dtype=jnp.int32)

@@ -57,6 +57,7 @@ class PreemptionEvaluator:
         node_ports_counts: np.ndarray | None = None,
         spread_counts=None,
         pa_sums=None,
+        nominated_active: np.ndarray | None = None,
     ):
         if batch.node_tensors is None:
             raise ValueError("batch was encoded without node_tensors")
@@ -89,6 +90,32 @@ class PreemptionEvaluator:
         # the batch just tipped past max_skew could be nominated.
         self.spread_counts = spread_counts
         self.pa_sums = pa_sums
+        # Nomination charging state. ``nominated_active`` (G,) marks
+        # nominations NOT consumed by this batch's own greedy pass (a nominee
+        # the scan just assigned is already in `requested` — charging its
+        # nomination again would double-count). Host copies hoisted once;
+        # they never change over the evaluator's lifetime.
+        b = batch.device
+        if b.nominated_node is not None:
+            self._nom_node = np.asarray(jax.device_get(b.nominated_node))
+            self._nom_req = np.asarray(jax.device_get(b.nominated_req))
+            self._nom_gate = np.asarray(jax.device_get(b.nominated_gate))
+            self._nom_pod_idx = (
+                np.asarray(jax.device_get(b.nominated_pod_idx))
+                if b.nominated_pod_idx is not None
+                else np.full(self._nom_node.shape[0], -1, dtype=np.int32)
+            )
+            self._nom_ports = (
+                np.asarray(jax.device_get(b.nominated_ports))
+                if b.nominated_ports is not None else None
+            )
+            self._nom_active = (
+                np.asarray(jax.device_get(nominated_active))
+                if nominated_active is not None
+                else np.ones(self._nom_node.shape[0], dtype=bool)
+            )
+        else:
+            self._nom_node = None
 
     def _potential_mask(self, i: int) -> jnp.ndarray:
         """(N,) — nodes whose failure is the resolvable kind: all
@@ -103,6 +130,10 @@ class PreemptionEvaluator:
             node_ports=jnp.asarray(self.port_counts > 0),
             spread_counts=self.spread_counts,
             pa_sums=self.pa_sums,
+            nominated_active=(
+                jnp.asarray(self._nom_active)
+                if self._nom_node is not None else None
+            ),
         )
         ok_independent = static[0]
         for part in (spread_ok, pa_ok):
@@ -127,6 +158,12 @@ class PreemptionEvaluator:
 
         b = self.batch.device
         v = self.victims
+        # This preempt() replaces any prior nomination of pod i (on success a
+        # new node is charged via _apply; on failure the caller removes the
+        # nomination) — stop charging the stale one for the rest of the
+        # batch, or pod i would be double-charged on two nodes.
+        if self._nom_node is not None:
+            self._nom_active = self._nom_active & (self._nom_pod_idx != i)
         wants_conf = (
             jnp.einsum(
                 "k,kl->l",
@@ -134,16 +171,37 @@ class PreemptionEvaluator:
                 b.port_conflict.astype(jnp.int32),
             ) > 0
         )
+        # Charge equal/higher-priority nominated pods (resources, count AND
+        # host ports) to their nominated nodes before the victim search,
+        # mirroring the reference's RunFilterPluginsWithNominatedPods inside
+        # SelectVictimsOnNode (default_preemption.go:303,:323): a preemptor
+        # must not claim room another nominee has already reserved. The
+        # encoded gate row is exactly the >=-priority-and-not-self rule;
+        # nominations consumed by this batch's own assignments are inactive.
+        req, cnt, ports = self.requested, self.pod_count, self.port_counts
+        if self._nom_node is not None:
+            sel = self._nom_gate[i] & self._nom_active & (self._nom_node >= 0)
+            if sel.any():
+                req = req.copy()
+                cnt = cnt.copy()
+                np.add.at(req, self._nom_node[sel], self._nom_req[sel])
+                np.add.at(cnt, self._nom_node[sel], 1)
+                if self._nom_ports is not None and self._nom_ports[sel].any():
+                    ports = ports.copy()
+                    np.add.at(
+                        ports, self._nom_node[sel],
+                        self._nom_ports[sel].astype(ports.dtype),
+                    )
         node_idx, victims = OP.dry_run_preemption(
             b.requests[i],
             jnp.asarray(np.int64(pod.priority)),
             wants_conf,
             self._potential_mask(i),
             b.alloc,
-            jnp.asarray(self.requested),
-            jnp.asarray(self.pod_count),
+            jnp.asarray(req),
+            jnp.asarray(cnt),
             b.allowed_pods,
-            jnp.asarray(self.port_counts),
+            jnp.asarray(ports),
             jnp.asarray(v.valid),
             jnp.asarray(v.priority),
             jnp.asarray(v.start),
@@ -164,7 +222,7 @@ class PreemptionEvaluator:
         ]
         info = self.batch.node_tensors.infos[n]
         pods = [info.pods[u] for u in uids if u in info.pods]
-        self._apply(n, vrow)
+        self._apply(n, vrow, preemptor_index=i)
         return PreemptionResult(
             "success",
             node_name=self.batch.node_names[n],
@@ -172,9 +230,14 @@ class PreemptionEvaluator:
             victim_pods=pods,
         )
 
-    def _apply(self, n: int, victim_row: np.ndarray) -> None:
+    def _apply(
+        self, n: int, victim_row: np.ndarray, preemptor_index: int | None = None
+    ) -> None:
         """Commit one preemption to the host state so the NEXT preemptor in
-        this batch sees the victims gone (and the PDB budget spent)."""
+        this batch sees the victims gone (and the PDB budget spent) — AND the
+        just-nominated preemptor's reservation charged (preemptors run in
+        priority order, so every later pod in this cycle has priority <= this
+        one and the >=-priority charging rule applies)."""
         v = self.victims
         ks = np.flatnonzero(victim_row)
         for k in ks:
@@ -183,6 +246,17 @@ class PreemptionEvaluator:
             self.port_counts[n] -= v.victim_ports[n, k]
             self.pdb_allowed -= v.pdb[n, k].astype(np.int64)
             v.valid[n, k] = False
+        if preemptor_index is not None:
+            b = self.batch.device
+            self.requested[n] += np.asarray(
+                jax.device_get(b.requests[preemptor_index])
+            )
+            self.pod_count[n] += 1
+            # ports too: a later same-batch preemptor with a conflicting
+            # hostPort must not also be nominated here
+            self.port_counts[n] += np.asarray(
+                jax.device_get(b.pod_ports[preemptor_index])
+            ).astype(self.port_counts.dtype)
 
 
 def _one_pod_view(b: rt.DeviceBatch, i: int) -> rt.DeviceBatch:

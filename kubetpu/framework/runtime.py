@@ -72,6 +72,11 @@ class DeviceBatch:
     nominated_node: jnp.ndarray | None = None  # (G,) int32 node idx (-1 none)
     nominated_req: jnp.ndarray | None = None   # (G, R) int64
     nominated_gate: jnp.ndarray | None = None  # (P, G) bool
+    nominated_ports: jnp.ndarray | None = None  # (G, K) bool port triples
+    # batch index of each nomination's own pod (-1 if not in this batch):
+    # once the scan assigns that pod, its nomination stops being charged
+    # (the reference deletes nominations at assume, schedule_one.go:307)
+    nominated_pod_idx: jnp.ndarray | None = None  # (G,) int32
     # PodTopologySpread (None when no pod has constraints)
     spread: "SpreadDevice | None" = None
     # InterPodAffinity (None when no pod carries (anti)affinity)
@@ -220,8 +225,12 @@ def encode_batch(
     enabled_sc = (
         frozenset(profile.scores.names()) if profile is not None else None
     )
+    nominated_triples: list[tuple[int, str, str]] = []
+    for e in nominated:
+        nominated_triples.extend(getattr(e, "ports", ()))
     pb = enc.encode_pod_batch(
-        nt, pods, enabled_filters=enabled, pad_pods=PP, enabled_scores=enabled_sc
+        nt, pods, enabled_filters=enabled, pad_pods=PP,
+        enabled_scores=enabled_sc, extra_port_triples=nominated_triples,
     )
     want_na = profile is None or profile.has_score(C.NODE_AFFINITY)
     want_tt = profile is None or profile.has_score(C.TAINT_TOLERATION)
@@ -291,20 +300,29 @@ def encode_batch(
     # Nominator reservations (queue/nominator.py): the gate row for pod p
     # enables nomination g iff g's priority >= p's and g is not p itself
     # (framework/runtime's RunFilterPluginsWithNominatedPods rule).
-    nom_node = nom_req = nom_gate = None
+    nom_node = nom_req = nom_gate = nom_ports = nom_pod_idx = None
     if nominated:
         name_to_idx = {n: j for j, n in enumerate(nt.node_names)}
+        uid_to_idx = {p_.uid: i for i, p_ in enumerate(pods)}
         G = len(nominated)
+        K = pb.port_conflict.shape[0]
         nom_node = np.full(G, -1, dtype=np.int32)
         nom_req = np.zeros((G, len(nt.resource_names)), dtype=np.int64)
         nom_gate = np.zeros((PP, G), dtype=bool)
+        nom_ports = np.zeros((G, K), dtype=bool)
+        nom_pod_idx = np.full(G, -1, dtype=np.int32)
         ridx = {r: j for j, r in enumerate(nt.resource_names)}
         for g, e in enumerate(nominated):
             nom_node[g] = name_to_idx.get(e.node_name, -1)
+            nom_pod_idx[g] = uid_to_idx.get(e.uid, -1)
             for k, val in e.requests:
                 j = ridx.get(k)
                 if j is not None:
                     nom_req[g, j] = val
+            for tr in getattr(e, "ports", ()):
+                tid = pb.port_vocab.get(tr)
+                if tid >= 0:
+                    nom_ports[g, tid] = True
             for i, p_ in enumerate(pods):
                 nom_gate[i, g] = e.priority >= p_.priority and e.uid != p_.uid
 
@@ -337,6 +355,10 @@ def encode_batch(
         nominated_node=jnp.asarray(nom_node) if nom_node is not None else None,
         nominated_req=jnp.asarray(nom_req) if nom_req is not None else None,
         nominated_gate=jnp.asarray(nom_gate) if nom_gate is not None else None,
+        nominated_ports=jnp.asarray(nom_ports) if nom_ports is not None else None,
+        nominated_pod_idx=(
+            jnp.asarray(nom_pod_idx) if nom_pod_idx is not None else None
+        ),
         spread=spread_dev,
         podaffinity=pa_dev,
     )
@@ -417,6 +439,7 @@ def filter_components(
     node_ports: jnp.ndarray | None = None,
     spread_counts: jnp.ndarray | None = None,
     pa_sums: jnp.ndarray | None = None,
+    nominated_active: jnp.ndarray | None = None,
 ):
     """Per-plugin Filter masks, un-ANDed — the split preemption needs:
     failures of ``static`` / ``spread_ok`` / ``pa_ok`` are
@@ -439,9 +462,14 @@ def filter_components(
     fit = None
     if p.filter_fit:
         if b.nominated_node is not None:
+            gate = b.nominated_gate
+            if nominated_active is not None:
+                # a nomination stops charging once its own pod was assigned
+                # earlier in this batch (assume deletes the nomination)
+                gate = gate & nominated_active[None, :]
             fit = F.resource_fit_mask_nominated(
                 b.requests, b.alloc, req, pc, b.allowed_pods,
-                b.nominated_gate, b.nominated_node, b.nominated_req,
+                gate, b.nominated_node, b.nominated_req,
             )
         else:
             fit = F.resource_fit_mask(
@@ -457,6 +485,29 @@ def filter_components(
         conflict = jnp.einsum(
             "pl,nl->pn", wants_conf, ports.astype(jnp.int32)
         ) > 0                                                 # (P, N)
+        if b.nominated_ports is not None and b.nominated_node is not None:
+            # nominated pods' host ports are reserved on their nominated
+            # node for >=-priority-gated pods, like their resources
+            # (RunFilterPluginsWithNominatedPods adds the whole pod)
+            gate = b.nominated_gate
+            if nominated_active is not None:
+                gate = gate & nominated_active[None, :]
+            nom_conf = jnp.einsum(
+                "pl,gl->pg", wants_conf,
+                b.nominated_ports.astype(jnp.int32),
+            )                                                 # (P, G)
+            n_nodes = ports.shape[0]
+            at_node = (
+                b.nominated_node[:, None]
+                == jnp.arange(n_nodes, dtype=b.nominated_node.dtype)[None, :]
+            )                                                 # (G, N)
+            conflict = conflict | (
+                jnp.einsum(
+                    "pg,gn->pn",
+                    (gate & (nom_conf > 0)).astype(jnp.int32),
+                    at_node.astype(jnp.int32),
+                ) > 0
+            )
         ports_ok = ~conflict
     sp = b.spread
     sp_counts = None
@@ -492,6 +543,7 @@ def feasible_and_scores(
     node_ports: jnp.ndarray | None = None,
     spread_counts: jnp.ndarray | None = None,
     pa_sums: jnp.ndarray | None = None,
+    nominated_active: jnp.ndarray | None = None,
 ) -> tuple[jnp.ndarray, jnp.ndarray]:
     """The full Filter + Score composition for a batch against ONE snapshot
     state (no inter-pod capacity coupling — that is the assignment engine's
@@ -513,7 +565,7 @@ def feasible_and_scores(
         filter_components(
             b, p, requested=requested, pod_count=pod_count,
             node_ports=node_ports, spread_counts=spread_counts,
-            pa_sums=pa_sums,
+            pa_sums=pa_sums, nominated_active=nominated_active,
         )
     )
     mask = static

@@ -26,12 +26,15 @@ from ..api import types as t
 
 @dataclass(frozen=True)
 class NominatedPod:
-    """One nomination: pod identity + what it reserves where."""
+    """One nomination: pod identity + what it reserves where. ``ports``
+    carries the pod's host-port triples so the victim search can charge them
+    (the reference's AddPod includes the whole nominated pod)."""
 
     uid: str
     node_name: str
     priority: int
     requests: tuple[tuple[str, int], ...]
+    ports: tuple[tuple[int, str, str], ...] = ()
 
 
 class Nominator:
@@ -46,6 +49,11 @@ class Nominator:
             node_name=node_name,
             priority=pod.priority,
             requests=pod.requests,
+            ports=tuple(
+                (cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0")
+                for cp in pod.ports
+                if cp.host_port > 0
+            ),
         )
 
     def remove(self, uid: str) -> None:

@@ -111,6 +111,7 @@ class DefaultPreemptionPostFilter:
             pod_count=pod_count,
             spread_counts=final_state[4],
             pa_sums=final_state[5],
+            nominated_active=final_state[6],
         )
         ev.port_counts = ev.port_counts + (final_ports & ~snap_union)
         return ev

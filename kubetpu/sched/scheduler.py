@@ -33,6 +33,7 @@ from ..framework import runtime as rt
 from ..assign.greedy import greedy_assign_device
 from ..state.snapshot import Cache, Snapshot
 from ..queue import PriorityQueue, QueuedPodInfo
+from ..queue.priority_queue import pod_key
 from ..queue.events import (
     ActionType,
     ClusterEvent,
@@ -204,6 +205,9 @@ class Scheduler:
 
     def on_pod_delete(self, pod: t.Pod) -> None:
         self.nominator.remove(pod.uid)
+        # a preemptor deleted while awaiting victim deletes must not leave a
+        # stale pending-victims record for a later same-ns/name pod
+        self._preempting.pop(pod_key(pod), None)
         if pod.node_name or self.cache.is_assumed(pod.uid):
             self.cache.remove_pod(pod)
             # an assumed pod also lives in the queue's in-flight set until

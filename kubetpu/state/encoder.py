@@ -300,10 +300,13 @@ def _pod_port_triples(pod: t.Pod) -> list[tuple[int, str, str]]:
 def _encode_ports(
     nt: NodeTensors, pods: Sequence[t.Pod],
     pad_pods: int | None = None, pad_nodes: int | None = None,
+    extra_triples: Sequence[tuple[int, str, str]] = (),
 ) -> tuple[np.ndarray, np.ndarray, np.ndarray, Vocab]:
     """Intern port triples → (pod_ports (P,K), node_ports (N,K),
     port_conflict (K,K), vocab). K is at least 1 (all-False dummy) so
-    downstream einsums never see a zero axis."""
+    downstream einsums never see a zero axis. ``extra_triples`` (e.g. from
+    nominated pods not in this batch) join the vocab + conflict matrix so
+    callers can build their own rows against it."""
     vocab = Vocab()
     P, N = len(pods), nt.num_nodes
     pod_rows: list[list[int]] = []
@@ -316,6 +319,8 @@ def _encode_ports(
             for tr in _pod_port_triples(pod):
                 row.add(vocab.intern(tr))
         node_rows.append(sorted(row))
+    for tr in extra_triples:
+        vocab.intern(tr)
 
     K = max(len(vocab), 1)
     pod_ports = np.zeros((max(pad_pods or P, P), K), dtype=bool)
@@ -341,6 +346,7 @@ def encode_pod_batch(
     enabled_filters: frozenset[str] | None = None,
     pad_pods: int | None = None,
     enabled_scores: frozenset[str] | None = None,
+    extra_port_triples: Sequence[tuple[int, str, str]] = (),
 ) -> PodBatch:
     """``enabled_filters`` is the profile's Filter plugin set (names from
     ``kubetpu.names``); None enables everything. Disabled static predicates
@@ -495,7 +501,8 @@ def encode_pod_batch(
                 tt_raw[i, :N] = entry[1]
 
     pod_ports, node_ports, port_conflict, port_vocab = _encode_ports(
-        nt, pods, pad_pods=PP, pad_nodes=NC
+        nt, pods, pad_pods=PP, pad_nodes=NC,
+        extra_triples=extra_port_triples,
     )
     return PodBatch(
         pods=list(pods),

@@ -210,6 +210,208 @@ class TestKernelVsOracle:
         assert r0.node_name != r1.node_name
         assert set(r0.victim_uids).isdisjoint(r1.victim_uids)
 
+    def test_same_cycle_nominee_charge(self):
+        """An earlier preemptor's reservation is charged in later victim
+        searches of the SAME cycle (RunFilterPluginsWithNominatedPods inside
+        SelectVictimsOnNode, default_preemption.go:303): h1 must not kill v2
+        for room h0 already reserved."""
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod(
+            "v1", cpu_milli=500, priority=0, node_name="n0", creation_index=0
+        ))
+        cache.add_pod(make_pod(
+            "v2", cpu_milli=400, priority=0, node_name="n0", creation_index=1
+        ))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        highs = [
+            make_pod("h0", cpu_milli=550, priority=100, creation_index=2),
+            make_pod("h1", cpu_milli=700, priority=100, creation_index=3),
+        ]
+        batch = encode_batch(snap, highs, profile)
+        params = score_params(profile, batch.resource_names)
+        ev = PreemptionEvaluator(batch, params)
+        r0 = ev.preempt(0)
+        assert r0.status == "success"
+        assert [p.name for p in r0.victim_pods] == ["v1"]
+        # after h0: v1 dead (500 freed), h0 reserves 550 → 950 of 1000 spoken
+        # for; h1's 700 cannot fit even with v2 gone — killing v2 would be
+        # for room h1 can never obtain
+        r1 = ev.preempt(1)
+        assert r1.status != "success", "h1 killed a victim for reserved room"
+        assert not r1.victim_uids
+
+    def test_cross_cycle_nomination_charged(self):
+        """A nomination from a previous cycle with priority >= the preemptor
+        is charged to its node before the victim search."""
+        from kubetpu.queue.nominator import Nominator
+
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod("v2", cpu_milli=400, priority=0, node_name="n0"))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        nom = Nominator()
+        nom.add(make_pod("nominee", cpu_milli=550, priority=100), "n0")
+        preemptor = make_pod("h1", cpu_milli=700, priority=100)
+        batch = encode_batch(snap, [preemptor], profile, nominated=nom.entries())
+        params = score_params(profile, batch.resource_names)
+        ev = PreemptionEvaluator(batch, params)
+        r = ev.preempt(0)
+        assert r.status != "success", "victim killed for room a nominee holds"
+
+    def test_nominee_assigned_in_batch_not_double_charged(self):
+        """A nominee the current batch's greedy pass just assigned is in the
+        final-state usage already — its (now consumed) nomination must not be
+        charged again in the victim search (the reference deletes nominations
+        at assume, schedule_one.go:307)."""
+        from kubetpu.assign.greedy import greedy_assign_device
+        from kubetpu.queue.nominator import Nominator
+
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod(
+            "v", cpu_milli=300, priority=0, node_name="n0", creation_index=0
+        ))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        nominee = make_pod("nom", cpu_milli=600, priority=100, creation_index=1)
+        nom = Nominator()
+        nom.add(nominee, "n0")
+        h2 = make_pod("h2", cpu_milli=300, priority=100, creation_index=2)
+        batch = encode_batch(
+            snap, [nominee, h2], profile, nominated=nom.entries()
+        )
+        params = score_params(profile, batch.resource_names)
+        assignments, final_state = greedy_assign_device(batch.device, params)
+        a = np.asarray(assignments)
+        assert a[0] == 0 and a[1] == -1  # nominee lands on n0; h2 fails
+        ev = PreemptionEvaluator(
+            batch, params,
+            requested=np.asarray(final_state[0]),
+            pod_count=np.asarray(final_state[2]),
+            nominated_active=np.asarray(final_state[6]),
+        )
+        r = ev.preempt(1)
+        # with the phantom double charge the node would look 1500m-full and
+        # h2 would be declared unschedulable; actually killing v (300m) fits
+        assert r.status == "success"
+        assert [p.name for p in r.victim_pods] == ["v"]
+
+    def test_same_cycle_nominee_port_charge(self):
+        """A later same-batch preemptor with a conflicting hostPort must see
+        the earlier preemptor's port reservation (AddPod includes ports)."""
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod(
+            "v1", cpu_milli=100, priority=0, node_name="n0",
+            host_ports=[80], creation_index=0,
+        ))
+        cache.add_pod(make_pod(
+            "v2", cpu_milli=800, priority=0, node_name="n0", creation_index=1
+        ))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        highs = [
+            make_pod("h0", cpu_milli=100, priority=100, host_ports=[80],
+                     creation_index=2),
+            make_pod("h1", cpu_milli=700, priority=100, host_ports=[80],
+                     creation_index=3),
+        ]
+        batch = encode_batch(snap, highs, profile)
+        params = score_params(profile, batch.resource_names)
+        ev = PreemptionEvaluator(batch, params)
+        r0 = ev.preempt(0)
+        assert r0.status == "success"
+        assert [p.name for p in r0.victim_pods] == ["v1"]
+        # h0 now holds port 80 on n0; h1 must not kill v2 for a node it can
+        # never land on
+        r1 = ev.preempt(1)
+        assert r1.status != "success", "h1 ignored h0's port reservation"
+
+    def test_stale_nomination_dropped_when_pod_repreempts(self):
+        """When a pod with a prior-cycle nomination runs preemption again,
+        its old nomination stops being charged — otherwise the pod would be
+        double-charged on two nodes for the rest of the batch (the reference
+        updates nominatedNodeName, charging each pod once)."""
+        from kubetpu.queue.nominator import Nominator
+
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_node(make_node("n1", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod(
+            "v0", cpu_milli=900, priority=40, node_name="n0", creation_index=0
+        ))
+        cache.add_pod(make_pod(
+            "v1", cpu_milli=900, priority=0, node_name="n1", creation_index=1
+        ))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        x = make_pod("x", cpu_milli=800, priority=100, creation_index=2)
+        y = make_pod("y", cpu_milli=900, priority=50, creation_index=3)
+        nom = Nominator()
+        nom.add(x, "n0")  # stale: this cycle x will re-preempt onto n1
+        batch = encode_batch(snap, [x, y], profile, nominated=nom.entries())
+        params = score_params(profile, batch.resource_names)
+        ev = PreemptionEvaluator(batch, params)
+        rx = ev.preempt(0)
+        assert rx.status == "success"
+        assert rx.node_name == "n1"  # lowest highest-victim priority
+        # x is now charged on n1 only; y (prio 50 > v0's 40) must be able to
+        # preempt v0 on n0 — the stale n0 charge would have blocked it
+        ry = ev.preempt(1)
+        assert ry.status == "success"
+        assert ry.node_name == "n0"
+        assert [p.name for p in ry.victim_pods] == ["v0"]
+
+    def test_nominated_ports_block_scheduling_cycle(self):
+        """A nominee's host ports are reserved in the scheduling-cycle
+        NodePorts filter for >=-priority-gated pods — a lower-priority pod
+        must not bind the port out from under the nominee, while a
+        higher-priority pod may."""
+        from kubetpu.assign.greedy import greedy_assign_device
+        from kubetpu.queue.nominator import Nominator
+
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=4000, memory=2**32))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        nominee = make_pod("nom", cpu_milli=100, priority=100, host_ports=[80])
+        nom = Nominator()
+        nom.add(nominee, "n0")
+        lo = make_pod("lo", cpu_milli=100, priority=50, host_ports=[80],
+                      creation_index=0)
+        hi = make_pod("hi", cpu_milli=100, priority=200, host_ports=[80],
+                      creation_index=1)
+        batch = encode_batch(snap, [lo, hi], profile, nominated=nom.entries())
+        params = score_params(profile, batch.resource_names)
+        a = np.asarray(greedy_assign_device(batch.device, params)[0])
+        assert a[0] == -1, "lo stole the nominee's reserved hostPort"
+        # the >= gate excludes hi (prio 200 > nominee's 100): a
+        # higher-priority pod may ignore the reservation
+        assert a[1] == 0
+
+    def test_lower_priority_nomination_not_charged_in_victim_search(self):
+        """A LOWER-priority nomination does not block a higher-priority
+        preemptor (the >= gate excludes it) — same rule as the fit filter."""
+        from kubetpu.queue.nominator import Nominator
+
+        cache = Cache()
+        cache.add_node(make_node("n0", cpu_milli=1000, memory=2**30))
+        cache.add_pod(make_pod("v2", cpu_milli=400, priority=0, node_name="n0"))
+        profile = default_profile()
+        snap = cache.update_snapshot()
+        nom = Nominator()
+        nom.add(make_pod("nominee", cpu_milli=550, priority=50), "n0")
+        preemptor = make_pod("h1", cpu_milli=700, priority=100)
+        batch = encode_batch(snap, [preemptor], profile, nominated=nom.entries())
+        params = score_params(profile, batch.resource_names)
+        ev = PreemptionEvaluator(batch, params)
+        r = ev.preempt(0)
+        assert r.status == "success"
+        assert [p.name for p in r.victim_pods] == ["v2"]
+
     @pytest.mark.parametrize("seed", range(6))
     def test_randomized_parity(self, seed):
         rng = np.random.default_rng(seed)
@@ -509,4 +711,45 @@ class TestSchedulerIntegration:
         sched.dispatcher.sync()
         assert r["scheduled"] == 1
         assert ("vip", "n0") in bound
+        sched.close()
+
+    def test_deleted_preemptor_clears_pending_victim_record(self):
+        """Deleting a preemptor that awaits victim deletion must clear its
+        _preempting record — a recreated same-ns/name pod must not inherit
+        the stale pending state (eventhandlers deletePodFromSchedulingQueue
+        analog)."""
+        from kubetpu.queue.priority_queue import pod_key
+        from kubetpu.sched.scheduler import Scheduler
+
+        class Client:
+            sched = None
+
+            def bind(self, pod, node_name):
+                self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+            def patch_status(self, pod, reason, message=""):
+                pass
+
+            def delete_pod(self, pod, reason=""):
+                pass  # victim delete never delivered (terminating)
+
+            def nominate(self, pod, node_name):
+                pass
+
+        client = Client()
+        sched = Scheduler(client, profile=default_profile())
+        client.sched = sched
+        sched.enable_preemption()
+        sched.on_node_add(make_node("n0", cpu_milli=1000, memory=2**30))
+        sched.on_pod_add(make_pod(
+            "low", cpu_milli=900, priority=0, node_name="n0", creation_index=0
+        ))
+        high = make_pod("high", cpu_milli=800, priority=100, creation_index=1)
+        sched.on_pod_add(high)
+        sched.schedule_batch()
+        sched.dispatcher.sync()
+        assert pod_key(high) in sched._preempting
+        sched.on_pod_delete(high)
+        assert pod_key(high) not in sched._preempting
+        assert sched.nominator.get(high.uid) is None
         sched.close()
