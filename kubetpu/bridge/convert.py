@@ -1,0 +1,247 @@
+"""v1.Pod / v1.Node JSON → kubetpu typed objects.
+
+The extender webhook receives real Kubernetes API objects
+(staging/src/k8s.io/kube-scheduler/extender/v1/types.go ExtenderArgs carries
+``*v1.Pod`` and ``*v1.NodeList``); this module decodes the
+scheduling-relevant envelope into ``kubetpu.api.types`` dataclasses, using
+the same aggregation the reference applies (computePodResourceRequest,
+fit.go:317; NodeInfo.Resource canonical units).
+"""
+
+from __future__ import annotations
+
+import calendar
+import time
+from typing import Any, Mapping
+
+from ..api import types as t
+from ..api.requests import pod_nonzero_requests, pod_requests
+from .quantity import canonical_resource
+
+_JSON = Mapping[str, Any]
+
+
+def _requirements(exprs) -> tuple[t.Requirement, ...]:
+    out = []
+    for e in exprs or ():
+        out.append(
+            t.Requirement(
+                key=e.get("key", ""),
+                operator=t.Operator(e.get("operator", "In")),
+                values=tuple(e.get("values") or ()),
+            )
+        )
+    return tuple(out)
+
+
+def _label_selector(sel: _JSON | None) -> t.LabelSelector | None:
+    if sel is None:
+        return None
+    return t.LabelSelector(
+        match_labels=tuple(sorted((sel.get("matchLabels") or {}).items())),
+        match_expressions=_requirements(sel.get("matchExpressions")),
+    )
+
+
+def _node_selector_term(term: _JSON) -> t.NodeSelectorTerm:
+    return t.NodeSelectorTerm(
+        match_expressions=_requirements(term.get("matchExpressions")),
+        match_fields=_requirements(term.get("matchFields")),
+    )
+
+
+def _affinity(spec_affinity: _JSON | None) -> t.Affinity | None:
+    if not spec_affinity:
+        return None
+    na = pa = paa = None
+    if "nodeAffinity" in spec_affinity:
+        j = spec_affinity["nodeAffinity"] or {}
+        req = j.get("requiredDuringSchedulingIgnoredDuringExecution")
+        required = (
+            t.NodeSelector(
+                terms=tuple(
+                    _node_selector_term(term)
+                    for term in req.get("nodeSelectorTerms") or ()
+                )
+            )
+            if req is not None else None
+        )
+        preferred = tuple(
+            t.PreferredSchedulingTerm(
+                weight=int(p.get("weight", 0)),
+                term=_node_selector_term(p.get("preference") or {}),
+            )
+            for p in j.get("preferredDuringSchedulingIgnoredDuringExecution") or ()
+        )
+        na = t.NodeAffinity(required=required, preferred=preferred)
+
+    def pod_aff(j: _JSON | None) -> t.PodAffinity | None:
+        if not j:
+            return None
+        return t.PodAffinity(
+            required=tuple(
+                _pod_affinity_term(term)
+                for term in j.get("requiredDuringSchedulingIgnoredDuringExecution") or ()
+            ),
+            preferred=tuple(
+                t.WeightedPodAffinityTerm(
+                    weight=int(w.get("weight", 0)),
+                    term=_pod_affinity_term(w.get("podAffinityTerm") or {}),
+                )
+                for w in j.get("preferredDuringSchedulingIgnoredDuringExecution") or ()
+            ),
+        )
+
+    pa = pod_aff(spec_affinity.get("podAffinity"))
+    paa = pod_aff(spec_affinity.get("podAntiAffinity"))
+    if na is None and pa is None and paa is None:
+        return None
+    return t.Affinity(node_affinity=na, pod_affinity=pa, pod_anti_affinity=paa)
+
+
+def _pod_affinity_term(term: _JSON) -> t.PodAffinityTerm:
+    return t.PodAffinityTerm(
+        topology_key=term.get("topologyKey", ""),
+        selector=_label_selector(term.get("labelSelector")),
+        namespaces=tuple(term.get("namespaces") or ()),
+        namespace_selector=_label_selector(term.get("namespaceSelector")),
+    )
+
+
+def _tolerations(spec: _JSON) -> tuple[t.Toleration, ...]:
+    out = []
+    for j in spec.get("tolerations") or ():
+        effect = j.get("effect")
+        out.append(
+            t.Toleration(
+                key=j.get("key", ""),
+                operator=t.TolerationOperator(j.get("operator", "Equal")),
+                value=j.get("value", ""),
+                effect=t.TaintEffect(effect) if effect else None,
+            )
+        )
+    return tuple(out)
+
+
+def _spread(spec: _JSON) -> tuple[t.TopologySpreadConstraint, ...]:
+    out = []
+    for j in spec.get("topologySpreadConstraints") or ():
+        out.append(
+            t.TopologySpreadConstraint(
+                max_skew=int(j.get("maxSkew", 1)),
+                topology_key=j.get("topologyKey", ""),
+                when_unsatisfiable=t.UnsatisfiableConstraintAction(
+                    j.get("whenUnsatisfiable", "DoNotSchedule")
+                ),
+                selector=_label_selector(j.get("labelSelector")),
+                min_domains=j.get("minDomains"),
+                node_affinity_policy=j.get("nodeAffinityPolicy", "Honor"),
+                node_taints_policy=j.get("nodeTaintsPolicy", "Ignore"),
+                match_label_keys=tuple(j.get("matchLabelKeys") or ()),
+            )
+        )
+    return tuple(out)
+
+
+def _creation_index(meta: _JSON) -> int:
+    """creationTimestamp (RFC3339) → epoch seconds; the framework only needs
+    a monotone ordering for queue sort + victim importance."""
+    ts = meta.get("creationTimestamp")
+    if not ts:
+        return 0
+    try:
+        return calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return 0
+
+
+def _container_requests(c: _JSON) -> dict[str, int]:
+    req = ((c.get("resources") or {}).get("requests")) or {}
+    return {name: canonical_resource(name, q) for name, q in req.items()}
+
+
+def pod_from_v1(obj: _JSON) -> t.Pod:
+    """Decode a v1.Pod JSON object (the scheduling envelope)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    containers = [
+        _container_requests(c) for c in spec.get("containers") or ()
+    ]
+    init_containers = [
+        _container_requests(c) for c in spec.get("initContainers") or ()
+    ]
+    overhead = {
+        name: canonical_resource(name, q)
+        for name, q in (spec.get("overhead") or {}).items()
+    }
+    requests = pod_requests(containers, init_containers, overhead)
+    nonzero = pod_nonzero_requests(containers, init_containers, overhead)
+    ports = []
+    for c in spec.get("containers") or ():
+        for p in c.get("ports") or ():
+            hp = int(p.get("hostPort", 0) or 0)
+            if hp > 0:
+                ports.append(
+                    t.ContainerPort(
+                        host_port=hp,
+                        protocol=p.get("protocol", "TCP") or "TCP",
+                        host_ip=p.get("hostIP", "") or "",
+                    )
+                )
+    images = tuple(
+        c["image"] for c in spec.get("containers") or () if c.get("image")
+    )
+    return t.Pod(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default") or "default",
+        uid=meta.get("uid") or f"{meta.get('namespace', 'default')}/{meta.get('name', '')}",
+        labels=t.freeze_map(meta.get("labels")),
+        requests=t.freeze_map(requests),
+        nonzero=t.freeze_map(nonzero),
+        node_name=spec.get("nodeName", "") or "",
+        node_selector=t.freeze_map(spec.get("nodeSelector")),
+        affinity=_affinity(spec.get("affinity")),
+        tolerations=_tolerations(spec),
+        topology_spread_constraints=_spread(spec),
+        priority=int(spec.get("priority", 0) or 0),
+        ports=tuple(ports),
+        scheduling_gates=tuple(
+            g.get("name", "") for g in spec.get("schedulingGates") or ()
+        ),
+        images=images,
+        preemption_policy=spec.get("preemptionPolicy", "PreemptLowerPriority")
+        or "PreemptLowerPriority",
+        creation_index=_creation_index(meta),
+    )
+
+
+def node_from_v1(obj: _JSON) -> t.Node:
+    """Decode a v1.Node JSON object (the scheduling envelope)."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    alloc = {
+        name: canonical_resource(name, q)
+        for name, q in (status.get("allocatable") or {}).items()
+    }
+    taints = tuple(
+        t.Taint(
+            key=j.get("key", ""),
+            value=j.get("value", "") or "",
+            effect=t.TaintEffect(j.get("effect", "NoSchedule")),
+        )
+        for j in spec.get("taints") or ()
+    )
+    images: list[tuple[str, t.ImageState]] = []
+    for img in status.get("images") or ():
+        state = t.ImageState(size_bytes=int(img.get("sizeBytes", 0) or 0))
+        for name in img.get("names") or ():
+            images.append((name, state))
+    return t.Node(
+        name=meta.get("name", ""),
+        labels=t.freeze_map(meta.get("labels")),
+        allocatable=t.freeze_map(alloc),
+        taints=taints,
+        unschedulable=bool(spec.get("unschedulable", False)),
+        images=tuple(sorted(images)),
+    )

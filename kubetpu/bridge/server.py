@@ -1,0 +1,349 @@
+"""Scheduler-extender webhook server — the framework's primary integration
+seam with a real kube-scheduler.
+
+The reference scheduler calls extenders over JSON/HTTP POST
+(pkg/scheduler/extender.go:44 ``HTTPExtender``, ``send`` :399) from
+``findNodesThatPassExtenders`` (schedule_one.go:886, serial) and
+``prioritizeNodes`` (schedule_one.go:987, concurrent), with wire types from
+staging/src/k8s.io/kube-scheduler/extender/v1/types.go:73-132. This module
+is the *server* half: a real kube-scheduler configured with
+
+    extenders:
+    - urlPrefix: http://<this-host>:<port>
+      filterVerb: filter
+      prioritizeVerb: prioritize
+      bindVerb: bind            # optional
+      preemptVerb: preempt      # optional
+      weight: 5
+      nodeCacheCapable: true    # send node names, not full objects
+      ignorable: true           # health-gated CPU fallback (SURVEY §5)
+
+offloads Filter + Score to the TPU batch kernels. Field names follow Go's
+default (untagged) encoding: ``Pod``, ``Nodes``, ``NodeNames``,
+``FailedNodes``, ``FailedAndUnresolvableNodes``, ``Error``, ``Host``,
+``Score`` — Go's decoder is case-insensitive, but we emit the canonical
+spelling.
+
+Two node-state modes, as in the reference config
+(pkg/scheduler/apis/config/types.go:267 ``Extender.NodeCacheCapable``):
+
+- ``NodeCacheCapable=true``: requests carry only candidate node NAMES; node
+  and pod state comes from this server's cache, fed by the delta-ingestion
+  endpoints (``/cache/nodes``, ``/cache/pods`` — the host half of SURVEY
+  §2.9's delta streaming).
+- ``NodeCacheCapable=false``: requests carry full v1.Node objects; they are
+  decoded and used directly (pod-derived state is whatever the cache knows).
+
+``Ignorable`` is enforced by the *caller* (scheduler skips a dead extender,
+extender.go IsIgnorable); this server's contract is to always answer with a
+well-formed body whose ``Error`` field carries failures, so a non-ignorable
+configuration fails scheduling loudly rather than silently.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable
+
+import numpy as np
+
+from ..api import types as t
+from ..framework import config as C
+from ..framework import runtime as rt
+from ..state.snapshot import Cache
+from .convert import node_from_v1, pod_from_v1
+
+# MaxExtenderPriority (extender/v1/types.go:28): extender scores are 0..10;
+# the scheduler rescales by weight * MaxNodeScore / MaxExtenderPriority
+# (schedule_one.go:1015).
+MAX_EXTENDER_PRIORITY = 10
+
+
+class ExtenderBackend:
+    """Cache + profile + the device Filter/Score path behind the verbs."""
+
+    def __init__(
+        self,
+        profile: C.Profile | None = None,
+        bind_fn: Callable[[t.Pod, str], None] | None = None,
+    ) -> None:
+        self.profile = profile or C.minimal_profile()
+        self.cache = Cache()
+        self.lock = threading.Lock()
+        self._bind_fn = bind_fn
+
+    # ---- delta ingestion (NodeCacheCapable state) -----------------------
+
+    def upsert_nodes(self, nodes: list[t.Node]) -> None:
+        with self.lock:
+            for n in nodes:
+                self.cache.add_node(n)  # upsert (cache.add_node semantics)
+
+    def remove_nodes(self, names: list[str]) -> None:
+        with self.lock:
+            for name in names:
+                self.cache.remove_node(name)
+
+    def upsert_pods(self, pods: list[t.Pod]) -> None:
+        with self.lock:
+            for p in pods:
+                if p.node_name:
+                    self.cache.add_pod(p)  # replace-on-add
+                elif self.cache.has_pod(p.uid):
+                    self.cache.remove_pod(p)
+
+    def remove_pods(self, pods: list[t.Pod]) -> None:
+        with self.lock:
+            for p in pods:
+                if self.cache.has_pod(p.uid):
+                    self.cache.remove_pod(p)
+
+    # ---- verb implementations ------------------------------------------
+
+    def _encode(self, pod: t.Pod, extra_nodes: list[t.Node] | None):
+        """One-pod batch over the FULL cache view (extended by any
+        request-supplied nodes); callers restrict to the candidate set by
+        name when assembling the response."""
+        with self.lock:
+            if extra_nodes:
+                for n in extra_nodes:
+                    self.cache.add_node(n)
+            snap = self.cache.update_snapshot()
+            batch = rt.encode_batch(snap, [pod], self.profile)
+            params = rt.score_params(self.profile, batch.resource_names)
+        return batch, params
+
+    def filter(self, args: dict) -> dict:
+        """ExtenderArgs → ExtenderFilterResult. Distinguishes resolvable
+        failures (FailedNodes) from victim-independent ones
+        (FailedAndUnresolvableNodes — preemption cannot help;
+        extender/v1/types.go:96-99) via the split filter masks."""
+        pod = pod_from_v1(args.get("Pod") or {})
+        node_names, extra_nodes, cache_capable = self._candidates(args)
+        batch, params = self._encode(pod, extra_nodes)
+        b = batch.device
+        static, fit, ports_ok, spread_ok, pa_ok, _, _ = rt.filter_components(
+            b, params
+        )
+        unresolvable = ~static
+        for part in (spread_ok, pa_ok):
+            if part is not None:
+                unresolvable = unresolvable | ~part
+        resolvable_fail = np.zeros_like(np.asarray(unresolvable))
+        for part in (fit, ports_ok):
+            if part is not None:
+                resolvable_fail = resolvable_fail | ~np.asarray(part)
+        unresolvable = np.asarray(unresolvable)[0]
+        resolvable_fail = resolvable_fail[0]
+        wanted = node_names if node_names is not None else batch.node_names
+        name_to_idx = {n: i for i, n in enumerate(batch.node_names)}
+        passing: list[str] = []
+        failed: dict[str, str] = {}
+        failed_unresolvable: dict[str, str] = {}
+        for name in wanted:
+            i = name_to_idx.get(name)
+            if i is None or i >= batch.num_nodes:
+                failed[name] = "node not in extender cache"
+                continue
+            if unresolvable[i]:
+                failed_unresolvable[name] = "node(s) didn't satisfy plugin filters"
+            elif resolvable_fail[i]:
+                failed[name] = "node(s) had insufficient resources or ports"
+            else:
+                passing.append(name)
+        result: dict = {
+            "Nodes": None,
+            "NodeNames": None,
+            "FailedNodes": failed,
+            "FailedAndUnresolvableNodes": failed_unresolvable,
+            "Error": "",
+        }
+        if cache_capable:
+            result["NodeNames"] = passing
+        else:
+            items = [
+                n for n in (args.get("Nodes") or {}).get("Items") or []
+                if ((n.get("metadata") or {}).get("name")) in set(passing)
+            ]
+            result["Nodes"] = {"Items": items}
+        return result
+
+    def prioritize(self, args: dict) -> list[dict]:
+        """ExtenderArgs → HostPriorityList. Scores are normalized to the
+        0..MaxExtenderPriority contract (the scheduler multiplies by
+        weight*MaxNodeScore/MaxExtenderPriority, schedule_one.go:1015)."""
+        pod = pod_from_v1(args.get("Pod") or {})
+        node_names, extra_nodes, _ = self._candidates(args)
+        batch, params = self._encode(pod, extra_nodes)
+        mask, total = rt.filter_score_batch(batch.device, params)
+        mask = np.asarray(mask)[0]
+        total = np.asarray(total)[0]
+        wanted = node_names if node_names is not None else batch.node_names
+        name_to_idx = {n: i for i, n in enumerate(batch.node_names)}
+        idxs = [name_to_idx[n] for n in wanted if n in name_to_idx]
+        hi = max((int(total[i]) for i in idxs if mask[i]), default=0)
+        out = []
+        for name in wanted:
+            i = name_to_idx.get(name)
+            score = 0
+            if i is not None and i < batch.num_nodes and mask[i] and hi > 0:
+                score = int(total[i]) * MAX_EXTENDER_PRIORITY // hi
+            out.append({"Host": name, "Score": score})
+        return out
+
+    def bind(self, args: dict) -> dict:
+        """ExtenderBindingArgs → ExtenderBindingResult. Delegates the actual
+        API write to ``bind_fn`` (the reference extender calls
+        pods/binding itself, extender_test.go Bind); default records the
+        assignment in the local cache."""
+        name = args.get("PodName", "")
+        namespace = args.get("PodNamespace", "default")
+        uid = args.get("PodUID", "") or f"{namespace}/{name}"
+        node = args.get("Node", "")
+        try:
+            pod = t.Pod(name=name, namespace=namespace, uid=uid, node_name=node)
+            if self._bind_fn is not None:
+                self._bind_fn(pod, node)
+            else:
+                with self.lock:
+                    if not self.cache.has_node(node):
+                        raise KeyError(f"unknown node {node!r}")
+                    if self.cache.has_pod(uid):
+                        self.cache.remove_pod(pod)
+                    self.cache.add_pod(pod)
+            return {"Error": ""}
+        except Exception as e:  # report, never crash the webhook
+            return {"Error": str(e)}
+
+    def preempt(self, args: dict) -> dict:
+        """ExtenderPreemptionArgs → ExtenderPreemptionResult. Converts the
+        scheduler's proposed victim map to MetaVictims, dropping nodes this
+        extender's filters reject outright (the extender may only shrink the
+        candidate set — extender.go ProcessPreemption)."""
+        pod = pod_from_v1(args.get("Pod") or {})
+        victims = args.get("NodeNameToVictims") or {}
+        meta = args.get("NodeNameToMetaVictims") or {}
+        candidates = list(victims.keys() or meta.keys())
+        batch, params = self._encode(pod, None)
+        b = batch.device
+        static, *_ = rt.filter_components(b, params)
+        static = np.asarray(static)[0]
+        name_to_idx = {n: i for i, n in enumerate(batch.node_names)}
+        out: dict[str, dict] = {}
+        for node in candidates:
+            i = name_to_idx.get(node)
+            if i is None or not static[i]:
+                continue  # victim-independent failure: removal can't help
+            if node in meta:
+                out[node] = meta[node]
+            else:
+                v = victims.get(node) or {}
+                out[node] = {
+                    "Pods": [
+                        {"UID": (p.get("metadata") or {}).get("uid", "")}
+                        for p in v.get("Pods") or ()
+                    ],
+                    "NumPDBViolations": v.get("NumPDBViolations", 0),
+                }
+        return {"NodeNameToMetaVictims": out}
+
+    # ---- helpers --------------------------------------------------------
+
+    def _candidates(self, args: dict):
+        """(node_names | None, extra request nodes, cache_capable)."""
+        names = args.get("NodeNames")
+        if names is not None:
+            return list(names), None, True
+        items = (args.get("Nodes") or {}).get("Items") or []
+        nodes = [node_from_v1(j) for j in items]
+        return [n.name for n in nodes], nodes, False
+
+
+class _Handler(BaseHTTPRequestHandler):
+    backend: ExtenderBackend  # set by server factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:  # quiet by default
+        pass
+
+    def _read_json(self) -> dict:
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    def _reply(self, obj, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_POST(self) -> None:  # noqa: N802 (http.server API)
+        be = self.backend
+        path = self.path.rstrip("/")
+        try:
+            args = self._read_json()
+        except json.JSONDecodeError:
+            self._reply({"Error": "Decode error"}, status=400)
+            return
+        try:
+            if path.endswith("/filter"):
+                self._reply(be.filter(args))
+            elif path.endswith("/prioritize"):
+                self._reply(be.prioritize(args))
+            elif path.endswith("/bind"):
+                self._reply(be.bind(args))
+            elif path.endswith("/preempt"):
+                self._reply(be.preempt(args))
+            elif path.endswith("/cache/nodes"):
+                be.upsert_nodes([node_from_v1(j) for j in args.get("Nodes") or ()])
+                be.remove_nodes(list(args.get("Remove") or ()))
+                self._reply({"Error": ""})
+            elif path.endswith("/cache/pods"):
+                be.upsert_pods([pod_from_v1(j) for j in args.get("Pods") or ()])
+                be.remove_pods([pod_from_v1(j) for j in args.get("Remove") or ()])
+                self._reply({"Error": ""})
+            elif path.endswith("/healthz"):
+                self._reply({"ok": True})
+            else:
+                self._reply({"Error": f"Unknown verb {path!r}"}, status=404)
+        except Exception as e:
+            # a well-formed error body lets an Ignorable caller skip us
+            self._reply({"Error": f"{type(e).__name__}: {e}"}, status=500)
+
+    do_GET = do_POST
+
+
+class ExtenderServer:
+    """In-process webhook server (the httptest.NewServer analog the
+    reference integration tests use, extender_test.go:297)."""
+
+    def __init__(
+        self,
+        backend: ExtenderBackend | None = None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+    ) -> None:
+        self.backend = backend or ExtenderBackend()
+        handler = type("BoundHandler", (_Handler,), {"backend": self.backend})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "ExtenderServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

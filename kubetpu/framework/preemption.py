@@ -93,9 +93,13 @@ class PreemptionEvaluator:
         # Nomination charging state. ``nominated_active`` (G,) marks
         # nominations NOT consumed by this batch's own greedy pass (a nominee
         # the scan just assigned is already in `requested` — charging its
-        # nomination again would double-count). Host copies hoisted once;
-        # they never change over the evaluator's lifetime.
+        # nomination again would double-count). The _nom_node/_nom_req/
+        # _nom_gate/_nom_pod_idx/_nom_ports host copies are hoisted once and
+        # never change; _nom_active IS mutated by each preempt() call (stale
+        # nominations drop as their pods re-preempt).
         b = batch.device
+        self._pod_requests = np.asarray(jax.device_get(b.requests))
+        self._pod_ports = np.asarray(jax.device_get(b.pod_ports))
         if b.nominated_node is not None:
             self._nom_node = np.asarray(jax.device_get(b.nominated_node))
             self._nom_req = np.asarray(jax.device_get(b.nominated_req))
@@ -247,16 +251,13 @@ class PreemptionEvaluator:
             self.pdb_allowed -= v.pdb[n, k].astype(np.int64)
             v.valid[n, k] = False
         if preemptor_index is not None:
-            b = self.batch.device
-            self.requested[n] += np.asarray(
-                jax.device_get(b.requests[preemptor_index])
-            )
+            self.requested[n] += self._pod_requests[preemptor_index]
             self.pod_count[n] += 1
             # ports too: a later same-batch preemptor with a conflicting
             # hostPort must not also be nominated here
-            self.port_counts[n] += np.asarray(
-                jax.device_get(b.pod_ports[preemptor_index])
-            ).astype(self.port_counts.dtype)
+            self.port_counts[n] += self._pod_ports[preemptor_index].astype(
+                self.port_counts.dtype
+            )
 
 
 def _one_pod_view(b: rt.DeviceBatch, i: int) -> rt.DeviceBatch:

@@ -44,16 +44,14 @@ class Nominator:
         self._by_uid: dict[str, NominatedPod] = {}
 
     def add(self, pod: t.Pod, node_name: str) -> None:
+        from ..state.encoder import _pod_port_triples
+
         self._by_uid[pod.uid] = NominatedPod(
             uid=pod.uid,
             node_name=node_name,
             priority=pod.priority,
             requests=pod.requests,
-            ports=tuple(
-                (cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0")
-                for cp in pod.ports
-                if cp.host_port > 0
-            ),
+            ports=tuple(_pod_port_triples(pod)),
         )
 
     def remove(self, uid: str) -> None:

@@ -120,6 +120,9 @@ class Cache:
     def update_node(self, node: t.Node) -> None:
         self.add_node(node)
 
+    def has_node(self, name: str) -> bool:
+        return name in self._nodes
+
     def remove_node(self, name: str) -> None:
         """cache.go RemoveNode semantics: the NodeInfo must survive while pods
         are still assigned to it (pod deletes arrive on a different watch);

@@ -1,0 +1,362 @@
+"""Extender webhook bridge tests — in-process HTTP server speaking the
+extender/v1 JSON protocol, mirroring the reference's integration harness
+(test/integration/scheduler/extender/extender_test.go:297-335 runs extenders
+as httptest servers and drives them through real HTTP)."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.bridge import (
+    ExtenderBackend,
+    ExtenderServer,
+    node_from_v1,
+    parse_quantity,
+    pod_from_v1,
+    quantity_to_int,
+    quantity_to_milli,
+)
+from kubetpu.framework import config as C
+
+
+# ---------------------------------------------------------------------------
+# quantity parsing (apimachinery resource.Quantity envelope)
+# ---------------------------------------------------------------------------
+
+class TestQuantity:
+    @pytest.mark.parametrize("s,milli", [
+        ("100m", 100), ("1", 1000), ("2", 2000), ("0.5", 500),
+        ("1500m", 1500), ("2.5", 2500), ("0.1", 100),
+    ])
+    def test_cpu_milli(self, s, milli):
+        assert quantity_to_milli(s) == milli
+
+    @pytest.mark.parametrize("s,val", [
+        ("128974848", 128974848),
+        ("129e6", 129000000),
+        ("129M", 129000000),
+        ("123Mi", 123 * 1024**2),
+        ("1Gi", 1024**3),
+        ("1G", 10**9),
+        ("64Ki", 64 * 1024),
+        ("1Ti", 1024**4),
+        ("5", 5),
+        ("1k", 1000),
+    ])
+    def test_memory_bytes(self, s, val):
+        assert quantity_to_int(s) == val
+
+    def test_value_rounds_up(self):
+        # quantity.go Value(): ceil — 1500m as an integer value is 2
+        assert quantity_to_int("1500m") == 2
+
+    def test_exponent_vs_exa_suffix(self):
+        assert parse_quantity("2E") == 2 * 10**18
+        assert parse_quantity("2e3") == 2000
+
+
+# ---------------------------------------------------------------------------
+# v1 object conversion
+# ---------------------------------------------------------------------------
+
+V1_POD = {
+    "metadata": {
+        "name": "web-1",
+        "namespace": "prod",
+        "uid": "uid-web-1",
+        "labels": {"app": "web"},
+        "creationTimestamp": "2026-01-02T03:04:05Z",
+    },
+    "spec": {
+        "priority": 10,
+        "nodeSelector": {"disktype": "ssd"},
+        "containers": [
+            {
+                "name": "c1",
+                "image": "nginx:1.25",
+                "resources": {"requests": {"cpu": "500m", "memory": "256Mi"}},
+                "ports": [{"containerPort": 80, "hostPort": 8080}],
+            },
+            {
+                "name": "c2",
+                "resources": {"requests": {"cpu": "250m"}},
+            },
+        ],
+        "tolerations": [
+            {"key": "dedicated", "operator": "Equal", "value": "gpu",
+             "effect": "NoSchedule"},
+        ],
+        "affinity": {
+            "nodeAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": {
+                    "nodeSelectorTerms": [
+                        {"matchExpressions": [
+                            {"key": "zone", "operator": "In", "values": ["a", "b"]},
+                        ]},
+                    ]
+                }
+            },
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [
+                    {"topologyKey": "kubernetes.io/hostname",
+                     "labelSelector": {"matchLabels": {"app": "web"}}},
+                ]
+            },
+        },
+        "topologySpreadConstraints": [
+            {"maxSkew": 2, "topologyKey": "topology.kubernetes.io/zone",
+             "whenUnsatisfiable": "DoNotSchedule",
+             "labelSelector": {"matchLabels": {"app": "web"}}},
+        ],
+    },
+}
+
+V1_NODE = {
+    "metadata": {
+        "name": "node-a",
+        "labels": {"disktype": "ssd", "zone": "a"},
+    },
+    "spec": {
+        "taints": [{"key": "dedicated", "value": "gpu", "effect": "NoSchedule"}],
+    },
+    "status": {
+        "allocatable": {"cpu": "4", "memory": "16Gi", "pods": "110"},
+        "images": [{"names": ["nginx:1.25"], "sizeBytes": 50000000}],
+    },
+}
+
+
+class TestConvert:
+    def test_pod_round_trip(self):
+        p = pod_from_v1(V1_POD)
+        assert (p.name, p.namespace, p.uid) == ("web-1", "prod", "uid-web-1")
+        assert p.requests_dict() == {
+            "cpu": 750, "memory": 256 * 1024**2,
+        }
+        # NonZero: c2 has no memory request → +200MiB default for c2
+        assert p.nonzero_requests()["memory"] == 256 * 1024**2 + 200 * 1024**2
+        assert p.priority == 10
+        assert dict(p.node_selector) == {"disktype": "ssd"}
+        assert p.ports[0].host_port == 8080
+        assert p.tolerations[0].key == "dedicated"
+        assert p.affinity.node_affinity.required.terms[0].match_expressions[0].values == ("a", "b")
+        assert p.affinity.pod_anti_affinity.required[0].topology_key == "kubernetes.io/hostname"
+        assert p.topology_spread_constraints[0].max_skew == 2
+        assert p.images == ("nginx:1.25",)
+        assert p.creation_index == 1767323045
+
+    def test_node_round_trip(self):
+        n = node_from_v1(V1_NODE)
+        assert n.name == "node-a"
+        assert n.allocatable_dict() == {
+            "cpu": 4000, "memory": 16 * 1024**3, "pods": 110,
+        }
+        assert n.taints[0] == t.Taint(
+            key="dedicated", value="gpu", effect=t.TaintEffect.NO_SCHEDULE
+        )
+        assert n.labels_dict()["zone"] == "a"
+        assert n.images[0][0] == "nginx:1.25"
+
+
+# ---------------------------------------------------------------------------
+# webhook end-to-end (HTTP)
+# ---------------------------------------------------------------------------
+
+def _post(url: str, body: dict):
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST",
+    )
+    with urllib.request.urlopen(req, timeout=30) as resp:
+        return json.loads(resp.read())
+
+
+def _v1_node(name: str, cpu="4", memory="16Gi", labels=None, unschedulable=False):
+    return {
+        "metadata": {"name": name, "labels": labels or {}},
+        "spec": {"unschedulable": unschedulable},
+        "status": {"allocatable": {"cpu": cpu, "memory": memory, "pods": "110"}},
+    }
+
+
+def _v1_pod(name: str, cpu="1", memory="1Gi", namespace="default", node=None):
+    obj = {
+        "metadata": {"name": name, "namespace": namespace,
+                     "uid": f"{namespace}/{name}"},
+        "spec": {
+            "containers": [
+                {"name": "c", "resources": {
+                    "requests": {"cpu": cpu, "memory": memory}}},
+            ],
+        },
+    }
+    if node:
+        obj["spec"]["nodeName"] = node
+    return obj
+
+
+@pytest.fixture()
+def server():
+    srv = ExtenderServer(ExtenderBackend(profile=C.Profile())).start()
+    yield srv
+    srv.close()
+
+
+class TestWebhook:
+    def test_filter_node_cache_capable(self, server):
+        # ingest node deltas, then filter by name (NodeCacheCapable=true)
+        _post(server.url + "/cache/nodes", {"Nodes": [
+            _v1_node("n0", cpu="4"),
+            _v1_node("n1", cpu="1"),          # too small for a 2-cpu pod
+            _v1_node("n2", cpu="4", unschedulable=True),
+        ]})
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("p", cpu="2"),
+            "NodeNames": ["n0", "n1", "n2", "ghost"],
+        })
+        assert res["NodeNames"] == ["n0"]
+        assert res["Nodes"] is None
+        assert "n1" in res["FailedNodes"]
+        # unschedulable is a victim-independent failure: preemption can't fix
+        assert "n2" in res["FailedAndUnresolvableNodes"]
+        assert "ghost" in res["FailedNodes"]
+        assert res["Error"] == ""
+
+    def test_filter_full_node_list(self, server):
+        # NodeCacheCapable=false: full v1.Node objects in, subset out
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("p", cpu="2"),
+            "Nodes": {"Items": [_v1_node("m0", cpu="4"), _v1_node("m1", cpu="1")]},
+        })
+        names = [n["metadata"]["name"] for n in res["Nodes"]["Items"]]
+        assert names == ["m0"]
+        assert res["NodeNames"] is None
+        assert "m1" in res["FailedNodes"]
+
+    def test_prioritize_host_priority_list(self, server):
+        _post(server.url + "/cache/nodes", {"Nodes": [
+            _v1_node("n0", cpu="4"), _v1_node("n1", cpu="8"),
+        ]})
+        # one existing pod loads n0 → LeastAllocated prefers n1
+        _post(server.url + "/cache/pods", {"Pods": [
+            _v1_pod("busy", cpu="3", node="n0"),
+        ]})
+        res = _post(server.url + "/prioritize", {
+            "Pod": _v1_pod("p", cpu="1"),
+            "NodeNames": ["n0", "n1"],
+        })
+        scores = {h["Host"]: h["Score"] for h in res}
+        assert set(scores) == {"n0", "n1"}
+        assert all(0 <= s <= 10 for s in scores.values())  # MaxExtenderPriority
+        assert scores["n1"] > scores["n0"]
+
+    def test_bind_updates_cache(self, server):
+        _post(server.url + "/cache/nodes", {"Nodes": [_v1_node("n0")]})
+        res = _post(server.url + "/bind", {
+            "PodName": "p", "PodNamespace": "default",
+            "PodUID": "default/p", "Node": "n0",
+        })
+        assert res["Error"] == ""
+        be = server.backend
+        assert be.cache.has_pod("default/p")
+        # a second filter sees the bound pod's usage
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("q", cpu="1"), "NodeNames": ["n0"]})
+        assert res["NodeNames"] == ["n0"]
+
+    def test_bind_unknown_node_reports_error(self, server):
+        res = _post(server.url + "/bind", {
+            "PodName": "p", "PodNamespace": "default",
+            "PodUID": "default/p", "Node": "nope",
+        })
+        assert "nope" in res["Error"]
+
+    def test_preempt_filters_victim_map(self, server):
+        _post(server.url + "/cache/nodes", {"Nodes": [
+            _v1_node("n0"), _v1_node("n1", unschedulable=True),
+        ]})
+        res = _post(server.url + "/preempt", {
+            "Pod": _v1_pod("p", cpu="1"),
+            "NodeNameToVictims": {
+                "n0": {"Pods": [{"metadata": {"uid": "u1"}}],
+                       "NumPDBViolations": 0},
+                "n1": {"Pods": [{"metadata": {"uid": "u2"}}],
+                       "NumPDBViolations": 0},
+            },
+        })
+        out = res["NodeNameToMetaVictims"]
+        assert "n0" in out and out["n0"]["Pods"][0]["UID"] == "u1"
+        assert "n1" not in out   # unschedulable: victims can't help
+
+    def test_unknown_verb_404_and_error_body(self, server):
+        req = urllib.request.Request(
+            server.url + "/frobnicate", data=b"{}", method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = True
+            body = json.loads(e.read())
+            assert e.code == 404
+            assert "Unknown verb" in body["Error"]
+        assert raised
+
+    def test_malformed_json_is_a_well_formed_error(self, server):
+        # an Ignorable caller must get a decodable body, not a crash
+        req = urllib.request.Request(
+            server.url + "/filter", data=b"{nope", method="POST"
+        )
+        try:
+            urllib.request.urlopen(req, timeout=30)
+            raised = False
+        except urllib.error.HTTPError as e:
+            raised = True
+            assert json.loads(e.read())["Error"] == "Decode error"
+        assert raised
+
+    def test_cache_node_removal(self, server):
+        _post(server.url + "/cache/nodes", {"Nodes": [_v1_node("n0")]})
+        _post(server.url + "/cache/nodes", {"Remove": ["n0"]})
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("p"), "NodeNames": ["n0"]})
+        assert res["NodeNames"] == []
+        assert "n0" in res["FailedNodes"]
+
+    def test_filter_parity_with_direct_kernels(self, server):
+        """The HTTP path must agree with calling the kernels directly."""
+        from kubetpu.api.wrappers import make_node, make_pod
+        from kubetpu.assign import greedy_assign
+        from kubetpu.framework import encode_batch
+        from kubetpu.state import Cache
+
+        nodes_v1 = [
+            _v1_node(f"n{i}", cpu=str(2 + i % 3), labels={"zone": "z%d" % (i % 2)})
+            for i in range(12)
+        ]
+        _post(server.url + "/cache/nodes", {"Nodes": nodes_v1})
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("p", cpu="3"),
+            "NodeNames": [f"n{i}" for i in range(12)],
+        })
+        cache = Cache()
+        for nv in nodes_v1:
+            cache.add_node(node_from_v1(nv))
+        pod = pod_from_v1(_v1_pod("p", cpu="3"))
+        profile = C.Profile()
+        batch = encode_batch(cache.update_snapshot(), [pod], profile)
+        from kubetpu.framework import runtime as rt, score_params
+        mask, _ = rt.filter_score_batch(
+            batch.device, score_params(profile, batch.resource_names)
+        )
+        direct = {
+            batch.node_names[i]
+            for i in range(batch.num_nodes)
+            if np.asarray(mask)[0][i]
+        }
+        assert set(res["NodeNames"]) == direct
