@@ -73,6 +73,19 @@ class ExtenderBackend:
         self.cache = Cache()
         self.lock = threading.Lock()
         self._bind_fn = bind_fn
+        # persistent snapshot: update_snapshot(self._snapshot) re-clones only
+        # NodeInfos whose generation moved, so an unchanged cache costs O(Δ)
+        # per webhook hit (cache.go:190 UpdateSnapshot semantics)
+        self._snapshot = None
+        # pods seen in filter/prioritize args, by uid — bind args carry only
+        # the pod's identity (ExtenderBindingArgs), so the real requests for
+        # cache accounting come from the preceding scheduling call
+        import collections
+
+        self._seen_pods: "collections.OrderedDict[str, t.Pod]" = (
+            collections.OrderedDict()
+        )
+        self._seen_cap = 16384
 
     # ---- delta ingestion (NodeCacheCapable state) -----------------------
 
@@ -102,15 +115,38 @@ class ExtenderBackend:
 
     # ---- verb implementations ------------------------------------------
 
+    def _remember(self, pod: t.Pod) -> None:
+        self._seen_pods[pod.uid] = pod
+        self._seen_pods.move_to_end(pod.uid)
+        while len(self._seen_pods) > self._seen_cap:
+            self._seen_pods.popitem(last=False)
+
     def _encode(self, pod: t.Pod, extra_nodes: list[t.Node] | None):
-        """One-pod batch over the FULL cache view (extended by any
-        request-supplied nodes); callers restrict to the candidate set by
-        name when assembling the response."""
+        """One-pod batch. NodeCacheCapable mode encodes the shared cache
+        (incremental snapshot); non-cache mode builds an EPHEMERAL view of
+        exactly the request's nodes (+ any pod state the shared cache holds
+        for them) so request-supplied nodes never pollute the shared cache.
+        Callers restrict to the candidate set by name when assembling the
+        response."""
         with self.lock:
+            self._remember(pod)
             if extra_nodes:
+                tmp = Cache()
+                self._snapshot = self.cache.update_snapshot(self._snapshot)
+                shared = {
+                    info.node.name: info
+                    for info in self._snapshot.node_infos()
+                }
                 for n in extra_nodes:
-                    self.cache.add_node(n)
-            snap = self.cache.update_snapshot()
+                    tmp.add_node(n)
+                    info = shared.get(n.name)
+                    if info is not None:
+                        for p in info.pods.values():
+                            tmp.add_pod(p)
+                snap = tmp.update_snapshot()
+            else:
+                self._snapshot = self.cache.update_snapshot(self._snapshot)
+                snap = self._snapshot
             batch = rt.encode_batch(snap, [pod], self.profile)
             params = rt.score_params(self.profile, batch.resource_names)
         return batch, params
@@ -163,9 +199,10 @@ class ExtenderBackend:
         if cache_capable:
             result["NodeNames"] = passing
         else:
+            passing_set = set(passing)
             items = [
                 n for n in (args.get("Nodes") or {}).get("Items") or []
-                if ((n.get("metadata") or {}).get("name")) in set(passing)
+                if ((n.get("metadata") or {}).get("name")) in passing_set
             ]
             result["Nodes"] = {"Items": items}
         return result
@@ -203,7 +240,16 @@ class ExtenderBackend:
         uid = args.get("PodUID", "") or f"{namespace}/{name}"
         node = args.get("Node", "")
         try:
-            pod = t.Pod(name=name, namespace=namespace, uid=uid, node_name=node)
+            # bind args carry only identity; recover the real spec (requests,
+            # labels, ports) from the preceding filter/prioritize call so the
+            # cache accounting is correct, not a zero-request placeholder
+            seen = self._seen_pods.get(uid)
+            if seen is not None:
+                pod = seen.with_node(node)
+            else:
+                pod = t.Pod(
+                    name=name, namespace=namespace, uid=uid, node_name=node
+                )
             if self._bind_fn is not None:
                 self._bind_fn(pod, node)
             else:

@@ -1,0 +1,14 @@
+"""scheduler_perf analog: op-list workloads driving the real scheduler loop
+(test/integration/scheduler_perf)."""
+
+from .runner import WorkloadResult, run_label, run_workload
+from .workloads import TEST_CASES, TestCase, Workload
+
+__all__ = [
+    "TEST_CASES",
+    "TestCase",
+    "Workload",
+    "WorkloadResult",
+    "run_label",
+    "run_workload",
+]

@@ -1,0 +1,50 @@
+"""CLI: python -m kubetpu.perf [--case NAME] [--workload NAME] [--label L]
+
+Prints one JSON line per workload result (the perf-dash-style emission the
+reference's benchmark mode produces)."""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from . import TEST_CASES, run_label, run_workload
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--case", help="test case name (see --list)")
+    ap.add_argument("--workload", help="workload name within the case")
+    ap.add_argument("--label", default=None,
+                    help="run all workloads with this label (e.g. performance)")
+    ap.add_argument("--list", action="store_true")
+    ap.add_argument("--max-batch", type=int, default=1024)
+    ap.add_argument("--timeout", type=float, default=1800.0)
+    args = ap.parse_args()
+
+    if args.list:
+        for case in TEST_CASES.values():
+            for wl in case.workloads:
+                extra = f" threshold={wl.threshold}" if wl.threshold else ""
+                print(f"{case.name}/{wl.name}{extra} {list(wl.labels)}")
+        return
+
+    if args.label:
+        for r in run_label(args.label, max_batch=args.max_batch,
+                           timeout_s=args.timeout):
+            print(json.dumps(r.to_json()))
+        return
+
+    case = TEST_CASES[args.case]
+    workloads = (
+        [w for w in case.workloads if w.name == args.workload]
+        if args.workload else list(case.workloads)
+    )
+    for wl in workloads:
+        r = run_workload(case, wl, max_batch=args.max_batch,
+                         timeout_s=args.timeout)
+        print(json.dumps(r.to_json()))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,259 @@
+"""scheduler_perf runner — drive the REAL scheduler loop through an op list.
+
+The reference harness (test/integration/scheduler_perf/scheduler_perf.go:756)
+boots apiserver+etcd+scheduler in one process, feeds API objects, and
+measures SchedulingThroughput at bind time. Here the same op lists drive the
+full kubetpu ``Scheduler`` — queue (backoff/hints), cache/snapshot, encode,
+device greedy scan, async dispatcher — through its informer seam; no HTTP
+hop, same semantics.
+
+Threading note: the Scheduler is single-owner (informer callbacks + loop on
+one thread), so churn is injected *synchronously* between cycles on the
+loop thread, clocked by elapsed wall time against the op's
+``intervalMilliseconds`` — equivalent to the reference's churn goroutine
+observed at cycle boundaries.
+
+Throughput definition: measured-phase scheduled pods / measured-phase wall
+seconds — the average the reference's threshold selector asserts on
+(scheduler_perf.go:352-359 "SchedulingThroughput / Average"; collector
+util.go:468 samples scheduled-pod deltas every second and averages).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..api import types as t
+from ..framework import config as C
+from ..sched.scheduler import Scheduler
+from . import workloads as W
+
+
+@dataclass
+class WorkloadResult:
+    case_name: str
+    workload_name: str
+    threshold: float | None
+    measure_pods: int
+    scheduled: int
+    duration_s: float
+    throughput: float                 # pods/s, the SchedulingThroughput avg
+    vs_threshold: float | None        # throughput / threshold
+    attempts: int
+    cycles: int
+    p99_attempt_latency_ms: float | None = None
+
+    def to_json(self) -> dict:
+        out = {
+            "case": self.case_name,
+            "workload": self.workload_name,
+            "metric": "SchedulingThroughput/Average",
+            "value": round(self.throughput, 1),
+            "unit": "pods/s",
+            "scheduled": self.scheduled,
+            "measure_pods": self.measure_pods,
+            "duration_s": round(self.duration_s, 3),
+            "attempts": self.attempts,
+            "cycles": self.cycles,
+        }
+        if self.threshold is not None:
+            out["threshold"] = self.threshold
+            out["vs_baseline"] = round(self.vs_threshold, 2)
+        if self.p99_attempt_latency_ms is not None:
+            out["p99_attempt_latency_ms"] = round(self.p99_attempt_latency_ms, 2)
+        return out
+
+
+class _Client:
+    """API-server stand-in: dispatcher calls land here; bind/delete feed the
+    informer handlers back on the loop thread via a pending queue (the
+    watch-event delivery the reference gets from the apiserver)."""
+
+    def __init__(self) -> None:
+        self.sched: Scheduler | None = None
+        self.bound: list[tuple[str, str]] = []
+        import collections
+        self._events: "collections.deque" = collections.deque()
+
+    def bind(self, pod: t.Pod, node_name: str) -> None:
+        self.bound.append((pod.name, node_name))
+        self._events.append(("update", pod, pod.with_node(node_name)))
+
+    def delete_pod(self, pod: t.Pod, reason: str = "") -> None:
+        self._events.append(("delete", pod, None))
+
+    def patch_status(self, pod: t.Pod, reason: str, message: str = "") -> None:
+        pass
+
+    def nominate(self, pod: t.Pod, node_name: str) -> None:
+        pass
+
+    def deliver(self) -> None:
+        """Drain informer events on the loop thread."""
+        while True:
+            try:
+                kind, a, b = self._events.popleft()
+            except IndexError:
+                return
+            if kind == "update":
+                self.sched.on_pod_update(a, b)
+            else:
+                self.sched.on_pod_delete(a)
+
+
+@dataclass
+class _Churn:
+    op: W.ChurnOp
+    namespace: str
+    next_at: float = 0.0
+    seq: int = 0
+    live: list = field(default_factory=list)   # recreate-mode pool
+
+    def maybe_fire(self, sched: Scheduler, now: float) -> None:
+        while now >= self.next_at:
+            self.next_at = (self.next_at or now) + self.op.interval_ms / 1000.0
+            if self.op.mode == "recreate" and self.op.number and (
+                len(self.live) >= self.op.number
+            ):
+                victim = self.live.pop(0)
+                sched.on_pod_delete(victim)
+            pod = self.op.template(f"churn-{self.seq}", self.namespace)
+            self.seq += 1
+            sched.on_pod_add(pod)
+            if self.op.mode == "recreate":
+                self.live.append(pod)
+
+
+def run_workload(
+    case: W.TestCase | str,
+    workload: W.Workload | str,
+    profile: C.Profile | None = None,
+    max_batch: int = 1024,
+    timeout_s: float = 1800.0,
+) -> WorkloadResult:
+    """Execute one (test case, workload) pair and return the measurement."""
+    if isinstance(case, str):
+        case = W.TEST_CASES[case]
+    if isinstance(workload, str):
+        workload = next(w for w in case.workloads if w.name == workload)
+    params = dict(workload.params)
+
+    client = _Client()
+    sched = Scheduler(
+        client, profile=profile or C.Profile(), max_batch=max_batch
+    )
+    client.sched = sched
+    sched.enable_preemption()
+
+    churns: list[_Churn] = []
+    measured = 0
+    duration = 0.0
+    attempts0 = cycles0 = 0
+    op_ns_counter = 0
+
+    def settle(target: int, measure: bool) -> tuple[int, float]:
+        """Run cycles until ``target`` pods scheduled (or stall). Churn fires
+        between cycles. Returns (scheduled, wall seconds)."""
+        nonlocal measured
+        done = 0
+        idle = 0
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        while done < target:
+            now = time.perf_counter()
+            if now > deadline:
+                break
+            for ch in churns:
+                ch.maybe_fire(sched, now)
+            res = sched.schedule_batch()
+            client.deliver()
+            done_this = res["scheduled"]
+            done += done_this
+            if done_this == 0:
+                idle += 1
+                if idle > 200:
+                    break  # stalled (nothing schedulable): partial result
+                # let backoff clocks advance without spinning hot
+                time.sleep(0.005)
+            else:
+                idle = 0
+        return done, time.perf_counter() - t0
+
+    for op_i, op in enumerate(case.ops):
+        if isinstance(op, W.CreateNodesOp):
+            n = params[op.count_param]
+            for i in range(n):
+                sched.on_node_add(W.node_default(i, op.zones))
+        elif isinstance(op, W.CreateNamespacesOp):
+            pass  # namespaces exist implicitly; ops reference them by name
+        elif isinstance(op, W.ChurnOp):
+            churns.append(_Churn(op=op, namespace=f"churn-{len(churns)}"))
+        elif isinstance(op, W.BarrierOp):
+            sched.run_until_idle()
+            client.deliver()
+        elif isinstance(op, W.CreatePodsOp):
+            count = params[op.count_param]
+            template = op.template or case.default_pod_template
+            ns = op.namespace or f"namespace-{op_ns_counter}"
+            op_ns_counter += 1
+            # the op index keeps names unique when several createPods ops
+            # share one namespace (MixedSchedulingBasePod does)
+            prefix = f"{'measure' if op.collect_metrics else 'init'}-{op_i}"
+            if op.collect_metrics:
+                attempts0 = sched.metrics.schedule_attempts
+                cycles0 = sched.metrics.cycles
+            for j in range(count):
+                pod = template(f"{prefix}-{ns}-{j}", ns)
+                sched.on_pod_add(pod)
+            done, secs = settle(count, op.collect_metrics)
+            if op.collect_metrics:
+                measured += done
+                duration += secs
+        else:
+            raise TypeError(f"unknown op {op!r}")
+
+    sched.dispatcher.sync()
+    client.deliver()
+    sched._drain_bind_completions()
+    lat = None
+    if sched.metrics.attempt_latencies:
+        lat = float(
+            np.percentile(np.asarray(sched.metrics.attempt_latencies), 99)
+            * 1000.0
+        )
+    throughput = measured / duration if duration > 0 else 0.0
+    result = WorkloadResult(
+        case_name=case.name,
+        workload_name=workload.name,
+        threshold=workload.threshold,
+        measure_pods=sum(
+            params[op.count_param]
+            for op in case.ops
+            if isinstance(op, W.CreatePodsOp) and op.collect_metrics
+        ),
+        scheduled=measured,
+        duration_s=duration,
+        throughput=throughput,
+        vs_threshold=(
+            throughput / workload.threshold if workload.threshold else None
+        ),
+        attempts=sched.metrics.schedule_attempts - attempts0,
+        cycles=sched.metrics.cycles - cycles0,
+        p99_attempt_latency_ms=lat,
+    )
+    sched.close()
+    return result
+
+
+def run_label(label: str = "performance", **kwargs) -> list[WorkloadResult]:
+    """Run every workload carrying ``label`` (the reference's label selector,
+    e.g. -perf-scheduling-label-filter=performance)."""
+    out = []
+    for case in W.TEST_CASES.values():
+        for wl in case.workloads:
+            if label in wl.labels:
+                out.append(run_workload(case, wl, **kwargs))
+    return out

@@ -1,0 +1,431 @@
+"""scheduler_perf workload definitions — op lists + object templates.
+
+Mirrors the reference harness's shape
+(test/integration/scheduler_perf/scheduler_perf.go:756
+RunBenchmarkPerfScheduling; ops in operations.go; per-topic
+performance-config.yaml files): a *test case* is an op-list template
+(createNodes/createNamespaces/createPods/churn/barrier) plus named
+*workloads* binding the ``$param`` counts and the SchedulingThroughput
+threshold asserted by CI. Templates reproduce the reference's YAML pod/node
+templates (test/integration/scheduler_perf/templates/*.yaml) as factory
+functions.
+
+The measured metric is the reference's SchedulingThroughput: scheduled pods
+per second over the collect-metrics phase (scheduler_perf.go:352-359 selects
+``SchedulingThroughput / Average``; util.go:468 throughputCollector).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Mapping
+
+from ..api import types as t
+from ..api.wrappers import make_node, make_pod, pod_affinity_term, spread_constraint
+
+ZONE_KEY = "topology.kubernetes.io/zone"
+HOSTNAME_KEY = "kubernetes.io/hostname"
+
+# ---------------------------------------------------------------------------
+# object templates (templates/*.yaml analogs)
+# ---------------------------------------------------------------------------
+
+
+def node_default(i: int, zones: tuple[str, ...] = ()) -> t.Node:
+    """templates/node-default.yaml: 4 cpu / 32Gi / 110 pods, plus the
+    labelNodePrepareStrategy zone label (round-robin over ``zones``) and the
+    kubelet-maintained hostname label."""
+    name = f"scheduler-perf-{i}"
+    labels = {HOSTNAME_KEY: name}
+    if zones:
+        labels[ZONE_KEY] = zones[i % len(zones)]
+    return make_node(
+        name, cpu_milli=4000, memory=32 * 1024**3, pods=110, labels=labels
+    )
+
+
+_POD_REQ = dict(cpu_milli=100, memory=500 * 1024**2)  # 100m / 500Mi
+
+
+def pod_default(name: str, namespace: str) -> t.Pod:
+    """templates/pod-default.yaml."""
+    return make_pod(name, namespace=namespace, **_POD_REQ)
+
+
+def pod_with_pod_affinity(name: str, namespace: str) -> t.Pod:
+    """templates/pod-with-pod-affinity.yaml: color=blue, required zone
+    affinity to color=blue across sched-0/sched-1."""
+    term = pod_affinity_term(
+        ZONE_KEY, match_labels={"color": "blue"},
+        namespaces=("sched-1", "sched-0"),
+    )
+    return make_pod(
+        name, namespace=namespace, labels={"color": "blue"},
+        affinity=t.Affinity(pod_affinity=t.PodAffinity(required=(term,))),
+        **_POD_REQ,
+    )
+
+
+def pod_with_pod_anti_affinity(name: str, namespace: str) -> t.Pod:
+    """templates/pod-with-pod-anti-affinity.yaml: color=green, required
+    hostname anti-affinity to color=green."""
+    term = pod_affinity_term(
+        HOSTNAME_KEY, match_labels={"color": "green"},
+        namespaces=("sched-1", "sched-0"),
+    )
+    return make_pod(
+        name, namespace=namespace, labels={"color": "green"},
+        affinity=t.Affinity(pod_anti_affinity=t.PodAffinity(required=(term,))),
+        **_POD_REQ,
+    )
+
+
+def pod_anti_affinity_label_only(name: str, namespace: str) -> t.Pod:
+    """templates/pod-with-pod-anti-affinity-label.yaml: carries color=green
+    (matching the init pods' anti-affinity) but no constraint of its own."""
+    return make_pod(
+        name, namespace=namespace, labels={"color": "green"}, **_POD_REQ
+    )
+
+
+def pod_with_preferred_pod_affinity(name: str, namespace: str) -> t.Pod:
+    term = pod_affinity_term(
+        HOSTNAME_KEY, match_labels={"color": "red"},
+        namespaces=("sched-1", "sched-0"),
+    )
+    return make_pod(
+        name, namespace=namespace, labels={"color": "red"},
+        affinity=t.Affinity(pod_affinity=t.PodAffinity(
+            preferred=(t.WeightedPodAffinityTerm(1, term),)
+        )),
+        **_POD_REQ,
+    )
+
+
+def pod_with_preferred_pod_anti_affinity(name: str, namespace: str) -> t.Pod:
+    term = pod_affinity_term(
+        HOSTNAME_KEY, match_labels={"color": "yellow"},
+        namespaces=("sched-1", "sched-0"),
+    )
+    return make_pod(
+        name, namespace=namespace, labels={"color": "yellow"},
+        affinity=t.Affinity(pod_anti_affinity=t.PodAffinity(
+            preferred=(t.WeightedPodAffinityTerm(1, term),)
+        )),
+        **_POD_REQ,
+    )
+
+
+def pod_with_topology_spreading(name: str, namespace: str) -> t.Pod:
+    """templates/pod-with-topology-spreading.yaml: maxSkew 5 / zone /
+    DoNotSchedule over color=blue."""
+    return make_pod(
+        name, namespace=namespace, labels={"color": "blue"},
+        spread=(spread_constraint(
+            5, ZONE_KEY,
+            when=t.UnsatisfiableConstraintAction.DO_NOT_SCHEDULE,
+            match_labels={"color": "blue"},
+        ),),
+        **_POD_REQ,
+    )
+
+
+def pod_with_preferred_topology_spreading(name: str, namespace: str) -> t.Pod:
+    return make_pod(
+        name, namespace=namespace, labels={"color": "blue"},
+        spread=(spread_constraint(
+            5, ZONE_KEY,
+            when=t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY,
+            match_labels={"color": "blue"},
+        ),),
+        **_POD_REQ,
+    )
+
+
+def pod_with_node_affinity(name: str, namespace: str) -> t.Pod:
+    """templates/pod-with-node-affinity.yaml: required zone In [zone1,zone2]."""
+    from ..api.wrappers import node_affinity_required, req_in
+
+    return make_pod(
+        name, namespace=namespace,
+        affinity=node_affinity_required(
+            t.NodeSelectorTerm(match_expressions=(req_in(ZONE_KEY, "zone1", "zone2"),))
+        ),
+        **_POD_REQ,
+    )
+
+
+def pod_high_priority_large_cpu(name: str, namespace: str) -> t.Pod:
+    """templates/pod-high-priority-large-cpu.yaml: priority 10, 9 cpu."""
+    return make_pod(
+        name, namespace=namespace, priority=10,
+        cpu_milli=9000, memory=500 * 1024**2,
+    )
+
+
+# ---------------------------------------------------------------------------
+# op list (operations.go analogs)
+# ---------------------------------------------------------------------------
+
+PodTemplate = Callable[[str, str], t.Pod]
+
+
+@dataclass(frozen=True)
+class CreateNodesOp:
+    """operations.go:205 createNodesOp (+ labelNodePrepareStrategy)."""
+
+    count_param: str = "initNodes"
+    zones: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class CreateNamespacesOp:
+    """operations.go createNamespacesOp."""
+
+    prefix: str = "sched"
+    count: int = 2
+
+
+@dataclass(frozen=True)
+class CreatePodsOp:
+    """operations.go:295 createPodsOp."""
+
+    count_param: str = "initPods"
+    template: PodTemplate | None = None     # None → case default
+    collect_metrics: bool = False
+    namespace: str | None = None            # None → unique per-op namespace
+
+
+@dataclass(frozen=True)
+class ChurnOp:
+    """operations.go:518 churnOp — create (or recreate) interfering objects
+    at an interval while the measured phase runs."""
+
+    mode: str = "create"                    # create | recreate
+    template: PodTemplate = pod_high_priority_large_cpu
+    interval_ms: int = 500
+    number: int = 0                         # recreate pool size (0 = unbounded)
+
+
+@dataclass(frozen=True)
+class BarrierOp:
+    """operations.go:574 barrierOp — wait until all created pods scheduled."""
+
+
+Op = object  # union of the five ops above
+
+
+@dataclass(frozen=True)
+class Workload:
+    name: str
+    params: Mapping[str, int]
+    threshold: float | None = None          # SchedulingThroughput floor
+    labels: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class TestCase:
+    name: str
+    ops: tuple
+    workloads: tuple[Workload, ...]
+    default_pod_template: PodTemplate = pod_default
+    source: str = ""                        # reference config citation
+
+
+# ---------------------------------------------------------------------------
+# registry — the BASELINE.md rows (thresholds from the reference configs)
+# ---------------------------------------------------------------------------
+
+TEST_CASES: dict[str, TestCase] = {}
+
+
+def _case(tc: TestCase) -> TestCase:
+    TEST_CASES[tc.name] = tc
+    return tc
+
+
+_case(TestCase(
+    name="SchedulingBasic",
+    source="misc/performance-config.yaml:20",
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreatePodsOp("initPods"),
+        CreatePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000}),
+        Workload("5000Nodes_10000Pods",
+                 {"initNodes": 5000, "initPods": 1000, "measurePods": 10000},
+                 threshold=680, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingPodAntiAffinity",
+    source="affinity/performance-config.yaml:20",
+    default_pod_template=pod_with_pod_anti_affinity,
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreateNamespacesOp("sched", 2),
+        CreatePodsOp("initPods", namespace="sched-0"),
+        CreatePodsOp("measurePods", collect_metrics=True, namespace="sched-1"),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 100, "measurePods": 400}),
+        Workload("5000Nodes_2000Pods",
+                 {"initNodes": 5000, "initPods": 1000, "measurePods": 2000},
+                 threshold=180, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingPodMatchingAntiAffinity",
+    source="affinity/performance-config.yaml:60",
+    default_pod_template=pod_with_pod_anti_affinity,
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreateNamespacesOp("sched", 2),
+        CreatePodsOp("initPods", namespace="sched-0"),
+        CreatePodsOp("measurePods", template=pod_anti_affinity_label_only,
+                     collect_metrics=True, namespace="sched-1"),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 100, "measurePods": 400}),
+        Workload("5000Nodes_5000Pods",
+                 {"initNodes": 5000, "initPods": 1000, "measurePods": 5000},
+                 threshold=540, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingPodAffinity",
+    source="affinity/performance-config.yaml:96 (threshold 70 — the hardest quadratic workload)",
+    default_pod_template=pod_with_pod_affinity,
+    ops=(
+        CreateNodesOp("initNodes", zones=("zone1",)),
+        CreateNamespacesOp("sched", 2),
+        CreatePodsOp("initPods", namespace="sched-0"),
+        CreatePodsOp("measurePods", collect_metrics=True, namespace="sched-1"),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000}),
+        Workload("5000Nodes_5000Pods",
+                 {"initNodes": 5000, "initPods": 5000, "measurePods": 5000},
+                 threshold=70, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingNodeAffinity",
+    source="affinity/performance-config.yaml SchedulingNodeAffinity",
+    default_pod_template=pod_with_node_affinity,
+    ops=(
+        CreateNodesOp("initNodes", zones=("zone1",)),
+        CreatePodsOp("initPods"),
+        CreatePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000}),
+        Workload("5000Nodes_10000Pods",
+                 {"initNodes": 5000, "initPods": 1000, "measurePods": 10000},
+                 threshold=540, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="TopologySpreading",
+    source="topology_spreading/performance-config.yaml:19",
+    ops=(
+        CreateNodesOp("initNodes", zones=("moon-1", "moon-2", "moon-3")),
+        CreatePodsOp("initPods", template=pod_default),
+        CreatePodsOp("measurePods", template=pod_with_topology_spreading,
+                     collect_metrics=True),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 1000, "measurePods": 1000}),
+        Workload("5000Nodes_5000Pods",
+                 {"initNodes": 5000, "initPods": 5000, "measurePods": 5000},
+                 threshold=460, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="PreferredTopologySpreading",
+    source="topology_spreading/performance-config.yaml:64",
+    ops=(
+        CreateNodesOp("initNodes", zones=("moon-1", "moon-2", "moon-3")),
+        CreatePodsOp("initPods", template=pod_default),
+        CreatePodsOp("measurePods",
+                     template=pod_with_preferred_topology_spreading,
+                     collect_metrics=True),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 1000, "measurePods": 1000}),
+        Workload("5000Nodes_5000Pods",
+                 {"initNodes": 5000, "initPods": 5000, "measurePods": 5000},
+                 threshold=340, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="MixedSchedulingBasePod",
+    source="affinity/performance-config.yaml MixedSchedulingBasePod",
+    ops=(
+        CreateNodesOp("initNodes", zones=("zone1",)),
+        CreateNamespacesOp("sched", 1),
+        CreatePodsOp("initPods", namespace="sched-0"),
+        CreatePodsOp("initPods", template=pod_with_pod_affinity,
+                     namespace="sched-0"),
+        CreatePodsOp("initPods", template=pod_with_pod_anti_affinity,
+                     namespace="sched-0"),
+        CreatePodsOp("initPods", template=pod_with_preferred_pod_affinity,
+                     namespace="sched-0"),
+        CreatePodsOp("initPods", template=pod_with_preferred_pod_anti_affinity,
+                     namespace="sched-0"),
+        CreatePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 200, "measurePods": 1000}),
+        Workload("5000Nodes_5000Pods",
+                 {"initNodes": 5000, "initPods": 2000, "measurePods": 5000},
+                 threshold=540, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="Unschedulable",
+    source="misc/performance-config.yaml:252",
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreatePodsOp("initPods"),
+        ChurnOp(mode="create", template=pod_high_priority_large_cpu,
+                interval_ms=200),
+        CreatePodsOp("measurePods", template=pod_default,
+                     collect_metrics=True),
+    ),
+    workloads=(
+        Workload("500Nodes/10Init/1kPods",
+                 {"initNodes": 500, "initPods": 10, "measurePods": 1000}),
+        Workload("5kNodes/100Init/10kPods",
+                 {"initNodes": 5000, "initPods": 100, "measurePods": 10000},
+                 threshold=590, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingWithMixedChurn",
+    source="misc/performance-config.yaml:327",
+    ops=(
+        CreateNodesOp("initNodes"),
+        ChurnOp(mode="recreate", template=pod_high_priority_large_cpu,
+                interval_ms=1000, number=1),
+        CreatePodsOp("measurePods", template=pod_default,
+                     collect_metrics=True),
+    ),
+    workloads=(
+        Workload("1000Nodes", {"initNodes": 1000, "measurePods": 1000}),
+        Workload("5000Nodes_10000Pods",
+                 {"initNodes": 5000, "measurePods": 10000},
+                 threshold=710, labels=("performance",)),
+    ),
+))

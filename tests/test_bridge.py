@@ -238,6 +238,18 @@ class TestWebhook:
         assert res["NodeNames"] is None
         assert "m1" in res["FailedNodes"]
 
+    def test_full_node_list_does_not_pollute_shared_cache(self, server):
+        """Non-cache-capable requests encode an ephemeral view; their nodes
+        must not leak into the NodeCacheCapable cache."""
+        _post(server.url + "/filter", {
+            "Pod": _v1_pod("p"),
+            "Nodes": {"Items": [_v1_node("ephemeral-0")]},
+        })
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("q"), "NodeNames": ["ephemeral-0"]})
+        assert res["NodeNames"] == []
+        assert "ephemeral-0" in res["FailedNodes"]
+
     def test_prioritize_host_priority_list(self, server):
         _post(server.url + "/cache/nodes", {"Nodes": [
             _v1_node("n0", cpu="4"), _v1_node("n1", cpu="8"),
@@ -255,8 +267,15 @@ class TestWebhook:
         assert all(0 <= s <= 10 for s in scores.values())  # MaxExtenderPriority
         assert scores["n1"] > scores["n0"]
 
-    def test_bind_updates_cache(self, server):
-        _post(server.url + "/cache/nodes", {"Nodes": [_v1_node("n0")]})
+    def test_bind_updates_cache_with_real_requests(self, server):
+        """Bind args carry only identity; the backend must recover the pod's
+        requests from the preceding filter call, so a full node rejects the
+        next pod."""
+        _post(server.url + "/cache/nodes", {"Nodes": [_v1_node("n0", cpu="4")]})
+        # the scheduler always filters before binding
+        res = _post(server.url + "/filter", {
+            "Pod": _v1_pod("p", cpu="4"), "NodeNames": ["n0"]})
+        assert res["NodeNames"] == ["n0"]
         res = _post(server.url + "/bind", {
             "PodName": "p", "PodNamespace": "default",
             "PodUID": "default/p", "Node": "n0",
@@ -264,10 +283,12 @@ class TestWebhook:
         assert res["Error"] == ""
         be = server.backend
         assert be.cache.has_pod("default/p")
-        # a second filter sees the bound pod's usage
+        # n0 is now cpu-full: the bound pod's REAL 4-cpu request must be
+        # accounted (a zero-request placeholder would admit q)
         res = _post(server.url + "/filter", {
             "Pod": _v1_pod("q", cpu="1"), "NodeNames": ["n0"]})
-        assert res["NodeNames"] == ["n0"]
+        assert res["NodeNames"] == []
+        assert "n0" in res["FailedNodes"]
 
     def test_bind_unknown_node_reports_error(self, server):
         res = _post(server.url + "/bind", {

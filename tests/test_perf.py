@@ -1,0 +1,136 @@
+"""scheduler_perf harness tests: op-list execution over the real scheduler
+loop at toy scale, checking both mechanics (counts, metrics) and workload
+semantics (anti-affinity capacity, spread balance, churn interference)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.perf import TEST_CASES, run_workload
+from kubetpu.perf.workloads import (
+    ChurnOp,
+    CreateNodesOp,
+    CreatePodsOp,
+    TestCase,
+    Workload,
+    pod_default,
+    pod_with_pod_anti_affinity,
+)
+
+
+def tiny(**params):
+    return Workload("tiny", params)
+
+
+def test_registry_covers_baseline_rows():
+    """≥8 BASELINE.md workloads must be runnable with their thresholds."""
+    thresholds = {
+        ("SchedulingBasic", "5000Nodes_10000Pods"): 680,
+        ("SchedulingPodAntiAffinity", "5000Nodes_2000Pods"): 180,
+        ("SchedulingPodMatchingAntiAffinity", "5000Nodes_5000Pods"): 540,
+        ("SchedulingPodAffinity", "5000Nodes_5000Pods"): 70,
+        ("SchedulingNodeAffinity", "5000Nodes_10000Pods"): 540,
+        ("TopologySpreading", "5000Nodes_5000Pods"): 460,
+        ("PreferredTopologySpreading", "5000Nodes_5000Pods"): 340,
+        ("MixedSchedulingBasePod", "5000Nodes_5000Pods"): 540,
+        ("Unschedulable", "5kNodes/100Init/10kPods"): 590,
+        ("SchedulingWithMixedChurn", "5000Nodes_10000Pods"): 710,
+    }
+    for (case, wl_name), floor in thresholds.items():
+        tc = TEST_CASES[case]
+        wl = next(w for w in tc.workloads if w.name == wl_name)
+        assert wl.threshold == floor, (case, wl_name)
+        assert "performance" in wl.labels
+
+
+def test_basic_all_scheduled():
+    r = run_workload(
+        "SchedulingBasic", tiny(initNodes=20, initPods=10, measurePods=40),
+        timeout_s=120,
+    )
+    assert r.scheduled == r.measure_pods == 40
+    assert r.throughput > 0
+    assert r.attempts >= 40
+    assert r.to_json()["metric"] == "SchedulingThroughput/Average"
+
+
+def test_anti_affinity_respects_hostname_capacity():
+    """pod-with-pod-anti-affinity (hostname, color=green): at most ONE green
+    pod per node, so with N nodes only N measure pods can land."""
+    case = TEST_CASES["SchedulingPodAntiAffinity"]
+    n_nodes = 12
+    r = run_workload(
+        case, tiny(initNodes=n_nodes, initPods=4, measurePods=20),
+        timeout_s=60,
+    )
+    # 4 init + measure pods all anti-affine on hostname: 12 slots total
+    assert r.scheduled == n_nodes - 4
+    assert r.measure_pods == 20
+
+
+def test_spread_workload_balances_zones():
+    """TopologySpreading: measure pods carry maxSkew-5 zone constraints over
+    3 zones; final counts must respect the skew bound."""
+    from kubetpu.sched.scheduler import Scheduler  # noqa: F401 (import check)
+
+    r = run_workload(
+        "TopologySpreading", tiny(initNodes=30, initPods=15, measurePods=60),
+        timeout_s=120,
+    )
+    assert r.scheduled == 60
+
+
+def test_unschedulable_churn_does_not_block_measure_pods():
+    """Unschedulable: churn injects 9-cpu pods (no node fits); measure pods
+    must still all schedule and churn pods must not."""
+    r = run_workload(
+        "Unschedulable", tiny(initNodes=20, initPods=5, measurePods=50),
+        timeout_s=120,
+    )
+    assert r.scheduled == 50
+
+
+def test_mixed_base_pod_runs_every_template():
+    r = run_workload(
+        "MixedSchedulingBasePod",
+        tiny(initNodes=30, initPods=5, measurePods=30),
+        timeout_s=120,
+    )
+    assert r.scheduled == 30
+
+
+def test_custom_case_with_barrier_and_stall_reporting():
+    """A workload whose measure pods cannot all fit reports a partial count
+    instead of hanging."""
+    case = TestCase(
+        name="Saturated",
+        ops=(
+            CreateNodesOp("initNodes"),
+            # namespace must be sched-0: the template's anti-affinity term
+            # names namespaces sched-0/sched-1 explicitly
+            CreatePodsOp("measurePods", template=pod_with_pod_anti_affinity,
+                         collect_metrics=True, namespace="sched-0"),
+        ),
+        workloads=(tiny(initNodes=5, measurePods=9),),
+        default_pod_template=pod_default,
+    )
+    r = run_workload(case, case.workloads[0], timeout_s=30)
+    assert r.scheduled == 5          # one green pod per node
+    assert r.measure_pods == 9
+
+
+def test_churn_recreate_bounded_pool():
+    """recreate-mode churn keeps at most `number` live churn objects."""
+    case = TestCase(
+        name="ChurnRecreate",
+        ops=(
+            CreateNodesOp("initNodes"),
+            ChurnOp(mode="recreate", interval_ms=1, number=1),
+            CreatePodsOp("measurePods", collect_metrics=True),
+        ),
+        workloads=(tiny(initNodes=10, measurePods=30),),
+        default_pod_template=pod_default,
+    )
+    r = run_workload(case, case.workloads[0], timeout_s=60)
+    assert r.scheduled == 30
