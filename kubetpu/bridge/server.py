@@ -122,32 +122,24 @@ class ExtenderBackend:
             self._seen_pods.popitem(last=False)
 
     def _encode(self, pod: t.Pod, extra_nodes: list[t.Node] | None):
-        """One-pod batch. NodeCacheCapable mode encodes the shared cache
-        (incremental snapshot); non-cache mode builds an EPHEMERAL view of
-        exactly the request's nodes (+ any pod state the shared cache holds
-        for them) so request-supplied nodes never pollute the shared cache.
-        Callers restrict to the candidate set by name when assembling the
-        response."""
+        """One-pod batch over the shared cache (incremental snapshot:
+        update_snapshot(prev) re-clones only changed NodeInfos).
+
+        Non-cache-capable requests UPSERT their node objects first — the
+        cache is the union of everything seen, with requested nodes
+        refreshed per request. The union is what keeps bind/preempt and
+        cross-node affinity/spread state working in that mode (responses
+        are still restricted to the request's candidates by name); a node
+        deleted from the cluster lingers until a /cache/nodes Remove —
+        non-cache mode has no delete signal, one reason the reference
+        recommends NodeCacheCapable for stateful extenders."""
         with self.lock:
             self._remember(pod)
             if extra_nodes:
-                tmp = Cache()
-                self._snapshot = self.cache.update_snapshot(self._snapshot)
-                shared = {
-                    info.node.name: info
-                    for info in self._snapshot.node_infos()
-                }
                 for n in extra_nodes:
-                    tmp.add_node(n)
-                    info = shared.get(n.name)
-                    if info is not None:
-                        for p in info.pods.values():
-                            tmp.add_pod(p)
-                snap = tmp.update_snapshot()
-            else:
-                self._snapshot = self.cache.update_snapshot(self._snapshot)
-                snap = self._snapshot
-            batch = rt.encode_batch(snap, [pod], self.profile)
+                    self.cache.add_node(n)
+            self._snapshot = self.cache.update_snapshot(self._snapshot)
+            batch = rt.encode_batch(self._snapshot, [pod], self.profile)
             params = rt.score_params(self.profile, batch.resource_names)
         return batch, params
 

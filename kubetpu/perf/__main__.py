@@ -20,6 +20,10 @@ def main() -> None:
     ap.add_argument("--list", action="store_true")
     ap.add_argument("--max-batch", type=int, default=1024)
     ap.add_argument("--timeout", type=float, default=1800.0)
+    ap.add_argument("--engine", default="greedy",
+                    choices=["greedy", "batched"],
+                    help="assignment engine (assign.greedy scan vs "
+                         "assign.batched capacity-coupled rounds)")
     args = ap.parse_args()
 
     if args.list:
@@ -31,7 +35,7 @@ def main() -> None:
 
     if args.label:
         for r in run_label(args.label, max_batch=args.max_batch,
-                           timeout_s=args.timeout):
+                           timeout_s=args.timeout, engine=args.engine):
             print(json.dumps(r.to_json()))
         return
 
@@ -42,7 +46,7 @@ def main() -> None:
     )
     for wl in workloads:
         r = run_workload(case, wl, max_batch=args.max_batch,
-                         timeout_s=args.timeout)
+                         timeout_s=args.timeout, engine=args.engine)
         print(json.dumps(r.to_json()))
 
 

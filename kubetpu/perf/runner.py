@@ -133,8 +133,14 @@ def run_workload(
     profile: C.Profile | None = None,
     max_batch: int = 1024,
     timeout_s: float = 1800.0,
+    engine: str = "greedy",
+    stall_s: float = 15.0,
 ) -> WorkloadResult:
-    """Execute one (test case, workload) pair and return the measurement."""
+    """Execute one (test case, workload) pair and return the measurement.
+    ``engine`` selects the assignment engine ("greedy" scan or "batched"
+    rounds); ``stall_s`` is how long zero progress must persist before a
+    phase gives up (must exceed the queue's max backoff, default 10 s, or
+    backed-off pods read as stalls)."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -143,7 +149,8 @@ def run_workload(
 
     client = _Client()
     sched = Scheduler(
-        client, profile=profile or C.Profile(), max_batch=max_batch
+        client, profile=profile or C.Profile(), max_batch=max_batch,
+        engine=engine,
     )
     client.sched = sched
     sched.enable_preemption()
@@ -154,14 +161,13 @@ def run_workload(
     attempts0 = cycles0 = 0
     op_ns_counter = 0
 
-    def settle(target: int, measure: bool) -> tuple[int, float]:
+    def settle(target: int) -> tuple[int, float]:
         """Run cycles until ``target`` pods scheduled (or stall). Churn fires
         between cycles. Returns (scheduled, wall seconds)."""
-        nonlocal measured
         done = 0
-        idle = 0
         t0 = time.perf_counter()
         deadline = t0 + timeout_s
+        last_progress = t0
         while done < target:
             now = time.perf_counter()
             if now > deadline:
@@ -173,13 +179,13 @@ def run_workload(
             done_this = res["scheduled"]
             done += done_this
             if done_this == 0:
-                idle += 1
-                if idle > 200:
-                    break  # stalled (nothing schedulable): partial result
-                # let backoff clocks advance without spinning hot
+                # pods may simply be in backoff (max 10 s by default): only
+                # a sustained quiet period is a real stall
+                if now - last_progress > stall_s:
+                    break
                 time.sleep(0.005)
             else:
-                idle = 0
+                last_progress = now
         return done, time.perf_counter() - t0
 
     for op_i, op in enumerate(case.ops):
@@ -208,7 +214,7 @@ def run_workload(
             for j in range(count):
                 pod = template(f"{prefix}-{ns}-{j}", ns)
                 sched.on_pod_add(pod)
-            done, secs = settle(count, op.collect_metrics)
+            done, secs = settle(count)
             if op.collect_metrics:
                 measured += done
                 duration += secs

@@ -84,9 +84,22 @@ class Scheduler:
         max_batch: int = 1024,
         dispatcher_workers: int = 2,
         clock: Callable[[], float] = time.monotonic,
+        engine: str = "greedy",
     ) -> None:
+        """``engine``: "greedy" (per-pod lax.scan, exact reference
+        semantics) or "batched" (capacity-coupled rounds,
+        assign.batched — one big device program per round; wins when
+        batches are signature-homogeneous, the scheduler_perf shape)."""
         self.cfg = cfg or C.SchedulerConfiguration()
         self.profile = profile or self.cfg.profile()
+        if engine == "batched":
+            from ..assign.batched import batched_assign_device
+
+            self._assign_device = batched_assign_device
+        elif engine == "greedy":
+            self._assign_device = greedy_assign_device
+        else:
+            raise ValueError(f"unknown engine {engine!r}")
         self.cache = Cache(clock=clock)
         self.clock = clock
         self.max_batch = max_batch
@@ -243,7 +256,7 @@ class Scheduler:
                 nominated=self.nominator.entries(),
             )
             params = rt.score_params(self.profile, batch.resource_names)
-            assignments, final_state = greedy_assign_device(batch.device, params)
+            assignments, final_state = self._assign_device(batch.device, params)
             idx = np.asarray(jax.device_get(assignments))
             self._cycle_ctx = (
                 batch, params, final_state,

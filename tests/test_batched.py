@@ -1,0 +1,221 @@
+"""Batched assignment (v2) parity harness: round-based capacity-coupled
+assignment vs. the greedy scan (v1), per SURVEY §7 item 5 — ≥99% binding
+parity on SchedulingBasic shapes, exact capacity safety on saturated
+clusters, and convergence accounting."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.assign.batched import batched_assign_device
+from kubetpu.assign.greedy import greedy_assign_device
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, score_params
+from kubetpu.state import Cache
+
+from .cluster_gen import random_cluster
+from .test_podaffinity import add_affinity
+from .test_spread import add_spread_pods
+
+
+def run_both(cache, pending, profile):
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pending, profile)
+    params = score_params(profile, batch.resource_names)
+    g, g_state = greedy_assign_device(batch.device, params)
+    v, v_state = batched_assign_device(batch.device, params)
+    P = batch.num_pods
+    return (np.asarray(g)[:P], np.asarray(v)[:P], g_state, v_state, batch)
+
+
+def test_identical_pods_exact_parity():
+    """SchedulingBasic shape: uniform nodes + identical pods. Tie-spreading
+    must reproduce the scan's round-robin exactly, pod for pod."""
+    cache = Cache()
+    for i in range(64):
+        cache.add_node(make_node(f"n{i:03d}", cpu_milli=4000, memory=32 * 1024**3))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=100, memory=500 * 1024**2, creation_index=j)
+        for j in range(48)
+    ]
+    g, v, *_ = run_both(cache, pending, C.minimal_profile())
+    np.testing.assert_array_equal(g, v)
+
+
+def test_identical_pods_more_pods_than_nodes():
+    """More pods than nodes: the scan wraps around; rounds must too."""
+    cache = Cache()
+    for i in range(8):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, memory=32 * 1024**3))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=100, memory=128 * 1024**2, creation_index=j)
+        for j in range(40)
+    ]
+    g, v, *_ = run_both(cache, pending, C.minimal_profile())
+    np.testing.assert_array_equal(g, v)
+
+
+def test_saturated_cluster_capacity_safety():
+    """Saturated cluster: only some pods fit. The batched result must (a)
+    never violate capacity, (b) schedule exactly as many pods as greedy."""
+    cache = Cache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=1000, memory=8 * 1024**3))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=300, memory=128 * 1024**2, creation_index=j)
+        for j in range(20)
+    ]
+    g, v, g_state, v_state, batch = run_both(cache, pending, C.minimal_profile())
+    assert (g >= 0).sum() == (v >= 0).sum() == 12  # 3 per node
+    # capacity: recompute usage per node from the v2 assignment
+    req = {f"n{i}": 0 for i in range(4)}
+    for j, node in enumerate(v):
+        if node >= 0:
+            req[batch.node_names[node]] += 300
+    assert all(x <= 1000 for x in req.values())
+    np.testing.assert_array_equal(g, v)
+
+
+def test_final_state_matches_greedy():
+    """The 7-slot final state (the cache's assume input) must agree."""
+    cache = Cache()
+    for i in range(16):
+        cache.add_node(make_node(f"n{i:02d}", cpu_milli=4000, memory=32 * 1024**3))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=250, memory=256 * 1024**2, creation_index=j)
+        for j in range(30)
+    ]
+    g, v, g_state, v_state, _ = run_both(cache, pending, C.minimal_profile())
+    np.testing.assert_array_equal(g, v)
+    for a, b in zip(g_state[:4], v_state[:4]):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_host_port_conflicts_across_rounds():
+    """Two pods wanting the same hostPort choosing one node in the same
+    round: exactly one is admitted; the other lands elsewhere."""
+    cache = Cache()
+    cache.add_node(make_node("n0", cpu_milli=4000, memory=32 * 1024**3))
+    cache.add_node(make_node("n1", cpu_milli=4000, memory=32 * 1024**3))
+    pending = [
+        make_pod("a", cpu_milli=100, host_ports=[80], creation_index=0),
+        make_pod("b", cpu_milli=100, host_ports=[80], creation_index=1),
+        make_pod("c", cpu_milli=100, host_ports=[80], creation_index=2),
+    ]
+    profile = C.Profile(
+        filters=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1), (C.NODE_PORTS, 1))),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+    g, v, *_ = run_both(cache, pending, profile)
+    assert (v >= 0).sum() == 2
+    assert v[0] != v[1]
+    assert v[2] == -1
+    np.testing.assert_array_equal(g, v)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_randomized_parity_resources(seed):
+    """≥99% binding parity on randomized resource-only clusters. Mismatches
+    are legal only when score-equivalent; we assert strict-equality rate and
+    identical scheduled counts."""
+    rng = np.random.default_rng(seed + 900)
+    cache, pending = random_cluster(
+        rng, num_nodes=48, num_existing=80, num_pending=64
+    )
+    g, v, *_ = run_both(cache, pending, C.minimal_profile())
+    assert (g >= 0).sum() == (v >= 0).sum()
+    agree = float((g == v).mean())
+    assert agree >= 0.99, f"binding parity {agree:.3f} < 0.99"
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_randomized_parity_full_profile(seed):
+    """Spread + affinity + taints workloads: scheduled counts must match and
+    hard constraints hold; per-pod agreement stays high (ties may resolve
+    differently only within score-equivalent sets)."""
+    rng = np.random.default_rng(seed + 950)
+    cache, pending = random_cluster(
+        rng, num_nodes=32, num_existing=50, num_pending=32, with_taints=True
+    )
+    pending = add_spread_pods(rng, pending)
+    pending = add_affinity(rng, pending)
+    profile = C.Profile()
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pending, profile)
+    params = score_params(profile, batch.resource_names)
+    g, _ = greedy_assign_device(batch.device, params)
+    v, _ = batched_assign_device(batch.device, params)
+    P = batch.num_pods
+    g, v = np.asarray(g)[:P], np.asarray(v)[:P]
+    assert (g >= 0).sum() == (v >= 0).sum()
+    agree = float((g == v).mean())
+    assert agree >= 0.9, f"agreement {agree:.3f}"
+
+
+def test_round_count_is_small_for_uniform_batch():
+    """The whole point: identical pods over uniform nodes converge in few
+    rounds, not P steps. 96 pods / 64 nodes → 2 rounds."""
+    import jax
+
+    cache = Cache()
+    for i in range(64):
+        cache.add_node(make_node(f"n{i:03d}", cpu_milli=4000, memory=32 * 1024**3))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=100, memory=128 * 1024**2, creation_index=j)
+        for j in range(96)
+    ]
+    snap = cache.update_snapshot()
+    profile = C.minimal_profile()
+    batch = encode_batch(snap, pending, profile)
+    params = score_params(profile, batch.resource_names)
+    # count rounds by running the loop body manually via max_rounds sweep:
+    # with max_rounds=2 every pod must already be placed
+    v, _ = batched_assign_device(batch.device, params, max_rounds=2)
+    assert (np.asarray(v)[:96] >= 0).all()
+
+
+def test_scheduler_loop_with_batched_engine():
+    """The full scheduler loop runs on the batched engine and produces the
+    same bindings as the greedy engine."""
+    from kubetpu.sched.scheduler import Scheduler
+
+    def build(engine):
+        bound = []
+
+        class Client:
+            sched = None
+
+            def bind(self, pod, node_name):
+                bound.append((pod.name, node_name))
+                self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+            def patch_status(self, pod, reason, message=""):
+                pass
+
+            def delete_pod(self, pod, reason=""):
+                self.sched.on_pod_delete(pod)
+
+            def nominate(self, pod, node_name):
+                pass
+
+        client = Client()
+        sched = Scheduler(client, profile=C.minimal_profile(), engine=engine)
+        client.sched = sched
+        for i in range(16):
+            sched.on_node_add(make_node(f"n{i:02d}", cpu_milli=4000,
+                                        memory=32 * 1024**3))
+        for j in range(40):
+            sched.on_pod_add(make_pod(f"p{j}", cpu_milli=200,
+                                      memory=256 * 1024**2, creation_index=j))
+        total = sched.run_until_idle()
+        sched.close()
+        return total, sorted(bound)
+
+    tg, bg = build("greedy")
+    tb, bb = build("batched")
+    assert tg == tb == 40
+    assert bg == bb

@@ -238,17 +238,25 @@ class TestWebhook:
         assert res["NodeNames"] is None
         assert "m1" in res["FailedNodes"]
 
-    def test_full_node_list_does_not_pollute_shared_cache(self, server):
-        """Non-cache-capable requests encode an ephemeral view; their nodes
-        must not leak into the NodeCacheCapable cache."""
+    def test_full_node_list_bind_and_union_view(self, server):
+        """Non-cache-capable mode: request nodes join the union view, so a
+        subsequent bind (identity-only args) and cross-node state work."""
         _post(server.url + "/filter", {
-            "Pod": _v1_pod("p"),
-            "Nodes": {"Items": [_v1_node("ephemeral-0")]},
+            "Pod": _v1_pod("p", cpu="2"),
+            "Nodes": {"Items": [_v1_node("u0", cpu="4")]},
         })
+        res = _post(server.url + "/bind", {
+            "PodName": "p", "PodNamespace": "default",
+            "PodUID": "default/p", "Node": "u0",
+        })
+        assert res["Error"] == ""
+        # the bound pod's 2 cpu is accounted on the union view
         res = _post(server.url + "/filter", {
-            "Pod": _v1_pod("q"), "NodeNames": ["ephemeral-0"]})
-        assert res["NodeNames"] == []
-        assert "ephemeral-0" in res["FailedNodes"]
+            "Pod": _v1_pod("q", cpu="3"),
+            "Nodes": {"Items": [_v1_node("u0", cpu="4")]},
+        })
+        assert [n["metadata"]["name"] for n in res["Nodes"]["Items"]] == []
+        assert "u0" in res["FailedNodes"]
 
     def test_prioritize_host_priority_list(self, server):
         _post(server.url + "/cache/nodes", {"Nodes": [
