@@ -254,6 +254,19 @@ class Pod:
 
 
 @dataclass(frozen=True)
+class Namespace:
+    """The slice of v1.Namespace affinity needs: its labels, matched by
+    PodAffinityTerm.namespace_selector (framework/types.go
+    AffinityTerm.Matches takes nsLabels)."""
+
+    name: str
+    labels: tuple[tuple[str, str], ...] = ()
+
+    def labels_dict(self) -> dict[str, str]:
+        return dict(self.labels)
+
+
+@dataclass(frozen=True)
 class PodDisruptionBudget:
     """The slice of policy/v1 PodDisruptionBudget preemption consumes
     (framework/plugins/defaultpreemption/default_preemption.go:406

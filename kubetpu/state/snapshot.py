@@ -76,6 +76,8 @@ class Snapshot:
     # per-node cache generation this snapshot last copied (owned by this
     # snapshot so several snapshots can be refreshed independently)
     node_generation: dict[str, int] = field(default_factory=dict)
+    # namespace name → labels (the nsLister view affinity terms match)
+    namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
 
     def node_infos(self) -> list[NodeInfo]:
         return [self.nodes[n] for n in self.node_order]
@@ -102,6 +104,16 @@ class Cache:
         self._ttl = ttl_seconds
         self._clock = clock
         self._deleted_nodes: dict[str, NodeInfo] = {}
+        self._namespaces: dict[str, dict[str, str]] = {}
+
+    # --- namespaces ------------------------------------------------------
+    def add_namespace(self, ns: "t.Namespace") -> None:
+        self._namespaces[ns.name] = ns.labels_dict()
+
+    update_namespace = add_namespace
+
+    def remove_namespace(self, name: str) -> None:
+        self._namespaces.pop(name, None)
 
     # --- nodes -----------------------------------------------------------
     def add_node(self, node: t.Node) -> None:
@@ -250,5 +262,6 @@ class Cache:
         snapshot.nodes = new_nodes
         snapshot.node_generation = new_gens
         snapshot.node_order = list(self._node_order)
+        snapshot.namespaces = {k: dict(v) for k, v in self._namespaces.items()}
         snapshot.generation = next(self._gen)
         return snapshot
