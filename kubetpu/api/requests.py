@@ -1,11 +1,20 @@
 """Pod resource-request aggregation.
 
 Reference semantics: ``resource.PodRequests`` (k8s.io/component-helpers
-resource helpers), as used by ``computePodResourceRequest``
-(pkg/scheduler/framework/plugins/noderesources/fit.go:317-327):
+resource helpers, helpers.go:243 podRequests / :438 aggregation), as used by
+``computePodResourceRequest`` (pkg/scheduler/framework/plugins/noderesources/
+fit.go:317-327):
 
-    total = sum over app containers of per-resource requests
-    total = max(total, max over init containers)   (element-wise)
+    total  = sum over app containers of per-resource requests
+    sidecar init containers (restartPolicy: Always) run for the pod's whole
+    lifetime: their requests ADD to the running total, and accumulate into a
+    sidecar sum that also rides along with every later (non-sidecar) init
+    container's peak:
+        for each init container, in order:
+            if sidecar: total += req; sidecar_sum += req; candidate = sidecar_sum
+            else:       candidate = req + sidecar_sum
+            init_peak = max(init_peak, candidate)     (element-wise)
+    total  = max(total, init_peak)                    (element-wise)
     total += pod overhead
 
 Pod-level resources (PodLevelResources feature) take precedence when set.
@@ -32,13 +41,31 @@ def pod_requests(
     init_containers: Sequence[Mapping[str, int]] = (),
     overhead: Mapping[str, int] | None = None,
     pod_level: Mapping[str, int] | None = None,
+    init_restartable: Sequence[bool] | None = None,
 ) -> dict[str, int]:
-    """Aggregate container requests into the pod's effective request."""
+    """Aggregate container requests into the pod's effective request.
+
+    ``init_restartable[i]`` marks init container *i* as a sidecar
+    (``restartPolicy: Always``) — its requests persist for the pod's
+    lifetime instead of participating only in the init-phase peak
+    (helpers.go:243 podRequests restartable branch).
+    """
     total: dict[str, int] = {}
     for c in containers:
         _add(total, c)
-    for ic in init_containers:
-        _max_merge(total, ic)
+    sidecar_sum: dict[str, int] = {}
+    init_peak: dict[str, int] = {}
+    for i, ic in enumerate(init_containers):
+        if init_restartable is not None and i < len(init_restartable) and init_restartable[i]:
+            _add(total, ic)
+            _add(sidecar_sum, ic)
+            candidate: Mapping[str, int] = dict(sidecar_sum)
+        else:
+            cand = dict(ic)
+            _add(cand, sidecar_sum)
+            candidate = cand
+        _max_merge(init_peak, candidate)
+    _max_merge(total, init_peak)
     if pod_level:
         # Pod-level resources override the aggregate for the resources they name.
         for k, v in pod_level.items():
@@ -53,6 +80,7 @@ def pod_nonzero_requests(
     init_containers: Sequence[Mapping[str, int]] = (),
     overhead: Mapping[str, int] | None = None,
     pod_level: Mapping[str, int] | None = None,
+    init_restartable: Sequence[bool] | None = None,
 ) -> dict[str, int]:
     """The NonZeroRequested (scoring) view of the pod's cpu/memory request.
 
@@ -81,4 +109,5 @@ def pod_nonzero_requests(
         [fill(ic) for ic in init_containers],
         overhead,
         pod_level,
+        init_restartable,
     )

@@ -45,6 +45,7 @@ def make_pod(
     requests: Mapping[str, int] | None = None,
     containers: Sequence[Mapping[str, int]] | None = None,
     init_containers: Sequence[Mapping[str, int]] = (),
+    init_restartable: Sequence[bool] | None = None,
     overhead: Mapping[str, int] | None = None,
     node_name: str = "",
     node_selector: Mapping[str, str] | None = None,
@@ -61,9 +62,15 @@ def make_pod(
 ) -> t.Pod:
     nonzero = None
     if containers is not None:
-        req = pod_requests(containers, init_containers, overhead)
+        req = pod_requests(
+            containers, init_containers, overhead,
+            init_restartable=init_restartable,
+        )
         nonzero = t.freeze_map(
-            pod_nonzero_requests(containers, init_containers, overhead)
+            pod_nonzero_requests(
+                containers, init_containers, overhead,
+                init_restartable=init_restartable,
+            )
         )
     else:
         req = dict(requests or {})

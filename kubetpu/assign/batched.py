@@ -90,7 +90,7 @@ def _tie_spread_choice(mask, score, active):
     return jnp.where(any_f & active, choice, jnp.int32(-1))
 
 
-def _accept(choice, requests, free, count_room):
+def _accept(choice, requests, free, count_room, check_capacity=True):
     """Queue-order admission, at most ONE pod per node per round.
 
     One-per-node is the sequential-consistency key: with it, a pod's round-k
@@ -106,6 +106,10 @@ def _accept(choice, requests, free, count_room):
     ``choice`` (P,) target node (-1 = none); ``free`` (N, R) remaining
     resources; ``count_room`` (N,) remaining pod slots. Feasibility vs. the
     node STATE (ports included) was already enforced by the choice mask.
+    ``check_capacity`` mirrors the profile's NodeResourcesFit *filter*: when
+    that filter is disabled, the greedy scan happily overcommits a node
+    (nothing masks it out), so the batched engine must not re-impose the
+    capacity projection here or the two engines diverge.
     """
     p = requests.shape[0]
     n = free.shape[0]
@@ -114,13 +118,14 @@ def _accept(choice, requests, free, count_room):
     sk, si = jax.lax.sort((key, iota), num_keys=2)
     first = jnp.concatenate([jnp.array([True]), sk[1:] != sk[:-1]])
     node = jnp.minimum(sk, n - 1)
-    s_req = requests[si]
-    ok = (
-        first
-        & (sk < n)
-        & jnp.all(s_req <= free[node], axis=1)
-        & (count_room[node] >= 1)
-    )
+    ok = first & (sk < n)
+    if check_capacity:
+        s_req = requests[si]
+        ok = (
+            ok
+            & jnp.all(s_req <= free[node], axis=1)
+            & (count_room[node] >= 1)
+        )
     accepted = jnp.zeros(p, dtype=bool).at[si].set(ok)
     return accepted & (choice >= 0)
 
@@ -156,6 +161,7 @@ def batched_assign_device(
             choice, b.requests,
             free=b.alloc - requested,
             count_room=b.allowed_pods - pod_count,
+            check_capacity=params.filter_fit,
         )
         # Commit only the queue-order prefix before the FIRST rejection: a
         # rejected pod re-chooses next round, and anything a later pod

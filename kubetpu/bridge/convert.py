@@ -170,12 +170,22 @@ def pod_from_v1(obj: _JSON) -> t.Pod:
     init_containers = [
         _container_requests(c) for c in spec.get("initContainers") or ()
     ]
+    # restartPolicy: Always marks a sidecar whose requests persist for the
+    # pod's lifetime (component-helpers/resource/helpers.go:243,438)
+    init_restartable = [
+        c.get("restartPolicy") == "Always"
+        for c in spec.get("initContainers") or ()
+    ]
     overhead = {
         name: canonical_resource(name, q)
         for name, q in (spec.get("overhead") or {}).items()
     }
-    requests = pod_requests(containers, init_containers, overhead)
-    nonzero = pod_nonzero_requests(containers, init_containers, overhead)
+    requests = pod_requests(
+        containers, init_containers, overhead, init_restartable=init_restartable
+    )
+    nonzero = pod_nonzero_requests(
+        containers, init_containers, overhead, init_restartable=init_restartable
+    )
     ports = []
     for c in spec.get("containers") or ():
         for p in c.get("ports") or ():

@@ -147,7 +147,14 @@ class ExtenderBackend:
         """ExtenderArgs → ExtenderFilterResult. Distinguishes resolvable
         failures (FailedNodes) from victim-independent ones
         (FailedAndUnresolvableNodes — preemption cannot help;
-        extender/v1/types.go:96-99) via the split filter masks."""
+        extender/v1/types.go:96-99) via the split filter masks.
+
+        Only the static per-node predicates (labels, taints, unschedulable,
+        node name/affinity) are victim-independent. Spread and pod-affinity
+        failures are pod-state-dependent — the reference returns plain
+        Unschedulable for them (interpodaffinity/filtering.go:436,
+        podtopologyspread/filtering.go Filter) so the scheduler keeps those
+        nodes as preemption candidates — as do fit/ports failures."""
         pod = pod_from_v1(args.get("Pod") or {})
         node_names, extra_nodes, cache_capable = self._candidates(args)
         batch, params = self._encode(pod, extra_nodes)
@@ -155,12 +162,9 @@ class ExtenderBackend:
         static, fit, ports_ok, spread_ok, pa_ok, _, _ = rt.filter_components(
             b, params
         )
-        unresolvable = ~static
-        for part in (spread_ok, pa_ok):
-            if part is not None:
-                unresolvable = unresolvable | ~part
-        resolvable_fail = np.zeros_like(np.asarray(unresolvable))
-        for part in (fit, ports_ok):
+        unresolvable = np.asarray(~static)
+        resolvable_fail = np.zeros_like(unresolvable)
+        for part in (fit, ports_ok, spread_ok, pa_ok):
             if part is not None:
                 resolvable_fail = resolvable_fail | ~np.asarray(part)
         unresolvable = np.asarray(unresolvable)[0]

@@ -251,6 +251,7 @@ def encode_batch(
                 profile.hard_pod_affinity_weight if profile is not None else 1
             ),
             pad_pods=PP,
+            namespaces=snapshot.namespaces,
         )
         if pa is not None:
             pa_dev = PodAffinityDevice(

@@ -40,10 +40,12 @@ Row kinds:
 
 Namespace semantics: a term's namespaces default to the owner pod's namespace
 (framework.NewPodInfo defaultNamespaces); a non-nil namespace_selector is
-evaluated against the target namespace's labels — namespace objects are not
-modeled, so namespace labels are {} (an empty selector then matches every
-namespace, a non-empty one none), matching the reference when namespaces
-carry no labels.
+evaluated against the target pod's NAMESPACE labels (AffinityTerm.Matches,
+framework/types.go — nsLabels come from the nsLister snapshot,
+GetNamespaceLabelsSnapshot). ``encode_pod_affinity`` takes the snapshot's
+namespace→labels map; a namespace absent from the map matches as if it had
+no labels (empty selector matches, non-empty doesn't), which is also the
+reference behavior for an unsynced namespace.
 """
 
 from __future__ import annotations
@@ -59,13 +61,19 @@ from .encoder import NodeTensors
 from .vocab import Vocab
 
 
-def term_matches_pod(term: t.PodAffinityTerm, owner_ns: str, pod: t.Pod) -> bool:
+def term_matches_pod(
+    term: t.PodAffinityTerm,
+    owner_ns: str,
+    pod: t.Pod,
+    ns_labels: "dict[str, str] | None" = None,
+) -> bool:
     """AffinityTerm.Matches (framework/types.go): namespace membership OR
-    namespace-selector match, AND label selector match."""
+    namespace-selector match (against the labels of the TARGET pod's
+    namespace), AND label selector match."""
     namespaces = term.namespaces or (owner_ns,)
     ns_ok = pod.namespace in namespaces
     if not ns_ok and term.namespace_selector is not None:
-        ns_ok = sel.label_selector_matches(term.namespace_selector, {})
+        ns_ok = sel.label_selector_matches(term.namespace_selector, ns_labels or {})
     if not ns_ok:
         return False
     if term.selector is None:
@@ -136,9 +144,16 @@ def encode_pod_affinity(
     pods: Sequence[t.Pod],
     hard_pod_affinity_weight: int = 1,
     pad_pods: int | None = None,
+    namespaces: "dict[str, dict[str, str]] | None" = None,
 ) -> PodAffinityTensors | None:
     """Build affinity tensors; None when neither pending pods nor existing
-    pods carry any (anti)affinity."""
+    pods carry any (anti)affinity. ``namespaces`` is the snapshot's
+    namespace→labels map, matched by namespace selectors."""
+    ns_map = namespaces or {}
+
+    def ns_labels_of(q: t.Pod) -> dict[str, str]:
+        return ns_map.get(q.namespace, {})
+
     P = len(pods)
     N = nt.num_nodes
     NC = nt.alloc.shape[0]
@@ -177,7 +192,10 @@ def encode_pod_affinity(
                     dict(terms=aff, ns=p.namespace),
                 )
                 fa_slots[i].append(rid)
-            fa_self[i] = all(term_matches_pod(tm, p.namespace, p) for tm in aff)
+            fa_self[i] = all(
+                term_matches_pod(tm, p.namespace, p, ns_labels_of(p))
+                for tm in aff
+            )
         for term in _req_anti_terms(p):
             rid = row(
                 "RA", term.topology_key, ("term", term, p.namespace),
@@ -270,9 +288,12 @@ def encode_pod_affinity(
     def count_match(meta: dict, q: t.Pod) -> bool:
         kind = meta["kind"]
         if kind == "FA":
-            return all(term_matches_pod(tm, meta["ns"], q) for tm in meta["terms"])
+            return all(
+                term_matches_pod(tm, meta["ns"], q, ns_labels_of(q))
+                for tm in meta["terms"]
+            )
         if kind in ("RA", "SCI"):
-            return term_matches_pod(meta["term"], meta["ns"], q)
+            return term_matches_pod(meta["term"], meta["ns"], q, ns_labels_of(q))
         # EA/SCH/SCP rows count pods that HAVE the term — membership was
         # resolved when the row was appended for that pod, so here we only
         # get called for base sums via ex_rows/pend_rows, not a predicate.
@@ -326,7 +347,7 @@ def encode_pod_affinity(
         lst = [
             r for r, meta in enumerate(row_meta)
             if meta["kind"] == "EA"
-            and term_matches_pod(meta["term"], meta["ns"], p)
+            and term_matches_pod(meta["term"], meta["ns"], p, ns_labels_of(p))
         ]
         ea_lists.append(lst)
     CE = max((len(x) for x in ea_lists), default=1) or 1
@@ -351,10 +372,10 @@ def encode_pod_affinity(
         # existing pods' terms vs this pod (scoring.go:110-124)
         for r, meta in enumerate(row_meta):
             if meta["kind"] == "SCH" and hard_pod_affinity_weight > 0:
-                if term_matches_pod(meta["term"], meta["ns"], p):
+                if term_matches_pod(meta["term"], meta["ns"], p, ns_labels_of(p)):
                     w[r] = w.get(r, 0) + hard_pod_affinity_weight
             elif meta["kind"] == "SCP":
-                if term_matches_pod(meta["term"], meta["ns"], p):
+                if term_matches_pod(meta["term"], meta["ns"], p, ns_labels_of(p)):
                     w[r] = w.get(r, 0) + meta["sign"] * meta["weight"]
         sc_lists.append(sorted(w.items()))
     CS = max((len(x) for x in sc_lists), default=1) or 1

@@ -79,6 +79,31 @@ def test_saturated_cluster_capacity_safety():
     np.testing.assert_array_equal(g, v)
 
 
+def test_no_fit_filter_overcommits_like_greedy():
+    """With the NodeResourcesFit FILTER disabled nothing masks a full node,
+    so the greedy scan overcommits; the batched engine must not re-impose a
+    capacity projection in its acceptance step (ADVICE r2 finding d) — the
+    two engines must still agree pod-for-pod."""
+    profile = C.Profile(
+        filters=C.PluginSet(enabled=()),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+    cache = Cache()
+    for i in range(3):
+        cache.add_node(make_node(f"n{i}", cpu_milli=1000, memory=1024**3))
+    # 2000m demand vs 1000m capacity per node: every pod must still land
+    pending = [
+        make_pod(f"p{j}", cpu_milli=500, memory=128 * 1024**2,
+                 creation_index=j)
+        for j in range(12)
+    ]
+    g, v, *_ = run_both(cache, pending, profile)
+    assert (g >= 0).all()          # greedy overcommits rather than failing
+    assert (v >= 0).all()          # batched must not reject on capacity
+    np.testing.assert_array_equal(g, v)
+
+
 def test_final_state_matches_greedy():
     """The 7-slot final state (the cache's assume input) must agree."""
     cache = Cache()

@@ -150,6 +150,32 @@ class TestConvert:
         assert p.images == ("nginx:1.25",)
         assert p.creation_index == 1767323045
 
+    def test_sidecar_init_container_accounting(self):
+        """A restartPolicy: Always init container (sidecar) keeps its
+        requests for the pod's lifetime (helpers.go:243,438) — max-merging
+        it like a plain init container undercounts and overcommits nodes."""
+        obj = {
+            "metadata": {"name": "p", "namespace": "default"},
+            "spec": {
+                "containers": [
+                    {"name": "app", "resources": {"requests": {"cpu": "1"}}},
+                ],
+                "initContainers": [
+                    {"name": "sidecar", "restartPolicy": "Always",
+                     "resources": {"requests": {"cpu": "500m"}}},
+                    {"name": "setup",
+                     "resources": {"requests": {"cpu": "1200m"}}},
+                ],
+            },
+        }
+        p = pod_from_v1(obj)
+        # app 1000 + sidecar 500 = 1500; init peak = 1200 + 500 = 1700
+        assert p.requests_dict()["cpu"] == 1700
+        # without the sidecar marker the old (wrong) answer was
+        # max(1000, 1200) = 1200 — a 500m undercount
+        obj["spec"]["initContainers"][0].pop("restartPolicy")
+        assert pod_from_v1(obj).requests_dict()["cpu"] == 1200
+
     def test_node_round_trip(self):
         n = node_from_v1(V1_NODE)
         assert n.name == "node-a"
@@ -257,6 +283,37 @@ class TestWebhook:
         })
         assert [n["metadata"]["name"] for n in res["Nodes"]["Items"]] == []
         assert "u0" in res["FailedNodes"]
+
+    def test_affinity_failures_are_resolvable(self, server):
+        """Pod-affinity/spread Filter failures depend on which pods sit on
+        the node — the reference returns plain Unschedulable for them
+        (interpodaffinity/filtering.go:436), keeping the node a preemption
+        candidate. Reporting them as FailedAndUnresolvableNodes would make
+        a real kube-scheduler skip the node in the preemption dry-run."""
+        host = "kubernetes.io/hostname"
+        _post(server.url + "/cache/nodes", {"Nodes": [
+            _v1_node("a0", cpu="4", labels={host: "a0"}),
+            _v1_node("a1", cpu="4", labels={host: "a1"}),
+        ]})
+        db = _v1_pod("db", cpu="1", node="a0")
+        db["metadata"]["labels"] = {"app": "db"}
+        _post(server.url + "/cache/pods", {"Pods": [db]})
+        incoming = _v1_pod("p-anti", cpu="1")
+        incoming["spec"]["affinity"] = {
+            "podAntiAffinity": {
+                "requiredDuringSchedulingIgnoredDuringExecution": [{
+                    "topologyKey": host,
+                    "labelSelector": {"matchLabels": {"app": "db"}},
+                }],
+            },
+        }
+        res = _post(server.url + "/filter", {
+            "Pod": incoming, "NodeNames": ["a0", "a1"],
+        })
+        assert res["NodeNames"] == ["a1"]
+        # a0 fails ONLY via anti-affinity: resolvable, preemption may help
+        assert "a0" in res["FailedNodes"]
+        assert "a0" not in res["FailedAndUnresolvableNodes"]
 
     def test_prioritize_host_priority_list(self, server):
         _post(server.url + "/cache/nodes", {"Nodes": [

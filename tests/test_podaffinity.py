@@ -146,6 +146,77 @@ def test_anti_affinity_excludes_one_per_host():
     assert got[3] is None      # nowhere left
 
 
+def _ns_cluster():
+    """Two labeled namespaces, two nodes, one team-a db pod on n0."""
+    cache = Cache()
+    cache.add_namespace(t.Namespace(name="team-a", labels=(("team", "a"),)))
+    cache.add_namespace(t.Namespace(name="team-b", labels=(("team", "b"),)))
+    for i in range(2):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000,
+                                 labels={HOST: f"n{i}"}))
+    cache.add_pod(make_pod("db", namespace="team-a", cpu_milli=10,
+                           labels={"app": "db"}, node_name="n0"))
+    return cache
+
+
+def test_namespace_selector_matches_namespace_labels():
+    """A term's namespaceSelector is evaluated against the TARGET pod's
+    namespace labels (AffinityTerm.Matches, framework/types.go) — the
+    nsLister view lives in the snapshot's namespaces map."""
+    cache = _ns_cluster()
+    term = pod_affinity_term(
+        HOST, match_labels={"app": "db"},
+        namespace_selector=t.LabelSelector(match_labels=(("team", "a"),)),
+    )
+    aff = t.Affinity(pod_affinity=t.PodAffinity(required=(term,)))
+    p = make_pod("p", namespace="team-b", cpu_milli=10, affinity=aff)
+    profile = affinity_profile()
+    batch = encode_batch(cache.update_snapshot(), [p], profile)
+    assert greedy_assign(batch, profile) == ["n0"]
+
+    # selector matching no namespace labels → no target pods → unschedulable
+    # (p does not self-match: wrong labels AND wrong namespace)
+    term2 = pod_affinity_term(
+        HOST, match_labels={"app": "db"},
+        namespace_selector=t.LabelSelector(match_labels=(("team", "zzz"),)),
+    )
+    aff2 = t.Affinity(pod_affinity=t.PodAffinity(required=(term2,)))
+    p2 = make_pod("p2", namespace="team-b", cpu_milli=10, affinity=aff2)
+    batch = encode_batch(cache.update_snapshot(), [p2], profile)
+    assert greedy_assign(batch, profile) == [None]
+
+
+def test_namespace_selector_anti_affinity():
+    """Anti-affinity across namespaces via namespaceSelector: the team-a db
+    pod on n0 repels a team-b pod whose term selects team=a namespaces."""
+    cache = _ns_cluster()
+    term = pod_affinity_term(
+        HOST, match_labels={"app": "db"},
+        namespace_selector=t.LabelSelector(match_labels=(("team", "a"),)),
+    )
+    aff = t.Affinity(pod_anti_affinity=t.PodAffinity(required=(term,)))
+    p = make_pod("p", namespace="team-b", cpu_milli=10, affinity=aff)
+    profile = affinity_profile()
+    batch = encode_batch(cache.update_snapshot(), [p], profile)
+    assert greedy_assign(batch, profile) == ["n1"]
+
+
+def test_empty_namespace_selector_matches_all():
+    """A non-nil but EMPTY namespaceSelector is labels.Everything(): it
+    matches every namespace (podaffinity docstring / reference nil-vs-empty
+    contract), so the team-a db pod is visible from team-b."""
+    cache = _ns_cluster()
+    term = pod_affinity_term(
+        HOST, match_labels={"app": "db"},
+        namespace_selector=t.LabelSelector(),
+    )
+    aff = t.Affinity(pod_anti_affinity=t.PodAffinity(required=(term,)))
+    p = make_pod("p", namespace="team-b", cpu_milli=10, affinity=aff)
+    profile = affinity_profile()
+    batch = encode_batch(cache.update_snapshot(), [p], profile)
+    assert greedy_assign(batch, profile) == ["n1"]
+
+
 def test_affinity_self_escape_then_colocate():
     """First pod of a self-affine series passes via the escape clause; later
     pods must land in the same zone (counting the in-batch assignment)."""

@@ -20,6 +20,50 @@ def test_pod_requests_aggregation():
     assert req[t.MEMORY] == 200
 
 
+def test_sidecar_init_containers_persist():
+    """restartPolicy: Always init containers (sidecars) run for the pod's
+    lifetime: their requests ADD to the container sum instead of only
+    peaking during init (component-helpers/resource/helpers.go:243,438)."""
+    # one app container (100m) + one sidecar (200m) + one plain init (250m).
+    # total = 100 + 200 = 300; init peak = max(sidecar_sum=200, 250+200=450)
+    # -> final cpu = max(300, 450) = 450
+    req = pod_requests(
+        containers=[{t.CPU: 100}],
+        init_containers=[{t.CPU: 200}, {t.CPU: 250}],
+        init_restartable=[True, False],
+    )
+    assert req[t.CPU] == 450
+    # sidecar alone, no plain init: total = 100+200 = 300, peak = 200
+    req = pod_requests(
+        containers=[{t.CPU: 100}],
+        init_containers=[{t.CPU: 200}],
+        init_restartable=[True],
+    )
+    assert req[t.CPU] == 300
+    # plain init BEFORE the sidecar does not ride the sidecar sum
+    # (order matters: helpers.go accumulates sidecars as it walks)
+    req = pod_requests(
+        containers=[{t.CPU: 100}],
+        init_containers=[{t.CPU: 250}, {t.CPU: 200}],
+        init_restartable=[False, True],
+    )
+    # total = 100+200=300; peak = max(250, sidecar_sum-after=200) = 250
+    assert req[t.CPU] == 300
+    # two sidecars both persist
+    req = pod_requests(
+        containers=[{t.CPU: 100}],
+        init_containers=[{t.CPU: 200}, {t.CPU: 300}],
+        init_restartable=[True, True],
+    )
+    assert req[t.CPU] == 600
+    # without flags the old max-merge semantics hold (regression guard)
+    req = pod_requests(
+        containers=[{t.CPU: 100}],
+        init_containers=[{t.CPU: 200}, {t.CPU: 250}],
+    )
+    assert req[t.CPU] == 250
+
+
 def test_nonzero_defaults_per_container():
     # types.go:1035 CalculateResource: defaults fill PER CONTAINER.
     # containers [{cpu:500m}, {memory:1GiB}] -> Non0CPU=600m, Non0Mem=1GiB+200MiB
