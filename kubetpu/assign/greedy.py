@@ -45,10 +45,15 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         requests=b.requests[i][None],
         nonzero_requests=b.nonzero_requests[i][None],
         pod_valid=b.pod_valid[i][None],
-        static_mask=row(b.static_mask),
-        node_affinity_raw=row(b.node_affinity_raw),
-        taint_prefer_raw=row(b.taint_prefer_raw),
-        image_sum_scores=row(b.image_sum_scores),
+        # (S, N) signature arrays pass through whole; the view narrows only
+        # the per-pod row indices (device gathers the row inside the kernel)
+        static_mask=b.static_mask,
+        static_sig=row(b.static_sig),
+        node_affinity_raw=b.node_affinity_raw,
+        taint_prefer_raw=b.taint_prefer_raw,
+        score_sig=row(b.score_sig),
+        image_sum_scores=b.image_sum_scores,
+        image_sig=row(b.image_sig),
         image_count=row(b.image_count),
         pod_ports=b.pod_ports[i][None],
         node_ports=b.node_ports,

@@ -77,6 +77,7 @@ class ExtenderBackend:
         # NodeInfos whose generation moved, so an unchanged cache costs O(Δ)
         # per webhook hit (cache.go:190 UpdateSnapshot semantics)
         self._snapshot = None
+        self._prev_nt = None  # incremental NodeTensors (encode_snapshot prev)
         # pods seen in filter/prioritize args, by uid — bind args carry only
         # the pod's identity (ExtenderBindingArgs), so the real requests for
         # cache accounting come from the preceding scheduling call
@@ -139,7 +140,10 @@ class ExtenderBackend:
                 for n in extra_nodes:
                     self.cache.add_node(n)
             self._snapshot = self.cache.update_snapshot(self._snapshot)
-            batch = rt.encode_batch(self._snapshot, [pod], self.profile)
+            batch = rt.encode_batch(
+                self._snapshot, [pod], self.profile, prev_nt=self._prev_nt
+            )
+            self._prev_nt = batch.node_tensors
             params = rt.score_params(self.profile, batch.resource_names)
         return batch, params
 

@@ -53,16 +53,16 @@ class DeviceBatch:
     requests: jnp.ndarray           # (P, R) int64 exact
     nonzero_requests: jnp.ndarray   # (P, R) int64
     pod_valid: jnp.ndarray          # (P,) bool
-    # static per-(pod,node) facts from the encoder. The int64 (P, N) raw
-    # score tensors are ~N*P*8 bytes each — None (an empty pytree leaf) when
-    # the profile does not score that plugin, so a resources-only workload at
-    # 5k nodes × 10k pods does not hold gigabytes of zeros in HBM. The bool
-    # mask is None when no pod has a static constraint (all-True over valid
-    # rows).
-    static_mask: jnp.ndarray | None        # (P, N) bool
-    node_affinity_raw: jnp.ndarray | None  # (P, N) int64
-    taint_prefer_raw: jnp.ndarray | None   # (P, N) int64
-    image_sum_scores: jnp.ndarray | None   # (P, N) int64
+    # static per-(pod,node) facts from the encoder, SIGNATURE-compressed:
+    # (S, N) rows for S distinct pod signatures plus a per-pod (P,) row
+    # index; kernels gather rows on device (the host→device transfer and
+    # host encode are O(S·N), not O(P·N) — S=1 for replicated workloads).
+    # None (an empty pytree leaf) when the profile does not score that
+    # plugin / no pod has a static constraint.
+    static_mask: jnp.ndarray | None        # (S, N) bool
+    node_affinity_raw: jnp.ndarray | None  # (S2, N) int64
+    taint_prefer_raw: jnp.ndarray | None   # (S2, N) int64
+    image_sum_scores: jnp.ndarray | None   # (S3, N) int64
     image_count: jnp.ndarray | None        # (P,) int32
     # NodePorts dynamic filter (interned triples, see encoder._encode_ports)
     pod_ports: jnp.ndarray          # (P, K) bool
@@ -81,6 +81,11 @@ class DeviceBatch:
     spread: "SpreadDevice | None" = None
     # InterPodAffinity (None when no pod carries (anti)affinity)
     podaffinity: "PodAffinityDevice | None" = None
+    # per-pod signature row indices for the (S, N) arrays above (None when
+    # the matching array is None)
+    static_sig: jnp.ndarray | None = None  # (P,) int32 row into static_mask
+    score_sig: jnp.ndarray | None = None   # (P,) int32 row into na/tt raws
+    image_sig: jnp.ndarray | None = None   # (P,) int32 row into image sums
 
 
 @jax.tree_util.register_dataclass
@@ -160,31 +165,31 @@ def _is_scalar(resource_names: Sequence[str]) -> np.ndarray:
 
 def _image_tensors(
     nt: enc.NodeTensors, pods: Sequence[t.Pod], pad_pods: int | None = None
-) -> tuple[np.ndarray, np.ndarray]:
+) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
     """ImageLocality host encoding (imagelocality/image_locality.go:60
     sumImageScores + :118 scaledImageScore): per (pod, node) the sum over the
     pod's container images present on the node of
-    ``size * numNodesWithImage // totalNumNodes``."""
+    ``size * numNodesWithImage // totalNumNodes``. Signature-compressed: one
+    (N,) row per distinct image set, pods carry the row index."""
     N = nt.num_nodes
     NC = nt.alloc.shape[0]
     P = len(pods)
     PP = max(pad_pods or P, P)
     total = max(N, 1)
-    sums = np.zeros((PP, NC), dtype=np.int64)
     counts = np.zeros(PP, dtype=np.int32)
+    sig = np.zeros(PP, dtype=np.int32)
     if not any(p.images for p in pods):
-        return sums, counts
+        return np.zeros((1, NC), dtype=np.int64), sig, counts
     node_images: list[dict[str, t.ImageState]] = [
         dict(info.node.images) for info in nt.infos
     ]
-    cache: dict[tuple[str, ...], np.ndarray] = {}
+    ids: dict[tuple[str, ...], int] = {(): 0}
+    rows: list[np.ndarray] = [np.zeros(N, dtype=np.int64)]
     for i, p in enumerate(pods):
         counts[i] = len(p.images)
-        if not p.images:
-            continue
         key = p.images
-        v = cache.get(key)
-        if v is None:
+        sid = ids.get(key)
+        if sid is None:
             v = np.zeros(N, dtype=np.int64)
             for n_i, imgs in enumerate(node_images):
                 s = 0
@@ -193,9 +198,14 @@ def _image_tensors(
                     if st is not None:
                         s += st.size_bytes * st.num_nodes // total
                 v[n_i] = s
-            cache[key] = v
-        sums[i, :N] = v
-    return sums, counts
+            sid = len(rows)
+            ids[key] = sid
+            rows.append(v)
+        sig[i] = sid
+    sums = np.zeros((len(rows), NC), dtype=np.int64)
+    for s, v in enumerate(rows):
+        sums[s, :N] = v
+    return sums, sig, counts
 
 
 def encode_batch(
@@ -205,6 +215,7 @@ def encode_batch(
     pad: bool = True,
     resource_names: Sequence[str] | None = None,
     nominated: Sequence = (),
+    prev_nt: "enc.NodeTensors | None" = None,
 ) -> EncodedBatch:
     """Snapshot + pending pods → padded device batch.
 
@@ -212,12 +223,17 @@ def encode_batch(
     XLA compile cache (SURVEY §7 'dynamic shapes'): padded nodes have zero
     allocatable and ``allowed_pods``=0 (infeasible for every pod), padded pods
     have an all-False static mask.
+
+    ``prev_nt``: the previous cycle's ``EncodedBatch.node_tensors`` — lets
+    ``encode_snapshot`` refresh only the node rows whose generation moved
+    (the loop's per-cycle host encode becomes O(Δ + batch)).
     """
     N, P = snapshot.num_nodes(), len(pods)
     NP = enc.round_up(N) if pad else N
     PP = enc.round_up(P) if pad else P
     nt = enc.encode_snapshot(
-        snapshot, resource_names=resource_names, pods=pods, pad_nodes=NP
+        snapshot, resource_names=resource_names, pods=pods, pad_nodes=NP,
+        prev=prev_nt,
     )
     enabled = (
         frozenset(profile.filters.names()) if profile is not None else None
@@ -290,8 +306,9 @@ def encode_batch(
                 has_hard=sp.has_hard,
                 has_soft=sp.has_soft,
             )
-    img_sums, img_counts = (
-        _image_tensors(nt, pods, pad_pods=PP) if want_img else (None, None)
+    img_sums, img_sig, img_counts = (
+        _image_tensors(nt, pods, pad_pods=PP)
+        if want_img else (None, None, None)
     )
     node_valid = np.zeros(NP, dtype=bool)
     node_valid[:N] = True
@@ -340,6 +357,9 @@ def encode_batch(
         static_mask=(
             jnp.asarray(pb.static_mask) if pb.static_mask is not None else None
         ),
+        static_sig=(
+            jnp.asarray(pb.static_sig) if pb.static_mask is not None else None
+        ),
         node_affinity_raw=(
             jnp.asarray(pb.node_affinity_raw)
             if want_na and pb.node_affinity_raw is not None else None
@@ -348,7 +368,15 @@ def encode_batch(
             jnp.asarray(pb.taint_prefer_raw)
             if want_tt and pb.taint_prefer_raw is not None else None
         ),
+        score_sig=(
+            jnp.asarray(pb.score_sig)
+            if pb.score_sig is not None
+            and ((want_na and pb.node_affinity_raw is not None)
+                 or (want_tt and pb.taint_prefer_raw is not None))
+            else None
+        ),
         image_sum_scores=jnp.asarray(img_sums) if want_img else None,
+        image_sig=jnp.asarray(img_sig) if want_img else None,
         image_count=jnp.asarray(img_counts) if want_img else None,
         pod_ports=jnp.asarray(pb.pod_ports),
         node_ports=jnp.asarray(pb.node_ports),
@@ -459,7 +487,12 @@ def filter_components(
 
     static = b.node_valid[None, :] & b.pod_valid[:, None]
     if b.static_mask is not None:
-        static = static & b.static_mask
+        # (S, N) rows gathered per pod on device (fused into consumers)
+        sm = (
+            b.static_mask[b.static_sig]
+            if b.static_sig is not None else b.static_mask
+        )
+        static = static & sm
     fit = None
     if p.filter_fit:
         if b.nominated_node is not None:
@@ -594,17 +627,23 @@ def feasible_and_scores(
         raw = S.balanced_allocation_score(b.requests, req, b.alloc, w_bal, scal)
         total = total + p.w_balanced * raw
     if p.w_node_affinity and b.node_affinity_raw is not None:
-        total = total + p.w_node_affinity * masked_normalize(
-            b.node_affinity_raw, mask
+        na_raw = (
+            b.node_affinity_raw[b.score_sig]
+            if b.score_sig is not None else b.node_affinity_raw
         )
+        total = total + p.w_node_affinity * masked_normalize(na_raw, mask)
     if p.w_taint and b.taint_prefer_raw is not None:
-        total = total + p.w_taint * masked_normalize(
-            b.taint_prefer_raw, mask, reverse=True
+        tt_raw = (
+            b.taint_prefer_raw[b.score_sig]
+            if b.score_sig is not None else b.taint_prefer_raw
         )
+        total = total + p.w_taint * masked_normalize(tt_raw, mask, reverse=True)
     if p.w_image and b.image_sum_scores is not None:
-        total = total + p.w_image * S.image_locality_score(
-            b.image_sum_scores, b.image_count
+        img = (
+            b.image_sum_scores[b.image_sig]
+            if b.image_sig is not None else b.image_sum_scores
         )
+        total = total + p.w_image * S.image_locality_score(img, b.image_count)
     if sp is not None and p.w_spread and sp.has_soft:
         spread_sc = jax.vmap(
             lambda si, ac, ms, ig, m: SP.spread_score_pod(

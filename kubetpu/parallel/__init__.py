@@ -9,4 +9,4 @@ node-sharded; per-pod tensors are replicated. XLA inserts the collectives
 (the per-pod argmax becomes a cross-shard max reduction over ICI).
 """
 
-from .mesh import make_mesh, shard_batch, sharded_greedy  # noqa: F401
+from .mesh import make_mesh, shard_batch, sharded_batched, sharded_greedy  # noqa: F401

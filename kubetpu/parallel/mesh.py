@@ -97,3 +97,19 @@ def sharded_greedy(
 
     sb = shard_batch(b, mesh, axis)
     return greedy_assign_device(sb, params)
+
+
+def sharded_batched(
+    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: str = "nodes",
+    max_rounds: int = 0,
+):
+    """Shard the batch and run the capacity-coupled round engine
+    (assign.batched) under the mesh. Each round's (P, N) filter+score is
+    node-shard-local; the tie-spread argmax and one-per-node acceptance sort
+    become cross-shard collectives XLA inserts from the shardings — the
+    engine body is unchanged (SPMD via sharding annotations, not explicit
+    communication)."""
+    from ..assign.batched import batched_assign_device
+
+    sb = shard_batch(b, mesh, axis)
+    return batched_assign_device(sb, params, max_rounds=max_rounds)

@@ -102,9 +102,13 @@ def _pref_anti_terms(pod: t.Pod) -> tuple[t.WeightedPodAffinityTerm, ...]:
 
 
 def has_any_affinity(pod: t.Pod) -> bool:
+    a = pod.affinity
+    if a is None:
+        return False
+    pa, paa = a.pod_affinity, a.pod_anti_affinity
     return bool(
-        _req_affinity_terms(pod) or _req_anti_terms(pod)
-        or _pref_affinity_terms(pod) or _pref_anti_terms(pod)
+        (pa is not None and (pa.required or pa.preferred))
+        or (paa is not None and (paa.required or paa.preferred))
     )
 
 

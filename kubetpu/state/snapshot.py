@@ -33,6 +33,10 @@ class NodeInfo:
     pods: dict[str, t.Pod] = field(default_factory=dict)  # uid -> pod
     requested: dict[str, int] = field(default_factory=dict)
     nonzero_requested: dict[str, int] = field(default_factory=dict)
+    # refcounted (hostPort, protocol, hostIP) triples in use on this node
+    # (fwk.NodeInfo.UsedPorts) — maintained here so the per-cycle port
+    # encoding is O(nodes-with-ports), not O(all pods)
+    port_triples: dict[tuple[int, str, str], int] = field(default_factory=dict)
     generation: int = 0
 
     def add_pod(self, pod: t.Pod) -> None:
@@ -41,6 +45,10 @@ class NodeInfo:
             self.requested[k] = self.requested.get(k, 0) + v
         for k, v in pod.nonzero_requests().items():
             self.nonzero_requested[k] = self.nonzero_requested.get(k, 0) + v
+        for cp in pod.ports:
+            if cp.host_port > 0:
+                tr = (cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0")
+                self.port_triples[tr] = self.port_triples.get(tr, 0) + 1
 
     def remove_pod(self, pod: t.Pod) -> None:
         if pod.uid not in self.pods:
@@ -50,6 +58,14 @@ class NodeInfo:
             self.requested[k] = self.requested.get(k, 0) - v
         for k, v in pod.nonzero_requests().items():
             self.nonzero_requested[k] = self.nonzero_requested.get(k, 0) - v
+        for cp in pod.ports:
+            if cp.host_port > 0:
+                tr = (cp.host_port, cp.protocol or "TCP", cp.host_ip or "0.0.0.0")
+                left = self.port_triples.get(tr, 0) - 1
+                if left > 0:
+                    self.port_triples[tr] = left
+                else:
+                    self.port_triples.pop(tr, None)
 
     def clone(self) -> "NodeInfo":
         return NodeInfo(
@@ -57,6 +73,7 @@ class NodeInfo:
             pods=dict(self.pods),
             requested=dict(self.requested),
             nonzero_requested=dict(self.nonzero_requested),
+            port_triples=dict(self.port_triples),
             generation=self.generation,
         )
 

@@ -137,7 +137,7 @@ def test_static_mask_taints_affinity(seed):
                 and oracle.node_affinity_filter(pod, info)
                 and not info.node.unschedulable
             )
-            assert pb.static_mask[i, j] == want, (pod.name, info.node.name)
+            assert pb.static_row(i)[j] == want, (pod.name, info.node.name)
 
 
 @pytest.mark.parametrize("seed", [0, 1])
@@ -195,8 +195,8 @@ def test_taint_prefer_and_node_affinity_raw_scores():
     infos = snap.node_infos()
     for i, pod in enumerate(pending):
         for j, info in enumerate(infos):
-            assert pb.node_affinity_raw[i, j] == oracle.node_affinity_score_raw(pod, info)
-            assert pb.taint_prefer_raw[i, j] == oracle.taint_score_raw(pod, info)
+            assert pb.na_row(i)[j] == oracle.node_affinity_score_raw(pod, info)
+            assert pb.tt_row(i)[j] == oracle.taint_score_raw(pod, info)
 
 
 def test_default_normalize_matches_oracle():
@@ -269,8 +269,8 @@ def test_unknown_resource_request_is_infeasible_everywhere():
     # encode WITHOUT passing pods: the axis omits the fpga resource
     nt = encode_snapshot(snap)
     pb = encode_pod_batch(nt, pending)
-    assert not pb.static_mask[0].any()
-    assert pb.static_mask[1].all()
+    assert not pb.static_row(0).any()
+    assert pb.static_row(1).all()
 
 
 def test_second_snapshot_not_stale():
