@@ -230,6 +230,10 @@ class Pod:
     images: tuple[str, ...] = ()          # container images, for ImageLocality
     preemption_policy: str = "PreemptLowerPriority"  # or "Never"
     creation_index: int = 0  # monotonic stand-in for creationTimestamp
+    # spec.schedulingGroup.podGroupName (core/v1 types.go:4641
+    # PodSchedulingGroup) — names a PodGroup in the pod's namespace; drives
+    # gang / workload-aware scheduling. "" = not a group member.
+    scheduling_group: str = ""
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -251,6 +255,31 @@ class Pod:
 
     def with_node(self, node_name: str) -> "Pod":
         return dataclasses.replace(self, node_name=node_name)
+
+
+@dataclass(frozen=True)
+class GangPolicy:
+    """GangSchedulingPolicy (scheduling/v1alpha3 types.go:237): the group is
+    admitted only when ``min_count`` pods can be scheduled together."""
+
+    min_count: int = 1
+
+
+@dataclass(frozen=True)
+class PodGroup:
+    """The scheduling slice of scheduling/v1alpha3 PodGroup (types.go:339):
+    gang policy + topology constraint keys (SchedulingConstraints.Topology,
+    types.go:595 — all pods of the group colocate within one domain of each
+    key; currently a single key, like the reference)."""
+
+    name: str
+    namespace: str = "default"
+    gang: GangPolicy | None = None
+    topology_keys: tuple[str, ...] = ()
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 @dataclass(frozen=True)

@@ -59,6 +59,7 @@ def make_pod(
     images: Sequence[str] = (),
     creation_index: int = 0,
     preemption_policy: str = "PreemptLowerPriority",
+    scheduling_group: str = "",
 ) -> t.Pod:
     nonzero = None
     if containers is not None:
@@ -100,6 +101,23 @@ def make_pod(
         images=tuple(images),
         creation_index=creation_index,
         preemption_policy=preemption_policy,
+        scheduling_group=scheduling_group,
+    )
+
+
+def make_pod_group(
+    name: str,
+    namespace: str = "default",
+    min_count: int | None = None,
+    topology_keys: Sequence[str] = (),
+) -> t.PodGroup:
+    """A PodGroup with an optional gang policy (min_count) and topology
+    constraint keys (scheduling/v1alpha3 PodGroupSpec)."""
+    return t.PodGroup(
+        name=name,
+        namespace=namespace,
+        gang=t.GangPolicy(min_count=min_count) if min_count else None,
+        topology_keys=tuple(topology_keys),
     )
 
 

@@ -1,0 +1,64 @@
+"""Device-parallel placement search for pod-group (gang) scheduling.
+
+The reference evaluates candidate placements SEQUENTIALLY: for each
+placement it restricts the snapshot, runs the per-pod algorithm, reverts,
+and finally scores the successful placements
+(pkg/scheduler/schedule_one_podgroup.go:632 podGroupSchedulingPlacementAlgorithm,
+framework/plugins/topologyaware/topology_placement.go:61 GeneratePlacements).
+
+TPU-native re-design: a placement is a ``(N,)`` node mask; all D candidate
+placements are stacked into a ``(D, N)`` tensor and the WHOLE search runs as
+one device program — ``vmap`` of the assignment engine over the placement
+axis. Every placement's simulation is independent (the reference reverts
+between them), so the vmap is semantically exact, and the D sequential
+snapshot-restrict/simulate/revert rounds become one batched program.
+
+Placement selection (findBestPlacement, schedule_one_podgroup.go:706) uses
+PlacementScore plugins; the in-tree scorer is PodGroupPodsCount
+(plugins/podgrouppodscount/podgroup_pods_count.go:52 — scheduled + proposed
+count, min-max normalized). With one scorer, normalization is monotone, so
+argmax of the raw count picks the same placement; ties break on the FIRST
+placement in generation order (deterministic) where the reference picks a
+random tie (score.Randomizer) — same documented tie-break budget as the
+greedy scan's first-max-node rule.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from ..framework import runtime as rt
+
+
+@partial(jax.jit, static_argnames=("params", "engine"))
+def placement_assign_device(
+    b: rt.DeviceBatch,
+    params: rt.ScoreParams,
+    placement_masks: jnp.ndarray,     # (D, N) bool — candidate node subsets
+    engine: str = "greedy",
+):
+    """Run the assignment engine once per placement, all on device.
+
+    Returns ``(assignments (D, P) int32, counts (D,) int32)`` where
+    ``counts[d]`` is how many batch pods placement d schedules (the
+    ProposedAssignments count the placement scorer consumes).
+    """
+    if engine == "batched":
+        from .batched import batched_assign_device as assign
+    else:
+        from .greedy import greedy_assign_device as assign
+
+    def one(mask):
+        bb = dataclasses.replace(b, node_valid=b.node_valid & mask)
+        assignments, _ = assign(bb, params)
+        return assignments
+
+    assignments = jax.vmap(one)(placement_masks)              # (D, P)
+    counts = jnp.sum(
+        (assignments >= 0) & b.pod_valid[None, :], axis=1
+    ).astype(jnp.int32)
+    return assignments, counts

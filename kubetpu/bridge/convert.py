@@ -222,6 +222,28 @@ def pod_from_v1(obj: _JSON) -> t.Pod:
         preemption_policy=spec.get("preemptionPolicy", "PreemptLowerPriority")
         or "PreemptLowerPriority",
         creation_index=_creation_index(meta),
+        scheduling_group=(
+            (spec.get("schedulingGroup") or {}).get("podGroupName") or ""
+        ),
+    )
+
+
+def pod_group_from_v1alpha3(obj: _JSON) -> t.PodGroup:
+    """Decode a scheduling/v1alpha3 PodGroup (types.go:339) — gang policy +
+    topology constraint keys."""
+    meta = obj.get("metadata") or {}
+    spec = obj.get("spec") or {}
+    policy = spec.get("schedulingPolicy") or {}
+    gang = policy.get("gang")
+    constraints = spec.get("schedulingConstraints") or {}
+    keys = tuple(
+        c.get("key", "") for c in constraints.get("topology") or () if c.get("key")
+    )
+    return t.PodGroup(
+        name=meta.get("name", ""),
+        namespace=meta.get("namespace", "default") or "default",
+        gang=t.GangPolicy(min_count=int(gang.get("minCount", 1))) if gang else None,
+        topology_keys=keys,
     )
 
 

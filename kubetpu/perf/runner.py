@@ -104,6 +104,18 @@ class _Client:
                 self.sched.on_pod_delete(a)
 
 
+def _begin_measured_phase(sched, warmup: bool, warm_pods) -> tuple[int, int, int]:
+    """Optionally compile the measured phase's device program, then snapshot
+    the metric counters the measurement is scoped to."""
+    if warmup:
+        sched.warmup(warm_pods)
+    return (
+        sched.metrics.schedule_attempts,
+        sched.metrics.cycles,
+        len(sched.metrics.attempt_latencies),
+    )
+
+
 @dataclass
 class _Churn:
     op: W.ChurnOp
@@ -200,6 +212,45 @@ def run_workload(
                 sched.on_node_add(W.node_default(i, op.zones))
         elif isinstance(op, W.CreateNamespacesOp):
             pass  # namespaces exist implicitly; ops reference them by name
+        elif isinstance(op, W.CreatePodGroupsOp):
+            from ..api.wrappers import make_pod_group
+
+            groups = params[op.count_param]
+            min_count = params[op.min_count_param]
+            for g in range(groups):
+                sched.on_pod_group_add(make_pod_group(
+                    f"{op.prefix}-{g}", namespace=f"{op.prefix}-0",
+                    min_count=min_count,
+                ))
+        elif isinstance(op, W.CreateGangPodsOp):
+            from ..api.wrappers import make_pod
+
+            groups = params[op.count_param]
+            per = params[op.multiplier_param]
+            count = groups * per
+            if op.collect_metrics:
+                # group-lane shapes: one coalesced batch of plain pods
+                attempts0, cycles0, lat0 = _begin_measured_phase(
+                    sched, warmup,
+                    [
+                        make_pod(
+                            f"warmup-gang-{j}", namespace=op.namespace,
+                            cpu_milli=100, memory=100 * 1024**2,
+                        )
+                        for j in range(min(count, sched.max_batch))
+                    ],
+                )
+            for j in range(count):
+                sched.on_pod_add(make_pod(
+                    f"gangpod-{j}", namespace=op.namespace,
+                    cpu_milli=100, memory=100 * 1024**2,
+                    scheduling_group=f"{op.prefix}-{j // per}",
+                    creation_index=j,
+                ))
+            done, secs = settle(count)
+            if op.collect_metrics:
+                measured += done
+                duration += secs
         elif isinstance(op, W.ChurnOp):
             churns.append(_Churn(op=op, namespace=f"churn-{len(churns)}"))
         elif isinstance(op, W.BarrierOp):
@@ -214,14 +265,13 @@ def run_workload(
             # share one namespace (MixedSchedulingBasePod does)
             prefix = f"{'measure' if op.collect_metrics else 'init'}-{op_i}"
             if op.collect_metrics:
-                if warmup:
-                    sched.warmup([
+                attempts0, cycles0, lat0 = _begin_measured_phase(
+                    sched, warmup,
+                    [
                         template(f"warmup-{op_i}-{j}", ns)
                         for j in range(min(count, sched.max_batch))
-                    ])
-                attempts0 = sched.metrics.schedule_attempts
-                cycles0 = sched.metrics.cycles
-                lat0 = len(sched.metrics.attempt_latencies)
+                    ],
+                )
             for j in range(count):
                 pod = template(f"{prefix}-{ns}-{j}", ns)
                 sched.on_pod_add(pod)
@@ -254,6 +304,10 @@ def run_workload(
             params[op.count_param]
             for op in case.ops
             if isinstance(op, W.CreatePodsOp) and op.collect_metrics
+        ) + sum(
+            params[op.count_param] * params[op.multiplier_param]
+            for op in case.ops
+            if isinstance(op, W.CreateGangPodsOp) and op.collect_metrics
         ),
         scheduled=measured,
         duration_s=duration,

@@ -197,6 +197,31 @@ class CreatePodsOp:
 
 
 @dataclass(frozen=True)
+class CreatePodGroupsOp:
+    """operations.go createAny with a PodGroup template
+    (podgroup/gangscheduling/performance-config.yaml:18 + its
+    templates/podgroup.yaml: gangs gang-0..gang-(n-1), each with
+    minCount = podsPerGroup)."""
+
+    count_param: str = "initPodGroups"
+    min_count_param: str = "podsPerGroup"
+    prefix: str = "gang"
+
+
+@dataclass(frozen=True)
+class CreateGangPodsOp:
+    """createPods with countMultiplierParam (performance-config.yaml:28 +
+    templates/gang-pod.yaml): pod i references gang-(i // podsPerGroup);
+    100m cpu / 100Mi, like the reference template."""
+
+    count_param: str = "initPodGroups"
+    multiplier_param: str = "podsPerGroup"
+    prefix: str = "gang"
+    collect_metrics: bool = True
+    namespace: str = "gang-0"
+
+
+@dataclass(frozen=True)
 class ChurnOp:
     """operations.go:518 churnOp — create (or recreate) interfering objects
     at an interval while the measured phase runs."""
@@ -389,6 +414,30 @@ _case(TestCase(
         Workload("5000Nodes_5000Pods",
                  {"initNodes": 5000, "initPods": 2000, "measurePods": 5000},
                  threshold=540, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="GangScheduling",
+    source="podgroup/gangscheduling/performance-config.yaml:7 (no thresholds yet — new suite)",
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreateNamespacesOp("gang", 1),
+        CreatePodGroupsOp("initPodGroups", "podsPerGroup"),
+        CreateGangPodsOp("initPodGroups", "podsPerGroup",
+                         collect_metrics=True),
+    ),
+    workloads=(
+        Workload("10Nodes_3Gangs",
+                 {"initNodes": 10, "initPodGroups": 3, "podsPerGroup": 3}),
+        Workload("100Nodes_10Gangs",
+                 {"initNodes": 100, "initPodGroups": 10, "podsPerGroup": 3}),
+        Workload("5000Nodes_1000Gangs_3000Pods",
+                 {"initNodes": 5000, "initPodGroups": 1000, "podsPerGroup": 3},
+                 labels=("performance",)),
+        Workload("5000Nodes_3Gangs_3000Pods_1000PerGroup",
+                 {"initNodes": 5000, "initPodGroups": 3, "podsPerGroup": 1000},
+                 labels=("performance",)),
     ),
 ))
 

@@ -1,0 +1,466 @@
+"""Pod-group (gang) scheduling: state tracking + the group scheduling cycle.
+
+Reference surfaces mirrored:
+
+- ``PodGroupManager`` tracks member pods per group the way the reference's
+  pod-group state + queue-side pending pool do
+  (backend/queue/pending_pod_group_pods.go, fwk.PodGroupManager): pending
+  (unscheduled) members, scheduled (assumed/assigned) members, attempt
+  bookkeeping.
+- Quorum gating = the GangScheduling plugin's PreEnqueue
+  (plugins/gangscheduling/gangscheduling.go:130): a gang pod waits outside
+  the active lane until its PodGroup object exists and
+  AllPodsCount >= minCount.
+- The group cycle = scheduleOnePodGroup → podGroupCycle → the placement /
+  default algorithms (schedule_one_podgroup.go:43,:172,:319,:632), with the
+  all-or-nothing acceptance of the GangScheduling PlacementFeasible plugin
+  (gangscheduling.go:248: scheduled >= minCount, or UnschedulableAndUnresolvable
+  when remaining + scheduled < minCount).
+
+Batch-native re-shapes (documented deviations, same observable outcomes):
+
+- The reference fans gang pods one-at-a-time through Permit, where they WAIT
+  until minCount pods are assumed (gangscheduling.go Permit). Here the whole
+  group is decided atomically inside one device cycle, so there is nothing
+  to wait on: accepted groups go straight to binding, rejected groups roll
+  back in-cycle (the revertFn stack in podGroupSchedulingDefaultAlgorithm
+  becomes "never assume"). Permit-style waiting still exists for
+  out-of-tree plugins via the framework's extension points.
+- Topology-constrained groups run the device-parallel placement search
+  (assign/placement.py) instead of the sequential simulate/revert loop.
+- Unconstrained groups are BATCHED: many ready groups join one device
+  assignment; per-group all-or-nothing acceptance is applied to the result.
+  A rejected group's pods are never assumed, so later groups saw a
+  conservatively fuller cluster — they can only have been denied nodes, not
+  handed infeasible ones.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+from typing import TYPE_CHECKING
+
+import numpy as np
+
+from ..api import types as t
+from ..queue.priority_queue import QueuedPodInfo, pod_key
+
+if TYPE_CHECKING:
+    from .scheduler import Scheduler
+
+
+@dataclass
+class GroupEntry:
+    """Queue + state bookkeeping for one pod group (QueuedPodGroupInfo)."""
+
+    group: t.PodGroup | None = None           # None until informer delivers it
+    pending: dict[str, QueuedPodInfo] = field(default_factory=dict)  # key -> info
+    scheduled: dict[str, str] = field(default_factory=dict)  # pod key -> node
+    attempts: int = 0
+    unschedulable_count: int = 0
+    timestamp: float = 0.0
+    backoff_until: float = 0.0
+    parked: bool = False                      # unschedulable pool (event-woken)
+
+    def all_count(self) -> int:
+        return len(self.pending) + len(self.scheduled)
+
+    def min_count(self) -> int:
+        g = self.group
+        if g is None or g.gang is None:
+            return 1
+        return g.gang.min_count
+
+    def quorum_met(self) -> bool:
+        return self.group is not None and self.all_count() >= self.min_count()
+
+
+class PodGroupManager:
+    """Tracks pod groups and their member pods; owns the group-side queue
+    states (pending-quorum / active / backoff / parked)."""
+
+    def __init__(self, clock, initial_backoff: float = 1.0,
+                 max_backoff: float = 10.0) -> None:
+        self._clock = clock
+        self._initial_backoff = initial_backoff
+        self._max_backoff = max_backoff
+        self.entries: dict[str, GroupEntry] = {}   # "ns/name" -> entry
+
+    def _entry(self, namespace: str, name: str) -> GroupEntry:
+        key = f"{namespace}/{name}"
+        e = self.entries.get(key)
+        if e is None:
+            e = GroupEntry(timestamp=self._clock())
+            self.entries[key] = e
+        return e
+
+    def entry_for_pod(self, pod: t.Pod) -> GroupEntry:
+        return self._entry(pod.namespace, pod.scheduling_group)
+
+    # ---- informer surface ----------------------------------------------
+
+    def add_group(self, group: t.PodGroup) -> None:
+        e = self._entry(group.namespace, group.name)
+        e.group = group
+        # the gangscheduling PodGroup/Add hint (gangscheduling.go:109): a
+        # group add/update (e.g. lowered minCount) can revive a parked gang
+        e.parked = False
+
+    update_group = add_group
+
+    def remove_group(self, group: t.PodGroup) -> None:
+        e = self.entries.get(group.key)
+        if e is not None:
+            e.group = None
+
+    def add_pod(self, info: QueuedPodInfo) -> None:
+        """An unscheduled gang pod arrived (PreEnqueue holds it here until
+        quorum). A new member also un-parks the group — the GangScheduling
+        queueing hint for UnscheduledPod/Add (gangscheduling.go:95)."""
+        e = self.entry_for_pod(info.pod)
+        e.pending[info.key] = info
+        e.parked = False
+
+    def remove_pod(self, pod: t.Pod) -> None:
+        e = self.entries.get(f"{pod.namespace}/{pod.scheduling_group}")
+        if e is None:
+            return
+        e.pending.pop(pod_key(pod), None)
+        e.scheduled.pop(pod_key(pod), None)
+
+    def update_pod(self, pod: t.Pod) -> None:
+        """Informer update for an unbound member: refresh the stored object
+        (spec changes like priority/requests take effect next attempt)."""
+        e = self.entry_for_pod(pod)
+        info = e.pending.get(pod_key(pod))
+        if info is not None:
+            info.pod = pod
+        else:
+            self.add_pod(QueuedPodInfo(pod=pod, timestamp=self._clock()))
+
+    def mark_scheduled(self, pod: t.Pod, node_name: str) -> None:
+        e = self._entry(pod.namespace, pod.scheduling_group)
+        e.pending.pop(pod_key(pod), None)
+        e.scheduled[pod_key(pod)] = node_name
+        e.parked = False   # AssignedPod/Add hint (gangscheduling.go:82)
+
+    def unmark_scheduled(self, pod: t.Pod) -> None:
+        """Bind failed / assumed pod forgotten: the member is pending again."""
+        e = self._entry(pod.namespace, pod.scheduling_group)
+        e.scheduled.pop(pod_key(pod), None)
+
+    def requeue_member(self, info: QueuedPodInfo) -> None:
+        e = self.entry_for_pod(info.pod)
+        e.pending[info.key] = info
+
+    def wake_all(self) -> None:
+        """Cluster event that may free capacity (node add / assigned-pod
+        delete): un-park every parked group. Conservative analog of the
+        hint-driven moveAllToActiveOrBackoffQueue for group entities."""
+        for e in self.entries.values():
+            e.parked = False
+
+    # ---- queue-side ------------------------------------------------------
+
+    def _backoff_duration(self, e: GroupEntry) -> float:
+        """Group-level backoff caps at plain max_backoff. The reference's
+        sqrt(entity_size) cap scaling (backoff_queue.go:247) applies to the
+        per-pod queue's entity requeues and is kept there
+        (priority_queue._backoff_duration); a sqrt-scaled cap here (316 s
+        for a 1000-pod gang) would outlast every stall detector while the
+        reference's own leftover flush bounds staleness at 30 s anyway."""
+        if e.unschedulable_count == 0:
+            return 0.0
+        return min(
+            self._initial_backoff * (2.0 ** (e.unschedulable_count - 1)),
+            self._max_backoff,
+        )
+
+    def ready_groups(self) -> list[tuple[str, GroupEntry]]:
+        """Groups with quorum met, not parked, past backoff, with pending
+        pods — the pop-side of the group lane."""
+        now = self._clock()
+        out = []
+        for key, e in self.entries.items():
+            if not e.pending or e.parked or not e.quorum_met():
+                continue
+            if e.backoff_until > now:
+                continue
+            out.append((key, e))
+        # PrioritySort analog at group granularity: highest member priority
+        # first, then oldest
+        out.sort(key=lambda kv: (
+            -max((i.pod.priority for i in kv[1].pending.values()), default=0),
+            kv[1].timestamp,
+        ))
+        return out
+
+    def group_failed(self, e: GroupEntry) -> None:
+        e.unschedulable_count += 1
+        e.attempts += 1
+        e.backoff_until = self._clock() + self._backoff_duration(e)
+        e.parked = True
+
+    def group_attempted(self, e: GroupEntry) -> None:
+        e.attempts += 1
+        e.unschedulable_count = 0
+        e.backoff_until = 0.0
+
+
+# --------------------------------------------------------------------------
+# placement generation (TopologyPlacementGenerator analog)
+# --------------------------------------------------------------------------
+
+
+def generate_placements(
+    sched: "Scheduler", e: GroupEntry, node_names: list[str], num_nodes: int,
+    node_capacity: int,
+) -> tuple[np.ndarray, list[str]] | None:
+    """Candidate placements as a (D, NC) node-mask stack.
+
+    topology_placement.go:61 GeneratePlacements: group nodes by the
+    constraint key's label value; when some member pods are already
+    scheduled, only their domain qualifies (getScheduledPodsTopologyDomain —
+    pods split across domains is an error → no placements). Without
+    topology constraints there is ONE placement spanning all nodes.
+    Returns (masks, placement_names) or None when no placement exists.
+    """
+    group = e.group
+    keys = group.topology_keys if group is not None else ()
+    if not keys:
+        mask = np.zeros((1, node_capacity), dtype=bool)
+        mask[0, :num_nodes] = True
+        return mask, ["<all>"]
+    key = keys[0]   # single constraint, like the reference (maxItems=1)
+    domains: dict[str, list[int]] = {}
+    snapshot = sched._snapshot
+    for i, name in enumerate(node_names):
+        info = snapshot.nodes.get(name)
+        if info is None:
+            continue
+        val = info.node.labels_dict().get(key)
+        if val is not None:
+            domains.setdefault(val, []).append(i)
+    required: str | None = None
+    for pk, node in e.scheduled.items():
+        info = snapshot.nodes.get(node)
+        val = info.node.labels_dict().get(key) if info is not None else None
+        if val is None:
+            return None    # scheduled pod on an unlabeled node: no domain
+        if required is not None and required != val:
+            return None    # members split across domains (reference errors)
+        required = val
+    names = sorted(domains)
+    if required is not None:
+        names = [d for d in names if d == required]
+    if not names:
+        return None
+    masks = np.zeros((len(names), node_capacity), dtype=bool)
+    for d, dom in enumerate(names):
+        masks[d, domains[dom]] = True
+    return masks, names
+
+
+# --------------------------------------------------------------------------
+# the group cycles (called from Scheduler.schedule_batch)
+# --------------------------------------------------------------------------
+
+
+def schedule_pod_groups(sched: "Scheduler", budget: int) -> dict[str, int]:
+    """Run group cycles for ready groups, up to ``budget`` pods total.
+
+    Unconstrained groups are coalesced into one multi-group device cycle;
+    topology-constrained groups each run the placement search. Returns
+    result counts {"scheduled": n, "unschedulable": m}.
+    """
+    mgr = sched.podgroups
+    ready = mgr.ready_groups()
+    if not ready:
+        return {"scheduled": 0, "unschedulable": 0}
+
+    scheduled = unschedulable = 0
+    plain: list[tuple[str, GroupEntry]] = []
+    constrained: list[tuple[str, GroupEntry]] = []
+    total = 0
+    for key, e in ready:
+        if total + len(e.pending) > budget and (plain or constrained):
+            break
+        total += len(e.pending)
+        if e.group is not None and e.group.topology_keys:
+            constrained.append((key, e))
+        else:
+            plain.append((key, e))
+
+    if plain:
+        s, u = _coalesced_group_cycle(sched, [e for _, e in plain])
+        scheduled += s
+        unschedulable += u
+    for _, e in constrained:
+        s, u = _placement_group_cycle(sched, e)
+        scheduled += s
+        unschedulable += u
+    return {"scheduled": scheduled, "unschedulable": unschedulable}
+
+
+def _pop_members(e: GroupEntry, clock) -> list[QueuedPodInfo]:
+    """Take the group's pending members for one attempt (queue-sort order).
+    Clears the pending pool — failure paths re-add."""
+    infos = sorted(e.pending.values(), key=lambda i: i.sort_key())
+    e.pending.clear()
+    now = clock()
+    for i in infos:
+        i.attempts += 1
+        if i.initial_attempt_timestamp is None:
+            i.initial_attempt_timestamp = now
+    return infos
+
+
+def _coalesced_group_cycle(
+    sched: "Scheduler", entries: list[GroupEntry]
+) -> tuple[int, int]:
+    """One device assignment over the concatenated members of many
+    unconstrained groups, then per-group all-or-nothing acceptance.
+
+    Greedy parity note: the engine sees groups in queue order, exactly like
+    back-to-back scheduleOnePodGroup cycles — except a REJECTED group's pods
+    were visible (as in-batch assignments) to later groups' scoring. The
+    rejection rolls them back (never assumed), so later groups only saw a
+    fuller cluster: conservative, never over-committing.
+    """
+    from ..framework import runtime as rt
+
+    import jax
+
+    sched._snapshot = sched.cache.update_snapshot(sched._snapshot)
+    groups_infos = [_pop_members(e, sched.clock) for e in entries]
+    pods: list[t.Pod] = []
+    spans: list[tuple[int, int]] = []
+    for infos in groups_infos:
+        start = len(pods)
+        pods.extend(i.pod for i in infos)
+        spans.append((start, len(pods)))
+    batch = rt.encode_batch(
+        sched._snapshot, pods, sched.profile,
+        nominated=sched.nominator.entries(), prev_nt=sched._prev_nt,
+    )
+    sched._prev_nt = batch.node_tensors
+    params = rt.score_params(sched.profile, batch.resource_names)
+    assignments, _ = sched._assign_device(batch.device, params)
+    idx = np.asarray(jax.device_get(assignments))
+
+    scheduled = unschedulable = 0
+    for e, infos, (start, end) in zip(entries, groups_infos, spans):
+        rows = idx[start:end]
+        sched.metrics.schedule_attempts += len(infos)
+        fitted = int((rows >= 0).sum())
+        # PlacementFeasible (gang): scheduled members + this attempt's fits
+        if fitted + len(e.scheduled) >= e.min_count():
+            mgr_scheduled = 0
+            for k, info in enumerate(infos):
+                j = int(rows[k])
+                if 0 <= j < len(batch.node_names):
+                    _bind_member(sched, e, info, batch.node_names[j])
+                    mgr_scheduled += 1
+                else:
+                    # group admitted; this member retries after capacity
+                    # changes (leftovers park with backoff, or they would
+                    # re-run a full device cycle every schedule_batch)
+                    e.pending[info.key] = info
+            if mgr_scheduled == len(infos):
+                sched.podgroups.group_attempted(e)
+            else:
+                sched.podgroups.group_failed(e)
+            scheduled += mgr_scheduled
+            unschedulable += len(infos) - mgr_scheduled
+        else:
+            # all-or-nothing rollback: nothing was assumed; park the group
+            for info in infos:
+                e.pending[info.key] = info
+            sched.podgroups.group_failed(e)
+            unschedulable += len(infos)
+    return scheduled, unschedulable
+
+
+def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]:
+    """Placement search for one topology-constrained group: generate domain
+    placements, simulate ALL of them in one vmapped device program, pick the
+    best feasible one (PodGroupPodsCount score = scheduled + proposed)."""
+    from ..assign.placement import placement_assign_device
+    from ..framework import runtime as rt
+
+    import jax
+    import jax.numpy as jnp
+
+    sched._snapshot = sched.cache.update_snapshot(sched._snapshot)
+    infos = _pop_members(e, sched.clock)
+    pods = [i.pod for i in infos]
+    batch = rt.encode_batch(
+        sched._snapshot, pods, sched.profile,
+        nominated=sched.nominator.entries(), prev_nt=sched._prev_nt,
+    )
+    sched._prev_nt = batch.node_tensors
+    gen = generate_placements(
+        sched, e, batch.node_names, batch.num_nodes,
+        batch.device.alloc.shape[0],
+    )
+    if gen is None:
+        for info in infos:
+            e.pending[info.key] = info
+        sched.podgroups.group_failed(e)
+        return 0, len(infos)
+    masks, names = gen
+    params = rt.score_params(sched.profile, batch.resource_names)
+    assignments, counts = placement_assign_device(
+        batch.device, params, jnp.asarray(masks), engine=sched.engine
+    )
+    counts = np.asarray(jax.device_get(counts))
+    assignments = np.asarray(jax.device_get(assignments))
+    sched.metrics.schedule_attempts += len(infos)
+
+    need = e.min_count() - len(e.scheduled)
+    feasible = counts >= need
+    if not feasible.any():
+        for info in infos:
+            e.pending[info.key] = info
+        sched.podgroups.group_failed(e)
+        return 0, len(infos)
+    # PodGroupPodsCount: maximize scheduled + proposed; first-best tie-break
+    best = int(np.argmax(np.where(feasible, counts, -1)))
+    rows = assignments[best]
+    scheduled = 0
+    for k, info in enumerate(infos):
+        j = int(rows[k])
+        if 0 <= j < len(batch.node_names):
+            _bind_member(sched, e, info, batch.node_names[j])
+            scheduled += 1
+        else:
+            e.pending[info.key] = info
+    if scheduled == len(infos):
+        sched.podgroups.group_attempted(e)
+    else:
+        sched.podgroups.group_failed(e)   # leftovers park with backoff
+    return scheduled, len(infos) - scheduled
+
+
+def _bind_member(
+    sched: "Scheduler", e: GroupEntry, info: QueuedPodInfo, node_name: str
+) -> None:
+    """Assume + async-bind one accepted member (prepareForBindingCycle +
+    runBindingCycle, submitPodGroupAlgorithmResult success arm)."""
+    from .api_dispatcher import BindCall
+
+    e.pending.pop(info.key, None)
+    e.scheduled[info.key] = node_name
+    assumed = info.pod.with_node(node_name)
+    sched.cache.assume_pod(assumed)
+    if info.initial_attempt_timestamp is not None:
+        sched.metrics.attempt_latencies.append(
+            sched.clock() - info.initial_attempt_timestamp
+        )
+    sched.metrics.scheduled += 1
+
+    def on_done(err, info=info, assumed=assumed):
+        sched._bind_completions.append((info, assumed, err))
+
+    sched.dispatcher.add(BindCall(info.pod, node_name, on_done=on_done))

@@ -100,6 +100,7 @@ class Scheduler:
             self._assign_device = greedy_assign_device
         else:
             raise ValueError(f"unknown engine {engine!r}")
+        self.engine = engine
         self.cache = Cache(clock=clock)
         self.clock = clock
         self.max_batch = max_batch
@@ -136,6 +137,13 @@ class Scheduler:
         from ..queue.nominator import Nominator
 
         self.nominator = Nominator()
+        from .podgroup import PodGroupManager
+
+        self.podgroups = PodGroupManager(
+            clock,
+            initial_backoff=self.cfg.pod_initial_backoff_seconds,
+            max_backoff=self.cfg.pod_max_backoff_seconds,
+        )
 
     def enable_preemption(self) -> None:
         """Wire the DefaultPreemption PostFilter
@@ -169,6 +177,7 @@ class Scheduler:
         self.queue.on_event(
             ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
         )
+        self.podgroups.wake_all()   # new capacity may fit a parked gang
 
     def on_node_update(self, old: t.Node | None, new: t.Node) -> None:
         self.cache.update_node(new)
@@ -185,10 +194,21 @@ class Scheduler:
     def on_pod_add(self, pod: t.Pod) -> None:
         if pod.node_name:
             self.cache.add_pod(pod)
+            if pod.scheduling_group:
+                # a pre-bound member counts toward the gang quorum
+                # (gangscheduling.go:82 AssignedPod/Add hint)
+                self.podgroups.mark_scheduled(pod, pod.node_name)
             self.queue.on_event(
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
                 None, pod,
             )
+        elif pod.scheduling_group:
+            # gang member: held by the manager until quorum (the
+            # GangScheduling PreEnqueue, gangscheduling.go:130)
+            from ..queue.priority_queue import QueuedPodInfo
+
+            info = QueuedPodInfo(pod=pod, timestamp=self.clock())
+            self.podgroups.add_pod(info)
         else:
             self.queue.add(pod)
 
@@ -212,10 +232,17 @@ class Scheduler:
                 # informers deliver exactly this Delete+Add pair)
                 self.cache.add_pod(new)
                 self.queue.delete(new)
+                if new.scheduling_group:
+                    self.podgroups.mark_scheduled(new, new.node_name)
                 self.queue.on_event(
                     ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
                     None, new,
                 )
+        elif new.scheduling_group:
+            # unbound gang member: refresh the manager's copy — routing it
+            # into the per-pod queue would bypass quorum gating and let the
+            # pod double-schedule against its own group lane
+            self.podgroups.update_pod(new)
         else:
             self.queue.update(old, new)
 
@@ -224,6 +251,8 @@ class Scheduler:
         # a preemptor deleted while awaiting victim deletes must not leave a
         # stale pending-victims record for a later same-ns/name pod
         self._preempting.pop(pod_key(pod), None)
+        if pod.scheduling_group:
+            self.podgroups.remove_pod(pod)
         if pod.node_name or self.cache.is_assumed(pod.uid):
             self.cache.remove_pod(pod)
             # an assumed pod also lives in the queue's in-flight set until
@@ -234,8 +263,23 @@ class Scheduler:
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
                 pod, None,
             )
+            self.podgroups.wake_all()   # freed capacity may fit a gang
         else:
             self.queue.delete(pod)
+
+    # ---------------------------------------------------- PodGroup informers
+    def on_pod_group_add(self, group: t.PodGroup) -> None:
+        """scheduling/v1alpha3 PodGroup informer (gangscheduling.go:109:
+        a PodGroup add can complete a waiting gang's quorum)."""
+        self.podgroups.add_group(group)
+        self.queue.on_event(
+            ClusterEvent(EventResource.WORKLOAD, ActionType.ADD), None, group
+        )
+
+    on_pod_group_update = on_pod_group_add
+
+    def on_pod_group_delete(self, group: t.PodGroup) -> None:
+        self.podgroups.remove_group(group)
 
     # --------------------------------------------------------- batch cycle
 
@@ -264,10 +308,18 @@ class Scheduler:
         encode → device assign → assume + dispatch binds → requeue failures."""
         self._drain_bind_completions()
         self._flush_timers()
-        batch_infos = self.queue.pop_batch(max_batch or self.max_batch)
+        limit = max_batch or self.max_batch
+        batch_infos = self.queue.pop_batch(limit)
         self.metrics.cycles += 1
         if not batch_infos:
-            return {"scheduled": 0, "unschedulable": 0}
+            # group lane: ready gangs run when the per-pod lane is drained
+            # (the reference interleaves group entities through the same
+            # queue; the batch loop gives per-pod work priority per cycle)
+            from .podgroup import schedule_pod_groups
+
+            res = schedule_pod_groups(self, budget=limit)
+            self.metrics.unschedulable += res["unschedulable"]
+            return res
         t0 = self.clock()
 
         try:
@@ -360,7 +412,13 @@ class Scheduler:
                 self.metrics.bind_errors += 1
                 self.metrics.errors += 1
                 self.cache.forget_pod(assumed)
-                self.queue.add_unschedulable(info, error=True)
+                if info.pod.scheduling_group:
+                    # gang member: hand back to the group manager (it never
+                    # lived in the per-pod queue)
+                    self.podgroups.unmark_scheduled(info.pod)
+                    self.podgroups.requeue_member(info)
+                else:
+                    self.queue.add_unschedulable(info, error=True)
 
     def _handle_unschedulable(self, info: QueuedPodInfo) -> None:
         """No feasible node. Run PostFilter (preemption) if wired, then

@@ -134,3 +134,28 @@ def test_churn_recreate_bounded_pool():
     )
     r = run_workload(case, case.workloads[0], timeout_s=60)
     assert r.scheduled == 30
+
+
+def test_gang_scheduling_workload():
+    """The GangScheduling perf case at toy scale: every gang fully lands
+    (podgroup/gangscheduling/performance-config.yaml shape)."""
+    r = run_workload("GangScheduling", "10Nodes_3Gangs", timeout_s=60,
+                     warmup=False)
+    assert r.measure_pods == 9
+    assert r.scheduled == 9
+
+
+def test_gang_scheduling_all_or_nothing_at_capacity():
+    """One gang cannot fit: its pods must NOT bind partially."""
+    from kubetpu.perf.workloads import TEST_CASES
+    from kubetpu.perf.workloads import Workload
+
+    case = TEST_CASES["GangScheduling"]
+    # 2 nodes x 110-pod allowance, gangs of 3 @100m: capacity-bound via cpu?
+    # 4000m/node / 100m = 40 pods per node -> 80 slots; 30 gangs x 3 = 90
+    # pods: exactly 80 fit; gangs are all-or-nothing so scheduled % 3 == 0
+    wl = Workload("tiny-sat", {"initNodes": 2, "initPodGroups": 30,
+                               "podsPerGroup": 3})
+    r = run_workload(case, wl, timeout_s=60, warmup=False)
+    assert r.scheduled % 3 == 0
+    assert r.scheduled <= 80

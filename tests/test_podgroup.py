@@ -1,0 +1,280 @@
+"""Pod-group (gang) scheduling tests — the analog of
+schedule_one_podgroup.go's algorithm tests + the GangScheduling plugin tests
+(gangscheduling_test.go): quorum gating, all-or-nothing acceptance,
+placement generation/selection, rollback, and oracle parity with the
+sequential placement algorithm (podGroupSchedulingDefaultAlgorithm,
+schedule_one_podgroup.go:319)."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api.wrappers import make_node, make_pod, make_pod_group
+from kubetpu.framework import config as C
+
+from . import oracle
+from .test_scheduler import FakeClient, make_sched
+
+ZONE = "topology.kubernetes.io/zone"
+
+
+def gang_pod(name, group, cpu=500, prio=0, idx=0):
+    return make_pod(
+        f"{name}", cpu_milli=cpu, memory=128 * 1024**2,
+        scheduling_group=group, priority=prio, creation_index=idx,
+    )
+
+
+def settle(s, cycles=8):
+    total = 0
+    for _ in range(cycles):
+        res = s.schedule_batch()
+        total += res["scheduled"]
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    return total
+
+
+class TestQuorumGating:
+    def test_pods_wait_for_pod_group_object(self):
+        client = FakeClient()
+        s, _ = make_sched(client)
+        s.on_node_add(make_node("n0", cpu_milli=8000))
+        for i in range(3):
+            s.on_pod_add(gang_pod(f"g-{i}", "gang-a", idx=i))
+        assert settle(s) == 0            # no PodGroup object yet
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=3))
+        assert settle(s) == 3
+        assert len(client.bound) == 3
+
+    def test_pods_wait_for_min_count(self):
+        client = FakeClient()
+        s, _ = make_sched(client)
+        s.on_node_add(make_node("n0", cpu_milli=8000))
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=3))
+        s.on_pod_add(gang_pod("g-0", "gang-a", idx=0))
+        s.on_pod_add(gang_pod("g-1", "gang-a", idx=1))
+        assert settle(s) == 0            # 2 < minCount 3
+        s.on_pod_add(gang_pod("g-2", "gang-a", idx=2))
+        assert settle(s) == 3
+
+    def test_prebound_member_counts_toward_quorum(self):
+        """gangscheduling.go:82 — an AssignedPod add can complete a gang."""
+        client = FakeClient()
+        s, _ = make_sched(client)
+        s.on_node_add(make_node("n0", cpu_milli=8000))
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=3))
+        s.on_pod_add(gang_pod("g-0", "gang-a", idx=0))
+        s.on_pod_add(gang_pod("g-1", "gang-a", idx=1))
+        prebound = gang_pod("g-2", "gang-a", idx=2).with_node("n0")
+        s.on_pod_add(prebound)           # pre-bound member
+        assert settle(s) == 2            # the two pending members schedule
+
+
+class TestAllOrNothing:
+    def test_insufficient_capacity_schedules_nothing(self):
+        client = FakeClient()
+        s, clock = make_sched(client)
+        # two nodes x 1 pod worth of cpu; gang needs 3
+        for i in range(2):
+            s.on_node_add(make_node(f"n{i}", cpu_milli=600))
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=3))
+        for i in range(3):
+            s.on_pod_add(gang_pod(f"g-{i}", "gang-a", idx=i))
+        assert settle(s) == 0
+        assert client.bound == {}        # NOTHING assumed or bound
+        # nothing left accounted on the nodes
+        snap = s.cache.update_snapshot()
+        assert all(not info.pods for info in snap.node_infos())
+        # capacity arrives -> the gang becomes schedulable (node-add wakes it)
+        s.on_node_add(make_node("n2", cpu_milli=600))
+        clock.tick(30)                   # past group backoff
+        assert settle(s) == 3
+        assert len(client.bound) == 3
+
+    def test_min_count_below_group_size_partial(self):
+        """minCount 2, four members, room for 2: the group is admitted and
+        the two fitting members bind; the rest stay pending."""
+        client = FakeClient()
+        s, _ = make_sched(client)
+        for i in range(2):
+            s.on_node_add(make_node(f"n{i}", cpu_milli=600))
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=2))
+        for i in range(4):
+            s.on_pod_add(gang_pod(f"g-{i}", "gang-a", idx=i))
+        assert settle(s) == 2
+        assert len(client.bound) == 2
+        e = s.podgroups.entries["default/gang-a"]
+        assert len(e.pending) == 2 and len(e.scheduled) == 2
+
+    def test_bind_error_returns_member_to_pending(self):
+        client = FakeClient(fail_binds_for={"default/g-1"})
+        s, clock = make_sched(client)
+        s.on_node_add(make_node("n0", cpu_milli=8000))
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=2))
+        for i in range(2):
+            s.on_pod_add(gang_pod(f"g-{i}", "gang-a", idx=i))
+        settle(s)
+        # g-1's first bind failed; it returned to pending and retries
+        clock.tick(30)
+        settle(s)
+        assert set(client.bound) == {"default/g-0", "default/g-1"}
+
+
+class TestTopologyPlacement:
+    def _cluster(self, s, free_a=2, free_b=3, slot=1000):
+        """zone-a nodes then zone-b nodes, one slot each."""
+        idx = 0
+        for z, count in (("a", free_a), ("b", free_b)):
+            for i in range(count):
+                s.on_node_add(make_node(
+                    f"{z}{i}", cpu_milli=slot,
+                    labels={ZONE: f"zone-{z}"},
+                ))
+                idx += 1
+
+    def test_group_lands_in_single_domain(self):
+        """Placement search picks the domain that fits the most members
+        (PodGroupPodsCount), and every member colocates there."""
+        client = FakeClient()
+        s, _ = make_sched(client)
+        self._cluster(s, free_a=2, free_b=3)
+        s.on_pod_group_add(make_pod_group(
+            "gang-t", min_count=3, topology_keys=(ZONE,),
+        ))
+        for i in range(3):
+            s.on_pod_add(gang_pod(f"t-{i}", "gang-t", cpu=800, idx=i))
+        assert settle(s) == 3
+        zones = {node[0] for node in client.bound.values()}  # "a.." / "b.."
+        assert zones == {"b"}            # only zone-b fits all 3
+
+    def test_no_domain_fits_group_unschedulable(self):
+        client = FakeClient()
+        s, _ = make_sched(client)
+        self._cluster(s, free_a=2, free_b=2)
+        s.on_pod_group_add(make_pod_group(
+            "gang-t", min_count=3, topology_keys=(ZONE,),
+        ))
+        for i in range(3):
+            s.on_pod_add(gang_pod(f"t-{i}", "gang-t", cpu=800, idx=i))
+        assert settle(s) == 0
+        assert client.bound == {}
+
+    def test_scheduled_member_pins_domain(self):
+        """getScheduledPodsTopologyDomain: an already-scheduled member forces
+        the group's domain even when another fits more pods."""
+        client = FakeClient()
+        s, _ = make_sched(client)
+        self._cluster(s, free_a=3, free_b=5)
+        s.on_pod_group_add(make_pod_group(
+            "gang-t", min_count=3, topology_keys=(ZONE,),
+        ))
+        # one member already bound in zone-a
+        s.on_pod_add(gang_pod("t-0", "gang-t", cpu=800, idx=0).with_node("a0"))
+        for i in range(1, 3):
+            s.on_pod_add(gang_pod(f"t-{i}", "gang-t", cpu=800, idx=i))
+        assert settle(s) == 2
+        assert {n[0] for n in client.bound.values()} == {"a"}
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_placement_parity_with_sequential_oracle(seed):
+    """Device-parallel placement search vs the reference-shaped sequential
+    algorithm: per domain, clone the domain's nodes and run the per-pod
+    greedy loop (podGroupSchedulingDefaultAlgorithm restricted to the
+    placement, snapshot.go placementNodes); feasible iff count >= minCount;
+    best placement by count with first-in-sorted-order tie-break."""
+    rng = np.random.default_rng(seed + 4200)
+    client = FakeClient()
+    s, _ = make_sched(client)
+    zones = ["z0", "z1", "z2"]
+    nodes = []
+    for i in range(12):
+        n = make_node(
+            f"n{i:02d}", cpu_milli=int(rng.integers(800, 2400)),
+            memory=8 * 1024**3, labels={ZONE: zones[i % 3]},
+        )
+        nodes.append(n)
+        s.on_node_add(n)
+    min_count = 3
+    s.on_pod_group_add(make_pod_group(
+        "gang-p", min_count=min_count, topology_keys=(ZONE,),
+    ))
+    pods = [
+        gang_pod(f"p-{j}", "gang-p", cpu=int(rng.integers(300, 900)), idx=j)
+        for j in range(5)
+    ]
+    for p in pods:
+        s.on_pod_add(p)
+    settle(s)
+
+    # ---- oracle: sequential placement loop over sorted domains ----------
+    snap_infos = {n.name: n for n in nodes}
+    domains = sorted({n.labels_dict()[ZONE] for n in nodes})
+    best_count, best_domain, best_assign = -1, None, None
+    for dom in domains:
+        from kubetpu.state.snapshot import NodeInfo
+
+        dom_infos = [
+            NodeInfo(node=n) for n in nodes if n.labels_dict()[ZONE] == dom
+        ]
+        got = oracle.greedy(
+            dom_infos, pods, w_fit=1, check_ports=False, check_static=False,
+        )
+        count = sum(1 for g in got if g is not None)
+        if count >= min_count and count > best_count:
+            best_count, best_domain, best_assign = count, dom, got
+    want = {}
+    if best_domain is not None:
+        for p, node_name in zip(pods, best_assign):
+            if node_name is not None:
+                want[f"default/{p.name}"] = node_name
+    assert client.bound == want
+
+
+def test_update_of_waiting_member_does_not_bypass_gating():
+    """Regression: an informer update for a gang pod still waiting for
+    quorum must NOT fall through to the per-pod queue (which would schedule
+    it individually and later crash the group lane on double-assume)."""
+    import dataclasses
+
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=8000))
+    s.on_pod_group_add(make_pod_group("gang-a", min_count=3))
+    p0 = gang_pod("g-0", "gang-a", idx=0)
+    s.on_pod_add(p0)
+    s.on_pod_update(p0, dataclasses.replace(p0, labels=(("x", "y"),)))
+    assert settle(s) == 0            # still gated
+    assert client.bound == {}
+    s.on_pod_add(gang_pod("g-1", "gang-a", idx=1))
+    s.on_pod_add(gang_pod("g-2", "gang-a", idx=2))
+    assert settle(s) == 3
+
+
+def test_admitted_group_leftovers_park_with_backoff():
+    """Regression: leftover members of an admitted gang must not re-run a
+    device cycle every schedule_batch with zero backoff — they park until a
+    capacity event."""
+    client = FakeClient()
+    s, clock = make_sched(client)
+    for i in range(2):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=600))
+    s.on_pod_group_add(make_pod_group("gang-a", min_count=2))
+    for i in range(4):
+        s.on_pod_add(gang_pod(f"g-{i}", "gang-a", idx=i))
+    assert settle(s) == 2
+    e = s.podgroups.entries["default/gang-a"]
+    assert e.parked and e.backoff_until > clock()
+    cycles_before = s.metrics.cycles
+    attempts_before = s.metrics.schedule_attempts
+    settle(s, cycles=3)              # parked: no group attempts burned
+    assert s.metrics.schedule_attempts == attempts_before
+    assert s.metrics.cycles == cycles_before + 3
+    # capacity arrives -> woken, and past backoff the leftovers land
+    s.on_node_add(make_node("n2", cpu_milli=600))
+    s.on_node_add(make_node("n3", cpu_milli=600))
+    clock.tick(30)
+    assert settle(s) == 2
+    assert len(client.bound) == 4
