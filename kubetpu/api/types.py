@@ -234,6 +234,8 @@ class Pod:
     # PodSchedulingGroup) — names a PodGroup in the pod's namespace; drives
     # gang / workload-aware scheduling. "" = not a group member.
     scheduling_group: str = ""
+    # spec.volumes, PVC references only (the volume plugin family)
+    volumes: tuple[PodVolume, ...] = ()
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -255,6 +257,75 @@ class Pod:
 
     def with_node(self, node_name: str) -> "Pod":
         return dataclasses.replace(self, node_name=node_name)
+
+
+@dataclass(frozen=True)
+class PodVolume:
+    """The scheduling slice of v1.Volume: only PVC references matter to the
+    volume plugins (volumezone/volume_zone.go Filter: 'Currently this is
+    only supported with PersistentVolumeClaims'); other volume sources are
+    node-agnostic."""
+
+    name: str
+    pvc_name: str = ""          # persistentVolumeClaim.claimName ("" = other source)
+    read_only: bool = False
+
+
+# v1.PersistentVolumeAccessMode values the restrictions/binding plugins read
+READ_WRITE_ONCE_POD = "ReadWriteOncePod"
+
+
+@dataclass(frozen=True)
+class PersistentVolume:
+    """The scheduling slice of v1.PersistentVolume: zone/region labels
+    (VolumeZone), spec.nodeAffinity.required (VolumeBinding bound-PV check),
+    class/capacity/access (the WaitForFirstConsumer binding search), the CSI
+    driver (NodeVolumeLimits counting), and the claim binding."""
+
+    name: str
+    labels: tuple[tuple[str, str], ...] = ()
+    node_affinity: NodeSelector | None = None
+    storage_class: str = ""
+    capacity: int = 0                           # storage bytes
+    access_modes: tuple[str, ...] = ()
+    claim_ref: str = ""                         # "ns/name" of bound PVC
+    driver: str = ""                            # CSI driver name
+
+    def labels_dict(self) -> dict[str, str]:
+        return dict(self.labels)
+
+
+@dataclass(frozen=True)
+class PersistentVolumeClaim:
+    """The scheduling slice of v1.PersistentVolumeClaim."""
+
+    name: str
+    namespace: str = "default"
+    volume_name: str = ""                       # bound PV ("" = unbound)
+    storage_class: str = ""
+    access_modes: tuple[str, ...] = ()
+    request: int = 0                            # requested storage bytes
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+# storagev1.VolumeBindingMode
+BINDING_IMMEDIATE = "Immediate"
+BINDING_WAIT_FOR_FIRST_CONSUMER = "WaitForFirstConsumer"
+
+# provisioner value that means "no dynamic provisioning"
+NO_PROVISIONER = "kubernetes.io/no-provisioner"
+
+
+@dataclass(frozen=True)
+class StorageClass:
+    """The scheduling slice of storagev1.StorageClass."""
+
+    name: str
+    binding_mode: str = BINDING_IMMEDIATE
+    provisioner: str = NO_PROVISIONER
 
 
 @dataclass(frozen=True)

@@ -60,6 +60,7 @@ def make_pod(
     creation_index: int = 0,
     preemption_policy: str = "PreemptLowerPriority",
     scheduling_group: str = "",
+    pvcs: Sequence[str] = (),
 ) -> t.Pod:
     nonzero = None
     if containers is not None:
@@ -102,6 +103,10 @@ def make_pod(
         creation_index=creation_index,
         preemption_policy=preemption_policy,
         scheduling_group=scheduling_group,
+        volumes=tuple(
+            t.PodVolume(name=f"vol-{i}", pvc_name=c)
+            for i, c in enumerate(pvcs)
+        ),
     )
 
 

@@ -5,6 +5,14 @@ The analog of ``pkg/scheduler/framework/runtime`` + ``pkg/scheduler/apis/config`
 
 from . import config  # noqa: F401
 from .config import Profile, SchedulerConfiguration, minimal_profile  # noqa: F401
+from .lifecycle import (  # noqa: F401
+    LifecyclePlugin,
+    LifecycleRunner,
+    Registry,
+    Status,
+    WaitingPod,
+    default_registry,
+)
 from .runtime import (  # noqa: F401
     DeviceBatch,
     EncodedBatch,

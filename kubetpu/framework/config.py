@@ -34,10 +34,14 @@ from ..names import (  # noqa: F401  (canonical plugin names, re-exported)
     NODE_RESOURCES_BALANCED,
     NODE_RESOURCES_FIT,
     NODE_UNSCHEDULABLE,
+    NODE_VOLUME_LIMITS,
     POD_TOPOLOGY_SPREAD,
     PRIORITY_SORT,
     SCHEDULING_GATES,
     TAINT_TOLERATION,
+    VOLUME_BINDING,
+    VOLUME_RESTRICTIONS,
+    VOLUME_ZONE,
 )
 
 LEAST_ALLOCATED = "LeastAllocated"
@@ -84,6 +88,10 @@ DEFAULT_FILTERS = PluginSet(enabled=(
     (NODE_AFFINITY, 1),
     (NODE_PORTS, 1),
     (NODE_RESOURCES_FIT, 1),
+    (VOLUME_RESTRICTIONS, 1),
+    (NODE_VOLUME_LIMITS, 1),
+    (VOLUME_BINDING, 1),
+    (VOLUME_ZONE, 1),
     (POD_TOPOLOGY_SPREAD, 1),
     (INTER_POD_AFFINITY, 1),
 ))
@@ -105,6 +113,12 @@ class Profile:
     name: str = "default-scheduler"
     filters: PluginSet = DEFAULT_FILTERS
     scores: PluginSet = DEFAULT_SCORES
+    # Host-side lifecycle plugins (Reserve/Permit/PreBind/PostBind —
+    # interface.go:636-680), resolved by name against the scheduler's
+    # lifecycle Registry; one name may serve several extension points, like
+    # reference plugins implementing multiple interfaces. VolumeBinding's
+    # Reserve/PreBind half is in the default set (default_plugins.go:30).
+    lifecycle: PluginSet = PluginSet(enabled=((VOLUME_BINDING, 1),))
     scoring_strategy: ScoringStrategy = ScoringStrategy()
     balanced_resources: tuple[tuple[str, int], ...] = ((t.CPU, 1), (t.MEMORY, 1))
     # InterPodAffinityArgs.HardPodAffinityWeight (types_pluginargs.go, default 1)

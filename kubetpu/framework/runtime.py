@@ -244,9 +244,17 @@ def encode_batch(
     nominated_triples: list[tuple[int, str, str]] = []
     for e in nominated:
         nominated_triples.extend(getattr(e, "ports", ()))
+    vol_state = None
+    if any(v.pvc_name for p_ in pods for v in p_.volumes):
+        # a pod referencing a PVC engages the volume plugins even when the
+        # listers are empty (a MISSING claim is what rejects it)
+        from ..state.volumes import VolumeState
+
+        vol_state = VolumeState(snapshot)
     pb = enc.encode_pod_batch(
         nt, pods, enabled_filters=enabled, pad_pods=PP,
         enabled_scores=enabled_sc, extra_port_triples=nominated_triples,
+        volume_state=vol_state,
     )
     want_na = profile is None or profile.has_score(C.NODE_AFFINITY)
     want_tt = profile is None or profile.has_score(C.TAINT_TOLERATION)

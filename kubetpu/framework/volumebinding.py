@@ -1,0 +1,126 @@
+"""VolumeBinding's Reserve / PreBind half as a lifecycle plugin.
+
+Reference: pkg/scheduler/framework/plugins/volumebinding/volume_binding.go —
+``Reserve`` (:521) runs AssumePodVolumes: pick concrete PVs for the pod's
+unbound WaitForFirstConsumer claims on the chosen node (the binder's
+findMatchingVolumes smallest-fit) and assume the binding in cache;
+``Unreserve`` (:594) reverts the assumption; ``PreBind`` (:567) issues the
+API writes that actually bind the claims (BindPodVolumes) before the pod
+binds. The Filter half lives in the encoder's static volume masks
+(state/volumes.py).
+
+The assumed PVC→PV bindings are written into the scheduler's CACHE volume
+listers (the reference assumes into its PV cache the same way), so later
+cycles' Filter masks see claimed PVs as taken; the informer's eventual
+PVC/PV updates confirm them.
+"""
+
+from __future__ import annotations
+
+from ..api import types as t
+from ..state.volumes import VolumeState, node_affinity_matches
+from . import lifecycle as lc
+
+
+class VolumeBindingPlugin(lc.LifecyclePlugin):
+    """Reserve/Unreserve/PreBind for WaitForFirstConsumer claims."""
+
+    name = "VolumeBinding"
+
+    def __init__(self, profile=None) -> None:
+        # pod key -> [(pvc, pv_name)] assumed at Reserve
+        self._assumed: dict[str, list[tuple[t.PersistentVolumeClaim, str]]] = {}
+
+    # -- Reserve (volume_binding.go:521 AssumePodVolumes) -----------------
+    def reserve(self, handle, pod: t.Pod, node_name: str) -> lc.Status:
+        cache = handle.cache
+        snapshot = handle.cache.update_snapshot(handle._snapshot)
+        handle._snapshot = snapshot
+        import dataclasses
+
+        vs = VolumeState(snapshot)
+        node_info = snapshot.nodes.get(node_name)
+        labels = node_info.node.labels_dict() if node_info else {}
+        picks: list[tuple[t.PersistentVolumeClaim, str]] = []
+        taken: set[str] = set()   # PVs chosen for EARLIER claims of this pod
+
+        def fail(reason: str) -> lc.Status:
+            # revert the picks already applied (AssumePodVolumes reverts on
+            # failure — a half-reserved pod must leak nothing)
+            for pvc_, pv_name in picks:
+                pv_ = snapshot.pvs.get(pv_name)
+                if pv_ is not None:
+                    cache.update_pv(dataclasses.replace(pv_, claim_ref=""))
+                cache.update_pvc(pvc_)   # original unbound object
+            return lc.Status(lc.UNSCHEDULABLE, reason, self.name)
+
+        for vol in pod.volumes:
+            if not vol.pvc_name:
+                continue
+            pvc = snapshot.pvcs.get(f"{pod.namespace}/{vol.pvc_name}")
+            if pvc is None:
+                return fail("claim disappeared")
+            if pvc.volume_name:
+                continue   # already bound
+            sc = snapshot.storage_classes.get(pvc.storage_class)
+            if sc is None or sc.binding_mode != t.BINDING_WAIT_FOR_FIRST_CONSUMER:
+                return fail("claim not bindable here")
+            chosen = ""
+            for pv in vs.available_pvs_for(pvc):
+                if pv.name in taken:
+                    continue   # chosen for an earlier claim of this pod
+                if node_affinity_matches(pv.node_affinity, labels, node_name):
+                    chosen = pv.name
+                    break
+            if not chosen:
+                if sc.provisioner and sc.provisioner != t.NO_PROVISIONER:
+                    continue   # dynamic provisioning handles it at PreBind
+                return fail("no matching PersistentVolume on node")
+            picks.append((pvc, chosen))
+            taken.add(chosen)
+            # assume: mark the PV claimed and the PVC bound in the cache's
+            # lister view so this cycle's later pods (and later cycles)
+            # don't double-book it
+            pv = snapshot.pvs[chosen]
+            cache.update_pv(dataclasses.replace(pv, claim_ref=pvc.key))
+            cache.update_pvc(dataclasses.replace(pvc, volume_name=chosen))
+        if picks:
+            self._assumed[f"{pod.namespace}/{pod.name}"] = picks
+        return lc.Status()
+
+    def unreserve(self, handle, pod: t.Pod, node_name: str) -> None:
+        """RevertAssumedPodVolumes (:594)."""
+        import dataclasses
+
+        picks = self._assumed.pop(f"{pod.namespace}/{pod.name}", None)
+        if not picks:
+            return
+        cache = handle.cache
+        snapshot = cache.update_snapshot(handle._snapshot)
+        handle._snapshot = snapshot
+        for pvc, pv_name in picks:
+            pv = snapshot.pvs.get(pv_name)
+            if pv is not None and pv.claim_ref == pvc.key:
+                cache.update_pv(dataclasses.replace(pv, claim_ref=""))
+            cur = snapshot.pvcs.get(pvc.key)
+            if cur is not None and cur.volume_name == pv_name:
+                cache.update_pvc(dataclasses.replace(cur, volume_name=""))
+
+    # -- PreBind (volume_binding.go:567 BindPodVolumes) --------------------
+    def pre_bind(self, handle, pod: t.Pod, node_name: str) -> lc.Status:
+        picks = self._assumed.pop(f"{pod.namespace}/{pod.name}", None)
+        if not picks:
+            return lc.Status()
+        client = getattr(handle.dispatcher, "_client", None)
+        bind_pvc = getattr(client, "bind_pvc", None)
+        for pvc, pv_name in picks:
+            if bind_pvc is not None:
+                # the API write (PATCH pvc.spec.volumeName + pv.claimRef)
+                bind_pvc(pvc, pv_name)
+            # the cache already holds the assumed binding from Reserve; the
+            # informer's PVC/PV updates will re-deliver the bound objects
+        return lc.Status()
+
+
+def register(registry: lc.Registry) -> None:
+    registry.register("VolumeBinding", VolumeBindingPlugin)

@@ -36,4 +36,8 @@ ALL_FILTERS = frozenset({
     NODE_UNSCHEDULABLE,
     POD_TOPOLOGY_SPREAD,
     INTER_POD_AFFINITY,
+    VOLUME_RESTRICTIONS,
+    VOLUME_ZONE,
+    NODE_VOLUME_LIMITS,
+    VOLUME_BINDING,
 })

@@ -222,6 +222,44 @@ def run_workload(
                     f"{op.prefix}-{g}", namespace=f"{op.prefix}-0",
                     min_count=min_count,
                 ))
+        elif isinstance(op, W.CreatePodsWithPVsOp):
+            from ..api import types as t
+            from ..api.wrappers import make_pod
+
+            count = params[op.count_param]
+            ns = op.namespace or f"pv-{op_i}"
+            if op.collect_metrics:
+                # warmup shape: plain pods (the PVC mask is a static-sig
+                # column; shapes match the measured batch)
+                attempts0, cycles0, lat0 = _begin_measured_phase(
+                    sched, warmup,
+                    [
+                        make_pod(f"warmup-pv-{j}", namespace=ns,
+                                 cpu_milli=100, memory=500 * 1024**2)
+                        for j in range(min(count, sched.max_batch))
+                    ],
+                )
+            for j in range(count):
+                pv_name = f"{ns}-pv-{j}"
+                sched.on_pv_add(t.PersistentVolume(
+                    name=pv_name, driver=op.driver,
+                    access_modes=("ReadOnlyMany",), capacity=1024**3,
+                    claim_ref=f"{ns}/{ns}-claim-{j}",
+                ))
+                sched.on_pvc_add(t.PersistentVolumeClaim(
+                    name=f"{ns}-claim-{j}", namespace=ns,
+                    volume_name=pv_name, access_modes=("ReadOnlyMany",),
+                    request=1024**3,
+                ))
+                sched.on_pod_add(make_pod(
+                    f"pvpod-{op_i}-{j}", namespace=ns, cpu_milli=100,
+                    memory=500 * 1024**2, creation_index=j,
+                    pvcs=(f"{ns}-claim-{j}",),
+                ))
+            done, secs = settle(count)
+            if op.collect_metrics:
+                measured += done
+                duration += secs
         elif isinstance(op, W.CreateGangPodsOp):
             from ..api.wrappers import make_pod
 
@@ -308,6 +346,10 @@ def run_workload(
             params[op.count_param] * params[op.multiplier_param]
             for op in case.ops
             if isinstance(op, W.CreateGangPodsOp) and op.collect_metrics
+        ) + sum(
+            params[op.count_param]
+            for op in case.ops
+            if isinstance(op, W.CreatePodsWithPVsOp) and op.collect_metrics
         ),
         scheduled=measured,
         duration_s=duration,

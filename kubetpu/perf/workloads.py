@@ -222,6 +222,20 @@ class CreateGangPodsOp:
 
 
 @dataclass(frozen=True)
+class CreatePodsWithPVsOp:
+    """createPods with persistentVolumeTemplatePath /
+    persistentVolumeClaimTemplatePath (volumes/performance-config.yaml:55
+    SchedulingInTreePVs, :142 SchedulingCSIPVs): each pod gets its own
+    bound PV+PVC pair (templates/pv-aws.yaml + templates/pvc.yaml —
+    ReadOnlyMany, 1Gi, bind-completed)."""
+
+    count_param: str = "measurePods"
+    collect_metrics: bool = False
+    driver: str = ""                        # CSI driver name ("" = in-tree)
+    namespace: str | None = None
+
+
+@dataclass(frozen=True)
 class ChurnOp:
     """operations.go:518 churnOp — create (or recreate) interfering objects
     at an interval while the measured phase runs."""
@@ -414,6 +428,39 @@ _case(TestCase(
         Workload("5000Nodes_5000Pods",
                  {"initNodes": 5000, "initPods": 2000, "measurePods": 5000},
                  threshold=540, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingInTreePVs",
+    source="volumes/performance-config.yaml:55 (threshold 290)",
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreatePodsWithPVsOp("initPods"),
+        CreatePodsWithPVsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("5Nodes", {"initNodes": 5, "initPods": 5, "measurePods": 10}),
+        Workload("5000Nodes_2000Pods",
+                 {"initNodes": 5000, "initPods": 1000, "measurePods": 2000},
+                 threshold=290, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingCSIPVs",
+    source="volumes/performance-config.yaml:142 (threshold 100)",
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreatePodsWithPVsOp("initPods", driver="ebs.csi.aws.com"),
+        CreatePodsWithPVsOp("measurePods", driver="ebs.csi.aws.com",
+                            collect_metrics=True),
+    ),
+    workloads=(
+        Workload("5Nodes", {"initNodes": 5, "initPods": 5, "measurePods": 10}),
+        Workload("5000Nodes_2000Pods",
+                 {"initNodes": 5000, "initPods": 1000, "measurePods": 2000},
+                 threshold=100, labels=("performance",)),
     ),
 ))
 

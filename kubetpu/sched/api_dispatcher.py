@@ -44,11 +44,16 @@ class BindCall:
     """POST pods/<name>/binding (DefaultBinder,
     framework/plugins/defaultbinder/default_binder.go). ``on_done(err)`` fires
     after execution — the scheduler's binding-cycle epilogue (finish_binding
-    on success, forget+requeue on failure)."""
+    on success, forget+requeue on failure). ``pre``/``post`` carry the
+    binding cycle's PreBind / PostBind plugin runs (schedule_one.go:391
+    bindingCycle order: WaitOnPermit → PreBind → Bind → PostBind); a raising
+    ``pre`` fails the bind, ``post`` is informational."""
 
     pod: t.Pod
     node_name: str
     on_done: Callable[[Exception | None], None] | None = None
+    pre: Callable[[], None] | None = None
+    post: Callable[[], None] | None = None
     call_type: str = field(default="bind", init=False)
 
     @property
@@ -56,7 +61,11 @@ class BindCall:
         return f"{self.pod.namespace}/{self.pod.name}"
 
     def execute(self, client: Any) -> None:
+        if self.pre is not None:
+            self.pre()
         client.bind(self.pod, self.node_name)
+        if self.post is not None:
+            self.post()
 
     def merge(self, older: "BindCall") -> None:
         # a second bind for the same pod supersedes the first

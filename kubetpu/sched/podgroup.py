@@ -360,8 +360,8 @@ def _coalesced_group_cycle(
             for k, info in enumerate(infos):
                 j = int(rows[k])
                 if 0 <= j < len(batch.node_names):
-                    _bind_member(sched, e, info, batch.node_names[j])
-                    mgr_scheduled += 1
+                    if _bind_member(sched, e, info, batch.node_names[j]):
+                        mgr_scheduled += 1
                 else:
                     # group admitted; this member retries after capacity
                     # changes (leftovers park with backoff, or they would
@@ -432,8 +432,8 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
     for k, info in enumerate(infos):
         j = int(rows[k])
         if 0 <= j < len(batch.node_names):
-            _bind_member(sched, e, info, batch.node_names[j])
-            scheduled += 1
+            if _bind_member(sched, e, info, batch.node_names[j]):
+                scheduled += 1
         else:
             e.pending[info.key] = info
     if scheduled == len(infos):
@@ -445,11 +445,12 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
 
 def _bind_member(
     sched: "Scheduler", e: GroupEntry, info: QueuedPodInfo, node_name: str
-) -> None:
-    """Assume + async-bind one accepted member (prepareForBindingCycle +
-    runBindingCycle, submitPodGroupAlgorithmResult success arm)."""
-    from .api_dispatcher import BindCall
-
+) -> bool:
+    """Assume + Reserve/Permit + async-bind one accepted member
+    (prepareForBindingCycle + runBindingCycle,
+    submitPodGroupAlgorithmResult success arm). Returns False when a
+    Reserve/Permit plugin rejected the member — _reject_assumed's group
+    branch already handed it back to the manager's pending pool."""
     e.pending.pop(info.key, None)
     e.scheduled[info.key] = node_name
     assumed = info.pod.with_node(node_name)
@@ -458,9 +459,7 @@ def _bind_member(
         sched.metrics.attempt_latencies.append(
             sched.clock() - info.initial_attempt_timestamp
         )
+    if not sched._begin_binding(info, assumed):
+        return False
     sched.metrics.scheduled += 1
-
-    def on_done(err, info=info, assumed=assumed):
-        sched._bind_completions.append((info, assumed, err))
-
-    sched.dispatcher.add(BindCall(info.pod, node_name, on_done=on_done))
+    return True

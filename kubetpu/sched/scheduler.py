@@ -85,11 +85,15 @@ class Scheduler:
         dispatcher_workers: int = 2,
         clock: Callable[[], float] = time.monotonic,
         engine: str = "greedy",
+        registry=None,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
         assign.batched — one big device program per round; wins when
-        batches are signature-homogeneous, the scheduler_perf shape)."""
+        batches are signature-homogeneous, the scheduler_perf shape).
+        ``registry``: a lifecycle-plugin Registry (framework.lifecycle);
+        defaults to the in-tree set — out-of-tree plugins register on a
+        copy and pass it here (the reference's app.WithPlugin)."""
         self.cfg = cfg or C.SchedulerConfiguration()
         self.profile = profile or self.cfg.profile()
         if engine == "batched":
@@ -144,6 +148,14 @@ class Scheduler:
             initial_backoff=self.cfg.pod_initial_backoff_seconds,
             max_backoff=self.cfg.pod_max_backoff_seconds,
         )
+        from ..framework import lifecycle as lc
+
+        self.registry = registry if registry is not None else lc.default_registry()
+        self.lifecycle = self.registry.build(
+            self.profile.lifecycle.names(), self.profile
+        )
+        # permitted-with-Wait pods parked before binding (waitingPodsMap)
+        self.waiting_pods: dict[str, lc.WaitingPod] = {}
 
     def enable_preemption(self) -> None:
         """Wire the DefaultPreemption PostFilter
@@ -253,6 +265,10 @@ class Scheduler:
         self._preempting.pop(pod_key(pod), None)
         if pod.scheduling_group:
             self.podgroups.remove_pod(pod)
+        wp = self.waiting_pods.pop(pod_key(pod), None)
+        if wp is not None:
+            # a deleted waiting pod unreserves; its assume drops below
+            self.lifecycle.run_unreserve(self, wp.pod, wp.node_name)
         if pod.node_name or self.cache.is_assumed(pod.uid):
             self.cache.remove_pod(pod)
             # an assumed pod also lives in the queue's in-flight set until
@@ -266,6 +282,58 @@ class Scheduler:
             self.podgroups.wake_all()   # freed capacity may fit a gang
         else:
             self.queue.delete(pod)
+
+    # ------------------------------------------------------ volume informers
+    def on_pv_add(self, pv: t.PersistentVolume) -> None:
+        self.cache.add_pv(pv)
+        self.queue.on_event(
+            ClusterEvent(EventResource.PERSISTENT_VOLUME, ActionType.ADD),
+            None, pv,
+        )
+
+    def on_pv_update(self, old, new: t.PersistentVolume) -> None:
+        self.cache.update_pv(new)
+        self.queue.on_event(
+            ClusterEvent(EventResource.PERSISTENT_VOLUME, ActionType.UPDATE),
+            old, new,
+        )
+
+    def on_pv_delete(self, pv: t.PersistentVolume) -> None:
+        self.cache.remove_pv(pv.name)
+
+    def on_pvc_add(self, pvc: t.PersistentVolumeClaim) -> None:
+        self.cache.add_pvc(pvc)
+        self.queue.on_event(
+            ClusterEvent(EventResource.PERSISTENT_VOLUME_CLAIM, ActionType.ADD),
+            None, pvc,
+        )
+
+    def on_pvc_update(self, old, new: t.PersistentVolumeClaim) -> None:
+        self.cache.update_pvc(new)
+        self.queue.on_event(
+            ClusterEvent(EventResource.PERSISTENT_VOLUME_CLAIM, ActionType.UPDATE),
+            old, new,
+        )
+
+    def on_pvc_delete(self, pvc: t.PersistentVolumeClaim) -> None:
+        self.cache.remove_pvc(pvc.key)
+
+    def on_storage_class_add(self, sc: t.StorageClass) -> None:
+        self.cache.add_storage_class(sc)
+        self.queue.on_event(
+            ClusterEvent(EventResource.STORAGE_CLASS, ActionType.ADD),
+            None, sc,
+        )
+
+    def on_storage_class_update(self, old, new: t.StorageClass) -> None:
+        self.cache.update_storage_class(new)
+        self.queue.on_event(
+            ClusterEvent(EventResource.STORAGE_CLASS, ActionType.ADD),
+            old, new,
+        )
+
+    def on_storage_class_delete(self, sc: t.StorageClass) -> None:
+        self.cache.remove_storage_class(sc.name)
 
     # ---------------------------------------------------- PodGroup informers
     def on_pod_group_add(self, group: t.PodGroup) -> None:
@@ -353,8 +421,9 @@ class Scheduler:
             j = int(idx[k])
             self.metrics.schedule_attempts += 1
             if 0 <= j < len(batch.node_names):
-                self._assume_and_bind(info, batch.node_names[j])
-                scheduled += 1
+                if self._assume_and_bind(info, batch.node_names[j]):
+                    scheduled += 1
+                # a Reserve/Permit rejection already requeued the pod
             else:
                 failed.append(info)
         self.metrics.scheduled += scheduled
@@ -374,8 +443,11 @@ class Scheduler:
                     reset()
         return {"scheduled": scheduled, "unschedulable": len(failed)}
 
-    def _assume_and_bind(self, info: QueuedPodInfo, node_name: str) -> None:
-        """assumeAndReserve + async binding cycle (schedule_one.go:307,:391)."""
+    def _assume_and_bind(self, info: QueuedPodInfo, node_name: str) -> bool:
+        """assumeAndReserve + Permit + async binding cycle
+        (schedule_one.go:307 assumeAndReserve, :211 RunPermitPlugins, :391
+        bindingCycle). Returns False when a Reserve/Permit plugin rejected
+        the pod (it was forgotten and requeued)."""
         assumed = info.pod.with_node(node_name)
         self.cache.assume_pod(assumed)
         # a scheduled pod's nomination (if any) is spent
@@ -387,11 +459,101 @@ class Scheduler:
             self.metrics.attempt_latencies.append(
                 self.clock() - info.initial_attempt_timestamp
             )
+        return self._begin_binding(info, assumed)
+
+    def _begin_binding(self, info: QueuedPodInfo, assumed: t.Pod) -> bool:
+        """Reserve → Permit → dispatch (or park as a waiting pod). Shared by
+        the per-pod batch and the pod-group lane."""
+        from ..framework import lifecycle as lc
+
+        node_name = assumed.node_name
+        if self.lifecycle:
+            st = self.lifecycle.run_reserve(self, info.pod, node_name)
+            if not st.ok:
+                self.lifecycle.run_unreserve(self, info.pod, node_name)
+                self._reject_assumed(info, assumed, st)
+                return False
+            st, pending, deadline = self.lifecycle.run_permit(
+                self, info.pod, node_name, self.clock()
+            )
+            if st.code == lc.WAIT:
+                self.waiting_pods[info.key] = lc.WaitingPod(
+                    pod=info.pod, node_name=node_name, info=info,
+                    pending=pending, deadline=deadline,
+                )
+                return True
+            if not st.ok:
+                self.lifecycle.run_unreserve(self, info.pod, node_name)
+                self._reject_assumed(info, assumed, st)
+                return False
+        self._dispatch_bind(info, assumed)
+        return True
+
+    def _dispatch_bind(self, info: QueuedPodInfo, assumed: t.Pod) -> None:
+        node_name = assumed.node_name
 
         def on_done(err: Exception | None, info=info, assumed=assumed) -> None:
             self._bind_completions.append((info, assumed, err))
 
-        self.dispatcher.add(BindCall(info.pod, node_name, on_done=on_done))
+        pre = post = None
+        if self.lifecycle.pre_bind_plugins:
+            def pre(info=info, node_name=node_name):
+                st = self.lifecycle.run_pre_bind(self, info.pod, node_name)
+                if not st.ok:
+                    raise RuntimeError(
+                        f"PreBind {st.plugin}: {st.reason or st.code}"
+                    )
+        if self.lifecycle.post_bind_plugins:
+            def post(info=info, node_name=node_name):
+                self.lifecycle.run_post_bind(self, info.pod, node_name)
+        self.dispatcher.add(
+            BindCall(info.pod, node_name, on_done=on_done, pre=pre, post=post)
+        )
+
+    def _reject_assumed(self, info: QueuedPodInfo, assumed: t.Pod, st) -> None:
+        """A Reserve/Permit rejection (or permit timeout): forget the assume
+        and requeue — handleSchedulingFailure for the binding-path statuses."""
+        self.cache.forget_pod(assumed)
+        self.metrics.unschedulable += 1
+        if info.pod.scheduling_group:
+            self.podgroups.unmark_scheduled(info.pod)
+            self.podgroups.requeue_member(info)
+        else:
+            self.queue.add_unschedulable(
+                info, [st.plugin] if st.plugin else ()
+            )
+
+    # ---------------------------------------------------------- waiting pods
+    def get_waiting_pod(self, key: str):
+        """fwk.Handle.GetWaitingPod — Permit plugins allow/reject through
+        the returned WaitingPod; verdicts take effect next cycle."""
+        return self.waiting_pods.get(key)
+
+    def iterate_waiting_pods(self):
+        return list(self.waiting_pods.values())
+
+    def _drain_waiting_pods(self) -> None:
+        """Move decided waiting pods onward; time out the overdue (the
+        reference rejects on permit timeout, frameworkImpl.WaitOnPermit)."""
+        from ..framework import lifecycle as lc
+
+        now = self.clock()
+        for key in list(self.waiting_pods):
+            wp = self.waiting_pods[key]
+            if wp.rejected is None and wp.pending and now >= wp.deadline:
+                wp.rejected = lc.Status(
+                    lc.UNSCHEDULABLE, "permit wait timed out",
+                    next(iter(sorted(wp.pending))),
+                )
+            if not wp.decided:
+                continue
+            del self.waiting_pods[key]
+            assumed = wp.pod.with_node(wp.node_name)
+            if wp.rejected is not None:
+                self.lifecycle.run_unreserve(self, wp.pod, wp.node_name)
+                self._reject_assumed(wp.info, assumed, wp.rejected)
+            else:
+                self._dispatch_bind(wp.info, assumed)
 
     def _drain_bind_completions(self) -> None:
         """Bind results re-enter the loop thread here (the reference handles
@@ -412,6 +574,9 @@ class Scheduler:
                 self.metrics.bind_errors += 1
                 self.metrics.errors += 1
                 self.cache.forget_pod(assumed)
+                # binding-cycle failure runs Unreserve (schedule_one.go:391
+                # bindingCycle's deferred unreserve-on-failure)
+                self.lifecycle.run_unreserve(self, info.pod, assumed.node_name)
                 if info.pod.scheduling_group:
                     # gang member: hand back to the group manager (it never
                     # lived in the per-pod queue)
@@ -458,6 +623,8 @@ class Scheduler:
             self.cache.cleanup_expired()
             self._last_flush = now
         self.queue.flush_backoff_completed()
+        if self.waiting_pods:
+            self._drain_waiting_pods()
 
     def run_until_idle(self, max_cycles: int = 10000) -> int:
         """Drive cycles until no pod is ready (harness/test mode). Returns

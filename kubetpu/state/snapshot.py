@@ -95,6 +95,11 @@ class Snapshot:
     node_generation: dict[str, int] = field(default_factory=dict)
     # namespace name → labels (the nsLister view affinity terms match)
     namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
+    # volume listers' view (pv/pvc/storageclass), copied on change only
+    pvs: dict[str, "t.PersistentVolume"] = field(default_factory=dict)
+    pvcs: dict[str, "t.PersistentVolumeClaim"] = field(default_factory=dict)  # "ns/name"
+    storage_classes: dict[str, "t.StorageClass"] = field(default_factory=dict)
+    volumes_generation: int = -1
 
     def node_infos(self) -> list[NodeInfo]:
         return [self.nodes[n] for n in self.node_order]
@@ -122,6 +127,41 @@ class Cache:
         self._clock = clock
         self._deleted_nodes: dict[str, NodeInfo] = {}
         self._namespaces: dict[str, dict[str, str]] = {}
+        self._pvs: dict[str, t.PersistentVolume] = {}
+        self._pvcs: dict[str, t.PersistentVolumeClaim] = {}
+        self._storage_classes: dict[str, t.StorageClass] = {}
+        self._volumes_gen = 0
+
+    # --- volumes (pv/pvc/storageclass listers) ---------------------------
+    def add_pv(self, pv: "t.PersistentVolume") -> None:
+        self._pvs[pv.name] = pv
+        self._volumes_gen += 1
+
+    update_pv = add_pv
+
+    def remove_pv(self, name: str) -> None:
+        if self._pvs.pop(name, None) is not None:
+            self._volumes_gen += 1
+
+    def add_pvc(self, pvc: "t.PersistentVolumeClaim") -> None:
+        self._pvcs[pvc.key] = pvc
+        self._volumes_gen += 1
+
+    update_pvc = add_pvc
+
+    def remove_pvc(self, key: str) -> None:
+        if self._pvcs.pop(key, None) is not None:
+            self._volumes_gen += 1
+
+    def add_storage_class(self, sc: "t.StorageClass") -> None:
+        self._storage_classes[sc.name] = sc
+        self._volumes_gen += 1
+
+    update_storage_class = add_storage_class
+
+    def remove_storage_class(self, name: str) -> None:
+        if self._storage_classes.pop(name, None) is not None:
+            self._volumes_gen += 1
 
     # --- namespaces ------------------------------------------------------
     def add_namespace(self, ns: "t.Namespace") -> None:
@@ -280,5 +320,12 @@ class Cache:
         snapshot.node_generation = new_gens
         snapshot.node_order = list(self._node_order)
         snapshot.namespaces = {k: dict(v) for k, v in self._namespaces.items()}
+        if snapshot.volumes_generation != self._volumes_gen:
+            # volume objects are immutable values: a shallow dict copy per
+            # CHANGE (not per refresh) gives the snapshot a stable view
+            snapshot.pvs = dict(self._pvs)
+            snapshot.pvcs = dict(self._pvcs)
+            snapshot.storage_classes = dict(self._storage_classes)
+            snapshot.volumes_generation = self._volumes_gen
         snapshot.generation = next(self._gen)
         return snapshot

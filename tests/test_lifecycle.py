@@ -1,0 +1,205 @@
+"""Reserve / Permit / PreBind / PostBind extension points + plugin registry
+(interface.go:636-680 semantics; frameworkImpl waiting-pods map;
+plugins/registry.go name-keyed registration)."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.framework import config as C
+from kubetpu.framework import lifecycle as lc
+
+from .test_scheduler import FakeClient, FakeClock
+from kubetpu.sched import Scheduler
+
+
+class RecordingPlugin(lc.LifecyclePlugin):
+    """Reserves (tracking order), optionally waits on permit, records
+    unreserve/pre/post calls — the 'test plugin' of the round-3 verdict."""
+
+    def __init__(self, wait: float = 0.0, reject_reserve: bool = False,
+                 fail_pre_bind: bool = False):
+        self.wait = wait
+        self.reject_reserve = reject_reserve
+        self.fail_pre_bind = fail_pre_bind
+        self.events: list[tuple[str, str]] = []
+
+    def reserve(self, handle, pod, node_name):
+        self.events.append(("reserve", pod.name))
+        if self.reject_reserve:
+            return lc.Status(lc.UNSCHEDULABLE, "no room reserved")
+        return lc.Status()
+
+    def unreserve(self, handle, pod, node_name):
+        self.events.append(("unreserve", pod.name))
+
+    def permit(self, handle, pod, node_name):
+        if self.wait:
+            self.events.append(("permit-wait", pod.name))
+            return lc.Status(lc.WAIT), self.wait
+        self.events.append(("permit-allow", pod.name))
+        return lc.Status(), 0.0
+
+    def pre_bind(self, handle, pod, node_name):
+        self.events.append(("pre_bind", pod.name))
+        if self.fail_pre_bind:
+            return lc.Status(lc.UNSCHEDULABLE, "volume attach failed")
+        return lc.Status()
+
+    def post_bind(self, handle, pod, node_name):
+        self.events.append(("post_bind", pod.name))
+
+
+def build(plugin, **sched_kw):
+    reg = lc.Registry()
+    reg.register("TestPlugin", lambda profile: plugin)
+    profile = C.Profile(
+        filters=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        lifecycle=C.PluginSet(enabled=(("TestPlugin", 1),)),
+        default_spread_constraints=(),
+    )
+    client = FakeClient(**sched_kw.pop("client_kw", {}))
+    clock = FakeClock()
+    s = Scheduler(client, profile=profile, registry=reg,
+                  dispatcher_workers=0, clock=clock, **sched_kw)
+    return s, client, clock, plugin
+
+
+def test_full_lifecycle_order():
+    plugin = RecordingPlugin()
+    s, client, _, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound == {"default/p": "n0"}
+    assert plugin.events == [
+        ("reserve", "p"), ("permit-allow", "p"),
+        ("pre_bind", "p"), ("post_bind", "p"),
+    ]
+
+
+def test_reserve_rejection_unreserves_and_requeues():
+    plugin = RecordingPlugin(reject_reserve=True)
+    s, client, _, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    res = s.schedule_batch()
+    assert res["scheduled"] == 0
+    assert client.bound == {}
+    assert ("unreserve", "p") in plugin.events
+    # the assume was rolled back
+    snap = s.cache.update_snapshot()
+    assert not snap.nodes["n0"].pods
+    # pod is requeued with the rejecting plugin as its rejector
+    assert len(s.queue) == 1
+
+
+def test_permit_wait_parks_then_allow_binds():
+    plugin = RecordingPlugin(wait=300.0)
+    s, client, _, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    res = s.schedule_batch()
+    assert res["scheduled"] == 1          # assumed + waiting counts as in-cycle
+    assert client.bound == {}             # NOT bound yet
+    wp = s.get_waiting_pod("default/p")
+    assert wp is not None and wp.pending == {"TestPlugin"}
+    # resources stay reserved while waiting (the assume holds)
+    snap = s.cache.update_snapshot()
+    assert snap.nodes["n0"].pods
+    wp.allow("TestPlugin")
+    s.schedule_batch()                    # drain loop picks up the verdict
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound == {"default/p": "n0"}
+
+
+def test_permit_reject_unreserves_and_forgets():
+    plugin = RecordingPlugin(wait=300.0)
+    s, client, _, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    s.schedule_batch()
+    wp = s.get_waiting_pod("default/p")
+    wp.reject("TestPlugin", "gang quorum failed")
+    s.schedule_batch()
+    assert client.bound == {}
+    assert ("unreserve", "p") in plugin.events
+    snap = s.cache.update_snapshot()
+    assert not snap.nodes["n0"].pods      # assume rolled back
+
+
+def test_permit_timeout_rejects():
+    plugin = RecordingPlugin(wait=5.0)
+    s, client, clock, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    s.schedule_batch()
+    assert s.get_waiting_pod("default/p") is not None
+    clock.tick(6.0)                       # past the permit timeout
+    s.schedule_batch()
+    assert s.get_waiting_pod("default/p") is None
+    assert client.bound == {}
+    assert ("unreserve", "p") in plugin.events
+
+
+def test_bind_failure_unreserves():
+    plugin = RecordingPlugin()
+    s, client, clock, _ = build(
+        plugin, client_kw=dict(fail_binds_for={"default/p"})
+    )
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s.schedule_batch()                    # drains the failed completion
+    assert ("unreserve", "p") in plugin.events
+    # retry succeeds (FakeClient fails once) and re-reserves
+    clock.tick(30)
+    for _ in range(4):
+        s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound == {"default/p": "n0"}
+    assert plugin.events.count(("reserve", "p")) == 2
+
+
+def test_pre_bind_failure_fails_binding_cycle():
+    plugin = RecordingPlugin(fail_pre_bind=True)
+    s, client, _, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s.schedule_batch()
+    assert client.bound == {}
+    assert ("unreserve", "p") in plugin.events
+    assert s.metrics.bind_errors == 1
+
+
+def test_registry_rejects_unknown_and_duplicate_names():
+    reg = lc.Registry()
+    reg.register("A", lambda p: lc.LifecyclePlugin())
+    with pytest.raises(ValueError):
+        reg.register("A", lambda p: lc.LifecyclePlugin())
+    with pytest.raises(KeyError):
+        reg.build(["Missing"], C.Profile())
+
+
+def test_waiting_pod_deleted_while_waiting():
+    plugin = RecordingPlugin(wait=300.0)
+    s, client, _, _ = build(plugin)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    pod = make_pod("p", cpu_milli=100)
+    s.on_pod_add(pod)
+    s.schedule_batch()
+    assert s.get_waiting_pod("default/p") is not None
+    s.on_pod_delete(pod)
+    assert s.get_waiting_pod("default/p") is None
+    assert ("unreserve", "p") in plugin.events
+    snap = s.cache.update_snapshot()
+    assert not snap.nodes["n0"].pods

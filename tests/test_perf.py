@@ -159,3 +159,11 @@ def test_gang_scheduling_all_or_nothing_at_capacity():
     r = run_workload(case, wl, timeout_s=60, warmup=False)
     assert r.scheduled % 3 == 0
     assert r.scheduled <= 80
+
+
+def test_volumes_workloads_toy_scale():
+    """The volumes perf topic at toy scale: every pod's bound PV+PVC pair
+    admits it (volumes/performance-config.yaml shapes)."""
+    for case in ("SchedulingInTreePVs", "SchedulingCSIPVs"):
+        r = run_workload(case, "5Nodes", timeout_s=60, warmup=False)
+        assert r.scheduled == 10, case

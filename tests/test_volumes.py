@@ -1,0 +1,348 @@
+"""Volume plugin family: VolumeZone, VolumeBinding (Filter + Reserve/
+PreBind), VolumeRestrictions (ReadWriteOncePod), NodeVolumeLimits — against
+the reference semantics (volumezone/volume_zone.go,
+volumebinding/volume_binding.go, volumerestrictions/, nodevolumelimits/)."""
+
+import dataclasses
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.assign import greedy_assign
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch
+from kubetpu.state import Cache
+
+from .test_scheduler import FakeClient, make_sched
+
+ZONE = "topology.kubernetes.io/zone"
+BETA_ZONE = "failure-domain.beta.kubernetes.io/zone"
+
+
+def volume_profile():
+    return C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.VOLUME_ZONE, 1),
+            (C.VOLUME_BINDING, 1), (C.VOLUME_RESTRICTIONS, 1),
+            (C.NODE_VOLUME_LIMITS, 1),
+        )),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+
+
+def two_zone_cache():
+    cache = Cache()
+    for i, z in enumerate(("zone-a", "zone-a", "zone-b")):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000, labels={ZONE: z}))
+    return cache
+
+
+def assign(cache, pods, profile=None):
+    profile = profile or volume_profile()
+    batch = encode_batch(cache.update_snapshot(), pods, profile)
+    return greedy_assign(batch, profile)
+
+
+class TestVolumeZone:
+    def test_bound_pv_zone_restricts_nodes(self):
+        cache = two_zone_cache()
+        cache.add_pv(t.PersistentVolume(
+            name="pv-b", labels=((ZONE, "zone-b"),),
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(name="claim", volume_name="pv-b"))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == ["n2"]            # only the zone-b node
+
+    def test_beta_pv_label_matches_ga_node_label(self):
+        """volume_zone.go:91 translateToGALabel: a PV with the beta zone
+        label matches nodes labeled with the GA key."""
+        cache = two_zone_cache()
+        cache.add_pv(t.PersistentVolume(
+            name="pv-b", labels=((BETA_ZONE, "zone-b"),),
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(name="claim", volume_name="pv-b"))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == ["n2"]
+
+    def test_unlabeled_node_single_zone_escape(self):
+        """volume_zone.go:226: nodes with NO topology labels pass."""
+        cache = Cache()
+        cache.add_node(make_node("bare", cpu_milli=4000))
+        cache.add_pv(t.PersistentVolume(
+            name="pv", labels=((ZONE, "zone-x"),),
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(name="claim", volume_name="pv"))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == ["bare"]
+
+
+class TestVolumeBindingFilter:
+    def test_missing_pvc_unschedulable(self):
+        cache = two_zone_cache()
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("ghost",))])
+        assert got == [None]
+
+    def test_unbound_immediate_class_waits_for_binder(self):
+        cache = two_zone_cache()
+        cache.add_storage_class(t.StorageClass(
+            name="fast", binding_mode=t.BINDING_IMMEDIATE,
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", storage_class="fast",
+        ))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == [None]
+
+    def test_wffc_restricts_to_nodes_with_matching_pv(self):
+        """WaitForFirstConsumer + no-provisioner: only nodes an available
+        PV's node affinity covers pass."""
+        cache = two_zone_cache()
+        cache.add_storage_class(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        sel = t.NodeSelector(terms=(t.NodeSelectorTerm(
+            match_expressions=(t.Requirement(ZONE, t.Operator.IN, ("zone-b",)),)
+        ),))
+        cache.add_pv(t.PersistentVolume(
+            name="pv-local", storage_class="local", capacity=100,
+            node_affinity=sel,
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", storage_class="local", request=50,
+        ))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == ["n2"]
+
+    def test_wffc_dynamic_provisioner_passes_everywhere(self):
+        cache = two_zone_cache()
+        cache.add_storage_class(t.StorageClass(
+            name="csi", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+            provisioner="ebs.csi.example.com",
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", storage_class="csi", request=50,
+        ))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got[0] is not None
+
+    def test_too_small_pv_does_not_match(self):
+        cache = two_zone_cache()
+        cache.add_storage_class(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        cache.add_pv(t.PersistentVolume(
+            name="small", storage_class="local", capacity=10,
+        ))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", storage_class="local", request=50,
+        ))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == [None]
+
+
+class TestVolumeRestrictions:
+    def test_rwop_claim_in_use_rejects(self):
+        cache = two_zone_cache()
+        cache.add_pv(t.PersistentVolume(name="pv"))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", volume_name="pv",
+            access_modes=(t.READ_WRITE_ONCE_POD,),
+        ))
+        cache.add_pod(make_pod("owner", cpu_milli=100, pvcs=("claim",),
+                               node_name="n0"))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got == [None]
+
+    def test_rwx_claim_shared_ok(self):
+        cache = two_zone_cache()
+        cache.add_pv(t.PersistentVolume(name="pv"))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", volume_name="pv", access_modes=("ReadWriteMany",),
+        ))
+        cache.add_pod(make_pod("owner", cpu_milli=100, pvcs=("claim",),
+                               node_name="n0"))
+        got = assign(cache, [make_pod("p", cpu_milli=100, pvcs=("claim",))])
+        assert got[0] is not None
+
+
+class TestNodeVolumeLimits:
+    def test_csi_attach_limit_enforced(self):
+        cache = Cache()
+        # both nodes allow 2 attachments of driver d; n0 already has 2
+        for n in ("n0", "n1"):
+            cache.add_node(make_node(
+                n, cpu_milli=4000,
+                extended={"attachable-volumes-csi-d": 2},
+            ))
+        for i in range(3):
+            cache.add_pv(t.PersistentVolume(name=f"pv{i}", driver="d"))
+            cache.add_pvc(t.PersistentVolumeClaim(
+                name=f"c{i}", volume_name=f"pv{i}",
+            ))
+        cache.add_pod(make_pod("e0", cpu_milli=10, pvcs=("c0",), node_name="n0"))
+        cache.add_pod(make_pod("e1", cpu_milli=10, pvcs=("c1",), node_name="n0"))
+        got = assign(cache, [make_pod("p", cpu_milli=10, pvcs=("c2",))])
+        assert got == ["n1"]            # n0 is at its attach limit
+
+
+class TestVolumeBindingLifecycle:
+    def test_reserve_assumes_and_prebind_binds(self):
+        """The WFFC claim gets a concrete PV at Reserve (smallest fit on the
+        chosen node) and PreBind issues the binding write."""
+        client = FakeClient()
+        client.pvc_binds = []
+        client.bind_pvc = lambda pvc, pv: client.pvc_binds.append(
+            (pvc.key, pv)
+        )
+        s, _ = make_sched(client, profile=volume_profile())
+        s.on_node_add(make_node("n0", cpu_milli=4000, labels={ZONE: "a"}))
+        s.on_storage_class_add(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        s.on_pv_add(t.PersistentVolume(
+            name="pv-big", storage_class="local", capacity=500,
+        ))
+        s.on_pv_add(t.PersistentVolume(
+            name="pv-small", storage_class="local", capacity=100,
+        ))
+        s.on_pvc_add(t.PersistentVolumeClaim(
+            name="claim", storage_class="local", request=50,
+        ))
+        s.on_pod_add(make_pod("p", cpu_milli=100, pvcs=("claim",)))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert client.bound == {"default/p": "n0"}
+        # smallest-fit PV chosen, bound via the client write
+        assert client.pvc_binds == [("default/claim", "pv-small")]
+        snap = s.cache.update_snapshot()
+        assert snap.pvcs["default/claim"].volume_name == "pv-small"
+        assert snap.pvs["pv-small"].claim_ref == "default/claim"
+
+    def test_second_pod_cannot_double_book_assumed_pv(self):
+        """The assumed binding claims the PV in cache: a second WFFC claim
+        in the same batch must take the OTHER PV."""
+        client = FakeClient()
+        s, _ = make_sched(client, profile=volume_profile())
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_storage_class_add(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        for i in range(2):
+            s.on_pv_add(t.PersistentVolume(
+                name=f"pv{i}", storage_class="local", capacity=100,
+            ))
+            s.on_pvc_add(t.PersistentVolumeClaim(
+                name=f"claim{i}", storage_class="local", request=50,
+            ))
+        s.on_pod_add(make_pod("p0", cpu_milli=100, pvcs=("claim0",),
+                              creation_index=0))
+        s.on_pod_add(make_pod("p1", cpu_milli=100, pvcs=("claim1",),
+                              creation_index=1))
+        for _ in range(3):
+            s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        snap = s.cache.update_snapshot()
+        assert snap.pvcs["default/claim0"].volume_name
+        assert snap.pvcs["default/claim1"].volume_name
+        assert (snap.pvcs["default/claim0"].volume_name
+                != snap.pvcs["default/claim1"].volume_name)
+
+    def test_unreserve_on_bind_failure_releases_pv(self):
+        client = FakeClient(fail_binds_for={"default/p"})
+        s, clock = make_sched(client, profile=volume_profile())
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_storage_class_add(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        s.on_pv_add(t.PersistentVolume(
+            name="pv0", storage_class="local", capacity=100,
+        ))
+        s.on_pvc_add(t.PersistentVolumeClaim(
+            name="claim", storage_class="local", request=50,
+        ))
+        s.on_pod_add(make_pod("p", cpu_milli=100, pvcs=("claim",)))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s.schedule_batch()      # drain the failed completion -> unreserve
+        snap = s.cache.update_snapshot()
+        # NOTE: PreBind already consumed the assumption before the bind API
+        # call failed; the claim write stands (the reference keeps bound
+        # volumes on bind failure too — the pod retries with a bound claim)
+        clock.tick(30)
+        for _ in range(4):
+            s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert client.bound == {"default/p": "n0"}
+
+
+class TestReviewRegressions:
+    def test_two_claims_one_pod_distinct_pvs(self):
+        """Reserve must not hand the same PV to two claims of one pod."""
+        client = FakeClient()
+        s, _ = make_sched(client, profile=volume_profile())
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_storage_class_add(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        for i in range(2):
+            s.on_pv_add(t.PersistentVolume(
+                name=f"pv{i}", storage_class="local", capacity=100,
+            ))
+            s.on_pvc_add(t.PersistentVolumeClaim(
+                name=f"claim{i}", storage_class="local", request=50,
+            ))
+        s.on_pod_add(make_pod("p", cpu_milli=100, pvcs=("claim0", "claim1")))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        snap = s.cache.update_snapshot()
+        v0 = snap.pvcs["default/claim0"].volume_name
+        v1 = snap.pvcs["default/claim1"].volume_name
+        assert v0 and v1 and v0 != v1
+
+    def test_partial_reserve_failure_reverts_picks(self):
+        """First claim matches, second has no PV: the first claim's assumed
+        binding must be reverted, leaving the PV available."""
+        client = FakeClient()
+        s, _ = make_sched(client, profile=volume_profile())
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_storage_class_add(t.StorageClass(
+            name="local", binding_mode=t.BINDING_WAIT_FOR_FIRST_CONSUMER,
+        ))
+        s.on_pv_add(t.PersistentVolume(
+            name="pv0", storage_class="local", capacity=100,
+        ))
+        for i in range(2):
+            s.on_pvc_add(t.PersistentVolumeClaim(
+                name=f"claim{i}", storage_class="local", request=50,
+            ))
+        # the static filter passes (pv0 satisfies either claim's class), but
+        # Reserve can only bind one of the two claims -> rejection + revert
+        s.on_pod_add(make_pod("p", cpu_milli=100, pvcs=("claim0", "claim1")))
+        s.schedule_batch()
+        snap = s.cache.update_snapshot()
+        assert snap.pvs["pv0"].claim_ref == ""
+        assert snap.pvcs["default/claim0"].volume_name == ""
+        assert client.bound == {}
+
+    def test_rwop_in_batch_conflict(self):
+        """Two batch pods sharing an RWOP claim must not co-schedule."""
+        cache = two_zone_cache()
+        cache.add_pv(t.PersistentVolume(name="pv"))
+        cache.add_pvc(t.PersistentVolumeClaim(
+            name="claim", volume_name="pv",
+            access_modes=(t.READ_WRITE_ONCE_POD,),
+        ))
+        got = assign(cache, [
+            make_pod("p0", cpu_milli=100, pvcs=("claim",), creation_index=0),
+            make_pod("p1", cpu_milli=100, pvcs=("claim",), creation_index=1),
+        ])
+        assert got[0] is not None
+        assert got[1] is None
