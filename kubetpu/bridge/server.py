@@ -68,11 +68,16 @@ class ExtenderBackend:
         self,
         profile: C.Profile | None = None,
         bind_fn: Callable[[t.Pod, str], None] | None = None,
+        metrics_source: Callable[[], str] | None = None,
     ) -> None:
+        """``metrics_source``: optional Prometheus-text provider served at
+        GET /metrics (e.g. a Scheduler's ``metrics_text`` — every reference
+        binary exposes /metrics, component-base/metrics legacy registry)."""
         self.profile = profile or C.minimal_profile()
         self.cache = Cache()
         self.lock = threading.Lock()
         self._bind_fn = bind_fn
+        self.metrics_source = metrics_source
         # persistent snapshot: update_snapshot(self._snapshot) re-clones only
         # NodeInfos whose generation moved, so an unchanged cache costs O(Δ)
         # per webhook hit (cache.go:190 UpdateSnapshot semantics)
@@ -354,6 +359,18 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply({"Error": ""})
             elif path.endswith("/healthz"):
                 self._reply({"ok": True})
+            elif path.endswith("/metrics"):
+                if be.metrics_source is None:
+                    self._reply({"Error": "no metrics source wired"}, status=404)
+                else:
+                    body = be.metrics_source().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type", "text/plain; version=0.0.4"
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
             else:
                 self._reply({"Error": f"Unknown verb {path!r}"}, status=404)
         except Exception as e:

@@ -1,0 +1,11 @@
+"""Metrics — Prometheus-shaped counters/gauges/histograms with a registry
+and text exposition (the component-base/metrics analog, SURVEY §5)."""
+
+from .registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    exponential_buckets,
+)
+from .scheduler_metrics import SchedulerMetricsRegistry  # noqa: F401

@@ -1,0 +1,251 @@
+"""Prometheus-shaped metric primitives + registry + text exposition.
+
+The analog of staging/src/k8s.io/component-base/metrics (which wraps
+client_golang): Counter/Gauge/Histogram vectors keyed by label values, a
+Registry for /metrics exposition (Prometheus text format 0.0.4), and
+``exponential_buckets`` matching prometheus.ExponentialBuckets — the bucket
+layouts in pkg/scheduler/metrics/metrics.go are reproduced exactly so
+dashboards built for the reference read identically.
+
+Histogram quantiles use the Prometheus histogram_quantile estimation
+(linear interpolation within the bucket), so the perf harness's p99 numbers
+come from the same math a PromQL query would produce.
+"""
+
+from __future__ import annotations
+
+import bisect
+import math
+import threading
+from dataclasses import dataclass, field
+
+
+def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
+    """prometheus.ExponentialBuckets: count buckets, start * factor^i."""
+    return [start * (factor ** i) for i in range(count)]
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labels: tuple[str, ...] = ()):
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self._children: dict[tuple, "_Metric"] = {}
+        self._lock = threading.Lock()
+
+    def labels(self, *values: str):
+        """Child metric for one label-value combination (Vec semantics)."""
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, got {values}"
+            )
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._make_child()
+                self._children[key] = child
+            return child
+
+    def _make_child(self):
+        raise NotImplementedError
+
+    def _children_snapshot(self) -> list[tuple]:
+        """Stable view for iteration — labels() may insert concurrently
+        (the scheduler thread observes while a /metrics scrape walks)."""
+        with self._lock:
+            return list(self._children.items())
+
+    def samples(self):
+        """Yield (suffix, label_values, extra_label_pairs, value)."""
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name, help="", labels=()):
+        super().__init__(name, help, labels)
+        self.value = 0.0
+
+    def _make_child(self):
+        return Counter(self.name)
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def samples(self):
+        if self.label_names:
+            for key, child in self._children_snapshot():
+                yield "", key, child.value
+        else:
+            yield "", (), self.value
+
+
+class Gauge(Counter):
+    kind = "gauge"
+
+    def _make_child(self):
+        return Gauge(self.name)
+
+    def set(self, value: float) -> None:
+        self.value = value
+
+    def dec(self, amount: float = 1.0) -> None:
+        self.value -= amount
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name, help="", labels=(), buckets=None):
+        super().__init__(name, help, labels)
+        self.buckets = list(buckets if buckets is not None
+                            else exponential_buckets(0.001, 2, 15))
+        self.counts = [0] * (len(self.buckets) + 1)   # +Inf tail
+        self.total = 0
+        self.sum = 0.0
+
+    def _make_child(self):
+        return Histogram(self.name, buckets=self.buckets)
+
+    def observe(self, value: float) -> None:
+        i = bisect.bisect_left(self.buckets, value)
+        self.counts[i] += 1
+        self.total += 1
+        self.sum += value
+
+    def observe_n(self, value: float, n: int) -> None:
+        """n identical observations in O(1) — batch cycles record one
+        duration for every pod of the batch."""
+        if n <= 0:
+            return
+        i = bisect.bisect_left(self.buckets, value)
+        self.counts[i] += n
+        self.total += n
+        self.sum += value * n
+
+    def merged(self) -> "Histogram":
+        """Aggregate across children (and self) — what a PromQL sum() over
+        label dimensions sees."""
+        out = Histogram(self.name, buckets=self.buckets)
+        children = [c for _, c in self._children_snapshot()]
+        sources = children or [self]
+        if children and self.total:
+            sources.append(self)
+        for src in sources:
+            for i, c in enumerate(src.counts):
+                out.counts[i] += c
+            out.total += src.total
+            out.sum += src.sum
+        return out
+
+    def since(self, earlier: "Histogram") -> "Histogram":
+        """The delta histogram vs an earlier ``merged()`` snapshot — scopes
+        quantiles to a measurement window (the perf harness's per-workload
+        p99)."""
+        h = self.merged() if self._children else self
+        out = Histogram(self.name, buckets=self.buckets)
+        out.counts = [a - b for a, b in zip(h.counts, earlier.counts)]
+        out.total = h.total - earlier.total
+        out.sum = h.sum - earlier.sum
+        return out
+
+    def quantile(self, q: float) -> float:
+        """histogram_quantile(q, …): linear interpolation inside the target
+        bucket; NaN when empty; the last bucket's upper bound caps +Inf."""
+        h = self.merged() if self._children_snapshot() else self
+        if h.total == 0:
+            return float("nan")
+        rank = q * h.total
+        acc = 0
+        for i, c in enumerate(h.counts):
+            acc += c
+            if acc >= rank and c > 0:
+                lo = h.buckets[i - 1] if i > 0 else 0.0
+                hi = h.buckets[i] if i < len(h.buckets) else h.buckets[-1]
+                frac = (rank - (acc - c)) / c
+                return lo + (hi - lo) * frac
+        return h.buckets[-1]
+
+    def samples(self):
+        def rows(child, key):
+            acc = 0
+            for i, ub in enumerate(child.buckets):
+                acc += child.counts[i]
+                yield "_bucket", key + (("le", _fmt(ub)),), acc
+            yield "_bucket", key + (("le", "+Inf"),), child.total
+            yield "_sum", key, child.sum
+            yield "_count", key, child.total
+
+        if self.label_names:
+            for key, child in self._children_snapshot():
+                labeled = tuple(zip(self.label_names, key))
+                yield from rows(child, labeled)
+        else:
+            yield from rows(self, ())
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if v == int(v):
+        return str(int(v))
+    return repr(v)
+
+
+@dataclass
+class Registry:
+    """Named metric registry + Prometheus text exposition (the legacy
+    registry + /metrics handler of component-base)."""
+
+    metrics: dict[str, _Metric] = field(default_factory=dict)
+
+    def register(self, metric: _Metric) -> _Metric:
+        if metric.name in self.metrics:
+            raise ValueError(f"metric {metric.name!r} already registered")
+        self.metrics[metric.name] = metric
+        return metric
+
+    def counter(self, name, help="", labels=()) -> Counter:
+        return self.register(Counter(name, help, labels))
+
+    def gauge(self, name, help="", labels=()) -> Gauge:
+        return self.register(Gauge(name, help, labels))
+
+    def histogram(self, name, help="", labels=(), buckets=None) -> Histogram:
+        return self.register(Histogram(name, help, labels, buckets))
+
+    def get(self, name: str) -> _Metric | None:
+        return self.metrics.get(name)
+
+    def expose(self) -> str:
+        """Prometheus text format 0.0.4."""
+        out: list[str] = []
+        for name in sorted(self.metrics):
+            m = self.metrics[name]
+            out.append(f"# HELP {name} {m.help}")
+            out.append(f"# TYPE {name} {m.kind}")
+            for suffix, label_pairs, value in m.samples():
+                if isinstance(label_pairs, tuple) and label_pairs and (
+                    not isinstance(label_pairs[0], tuple)
+                ):
+                    # bare child key from a vec Counter/Gauge
+                    label_pairs = tuple(zip(m.label_names, label_pairs))
+                if label_pairs:
+                    body = ",".join(
+                        f'{k}="{v}"' for k, v in label_pairs
+                    )
+                    out.append(f"{name}{suffix}{{{body}}} {_num(value)}")
+                else:
+                    out.append(f"{name}{suffix} {_num(value)}")
+        return "\n".join(out) + "\n"
+
+
+def _num(v) -> str:
+    f = float(v)
+    if f == int(f):
+        return str(int(f))
+    return repr(f)

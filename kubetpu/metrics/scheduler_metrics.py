@@ -1,0 +1,90 @@
+"""The scheduler's metric set, with the reference's names and bucket
+layouts (pkg/scheduler/metrics/metrics.go):
+
+- scheduling_attempt_duration_seconds{result, profile} (:247, STABLE,
+  ExponentialBuckets(0.001, 2, 15))
+- scheduling_algorithm_duration_seconds (:252, same buckets)
+- pod_scheduling_sli_duration_seconds{attempts} (:316, BETA,
+  ExponentialBuckets(0.01, 2, 20)) — e2e from queue entry to bind dispatch
+- pod_scheduling_attempts (:327, ExponentialBuckets(1, 2, 5))
+- framework_extension_point_duration_seconds{extension_point, status,
+  profile} (:344, ExponentialBuckets(0.0001, 2, 12))
+- schedule_attempts_total{result, profile}, preemption_attempts_total,
+  preemption_victims (:267 ExponentialBuckets(1, 2, 7)), pending_pods{queue}
+"""
+
+from __future__ import annotations
+
+from .registry import Registry, exponential_buckets
+
+
+class SchedulerMetricsRegistry:
+    """Owns a Registry pre-populated with the scheduler metric set; the
+    Scheduler observes into it and /metrics exposes it."""
+
+    def __init__(self) -> None:
+        r = Registry()
+        self.registry = r
+        self.scheduling_attempt_duration = r.histogram(
+            "scheduler_scheduling_attempt_duration_seconds",
+            "Scheduling attempt latency in seconds (scheduling algorithm + binding)",
+            labels=("result", "profile"),
+            buckets=exponential_buckets(0.001, 2, 15),
+        )
+        self.scheduling_algorithm_duration = r.histogram(
+            "scheduler_scheduling_algorithm_duration_seconds",
+            "Scheduling algorithm latency in seconds",
+            buckets=exponential_buckets(0.001, 2, 15),
+        )
+        self.pod_scheduling_sli_duration = r.histogram(
+            "scheduler_pod_scheduling_sli_duration_seconds",
+            "E2e latency for a pod being scheduled, from the time the pod "
+            "enters the scheduling queue and might involve multiple "
+            "scheduling attempts.",
+            labels=("attempts",),
+            buckets=exponential_buckets(0.01, 2, 20),
+        )
+        self.pod_scheduling_attempts = r.histogram(
+            "scheduler_pod_scheduling_attempts",
+            "Number of attempts to successfully schedule a pod.",
+            buckets=exponential_buckets(1, 2, 5),
+        )
+        self.framework_extension_point_duration = r.histogram(
+            "scheduler_framework_extension_point_duration_seconds",
+            "Latency for running all plugins of a specific extension point.",
+            labels=("extension_point", "status", "profile"),
+            buckets=exponential_buckets(0.0001, 2, 12),
+        )
+        self.schedule_attempts = r.counter(
+            "scheduler_schedule_attempts_total",
+            "Number of attempts to schedule pods, by the result.",
+            labels=("result", "profile"),
+        )
+        self.preemption_attempts = r.counter(
+            "scheduler_preemption_attempts_total",
+            "Total preemption attempts in the cluster till now",
+        )
+        self.preemption_victims = r.histogram(
+            "scheduler_preemption_victims",
+            "Number of selected preemption victims",
+            buckets=exponential_buckets(1, 2, 7),
+        )
+        self.pending_pods = r.gauge(
+            "scheduler_pending_pods",
+            "Number of pending pods, by the queue type.",
+            labels=("queue",),
+        )
+        self.queue_incoming_pods = r.counter(
+            "scheduler_queue_incoming_pods_total",
+            "Number of pods added to scheduling queues by event and queue type.",
+            labels=("queue", "event"),
+        )
+
+    def expose(self) -> str:
+        return self.registry.expose()
+
+    # --- convenience for the perf harness ---------------------------------
+    def p99_attempt_latency_s(self) -> float:
+        """p99 of pod_scheduling_sli_duration_seconds across attempt labels
+        (histogram_quantile over the summed buckets)."""
+        return self.pod_scheduling_sli_duration.quantile(0.99)

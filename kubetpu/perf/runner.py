@@ -104,15 +104,16 @@ class _Client:
                 self.sched.on_pod_delete(a)
 
 
-def _begin_measured_phase(sched, warmup: bool, warm_pods) -> tuple[int, int, int]:
+def _begin_measured_phase(sched, warmup: bool, warm_pods):
     """Optionally compile the measured phase's device program, then snapshot
-    the metric counters the measurement is scoped to."""
+    the metric counters (and the SLI histogram) the measurement is scoped
+    to."""
     if warmup:
         sched.warmup(warm_pods)
     return (
         sched.metrics.schedule_attempts,
         sched.metrics.cycles,
-        len(sched.metrics.attempt_latencies),
+        sched.metrics.prom.pod_scheduling_sli_duration.merged(),
     )
 
 
@@ -168,6 +169,7 @@ def run_workload(
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine,
+        feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     client.sched = sched
     sched.enable_preemption()
@@ -175,7 +177,8 @@ def run_workload(
     churns: list[_Churn] = []
     measured = 0
     duration = 0.0
-    attempts0 = cycles0 = lat0 = 0
+    attempts0 = cycles0 = 0
+    lat0 = None
     op_ns_counter = 0
 
     def settle(target: int) -> tuple[int, float]:
@@ -323,16 +326,14 @@ def run_workload(
     sched.dispatcher.sync()
     client.deliver()
     sched._drain_bind_completions()
+    # p99 from the pod_scheduling_sli_duration_seconds HISTOGRAM, scoped to
+    # the measured phase (the reference's perf harness reads the scheduler
+    # histograms the same way; histogram_quantile estimation)
     lat = None
-    lats = list(sched.metrics.attempt_latencies)
-    if len(lats) < sched.metrics.attempt_latencies.maxlen:
-        # p99 over the MEASURED phase only (the reference's throughput
-        # collector scopes histograms to the workload the same way); when
-        # the bounded deque overflowed, offsets are unknowable — fall back
-        # to the whole reservoir
-        lats = lats[lat0:]
-    if lats:
-        lat = float(np.percentile(np.asarray(lats), 99) * 1000.0)
+    if lat0 is not None:
+        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(lat0)
+        if delta.total > 0:
+            lat = float(delta.quantile(0.99) * 1000.0)
     throughput = measured / duration if duration > 0 else 0.0
     result = WorkloadResult(
         case_name=case.name,

@@ -269,6 +269,8 @@ class TestCase:
     workloads: tuple[Workload, ...]
     default_pod_template: PodTemplate = pod_default
     source: str = ""                        # reference config citation
+    # per-case featureGates block (performance-config.yaml featureGates:)
+    feature_gates: tuple[tuple[str, bool], ...] = ()
 
 
 # ---------------------------------------------------------------------------
@@ -467,6 +469,7 @@ _case(TestCase(
 _case(TestCase(
     name="GangScheduling",
     source="podgroup/gangscheduling/performance-config.yaml:7 (no thresholds yet — new suite)",
+    feature_gates=(("GenericWorkload", True), ("GangScheduling", True)),
     ops=(
         CreateNodesOp("initNodes"),
         CreateNamespacesOp("gang", 1),

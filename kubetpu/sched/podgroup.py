@@ -283,11 +283,15 @@ def schedule_pod_groups(sched: "Scheduler", budget: int) -> dict[str, int]:
     plain: list[tuple[str, GroupEntry]] = []
     constrained: list[tuple[str, GroupEntry]] = []
     total = 0
+    # placement search rides the TopologyAwareWorkloadScheduling gate
+    # (schedule_one_podgroup.go:759: non-TAS falls back to the default
+    # algorithm, which ignores topology constraints)
+    tas = sched.feature_gates.enabled("TopologyAwareWorkloadScheduling")
     for key, e in ready:
         if total + len(e.pending) > budget and (plain or constrained):
             break
         total += len(e.pending)
-        if e.group is not None and e.group.topology_keys:
+        if tas and e.group is not None and e.group.topology_keys:
             constrained.append((key, e))
         else:
             plain.append((key, e))
@@ -456,9 +460,12 @@ def _bind_member(
     assumed = info.pod.with_node(node_name)
     sched.cache.assume_pod(assumed)
     if info.initial_attempt_timestamp is not None:
-        sched.metrics.attempt_latencies.append(
-            sched.clock() - info.initial_attempt_timestamp
-        )
+        sli = sched.clock() - info.initial_attempt_timestamp
+        sched.metrics.attempt_latencies.append(sli)
+        sched.metrics.prom.pod_scheduling_sli_duration.labels(
+            str(info.attempts)
+        ).observe(sli)
+        sched.metrics.prom.pod_scheduling_attempts.observe(info.attempts)
     if not sched._begin_binding(info, assumed):
         return False
     sched.metrics.scheduled += 1

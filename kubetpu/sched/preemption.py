@@ -69,6 +69,7 @@ class DefaultPreemptionPostFilter:
         if i is None:
             return None
         sched.metrics.preemption_attempts += 1
+        sched.metrics.prom.preemption_attempts.inc()
 
         if self._ctx_token is not ctx:
             self._ctx_token = ctx
@@ -84,6 +85,7 @@ class DefaultPreemptionPostFilter:
             return None
 
         sched.metrics.preemption_victims += len(result.victim_pods)
+        sched.metrics.prom.preemption_victims.observe(len(result.victim_pods))
         sched._preempting[info.key] = set(result.victim_uids)
         sched.nominator.add(info.pod, result.node_name)
         for victim in result.victim_pods:

@@ -50,8 +50,9 @@ import numpy as np
 
 @dataclass
 class SchedulerMetrics:
-    """Counters the full metrics registry (kubetpu.metrics) wraps later;
-    names mirror pkg/scheduler/metrics/metrics.go."""
+    """Plain counters (hot-loop cheap) + the Prometheus-shaped registry
+    (kubetpu.metrics) holding the reference-named histograms
+    (pkg/scheduler/metrics/metrics.go)."""
 
     schedule_attempts: int = 0          # scheduling_attempts_total
     scheduled: int = 0                  # result "scheduled"
@@ -62,11 +63,18 @@ class SchedulerMetrics:
     preemption_attempts: int = 0        # preemption_attempts_total
     preemption_victims: int = 0         # preemption_victims histogram feed
     scheduling_seconds: float = 0.0     # scheduling_algorithm_duration sum
-    # bounded reservoir of recent e2e attempt latencies (p99 estimation);
-    # the metrics registry keeps the full histogram
+    # bounded reservoir of recent e2e attempt latencies (debugging aid);
+    # the real p99 source is the prom SLI histogram
     attempt_latencies: collections.deque = field(
         default_factory=lambda: collections.deque(maxlen=10000)
     )
+    prom: "object" = None               # SchedulerMetricsRegistry
+
+    def __post_init__(self) -> None:
+        if self.prom is None:
+            from ..metrics import SchedulerMetricsRegistry
+
+            self.prom = SchedulerMetricsRegistry()
 
 
 class Scheduler:
@@ -86,6 +94,7 @@ class Scheduler:
         clock: Callable[[], float] = time.monotonic,
         engine: str = "greedy",
         registry=None,
+        feature_gates=None,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -93,9 +102,16 @@ class Scheduler:
         batches are signature-homogeneous, the scheduler_perf shape).
         ``registry``: a lifecycle-plugin Registry (framework.lifecycle);
         defaults to the in-tree set — out-of-tree plugins register on a
-        copy and pass it here (the reference's app.WithPlugin)."""
+        copy and pass it here (the reference's app.WithPlugin).
+        ``feature_gates``: a FeatureGate or {name: bool} overrides
+        (pkg/features defaults apply; unknown names fail loudly)."""
+        from ..framework.featuregate import FeatureGate
+
         self.cfg = cfg or C.SchedulerConfiguration()
         self.profile = profile or self.cfg.profile()
+        if feature_gates is None or isinstance(feature_gates, dict):
+            feature_gates = FeatureGate(feature_gates)
+        self.feature_gates = feature_gates
         if engine == "batched":
             from ..assign.batched import batched_assign_device
 
@@ -151,6 +167,11 @@ class Scheduler:
         from ..framework import lifecycle as lc
 
         self.registry = registry if registry is not None else lc.default_registry()
+        # loud config validation (apis/config/validation analog): a
+        # malformed profile must never reach the hot loop
+        from ..framework.validation import must_validate
+
+        must_validate(self.profile, self.registry)
         self.lifecycle = self.registry.build(
             self.profile.lifecycle.names(), self.profile
         )
@@ -177,6 +198,14 @@ class Scheduler:
     # The informer seam (eventhandlers.go:455): assigned pods maintain the
     # cache; unscheduled pods maintain the queue; every event also feeds the
     # queueing hints so parked pods wake up.
+
+    def _gang_member(self, pod: t.Pod) -> bool:
+        """Is this pod routed through the gang lane? One predicate for
+        EVERY routing decision (add/update/reject/bind-failure) — a pod
+        must never be gang-routed on one path and queue-routed on another."""
+        return bool(pod.scheduling_group) and self.feature_gates.enabled(
+            "GangScheduling"
+        )
 
     @staticmethod
     def _scheduling_gates(pod: t.Pod) -> str | None:
@@ -206,7 +235,7 @@ class Scheduler:
     def on_pod_add(self, pod: t.Pod) -> None:
         if pod.node_name:
             self.cache.add_pod(pod)
-            if pod.scheduling_group:
+            if self._gang_member(pod):
                 # a pre-bound member counts toward the gang quorum
                 # (gangscheduling.go:82 AssignedPod/Add hint)
                 self.podgroups.mark_scheduled(pod, pod.node_name)
@@ -214,9 +243,11 @@ class Scheduler:
                 ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
                 None, pod,
             )
-        elif pod.scheduling_group:
+        elif self._gang_member(pod):
             # gang member: held by the manager until quorum (the
-            # GangScheduling PreEnqueue, gangscheduling.go:130)
+            # GangScheduling PreEnqueue, gangscheduling.go:130). With the
+            # gate off, group members schedule individually (the plugin is
+            # simply not registered in the reference).
             from ..queue.priority_queue import QueuedPodInfo
 
             info = QueuedPodInfo(pod=pod, timestamp=self.clock())
@@ -244,13 +275,13 @@ class Scheduler:
                 # informers deliver exactly this Delete+Add pair)
                 self.cache.add_pod(new)
                 self.queue.delete(new)
-                if new.scheduling_group:
+                if self._gang_member(new):
                     self.podgroups.mark_scheduled(new, new.node_name)
                 self.queue.on_event(
                     ClusterEvent(EventResource.ASSIGNED_POD, ActionType.ADD),
                     None, new,
                 )
-        elif new.scheduling_group:
+        elif self._gang_member(new):
             # unbound gang member: refresh the manager's copy — routing it
             # into the per-pod queue would bypass quorum gating and let the
             # pod double-schedule against its own group lane
@@ -428,7 +459,23 @@ class Scheduler:
                 failed.append(info)
         self.metrics.scheduled += scheduled
         self.metrics.unschedulable += len(failed)
-        self.metrics.scheduling_seconds += self.clock() - t0
+        cycle_s = self.clock() - t0
+        self.metrics.scheduling_seconds += cycle_s
+        prom = self.metrics.prom
+        prom.scheduling_algorithm_duration.observe(cycle_s)
+        # per-attempt duration: each pod's attempt spans the batch cycle
+        # (the reference's per-pod loop measures its own span; the batch is
+        # the attempt for every pod in it)
+        if scheduled:
+            prom.schedule_attempts.labels("scheduled", self.profile.name).inc(scheduled)
+            prom.scheduling_attempt_duration.labels(
+                "scheduled", self.profile.name
+            ).observe_n(cycle_s, scheduled)
+        if failed:
+            prom.schedule_attempts.labels("unschedulable", self.profile.name).inc(len(failed))
+            prom.scheduling_attempt_duration.labels(
+                "unschedulable", self.profile.name
+            ).observe_n(cycle_s, len(failed))
 
         try:
             for info in failed:
@@ -456,9 +503,12 @@ class Scheduler:
         # the pod stays in flight through the binding cycle — queue.done only
         # after the bind lands, so events during binding replay on failure
         if info.initial_attempt_timestamp is not None:
-            self.metrics.attempt_latencies.append(
-                self.clock() - info.initial_attempt_timestamp
-            )
+            sli = self.clock() - info.initial_attempt_timestamp
+            self.metrics.attempt_latencies.append(sli)
+            self.metrics.prom.pod_scheduling_sli_duration.labels(
+                str(info.attempts)
+            ).observe(sli)
+            self.metrics.prom.pod_scheduling_attempts.observe(info.attempts)
         return self._begin_binding(info, assumed)
 
     def _begin_binding(self, info: QueuedPodInfo, assumed: t.Pod) -> bool:
@@ -515,7 +565,7 @@ class Scheduler:
         and requeue — handleSchedulingFailure for the binding-path statuses."""
         self.cache.forget_pod(assumed)
         self.metrics.unschedulable += 1
-        if info.pod.scheduling_group:
+        if self._gang_member(info.pod):
             self.podgroups.unmark_scheduled(info.pod)
             self.podgroups.requeue_member(info)
         else:
@@ -577,7 +627,7 @@ class Scheduler:
                 # binding-cycle failure runs Unreserve (schedule_one.go:391
                 # bindingCycle's deferred unreserve-on-failure)
                 self.lifecycle.run_unreserve(self, info.pod, assumed.node_name)
-                if info.pod.scheduling_group:
+                if self._gang_member(info.pod):
                     # gang member: hand back to the group manager (it never
                     # lived in the per-pod queue)
                     self.podgroups.unmark_scheduled(info.pod)
@@ -625,6 +675,13 @@ class Scheduler:
         self.queue.flush_backoff_completed()
         if self.waiting_pods:
             self._drain_waiting_pods()
+        for queue_name, count in self.queue.stats().items():
+            self.metrics.prom.pending_pods.labels(queue_name).set(count)
+
+    def metrics_text(self) -> str:
+        """Prometheus text exposition of the scheduler metric set (the
+        /metrics endpoint body)."""
+        return self.metrics.prom.expose()
 
     def run_until_idle(self, max_cycles: int = 10000) -> int:
         """Drive cycles until no pod is ready (harness/test mode). Returns

@@ -1,0 +1,157 @@
+"""Feature gates (component-base featuregate analog) + loud configuration
+validation (apis/config/validation analog)."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod, make_pod_group
+from kubetpu.framework import config as C
+from kubetpu.framework.featuregate import FeatureGate
+from kubetpu.framework.validation import (
+    must_validate,
+    validate_configuration,
+    validate_profile,
+)
+
+from .test_scheduler import FakeClient, make_sched
+
+
+class TestFeatureGates:
+    def test_defaults_match_reference_stages(self):
+        fg = FeatureGate()
+        assert not fg.enabled("GangScheduling")          # alpha, off
+        assert not fg.enabled("GenericWorkload")         # alpha, off
+        assert fg.enabled("OpportunisticBatching")       # beta, on
+
+    def test_unknown_gate_fails_loudly(self):
+        with pytest.raises(ValueError, match="unknown feature gate"):
+            FeatureGate({"NotAFeature": True})
+        with pytest.raises(ValueError, match="unknown feature gate"):
+            FeatureGate().enabled("NotAFeature")
+
+    def test_dependency_enforced(self):
+        with pytest.raises(ValueError, match="requires GenericWorkload"):
+            FeatureGate({"GangScheduling": True})
+        fg = FeatureGate({"GangScheduling": True, "GenericWorkload": True})
+        assert fg.enabled("GangScheduling")
+
+    def test_gate_off_schedules_gang_pods_individually(self):
+        """With GangScheduling off the plugin isn't registered: group
+        members flow through the ordinary per-pod queue."""
+        client = FakeClient()
+        s, _ = make_sched(client)        # default gates: gang OFF
+        s.on_node_add(make_node("n0", cpu_milli=8000))
+        s.on_pod_group_add(make_pod_group("gang-a", min_count=3))
+        s.on_pod_add(make_pod("g-0", cpu_milli=100, scheduling_group="gang-a"))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert client.bound == {"default/g-0": "n0"}     # no quorum wait
+
+
+class TestValidation:
+    def test_valid_default_profile(self):
+        assert validate_profile(C.Profile()) == []
+        assert validate_configuration(C.SchedulerConfiguration()) == []
+
+    def test_unknown_plugin_names_rejected(self):
+        p = C.Profile(
+            filters=C.PluginSet(enabled=(("NotAPlugin", 1),)),
+            scores=C.PluginSet(enabled=(("AlsoNot", 1),)),
+        )
+        errs = validate_profile(p)
+        assert any("filters['NotAPlugin']" in e for e in errs)
+        assert any("scores['AlsoNot']" in e for e in errs)
+
+    def test_score_weight_bounds(self):
+        p = C.Profile(scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 0),)))
+        assert any("weight 0" in e for e in validate_profile(p))
+        p = C.Profile(scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 101),)))
+        assert any("weight 101" in e for e in validate_profile(p))
+
+    def test_rtcr_shape_validation(self):
+        p = C.Profile(scoring_strategy=C.ScoringStrategy(
+            type=C.REQUESTED_TO_CAPACITY_RATIO, shape=(),
+        ))
+        assert any("shape: required" in e for e in validate_profile(p))
+        p = C.Profile(scoring_strategy=C.ScoringStrategy(
+            type=C.REQUESTED_TO_CAPACITY_RATIO,
+            shape=((50, 5), (50, 8)),          # not strictly increasing
+        ))
+        assert any("strictly increasing" in e for e in validate_profile(p))
+        p = C.Profile(scoring_strategy=C.ScoringStrategy(
+            type=C.REQUESTED_TO_CAPACITY_RATIO,
+            shape=((0, 0), (100, 99)),          # score above max 10
+        ))
+        assert any("score 99" in e for e in validate_profile(p))
+
+    def test_duplicate_plugins_and_profiles(self):
+        p = C.Profile(filters=C.PluginSet(enabled=(
+            (C.NODE_NAME, 1), (C.NODE_NAME, 1),
+        )))
+        assert any("duplicate plugin" in e for e in validate_profile(p))
+        cfg = C.SchedulerConfiguration(profiles=(C.Profile(), C.Profile()))
+        assert any("duplicate profile" in e for e in validate_configuration(cfg))
+
+    def test_spread_constraint_validation(self):
+        p = C.Profile(default_spread_constraints=(
+            t.TopologySpreadConstraint(
+                max_skew=0, topology_key="",
+                when_unsatisfiable=t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY,
+            ),
+        ))
+        errs = validate_profile(p)
+        assert any("maxSkew" in e for e in errs)
+        assert any("topologyKey" in e for e in errs)
+
+    def test_backoff_and_percentage_bounds(self):
+        cfg = C.SchedulerConfiguration(
+            percentage_of_nodes_to_score=150,
+            pod_initial_backoff_seconds=5.0,
+            pod_max_backoff_seconds=1.0,
+        )
+        errs = validate_configuration(cfg)
+        assert any("percentageOfNodesToScore" in e for e in errs)
+        assert any("podMaxBackoffSeconds" in e for e in errs)
+
+    def test_scheduler_construction_fails_loudly(self):
+        bad = C.Profile(filters=C.PluginSet(enabled=(("Bogus", 1),)))
+        with pytest.raises(ValueError, match="invalid scheduler configuration"):
+            make_sched(FakeClient(), profile=bad)
+
+    def test_unregistered_lifecycle_plugin_rejected(self):
+        bad = C.Profile(lifecycle=C.PluginSet(enabled=(("Ghost", 1),)))
+        with pytest.raises(ValueError, match="lifecycle\\['Ghost'\\]"):
+            make_sched(FakeClient(), profile=bad)
+
+    def test_must_validate_lists_all_errors(self):
+        p = C.Profile(
+            filters=C.PluginSet(enabled=(("Bogus", 1),)),
+            hard_pod_affinity_weight=1000,
+        )
+        with pytest.raises(ValueError) as exc:
+            must_validate(p)
+        msg = str(exc.value)
+        assert "Bogus" in msg and "hardPodAffinityWeight" in msg
+
+
+def test_gate_off_bind_failure_requeues_to_pod_queue():
+    """Regression: with GangScheduling off, a failed bind of a
+    scheduling_group-labeled pod must requeue through the PER-POD queue —
+    parking it in the group manager (whose quorum can never be met without
+    a PodGroup) would starve it forever."""
+    client = FakeClient(fail_binds_for={"default/g-0"})
+    s, clock = make_sched(client)        # default gates: gang OFF
+    s.on_node_add(make_node("n0", cpu_milli=8000))
+    s.on_pod_add(make_pod("g-0", cpu_milli=100, scheduling_group="gang-a"))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s.schedule_batch()                   # drain the failed completion
+    clock.tick(30)
+    for _ in range(4):
+        s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound == {"default/g-0": "n0"}

@@ -1,0 +1,108 @@
+"""Metrics registry/histograms (component-base/metrics analog) and the
+scheduler's reference-named metric set (pkg/scheduler/metrics/metrics.go)."""
+
+import math
+import urllib.request
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.metrics import Histogram, Registry, exponential_buckets
+
+from .test_scheduler import FakeClient, make_sched
+
+
+def test_exponential_buckets_match_prometheus():
+    got = exponential_buckets(0.001, 2, 4)
+    assert got == [0.001, 0.002, 0.004, 0.008]
+
+
+def test_histogram_observe_and_quantile():
+    h = Histogram("h", buckets=[1, 2, 4, 8])
+    for v in (0.5, 1.5, 3, 3, 7):
+        h.observe(v)
+    assert h.total == 5 and h.sum == 15.0
+    # p50 rank 2.5 lands in the (2,4] bucket: 2 + (2.5-2)/2 * 2 = 2.5
+    assert h.quantile(0.5) == pytest.approx(2.5)
+    # empty histogram → NaN
+    assert math.isnan(Histogram("e").quantile(0.99))
+
+
+def test_histogram_since_scopes_window():
+    h = Histogram("h", buckets=[1, 2, 4])
+    h.observe(100)                       # pre-window outlier (+Inf bucket)
+    snap = h.merged()
+    for _ in range(100):
+        h.observe(0.5)
+    delta = h.since(snap)
+    assert delta.total == 100
+    assert delta.quantile(0.99) <= 1.0   # the outlier is outside the window
+
+
+def test_labeled_histogram_merges_across_children():
+    h = Histogram("h", labels=("attempts",), buckets=[1, 2, 4])
+    h.labels("1").observe(0.5)
+    h.labels("2").observe(3)
+    assert h.merged().total == 2
+    assert h.quantile(1.0) <= 4
+
+
+def test_registry_exposition_format():
+    r = Registry()
+    c = r.counter("requests_total", "reqs", labels=("code",))
+    c.labels("200").inc(3)
+    h = r.histogram("lat_seconds", "lat", buckets=[1, 2])
+    h.observe(1.5)
+    text = r.expose()
+    assert "# TYPE requests_total counter" in text
+    assert 'requests_total{code="200"} 3' in text
+    assert 'lat_seconds_bucket{le="2"} 1' in text
+    assert 'lat_seconds_bucket{le="+Inf"} 1' in text
+    assert "lat_seconds_count 1" in text
+    with pytest.raises(ValueError):
+        r.counter("requests_total")
+
+
+def test_scheduler_observes_reference_metrics():
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    for i in range(3):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=100, creation_index=i))
+    # an unschedulable pod too
+    s.on_pod_add(make_pod("huge", cpu_milli=999999, creation_index=9))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    text = s.metrics_text()
+    assert 'scheduler_schedule_attempts_total{result="scheduled"' in text
+    assert 'scheduler_schedule_attempts_total{result="unschedulable"' in text
+    assert "scheduler_scheduling_attempt_duration_seconds_bucket" in text
+    assert "scheduler_pod_scheduling_sli_duration_seconds_bucket" in text
+    sli = s.metrics.prom.pod_scheduling_sli_duration
+    assert sli.merged().total == 3
+    assert s.metrics.prom.p99_attempt_latency_s() >= 0.0
+    assert s.metrics.prom.pod_scheduling_attempts.total == 3
+
+
+def test_metrics_served_over_http():
+    """GET /metrics on the bridge server exposes the scheduler registry
+    (every reference binary serves /metrics)."""
+    from kubetpu.bridge import ExtenderBackend, ExtenderServer
+
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    s.on_pod_add(make_pod("p", cpu_milli=100))
+    s.schedule_batch()
+    srv = ExtenderServer(ExtenderBackend(metrics_source=s.metrics_text)).start()
+    try:
+        with urllib.request.urlopen(srv.url + "/metrics", timeout=10) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+    finally:
+        srv.close()
+    assert "scheduler_pending_pods" in body
+    assert "scheduler_scheduling_algorithm_duration_seconds_sum" in body

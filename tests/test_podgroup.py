@@ -14,7 +14,20 @@ from kubetpu.api.wrappers import make_node, make_pod, make_pod_group
 from kubetpu.framework import config as C
 
 from . import oracle
-from .test_scheduler import FakeClient, make_sched
+from .test_scheduler import FakeClient, make_sched as _make_sched
+
+# gang scheduling rides alpha gates (pkg/features kube_features.go:1415);
+# the reference perf config enables exactly these (performance-config.yaml:8)
+GANG_GATES = {
+    "GenericWorkload": True,
+    "GangScheduling": True,
+    "TopologyAwareWorkloadScheduling": True,
+}
+
+
+def make_sched(client=None, **kw):
+    kw.setdefault("feature_gates", dict(GANG_GATES))
+    return _make_sched(client, **kw)
 
 ZONE = "topology.kubernetes.io/zone"
 
