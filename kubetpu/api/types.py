@@ -329,6 +329,22 @@ class StorageClass:
 
 
 @dataclass(frozen=True)
+class Service:
+    """The scheduling slice of v1.Service: its selector feeds the DEFAULT
+    PodTopologySpread constraints (component-helpers DefaultSelector merges
+    the selectors of services/controllers owning the pod;
+    podtopologyspread/common.go:62 buildDefaultConstraints)."""
+
+    name: str
+    namespace: str = "default"
+    selector: tuple[tuple[str, str], ...] = ()
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
 class GangPolicy:
     """GangSchedulingPolicy (scheduling/v1alpha3 types.go:237): the group is
     admitted only when ``min_count`` pods can be scheduled together."""

@@ -294,7 +294,17 @@ def encode_batch(
             )
     spread_dev = None
     if want_spread:
-        sp = enc_spread.encode_spread(nt, pods, pad_pods=PP)
+        defaults = (
+            profile.default_spread_constraints if profile is not None else ()
+        )
+        sp = enc_spread.encode_spread(
+            nt, pods, pad_pods=PP,
+            default_constraints=defaults,
+            default_selector_of=(
+                enc_spread.default_selector_from_services(snapshot)
+                if defaults and snapshot.services else None
+            ),
+        )
         if sp is not None:
             spread_dev = SpreadDevice(
                 eligible=jnp.asarray(sp.eligible),

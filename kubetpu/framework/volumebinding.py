@@ -33,13 +33,18 @@ class VolumeBindingPlugin(lc.LifecyclePlugin):
 
     # -- Reserve (volume_binding.go:521 AssumePodVolumes) -----------------
     def reserve(self, handle, pod: t.Pod, node_name: str) -> lc.Status:
-        cache = handle.cache
-        snapshot = handle.cache.update_snapshot(handle._snapshot)
-        handle._snapshot = snapshot
+        # FAST PATH: Reserve runs for EVERY scheduled pod — a pod without
+        # PVC volumes must cost O(1) here, not a snapshot refresh (that
+        # regression turned every cycle into O(batch × nodes))
+        if not any(v.pvc_name for v in pod.volumes):
+            return lc.Status()
         import dataclasses
 
-        vs = VolumeState(snapshot)
-        node_info = snapshot.nodes.get(node_name)
+        # the live cache IS the lister view (single-owner loop); no
+        # snapshot refresh needed for per-pod reserve decisions
+        cache = handle.cache
+        vs = VolumeState(cache)
+        node_info = cache.get_node_info(node_name)
         labels = node_info.node.labels_dict() if node_info else {}
         picks: list[tuple[t.PersistentVolumeClaim, str]] = []
         taken: set[str] = set()   # PVs chosen for EARLIER claims of this pod
@@ -48,7 +53,7 @@ class VolumeBindingPlugin(lc.LifecyclePlugin):
             # revert the picks already applied (AssumePodVolumes reverts on
             # failure — a half-reserved pod must leak nothing)
             for pvc_, pv_name in picks:
-                pv_ = snapshot.pvs.get(pv_name)
+                pv_ = cache.pvs.get(pv_name)
                 if pv_ is not None:
                     cache.update_pv(dataclasses.replace(pv_, claim_ref=""))
                 cache.update_pvc(pvc_)   # original unbound object
@@ -57,12 +62,12 @@ class VolumeBindingPlugin(lc.LifecyclePlugin):
         for vol in pod.volumes:
             if not vol.pvc_name:
                 continue
-            pvc = snapshot.pvcs.get(f"{pod.namespace}/{vol.pvc_name}")
+            pvc = cache.pvcs.get(f"{pod.namespace}/{vol.pvc_name}")
             if pvc is None:
                 return fail("claim disappeared")
             if pvc.volume_name:
                 continue   # already bound
-            sc = snapshot.storage_classes.get(pvc.storage_class)
+            sc = cache.storage_classes.get(pvc.storage_class)
             if sc is None or sc.binding_mode != t.BINDING_WAIT_FOR_FIRST_CONSUMER:
                 return fail("claim not bindable here")
             chosen = ""
@@ -81,7 +86,7 @@ class VolumeBindingPlugin(lc.LifecyclePlugin):
             # assume: mark the PV claimed and the PVC bound in the cache's
             # lister view so this cycle's later pods (and later cycles)
             # don't double-book it
-            pv = snapshot.pvs[chosen]
+            pv = cache.pvs[chosen]
             cache.update_pv(dataclasses.replace(pv, claim_ref=pvc.key))
             cache.update_pvc(dataclasses.replace(pvc, volume_name=chosen))
         if picks:
@@ -96,13 +101,11 @@ class VolumeBindingPlugin(lc.LifecyclePlugin):
         if not picks:
             return
         cache = handle.cache
-        snapshot = cache.update_snapshot(handle._snapshot)
-        handle._snapshot = snapshot
         for pvc, pv_name in picks:
-            pv = snapshot.pvs.get(pv_name)
+            pv = cache.pvs.get(pv_name)
             if pv is not None and pv.claim_ref == pvc.key:
                 cache.update_pv(dataclasses.replace(pv, claim_ref=""))
-            cur = snapshot.pvcs.get(pvc.key)
+            cur = cache.pvcs.get(pvc.key)
             if cur is not None and cur.volume_name == pv_name:
                 cache.update_pvc(dataclasses.replace(cur, volume_name=""))
 

@@ -57,14 +57,17 @@ def resource_fit_mask_nominated(
     resource (R is a small static constant)."""
     n = alloc.shape[0]
     onehot = (g_node[:, None] == jnp.arange(n, dtype=g_node.dtype))  # (G, N)
-    gate64 = gate.astype(jnp.int64)
+    gate_f = gate.astype(jnp.float64)
     extra_cnt = jnp.einsum("pg,gn->pn", gate.astype(jnp.int32),
                            onehot.astype(jnp.int32))
     mask = (pod_count[None, :] + 1 + extra_cnt) <= allowed_pods[None, :]
     free = alloc - requested                                         # (N, R)
     for r in range(alloc.shape[1]):
-        plane = (onehot * g_req[:, r][:, None]).astype(jnp.int64)    # (G, N)
-        extra_r = jnp.einsum("pg,gn->pn", gate64, plane)
+        plane = (onehot * g_req[:, r][:, None]).astype(jnp.float64)  # (G, N)
+        # the s64 contraction is not in TPU's X64-rewrite vocabulary; f64
+        # sums of integers < 2^53 are exact, so the dot runs in f64 and
+        # converts back (resource quantities are far below 2^53)
+        extra_r = jnp.einsum("pg,gn->pn", gate_f, plane).astype(jnp.int64)
         req_r = pod_requests[:, r][:, None]                          # (P, 1)
         mask = mask & ((req_r == 0) | (req_r <= free[None, :, r] - extra_r))
     return mask

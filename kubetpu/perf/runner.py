@@ -77,9 +77,14 @@ class _Client:
         self.bound: list[tuple[str, str]] = []
         import collections
         self._events: "collections.deque" = collections.deque()
+        # bind-time counts per namespace: the throughput collector's view
+        # (scheduler_perf measures SchedulingThroughput at bind, scoped to
+        # the measured op's pods — churn/preemption traffic must not count)
+        self.bound_by_ns: "collections.Counter" = collections.Counter()
 
     def bind(self, pod: t.Pod, node_name: str) -> None:
         self.bound.append((pod.name, node_name))
+        self.bound_by_ns[pod.namespace] += 1
         self._events.append(("update", pod, pod.with_node(node_name)))
 
     def delete_pod(self, pod: t.Pod, reason: str = "") -> None:
@@ -115,6 +120,26 @@ def _begin_measured_phase(sched, warmup: bool, warm_pods):
         sched.metrics.cycles,
         sched.metrics.prom.pod_scheduling_sli_duration.merged(),
     )
+
+
+@dataclass
+class _Deleter:
+    """deletePodsOp with skipWaitToCompletion: drain a namespace's created
+    pods at ``per_second`` between cycles (each delete fires the
+    AssignedPodDelete event through the queue)."""
+
+    pods: list
+    per_second: int
+    started_at: float = -1.0
+    deleted: int = 0
+
+    def maybe_fire(self, sched: Scheduler, now: float) -> None:
+        if self.started_at < 0:
+            self.started_at = now
+        due = int((now - self.started_at) * self.per_second)
+        while self.deleted < min(due, len(self.pods)):
+            sched.on_pod_delete(self.pods[self.deleted])
+            self.deleted += 1
 
 
 @dataclass
@@ -175,15 +200,25 @@ def run_workload(
     sched.enable_preemption()
 
     churns: list[_Churn] = []
+    deleters: list[_Deleter] = []
+    created_by_ns: dict[str, list[t.Pod]] = {}
     measured = 0
     duration = 0.0
     attempts0 = cycles0 = 0
     lat0 = None
     op_ns_counter = 0
 
-    def settle(target: int) -> tuple[int, float]:
-        """Run cycles until ``target`` pods scheduled (or stall). Churn fires
-        between cycles. Returns (scheduled, wall seconds)."""
+    def settle(target: int, namespaces: tuple[str, ...] = ()) -> tuple[int, float]:
+        """Run cycles until ``target`` pods of the op's ``namespaces`` are
+        BOUND (or stall). Churn fires between cycles; its pods bind in
+        their own namespaces and never count toward the op's target (the
+        reference scopes SchedulingThroughput to the measured pods too).
+        Returns (bound, wall seconds)."""
+
+        def bound_now() -> int:
+            return sum(client.bound_by_ns[ns] for ns in namespaces)
+
+        start = bound_now()
         done = 0
         t0 = time.perf_counter()
         deadline = t0 + timeout_s
@@ -194,11 +229,13 @@ def run_workload(
                 break
             for ch in churns:
                 ch.maybe_fire(sched, now)
+            for d in deleters:
+                d.maybe_fire(sched, now)
             res = sched.schedule_batch()
             client.deliver()
-            done_this = res["scheduled"]
-            done += done_this
-            if done_this == 0:
+            before = done
+            done = bound_now() - start
+            if done == before and res["scheduled"] == 0:
                 # pods may simply be in backoff (max 10 s by default): only
                 # a sustained quiet period is a real stall
                 if now - last_progress > stall_s:
@@ -210,11 +247,41 @@ def run_workload(
 
     for op_i, op in enumerate(case.ops):
         if isinstance(op, W.CreateNodesOp):
-            n = params[op.count_param]
+            n = op.count or params[op.count_param]
+            factory = op.template or W.node_default
             for i in range(n):
-                sched.on_node_add(W.node_default(i, op.zones))
+                sched.on_node_add(factory(i, op.zones))
         elif isinstance(op, W.CreateNamespacesOp):
-            pass  # namespaces exist implicitly; ops reference them by name
+            # namespace objects carry labels for affinity namespaceSelectors
+            n = params[op.count_param] if op.count_param else op.count
+            for i in range(n):
+                sched.on_namespace_add(t.Namespace(
+                    name=f"{op.prefix}-{i}", labels=op.labels,
+                ))
+        elif isinstance(op, W.CreateServiceOp):
+            sched.on_service_add(t.Service(
+                name=op.name, namespace=op.namespace, selector=op.selector,
+            ))
+        elif isinstance(op, W.DeletePodsOp):
+            deleters.append(_Deleter(
+                pods=list(created_by_ns.get(op.namespace, ())),
+                per_second=op.per_second,
+            ))
+        elif isinstance(op, W.CreatePodSetsOp):
+            count = params[op.count_param]
+            per = params[op.pods_param]
+            template = op.template or case.default_pod_template
+            total_sets = 0
+            for g in range(count):
+                ns = f"{op.prefix}-{g}"
+                for j in range(per):
+                    pod = template(f"set-{op_i}-{g}-{j}", ns)
+                    created_by_ns.setdefault(ns, []).append(pod)
+                    sched.on_pod_add(pod)
+                    total_sets += 1
+            settle(total_sets, tuple(
+                f"{op.prefix}-{g}" for g in range(count)
+            ))
         elif isinstance(op, W.CreatePodGroupsOp):
             from ..api.wrappers import make_pod_group
 
@@ -226,7 +293,6 @@ def run_workload(
                     min_count=min_count,
                 ))
         elif isinstance(op, W.CreatePodsWithPVsOp):
-            from ..api import types as t
             from ..api.wrappers import make_pod
 
             count = params[op.count_param]
@@ -259,7 +325,7 @@ def run_workload(
                     memory=500 * 1024**2, creation_index=j,
                     pvcs=(f"{ns}-claim-{j}",),
                 ))
-            done, secs = settle(count)
+            done, secs = settle(count, (ns,))
             if op.collect_metrics:
                 measured += done
                 duration += secs
@@ -288,7 +354,7 @@ def run_workload(
                     scheduling_group=f"{op.prefix}-{j // per}",
                     creation_index=j,
                 ))
-            done, secs = settle(count)
+            done, secs = settle(count, (op.namespace,))
             if op.collect_metrics:
                 measured += done
                 duration += secs
@@ -315,8 +381,11 @@ def run_workload(
                 )
             for j in range(count):
                 pod = template(f"{prefix}-{ns}-{j}", ns)
+                created_by_ns.setdefault(ns, []).append(pod)
                 sched.on_pod_add(pod)
-            done, secs = settle(count)
+            if op.skip_wait:
+                continue
+            done, secs = settle(count, (ns,))
             if op.collect_metrics:
                 measured += done
                 duration += secs

@@ -163,6 +163,84 @@ def pod_high_priority_large_cpu(name: str, namespace: str) -> t.Pod:
     )
 
 
+def pod_low_priority(name: str, namespace: str) -> t.Pod:
+    """templates/pod-low-priority.yaml: 900m/500Mi, priority 0 — four of
+    them fill 3.6 of a node's 4 cpu (the PreemptionAsync setup)."""
+    return make_pod(
+        name, namespace=namespace, cpu_milli=900, memory=500 * 1024**2,
+    )
+
+
+def pod_high_priority_3cpu(name: str, namespace: str) -> t.Pod:
+    """templates/pod-high-priority.yaml: priority 10, 3 cpu — must preempt
+    3 of 4 low-priority pods to fit."""
+    return make_pod(
+        name, namespace=namespace, priority=10,
+        cpu_milli=3000, memory=500 * 1024**2,
+    )
+
+
+def light_pod(name: str, namespace: str) -> t.Pod:
+    """templates/light-pod.yaml: no resource requests."""
+    return make_pod(name, namespace=namespace)
+
+
+def gated_pod(name: str, namespace: str) -> t.Pod:
+    """templates/gated-pod.yaml: held by a scheduling gate forever."""
+    return make_pod(name, namespace=namespace, gates=("test.k8s.io/hold",))
+
+
+def pod_with_label(name: str, namespace: str) -> t.Pod:
+    """templates/pod-with-label.yaml: a labeled pod with no constraints of
+    its own — exercises the profile's DEFAULT spread constraints path."""
+    return make_pod(
+        name, namespace=namespace, labels={"foo": "bar"}, **_POD_REQ,
+    )
+
+
+DAEMONSET_NODE = "scheduler-perf-node"
+
+
+def node_with_name(_: int = 0, zones: tuple[str, ...] = ()) -> t.Node:
+    """templates/node-with-name.yaml: one named node with a 90000-pod
+    allowance — the daemonset / gated cases funnel every pod onto it."""
+    return make_node(
+        DAEMONSET_NODE, cpu_milli=4000, memory=32 * 1024**3, pods=90000,
+        labels={HOSTNAME_KEY: DAEMONSET_NODE},
+    )
+
+
+def daemonset_pod(name: str, namespace: str) -> t.Pod:
+    """templates/daemonset-pod.yaml: required node affinity on
+    matchFields metadata.name = scheduler-perf-node, no requests."""
+    term = t.NodeSelectorTerm(match_fields=(
+        t.Requirement("metadata.name", t.Operator.IN, (DAEMONSET_NODE,)),
+    ))
+    return make_pod(
+        name, namespace=namespace,
+        affinity=t.Affinity(node_affinity=t.NodeAffinity(
+            required=t.NodeSelector(terms=(term,))
+        )),
+    )
+
+
+def pod_preferred_anti_affinity_ns_selector(name: str, namespace: str) -> t.Pod:
+    """templates/pod-preferred-anti-affinity-ns-selector.yaml: color=green,
+    preferred hostname anti-affinity to color=green across namespaces
+    labeled team=devops."""
+    term = pod_affinity_term(
+        HOSTNAME_KEY, match_labels={"color": "green"},
+        namespace_selector=t.LabelSelector(match_labels=(("team", "devops"),)),
+    )
+    return make_pod(
+        name, namespace=namespace, labels={"color": "green"},
+        affinity=t.Affinity(pod_anti_affinity=t.PodAffinity(
+            preferred=(t.WeightedPodAffinityTerm(1, term),)
+        )),
+        **_POD_REQ,
+    )
+
+
 # ---------------------------------------------------------------------------
 # op list (operations.go analogs)
 # ---------------------------------------------------------------------------
@@ -172,28 +250,70 @@ PodTemplate = Callable[[str, str], t.Pod]
 
 @dataclass(frozen=True)
 class CreateNodesOp:
-    """operations.go:205 createNodesOp (+ labelNodePrepareStrategy)."""
+    """operations.go:205 createNodesOp (+ labelNodePrepareStrategy).
+    ``count`` > 0 overrides ``count_param`` (the YAML ``count:`` form);
+    ``template`` overrides the default node factory (nodeTemplatePath)."""
 
     count_param: str = "initNodes"
     zones: tuple[str, ...] = ()
+    count: int = 0
+    template: Callable[[int, tuple[str, ...]], t.Node] | None = None
 
 
 @dataclass(frozen=True)
 class CreateNamespacesOp:
-    """operations.go createNamespacesOp."""
+    """operations.go createNamespacesOp. ``labels`` models
+    namespaceTemplatePath (templates/namespace-with-labels.yaml);
+    ``count_param`` overrides ``count`` when set."""
 
     prefix: str = "sched"
     count: int = 2
+    count_param: str = ""
+    labels: tuple[tuple[str, str], ...] = ()
+
+
+@dataclass(frozen=True)
+class CreateServiceOp:
+    """createAny with a Service template (templates/service.yaml:
+    selector foo=bar) — feeds the DefaultSelector for default spread."""
+
+    namespace: str = "service-ns"
+    name: str = "service"
+    selector: tuple[tuple[str, str], ...] = (("foo", "bar"),)
+
+
+@dataclass(frozen=True)
+class DeletePodsOp:
+    """operations.go deletePodsOp: gradually delete the pods previously
+    created in ``namespace`` at ``per_second``, while later ops run
+    (skipWaitToCompletion) — each delete fires an AssignedPodDelete event
+    through the queue."""
+
+    namespace: str
+    per_second: int = 50
+
+
+@dataclass(frozen=True)
+class CreatePodSetsOp:
+    """operations.go createPodSetsOp: for i in 0..count: createPods into
+    namespace ``{prefix}-{i}``."""
+
+    count_param: str = "initNamespaces"
+    pods_param: str = "initPodsPerNamespace"
+    prefix: str = "init-ns"
+    template: PodTemplate | None = None
 
 
 @dataclass(frozen=True)
 class CreatePodsOp:
-    """operations.go:295 createPodsOp."""
+    """operations.go:295 createPodsOp. ``skip_wait`` = the YAML
+    skipWaitToCompletion (gated pods never schedule; don't settle)."""
 
     count_param: str = "initPods"
     template: PodTemplate | None = None     # None → case default
     collect_metrics: bool = False
     namespace: str | None = None            # None → unique per-op namespace
+    skip_wait: bool = False
 
 
 @dataclass(frozen=True)
@@ -508,6 +628,116 @@ _case(TestCase(
         Workload("5kNodes/100Init/10kPods",
                  {"initNodes": 5000, "initPods": 100, "measurePods": 10000},
                  threshold=590, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="PreemptionAsync",
+    source="misc/performance-config.yaml:186 (threshold 570)",
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreatePodsOp("initPods", template=pod_low_priority),
+        ChurnOp(mode="create", template=pod_high_priority_3cpu,
+                interval_ms=200),
+        CreatePodsOp("measurePods", template=pod_default,
+                     collect_metrics=True),
+    ),
+    workloads=(
+        Workload("5Nodes", {"initNodes": 5, "initPods": 20, "measurePods": 5}),
+        Workload("500Nodes",
+                 {"initNodes": 500, "initPods": 2000, "measurePods": 500}),
+        Workload("5000Nodes",
+                 {"initNodes": 5000, "initPods": 20000, "measurePods": 5000},
+                 threshold=570, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingDaemonset",
+    source="misc/performance-config.yaml:91 (threshold 1100)",
+    default_pod_template=daemonset_pod,
+    ops=(
+        # one named node receives every pod; the default nodes exist only
+        # to be filtered out (the reference's PreFilterResult scenario)
+        CreateNodesOp(count=1, template=node_with_name),
+        CreateNodesOp("initNodes"),
+        CreatePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("5Nodes", {"initNodes": 5, "measurePods": 10}),
+        Workload("15000Nodes", {"initNodes": 15000, "measurePods": 30000},
+                 threshold=1100, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingWhileGated",
+    source="misc/performance-config.yaml:365 (threshold 910)",
+    default_pod_template=light_pod,
+    ops=(
+        CreateNodesOp(count=1, template=node_with_name),
+        # pods that stay gated to the end of the test
+        CreatePodsOp("gatedPods", template=gated_pod, namespace="gated",
+                     skip_wait=True),
+        # pods that get scheduled then gradually deleted, generating
+        # AssignedPodDelete events the queue must absorb
+        CreatePodsOp("deletingPods", namespace="deleting"),
+        DeletePodsOp(namespace="deleting", per_second=50),
+        CreatePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("1Node_10GatedPods",
+                 {"gatedPods": 10, "deletingPods": 10, "measurePods": 10}),
+        Workload("1Node_10000GatedPods",
+                 {"gatedPods": 10000, "deletingPods": 20000,
+                  "measurePods": 20000},
+                 threshold=910, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="DefaultTopologySpreading",
+    source="topology_spreading/performance-config.yaml:104 (threshold 160 at 50k; "
+           "a service's selector drives the DEFAULT spread constraints)",
+    default_pod_template=pod_with_label,
+    ops=(
+        CreateNodesOp("initNodes", zones=("moon-1", "moon-2", "moon-3")),
+        CreateServiceOp(namespace="service-ns"),
+        CreatePodsOp("initPods", template=pod_default),
+        CreatePodsOp("measurePods", collect_metrics=True,
+                     namespace="service-ns"),
+    ),
+    workloads=(
+        Workload("500Nodes", {"initNodes": 500, "initPods": 1000, "measurePods": 1000}),
+        Workload("5000Nodes_50000Pods",
+                 {"initNodes": 5000, "initPods": 5000, "measurePods": 50000},
+                 threshold=160, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingPreferredAntiAffinityWithNSSelector",
+    source="affinity/performance-config.yaml:391",
+    default_pod_template=pod_preferred_anti_affinity_ns_selector,
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreateNamespacesOp("init-ns", count_param="initNamespaces",
+                           labels=(("team", "devops"),)),
+        CreateNamespacesOp("measure-ns", count=1,
+                           labels=(("team", "devops"),)),
+        CreatePodSetsOp("initNamespaces", "initPodsPerNamespace",
+                        prefix="init-ns"),
+        CreatePodsOp("measurePods", collect_metrics=True,
+                     namespace="measure-ns-0"),
+    ),
+    workloads=(
+        Workload("10Nodes",
+                 {"initNodes": 10, "initPodsPerNamespace": 2,
+                  "initNamespaces": 2, "measurePods": 10}),
+        Workload("500Nodes",
+                 {"initNodes": 500, "initPodsPerNamespace": 4,
+                  "initNamespaces": 10, "measurePods": 100},
+                 labels=("performance",)),
     ),
 ))
 

@@ -300,7 +300,11 @@ class Scheduler:
         if wp is not None:
             # a deleted waiting pod unreserves; its assume drops below
             self.lifecycle.run_unreserve(self, wp.pod, wp.node_name)
-        if pod.node_name or self.cache.is_assumed(pod.uid):
+        # has_pod covers BOUND pods too: a Delete event may carry a stale
+        # object with node_name unset (the informer's last-known view from
+        # before the bind) and must still drop the cached accounting and
+        # fire AssignedPod/Delete (cache.go:583 RemovePod's contract)
+        if pod.node_name or self.cache.has_pod(pod.uid):
             self.cache.remove_pod(pod)
             # an assumed pod also lives in the queue's in-flight set until
             # its bind completes — drop it so a failing bind cannot
@@ -313,6 +317,29 @@ class Scheduler:
             self.podgroups.wake_all()   # freed capacity may fit a gang
         else:
             self.queue.delete(pod)
+
+    # ----------------------------------------------------- service informers
+    def on_service_add(self, svc: t.Service) -> None:
+        """Service selectors feed the DEFAULT PodTopologySpread constraints
+        (component-helpers DefaultSelector)."""
+        self.cache.add_service(svc)
+
+    def on_service_update(self, old, new: t.Service) -> None:
+        self.cache.update_service(new)
+
+    def on_service_delete(self, svc: t.Service) -> None:
+        self.cache.remove_service(svc.key)
+
+    # --------------------------------------------------- namespace informers
+    def on_namespace_add(self, ns: t.Namespace) -> None:
+        """nsLister feed — namespace labels drive affinity-term
+        namespaceSelectors (AffinityTerm.Matches nsLabels)."""
+        self.cache.add_namespace(ns)
+
+    on_namespace_update = on_namespace_add
+
+    def on_namespace_delete(self, ns: t.Namespace) -> None:
+        self.cache.remove_namespace(ns.name)
 
     # ------------------------------------------------------ volume informers
     def on_pv_add(self, pv: t.PersistentVolume) -> None:

@@ -95,10 +95,11 @@ class Snapshot:
     node_generation: dict[str, int] = field(default_factory=dict)
     # namespace name → labels (the nsLister view affinity terms match)
     namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
-    # volume listers' view (pv/pvc/storageclass), copied on change only
+    # object listers' view (pv/pvc/storageclass/service), copied on change only
     pvs: dict[str, "t.PersistentVolume"] = field(default_factory=dict)
     pvcs: dict[str, "t.PersistentVolumeClaim"] = field(default_factory=dict)  # "ns/name"
     storage_classes: dict[str, "t.StorageClass"] = field(default_factory=dict)
+    services: dict[str, "t.Service"] = field(default_factory=dict)  # "ns/name"
     volumes_generation: int = -1
 
     def node_infos(self) -> list[NodeInfo]:
@@ -130,7 +131,19 @@ class Cache:
         self._pvs: dict[str, t.PersistentVolume] = {}
         self._pvcs: dict[str, t.PersistentVolumeClaim] = {}
         self._storage_classes: dict[str, t.StorageClass] = {}
-        self._volumes_gen = 0
+        self._services: dict[str, t.Service] = {}
+        self._volumes_gen = 0  # object-lister generation (pv/pvc/sc/service)
+
+    # --- services (the DefaultSelector feed) -----------------------------
+    def add_service(self, svc: "t.Service") -> None:
+        self._services[svc.key] = svc
+        self._volumes_gen += 1
+
+    update_service = add_service
+
+    def remove_service(self, key: str) -> None:
+        if self._services.pop(key, None) is not None:
+            self._volumes_gen += 1
 
     # --- volumes (pv/pvc/storageclass listers) ---------------------------
     def add_pv(self, pv: "t.PersistentVolume") -> None:
@@ -191,6 +204,24 @@ class Cache:
 
     def has_node(self, name: str) -> bool:
         return name in self._nodes
+
+    def get_node_info(self, name: str) -> NodeInfo | None:
+        """Live NodeInfo view (single-owner loop access — lifecycle plugins
+        read labels without forcing a snapshot refresh)."""
+        return self._nodes.get(name)
+
+    # live lister views (satisfy the VolumeState snapshot-like protocol)
+    @property
+    def pvs(self) -> dict:
+        return self._pvs
+
+    @property
+    def pvcs(self) -> dict:
+        return self._pvcs
+
+    @property
+    def storage_classes(self) -> dict:
+        return self._storage_classes
 
     def remove_node(self, name: str) -> None:
         """cache.go RemoveNode semantics: the NodeInfo must survive while pods
@@ -321,11 +352,12 @@ class Cache:
         snapshot.node_order = list(self._node_order)
         snapshot.namespaces = {k: dict(v) for k, v in self._namespaces.items()}
         if snapshot.volumes_generation != self._volumes_gen:
-            # volume objects are immutable values: a shallow dict copy per
+            # lister objects are immutable values: a shallow dict copy per
             # CHANGE (not per refresh) gives the snapshot a stable view
             snapshot.pvs = dict(self._pvs)
             snapshot.pvcs = dict(self._pvcs)
             snapshot.storage_classes = dict(self._storage_classes)
+            snapshot.services = dict(self._services)
             snapshot.volumes_generation = self._volumes_gen
         snapshot.generation = next(self._gen)
         return snapshot

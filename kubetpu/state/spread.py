@@ -126,23 +126,75 @@ class SpreadTensors:
         return self.domain_present.shape[1]
 
 
+def default_selector_from_services(snapshot):
+    """component-helpers DefaultSelector, services part: the merged selector
+    of every service in the pod's namespace selecting the pod (controllers
+    — RC/RS/SS — are not modeled; services are what scheduler_perf's
+    DefaultTopologySpreading exercises). None when nothing selects the pod
+    (buildDefaultConstraints then drops the defaults, common.go:70)."""
+    by_ns: dict[str, list] = {}
+    for svc in snapshot.services.values():
+        by_ns.setdefault(svc.namespace, []).append(svc)
+
+    def fn(pod: t.Pod):
+        labels = pod.labels_dict()
+        merged: dict[str, str] = {}
+        for svc in by_ns.get(pod.namespace, ()):
+            if svc.selector and all(
+                labels.get(k) == v for k, v in svc.selector
+            ):
+                merged.update(dict(svc.selector))
+        if not merged:
+            return None
+        return t.LabelSelector(match_labels=tuple(sorted(merged.items())))
+
+    return fn
+
+
 def encode_spread(
     nt: NodeTensors,
     pods: Sequence[t.Pod],
     default_constraints: Sequence[t.TopologySpreadConstraint] = (),
     pad_pods: int | None = None,
+    default_selector_of=None,
 ) -> SpreadTensors | None:
     """Build spread tensors for the batch; None when no pending pod has (or
     inherits) topology spread constraints.
 
     ``default_constraints`` are only applied to pods WITHOUT their own
-    constraints AND require a default selector derived from owning
-    services/controllers (common.go:62 buildDefaultConstraints) — callers that
-    do not model services pass pods whose default selector is empty, and such
-    pods get no constraints, exactly like the reference.
+    constraints, with the selector computed by ``default_selector_of(pod)``
+    — the DefaultSelector derived from owning services/controllers
+    (common.go:62 buildDefaultConstraints). A pod whose default selector is
+    empty/None gets no constraints, exactly like the reference (common.go's
+    ``if selector.Empty() { return nil }``).
     """
+    import dataclasses
+
     P = len(pods)
-    if not any(p.topology_spread_constraints for p in pods):
+
+    sel_cache: dict = {}
+
+    def effective(p: t.Pod) -> tuple[t.TopologySpreadConstraint, ...]:
+        if p.topology_spread_constraints:
+            return p.topology_spread_constraints
+        if not default_constraints or default_selector_of is None:
+            return ()
+        key = (p.namespace, p.labels)
+        got = sel_cache.get(key)
+        if got is None:
+            dsel = default_selector_of(p)
+            got = (
+                ()
+                if dsel is None else tuple(
+                    dataclasses.replace(c, selector=dsel)
+                    for c in default_constraints
+                )
+            )
+            sel_cache[key] = got
+        return got
+
+    eff = [effective(p) for p in pods]
+    if not any(eff):
         return None
     N = nt.num_nodes
     NC = nt.alloc.shape[0]
@@ -153,9 +205,9 @@ def encode_spread(
     pod_slots: list[list[tuple]] = []   # per pod: (sig id, action, c)
 
     aff_cache: dict[tuple, np.ndarray] = {}
-    for p in pods:
+    for p_i, p in enumerate(pods):
         slots: list[tuple] = []
-        constraints = p.topology_spread_constraints
+        constraints = eff[p_i]
         if constraints:
             key_set = frozenset(c.topology_key for c in constraints)
             hard_keys = frozenset(
@@ -322,10 +374,9 @@ def encode_spread(
     ignored = np.zeros((PP, NC), dtype=bool)
     has_hard = has_soft = False
     for i, slots in enumerate(pod_slots):
-        p = pods[i]
         soft_keys = [
             c.topology_key
-            for c in p.topology_spread_constraints
+            for c in eff[i]
             if c.when_unsatisfiable == t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY
         ]
         if soft_keys:
@@ -341,11 +392,12 @@ def encode_spread(
             self_match[i, c_i] = selfm
             has_hard = has_hard or act == HARD
             has_soft = has_soft or act == SOFT
+        pod = pods[i]
         for s_id, info in enumerate(sig_info):
             # counting semantics, not Matches: a batch-assigned pod changes
             # the counts exactly as a from-scratch calPreFilterState would
-            if p.namespace == info["namespace"] and _selector_counts(
-                info["selector"], p.labels_dict()
+            if pod.namespace == info["namespace"] and _selector_counts(
+                info["selector"], pod.labels_dict()
             ):
                 pod_match_sig[i, s_id] = True
 

@@ -285,6 +285,7 @@ def greedy(
     check_spread: bool = False,
     check_interpod: bool = False,
     hard_weight: int = 1,
+    tie_rng=None,
 ) -> list[str | None]:
     """The per-pod greedy loop: Filter → Score → Normalize → weighted sum →
     first-max selectHost → assume (NodeInfo.add_pod). Mutates ``infos``."""
@@ -334,6 +335,13 @@ def greedy(
         for j in range(len(infos)):
             if feas[j] and totals[j] > best_score:
                 best, best_score = j, totals[j]
+        if tie_rng is not None:
+            # the reference's selectHost reservoir-samples uniformly among
+            # max-score nodes (schedule_one.go:1037); the deterministic
+            # first-max rule is the framework's documented deviation
+            ties = [j for j in range(len(infos))
+                    if feas[j] and totals[j] == best_score]
+            best = ties[int(tie_rng.integers(0, len(ties)))]
         infos[best].add_pod(pod.with_node(infos[best].node.name))
         out.append(infos[best].node.name)
     return out

@@ -244,3 +244,77 @@ def test_scheduler_loop_with_batched_engine():
     tb, bb = build("batched")
     assert tg == tb == 40
     assert bg == bb
+
+
+def test_hotspot_single_feasible_node_degrades_to_scan():
+    """Adversarial contention: every pod fits exactly ONE node (node_name
+    pre-assignment). One-per-node acceptance admits one pod per round —
+    O(P) rounds — but the results must still match greedy pod-for-pod,
+    and the capped round count must be exactly what's needed."""
+    cache = Cache()
+    for i in range(4):
+        cache.add_node(make_node(f"n{i}", cpu_milli=10000))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=100, node_name="n2", creation_index=j)
+        for j in range(12)
+    ]
+    g, v, *_ = run_both(cache, pending, C.Profile(
+        filters=C.PluginSet(enabled=((C.NODE_NAME, 1), (C.NODE_RESOURCES_FIT, 1))),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    ))
+    np.testing.assert_array_equal(g, v)
+    assert set(g) == {2}
+    # round accounting: 12 pods on one node need 12 rounds; 11 is too few
+    snap = cache.update_snapshot()
+    profile = C.Profile(
+        filters=C.PluginSet(enabled=((C.NODE_NAME, 1), (C.NODE_RESOURCES_FIT, 1))),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+    batch = encode_batch(snap, pending, profile)
+    params = score_params(profile, batch.resource_names)
+    v11, _ = batched_assign_device(batch.device, params, max_rounds=11)
+    assert (np.asarray(v11)[:12] >= 0).sum() == 11
+    v12, _ = batched_assign_device(batch.device, params, max_rounds=12)
+    assert (np.asarray(v12)[:12] >= 0).sum() == 12
+
+
+def test_one_zone_affinity_contention_parity():
+    """Zone-affine pods all race into one zone (the PodAffinity workload's
+    shape): acceptance conflicts every round, and topology-coupled scores
+    shift mid-round — the engines must still agree on the outcome COUNT
+    and on capacity safety (the documented parity budget allows node-level
+    divergence for topology-coupled scores, not count divergence)."""
+    from kubetpu.api import types as t
+    from kubetpu.api.wrappers import pod_affinity_term
+
+    ZONE = "topology.kubernetes.io/zone"
+    cache = Cache()
+    for i in range(8):
+        cache.add_node(make_node(
+            f"n{i}", cpu_milli=1000,
+            labels={ZONE: "z0" if i < 3 else "z1",
+                    "kubernetes.io/hostname": f"n{i}"},
+        ))
+    cache.add_pod(make_pod("seed", cpu_milli=100, labels={"app": "web"},
+                           node_name="n0"))
+    aff = t.Affinity(pod_affinity=t.PodAffinity(
+        required=(pod_affinity_term(ZONE, match_labels={"app": "web"}),)
+    ))
+    pending = [
+        make_pod(f"p{j}", cpu_milli=300, labels={"app": "web"},
+                 affinity=aff, creation_index=j)
+        for j in range(10)
+    ]
+    profile = C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.INTER_POD_AFFINITY, 1),
+        )),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+    g, v, g_state, v_state, batch = run_both(cache, pending, profile)
+    # zone z0 has 3 nodes x 1000m; seed uses 100m -> 2900m free -> 9 pods
+    assert (g >= 0).sum() == (v >= 0).sum() == 9
+    np.testing.assert_array_equal(g, v)

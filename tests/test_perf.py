@@ -167,3 +167,35 @@ def test_volumes_workloads_toy_scale():
     for case in ("SchedulingInTreePVs", "SchedulingCSIPVs"):
         r = run_workload(case, "5Nodes", timeout_s=60, warmup=False)
         assert r.scheduled == 10, case
+
+
+def test_preemption_async_workload():
+    """PreemptionAsync at toy scale: measure pods (100m) stay schedulable
+    while high-priority churn preempts low-priority pods."""
+    r = run_workload("PreemptionAsync", "5Nodes", timeout_s=60, warmup=False)
+    assert r.scheduled == 5
+
+
+def test_daemonset_workload_funnels_to_named_node():
+    r = run_workload("SchedulingDaemonset", "5Nodes", timeout_s=60,
+                     warmup=False)
+    assert r.scheduled == 10
+    # every measure pod matched the named node via matchFields
+
+
+def test_scheduling_while_gated_workload():
+    r = run_workload("SchedulingWhileGated", "1Node_10GatedPods",
+                     timeout_s=60, warmup=False)
+    assert r.scheduled == 10            # the measure pods; gated ones held
+
+
+def test_default_topology_spreading_workload():
+    r = run_workload("DefaultTopologySpreading", "500Nodes", timeout_s=120,
+                     warmup=False)
+    assert r.scheduled == 1000
+
+
+def test_ns_selector_anti_affinity_workload():
+    r = run_workload("SchedulingPreferredAntiAffinityWithNSSelector",
+                     "10Nodes", timeout_s=60, warmup=False)
+    assert r.scheduled == 10

@@ -272,3 +272,35 @@ def test_default_profile_full_cycle():
 
     zones = Counter(int(n[1]) % 2 for n in client.bound.values())
     assert abs(zones[0] - zones[1]) <= 4
+
+
+def test_delete_with_stale_unbound_object_drops_bound_pod():
+    """A Delete event may carry the informer's last-known view from BEFORE
+    the bind (node_name unset). The cached accounting must still drop and
+    AssignedPod/Delete must fire (cache.go:583 RemovePod contract) — the
+    perf harness's deletePodsOp relies on exactly this."""
+    client = FakeClient()
+    s, clock = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=1000))
+    stale = make_pod("p", cpu_milli=800)
+    s.on_pod_add(stale)
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    # confirm the bind (pending -> assigned transition)
+    s.on_pod_update(stale, stale.with_node("n0"))
+    assert client.bound == {"default/p": "n0"}
+    # a blocked pod waits for the capacity
+    s.on_pod_add(make_pod("q", cpu_milli=800))
+    s.schedule_batch()
+    assert len(client.bound) == 1
+    # delete with the STALE unbound object
+    s.on_pod_delete(stale)
+    snap = s.cache.update_snapshot()
+    assert not snap.nodes["n0"].pods          # accounting dropped
+    clock.tick(30)                            # q's backoff expires
+    for _ in range(3):
+        s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound.get("default/q") == "n0"   # the event woke q

@@ -171,3 +171,66 @@ def test_missing_topology_key_is_infeasible():
     batch = encode_batch(snap, [pod], profile)
     got = greedy_assign(batch, profile)
     assert got == ["zoned"]
+
+
+def test_default_constraints_via_service_selector():
+    """DEFAULT PodTopologySpread constraints activate when a Service's
+    selector matches the pod (component-helpers DefaultSelector →
+    buildDefaultConstraints, common.go:62): the defaulted pods must spread
+    exactly like pods carrying the equivalent explicit constraints."""
+    from kubetpu.api import types as t
+    from kubetpu.api.wrappers import spread_constraint
+    from kubetpu.assign import greedy_assign
+    from kubetpu.framework import encode_batch
+
+    ZONE = "topology.kubernetes.io/zone"
+    HOST = "kubernetes.io/hostname"
+
+    def cluster():
+        cache = Cache()
+        for i in range(6):
+            cache.add_node(make_node(
+                f"n{i}", cpu_milli=4000,
+                labels={ZONE: f"z{i % 2}", HOST: f"n{i}"},
+            ))
+        return cache
+
+    profile = C.Profile()   # carries the system default constraints
+    # defaulted path: plain labeled pods + a selecting service
+    cache_a = cluster()
+    cache_a.add_service(t.Service(
+        name="svc", namespace="default", selector=(("app", "x"),),
+    ))
+    pods_a = [
+        make_pod(f"p{j}", cpu_milli=100, labels={"app": "x"},
+                 creation_index=j)
+        for j in range(8)
+    ]
+    got_default = greedy_assign(
+        encode_batch(cache_a.update_snapshot(), pods_a, profile), profile
+    )
+    # explicit path: same pods carrying the default constraints spelled out
+    cache_b = cluster()
+    explicit = (
+        spread_constraint(3, ZONE,
+                          when=t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY,
+                          match_labels={"app": "x"}),
+        spread_constraint(5, HOST,
+                          when=t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY,
+                          match_labels={"app": "x"}),
+    )
+    pods_b = [
+        make_pod(f"p{j}", cpu_milli=100, labels={"app": "x"},
+                 spread=explicit, creation_index=j)
+        for j in range(8)
+    ]
+    got_explicit = greedy_assign(
+        encode_batch(cache_b.update_snapshot(), pods_b, profile), profile
+    )
+    assert got_default == got_explicit
+    # and without the service, defaults do NOT apply (selector empty)
+    cache_c = cluster()
+    got_none = greedy_assign(
+        encode_batch(cache_c.update_snapshot(), pods_a, profile), profile
+    )
+    assert all(g is not None for g in got_none)
