@@ -231,6 +231,9 @@ def encode_batch(
     N, P = snapshot.num_nodes(), len(pods)
     NP = enc.round_up(N) if pad else N
     PP = enc.round_up(P) if pad else P
+    folded: frozenset = frozenset()
+    if resource_names is None:
+        resource_names, folded = enc.batch_resource_axis(snapshot, pods)
     nt = enc.encode_snapshot(
         snapshot, resource_names=resource_names, pods=pods, pad_nodes=NP,
         prev=prev_nt,
@@ -251,10 +254,20 @@ def encode_batch(
         from ..state.volumes import VolumeState
 
         vol_state = VolumeState(snapshot)
+    folded_nominated = (
+        [
+            (e.node_name, tuple(e.requests))
+            for e in nominated
+            if getattr(e, "node_name", "")
+        ]
+        if folded else ()
+    )
     pb = enc.encode_pod_batch(
         nt, pods, enabled_filters=enabled, pad_pods=PP,
         enabled_scores=enabled_sc, extra_port_triples=nominated_triples,
         volume_state=vol_state,
+        folded_resources=folded,
+        folded_nominated=folded_nominated,
     )
     want_na = profile is None or profile.has_score(C.NODE_AFFINITY)
     want_tt = profile is None or profile.has_score(C.TAINT_TOLERATION)

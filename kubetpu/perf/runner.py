@@ -329,6 +329,31 @@ def run_workload(
             if op.collect_metrics:
                 measured += done
                 duration += secs
+        elif isinstance(op, W.CreateExtendedResourcePodsOp):
+            from ..api.wrappers import make_pod
+
+            count = params[op.count_param]
+            ns = op.namespace
+            if op.collect_metrics:
+                attempts0, cycles0, lat0 = _begin_measured_phase(
+                    sched, warmup,
+                    [
+                        make_pod(
+                            f"warmup-ext-{j}", namespace=ns,
+                            requests={f"foo.com/bar-{j}": 1},
+                        )
+                        for j in range(min(count, sched.max_batch))
+                    ],
+                )
+            for j in range(count):
+                sched.on_pod_add(make_pod(
+                    f"extpod-{j}", namespace=ns, creation_index=j,
+                    requests={f"foo.com/bar-{j}": 1},
+                ))
+            done, secs = settle(count, (ns,))
+            if op.collect_metrics:
+                measured += done
+                duration += secs
         elif isinstance(op, W.CreateGangPodsOp):
             from ..api.wrappers import make_pod
 
@@ -419,7 +444,9 @@ def run_workload(
         ) + sum(
             params[op.count_param]
             for op in case.ops
-            if isinstance(op, W.CreatePodsWithPVsOp) and op.collect_metrics
+            if isinstance(
+                op, (W.CreatePodsWithPVsOp, W.CreateExtendedResourcePodsOp)
+            ) and op.collect_metrics
         ),
         scheduled=measured,
         duration_s=duration,

@@ -198,6 +198,27 @@ def pod_with_label(name: str, namespace: str) -> t.Pod:
     )
 
 
+def node_with_extended_resource(i: int, zones: tuple[str, ...] = ()) -> t.Node:
+    """templates/node-with-extended-resource.yaml: each node advertises ONE
+    unit of a PER-NODE-UNIQUE extended resource (foo.com/bar-{i}) — the
+    DRA-extended-resource scheduling shape."""
+    return make_node(
+        f"ext-node-{i}", cpu_milli=4000, memory=32 * 1024**3, pods=110,
+        labels={"node-with-extended-resource": "true"},
+        extended={f"foo.com/bar-{i}": 1},
+    )
+
+
+@dataclass(frozen=True)
+class CreateExtendedResourcePodsOp:
+    """createPods with templates/pod-with-extended-resource.yaml: pod i
+    requests foo.com/bar-{i}: 1 — each pod fits exactly one node."""
+
+    count_param: str = "measurePods"
+    collect_metrics: bool = False
+    namespace: str = "test"
+
+
 DAEMONSET_NODE = "scheduler-perf-node"
 
 
@@ -738,6 +759,26 @@ _case(TestCase(
                  {"initNodes": 500, "initPodsPerNamespace": 4,
                   "initNamespaces": 10, "measurePods": 100},
                  labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingWithExtendedResource",
+    source="misc/performance-config.yaml:452 (threshold 180)",
+    ops=(
+        CreateNodesOp("nodesWithoutExtendedResource"),
+        CreateNodesOp("nodesWithExtendedResource",
+                      template=node_with_extended_resource),
+        CreateExtendedResourcePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        Workload("fast", {"nodesWithExtendedResource": 10,
+                          "nodesWithoutExtendedResource": 1,
+                          "measurePods": 10}),
+        Workload("5000pods_5000nodes",
+                 {"nodesWithExtendedResource": 5000,
+                  "nodesWithoutExtendedResource": 0, "measurePods": 5000},
+                 threshold=180, labels=("performance",)),
     ),
 ))
 

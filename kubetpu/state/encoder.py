@@ -79,6 +79,51 @@ def resource_axis(snapshot: Snapshot, pods: Sequence[t.Pod]) -> list[str]:
     return list(BASE_RESOURCES) + sorted(scalars)
 
 
+# singleton scalars stay dense while few (cheap; preserves full preemption
+# semantics for the common handful-of-scalar-types cluster); past this many
+# distinct singletons they ALL fold, keeping the resource axis STABLE
+# across cycles (a per-cycle-varying axis would defeat encode_snapshot's
+# prev-row reuse in exactly the per-node-unique workload folding targets)
+FOLD_SINGLETON_THRESHOLD = 8
+
+
+def batch_resource_axis(
+    snapshot: Snapshot, pods: Sequence[t.Pod]
+) -> tuple[list[str], frozenset]:
+    """The BATCH's resource axis: base resources plus the scalars the batch
+    actually requests (node-advertised-but-unrequested scalars never enter a
+    fit comparison, so they would be dead columns — the DRA/extended
+    per-node-unique resource shape advertises thousands).
+
+    Returns ``(resource_names, folded)``: when a batch carries more than
+    FOLD_SINGLETON_THRESHOLD distinct single-pod scalars, every singleton
+    folds into the static mask — a singleton has no in-batch capacity
+    contention by construction, so its availability check is a pure static
+    per-node mask (encode_pod_batch), and the dense axis (base + multi-pod
+    scalars) stays identical cycle to cycle. Known deviation: a pod blocked
+    ONLY on a folded resource reads as statically infeasible, so preemption
+    won't hunt victims for it (the reference can preempt to free extended
+    resources); multi-pod scalars always keep full dense preemption
+    semantics.
+    """
+    import collections
+
+    counts: collections.Counter = collections.Counter()
+    for p in pods:
+        for k, v in p.requests:
+            if k not in BASE_RESOURCES and k != t.PODS and v > 0:
+                counts[k] += 1
+    multi = sorted(k for k, c in counts.items() if c > 1)
+    singles = sorted(k for k, c in counts.items() if c == 1)
+    if len(singles) > FOLD_SINGLETON_THRESHOLD:
+        folded = frozenset(singles)
+        dense = multi
+    else:
+        folded = frozenset()
+        dense = multi + singles
+    return list(BASE_RESOURCES) + sorted(dense), folded
+
+
 @dataclass
 class NodeTensors:
     """Numpy-side encoded snapshot. Node-axis arrays may be allocated at a
@@ -437,6 +482,8 @@ def encode_pod_batch(
     enabled_scores: frozenset[str] | None = None,
     extra_port_triples: Sequence[tuple[int, str, str]] = (),
     volume_state=None,
+    folded_resources: frozenset = frozenset(),
+    folded_nominated: Sequence[tuple[str, Sequence[tuple[str, int]]]] = (),
 ) -> PodBatch:
     """``enabled_filters`` is the profile's Filter plugin set (names from
     ``kubetpu.names``); None enables everything. Disabled static predicates
@@ -478,7 +525,7 @@ def encode_pod_batch(
                 j = ridx.get(k)
                 if j is not None:
                     req_row[j] = v
-                elif v > 0 and k != t.PODS:
+                elif v > 0 and k != t.PODS and k not in folded_resources:
                     unknown = True
             for k, v in p.nonzero_requests().items():
                 j = ridx.get(k)
@@ -508,6 +555,30 @@ def encode_pod_batch(
     static_sig = np.zeros(PP, dtype=np.int32)
     any_nontrivial = False
 
+    # folded-scalar availability: one pass over nodes builds per-resource
+    # (node, available) occurrence lists — O(node scalar entries), not
+    # O(folded × N). A folded resource is requested by exactly one batch
+    # pod, so static masking is exact (no in-batch contention to couple).
+    # Nominated preemptors' folded requests are charged to their nominated
+    # node for EVERY batch pod (the dense path gates by priority via
+    # resource_fit_mask_nominated; folding charges conservatively —
+    # a higher-priority pod may be held off a unit a nominee reserved).
+    fold_avail: dict[str, list[tuple[int, int]]] = {}
+    if folded_resources:
+        nom_charge: dict[tuple[str, str], int] = {}
+        for node_name, reqs in folded_nominated:
+            for k, v in reqs:
+                if k in folded_resources:
+                    nom_charge[(k, node_name)] = (
+                        nom_charge.get((k, node_name), 0) + v
+                    )
+        for n_i, info in enumerate(nt.infos):
+            for k, cap in info.node.allocatable:
+                if k in folded_resources:
+                    avail = cap - info.requested.get(k, 0)
+                    avail -= nom_charge.get((k, info.node.name), 0)
+                    fold_avail.setdefault(k, []).append((n_i, avail))
+
     # in-batch ReadWriteOncePod guard: an RWOP claim taken by an EARLIER pod
     # of this batch rejects later users this cycle (the reference's per-pod
     # loop sees the first pod's assume; the batch must not co-schedule them)
@@ -515,6 +586,12 @@ def encode_pod_batch(
     for i, p in enumerate(pods):
         vol_sig = None
         rwop_dup = False
+        folded_items: tuple = ()
+        if folded_resources:
+            folded_items = tuple(
+                (k, v) for k, v in p.requests
+                if k in folded_resources and v > 0
+            )
         if volume_state is not None and p.volumes:
             vol_sig = (
                 p.namespace,
@@ -536,6 +613,7 @@ def encode_pod_batch(
             bool(unknown_resource[i]) and names.NODE_RESOURCES_FIT in f,
             vol_sig,
             rwop_dup,
+            folded_items,
         )
         sid = sig_ids.get(sig)
         if sid is None:
@@ -581,6 +659,13 @@ def encode_pod_batch(
                     m &= vm
             if rwop_dup:
                 m[:] = False
+            if folded_items and names.NODE_RESOURCES_FIT in f:
+                for k, v in folded_items:
+                    fm = np.zeros(N, dtype=bool)
+                    for n_i, avail in fold_avail.get(k, ()):
+                        if avail >= v:
+                            fm[n_i] = True
+                    m &= fm
             sid = len(sig_rows)
             sig_ids[sig] = sid
             sig_rows.append(m)

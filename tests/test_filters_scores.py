@@ -302,3 +302,98 @@ def test_pod_count_filter():
         )
     )
     assert not mask[0, j]
+
+
+class TestFoldedScalars:
+    """batch_resource_axis singleton folding (the DRA/extended per-node-
+    unique resource shape): singleton scalars past the dense cap become
+    static masks; multi-pod scalars keep dense capacity coupling."""
+
+    def _cluster(self, n=40):
+        cache = Cache()
+        for i in range(n):
+            cache.add_node(make_node(
+                f"n{i}", cpu_milli=4000,
+                extended={f"foo.com/bar-{i}": 1},
+            ))
+        return cache
+
+    def test_singletons_fold_and_land_on_their_node(self):
+        from kubetpu.assign import greedy_assign
+        from kubetpu.framework import config as C
+        from kubetpu.framework import encode_batch
+        from kubetpu.state.encoder import batch_resource_axis
+
+        cache = self._cluster()
+        pods = [
+            make_pod(f"p{j}", requests={f"foo.com/bar-{j}": 1, t.CPU: 100},
+                     creation_index=j)
+            for j in range(40)
+        ]
+        snap = cache.update_snapshot()
+        rnames, folded = batch_resource_axis(snap, pods)
+        # 40 singletons > threshold: ALL fold; the dense axis is just the
+        # base resources and stays identical cycle to cycle
+        assert len(rnames) == 3
+        assert len(folded) == 40
+        profile = C.minimal_profile()
+        batch = encode_batch(snap, pods, profile)
+        got = greedy_assign(batch, profile)
+        assert got == [f"n{j}" for j in range(40)]
+
+    def test_multi_pod_scalar_stays_dense_with_coupling(self):
+        from kubetpu.assign import greedy_assign
+        from kubetpu.framework import config as C
+        from kubetpu.framework import encode_batch
+        from kubetpu.state.encoder import batch_resource_axis
+
+        cache = Cache()
+        cache.add_node(make_node("g0", cpu_milli=4000,
+                                 extended={"example.com/gpu": 2}))
+        cache.add_node(make_node("g1", cpu_milli=4000,
+                                 extended={"example.com/gpu": 2}))
+        # THREE pods race for 2+2 gpus: capacity coupling must hold
+        pods = [
+            make_pod(f"p{j}", requests={"example.com/gpu": 2, t.CPU: 100},
+                     creation_index=j)
+            for j in range(3)
+        ]
+        snap = cache.update_snapshot()
+        rnames, folded = batch_resource_axis(snap, pods)
+        assert "example.com/gpu" in rnames and not folded
+        profile = C.minimal_profile()
+        batch = encode_batch(snap, pods, profile)
+        got = greedy_assign(batch, profile)
+        assert sorted(g for g in got if g) == ["g0", "g1"]
+        assert got[2] is None          # no third gpu pair anywhere
+
+    def test_folded_capacity_respected_across_cycles(self):
+        """A folded resource consumed in cycle 1 rejects cycle 2's pod."""
+        from .test_scheduler import FakeClient, make_sched
+
+        client = FakeClient()
+        s, _ = make_sched(client)
+        # the folded path needs >cap distinct singletons; build 33 nodes
+        for i in range(33):
+            s.on_node_add(make_node(
+                f"n{i}", cpu_milli=4000, extended={f"r-{i}": 1},
+            ))
+        batch1 = [
+            make_pod(f"a{j}", requests={f"r-{j}": 1, t.CPU: 100},
+                     creation_index=j)
+            for j in range(33)
+        ]
+        for p in batch1:
+            s.on_pod_add(p)
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert len(client.bound) == 33
+        # second wave wants the SAME units: all must fail
+        for j in range(33):
+            s.on_pod_add(make_pod(
+                f"b{j}", requests={f"r-{j}": 1, t.CPU: 100},
+                creation_index=100 + j,
+            ))
+        res = s.schedule_batch()
+        assert res["scheduled"] == 0

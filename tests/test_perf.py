@@ -199,3 +199,12 @@ def test_ns_selector_anti_affinity_workload():
     r = run_workload("SchedulingPreferredAntiAffinityWithNSSelector",
                      "10Nodes", timeout_s=60, warmup=False)
     assert r.scheduled == 10
+
+
+def test_extended_resource_workload():
+    """Per-node-unique extended resources: every pod lands on exactly its
+    node (the folded-scalar static-mask path; misc/performance-config.yaml
+    SchedulingWithExtendedResource shape)."""
+    r = run_workload("SchedulingWithExtendedResource", "fast", timeout_s=60,
+                     warmup=False)
+    assert r.scheduled == 10
