@@ -236,6 +236,9 @@ class Pod:
     scheduling_group: str = ""
     # spec.volumes, PVC references only (the volume plugin family)
     volumes: tuple[PodVolume, ...] = ()
+    # spec.schedulerName — selects the profile (profile.go:46 Map); pods
+    # naming an unknown profile are not this scheduler's to place
+    scheduler_name: str = "default-scheduler"
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)

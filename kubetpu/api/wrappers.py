@@ -61,6 +61,7 @@ def make_pod(
     preemption_policy: str = "PreemptLowerPriority",
     scheduling_group: str = "",
     pvcs: Sequence[str] = (),
+    scheduler_name: str = "default-scheduler",
 ) -> t.Pod:
     nonzero = None
     if containers is not None:
@@ -107,6 +108,7 @@ def make_pod(
             t.PodVolume(name=f"vol-{i}", pvc_name=c)
             for i, c in enumerate(pvcs)
         ),
+        scheduler_name=scheduler_name,
     )
 
 

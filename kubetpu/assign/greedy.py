@@ -55,6 +55,8 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         image_sum_scores=b.image_sum_scores,
         image_sig=row(b.image_sig),
         image_count=row(b.image_count),
+        extender_mask=row(b.extender_mask),
+        extender_score=row(b.extender_score),
         pod_ports=b.pod_ports[i][None],
         node_ports=b.node_ports,
         port_conflict=b.port_conflict,

@@ -225,6 +225,8 @@ def pod_from_v1(obj: _JSON) -> t.Pod:
         scheduling_group=(
             (spec.get("schedulingGroup") or {}).get("podGroupName") or ""
         ),
+        scheduler_name=spec.get("schedulerName", "default-scheduler")
+        or "default-scheduler",
     )
 
 
@@ -245,6 +247,149 @@ def pod_group_from_v1alpha3(obj: _JSON) -> t.PodGroup:
         gang=t.GangPolicy(min_count=int(gang.get("minCount", 1))) if gang else None,
         topology_keys=keys,
     )
+
+
+def _selector_to_v1(sel: t.LabelSelector | None) -> dict | None:
+    if sel is None:
+        return None
+    out: dict = {}
+    if sel.match_labels:
+        out["matchLabels"] = dict(sel.match_labels)
+    if sel.match_expressions:
+        out["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator.value,
+             "values": list(r.values)}
+            for r in sel.match_expressions
+        ]
+    return out
+
+
+def _term_to_v1(term: t.PodAffinityTerm) -> dict:
+    out: dict = {"topologyKey": term.topology_key}
+    if term.selector is not None:
+        out["labelSelector"] = _selector_to_v1(term.selector)
+    if term.namespaces:
+        out["namespaces"] = list(term.namespaces)
+    if term.namespace_selector is not None:
+        out["namespaceSelector"] = _selector_to_v1(term.namespace_selector)
+    return out
+
+
+def _node_term_to_v1(term: t.NodeSelectorTerm) -> dict:
+    out: dict = {}
+    if term.match_expressions:
+        out["matchExpressions"] = [
+            {"key": r.key, "operator": r.operator.value,
+             "values": list(r.values)}
+            for r in term.match_expressions
+        ]
+    if term.match_fields:
+        out["matchFields"] = [
+            {"key": r.key, "operator": r.operator.value,
+             "values": list(r.values)}
+            for r in term.match_fields
+        ]
+    return out
+
+
+def pod_to_v1(pod: t.Pod) -> dict:
+    """Encode a Pod back into the v1 JSON scheduling envelope — the wire
+    format the extender CLIENT posts (ExtenderArgs.Pod, extender.go:399
+    ``send``). Inverse of :func:`pod_from_v1` for the fields it decodes
+    (requests ride a single synthetic container)."""
+    spec: dict = {
+        "containers": [{
+            "name": "c0",
+            # canonical units back to quantities: cpu is milli ("750m"),
+            # memory/storage are bytes, scalars are counts
+            "resources": {"requests": {
+                k: (f"{v}m" if k == t.CPU else str(v))
+                for k, v in pod.requests
+            }},
+            "ports": [
+                {"hostPort": p.host_port, "protocol": p.protocol,
+                 **({"hostIP": p.host_ip} if p.host_ip else {})}
+                for p in pod.ports
+            ],
+        }],
+        "priority": pod.priority,
+        "schedulerName": pod.scheduler_name,
+        "preemptionPolicy": pod.preemption_policy,
+    }
+    if pod.node_name:
+        spec["nodeName"] = pod.node_name
+    if pod.node_selector:
+        spec["nodeSelector"] = dict(pod.node_selector)
+    if pod.tolerations:
+        spec["tolerations"] = [
+            {
+                "key": tol.key, "operator": tol.operator.value,
+                "value": tol.value,
+                **({"effect": tol.effect.value} if tol.effect else {}),
+            }
+            for tol in pod.tolerations
+        ]
+    if pod.scheduling_gates:
+        spec["schedulingGates"] = [
+            {"name": g} for g in pod.scheduling_gates
+        ]
+    if pod.topology_spread_constraints:
+        spec["topologySpreadConstraints"] = [
+            {
+                "maxSkew": c.max_skew, "topologyKey": c.topology_key,
+                "whenUnsatisfiable": c.when_unsatisfiable.value,
+                **({"labelSelector": _selector_to_v1(c.selector)}
+                   if c.selector is not None else {}),
+                **({"minDomains": c.min_domains}
+                   if c.min_domains is not None else {}),
+            }
+            for c in pod.topology_spread_constraints
+        ]
+    aff: dict = {}
+    if pod.affinity is not None:
+        na = pod.affinity.node_affinity
+        if na is not None:
+            na_out: dict = {}
+            if na.required is not None:
+                na_out["requiredDuringSchedulingIgnoredDuringExecution"] = {
+                    "nodeSelectorTerms": [
+                        _node_term_to_v1(term) for term in na.required.terms
+                    ]
+                }
+            if na.preferred:
+                na_out["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                    {"weight": p.weight, "preference": _node_term_to_v1(p.term)}
+                    for p in na.preferred
+                ]
+            aff["nodeAffinity"] = na_out
+        for field_name, pa in (
+            ("podAffinity", pod.affinity.pod_affinity),
+            ("podAntiAffinity", pod.affinity.pod_anti_affinity),
+        ):
+            if pa is None:
+                continue
+            pa_out: dict = {}
+            if pa.required:
+                pa_out["requiredDuringSchedulingIgnoredDuringExecution"] = [
+                    _term_to_v1(term) for term in pa.required
+                ]
+            if pa.preferred:
+                pa_out["preferredDuringSchedulingIgnoredDuringExecution"] = [
+                    {"weight": w.weight, "podAffinityTerm": _term_to_v1(w.term)}
+                    for w in pa.preferred
+                ]
+            aff[field_name] = pa_out
+    if aff:
+        spec["affinity"] = aff
+    return {
+        "metadata": {
+            "name": pod.name,
+            "namespace": pod.namespace,
+            "uid": pod.uid,
+            **({"labels": dict(pod.labels)} if pod.labels else {}),
+        },
+        "spec": spec,
+    }
 
 
 def node_from_v1(obj: _JSON) -> t.Node:

@@ -170,6 +170,22 @@ def minimal_profile(
 
 
 @dataclass(frozen=True)
+class ExtenderConfig:
+    """apis/config/types.go:267 Extender — the ``extenders:`` block of
+    KubeSchedulerConfiguration, consumed by the HTTP extender client
+    (sched/extender.py)."""
+
+    url_prefix: str
+    filter_verb: str = ""
+    prioritize_verb: str = ""
+    weight: int = 1
+    node_cache_capable: bool = False
+    ignorable: bool = False
+    http_timeout_s: float = 30.0
+    managed_resources: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
 class SchedulerConfiguration:
     """Subset of KubeSchedulerConfiguration (apis/config/types.go:37)."""
 
@@ -178,6 +194,7 @@ class SchedulerConfiguration:
     percentage_of_nodes_to_score: int = 0  # 0 = exhaustive (we never subsample)
     pod_initial_backoff_seconds: float = 1.0
     pod_max_backoff_seconds: float = 10.0
+    extenders: tuple[ExtenderConfig, ...] = ()
 
     def profile(self, name: str | None = None) -> Profile:
         if name is None:

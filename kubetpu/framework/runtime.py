@@ -86,6 +86,10 @@ class DeviceBatch:
     static_sig: jnp.ndarray | None = None  # (P,) int32 row into static_mask
     score_sig: jnp.ndarray | None = None   # (P,) int32 row into na/tt raws
     image_sig: jnp.ndarray | None = None   # (P,) int32 row into image sums
+    # extender webhook verdicts for this cycle (sched/extender.py):
+    # candidates may only SHRINK; scores arrive pre-weighted/scaled
+    extender_mask: jnp.ndarray | None = None   # (P, N) bool
+    extender_score: jnp.ndarray | None = None  # (P, N) int64
 
 
 @jax.tree_util.register_dataclass
@@ -254,11 +258,16 @@ def encode_batch(
         from ..state.volumes import VolumeState
 
         vol_state = VolumeState(snapshot)
+    # a nomination whose own pod sits in THIS batch is excluded: the folded
+    # resource is a batch singleton, so the nominee is its only requester —
+    # charging would block the nominee from its own nominated node (the
+    # dense path's self-exclusion is the per-pod gate, e.uid != p.uid)
+    batch_uids = {p_.uid for p_ in pods}
     folded_nominated = (
         [
             (e.node_name, tuple(e.requests))
             for e in nominated
-            if getattr(e, "node_name", "")
+            if getattr(e, "node_name", "") and e.uid not in batch_uids
         ]
         if folded else ()
     )
@@ -637,6 +646,10 @@ def feasible_and_scores(
     for part in (fit, ports_ok, spread_ok, pa_ok):
         if part is not None:
             mask = mask & part
+    if b.extender_mask is not None:
+        # findNodesThatPassExtenders (schedule_one.go:886): extenders only
+        # shrink the feasible set
+        mask = mask & b.extender_mask
     sp = b.spread
     pa = b.podaffinity
 
@@ -687,6 +700,10 @@ def feasible_and_scores(
             lambda sr, sv, m: PA.affinity_score_pod(pa, pa_state, sr, sv, m)
         )(pa.score_rows, pa.score_vals, mask)
         total = total + p.w_interpod * pa_sc
+    if b.extender_score is not None:
+        # extender Prioritize, pre-scaled weight*MaxNodeScore/MaxExtenderPriority
+        # (schedule_one.go:1015) — added after plugin normalization
+        total = total + b.extender_score
     return mask, total
 
 

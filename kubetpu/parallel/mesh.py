@@ -44,9 +44,9 @@ def _spec_for(field: str, axis: str) -> P:
     if field in ("alloc", "requested", "nonzero_requested", "pod_count",
                  "allowed_pods", "node_valid", "node_ports"):
         return P(axis)
-    # (P, N) pod × node tensors — shard the node axis
+    # (P|S, N) pod/signature × node tensors — shard the node axis
     if field in ("static_mask", "node_affinity_raw", "taint_prefer_raw",
-                 "image_sum_scores"):
+                 "image_sum_scores", "extender_mask", "extender_score"):
         return P(None, axis)
     # per-pod tensors + port conflict matrix — replicated
     return P()

@@ -297,9 +297,17 @@ def schedule_pod_groups(sched: "Scheduler", budget: int) -> dict[str, int]:
             plain.append((key, e))
 
     if plain:
-        s, u = _coalesced_group_cycle(sched, [e for _, e in plain])
-        scheduled += s
-        unschedulable += u
+        # one coalesced device cycle per PROFILE (frameworkForPodGroup: all
+        # members share a scheduler name; groups of different profiles are
+        # different tensor programs)
+        by_prof: dict[str, list[GroupEntry]] = {}
+        for _, e in plain:
+            first = next(iter(e.pending.values()))
+            by_prof.setdefault(first.pod.scheduler_name, []).append(e)
+        for pname, entries_ in by_prof.items():
+            s, u = _coalesced_group_cycle(sched, entries_)
+            scheduled += s
+            unschedulable += u
     for _, e in constrained:
         s, u = _placement_group_cycle(sched, e)
         scheduled += s
@@ -344,13 +352,15 @@ def _coalesced_group_cycle(
         start = len(pods)
         pods.extend(i.pod for i in infos)
         spans.append((start, len(pods)))
+    profile = sched._profile_for(pods[0]) or sched.profile
     batch = rt.encode_batch(
-        sched._snapshot, pods, sched.profile,
+        sched._snapshot, pods, profile,
         nominated=sched.nominator.entries(), prev_nt=sched._prev_nt,
     )
     sched._prev_nt = batch.node_tensors
-    params = rt.score_params(sched.profile, batch.resource_names)
-    assignments, _ = sched._assign_device(batch.device, params)
+    params = rt.score_params(profile, batch.resource_names)
+    device_batch = sched._apply_extenders(batch, pods)
+    assignments, _ = sched._assign_device(device_batch, params)
     idx = np.asarray(jax.device_get(assignments))
 
     scheduled = unschedulable = 0
@@ -399,8 +409,9 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
     sched._snapshot = sched.cache.update_snapshot(sched._snapshot)
     infos = _pop_members(e, sched.clock)
     pods = [i.pod for i in infos]
+    profile = sched._profile_for(pods[0]) or sched.profile
     batch = rt.encode_batch(
-        sched._snapshot, pods, sched.profile,
+        sched._snapshot, pods, profile,
         nominated=sched.nominator.entries(), prev_nt=sched._prev_nt,
     )
     sched._prev_nt = batch.node_tensors
@@ -414,9 +425,10 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
         sched.podgroups.group_failed(e)
         return 0, len(infos)
     masks, names = gen
-    params = rt.score_params(sched.profile, batch.resource_names)
+    params = rt.score_params(profile, batch.resource_names)
+    device_batch = sched._apply_extenders(batch, pods)
     assignments, counts = placement_assign_device(
-        batch.device, params, jnp.asarray(masks), engine=sched.engine
+        device_batch, params, jnp.asarray(masks), engine=sched.engine
     )
     counts = np.asarray(jax.device_get(counts))
     assignments = np.asarray(jax.device_get(assignments))

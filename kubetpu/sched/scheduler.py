@@ -109,6 +109,14 @@ class Scheduler:
 
         self.cfg = cfg or C.SchedulerConfiguration()
         self.profile = profile or self.cfg.profile()
+        # the profile Map (profile.go:46): pods select by spec.schedulerName.
+        # A single explicit ``profile`` also answers for the default name so
+        # plain pods keep scheduling under it (test/one-profile usage).
+        if profile is not None:
+            self.profiles: dict[str, C.Profile] = {profile.name: profile}
+            self.profiles.setdefault("default-scheduler", profile)
+        else:
+            self.profiles = {p.name: p for p in self.cfg.profiles}
         if feature_gates is None or isinstance(feature_gates, dict):
             feature_gates = FeatureGate(feature_gates)
         self.feature_gates = feature_gates
@@ -124,7 +132,9 @@ class Scheduler:
         self.cache = Cache(clock=clock)
         self.clock = clock
         self.max_batch = max_batch
-        filters = self.profile.filters.names()
+        filters = sorted({
+            n for prof in self.profiles.values() for n in prof.filters.names()
+        })
         self.queue = PriorityQueue(
             hints=default_queueing_hints(filters),
             pre_enqueue=[self._scheduling_gates],
@@ -157,6 +167,9 @@ class Scheduler:
         from ..queue.nominator import Nominator
 
         self.nominator = Nominator()
+        from .extender import HTTPExtender
+
+        self.extenders = [HTTPExtender(c) for c in self.cfg.extenders]
         from .podgroup import PodGroupManager
 
         self.podgroups = PodGroupManager(
@@ -171,9 +184,19 @@ class Scheduler:
         # malformed profile must never reach the hot loop
         from ..framework.validation import must_validate
 
-        must_validate(self.profile, self.registry)
-        self.lifecycle = self.registry.build(
-            self.profile.lifecycle.names(), self.profile
+        self._lifecycles: dict[str, lc.LifecycleRunner] = {}
+        built: dict[int, lc.LifecycleRunner] = {}
+        for pname, prof in self.profiles.items():
+            if id(prof) not in built:
+                must_validate(prof, self.registry)
+                built[id(prof)] = self.registry.build(
+                    prof.lifecycle.names(), prof
+                )
+            self._lifecycles[pname] = built[id(prof)]
+        # the default profile's runner (single-profile back-compat surface)
+        self.lifecycle = self._lifecycles.get(
+            "default-scheduler",
+            next(iter(self._lifecycles.values())),
         )
         # permitted-with-Wait pods parked before binding (waitingPodsMap)
         self.waiting_pods: dict[str, lc.WaitingPod] = {}
@@ -198,6 +221,13 @@ class Scheduler:
     # The informer seam (eventhandlers.go:455): assigned pods maintain the
     # cache; unscheduled pods maintain the queue; every event also feeds the
     # queueing hints so parked pods wake up.
+
+    def _profile_for(self, pod: t.Pod) -> C.Profile | None:
+        """frameworkForPod (schedule_one.go:532): None = not our pod."""
+        return self.profiles.get(pod.scheduler_name)
+
+    def _lifecycle_for(self, pod: t.Pod):
+        return self._lifecycles.get(pod.scheduler_name, self.lifecycle)
 
     def _gang_member(self, pod: t.Pod) -> bool:
         """Is this pod routed through the gang lane? One predicate for
@@ -233,6 +263,10 @@ class Scheduler:
         )
 
     def on_pod_add(self, pod: t.Pod) -> None:
+        if not pod.node_name and self._profile_for(pod) is None:
+            # a pod naming an unknown profile is another scheduler's
+            # responsibility (the reference's informer filters it out)
+            return
         if pod.node_name:
             self.cache.add_pod(pod)
             if self._gang_member(pod):
@@ -256,6 +290,9 @@ class Scheduler:
             self.queue.add(pod)
 
     def on_pod_update(self, old: t.Pod | None, new: t.Pod) -> None:
+        if not new.node_name and self._profile_for(new) is None:
+            # foreign-scheduler pod (see on_pod_add): never ours to queue
+            return
         if new.node_name:
             if old is not None and old.node_name:
                 self.cache.update_pod(old, new)
@@ -299,7 +336,7 @@ class Scheduler:
         wp = self.waiting_pods.pop(pod_key(pod), None)
         if wp is not None:
             # a deleted waiting pod unreserves; its assume drops below
-            self.lifecycle.run_unreserve(self, wp.pod, wp.node_name)
+            self._lifecycle_for(wp.pod).run_unreserve(self, wp.pod, wp.node_name)
         # has_pod covers BOUND pods too: a Delete event may carry a stale
         # object with node_name unset (the informer's last-known view from
         # before the bind) and must still drop the cached accounting and
@@ -431,7 +468,9 @@ class Scheduler:
     def schedule_batch(self, max_batch: int | None = None) -> dict[str, int]:
         """One scheduling cycle over up to ``max_batch`` pods. Returns result
         counts. The cycle: drain bind completions → pop batch → snapshot →
-        encode → device assign → assume + dispatch binds → requeue failures."""
+        encode → device assign → assume + dispatch binds → requeue failures.
+        A mixed-profile batch runs one sub-cycle per profile (each profile
+        is its own tensor program, frameworkForPod semantics)."""
         self._drain_bind_completions()
         self._flush_timers()
         limit = max_batch or self.max_batch
@@ -446,19 +485,44 @@ class Scheduler:
             res = schedule_pod_groups(self, budget=limit)
             self.metrics.unschedulable += res["unschedulable"]
             return res
+        # partition by profile, preserving queue order within each group
+        by_profile: dict[str, list[QueuedPodInfo]] = {}
+        for info in batch_infos:
+            by_profile.setdefault(info.pod.scheduler_name, []).append(info)
+        scheduled = unschedulable = 0
+        groups = list(by_profile.items())
+        for g_i, (pname, infos) in enumerate(groups):
+            try:
+                res = self._profile_cycle(self.profiles[pname], infos)
+            except Exception:
+                # an earlier profile's failure must not strand the LATER
+                # profiles' popped pods in the in-flight set
+                for _, rest in groups[g_i + 1:]:
+                    self.metrics.errors += len(rest)
+                    for info in rest:
+                        self.queue.add_unschedulable(info, error=True)
+                raise
+            scheduled += res["scheduled"]
+            unschedulable += res["unschedulable"]
+        return {"scheduled": scheduled, "unschedulable": unschedulable}
+
+    def _profile_cycle(
+        self, profile: C.Profile, batch_infos: list[QueuedPodInfo]
+    ) -> dict[str, int]:
         t0 = self.clock()
 
         try:
             self._snapshot = self.cache.update_snapshot(self._snapshot)
             pods = [info.pod for info in batch_infos]
             batch = rt.encode_batch(
-                self._snapshot, pods, self.profile,
+                self._snapshot, pods, profile,
                 nominated=self.nominator.entries(),
                 prev_nt=self._prev_nt,
             )
             self._prev_nt = batch.node_tensors
-            params = rt.score_params(self.profile, batch.resource_names)
-            assignments, final_state = self._assign_device(batch.device, params)
+            device_batch = self._apply_extenders(batch, pods)
+            params = rt.score_params(profile, batch.resource_names)
+            assignments, final_state = self._assign_device(device_batch, params)
             idx = np.asarray(jax.device_get(assignments))
             self._cycle_ctx = (
                 batch, params, final_state,
@@ -494,19 +558,19 @@ class Scheduler:
         # (the reference's per-pod loop measures its own span; the batch is
         # the attempt for every pod in it)
         if scheduled:
-            prom.schedule_attempts.labels("scheduled", self.profile.name).inc(scheduled)
+            prom.schedule_attempts.labels("scheduled", profile.name).inc(scheduled)
             prom.scheduling_attempt_duration.labels(
-                "scheduled", self.profile.name
+                "scheduled", profile.name
             ).observe_n(cycle_s, scheduled)
         if failed:
-            prom.schedule_attempts.labels("unschedulable", self.profile.name).inc(len(failed))
+            prom.schedule_attempts.labels("unschedulable", profile.name).inc(len(failed))
             prom.scheduling_attempt_duration.labels(
-                "unschedulable", self.profile.name
+                "unschedulable", profile.name
             ).observe_n(cycle_s, len(failed))
 
         try:
             for info in failed:
-                self._handle_unschedulable(info)
+                self._handle_unschedulable(info, profile)
         finally:
             # drop the cycle's batch (device tensors + host snapshot
             # encoding) so it doesn't pin memory across cycles
@@ -516,6 +580,35 @@ class Scheduler:
                 if reset is not None:
                     reset()
         return {"scheduled": scheduled, "unschedulable": len(failed)}
+
+    def _apply_extenders(self, batch, pods):
+        """Run the configured extender webhooks for the batch and attach
+        their (P, N) mask/score to the device pytree (findNodesThatPass
+        Extenders + extender Prioritize — sched/extender.py). Shared by the
+        per-pod lane and the pod-group lane."""
+        device_batch = batch.device
+        if not self.extenders:
+            return device_batch
+        from dataclasses import replace as _dc_replace
+
+        import jax.numpy as jnp
+
+        from .extender import run_extenders
+
+        ext_mask, ext_score = run_extenders(
+            self.extenders, pods, batch.node_names,
+            batch.num_nodes,
+            pad_pods=device_batch.requests.shape[0],
+            pad_nodes=device_batch.alloc.shape[0],
+            parallelism=self.cfg.parallelism,
+        )
+        if ext_mask is not None:
+            device_batch = _dc_replace(
+                device_batch,
+                extender_mask=jnp.asarray(ext_mask),
+                extender_score=jnp.asarray(ext_score),
+            )
+        return device_batch
 
     def _assume_and_bind(self, info: QueuedPodInfo, node_name: str) -> bool:
         """assumeAndReserve + Permit + async binding cycle
@@ -544,13 +637,14 @@ class Scheduler:
         from ..framework import lifecycle as lc
 
         node_name = assumed.node_name
-        if self.lifecycle:
-            st = self.lifecycle.run_reserve(self, info.pod, node_name)
+        lifecycle = self._lifecycle_for(info.pod)
+        if lifecycle:
+            st = lifecycle.run_reserve(self, info.pod, node_name)
             if not st.ok:
-                self.lifecycle.run_unreserve(self, info.pod, node_name)
+                lifecycle.run_unreserve(self, info.pod, node_name)
                 self._reject_assumed(info, assumed, st)
                 return False
-            st, pending, deadline = self.lifecycle.run_permit(
+            st, pending, deadline = lifecycle.run_permit(
                 self, info.pod, node_name, self.clock()
             )
             if st.code == lc.WAIT:
@@ -560,7 +654,7 @@ class Scheduler:
                 )
                 return True
             if not st.ok:
-                self.lifecycle.run_unreserve(self, info.pod, node_name)
+                lifecycle.run_unreserve(self, info.pod, node_name)
                 self._reject_assumed(info, assumed, st)
                 return False
         self._dispatch_bind(info, assumed)
@@ -572,17 +666,18 @@ class Scheduler:
         def on_done(err: Exception | None, info=info, assumed=assumed) -> None:
             self._bind_completions.append((info, assumed, err))
 
+        lifecycle = self._lifecycle_for(info.pod)
         pre = post = None
-        if self.lifecycle.pre_bind_plugins:
-            def pre(info=info, node_name=node_name):
-                st = self.lifecycle.run_pre_bind(self, info.pod, node_name)
+        if lifecycle.pre_bind_plugins:
+            def pre(info=info, node_name=node_name, lifecycle=lifecycle):
+                st = lifecycle.run_pre_bind(self, info.pod, node_name)
                 if not st.ok:
                     raise RuntimeError(
                         f"PreBind {st.plugin}: {st.reason or st.code}"
                     )
-        if self.lifecycle.post_bind_plugins:
-            def post(info=info, node_name=node_name):
-                self.lifecycle.run_post_bind(self, info.pod, node_name)
+        if lifecycle.post_bind_plugins:
+            def post(info=info, node_name=node_name, lifecycle=lifecycle):
+                lifecycle.run_post_bind(self, info.pod, node_name)
         self.dispatcher.add(
             BindCall(info.pod, node_name, on_done=on_done, pre=pre, post=post)
         )
@@ -627,7 +722,7 @@ class Scheduler:
             del self.waiting_pods[key]
             assumed = wp.pod.with_node(wp.node_name)
             if wp.rejected is not None:
-                self.lifecycle.run_unreserve(self, wp.pod, wp.node_name)
+                self._lifecycle_for(wp.pod).run_unreserve(self, wp.pod, wp.node_name)
                 self._reject_assumed(wp.info, assumed, wp.rejected)
             else:
                 self._dispatch_bind(wp.info, assumed)
@@ -653,7 +748,9 @@ class Scheduler:
                 self.cache.forget_pod(assumed)
                 # binding-cycle failure runs Unreserve (schedule_one.go:391
                 # bindingCycle's deferred unreserve-on-failure)
-                self.lifecycle.run_unreserve(self, info.pod, assumed.node_name)
+                self._lifecycle_for(info.pod).run_unreserve(
+                    self, info.pod, assumed.node_name
+                )
                 if self._gang_member(info.pod):
                     # gang member: hand back to the group manager (it never
                     # lived in the per-pod queue)
@@ -662,7 +759,9 @@ class Scheduler:
                 else:
                     self.queue.add_unschedulable(info, error=True)
 
-    def _handle_unschedulable(self, info: QueuedPodInfo) -> None:
+    def _handle_unschedulable(
+        self, info: QueuedPodInfo, profile: C.Profile | None = None
+    ) -> None:
         """No feasible node. Run PostFilter (preemption) if wired, then
         requeue with rejector plugins for the queueing hints.
 
@@ -670,6 +769,7 @@ class Scheduler:
         recorded (the reference records the plugins that actually rejected
         per node, schedule_one.go FitError) — over-eager wake-ups are safe;
         the leftover flush bounds staleness either way."""
+        profile = profile or self._profile_for(info.pod) or self.profile
         if self._post_filter is not None:
             nominated = self._post_filter(self, info)
             if nominated is not None:
@@ -677,11 +777,11 @@ class Scheduler:
                 # hints; pod waits in backoff for the room to open
                 info.nominated_node_name = nominated
                 self.queue.add_unschedulable(
-                    info, self.profile.filters.names()
+                    info, profile.filters.names()
                 )
                 return
         where = self.queue.add_unschedulable(
-            info, self.profile.filters.names()
+            info, profile.filters.names()
         )
         if where not in ("deleted", "already-queued"):
             # only patch status for pods that still exist and we own

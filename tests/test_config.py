@@ -15,7 +15,14 @@ from kubetpu.framework.validation import (
     validate_profile,
 )
 
-from .test_scheduler import FakeClient, make_sched
+from .test_scheduler import FakeClient, FakeClock, make_sched
+
+
+def make_cfg_sched(client, cfg):
+    clock = FakeClock()
+    from kubetpu.sched import Scheduler
+
+    return Scheduler(client, cfg=cfg, dispatcher_workers=0, clock=clock), clock
 
 
 class TestFeatureGates:
@@ -155,3 +162,87 @@ def test_gate_off_bind_failure_requeues_to_pod_queue():
     s.dispatcher.sync()
     s._drain_bind_completions()
     assert client.bound == {"default/g-0": "n0"}
+
+
+class TestMultiProfile:
+    def _two_profile_cfg(self):
+        most = C.Profile(
+            name="most-allocated",
+            filters=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+            scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+            scoring_strategy=C.ScoringStrategy(type=C.MOST_ALLOCATED),
+            default_spread_constraints=(),
+        )
+        least = C.Profile(
+            name="default-scheduler",
+            filters=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+            scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+            default_spread_constraints=(),
+        )
+        return C.SchedulerConfiguration(profiles=(least, most))
+
+    def test_pods_route_to_their_profile(self):
+        """profile.go:46 Map + frameworkForPod: a bin-packing profile and a
+        spreading profile coexist; each pod's schedulerName picks one."""
+        client = FakeClient()
+        s, _ = make_cfg_sched(client, self._two_profile_cfg())
+        # n0 is half-loaded; n1 empty
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_node_add(make_node("n1", cpu_milli=4000))
+        s.on_pod_add(make_pod("seed", cpu_milli=2000, node_name="n0"))
+        # LeastAllocated (default) spreads to the empty node;
+        # MostAllocated packs onto the loaded one — same cluster, same batch
+        s.on_pod_add(make_pod("spread-me", cpu_milli=100, creation_index=0))
+        s.on_pod_add(make_pod("pack-me", cpu_milli=100, creation_index=1,
+                              scheduler_name="most-allocated"))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert client.bound["default/spread-me"] == "n1"
+        assert client.bound["default/pack-me"] == "n0"
+
+    def test_unknown_scheduler_name_ignored(self):
+        """A pod naming an unknown profile is not ours to schedule (the
+        reference's informer filters it out)."""
+        client = FakeClient()
+        s, _ = make_cfg_sched(client, self._two_profile_cfg())
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_pod_add(make_pod("alien", cpu_milli=100,
+                              scheduler_name="someone-elses-scheduler"))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert client.bound == {}
+        assert len(s.queue) == 0
+
+    def test_metrics_labeled_per_profile(self):
+        client = FakeClient()
+        s, _ = make_cfg_sched(client, self._two_profile_cfg())
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_pod_add(make_pod("a", cpu_milli=100))
+        s.on_pod_add(make_pod("b", cpu_milli=100,
+                              scheduler_name="most-allocated"))
+        s.schedule_batch()
+        text = s.metrics_text()
+        assert 'profile="default-scheduler"' in text
+        assert 'profile="most-allocated"' in text
+
+
+def test_foreign_pod_update_stays_ignored():
+    """Regression: an update for a foreign-scheduler pod must not enter the
+    queue (on_pod_add ignores it; on_pod_update must too, or the next cycle
+    crashes on an unknown profile and strands the popped batch)."""
+    import dataclasses
+
+    client = FakeClient()
+    s, _ = make_cfg_sched(client, C.SchedulerConfiguration())
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    alien = make_pod("alien", cpu_milli=100, scheduler_name="not-ours")
+    s.on_pod_add(alien)
+    s.on_pod_update(alien, dataclasses.replace(alien, priority=5))
+    s.on_pod_add(make_pod("ours", cpu_milli=100))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound == {"default/ours": "n0"}
+    assert len(s.queue) == 0

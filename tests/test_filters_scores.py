@@ -397,3 +397,30 @@ class TestFoldedScalars:
             ))
         res = s.schedule_batch()
         assert res["scheduled"] == 0
+
+
+def test_folded_nominee_not_self_charged():
+    """Regression: a nominated pod appearing in the batch must still fit
+    its own nominated node when its extended resource is folded."""
+    from kubetpu.assign import greedy_assign
+    from kubetpu.framework import config as C
+    from kubetpu.framework import encode_batch
+    from kubetpu.queue.nominator import Nominator
+
+    cache = Cache()
+    for i in range(33):   # >8 singletons forces folding
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000,
+                                 extended={f"r-{i}": 1}))
+    pods = [
+        make_pod(f"p{j}", requests={f"r-{j}": 1, t.CPU: 100},
+                 creation_index=j)
+        for j in range(33)
+    ]
+    nom = Nominator()
+    nom.add(pods[5], "n5")     # p5 was preemption-nominated to its node
+    profile = C.minimal_profile()
+    batch = encode_batch(cache.update_snapshot(), pods, profile,
+                         nominated=nom.entries())
+    got = greedy_assign(batch, profile)
+    assert got[5] == "n5"      # the nominee lands on its own node
+    assert all(g == f"n{j}" for j, g in enumerate(got))
