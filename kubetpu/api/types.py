@@ -236,6 +236,9 @@ class Pod:
     scheduling_group: str = ""
     # spec.volumes, PVC references only (the volume plugin family)
     volumes: tuple[PodVolume, ...] = ()
+    # spec.resourceClaims with template instances resolved to claim names
+    # (the DynamicResources plugin family)
+    resource_claims: tuple["PodResourceClaim", ...] = ()
     # spec.schedulerName — selects the profile (profile.go:46 Map); pods
     # naming an unknown profile are not this scheduler's to place
     scheduler_name: str = "default-scheduler"
@@ -329,6 +332,153 @@ class StorageClass:
     name: str
     binding_mode: str = BINDING_IMMEDIATE
     provisioner: str = NO_PROVISIONER
+
+
+# --------------------------------------------------------------------------
+# Dynamic Resource Allocation (resource.k8s.io/v1 — GA in the 1.37 snapshot;
+# staging/src/k8s.io/api/resource/v1/types.go). The scheduling slice only:
+# device classes select devices via CEL, ResourceSlices publish per-node
+# device inventories, ResourceClaims request devices, and an allocation in
+# claim status pins the claim (and its pods) to a node.
+# --------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class Device:
+    """One device in a ResourceSlice pool (resource/v1 types.go Device):
+    a name plus typed attributes (string/int/bool, qualified names) and
+    integer capacities."""
+
+    name: str
+    attributes: tuple[tuple[str, object], ...] = ()
+    capacity: tuple[tuple[str, int], ...] = ()
+
+    def attributes_dict(self) -> dict:
+        return dict(self.attributes)
+
+
+@dataclass(frozen=True)
+class CELSelector:
+    """DeviceSelector.cel.expression — a CEL expression over ``device``.
+    kubetpu evaluates the structured subset the in-tree perf/e2e configs
+    use (see state.dra.parse_cel); anything else fails loudly at
+    class/claim validation, like a CEL compile error in the reference."""
+
+    expression: str
+
+
+@dataclass(frozen=True)
+class DeviceClass:
+    """resource/v1 DeviceClass: named selector bundle
+    (dra/templates/deviceclass.yaml shape)."""
+
+    name: str
+    selectors: tuple[CELSelector, ...] = ()
+
+
+@dataclass(frozen=True)
+class ResourceSlice:
+    """resource/v1 ResourceSlice: one driver's device pool. Node-local
+    (``node_name``) is the common case; ``all_nodes`` / ``node_selector``
+    publish network-attached devices reachable from many nodes."""
+
+    name: str
+    driver: str
+    pool: str
+    node_name: str = ""
+    all_nodes: bool = False
+    node_selector: NodeSelector | None = None
+    devices: tuple[Device, ...] = ()
+
+
+@dataclass(frozen=True)
+class DeviceSubRequest:
+    """One alternative of a prioritized-list request
+    (DeviceRequest.firstAvailable, resource/v1 types.go)."""
+
+    name: str
+    device_class_name: str
+    selectors: tuple[CELSelector, ...] = ()
+    count: int = 1
+
+
+# resourceapi.FirstAvailableDeviceRequestMaxSize — the Score contribution of
+# choosing alternative i is (MAX - i) (dynamicresources.go computeScore)
+FIRST_AVAILABLE_MAX = 8
+
+
+@dataclass(frozen=True)
+class DeviceRequest:
+    """ResourceClaim spec.devices.requests[] — either ``exactly`` (class +
+    selectors + count | all) or a ``first_available`` prioritized list."""
+
+    name: str
+    device_class_name: str = ""
+    selectors: tuple[CELSelector, ...] = ()
+    count: int = 1
+    all_devices: bool = False          # allocationMode: All
+    first_available: tuple[DeviceSubRequest, ...] = ()
+
+
+@dataclass(frozen=True)
+class DeviceConstraint:
+    """spec.devices.constraints[]: all devices allocated for ``requests``
+    (empty = every request) must share the ``match_attribute`` value."""
+
+    match_attribute: str
+    requests: tuple[str, ...] = ()
+
+
+@dataclass(frozen=True)
+class DeviceResult:
+    """status.allocation.devices.results[] — one concrete device."""
+
+    request: str
+    driver: str
+    pool: str
+    device: str
+
+
+@dataclass(frozen=True)
+class ClaimAllocation:
+    """status.allocation: devices + the node the claim is usable from
+    ('' = available everywhere, the network-attached case)."""
+
+    node_name: str
+    results: tuple[DeviceResult, ...] = ()
+
+
+# resourceclaim.ReservedForMaxSize — max pods sharing one claim
+RESERVED_FOR_MAX = 256
+
+
+@dataclass(frozen=True)
+class ResourceClaim:
+    """resource/v1 ResourceClaim (scheduling slice): device requests +
+    constraints, and the allocation/reservedFor status the scheduler both
+    reads and (via Reserve/PreBind) writes."""
+
+    name: str
+    namespace: str = "default"
+    uid: str = ""
+    requests: tuple[DeviceRequest, ...] = ()
+    constraints: tuple[DeviceConstraint, ...] = ()
+    allocation: ClaimAllocation | None = None
+    reserved_for: tuple[str, ...] = ()   # pod uids
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class PodResourceClaim:
+    """spec.resourceClaims[] with the template already resolved: the pod
+    references the ResourceClaim object ``claim_name`` in its namespace
+    (the resourceclaim controller names template instances; the scheduler
+    only ever sees resolved names via status.resourceClaimStatuses)."""
+
+    name: str
+    claim_name: str = ""
 
 
 @dataclass(frozen=True)

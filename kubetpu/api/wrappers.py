@@ -61,6 +61,7 @@ def make_pod(
     preemption_policy: str = "PreemptLowerPriority",
     scheduling_group: str = "",
     pvcs: Sequence[str] = (),
+    claims: Sequence[str] = (),
     scheduler_name: str = "default-scheduler",
 ) -> t.Pod:
     nonzero = None
@@ -107,6 +108,10 @@ def make_pod(
         volumes=tuple(
             t.PodVolume(name=f"vol-{i}", pvc_name=c)
             for i, c in enumerate(pvcs)
+        ),
+        resource_claims=tuple(
+            t.PodResourceClaim(name=f"claim-{i}", claim_name=c)
+            for i, c in enumerate(claims)
         ),
         scheduler_name=scheduler_name,
     )

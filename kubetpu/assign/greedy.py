@@ -57,6 +57,8 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         image_count=row(b.image_count),
         extender_mask=row(b.extender_mask),
         extender_score=row(b.extender_score),
+        dra_score_raw=b.dra_score_raw,
+        dra_score_sig=row(b.dra_score_sig),
         pod_ports=b.pod_ports[i][None],
         node_ports=b.node_ports,
         port_conflict=b.port_conflict,

@@ -26,6 +26,7 @@ from ..api import types as t
 from ..names import (  # noqa: F401  (canonical plugin names, re-exported)
     DEFAULT_BINDER,
     DEFAULT_PREEMPTION,
+    DYNAMIC_RESOURCES,
     IMAGE_LOCALITY,
     INTER_POD_AFFINITY,
     NODE_AFFINITY,
@@ -94,6 +95,9 @@ DEFAULT_FILTERS = PluginSet(enabled=(
     (VOLUME_ZONE, 1),
     (POD_TOPOLOGY_SPREAD, 1),
     (INTER_POD_AFFINITY, 1),
+    # DynamicResources joins the default set with DRA GA (resource.k8s.io/v1
+    # in the 1.37 snapshot; default_plugins.go:60-73 feature-gated add)
+    (DYNAMIC_RESOURCES, 1),
 ))
 DEFAULT_SCORES = PluginSet(enabled=(
     (TAINT_TOLERATION, 3),
@@ -103,6 +107,7 @@ DEFAULT_SCORES = PluginSet(enabled=(
     (INTER_POD_AFFINITY, 2),
     (NODE_RESOURCES_BALANCED, 1),
     (IMAGE_LOCALITY, 1),
+    (DYNAMIC_RESOURCES, 1),
 ))
 
 
@@ -118,7 +123,9 @@ class Profile:
     # lifecycle Registry; one name may serve several extension points, like
     # reference plugins implementing multiple interfaces. VolumeBinding's
     # Reserve/PreBind half is in the default set (default_plugins.go:30).
-    lifecycle: PluginSet = PluginSet(enabled=((VOLUME_BINDING, 1),))
+    lifecycle: PluginSet = PluginSet(
+        enabled=((VOLUME_BINDING, 1), (DYNAMIC_RESOURCES, 1))
+    )
     scoring_strategy: ScoringStrategy = ScoringStrategy()
     balanced_resources: tuple[tuple[str, int], ...] = ((t.CPU, 1), (t.MEMORY, 1))
     # InterPodAffinityArgs.HardPodAffinityWeight (types_pluginargs.go, default 1)

@@ -41,6 +41,10 @@ KNOWN_FEATURES: dict[str, FeatureSpec] = {
     ),
     "OpportunisticBatching": FeatureSpec(True, BETA),
     "SchedulerQueueingHints": FeatureSpec(True, BETA),
+    # DRA core is GA (resource.k8s.io/v1, kube_features.go DynamicResource-
+    # Allocation); the prioritized-list extension is beta default-on
+    "DynamicResourceAllocation": FeatureSpec(True, GA),
+    "DRAPrioritizedList": FeatureSpec(True, BETA),
 }
 
 

@@ -234,8 +234,10 @@ class Registry:
 
 def default_registry() -> Registry:
     """In-tree lifecycle plugins (NewInTreeRegistry analog)."""
+    from .dynamicresources import DynamicResourcesPlugin
     from .volumebinding import VolumeBindingPlugin
 
     reg = Registry()
     reg.register("VolumeBinding", VolumeBindingPlugin)
+    reg.register("DynamicResources", DynamicResourcesPlugin)
     return reg

@@ -90,6 +90,10 @@ class DeviceBatch:
     # candidates may only SHRINK; scores arrive pre-weighted/scaled
     extender_mask: jnp.ndarray | None = None   # (P, N) bool
     extender_score: jnp.ndarray | None = None  # (P, N) int64
+    # DynamicResources prioritized-list raw score (dynamicresources.go:1059
+    # computeScore), signature-compressed like the other static raws
+    dra_score_raw: jnp.ndarray | None = None   # (S5, N) int64
+    dra_score_sig: jnp.ndarray | None = None   # (P,) int32
 
 
 @jax.tree_util.register_dataclass
@@ -238,10 +242,35 @@ def encode_batch(
     folded: frozenset = frozenset()
     if resource_names is None:
         resource_names, folded = enc.batch_resource_axis(snapshot, pods)
+    # DRA (state.dra): pre-analyze the batch's claims so dense pool columns
+    # join the resource axis BEFORE the node tensors are built; pool ids are
+    # interned on the cache's index, keeping the axis cycle-stable for the
+    # incremental encode
+    dra_state = None
+    want_dra_plugin = profile is None or (
+        profile.has_filter(C.DYNAMIC_RESOURCES)
+    )
+    if (
+        want_dra_plugin
+        and getattr(snapshot, "dra", None) is not None
+        and any(p_.resource_claims for p_ in pods)
+    ):
+        from ..state.dra import DraState
+
+        dra_state = DraState(snapshot)
+        for p_ in pods:
+            dra_state.analyze(p_)
+        pool_names = dra_state.pool_resource_names()
+        if pool_names:
+            resource_names = list(resource_names) + pool_names
     nt = enc.encode_snapshot(
         snapshot, resource_names=resource_names, pods=pods, pad_nodes=NP,
         prev=prev_nt,
     )
+    if dra_state is not None and dra_state.used_pools:
+        dra_state.fill_node_columns(
+            nt, len(nt.resource_names) - len(dra_state.used_pools)
+        )
     enabled = (
         frozenset(profile.filters.names()) if profile is not None else None
     )
@@ -277,7 +306,39 @@ def encode_batch(
         volume_state=vol_state,
         folded_resources=folded,
         folded_nominated=folded_nominated,
+        dra_state=dra_state,
     )
+    # DRA prioritized-list score rows (per distinct host-spec set)
+    dra_score_raw = dra_score_sig = None
+    want_dra_score = profile is None or profile.has_score(C.DYNAMIC_RESOURCES)
+    if dra_state is not None and want_dra_score:
+        NC = nt.alloc.shape[0]
+        row_ids: dict[tuple, int] = {}
+        rows: list[np.ndarray] = []
+        sig_arr = np.zeros(PP, dtype=np.int32)
+        any_score = False
+        for i, p_ in enumerate(pods):
+            d = dra_state.analyze(p_)
+            specs = tuple(
+                s for s in d.host_specs
+                if dra_state.spec_score(s, nt) is not None
+            )
+            sid = row_ids.get(specs)
+            if sid is None:
+                v = np.zeros(N, dtype=np.int64)
+                for s in specs:
+                    v = v + dra_state.spec_score(s, nt)
+                sid = len(rows)
+                row_ids[specs] = sid
+                rows.append(v)
+            sig_arr[i] = sid
+            if specs:
+                any_score = True
+        if any_score:
+            dra_score_raw = np.zeros((len(rows), NC), dtype=np.int64)
+            for s_i, v in enumerate(rows):
+                dra_score_raw[s_i, :N] = v
+            dra_score_sig = sig_arr
     want_na = profile is None or profile.has_score(C.NODE_AFFINITY)
     want_tt = profile is None or profile.has_score(C.TAINT_TOLERATION)
     want_img = profile is None or profile.has_score(C.IMAGE_LOCALITY)
@@ -430,6 +491,12 @@ def encode_batch(
         ),
         spread=spread_dev,
         podaffinity=pa_dev,
+        dra_score_raw=(
+            jnp.asarray(dra_score_raw) if dra_score_raw is not None else None
+        ),
+        dra_score_sig=(
+            jnp.asarray(dra_score_sig) if dra_score_raw is not None else None
+        ),
     )
     return EncodedBatch(
         device=dev,
@@ -461,6 +528,7 @@ class ScoreParams:
     w_image: int
     w_spread: int
     w_interpod: int
+    w_dra: int
     filter_fit: bool
     filter_ports: bool
     filter_spread: bool
@@ -486,6 +554,7 @@ def score_params(profile: C.Profile, resource_names: Sequence[str]) -> ScorePara
         w_image=profile.score_weight(C.IMAGE_LOCALITY),
         w_spread=profile.score_weight(C.POD_TOPOLOGY_SPREAD),
         w_interpod=profile.score_weight(C.INTER_POD_AFFINITY),
+        w_dra=profile.score_weight(C.DYNAMIC_RESOURCES),
         filter_fit=profile.has_filter(C.NODE_RESOURCES_FIT),
         filter_ports=profile.has_filter(C.NODE_PORTS),
         filter_spread=profile.has_filter(C.POD_TOPOLOGY_SPREAD),
@@ -700,6 +769,14 @@ def feasible_and_scores(
             lambda sr, sv, m: PA.affinity_score_pod(pa, pa_state, sr, sv, m)
         )(pa.score_rows, pa.score_vals, mask)
         total = total + p.w_interpod * pa_sc
+    if p.w_dra and b.dra_score_raw is not None:
+        # DynamicResources prioritized-list score + DefaultNormalizeScore
+        # (dynamicresources.go:1059 Score, :1138 NormalizeScore)
+        dra_raw = (
+            b.dra_score_raw[b.dra_score_sig]
+            if b.dra_score_sig is not None else b.dra_score_raw
+        )
+        total = total + p.w_dra * masked_normalize(dra_raw, mask)
     if b.extender_score is not None:
         # extender Prioritize, pre-scaled weight*MaxNodeScore/MaxExtenderPriority
         # (schedule_one.go:1015) — added after plugin normalization

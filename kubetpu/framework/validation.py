@@ -22,6 +22,7 @@ SCORE_PLUGINS = frozenset({
     N.IMAGE_LOCALITY,
     N.POD_TOPOLOGY_SPREAD,
     N.INTER_POD_AFFINITY,
+    N.DYNAMIC_RESOURCES,
 })
 STRATEGIES = frozenset({
     C.LEAST_ALLOCATED, C.MOST_ALLOCATED, C.REQUESTED_TO_CAPACITY_RATIO,

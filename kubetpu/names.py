@@ -40,4 +40,5 @@ ALL_FILTERS = frozenset({
     VOLUME_ZONE,
     NODE_VOLUME_LIMITS,
     VOLUME_BINDING,
+    DYNAMIC_RESOURCES,
 })

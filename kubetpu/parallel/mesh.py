@@ -46,7 +46,8 @@ def _spec_for(field: str, axis: str) -> P:
         return P(axis)
     # (P|S, N) pod/signature × node tensors — shard the node axis
     if field in ("static_mask", "node_affinity_raw", "taint_prefer_raw",
-                 "image_sum_scores", "extender_mask", "extender_score"):
+                 "image_sum_scores", "extender_mask", "extender_score",
+                 "dra_score_raw"):
         return P(None, axis)
     # per-pod tensors + port conflict matrix — replicated
     return P()

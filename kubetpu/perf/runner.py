@@ -245,12 +245,15 @@ def run_workload(
                 last_progress = now
         return done, time.perf_counter() - t0
 
+    created_nodes: list[str] = []
     for op_i, op in enumerate(case.ops):
         if isinstance(op, W.CreateNodesOp):
             n = op.count or params[op.count_param]
             factory = op.template or W.node_default
             for i in range(n):
-                sched.on_node_add(factory(i, op.zones))
+                node = factory(i, op.zones)
+                created_nodes.append(node.name)
+                sched.on_node_add(node)
         elif isinstance(op, W.CreateNamespacesOp):
             # namespace objects carry labels for affinity namespaceSelectors
             n = params[op.count_param] if op.count_param else op.count
@@ -383,6 +386,59 @@ def run_workload(
             if op.collect_metrics:
                 measured += done
                 duration += secs
+        elif isinstance(op, W.CreateResourceDriverOp):
+            sched.on_device_class_add(t.DeviceClass(
+                name=op.class_name,
+                selectors=(t.CELSelector(
+                    f'device.driver == "{op.driver}"'
+                ),),
+            ))
+            per_node = params[op.max_claims_param]
+            for node_name in created_nodes:
+                if not node_name.startswith(op.node_prefix):
+                    continue
+                sched.on_resource_slice_add(t.ResourceSlice(
+                    name=f"slice-{node_name}", driver=op.driver,
+                    pool=node_name, node_name=node_name,
+                    devices=tuple(
+                        t.Device(name=f"device-{d}")
+                        for d in range(per_node)
+                    ),
+                ))
+        elif isinstance(op, W.CreateClaimPodsOp):
+            from ..api.wrappers import make_pod
+
+            count = params[op.count_param]
+            ns = op.namespace
+
+            def claim_pod(name: str, ns: str = ns, op=op) -> t.Pod:
+                sched.on_resource_claim_add(t.ResourceClaim(
+                    name=f"{name}-claim", namespace=ns,
+                    uid=f"{ns}/{name}-claim",
+                    requests=(t.DeviceRequest(
+                        name="req-0", device_class_name=op.class_name,
+                    ),),
+                ))
+                return make_pod(
+                    name, namespace=ns, claims=(f"{name}-claim",),
+                )
+
+            if op.collect_metrics:
+                attempts0, cycles0, lat0 = _begin_measured_phase(
+                    sched, warmup,
+                    [
+                        claim_pod(f"warmup-dra-{j}")
+                        for j in range(min(count, sched.max_batch))
+                    ],
+                )
+            for j in range(count):
+                pod = claim_pod(f"drapod-{op_i}-{j}")
+                created_by_ns.setdefault(ns, []).append(pod)
+                sched.on_pod_add(pod)
+            done, secs = settle(count, (ns,))
+            if op.collect_metrics:
+                measured += done
+                duration += secs
         elif isinstance(op, W.ChurnOp):
             churns.append(_Churn(op=op, namespace=f"churn-{len(churns)}"))
         elif isinstance(op, W.BarrierOp):
@@ -445,7 +501,8 @@ def run_workload(
             params[op.count_param]
             for op in case.ops
             if isinstance(
-                op, (W.CreatePodsWithPVsOp, W.CreateExtendedResourcePodsOp)
+                op, (W.CreatePodsWithPVsOp, W.CreateExtendedResourcePodsOp,
+                     W.CreateClaimPodsOp)
             ) and op.collect_metrics
         ),
         scheduled=measured,

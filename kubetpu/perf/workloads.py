@@ -376,6 +376,46 @@ class CreatePodsWithPVsOp:
     namespace: str | None = None
 
 
+def node_with_dra(i: int, zones: tuple[str, ...] = ()) -> t.Node:
+    """templates/node-with-dra-test-driver.yaml: a default node named to
+    match the driver op's ``nodes: scheduler-perf-dra-*`` selector."""
+    name = f"scheduler-perf-dra-{i}"
+    return make_node(
+        name, cpu_milli=4000, memory=32 * 1024**3, pods=110,
+        labels={HOSTNAME_KEY: name},
+    )
+
+
+@dataclass(frozen=True)
+class CreateResourceDriverOp:
+    """operations.go createResourceDriverOp (dra/performance-config.yaml
+    ``createResourceDriver``): publish the DRA driver's DeviceClass plus one
+    ResourceSlice with ``maxClaimsPerNodeParam`` devices per node matching
+    ``node_prefix`` (the reference's ``nodes: scheduler-perf-dra-*``
+    selector; test driver shape: templates/deviceclass.yaml + per-node
+    slices)."""
+
+    driver: str = "test-driver.cdi.k8s.io"
+    class_name: str = "test-class"
+    max_claims_param: str = "maxClaimsPerNode"
+    node_prefix: str = "scheduler-perf-dra-"
+
+
+@dataclass(frozen=True)
+class CreateClaimPodsOp:
+    """createPods with a ResourceClaimTemplate
+    (dra/performance-config.yaml SchedulingWithResourceClaimTemplate:
+    templates/resourceclaimtemplate.yaml + pod-with-claim-template.yaml):
+    each pod gets its OWN ResourceClaim instance — one request, one device
+    of ``class_name`` — exactly what the resourceclaim controller stamps
+    from the template."""
+
+    count_param: str = "measurePods"
+    class_name: str = "test-class"
+    collect_metrics: bool = False
+    namespace: str = "dra-test"
+
+
 @dataclass(frozen=True)
 class ChurnOp:
     """operations.go:518 churnOp — create (or recreate) interfering objects
@@ -779,6 +819,30 @@ _case(TestCase(
                  {"nodesWithExtendedResource": 5000,
                   "nodesWithoutExtendedResource": 0, "measurePods": 5000},
                  threshold=180, labels=("performance",)),
+    ),
+))
+
+_case(TestCase(
+    name="SchedulingWithResourceClaimTemplate",
+    source="dra/performance-config.yaml:58 (threshold 56, 'typically above 70')",
+    feature_gates=(("DynamicResourceAllocation", True),),
+    ops=(
+        CreateNodesOp("nodesWithoutDRA"),
+        CreateNodesOp("nodesWithDRA", template=node_with_dra),
+        CreateResourceDriverOp(),
+        CreateClaimPodsOp("initPods", namespace="init"),
+        CreateClaimPodsOp("measurePods", collect_metrics=True,
+                          namespace="test"),
+    ),
+    workloads=(
+        Workload("fast", {"nodesWithDRA": 1, "nodesWithoutDRA": 1,
+                          "initPods": 0, "measurePods": 10,
+                          "maxClaimsPerNode": 10}),
+        Workload("5000pods_500nodes",
+                 {"nodesWithDRA": 500, "nodesWithoutDRA": 0,
+                  "initPods": 2500, "measurePods": 2500,
+                  "maxClaimsPerNode": 10},
+                 threshold=56, labels=("performance",)),
     ),
 ))
 

@@ -29,6 +29,7 @@ class EventResource(str, enum.Enum):
     CSI_NODE = "CSINode"
     STORAGE_CLASS = "StorageClass"
     RESOURCE_CLAIM = "ResourceClaim"
+    RESOURCE_SLICE = "ResourceSlice"
     DEVICE_CLASS = "DeviceClass"
     WORKLOAD = "Workload"
     WILDCARD = "*"
@@ -234,6 +235,18 @@ def default_queueing_hints(filter_names: Sequence[str]) -> dict[str, list[HintRe
     add(
         N.NODE_VOLUME_LIMITS,
         ClusterEvent(EventResource.CSI_NODE, ActionType.ADD | ActionType.UPDATE),
+        ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
+    )
+    add(
+        N.DYNAMIC_RESOURCES,
+        # dynamicresources.go EventsToRegister (:245): claim changes (an
+        # allocation/deallocation or the template-instance creation), new
+        # slices/classes (capacity appeared), node adds, pod deletes
+        # (devices freed via the claim's deallocation)
+        ClusterEvent(EventResource.RESOURCE_CLAIM, ActionType.ADD | ActionType.UPDATE | ActionType.DELETE),
+        ClusterEvent(EventResource.RESOURCE_SLICE, ActionType.ADD | ActionType.UPDATE),
+        ClusterEvent(EventResource.DEVICE_CLASS, ActionType.ADD | ActionType.UPDATE),
+        node_add,
         ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
     )
     add(

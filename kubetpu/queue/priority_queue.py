@@ -383,6 +383,21 @@ class PriorityQueue:
                 self._requeue(info)
             self.moved_by_hint += 1
             moved += 1
+        # gated pods (PreEnqueue rejections) re-run their gate when a hint
+        # of the gating plugin fires (the reference keeps them in the
+        # unschedulable pool with the PreEnqueue plugin as rejector, so
+        # moveAllToActiveOrBackoffQueue covers them the same way; e.g. a
+        # ResourceClaim Add un-gates DynamicResources' waiters)
+        for key in list(self._gated):
+            info = self._gated[key]
+            hint = self._hint_for(info, event, old, new)
+            if hint is _QUEUE_SKIP:
+                continue
+            del self._gated[key]
+            self._enqueue_new(info)
+            if not info.gated:
+                self.moved_by_hint += 1
+                moved += 1
         return moved
 
     def flush_unschedulable_leftover(self) -> int:

@@ -117,6 +117,7 @@ def run_extenders(
     pad_pods: int,
     pad_nodes: int,
     parallelism: int = 16,
+    executor: ThreadPoolExecutor | None = None,
 ) -> tuple[np.ndarray | None, np.ndarray | None]:
     """The batch's extender pass: per pod, Filter through every extender in
     order (candidates only shrink), then Prioritize with weight scaling.
@@ -155,6 +156,11 @@ def run_extenders(
             if name not in allowed:
                 mask[i, j] = False
 
-    with ThreadPoolExecutor(max_workers=max(1, parallelism)) as ex:
-        list(ex.map(one, range(len(pods))))
+    if executor is not None:
+        # long-lived pool supplied by the scheduler (the reference reuses
+        # its parallelizer's worker set — no per-cycle thread churn)
+        list(executor.map(one, range(len(pods))))
+    else:
+        with ThreadPoolExecutor(max_workers=max(1, parallelism)) as ex:
+            list(ex.map(one, range(len(pods))))
     return mask, score

@@ -135,9 +135,13 @@ class Scheduler:
         filters = sorted({
             n for prof in self.profiles.values() for n in prof.filters.names()
         })
+        # the DRA PreEnqueue gate only applies when some profile runs the
+        # plugin — otherwise the gating rejector would have no registered
+        # queueing hints and a gated pod could never wake
+        self._dra_enabled = N.DYNAMIC_RESOURCES in filters
         self.queue = PriorityQueue(
             hints=default_queueing_hints(filters),
-            pre_enqueue=[self._scheduling_gates],
+            pre_enqueue=[self._scheduling_gates, self._dra_pre_enqueue],
             clock=clock,
             initial_backoff_seconds=self.cfg.pod_initial_backoff_seconds,
             max_backoff_seconds=self.cfg.pod_max_backoff_seconds,
@@ -170,6 +174,15 @@ class Scheduler:
         from .extender import HTTPExtender
 
         self.extenders = [HTTPExtender(c) for c in self.cfg.extenders]
+        self._extender_pool = None
+        if self.extenders:
+            from concurrent.futures import ThreadPoolExecutor
+
+            # one long-lived worker pool for the per-cycle extender fan-out
+            # (per-cycle executor construction was hot-path thread churn)
+            self._extender_pool = ThreadPoolExecutor(
+                max_workers=max(1, self.cfg.parallelism)
+            )
         from .podgroup import PodGroupManager
 
         self.podgroups = PodGroupManager(
@@ -242,6 +255,19 @@ class Scheduler:
         """SchedulingGates PreEnqueue (plugins/schedulinggates): any
         non-empty spec.schedulingGates holds the pod out of the queue."""
         return N.SCHEDULING_GATES if pod.scheduling_gates else None
+
+    def _dra_pre_enqueue(self, pod: t.Pod) -> str | None:
+        """DynamicResources PreEnqueue (dynamicresources.go:270): every
+        referenced ResourceClaim must exist before the pod may enter the
+        active queue (template instances are created by the resourceclaim
+        controller); a claim Add event re-runs this gate."""
+        if not pod.resource_claims or not self._dra_enabled:
+            return None
+        claims = self.cache.dra.claims
+        for rc in pod.resource_claims:
+            if not rc.claim_name or f"{pod.namespace}/{rc.claim_name}" not in claims:
+                return N.DYNAMIC_RESOURCES
+        return None
 
     def on_node_add(self, node: t.Node) -> None:
         self.cache.add_node(node)
@@ -430,6 +456,62 @@ class Scheduler:
     def on_storage_class_delete(self, sc: t.StorageClass) -> None:
         self.cache.remove_storage_class(sc.name)
 
+    # ------------------------------------------------------- DRA informers
+    def on_resource_claim_add(self, claim: t.ResourceClaim) -> None:
+        self.cache.dra.add_claim(claim)
+        self.queue.on_event(
+            ClusterEvent(EventResource.RESOURCE_CLAIM, ActionType.ADD),
+            None, claim,
+        )
+
+    def on_resource_claim_update(self, old, new: t.ResourceClaim) -> None:
+        self.cache.dra.add_claim(new)
+        self.queue.on_event(
+            ClusterEvent(EventResource.RESOURCE_CLAIM, ActionType.UPDATE),
+            old, new,
+        )
+
+    def on_resource_claim_delete(self, claim: t.ResourceClaim) -> None:
+        self.cache.dra.remove_claim(claim.key)
+        self.queue.on_event(
+            ClusterEvent(EventResource.RESOURCE_CLAIM, ActionType.DELETE),
+            claim, None,
+        )
+
+    def on_resource_slice_add(self, sl: t.ResourceSlice) -> None:
+        self.cache.dra.add_slice(sl)
+        self.queue.on_event(
+            ClusterEvent(EventResource.RESOURCE_SLICE, ActionType.ADD),
+            None, sl,
+        )
+
+    def on_resource_slice_update(self, old, new: t.ResourceSlice) -> None:
+        self.cache.dra.add_slice(new)
+        self.queue.on_event(
+            ClusterEvent(EventResource.RESOURCE_SLICE, ActionType.UPDATE),
+            old, new,
+        )
+
+    def on_resource_slice_delete(self, sl: t.ResourceSlice) -> None:
+        self.cache.dra.remove_slice(sl.name)
+
+    def on_device_class_add(self, dc: t.DeviceClass) -> None:
+        self.cache.dra.add_class(dc)
+        self.queue.on_event(
+            ClusterEvent(EventResource.DEVICE_CLASS, ActionType.ADD),
+            None, dc,
+        )
+
+    def on_device_class_update(self, old, new: t.DeviceClass) -> None:
+        self.cache.dra.add_class(new)
+        self.queue.on_event(
+            ClusterEvent(EventResource.DEVICE_CLASS, ActionType.UPDATE),
+            old, new,
+        )
+
+    def on_device_class_delete(self, dc: t.DeviceClass) -> None:
+        self.cache.dra.remove_class(dc.name)
+
     # ---------------------------------------------------- PodGroup informers
     def on_pod_group_add(self, group: t.PodGroup) -> None:
         """scheduling/v1alpha3 PodGroup informer (gangscheduling.go:109:
@@ -601,6 +683,7 @@ class Scheduler:
             pad_pods=device_batch.requests.shape[0],
             pad_nodes=device_batch.alloc.shape[0],
             parallelism=self.cfg.parallelism,
+            executor=self._extender_pool,
         )
         if ext_mask is not None:
             device_batch = _dc_replace(
@@ -826,3 +909,5 @@ class Scheduler:
     def close(self) -> None:
         self.dispatcher.close()
         self._drain_bind_completions()
+        if self._extender_pool is not None:
+            self._extender_pool.shutdown(wait=False)

@@ -484,6 +484,7 @@ def encode_pod_batch(
     volume_state=None,
     folded_resources: frozenset = frozenset(),
     folded_nominated: Sequence[tuple[str, Sequence[tuple[str, int]]]] = (),
+    dra_state=None,
 ) -> PodBatch:
     """``enabled_filters`` is the profile's Filter plugin set (names from
     ``kubetpu.names``); None enables everything. Disabled static predicates
@@ -511,11 +512,23 @@ def encode_pod_batch(
     # nowhere (no node advertises it: request > 0 - 0); mark them infeasible
     # everywhere instead of silently dropping the request.
     unknown_resource = np.zeros(P, dtype=bool)
+    # DRA (state.dra): per-pod analyses are precomputed+cached by
+    # encode_batch; dense pool requests join the request rows through
+    # columns named "dra/pool<id>" already present in the resource axis
+    want_dra = dra_state is not None and names.DYNAMIC_RESOURCES in f
+    dra_of: dict[int, object] = {}
+    if want_dra:
+        for i, p in enumerate(pods):
+            d = dra_state.analyze(p)
+            if d.any_work:
+                dra_of[i] = d
     # Request rows dedupe heavily across a batch (replicated workloads) —
     # build each distinct (requests, nonzero) row once.
     row_cache: dict[tuple, tuple[np.ndarray, np.ndarray, bool]] = {}
     for i, p in enumerate(pods):
-        key = (p.requests, p.nonzero)
+        d = dra_of.get(i)
+        dense_items = d.dense if d is not None else ()
+        key = (p.requests, p.nonzero, dense_items)
         entry = row_cache.get(key)
         if entry is None:
             req_row = np.zeros(R, dtype=np.int64)
@@ -531,6 +544,11 @@ def encode_pod_batch(
                 j = ridx.get(k)
                 if j is not None:
                     nz_row[j] = v
+            for pid, count in dense_items:
+                j = ridx.get(f"dra/pool{pid}")
+                if j is not None:
+                    req_row[j] = count
+                    nz_row[j] = count
             entry = (req_row, nz_row, unknown)
             row_cache[key] = entry
         requests[i], nonzero[i], unknown_resource[i] = entry
@@ -607,6 +625,10 @@ def encode_pod_batch(
                         if pk in seen_rwop:
                             rwop_dup = True
                         seen_rwop.add(pk)
+        d = dra_of.get(i)
+        dra_sig = (
+            (d.blocked, d.pin, d.host_specs) if d is not None else None
+        )
         sig = (
             _static_filter_signature(p),
             p.node_name if names.NODE_NAME in f else "",
@@ -614,6 +636,7 @@ def encode_pod_batch(
             vol_sig,
             rwop_dup,
             folded_items,
+            dra_sig,
         )
         sid = sig_ids.get(sig)
         if sid is None:
@@ -659,6 +682,21 @@ def encode_pod_batch(
                     m &= vm
             if rwop_dup:
                 m[:] = False
+            if dra_sig is not None:
+                # DynamicResources static contributions (dynamicresources.go
+                # Filter :734): blocked claims reject everywhere; an
+                # allocated claim pins to its node; host-path specs AND in
+                # the exact allocator's per-node feasibility
+                blocked_, pin_, host_specs_ = dra_sig
+                if blocked_:
+                    m[:] = False
+                else:
+                    if pin_:
+                        m &= np.array(
+                            [n == pin_ for n in nt.node_names], dtype=bool
+                        )
+                    for spec in host_specs_:
+                        m &= dra_state.spec_mask(spec, nt)
             if folded_items and names.NODE_RESOURCES_FIT in f:
                 for k, v in folded_items:
                     fm = np.zeros(N, dtype=bool)

@@ -101,6 +101,9 @@ class Snapshot:
     storage_classes: dict[str, "t.StorageClass"] = field(default_factory=dict)
     services: dict[str, "t.Service"] = field(default_factory=dict)  # "ns/name"
     volumes_generation: int = -1
+    # the Cache's DRA index, SHARED by reference (single-owner loop thread:
+    # encode and Reserve both run on it, like the volume listers' dicts)
+    dra: object = None
 
     def node_infos(self) -> list[NodeInfo]:
         return [self.nodes[n] for n in self.node_order]
@@ -133,6 +136,10 @@ class Cache:
         self._storage_classes: dict[str, t.StorageClass] = {}
         self._services: dict[str, t.Service] = {}
         self._volumes_gen = 0  # object-lister generation (pv/pvc/sc/service)
+        from .dra import DraIndex
+
+        # DRA listers + pool/allocation bookkeeping (state.dra.DraIndex)
+        self.dra = DraIndex()
 
     # --- services (the DefaultSelector feed) -----------------------------
     def add_service(self, svc: "t.Service") -> None:
@@ -359,5 +366,6 @@ class Cache:
             snapshot.storage_classes = dict(self._storage_classes)
             snapshot.services = dict(self._services)
             snapshot.volumes_generation = self._volumes_gen
+        snapshot.dra = self.dra
         snapshot.generation = next(self._gen)
         return snapshot

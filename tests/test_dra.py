@@ -1,0 +1,493 @@
+"""Dynamic Resource Allocation — CEL subset, pool tensorization, the exact
+host allocator, the lifecycle half (Reserve/Unreserve/PreBind), and the
+scheduler loop end to end.
+
+Reference semantics under test:
+pkg/scheduler/framework/plugins/dynamicresources/dynamicresources.go
+(PreEnqueue :270, Filter :734, Reserve :1146, Unreserve :1255,
+PreBind :1334, Score :1059) and
+staging/src/k8s.io/dynamic-resource-allocation/structured/allocator.go
+(selectors, ExactCount/All, matchAttribute constraints, firstAvailable).
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.assign import greedy_assign
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch
+from kubetpu.state import Cache
+from kubetpu.state.dra import CelUnsupportedError, DraIndex, parse_cel
+
+from .test_scheduler import FakeClient, make_sched
+
+DRIVER = "test-driver.cdi.k8s.io"
+
+
+def gpu_class(name="gpu", driver=DRIVER):
+    return t.DeviceClass(
+        name, selectors=(t.CELSelector(f'device.driver == "{driver}"'),)
+    )
+
+
+def node_slice(node, n_devices, driver=DRIVER, attrs=()):
+    return t.ResourceSlice(
+        name=f"slice-{node}", driver=driver, pool=node, node_name=node,
+        devices=tuple(
+            t.Device(f"dev-{j}", attributes=tuple(attrs))
+            for j in range(n_devices)
+        ),
+    )
+
+
+def one_device_claim(name, class_name="gpu", ns="default", count=1):
+    return t.ResourceClaim(
+        name=name, namespace=ns, uid=f"{ns}/{name}",
+        requests=(t.DeviceRequest(
+            name="req-0", device_class_name=class_name, count=count,
+        ),),
+    )
+
+
+def dra_profile():
+    return C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.DYNAMIC_RESOURCES, 1),
+        )),
+        scores=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), (C.DYNAMIC_RESOURCES, 1),
+        )),
+        default_spread_constraints=(),
+    )
+
+
+# ---------------------------------------------------------------- CEL subset
+
+def test_parse_cel_driver_and_attributes():
+    terms = parse_cel(
+        f'device.driver == "{DRIVER}" && '
+        'device.attributes["vendor.example.com"].model == "A100" && '
+        'device.capacity["vendor.example.com"].memory >= 8'
+    )
+    assert ("driver", "", "==", DRIVER) in terms
+    assert ("attr", "vendor.example.com.model", "==", "A100") in terms
+    assert ("cap", "vendor.example.com.memory", ">=", 8) in terms
+
+
+def test_parse_cel_rejects_outside_subset():
+    with pytest.raises(CelUnsupportedError):
+        parse_cel('device.driver.matches("^test-.*$")')
+    with pytest.raises(CelUnsupportedError):
+        parse_cel('device.driver == "a" || device.driver == "b"')
+
+
+def test_unparseable_class_blocks_claims():
+    idx = DraIndex()
+    idx.add_class(t.DeviceClass(
+        "weird", selectors=(t.CELSelector("device.driver in foo"),)
+    ))
+    idx.add_slice(node_slice("n0", 2))
+    probe = one_device_claim("c0", class_name="weird")
+    assert idx.allocate_on_node([probe], "n0") is None
+
+
+# ------------------------------------------------------------ host allocator
+
+def test_allocate_exact_count_and_exhaustion():
+    idx = DraIndex()
+    idx.add_class(gpu_class())
+    idx.add_slice(node_slice("n0", 2))
+    c1, c2, c3 = (one_device_claim(f"c{i}") for i in range(3))
+    idx.add_claim(c1)
+    idx.add_claim(c2)
+    idx.add_claim(c3)
+    a = idx.allocate_on_node([c1], "n0")
+    assert a is not None and len(a[0].results) == 1
+    idx.set_allocation(c1.key, a[0], "pod-1")
+    a2 = idx.allocate_on_node([c2], "n0")
+    assert a2 is not None
+    assert a2[0].results[0].device != a[0].results[0].device
+    idx.set_allocation(c2.key, a2[0], "pod-2")
+    assert idx.allocate_on_node([c3], "n0") is None  # pool exhausted
+    # releasing c1 frees its device again
+    idx.clear_allocation(c1.key)
+    assert idx.allocate_on_node([c3], "n0") is not None
+
+
+def test_allocate_all_mode_takes_every_matching_device():
+    idx = DraIndex()
+    idx.add_class(gpu_class())
+    idx.add_slice(node_slice("n0", 3))
+    claim = t.ResourceClaim(
+        name="all", uid="u-all",
+        requests=(t.DeviceRequest(
+            name="req-0", device_class_name="gpu", all_devices=True,
+        ),),
+    )
+    idx.add_claim(claim)
+    a = idx.allocate_on_node([claim], "n0")
+    assert a is not None and len(a[0].results) == 3
+
+
+def test_allocate_match_attribute_constraint():
+    """matchAttribute: both requests' devices must share the memory attr;
+    only the 8Gi pair can satisfy count=2 across requests."""
+    idx = DraIndex()
+    idx.add_class(gpu_class())
+    devices = (
+        t.Device("d0", attributes=(("vendor/mem", 4),)),
+        t.Device("d1", attributes=(("vendor/mem", 8),)),
+        t.Device("d2", attributes=(("vendor/mem", 8),)),
+    )
+    idx.add_slice(t.ResourceSlice(
+        name="s0", driver=DRIVER, pool="p0", node_name="n0", devices=devices,
+    ))
+    claim = t.ResourceClaim(
+        name="c", uid="u-c",
+        requests=(
+            t.DeviceRequest(name="a", device_class_name="gpu"),
+            t.DeviceRequest(name="b", device_class_name="gpu"),
+        ),
+        constraints=(t.DeviceConstraint(match_attribute="vendor/mem"),),
+    )
+    idx.add_claim(claim)
+    a = idx.allocate_on_node([claim], "n0")
+    assert a is not None
+    got = sorted(r.device for r in a[0].results)
+    assert got == ["d1", "d2"]
+
+
+def test_allocate_two_independent_match_attribute_constraints():
+    """Two matchAttribute constraints pin INDEPENDENTLY: the pair sharing
+    both version and model is the only valid choice."""
+    idx = DraIndex()
+    idx.add_class(gpu_class())
+    devices = (
+        t.Device("d0", attributes=(("ver", "1"), ("model", "A"))),
+        t.Device("d1", attributes=(("ver", "2"), ("model", "A"))),
+        t.Device("d2", attributes=(("ver", "2"), ("model", "A"))),
+        t.Device("d3", attributes=(("ver", "2"), ("model", "B"))),
+    )
+    idx.add_slice(t.ResourceSlice(
+        name="s0", driver=DRIVER, pool="p0", node_name="n0", devices=devices,
+    ))
+    claim = t.ResourceClaim(
+        name="c", uid="u-c",
+        requests=(
+            t.DeviceRequest(name="a", device_class_name="gpu"),
+            t.DeviceRequest(name="b", device_class_name="gpu"),
+        ),
+        constraints=(
+            t.DeviceConstraint(match_attribute="ver"),
+            t.DeviceConstraint(match_attribute="model"),
+        ),
+    )
+    idx.add_claim(claim)
+    a = idx.allocate_on_node([claim], "n0")
+    assert a is not None
+    got = sorted(r.device for r in a[0].results)
+    assert got == ["d1", "d2"]
+
+
+def test_allocate_first_available_prefers_earlier_alternative():
+    idx = DraIndex()
+    idx.add_class(gpu_class("big"))
+    idx.add_class(gpu_class("small"))
+    # only devices matching "small"'s extra selector exist
+    idx.add_slice(t.ResourceSlice(
+        name="s0", driver=DRIVER, pool="p0", node_name="n0",
+        devices=(t.Device("d0", attributes=(("kind", "small"),)),),
+    ))
+    claim = t.ResourceClaim(
+        name="c", uid="u-c",
+        requests=(t.DeviceRequest(
+            name="req",
+            first_available=(
+                t.DeviceSubRequest(
+                    name="want-big", device_class_name="big",
+                    selectors=(t.CELSelector(
+                        'device.attributes["kind"] == "big"'
+                    ),),
+                ),
+                t.DeviceSubRequest(
+                    name="want-small", device_class_name="small",
+                ),
+            ),
+        ),),
+    )
+    idx.add_claim(claim)
+    a = idx.allocate_on_node([claim], "n0")
+    assert a is not None
+    assert a[0].results[0].request == "req/want-small"
+
+
+def test_network_attached_devices_allocatable_from_any_node():
+    idx = DraIndex()
+    idx.add_class(gpu_class())
+    idx.add_slice(t.ResourceSlice(
+        name="net", driver=DRIVER, pool="shared", all_nodes=True,
+        devices=(t.Device("d0"),),
+    ))
+    c = one_device_claim("c0")
+    idx.add_claim(c)
+    a = idx.allocate_on_node([c], "n7")
+    assert a is not None
+    idx.set_allocation(c.key, a[0], "pod-1")
+    # consumed globally: no other node can take it
+    c2 = one_device_claim("c1")
+    idx.add_claim(c2)
+    assert idx.allocate_on_node([c2], "n8") is None
+
+
+# ------------------------------------------------- dense pool tensorization
+
+def test_dense_pool_columns_feed_the_fit_kernel():
+    cache = Cache()
+    cache.dra.add_class(gpu_class())
+    for i in range(2):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000))
+    cache.dra.add_slice(node_slice("n0", 2))  # only n0 has devices
+    claims = [one_device_claim(f"c{j}") for j in range(3)]
+    for c in claims:
+        cache.dra.add_claim(c)
+    pods = [
+        make_pod(f"p{j}", cpu_milli=100, claims=[f"c{j}"])
+        for j in range(3)
+    ]
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, pods, dra_profile())
+    assert any(r.startswith("dra/pool") for r in batch.resource_names)
+    got = greedy_assign(batch, dra_profile())
+    # 2 devices on n0: two pods land there, the third has no node
+    assert got.count("n0") == 2 and got.count(None) == 1
+
+
+def test_allocated_claim_pins_pod_to_its_node():
+    cache = Cache()
+    cache.dra.add_class(gpu_class())
+    for i in range(3):
+        cache.add_node(make_node(f"n{i}", cpu_milli=4000))
+        cache.dra.add_slice(node_slice(f"n{i}", 1))
+    c = one_device_claim("c0")
+    cache.dra.add_claim(c)
+    a = cache.dra.allocate_on_node([c], "n2")
+    cache.dra.set_allocation(c.key, a[0], "other-pod-uid")
+    pod = make_pod("p0", cpu_milli=100, claims=["c0"])
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], dra_profile())
+    got = greedy_assign(batch, dra_profile())
+    assert got == ["n2"]
+
+
+def test_missing_claim_blocks_everywhere():
+    cache = Cache()
+    cache.add_node(make_node("n0", cpu_milli=4000))
+    pod = make_pod("p0", cpu_milli=100, claims=["nope"])
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], dra_profile())
+    assert greedy_assign(batch, dra_profile()) == [None]
+
+
+def test_prioritized_list_score_prefers_earlier_alternative_node():
+    """Two nodes both feasible; the node satisfying the FIRST alternative
+    scores higher (computeScore: FIRST_AVAILABLE_MAX - index)."""
+    cache = Cache()
+    cache.dra.add_class(gpu_class("fast-gpu"))
+    cache.dra.add_class(gpu_class("slow-gpu"))
+    # n-slow FIRST: the first-max tie-break must not be what picks n-fast —
+    # only the DRA score can
+    cache.add_node(make_node("n-slow", cpu_milli=4000))
+    cache.add_node(make_node("n-fast", cpu_milli=4000))
+    cache.dra.add_slice(t.ResourceSlice(
+        name="sf", driver=DRIVER, pool="pf", node_name="n-fast",
+        devices=(t.Device("d0", attributes=(("kind", "fast"),)),),
+    ))
+    cache.dra.add_slice(t.ResourceSlice(
+        name="ss", driver=DRIVER, pool="ps", node_name="n-slow",
+        devices=(t.Device("d0", attributes=(("kind", "slow"),)),),
+    ))
+    claim = t.ResourceClaim(
+        name="c0", uid="u0",
+        requests=(t.DeviceRequest(
+            name="req",
+            first_available=(
+                t.DeviceSubRequest(
+                    name="fast", device_class_name="fast-gpu",
+                    selectors=(t.CELSelector(
+                        'device.attributes["kind"] == "fast"'
+                    ),),
+                ),
+                t.DeviceSubRequest(
+                    name="slow", device_class_name="slow-gpu",
+                ),
+            ),
+        ),),
+    )
+    cache.dra.add_claim(claim)
+    pod = make_pod("p0", cpu_milli=100, claims=["c0"])
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], dra_profile())
+    got = greedy_assign(batch, dra_profile())
+    assert got == ["n-fast"]
+
+
+# ------------------------------------------------------- scheduler lifecycle
+
+def dra_sched(client=None, nodes=2, devices_per_node=2):
+    s, clock = make_sched(client, profile=dra_profile())
+    s.on_device_class_add(gpu_class())
+    for i in range(nodes):
+        s.on_node_add(make_node(f"n{i}", cpu_milli=8000))
+        s.on_resource_slice_add(node_slice(f"n{i}", devices_per_node))
+    return s, clock
+
+
+def test_scheduler_allocates_claims_end_to_end():
+    client = FakeClient()
+    client.claim_updates = []
+    client.update_claim_status = (
+        lambda claim: client.claim_updates.append(claim)
+    )
+    s, _ = dra_sched(client)
+    for j in range(5):
+        s.on_resource_claim_add(one_device_claim(f"c{j}"))
+        s.on_pod_add(make_pod(f"p{j}", cpu_milli=100, claims=[f"c{j}"]))
+    total = s.run_until_idle()
+    assert total == 4   # 2 nodes x 2 devices
+    allocated = [
+        c for c in s.cache.dra.claims.values() if c.allocation is not None
+    ]
+    assert len(allocated) == 4
+    for c in allocated:
+        assert len(c.reserved_for) == 1
+    # PreBind pushed the claim-status writes through the dispatcher
+    assert len(client.claim_updates) == 4
+    # in-memory device accounting matches
+    used = sum(len(v) for v in s.cache.dra.allocated_devices.values())
+    assert used == 4
+
+
+def test_pod_delete_then_claim_release_requeues_waiter():
+    """The 5th pod waits; deleting a scheduled pod AND deallocating its
+    claim (the resourceclaim controller's job) frees a device and the
+    claim event wakes the waiter."""
+    client = FakeClient()
+    s, clock = dra_sched(client)
+    pods = {}
+    for j in range(5):
+        s.on_resource_claim_add(one_device_claim(f"c{j}"))
+        pods[j] = make_pod(f"p{j}", cpu_milli=100, claims=[f"c{j}"])
+        s.on_pod_add(pods[j])
+    assert s.run_until_idle() == 4
+    # victim: pod p0 (bound) goes away; controller clears its claim
+    bound_node = client.bound["default/p0"]
+    s.on_pod_delete(pods[0].with_node(bound_node))
+    released = s.cache.dra.claims["default/c0"]
+    s.on_resource_claim_update(
+        released,
+        t.ResourceClaim(
+            name="c0", uid="default/c0",
+            requests=released.requests,
+        ),
+    )
+    clock.tick(31)   # leftover flush / backoff expiry
+    assert s.run_until_idle() == 1
+    assert "default/p4" in client.bound
+
+
+def test_reserve_conflict_on_shared_pool_requeues():
+    """Two pods racing for the SAME single shared claim: one binds, the
+    other re-reserves the already-allocated claim on the same node (claims
+    are shareable, reservedFor grows)."""
+    client = FakeClient()
+    s, clock = dra_sched(client, nodes=1, devices_per_node=1)
+    s.on_resource_claim_add(one_device_claim("shared"))
+    s.on_pod_add(make_pod("p0", cpu_milli=100, claims=["shared"]))
+    s.on_pod_add(make_pod("p1", cpu_milli=100, claims=["shared"]))
+    total = s.run_until_idle()
+    clock.tick(2)   # the loser sits out its backoff, woken by the claim event
+    total += s.run_until_idle()
+    assert total == 2
+    claim = s.cache.dra.claims["default/shared"]
+    assert claim.allocation is not None
+    assert len(claim.reserved_for) == 2
+
+
+def test_unreserve_keeps_shared_claim_alive_for_co_reserver():
+    """A allocated shared claim C; B then reserved the already-allocated C
+    (sharers join via reservedFor). A's Unreserve must only drop A's entry
+    — B's reservation AND the allocation B relies on survive."""
+    from kubetpu.framework.dynamicresources import DynamicResourcesPlugin
+
+    client = FakeClient()
+    s, _ = dra_sched(client, nodes=1, devices_per_node=1)
+    s.on_resource_claim_add(one_device_claim("shared"))
+    plug = DynamicResourcesPlugin()
+    pa = make_pod("pa", cpu_milli=100, claims=["shared"])
+    pb = make_pod("pb", cpu_milli=100, claims=["shared"])
+    assert plug.reserve(s, pa, "n0").ok        # allocates C on n0
+    assert plug.reserve(s, pb, "n0").ok        # joins the reservation
+    plug.unreserve(s, pa, "n0")                # A's bind failed
+    claim = s.cache.dra.claims["default/shared"]
+    assert claim.allocation is not None
+    assert claim.reserved_for == ("default/pb",)
+    # the device is still accounted as consumed
+    assert sum(len(v) for v in s.cache.dra.allocated_devices.values()) == 1
+
+
+def test_unreserve_on_bind_failure_releases_devices():
+    client = FakeClient(fail_binds_for=("default/p0",))
+    s, clock = dra_sched(client, nodes=1, devices_per_node=1)
+    s.on_resource_claim_add(one_device_claim("c0"))
+    s.on_pod_add(make_pod("p0", cpu_milli=100, claims=["c0"]))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()   # bind fails -> Unreserve -> deallocate
+    assert s.cache.dra.claims["default/c0"].allocation is None
+    assert not s.cache.dra.allocated_devices
+    clock.tick(11)
+    assert s.run_until_idle() == 1   # retried and bound
+    assert s.cache.dra.claims["default/c0"].allocation is not None
+
+
+def test_pre_enqueue_gates_until_claim_exists():
+    client = FakeClient()
+    s, _ = dra_sched(client)
+    s.on_pod_add(make_pod("p0", cpu_milli=100, claims=["later"]))
+    assert s.queue.stats()["gated"] == 1
+    assert s.run_until_idle() == 0
+    s.on_resource_claim_add(one_device_claim("later"))
+    assert s.run_until_idle() == 1
+
+
+def test_in_batch_contention_matches_sequential_oracle():
+    """One batch of 6 pods over 2 nodes x 2 devices: the capacity-coupled
+    engines must schedule exactly 4 — the same outcome as the reference's
+    per-pod loop."""
+    for engine in ("greedy", "batched"):
+        client = FakeClient()
+        s, _ = make_sched(client, profile=dra_profile(), engine=engine)
+        s.on_device_class_add(gpu_class())
+        for i in range(2):
+            s.on_node_add(make_node(f"n{i}", cpu_milli=8000))
+            s.on_resource_slice_add(node_slice(f"n{i}", 2))
+        for j in range(6):
+            s.on_resource_claim_add(one_device_claim(f"c{j}"))
+            s.on_pod_add(make_pod(f"p{j}", cpu_milli=100, claims=[f"c{j}"]))
+        assert s.run_until_idle() == 4, engine
+        per_node = {}
+        for node in client.bound.values():
+            per_node[node] = per_node.get(node, 0) + 1
+        assert per_node == {"n0": 2, "n1": 2}, engine
+
+
+def test_perf_case_fast_schedules_everything():
+    from kubetpu.perf.runner import run_workload
+
+    r = run_workload(
+        "SchedulingWithResourceClaimTemplate", "fast", timeout_s=120,
+    )
+    assert r.scheduled == r.measure_pods == 10
