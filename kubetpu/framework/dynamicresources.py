@@ -141,7 +141,7 @@ class DynamicResourcesPlugin(lc.LifecyclePlugin):
             return lc.Status()
         _allocated, keys = entry
         index = handle.cache.dra
-        client = getattr(handle.dispatcher, "_client", None)
+        client = handle.dispatcher.client
         update = getattr(client, "update_claim_status", None)
         if update is not None:
             for key in keys:

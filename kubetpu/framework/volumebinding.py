@@ -114,7 +114,7 @@ class VolumeBindingPlugin(lc.LifecyclePlugin):
         picks = self._assumed.pop(f"{pod.namespace}/{pod.name}", None)
         if not picks:
             return lc.Status()
-        client = getattr(handle.dispatcher, "_client", None)
+        client = handle.dispatcher.client
         bind_pvc = getattr(client, "bind_pvc", None)
         for pvc, pv_name in picks:
             if bind_pvc is not None:

@@ -9,4 +9,11 @@ node-sharded; per-pod tensors are replicated. XLA inserts the collectives
 (the per-pod argmax becomes a cross-shard max reduction over ICI).
 """
 
-from .mesh import make_mesh, shard_batch, sharded_batched, sharded_greedy  # noqa: F401
+from .mesh import (  # noqa: F401
+    make_mesh,
+    make_mesh_2d,
+    make_multislice_mesh,
+    shard_batch,
+    sharded_batched,
+    sharded_greedy,
+)

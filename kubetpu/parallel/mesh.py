@@ -1,26 +1,35 @@
-"""Node-axis sharding over a device mesh.
+"""Device-mesh sharding: node axis, pod axis, and multi-slice (DCN).
 
-Sharding layout (the "tensor parallel" analog for a scheduling problem —
-SURVEY §2.10):
+Sharding layout (SURVEY §2.10's parallelism mapping):
 
-- ``(N, …)`` node tensors (alloc, requested, node_ports, …): sharded on axis
-  0 over mesh axis ``"nodes"``.
-- ``(P, N)`` pod×node tensors (static_mask, raw scores): sharded on axis 1.
-- ``(P, …)`` pod tensors and the tiny ``(K, K)`` port-conflict matrix:
-  replicated.
+- **Node axis ("tensor parallel" analog)**: ``(N, …)`` node tensors (alloc,
+  requested, node_ports, …) shard axis 0 over mesh axis ``"nodes"``;
+  ``(S, N)`` signature×node tensors shard axis 1. With these placements the
+  engines run unchanged: filter+score work is local to a node shard, and XLA
+  turns the ``argmax``/``any``/sort reductions into ICI collectives.
+- **Pod axis (the 2nd mesh axis — the pairwise-kernel shard)**: ``(P, …)``
+  per-pod tensors (requests, pod_ports, the spread/podaffinity per-pod term
+  rows) shard over mesh axis ``"pods"``, and ``(P, N)`` tensors shard BOTH
+  axes. This is the map for the quadratic InterPodAffinity composition: each
+  device owns a (pod-block × node-block) tile of the interaction, the
+  reference's O(pods×nodes) PreScore loop
+  (interpodaffinity/scoring.go:81 processExistingPod) becomes a 2-D-tiled
+  tensor contraction. The batched engine is fully SPMD under this layout
+  (every round is elementwise over the (P, N) tile + cross-shard sort);
+  the greedy scan stays legal but gathers one pod row per step, so the 2-D
+  mesh pays off with the batched engine.
+- **Multi-slice (DCN)**: ``make_multislice_mesh`` builds axes
+  ``("dcn", "nodes")`` and shards the NODE axis over both — hierarchical
+  node sharding where the inner factor rides ICI and the outer factor DCN.
+  Scores/argmax reduce slice-locally first (ICI), then across slices (DCN) —
+  exactly the two-level reduction the scaling-book recipe prescribes; no
+  engine change, only the axis tuple differs.
 
-With these placements ``greedy_assign_device`` runs unchanged: each step's
-filter+score work is local to a node shard, and XLA turns the
-``argmax``/``any`` reductions into ICI collectives. The carried scan state
-(requested/nonzero/pod_count/node_ports) stays node-sharded across steps, so
-per-step communication is O(1) scalars, not O(N) tensors — the same reason
-the reference keeps binding async and its cycle serialized
-(schedule_one.go:141): the sequential dependency is on a tiny decision, not
-on bulk state.
-
-Multi-slice (DCN) note: a second mesh axis over slices shards nodes
-hierarchically; the layout below is axis-count agnostic (everything shards
-over ALL axes named in ``axis``).
+The carried scan/round state (requested/nonzero/pod_count/node_ports) stays
+node-sharded across steps, so per-step communication is O(1) scalars, not
+O(N) tensors — the same reason the reference keeps binding async and its
+cycle serialized (schedule_one.go:141): the sequential dependency is on a
+tiny decision, not on bulk state.
 """
 
 from __future__ import annotations
@@ -33,84 +42,178 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..framework import runtime as rt
 
+Axis = "str | tuple[str, ...]"
+
 
 def make_mesh(devices: Sequence[jax.Device] | None = None, axis: str = "nodes") -> Mesh:
     devs = list(devices) if devices is not None else jax.devices()
     return Mesh(np.array(devs), (axis,))
 
 
-def _spec_for(field: str, axis: str) -> P:
-    # (N, ...) node-major tensors
-    if field in ("alloc", "requested", "nonzero_requested", "pod_count",
-                 "allowed_pods", "node_valid", "node_ports"):
-        return P(axis)
-    # (P|S, N) pod/signature × node tensors — shard the node axis
-    if field in ("static_mask", "node_affinity_raw", "taint_prefer_raw",
-                 "image_sum_scores", "extender_mask", "extender_score",
-                 "dra_score_raw"):
-        return P(None, axis)
-    # per-pod tensors + port conflict matrix — replicated
-    return P()
+def _mesh_2axes(
+    devices: Sequence[jax.Device] | None, outer: int,
+    axis_names: tuple[str, str],
+) -> Mesh:
+    devs = list(devices) if devices is not None else jax.devices()
+    if len(devs) % outer:
+        raise ValueError(
+            f"{len(devs)} devices do not split into "
+            f"{axis_names[0]}={outer}"
+        )
+    return Mesh(np.array(devs).reshape(outer, len(devs) // outer), axis_names)
 
 
-# Quadratic-kernel pytrees (the tensors the TPU story scales on): every
-# ``(…, N)`` leaf shards its node axis; per-pod / per-domain leaves are small
-# and replicated. SpreadDevice: eligible/node_domain/node_count/has_key are
-# (S, N), ignored is (P, N). PodAffinityDevice: node_domain/has_key are
-# (R, N); base_sums (R, D) stays replicated — domain counts are the
-# cross-shard reduction target, XLA materializes them via psum-style
-# collectives when the segment sums run.
-_NESTED_NODE_LAST = {
-    "spread": ("eligible", "node_domain", "node_count", "has_key", "ignored"),
-    "podaffinity": ("node_domain", "has_key"),
+def make_mesh_2d(
+    devices: Sequence[jax.Device] | None = None,
+    pods: int = 2,
+    axis_names: tuple[str, str] = ("pods", "nodes"),
+) -> Mesh:
+    """A (pods × nodes) mesh: ``pods`` devices along the pod axis, the rest
+    along the node axis. Map the SMALLER factor to the pod axis — node count
+    dominates the tensors."""
+    return _mesh_2axes(devices, pods, axis_names)
+
+
+def make_multislice_mesh(
+    devices: Sequence[jax.Device] | None = None,
+    slices: int = 2,
+    axis_names: tuple[str, str] = ("dcn", "nodes"),
+) -> Mesh:
+    """A (slices × per-slice) mesh whose BOTH axes shard the node dimension
+    (pass its axis_names tuple as ``axis`` to the sharded entry points).
+    On real hardware the outer axis crosses DCN; devices must be ordered
+    slice-major so the inner axis stays on ICI."""
+    return _mesh_2axes(devices, slices, axis_names)
+
+
+# DeviceBatch leaves by shape family. (P, N) leaves shard both axes when a
+# pod axis is present; (S, N) signature tables are NOT pod-aligned and only
+# ever shard their node axis.
+_NODE_MAJOR = frozenset({
+    "alloc", "requested", "nonzero_requested", "pod_count", "allowed_pods",
+    "node_valid", "node_ports",
+})
+_SIG_NODE_LAST = frozenset({
+    "static_mask", "node_affinity_raw", "taint_prefer_raw",
+    "image_sum_scores", "dra_score_raw",
+})
+_POD_NODE = frozenset({"extender_mask", "extender_score"})
+_POD_MAJOR = frozenset({
+    "requests", "nonzero_requests", "pod_valid", "static_sig", "score_sig",
+    "image_sig", "image_count", "pod_ports", "nominated_gate",
+    "dra_score_sig",
+})
+
+# Nested quadratic-kernel pytrees. SpreadDevice: eligible/node_domain/
+# node_count/has_key are (S, N); ignored is (P, N); sig_idx/action/max_skew/
+# min_domains/self_match/pod_match_sig are per-pod term rows. base_sums /
+# domain_present (…, D) stay replicated — domain counts are the cross-shard
+# reduction target, XLA materializes them via psum-style collectives when
+# the segment sums run.
+_NESTED = {
+    "spread": dict(
+        node_last=("eligible", "node_domain", "node_count", "has_key"),
+        pod_node=("ignored",),
+        pod_major=("sig_idx", "action", "max_skew", "min_domains",
+                   "self_match", "pod_match_sig"),
+    ),
+    "podaffinity": dict(
+        node_last=("node_domain", "has_key"),
+        pod_node=(),
+        pod_major=("update", "fa_rows", "fa_self", "ra_rows", "ea_rows",
+                   "score_rows", "score_vals"),
+    ),
 }
 
 
-def shard_batch(b: rt.DeviceBatch, mesh: Mesh, axis: str = "nodes") -> rt.DeviceBatch:
-    """Place every leaf with its node-axis sharding. The padded node count
-    must divide the mesh size (encode_batch pads to ≥8).
+def _spec_for(field: str, node_axis, pod_axis) -> P:
+    if field in _NODE_MAJOR:
+        return P(node_axis)
+    if field in _SIG_NODE_LAST:
+        return P(None, node_axis)
+    if field in _POD_NODE:
+        return P(pod_axis, node_axis)
+    if field in _POD_MAJOR and pod_axis is not None:
+        return P(pod_axis)
+    return P()
 
-    Registered-dataclass pytree flattening already excludes ``None`` leaves
-    and static metadata fields, so one sharding pytree + one ``device_put``
-    covers the whole batch, nested quadratic-kernel pytrees included.
+
+def shard_batch(
+    b: rt.DeviceBatch, mesh: Mesh, axis: Axis = "nodes",
+    pod_axis: str | None = None,
+) -> rt.DeviceBatch:
+    """Place every leaf with its mesh sharding. The padded node count must
+    divide the node-axis size, and (when ``pod_axis`` is given) the padded
+    pod count must divide the pod-axis size (encode_batch pads both to ≥8).
+
+    ``axis`` may be a tuple (multi-slice: the node dimension shards over
+    all named axes). Registered-dataclass pytree flattening already excludes
+    ``None`` leaves and static metadata fields, so one sharding pytree + one
+    ``device_put`` covers the whole batch, nested quadratic-kernel pytrees
+    included.
     """
 
     def spec(path, leaf) -> NamedSharding:
         names = [p.name for p in path if hasattr(p, "name")]
         field = names[-1]
         parent = names[-2] if len(names) > 1 else None
-        if parent in _NESTED_NODE_LAST:
-            s = P(None, axis) if field in _NESTED_NODE_LAST[parent] else P()
+        nested = _NESTED.get(parent)
+        if nested is not None:
+            if field in nested["node_last"]:
+                s = P(None, axis)
+            elif field in nested["pod_node"]:
+                s = P(pod_axis, axis)
+            elif field in nested["pod_major"] and pod_axis is not None:
+                s = P(pod_axis)
+            else:
+                s = P()
         else:
-            s = _spec_for(field, axis)
+            s = _spec_for(field, axis, pod_axis)
         return NamedSharding(mesh, s)
 
     shardings = jax.tree_util.tree_map_with_path(spec, b)
     return jax.device_put(b, shardings)
 
 
+def _axes_of(mesh: Mesh, axis, pod_axis):
+    """Infer (node_axis, pod_axis) from the mesh when defaults are passed:
+    a mesh with a "pods" axis engages the pod shard; a multi-axis mesh
+    without one shards nodes over ALL axes (multi-slice)."""
+    names = tuple(mesh.axis_names)
+    if pod_axis is None and "pods" in names:
+        pod_axis = "pods"
+    if axis == "nodes" and "nodes" not in names:
+        axis = names if len(names) > 1 else names[0]
+    elif axis == "nodes" and len(names) > 1 and pod_axis is None:
+        axis = names  # multi-slice: every axis shards the node dim
+    return axis, pod_axis
+
+
 def sharded_greedy(
-    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: str = "nodes"
+    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: Axis = "nodes",
+    pod_axis: str | None = None,
 ):
     """Shard the batch and run the greedy scan under the mesh; XLA inserts
     the cross-shard reductions."""
     from ..assign.greedy import greedy_assign_device
 
-    sb = shard_batch(b, mesh, axis)
+    axis, pod_axis = _axes_of(mesh, axis, pod_axis)
+    sb = shard_batch(b, mesh, axis, pod_axis)
     return greedy_assign_device(sb, params)
 
 
 def sharded_batched(
-    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: str = "nodes",
-    max_rounds: int = 0,
+    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: Axis = "nodes",
+    max_rounds: int = 0, pod_axis: str | None = None,
 ):
     """Shard the batch and run the capacity-coupled round engine
     (assign.batched) under the mesh. Each round's (P, N) filter+score is
-    node-shard-local; the tie-spread argmax and one-per-node acceptance sort
-    become cross-shard collectives XLA inserts from the shardings — the
-    engine body is unchanged (SPMD via sharding annotations, not explicit
-    communication)."""
+    shard-local (2-D-tiled when the mesh has a pod axis); the tie-spread
+    argmax and one-per-node acceptance sort become cross-shard collectives
+    XLA inserts from the shardings — the engine body is unchanged (SPMD via
+    sharding annotations, not explicit communication)."""
     from ..assign.batched import batched_assign_device
 
-    sb = shard_batch(b, mesh, axis)
+    axis, pod_axis = _axes_of(mesh, axis, pod_axis)
+    sb = shard_batch(b, mesh, axis, pod_axis)
     return batched_assign_device(sb, params, max_rounds=max_rounds)

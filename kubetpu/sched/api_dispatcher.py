@@ -169,6 +169,13 @@ class APIDispatcher:
                 th.start()
                 self._threads.append(th)
 
+    @property
+    def client(self) -> Any:
+        """The API client the dispatcher writes through — the public handle
+        lifecycle plugins use for their own API writes (PreBind's PV/claim
+        status patches)."""
+        return self._client
+
     def add(self, call: APICall) -> None:
         if self._workers == 0 or self._closed:
             self._execute(call)  # inline: no pool, or pool already drained

@@ -333,16 +333,19 @@ class DraIndex:
         if pid is None:
             pid = len(self._pools)
             self._pool_ids[key] = pid
+            # extra_terms None = unparseable request CEL: the pool stays
+            # permanently invalid (ensure_pool re-derives valid from it, so
+            # the marker must survive interning)
             self._pools.append(
-                _Pool(class_name=class_name, extra_terms=extra or ())
+                _Pool(class_name=class_name, extra_terms=extra)
             )
-            if extra is None:
-                self._pools[pid].valid = False
         return pid
 
     def _pool_device_matches(
         self, pool: _Pool, driver: str, device: t.Device
     ) -> bool:
+        if pool.extra_terms is None:
+            return False   # unparseable request CEL — matches nothing
         cls_terms = self.class_terms(pool.class_name)
         if cls_terms is None:
             return False
@@ -492,7 +495,12 @@ class DraIndex:
             picked: list[t.DeviceResult] = []
             local_taken: set[_DevKey] = set()
 
-            def req_candidates(req_name, class_name, selectors):
+            def req_candidates(names, class_name, selectors):
+                """``names``: every name this request answers to for
+                constraint membership — the parent request name AND (for a
+                prioritized-list alternative) the "parent/sub" form, per
+                resource.k8s.io/v1: a constraint naming the main request
+                covers its subrequests."""
                 cands = self._candidates(class_name, selectors, free, taken)
                 if cands is None:
                     return None
@@ -502,7 +510,7 @@ class DraIndex:
                         continue
                     ok = True
                     for attr, reqs in constraint_attrs:
-                        if reqs and req_name not in reqs:
+                        if reqs and reqs.isdisjoint(names):
                             continue
                         pin = attr_pin.get(attr)
                         if pin is not None and dev.attributes_dict().get(attr) != pin:
@@ -533,20 +541,19 @@ class DraIndex:
                 if req.first_available:
                     done = False
                     for i, sub in enumerate(req.first_available):
+                        full = f"{req.name}/{sub.name}"
                         cands = req_candidates(
-                            f"{req.name}/{sub.name}",
+                            {req.name, full},
                             sub.device_class_name, sub.selectors,
                         )
-                        if cands and take(
-                            f"{req.name}/{sub.name}", cands, sub.count, False
-                        ):
+                        if cands and take(full, cands, sub.count, False):
                             done = True
                             break
                     if not done:
                         return None
                 else:
                     cands = req_candidates(
-                        req.name, req.device_class_name, req.selectors
+                        {req.name}, req.device_class_name, req.selectors
                     )
                     if cands is None or not take(
                         req.name, cands, req.count, req.all_devices

@@ -160,6 +160,69 @@ def test_allocate_match_attribute_constraint():
     assert got == ["d1", "d2"]
 
 
+def test_unparseable_request_selector_blocks_dense_pool():
+    """A claim whose REQUEST carries CEL outside the subset must block —
+    never degrade to class-only matching (the intern-time marker has to
+    survive ensure_pool's cache rebuild)."""
+    cache = Cache()
+    cache.dra.add_class(gpu_class())
+    cache.add_node(make_node("n0", cpu_milli=4000))
+    cache.dra.add_slice(node_slice("n0", 2))
+    claim = t.ResourceClaim(
+        name="c0", uid="u0",
+        requests=(t.DeviceRequest(
+            name="r", device_class_name="gpu",
+            selectors=(t.CELSelector(
+                'device.attributes["kind"].matches("big.*")'
+            ),),
+        ),),
+    )
+    cache.dra.add_claim(claim)
+    pod = make_pod("p0", cpu_milli=100, claims=["c0"])
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], dra_profile())
+    assert greedy_assign(batch, dra_profile()) == [None]
+    assert cache.dra.allocate_on_node([claim], "n0") is None
+
+
+def test_match_attribute_constraint_covers_subrequests():
+    """A constraint naming the MAIN request applies to its firstAvailable
+    subrequests (resource/v1 semantics): mixed-model devices must not
+    satisfy a count=2 prioritized-list alternative."""
+    idx = DraIndex()
+    idx.add_class(gpu_class())
+    idx.add_slice(t.ResourceSlice(
+        name="s0", driver=DRIVER, pool="p0", node_name="n0",
+        devices=(
+            t.Device("d0", attributes=(("vendor/model", "A"),)),
+            t.Device("d1", attributes=(("vendor/model", "B"),)),
+        ),
+    ))
+    claim = t.ResourceClaim(
+        name="c", uid="u-c",
+        requests=(t.DeviceRequest(
+            name="req-0",
+            first_available=(t.DeviceSubRequest(
+                name="pair", device_class_name="gpu", count=2,
+            ),),
+        ),),
+        constraints=(t.DeviceConstraint(
+            match_attribute="vendor/model", requests=("req-0",),
+        ),),
+    )
+    idx.add_claim(claim)
+    assert idx.allocate_on_node([claim], "n0") is None
+    # two same-model devices satisfy it
+    idx.add_slice(t.ResourceSlice(
+        name="s1", driver=DRIVER, pool="p1", node_name="n0",
+        devices=(t.Device("d2", attributes=(("vendor/model", "B"),)),),
+    ))
+    a = idx.allocate_on_node([claim], "n0")
+    assert a is not None
+    models = sorted(r.device for r in a[0].results)
+    assert models == ["d1", "d2"]
+
+
 def test_allocate_two_independent_match_attribute_constraints():
     """Two matchAttribute constraints pin INDEPENDENTLY: the pair sharing
     both version and model is the only valid choice."""

@@ -163,3 +163,85 @@ def test_sharded_greedy_no_quadratic_work(mesh):
     ref_assign, _ = greedy_assign_device(batch.device, params)
     sh_assign, _ = sharded_greedy(batch.device, params, mesh)
     np.testing.assert_array_equal(np.asarray(ref_assign), np.asarray(sh_assign))
+
+
+# ---------------------------------------------------------------------------
+# Second mesh axis (pods × nodes) + multi-slice (DCN) — SURVEY §2.10 rows
+# "pairwise pod-axis shard" and "multi-slice DCN"
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def mesh2d():
+    from kubetpu.parallel import make_mesh_2d
+
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return make_mesh_2d(devs[:8], pods=2)   # 2 pod-shards × 4 node-shards
+
+
+@pytest.fixture(scope="module")
+def multislice():
+    from kubetpu.parallel import make_multislice_mesh
+
+    devs = jax.devices()
+    assert len(devs) >= 8
+    return make_multislice_mesh(devs[:8], slices=2)   # 2 "slices" × 4
+
+
+def test_2d_mesh_shards_pod_and_node_axes(mesh2d):
+    batch, _ = _build(seed=7)
+    b = batch.device
+    sb = shard_batch(b, mesh2d, pod_axis="pods")
+    p, n = b.requests.shape[0], b.alloc.shape[0]
+    # per-pod rows shard over the pod axis (2-way)
+    assert sb.requests.sharding.shard_shape(sb.requests.shape)[0] == p // 2
+    # node tensors shard over the node axis (4-way)
+    assert sb.alloc.sharding.shard_shape(sb.alloc.shape)[0] == n // 4
+    # the quadratic per-pod term rows shard the pod axis too
+    assert sb.podaffinity.update.sharding.shard_shape(
+        sb.podaffinity.update.shape
+    )[0] == p // 2
+    # (P, N) tiles shard BOTH axes
+    ig = sb.spread.ignored
+    assert ig.sharding.shard_shape(ig.shape) == (p // 2, n // 4)
+
+
+@pytest.mark.parametrize("seed", [0, 2])
+def test_2d_mesh_batched_exact_parity(mesh2d, seed):
+    """The batched engine under the (pods × nodes) mesh — the pairwise
+    InterPodAffinity composition 2-D-tiled — must match single-device."""
+    from kubetpu.assign.batched import batched_assign_device
+
+    batch, params = _build(seed=seed)
+    ref_assign, ref_state = batched_assign_device(batch.device, params)
+    sh_assign, sh_state = sharded_batched(batch.device, params, mesh2d)
+    np.testing.assert_array_equal(np.asarray(ref_assign), np.asarray(sh_assign))
+    for a, b_ in zip(jax.tree.leaves(ref_state), jax.tree.leaves(sh_state)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b_))
+
+
+def test_2d_mesh_greedy_exact_parity(mesh2d):
+    batch, params = _build(seed=1)
+    ref_assign, _ = greedy_assign_device(batch.device, params)
+    sh_assign, _ = sharded_greedy(batch.device, params, mesh2d)
+    np.testing.assert_array_equal(np.asarray(ref_assign), np.asarray(sh_assign))
+
+
+@pytest.mark.parametrize("engine", ["greedy", "batched"])
+def test_multislice_hierarchical_node_shard_parity(multislice, engine):
+    """Multi-slice layout: the node axis shards over ("dcn", "nodes")
+    hierarchically; assignments must match single-device for both engines."""
+    from kubetpu.assign.batched import batched_assign_device
+
+    batch, params = _build(seed=3)
+    fn = sharded_greedy if engine == "greedy" else sharded_batched
+    single = (
+        greedy_assign_device if engine == "greedy" else batched_assign_device
+    )
+    ref_assign, _ = single(batch.device, params)
+    sh_assign, _ = fn(batch.device, params, multislice)
+    np.testing.assert_array_equal(np.asarray(ref_assign), np.asarray(sh_assign))
+    # node tensors are sharded over BOTH mesh axes (8 shards total)
+    sb = shard_batch(batch.device, multislice, axis=("dcn", "nodes"))
+    n = batch.device.alloc.shape[0]
+    assert sb.alloc.sharding.shard_shape(sb.alloc.shape)[0] == n // 8
