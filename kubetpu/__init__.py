@@ -42,4 +42,4 @@ import jax
 if not os.environ.get("KUBETPU_NO_X64"):
     jax.config.update("jax_enable_x64", True)
 
-__version__ = "0.1.0"
+__version__ = "0.4.0"

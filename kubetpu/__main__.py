@@ -1,0 +1,6 @@
+"""``python -m kubetpu`` — the kube-scheduler binary analog (kubetpu.cli)."""
+
+from .cli import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -78,6 +78,9 @@ class ExtenderBackend:
         self.lock = threading.Lock()
         self._bind_fn = bind_fn
         self.metrics_source = metrics_source
+        # optional live-config provider served at GET /configz (the
+        # reference's configz endpoint, SURVEY §5 observability)
+        self.configz_source: Callable[[], dict] | None = None
         # persistent snapshot: update_snapshot(self._snapshot) re-clones only
         # NodeInfos whose generation moved, so an unchanged cache costs O(Δ)
         # per webhook hit (cache.go:190 UpdateSnapshot semantics)
@@ -359,6 +362,11 @@ class _Handler(BaseHTTPRequestHandler):
                 self._reply({"Error": ""})
             elif path.endswith("/healthz"):
                 self._reply({"ok": True})
+            elif path.endswith("/configz"):
+                if be.configz_source is None:
+                    self._reply({"Error": "no config source wired"}, status=404)
+                else:
+                    self._reply(be.configz_source())
             elif path.endswith("/metrics"):
                 if be.metrics_source is None:
                     self._reply({"Error": "no metrics source wired"}, status=404)

@@ -1,0 +1,141 @@
+"""The kubetpu command line — the cmd/kube-scheduler analog (layer 9).
+
+Reference: cmd/kube-scheduler/app/server.go:93 (``NewSchedulerCommand`` →
+``runCommand`` → ``Setup``/``Run``): parse a versioned
+KubeSchedulerConfiguration file, build the scheduler, serve healthz +
+metrics + configz, optionally leader-elect. Here the serving surface is the
+extender webhook bridge (``kubetpu.bridge.server``) — the integration seam
+a real kube-scheduler offloads Filter/Prioritize/Bind through — with the
+same side endpoints (/healthz, /metrics, /configz).
+
+Commands:
+- ``serve``        run the extender bridge from a config file
+- ``check-config`` decode + validate a config file, loudly
+- ``perf``         the scheduler_perf harness (kubetpu.perf)
+- ``version``      print the framework version
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import sys
+from typing import Any, Sequence
+
+
+def _config_to_dict(obj: Any) -> Any:
+    """Dataclass → plain JSON for /configz (live-config introspection)."""
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        return {
+            f.name: _config_to_dict(getattr(obj, f.name))
+            for f in dataclasses.fields(obj)
+        }
+    if isinstance(obj, (list, tuple)):
+        return [_config_to_dict(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): _config_to_dict(v) for k, v in obj.items()}
+    return obj
+
+
+def cmd_check_config(args) -> int:
+    from .framework.configload import ConfigError, load_config
+
+    try:
+        cfg = load_config(args.config)
+    except (ConfigError, OSError) as e:
+        print(f"invalid: {e}", file=sys.stderr)
+        return 1
+    names = ", ".join(p.name for p in cfg.profiles)
+    print(
+        f"ok: {len(cfg.profiles)} profile(s) [{names}], "
+        f"{len(cfg.extenders)} extender(s)"
+    )
+    return 0
+
+
+def cmd_serve(args) -> int:
+    from .bridge.server import ExtenderBackend, ExtenderServer
+    from .framework import config as C
+    from .framework.configload import ConfigError, load_config
+
+    if args.config:
+        try:
+            cfg = load_config(args.config)
+        except (ConfigError, OSError) as e:
+            print(f"invalid config: {e}", file=sys.stderr)
+            return 1
+    else:
+        cfg = C.SchedulerConfiguration()
+    try:
+        profile = cfg.profile(args.profile)
+    except KeyError as e:
+        print(e.args[0], file=sys.stderr)
+        return 1
+    backend = ExtenderBackend(profile=profile)
+    backend.configz_source = lambda: _config_to_dict(cfg)
+    server = ExtenderServer(backend, host=args.host, port=args.port).start()
+    print(f"kubetpu extender bridge serving on {server.url} "
+          f"(profile {profile.name!r}; verbs: /filter /prioritize /bind "
+          f"/preempt; /cache/nodes /cache/pods; /healthz /metrics /configz)",
+          flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()   # serve until interrupted
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
+def cmd_version(_args) -> int:
+    from . import __version__
+
+    print(f"kubetpu {__version__}")
+    return 0
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="kubetpu",
+        description="TPU-native scheduling framework (kube-scheduler parity)",
+    )
+    sub = p.add_subparsers(dest="command", required=True)
+
+    serve = sub.add_parser(
+        "serve", help="run the extender webhook bridge from a config file"
+    )
+    serve.add_argument("--config", default="", help="KubeSchedulerConfiguration file")
+    serve.add_argument("--profile", default=None, help="profile (schedulerName) to serve")
+    serve.add_argument("--host", default="127.0.0.1")
+    serve.add_argument("--port", type=int, default=10259)
+    serve.set_defaults(fn=cmd_serve)
+
+    check = sub.add_parser("check-config", help="validate a config file")
+    check.add_argument("config")
+    check.set_defaults(fn=cmd_check_config)
+
+    ver = sub.add_parser("version", help="print version")
+    ver.set_defaults(fn=cmd_version)
+
+    perf = sub.add_parser(
+        "perf", help="scheduler_perf harness (see python -m kubetpu.perf)"
+    )
+    perf.add_argument("rest", nargs=argparse.REMAINDER)
+    perf.set_defaults(fn=None)
+    return p
+
+
+def main(argv: Sequence[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.command == "perf":
+        from .perf.__main__ import main as perf_main
+
+        return perf_main(args.rest) or 0
+    return args.fn(args)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    raise SystemExit(main())

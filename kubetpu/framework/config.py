@@ -185,6 +185,8 @@ class ExtenderConfig:
     url_prefix: str
     filter_verb: str = ""
     prioritize_verb: str = ""
+    bind_verb: str = ""
+    preempt_verb: str = ""
     weight: int = 1
     node_cache_capable: bool = False
     ignorable: bool = False

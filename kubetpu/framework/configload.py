@@ -1,0 +1,335 @@
+"""Versioned component-config decoding — KubeSchedulerConfiguration v1.
+
+Reference: staging/src/k8s.io/kube-scheduler/config/v1/types.go:44
+(`KubeSchedulerConfiguration`), defaults `pkg/scheduler/apis/config/v1/
+defaults.go`, plugin-set merge semantics `pkg/scheduler/apis/config/v1/
+default_plugins.go:79 (mergePlugins)`: a profile STARTS from the default
+plugin set; its ``disabled`` list removes (name or "*" for all), then its
+``enabled`` list appends in order with per-plugin weight. Per-plugin args
+arrive through ``pluginConfig`` (types_pluginargs.go).
+
+The decoder is loud (apis/config/validation philosophy): wrong apiVersion/
+kind, unknown extension points, malformed args, or an invalid resulting
+profile raise ``ConfigError`` with field paths — a malformed file must
+never reach the scheduler loop.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import Any, Mapping
+
+from ..api import types as t
+from .. import names as N
+from . import config as C
+
+ACCEPTED_API_VERSIONS = (
+    "kubescheduler.config.k8s.io/v1",
+)
+KIND = "KubeSchedulerConfiguration"
+
+
+class ConfigError(ValueError):
+    pass
+
+
+def _err(msg: str):
+    raise ConfigError(msg)
+
+
+def load_config(path: str):
+    """Read + decode a config file (YAML or JSON by content)."""
+    with open(path) as f:
+        raw = f.read()
+    try:
+        try:
+            import yaml
+
+            obj = yaml.safe_load(raw)
+        except ImportError:  # pragma: no cover - yaml is baked into the image
+            obj = json.loads(raw)
+    except Exception as e:
+        # parser errors join the loud-ConfigError contract (the CLI shows
+        # "invalid: …", never a traceback)
+        raise ConfigError(f"{path}: {type(e).__name__}: {e}") from None
+    if not isinstance(obj, Mapping):
+        raise ConfigError(f"{path}: not a config object")
+    return decode_config(obj)
+
+
+# extension point name (v1 JSON) -> which Profile set it lands in
+_POINT_TO_SET = {
+    "preFilter": "filters",
+    "filter": "filters",
+    "postFilter": None,          # fixed in-tree DefaultPreemption wiring
+    "preScore": "scores",
+    "score": "scores",
+    "reserve": "lifecycle",
+    "permit": "lifecycle",
+    "preBind": "lifecycle",
+    "postBind": "lifecycle",
+    # queueSort/bind/preEnqueue have fixed in-tree implementations here;
+    # accepted and checked for known names, but not independently pluggable
+    "queueSort": None,
+    "bind": None,
+    "preEnqueue": None,
+    "multiPoint": "multi",
+}
+
+# which default sets a multiPoint-enabled plugin joins (the reference
+# expands multiPoint across every interface the plugin implements)
+_MULTIPOINT_SETS = {
+    N.NODE_RESOURCES_FIT: ("filters", "scores"),
+    N.NODE_RESOURCES_BALANCED: ("scores",),
+    N.NODE_AFFINITY: ("filters", "scores"),
+    N.TAINT_TOLERATION: ("filters", "scores"),
+    N.NODE_NAME: ("filters",),
+    N.NODE_PORTS: ("filters",),
+    N.NODE_UNSCHEDULABLE: ("filters",),
+    N.POD_TOPOLOGY_SPREAD: ("filters", "scores"),
+    N.INTER_POD_AFFINITY: ("filters", "scores"),
+    N.IMAGE_LOCALITY: ("scores",),
+    N.VOLUME_BINDING: ("filters", "lifecycle"),
+    N.VOLUME_RESTRICTIONS: ("filters",),
+    N.VOLUME_ZONE: ("filters",),
+    N.NODE_VOLUME_LIMITS: ("filters",),
+    N.DYNAMIC_RESOURCES: ("filters", "scores", "lifecycle"),
+}
+
+_DEFAULT_LIFECYCLE = C.Profile().lifecycle
+
+_ACCEPTED_NOOP_ARGS = frozenset({
+    N.DEFAULT_PREEMPTION,   # minCandidateNodes* — this engine is exhaustive
+    N.NODE_AFFINITY,        # addedAffinity — not modeled
+    N.VOLUME_BINDING,       # bindTimeoutSeconds — dispatcher owns timeouts
+})
+
+
+def _merge_set(
+    base: C.PluginSet, spec: Mapping | None, path: str
+) -> C.PluginSet:
+    """mergePlugins semantics for one extension point."""
+    if not spec:
+        return base
+    disabled = spec.get("disabled") or ()
+    enabled = spec.get("enabled") or ()
+    items = list(base.enabled)
+    for d in disabled:
+        name = (d or {}).get("name", "")
+        if name == "*":
+            items = []
+        else:
+            items = [(n, w) for n, w in items if n != name]
+    for e in enabled:
+        name = (e or {}).get("name", "")
+        if not name:
+            raise ConfigError(f"{path}.enabled[]: plugin name required")
+        weight = int(e.get("weight", 1) or 1)
+        items = [(n, w) for n, w in items if n != name]
+        items.append((name, weight))
+    return C.PluginSet(enabled=tuple(items))
+
+
+def _decode_spread_constraint(obj: Mapping, path: str) -> t.TopologySpreadConstraint:
+    try:
+        return t.TopologySpreadConstraint(
+            max_skew=int(obj["maxSkew"]),
+            topology_key=obj["topologyKey"],
+            when_unsatisfiable=obj.get("whenUnsatisfiable", "DoNotSchedule"),
+        )
+    except KeyError as e:
+        raise ConfigError(f"{path}: missing {e.args[0]}") from None
+
+
+def _apply_plugin_args(
+    kwargs: dict, name: str, args: Mapping, path: str
+) -> None:
+    """types_pluginargs.go subset: NodeResourcesFitArgs,
+    InterPodAffinityArgs, PodTopologySpreadArgs."""
+    if name == N.NODE_RESOURCES_FIT:
+        ss = args.get("scoringStrategy") or {}
+        resources = tuple(
+            (r["name"], int(r.get("weight", 1)))
+            for r in ss.get("resources") or ()
+        )
+        shape = tuple(
+            (int(p["utilization"]), int(p["score"]))
+            for p in ((ss.get("requestedToCapacityRatio") or {}).get("shape")
+                      or ())
+        )
+        kwargs["scoring_strategy"] = C.ScoringStrategy(
+            type=ss.get("type", C.LEAST_ALLOCATED),
+            resources=resources or C.ScoringStrategy().resources,
+            shape=shape,
+        )
+    elif name == N.INTER_POD_AFFINITY:
+        kwargs["hard_pod_affinity_weight"] = int(
+            args.get("hardPodAffinityWeight", 1)
+        )
+    elif name == N.POD_TOPOLOGY_SPREAD:
+        if args.get("defaultingType", "System") == "List":
+            kwargs["default_spread_constraints"] = tuple(
+                _decode_spread_constraint(c, f"{path}.defaultConstraints")
+                for c in args.get("defaultConstraints") or ()
+            )
+    elif name in _ACCEPTED_NOOP_ARGS:
+        # args the reference defines but whose knobs don't change this
+        # engine's behavior (e.g. preemption candidate subsampling — we are
+        # exhaustive); accepted so stock config files load unmodified
+        pass
+    else:
+        raise ConfigError(f"{path}: no args decoder for plugin {name!r}")
+
+
+def _decode_profile(obj: Mapping, idx: int) -> C.Profile:
+    path = f"profiles[{idx}]"
+    name = obj.get("schedulerName", "default-scheduler")
+    sets = {
+        "filters": C.DEFAULT_FILTERS,
+        "scores": C.DEFAULT_SCORES,
+        "lifecycle": _DEFAULT_LIFECYCLE,
+    }
+    plugins = obj.get("plugins") or {}
+    for point in plugins:
+        if point not in _POINT_TO_SET:
+            raise ConfigError(f"{path}.plugins.{point}: unknown extension point")
+    # multiPoint applies FIRST, specific extension points override it —
+    # regardless of key order in the file (default_plugins.go: specific
+    # point config always wins over multiPoint expansion)
+    ordered = sorted(
+        plugins.items(), key=lambda kv: 0 if kv[0] == "multiPoint" else 1
+    )
+    for point, spec in ordered:
+        target = _POINT_TO_SET[point]
+        if target == "multi":
+            # expand per plugin across the sets it implements
+            for e in (spec or {}).get("disabled") or ():
+                nm = (e or {}).get("name", "")
+                for key in sets:
+                    sets[key] = _merge_set(
+                        sets[key], {"disabled": [{"name": nm}]},
+                        f"{path}.plugins.multiPoint",
+                    )
+            for e in (spec or {}).get("enabled") or ():
+                nm = (e or {}).get("name", "")
+                targets = _MULTIPOINT_SETS.get(nm)
+                if targets is None:
+                    raise ConfigError(
+                        f"{path}.plugins.multiPoint: unknown plugin {nm!r}"
+                    )
+                for key in targets:
+                    sets[key] = _merge_set(
+                        sets[key], {"enabled": [e]},
+                        f"{path}.plugins.multiPoint",
+                    )
+        elif target is not None:
+            sets[target] = _merge_set(
+                sets[target], spec, f"{path}.plugins.{point}"
+            )
+    kwargs: dict = {}
+    for i, pc in enumerate(obj.get("pluginConfig") or ()):
+        if not isinstance(pc, Mapping) or not pc.get("name"):
+            raise ConfigError(
+                f"{path}.pluginConfig[{i}]: plugin name required"
+            )
+        pname = pc["name"]
+        _apply_plugin_args(
+            kwargs, pname, pc.get("args") or {},
+            f"{path}.pluginConfig[{pname!r}]",
+        )
+    return C.Profile(
+        name=name,
+        filters=sets["filters"],
+        scores=sets["scores"],
+        lifecycle=sets["lifecycle"],
+        **kwargs,
+    )
+
+
+_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)$")
+_DURATION_UNIT = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+
+
+def _duration_s(v, path: str) -> float:
+    """metav1.Duration: "30s" / "1m" strings or bare seconds."""
+    if isinstance(v, (int, float)):
+        return float(v)
+    m = _DURATION_RE.match(str(v))
+    if not m:
+        raise ConfigError(f"{path}: bad duration {v!r}")
+    return float(m.group(1)) * _DURATION_UNIT[m.group(2)]
+
+
+def _decode_extender(obj: Mapping, idx: int) -> C.ExtenderConfig:
+    path = f"extenders[{idx}]"
+    url = obj.get("urlPrefix", "")
+    if not url:
+        raise ConfigError(f"{path}.urlPrefix: required")
+    return C.ExtenderConfig(
+        url_prefix=url,
+        filter_verb=obj.get("filterVerb", ""),
+        prioritize_verb=obj.get("prioritizeVerb", ""),
+        bind_verb=obj.get("bindVerb", ""),
+        preempt_verb=obj.get("preemptVerb", ""),
+        weight=int(obj.get("weight", 1)),
+        node_cache_capable=bool(obj.get("nodeCacheCapable", False)),
+        ignorable=bool(obj.get("ignorable", False)),
+        http_timeout_s=_duration_s(
+            obj.get("httpTimeout", 30), f"{path}.httpTimeout"
+        ),
+        managed_resources=tuple(
+            (r or {}).get("name") or _err(f"{path}.managedResources[]: name required")
+            for r in obj.get("managedResources") or ()
+        ),
+    )
+
+
+def decode_config(obj: Mapping) -> C.SchedulerConfiguration:
+    api = obj.get("apiVersion", "")
+    if api not in ACCEPTED_API_VERSIONS:
+        raise ConfigError(
+            f"apiVersion: {api!r} not in {list(ACCEPTED_API_VERSIONS)}"
+        )
+    kind = obj.get("kind", "")
+    if kind != KIND:
+        raise ConfigError(f"kind: {kind!r} != {KIND!r}")
+    profile_objs = obj.get("profiles")
+    profiles = (
+        tuple(_decode_profile(p, i) for i, p in enumerate(profile_objs))
+        if profile_objs else (C.Profile(),)
+    )
+    seen = set()
+    for p in profiles:
+        if p.name in seen:
+            raise ConfigError(f"profiles: duplicate schedulerName {p.name!r}")
+        seen.add(p.name)
+    cfg = C.SchedulerConfiguration(
+        profiles=profiles,
+        parallelism=int(obj.get("parallelism", 16)),
+        percentage_of_nodes_to_score=int(
+            obj.get("percentageOfNodesToScore", 0) or 0
+        ),
+        pod_initial_backoff_seconds=_duration_s(
+            obj.get("podInitialBackoffSeconds", 1), "podInitialBackoffSeconds"
+        ),
+        pod_max_backoff_seconds=_duration_s(
+            obj.get("podMaxBackoffSeconds", 10), "podMaxBackoffSeconds"
+        ),
+        extenders=tuple(
+            _decode_extender(e, i)
+            for i, e in enumerate(obj.get("extenders") or ())
+        ),
+    )
+    # the same loud validation the scheduler runs at construction — fail at
+    # decode time with the file's field paths instead
+    from .lifecycle import default_registry
+    from .validation import validate_profile
+
+    errs: list[str] = []
+    reg = default_registry()
+    for p in cfg.profiles:
+        errs.extend(validate_profile(p, reg))
+    if errs:
+        raise ConfigError("; ".join(errs))
+    return cfg

@@ -11,7 +11,7 @@ import json
 from . import TEST_CASES, run_label, run_workload
 
 
-def main() -> None:
+def main(argv=None) -> None:
     ap = argparse.ArgumentParser()
     ap.add_argument("--case", help="test case name (see --list)")
     ap.add_argument("--workload", help="workload name within the case")
@@ -24,7 +24,7 @@ def main() -> None:
                     choices=["greedy", "batched"],
                     help="assignment engine (assign.greedy scan vs "
                          "assign.batched capacity-coupled rounds)")
-    args = ap.parse_args()
+    args = ap.parse_args(argv)
 
     if args.list:
         for case in TEST_CASES.values():

@@ -54,6 +54,9 @@ class BindCall:
     on_done: Callable[[Exception | None], None] | None = None
     pre: Callable[[], None] | None = None
     post: Callable[[], None] | None = None
+    # overrides the client's bind — an interested binder EXTENDER owns the
+    # bind API call for its pods (schedule_one.go extendersBinding)
+    bind_fn: Callable[[t.Pod, str], None] | None = None
     call_type: str = field(default="bind", init=False)
 
     @property
@@ -63,7 +66,10 @@ class BindCall:
     def execute(self, client: Any) -> None:
         if self.pre is not None:
             self.pre()
-        client.bind(self.pod, self.node_name)
+        if self.bind_fn is not None:
+            self.bind_fn(self.pod, self.node_name)
+        else:
+            client.bind(self.pod, self.node_name)
         if self.post is not None:
             self.post()
 

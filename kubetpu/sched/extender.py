@@ -94,6 +94,54 @@ class HTTPExtender:
         unresolvable = set(res.get("FailedAndUnresolvableNodes") or ())
         return passing, unresolvable
 
+    def is_binder(self) -> bool:
+        """extender.go IsBinder: a BindVerb makes the extender own the bind
+        API call for its managed pods."""
+        return bool(self.cfg.bind_verb)
+
+    def bind(self, pod: t.Pod, node_name: str) -> None:
+        """extender.go Bind: POST ExtenderBindingArgs; a non-empty Error in
+        ExtenderBindingResult fails the binding cycle
+        (extender/v1/types.go:106,:117)."""
+        res = self._post(self.cfg.bind_verb, {
+            "PodName": pod.name,
+            "PodNamespace": pod.namespace,
+            "PodUID": pod.uid,
+            "Node": node_name,
+        })
+        if res.get("Error"):
+            raise ExtenderError(res["Error"])
+
+    def supports_preemption(self) -> bool:
+        return bool(self.cfg.preempt_verb)
+
+    def process_preemption(
+        self, pod: t.Pod, victims_by_node: dict[str, list[t.Pod]]
+    ) -> dict[str, list[str]]:
+        """extender.go ProcessPreemption: POST the candidate victim map;
+        the extender returns the (possibly trimmed) map as MetaVictims —
+        {node: [victim pod uids]}. Candidate nodes the extender drops are
+        ineligible for preemption.
+
+        NOTE: the evaluator currently picks its best candidate before this
+        seam (sched/preemption.py); wiring the trim into the dry-run
+        candidate set is tracked as a known gap — the verb, wire format and
+        bridge-server half are complete and tested."""
+        args = {
+            "Pod": pod_to_v1(pod),
+            "NodeNameToVictims": {
+                node: {"Pods": [pod_to_v1(v) for v in victims]}
+                for node, victims in victims_by_node.items()
+            },
+        }
+        res = self._post(self.cfg.preempt_verb, args)
+        out: dict[str, list[str]] = {}
+        for node, mv in (res.get("NodeNameToMetaVictims") or {}).items():
+            out[node] = [
+                (p or {}).get("UID", "") for p in (mv or {}).get("Pods") or ()
+            ]
+        return out
+
     def prioritize(self, pod: t.Pod, node_names: list[str]) -> dict[str, int]:
         """→ {node: raw score 0..MaxExtenderPriority}."""
         args: dict = {"Pod": pod_to_v1(pod)}

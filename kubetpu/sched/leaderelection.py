@@ -1,0 +1,223 @@
+"""Lease-based leader election — the client-go leaderelection analog.
+
+Reference: staging/src/k8s.io/client-go/tools/leaderelection/
+(``LeaderElector``, ``tryAcquireOrRenew``; Lease CAS heartbeat), wired into
+the scheduler at cmd/kube-scheduler/app/server.go:301-341. Control-plane HA
+is active/passive: replicas race CAS updates on one Lease object; the
+holder runs, the rest watch. This is the framework's replica-parallelism
+row (SURVEY §2.10): the device mesh scales one scheduler, leases make N
+replicas safe.
+
+Design differences, deliberate:
+- **Step-driven, not thread-driven**: ``tick()`` performs one
+  acquire-or-renew attempt and returns leadership; the owner's loop calls
+  it between batch cycles (the same fold-the-goroutine-into-the-loop shape
+  as the queue's flush timers). ``run()`` is the convenience wrapper.
+- Expiry is judged by the elector's own clock against the time it FIRST
+  observed the current record (client-go's observedTime), so a stopped
+  leader's stale renew_time doesn't need cluster-synchronized clocks.
+
+The lock speaks a tiny client protocol — ``get_lease(ns, name)``,
+``create_lease(ns, name, record)``, ``update_lease(ns, name, record,
+version)`` (CAS on version) — implemented in-process by
+``InMemoryLeaseClient`` (the integration-test stand-in) and by any real
+API client the same way.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable
+
+
+@dataclass(frozen=True)
+class LeaderElectionRecord:
+    """coordination.k8s.io Lease spec slice (leaderelection's
+    LeaderElectionRecord)."""
+
+    holder_identity: str
+    lease_duration_s: float
+    acquire_time: float
+    renew_time: float
+    leader_transitions: int = 0
+
+
+class InMemoryLeaseClient:
+    """Lease storage with resourceVersion CAS — the fake-clientset
+    object-tracker analog for tests and single-process deployments."""
+
+    def __init__(self) -> None:
+        self._leases: dict[tuple[str, str], tuple[LeaderElectionRecord, int]] = {}
+
+    def get_lease(self, namespace: str, name: str):
+        got = self._leases.get((namespace, name))
+        if got is None:
+            return None, 0
+        return got
+
+    def create_lease(
+        self, namespace: str, name: str, record: LeaderElectionRecord
+    ) -> bool:
+        key = (namespace, name)
+        if key in self._leases:
+            return False
+        self._leases[key] = (record, 1)
+        return True
+
+    def update_lease(
+        self, namespace: str, name: str, record: LeaderElectionRecord,
+        version: int,
+    ) -> bool:
+        key = (namespace, name)
+        got = self._leases.get(key)
+        if got is None or got[1] != version:
+            return False   # CAS conflict
+        self._leases[key] = (record, version + 1)
+        return True
+
+
+@dataclass
+class LeaderElector:
+    """See module docstring. ``client`` speaks the lease protocol above."""
+
+    client: Any
+    identity: str
+    name: str = "kube-scheduler"
+    namespace: str = "kube-system"
+    # reference defaults (config/v1 LeaderElectionConfiguration)
+    lease_duration_s: float = 15.0
+    renew_deadline_s: float = 10.0
+    retry_period_s: float = 2.0
+    clock: Callable[[], float] = time.monotonic
+    on_started_leading: Callable[[], None] | None = None
+    on_stopped_leading: Callable[[], None] | None = None
+    on_new_leader: Callable[[str], None] | None = None
+    # internal observation state
+    _is_leader: bool = field(default=False, init=False)
+    _observed: LeaderElectionRecord | None = field(default=None, init=False)
+    _observed_at: float = field(default=0.0, init=False)
+    _last_renew: float = field(default=0.0, init=False)
+    _seen_leader: str = field(default="", init=False)
+
+    @property
+    def is_leader(self) -> bool:
+        return self._is_leader
+
+    # ------------------------------------------------------------- stepping
+    def tick(self) -> bool:
+        """One tryAcquireOrRenew attempt. Returns current leadership.
+
+        Renewals are throttled to ``retry_period_s`` (client-go renews on
+        RetryPeriod, not per wakeup), so a loop calling ``tick()`` between
+        millisecond batch cycles does not hammer the Lease API."""
+        now = self.clock()
+        if self._is_leader and now - self._last_renew > self.renew_deadline_s:
+            # failed to renew in time: step down (leaderelection.go renew
+            # timeout → OnStoppedLeading)
+            self._step_down()
+        if self._is_leader and now - self._last_renew < self.retry_period_s:
+            return True   # fresh enough — skip the get+CAS round trip
+        acquired = self._try_acquire_or_renew(now)
+        if acquired and not self._is_leader:
+            self._is_leader = True
+            if self.on_started_leading is not None:
+                self.on_started_leading()
+        elif not acquired and self._is_leader:
+            self._step_down()
+        return self._is_leader
+
+    def _step_down(self) -> None:
+        if self._is_leader:
+            self._is_leader = False
+            if self.on_stopped_leading is not None:
+                self.on_stopped_leading()
+
+    def _observe(self, record: LeaderElectionRecord) -> None:
+        if self._observed != record:
+            self._observed = record
+            self._observed_at = self.clock()
+        if record.holder_identity != self._seen_leader:
+            self._seen_leader = record.holder_identity
+            if self.on_new_leader is not None:
+                self.on_new_leader(record.holder_identity)
+
+    def _try_acquire_or_renew(self, now: float) -> bool:
+        record, version = self.client.get_lease(self.namespace, self.name)
+        if record is None:
+            fresh = LeaderElectionRecord(
+                holder_identity=self.identity,
+                lease_duration_s=self.lease_duration_s,
+                acquire_time=now,
+                renew_time=now,
+            )
+            if self.client.create_lease(self.namespace, self.name, fresh):
+                self._observe(fresh)
+                self._last_renew = now
+                return True
+            return False
+        self._observe(record)
+        if record.holder_identity != self.identity:
+            # another holder: usurp only after ITS lease duration has passed
+            # since we first observed this record (observedTime rule); an
+            # empty holder is a released lease — acquirable immediately
+            if record.holder_identity and (
+                now - self._observed_at < record.lease_duration_s
+            ):
+                return False
+            updated = replace(
+                record,
+                holder_identity=self.identity,
+                lease_duration_s=self.lease_duration_s,
+                acquire_time=now,
+                renew_time=now,
+                leader_transitions=record.leader_transitions + 1,
+            )
+        else:
+            updated = replace(
+                record,
+                lease_duration_s=self.lease_duration_s,
+                renew_time=now,
+            )
+        if self.client.update_lease(
+            self.namespace, self.name, updated, version
+        ):
+            self._observe(updated)
+            self._last_renew = now
+            return True
+        return False
+
+    # ------------------------------------------------------------ lifecycle
+    def release(self) -> None:
+        """ReleaseOnCancel: hand the lease off cleanly so the next replica
+        need not wait out the lease duration."""
+        if not self._is_leader:
+            return
+        record, version = self.client.get_lease(self.namespace, self.name)
+        if record is not None and record.holder_identity == self.identity:
+            now = self.clock()
+            self.client.update_lease(
+                self.namespace, self.name,
+                replace(
+                    record, holder_identity="", lease_duration_s=1.0,
+                    renew_time=now - record.lease_duration_s,
+                ),
+                version,
+            )
+        self._step_down()
+
+    def run(
+        self, work: Callable[[], bool],
+        sleep: Callable[[float], None] = time.sleep,
+    ) -> None:
+        """Convenience loop: tick; while leading, call ``work()`` (return
+        False to stop); while following, sleep the retry period."""
+        try:
+            while True:
+                if self.tick():
+                    if not work():
+                        return
+                else:
+                    sleep(self.retry_period_s)
+        finally:
+            self.release()

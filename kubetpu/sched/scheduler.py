@@ -148,6 +148,12 @@ class Scheduler:
         )
         self.dispatcher = APIDispatcher(client, workers=dispatcher_workers)
         self.metrics = SchedulerMetrics()
+        from ..tracing import Tracer
+
+        # cycle tracing (utiltrace analog): top-level span per profile
+        # cycle; >100ms cycles log their step breakdown
+        # (schedule_one.go:566-567's LogIfLong)
+        self.tracer = Tracer()
         self._snapshot = Snapshot()
         # previous cycle's NodeTensors — encode_snapshot refreshes only the
         # rows whose generation moved (O(Δ) per-cycle host encode)
@@ -594,18 +600,28 @@ class Scheduler:
         t0 = self.clock()
 
         try:
-            self._snapshot = self.cache.update_snapshot(self._snapshot)
-            pods = [info.pod for info in batch_infos]
-            batch = rt.encode_batch(
-                self._snapshot, pods, profile,
-                nominated=self.nominator.entries(),
-                prev_nt=self._prev_nt,
-            )
-            self._prev_nt = batch.node_tensors
-            device_batch = self._apply_extenders(batch, pods)
-            params = rt.score_params(profile, batch.resource_names)
-            assignments, final_state = self._assign_device(device_batch, params)
-            idx = np.asarray(jax.device_get(assignments))
+            with self.tracer.span(
+                "scheduling-cycle", profile=profile.name,
+                pods=len(batch_infos), cycle=self.metrics.cycles,
+            ):
+                with self.tracer.span("snapshot"):
+                    self._snapshot = self.cache.update_snapshot(self._snapshot)
+                pods = [info.pod for info in batch_infos]
+                with self.tracer.span("encode"):
+                    batch = rt.encode_batch(
+                        self._snapshot, pods, profile,
+                        nominated=self.nominator.entries(),
+                        prev_nt=self._prev_nt,
+                    )
+                self._prev_nt = batch.node_tensors
+                with self.tracer.span("extenders"):
+                    device_batch = self._apply_extenders(batch, pods)
+                params = rt.score_params(profile, batch.resource_names)
+                with self.tracer.span("assign"):
+                    assignments, final_state = self._assign_device(
+                        device_batch, params
+                    )
+                    idx = np.asarray(jax.device_get(assignments))
             self._cycle_ctx = (
                 batch, params, final_state,
                 {info.key: k for k, info in enumerate(batch_infos)},
@@ -761,8 +777,16 @@ class Scheduler:
         if lifecycle.post_bind_plugins:
             def post(info=info, node_name=node_name, lifecycle=lifecycle):
                 lifecycle.run_post_bind(self, info.pod, node_name)
+        # an interested binder extender owns the bind API call
+        # (schedule_one.go:1142 bind → extendersBinding)
+        bind_fn = None
+        for e in self.extenders:
+            if e.is_binder() and e.is_interested(info.pod):
+                bind_fn = e.bind
+                break
         self.dispatcher.add(
-            BindCall(info.pod, node_name, on_done=on_done, pre=pre, post=post)
+            BindCall(info.pod, node_name, on_done=on_done, pre=pre, post=post,
+                     bind_fn=bind_fn)
         )
 
     def _reject_assumed(self, info: QueuedPodInfo, assumed: t.Pod, st) -> None:

@@ -1,0 +1,134 @@
+"""Latency tracing — the utiltrace + component-base/tracing analog.
+
+Reference surfaces:
+- ``k8s.io/utils/trace`` (utiltrace): ``schedulePod`` opens a trace and
+  logs its step breakdown when the cycle exceeds 100 ms
+  (schedule_one.go:566-567). Mirrored by ``Tracer.span`` + the
+  over-threshold log hook.
+- ``component-base/tracing`` (OTel, utils.go:79-85): ratio-sampled spans
+  with attributes exported off-process. Mirrored structurally: spans carry
+  ids/parents/attributes and land in a bounded in-memory buffer an exporter
+  can drain (``Tracer.drain``); the scheduler joins device + host work by
+  cycle id, the OTel-span-per-cycle design SURVEY §5 prescribes.
+- JAX profiler: ``device_profile`` wraps ``jax.profiler.trace`` so a
+  perf investigation captures XLA device traces alongside the host spans.
+
+Single-owner like the scheduler loop: span entry/exit runs on the loop
+thread, so the parent stack is a plain list (no contextvars in the hot
+path). Recording one span costs two ``perf_counter`` calls and an append.
+"""
+
+from __future__ import annotations
+
+import collections
+import itertools
+import time
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+
+@dataclass
+class Span:
+    name: str
+    span_id: int
+    parent_id: int | None
+    start: float
+    end: float = 0.0
+    attrs: dict[str, Any] = field(default_factory=dict)
+
+    @property
+    def duration_s(self) -> float:
+        return max(self.end - self.start, 0.0)
+
+
+class Tracer:
+    """Bounded in-memory span recorder with utiltrace threshold logging."""
+
+    def __init__(
+        self,
+        enabled: bool = True,
+        max_spans: int = 4096,
+        threshold_s: float = 0.1,
+        log: Callable[[str], None] | None = None,
+        clock: Callable[[], float] = time.perf_counter,
+    ) -> None:
+        self.enabled = enabled
+        self.threshold_s = threshold_s
+        self._clock = clock
+        self._log = log
+        self._spans: collections.deque[Span] = collections.deque(
+            maxlen=max_spans
+        )
+        self._stack: list[Span] = []
+        self._ids = itertools.count(1)
+
+    @contextmanager
+    def span(self, name: str, **attrs):
+        """Open a span; yields it so steps can attach attributes. A
+        TOP-LEVEL span exceeding ``threshold_s`` logs its child breakdown
+        (utiltrace's LogIfLong)."""
+        if not self.enabled:
+            yield None
+            return
+        parent = self._stack[-1] if self._stack else None
+        sp = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=parent.span_id if parent else None,
+            start=self._clock(),
+            attrs=dict(attrs),
+        )
+        self._stack.append(sp)
+        try:
+            yield sp
+        finally:
+            sp.end = self._clock()
+            self._stack.pop()
+            self._spans.append(sp)
+            if parent is None and sp.duration_s >= self.threshold_s:
+                self._log_long(sp)
+
+    # ---- inspection ------------------------------------------------------
+    def recent(self, n: int = 100) -> list[Span]:
+        return list(self._spans)[-n:]
+
+    def drain(self) -> list[Span]:
+        """Hand the buffered spans to an exporter and clear the buffer."""
+        out = list(self._spans)
+        self._spans.clear()
+        return out
+
+    def children_of(self, span: Span) -> list[Span]:
+        return [s for s in self._spans if s.parent_id == span.span_id]
+
+    # ---- threshold logging ----------------------------------------------
+    def _log_long(self, sp: Span) -> None:
+        steps = "; ".join(
+            f"{c.name} {c.duration_s * 1000:.1f}ms"
+            for c in self.children_of(sp)
+        )
+        attrs = ",".join(f"{k}={v}" for k, v in sp.attrs.items())
+        msg = (
+            f"Trace[{sp.name}] ({attrs}): {sp.duration_s * 1000:.1f}ms"
+            + (f" — steps: {steps}" if steps else "")
+        )
+        if self._log is not None:
+            self._log(msg)
+        else:  # pragma: no cover - default sink
+            import logging
+
+            logging.getLogger("kubetpu.trace").warning(msg)
+
+
+@contextmanager
+def device_profile(log_dir: str):
+    """Capture an XLA device trace for the enclosed block (JAX profiler —
+    the TPU side of a latency investigation; view with tensorboard/xprof)."""
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()

@@ -266,3 +266,66 @@ def test_gang_pods_respect_extender_filter():
         assert all(n in ("n2", "n3") for n in client.bound.values())
     finally:
         ext.close()
+
+
+def test_binder_extender_owns_the_bind_call():
+    """An extender with a bindVerb binds its interested pods — the default
+    client bind must NOT run (schedule_one.go extendersBinding)."""
+    from kubetpu.bridge import ExtenderBackend, ExtenderServer
+
+    bound_via_extender = []
+    backend = ExtenderBackend(
+        profile=C.minimal_profile(),
+        bind_fn=lambda pod, node: bound_via_extender.append(
+            (f"{pod.namespace}/{pod.name}", node)
+        ),
+    )
+    srv = ExtenderServer(backend).start()
+    try:
+        backend.upsert_nodes([make_node("n0", cpu_milli=4000)])
+        client = FakeClient()
+        s, _ = make_ext_sched(client, C.ExtenderConfig(
+            url_prefix=srv.url, filter_verb="filter", bind_verb="bind",
+            node_cache_capable=True,
+        ))
+        s.on_node_add(make_node("n0", cpu_milli=4000))
+        s.on_pod_add(make_pod("p", cpu_milli=100))
+        s.schedule_batch()
+        s.dispatcher.sync()
+        s._drain_bind_completions()
+        assert bound_via_extender == [("default/p", "n0")]
+        assert client.bound == {}          # default binder skipped
+        assert client.bind_calls == 0
+        # the scheduler still confirmed the bind (cache + queue bookkeeping)
+        assert s.metrics.scheduled == 1 and s.metrics.bind_errors == 0
+    finally:
+        srv.close()
+
+
+def test_process_preemption_round_trip_against_own_server():
+    """ProcessPreemption wire format: client sends the victim map, the
+    server trims statically-infeasible nodes, UIDs come back as MetaVictims."""
+    from kubetpu.bridge import ExtenderBackend, ExtenderServer
+    from kubetpu.sched.extender import HTTPExtender
+
+    backend = ExtenderBackend(profile=C.minimal_profile())
+    srv = ExtenderServer(backend).start()
+    try:
+        backend.upsert_nodes([
+            make_node("n0", cpu_milli=4000), make_node("n1", cpu_milli=4000),
+        ])
+        ext = HTTPExtender(C.ExtenderConfig(
+            url_prefix=srv.url, preempt_verb="preempt",
+        ))
+        assert ext.supports_preemption()
+        preemptor = make_pod("hungry", cpu_milli=1000)
+        victims = {
+            "n0": [make_pod("v0", cpu_milli=500, node_name="n0")],
+            # n-gone is unknown to the server's cache -> dropped
+            "n-gone": [make_pod("v1", cpu_milli=500, node_name="n-gone")],
+        }
+        out = ext.process_preemption(preemptor, victims)
+        assert set(out) == {"n0"}
+        assert out["n0"] == ["default/v0"]
+    finally:
+        srv.close()
