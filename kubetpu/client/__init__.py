@@ -1,0 +1,5 @@
+"""Client runtime: Reflector, SharedInformer, and the scheduler's informer
+bundle (the client-go layer)."""
+
+from .reflector import Reflector, SharedInformer  # noqa: F401
+from .informers import SchedulerInformers, StoreClient  # noqa: F401

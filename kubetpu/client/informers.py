@@ -1,0 +1,170 @@
+"""The scheduler's informer bundle + store-backed API client.
+
+``addAllEventHandlers`` (pkg/scheduler/eventhandlers.go:455) registers the
+scheduler's informer callbacks for every resource it watches; this module is
+that wiring against the framework's own storage layer: one Reflector +
+SharedInformer per resource kind, deliveries bound to the scheduler's
+``on_*`` seam. ``StoreClient`` closes the loop the other way — the
+dispatcher's bind/status/claim writes land in the store, whose watch events
+flow back through the informers (level-triggered reconciliation, the same
+all-state-through-the-API-server shape as the reference; SURVEY §1).
+
+Pump-driven: ``pump()`` steps every reflector once; callers interleave it
+with ``schedule_batch`` (the informer goroutines folded into the loop).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..api import types as t
+from ..store.memstore import MemStore
+from .reflector import FuncHandler, Reflector, SharedInformer
+
+# store bucket names (the GVR path segments)
+NODES = "nodes"
+PODS = "pods"
+RESOURCE_CLAIMS = "resourceclaims"
+RESOURCE_SLICES = "resourceslices"
+DEVICE_CLASSES = "deviceclasses"
+PERSISTENT_VOLUMES = "persistentvolumes"
+PERSISTENT_VOLUME_CLAIMS = "persistentvolumeclaims"
+STORAGE_CLASSES = "storageclasses"
+SERVICES = "services"
+NAMESPACES = "namespaces"
+POD_GROUPS = "podgroups"
+PDBS = "poddisruptionbudgets"
+LEASES = "leases"
+
+
+def pod_store_key(pod: t.Pod) -> str:
+    return f"{pod.namespace}/{pod.name}"
+
+
+class StoreClient:
+    """The API client the scheduler's dispatcher writes through, backed by
+    the store — binds/status/claims become versioned writes whose watch
+    events the informers deliver back."""
+
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self.status_patches: list[tuple[str, str]] = []
+
+    def bind(self, pod: t.Pod, node_name: str) -> None:
+        key = pod_store_key(pod)
+        current, rv = self.store.get(PODS, key)
+        if current is None:
+            raise RuntimeError(f"bind conflict: pod {key} is gone")
+        if current.node_name and current.node_name != node_name:
+            raise RuntimeError(
+                f"bind conflict: pod {key} already on {current.node_name}"
+            )
+        self.store.update(PODS, key, current.with_node(node_name), expect_rv=rv)
+
+    def patch_status(self, pod: t.Pod, reason: str, message: str = "") -> None:
+        # PodScheduled=False condition patch; conditions aren't part of the
+        # scheduling envelope, so record without a store write
+        self.status_patches.append((pod_store_key(pod), reason))
+
+    def delete_pod(self, pod: t.Pod) -> None:
+        try:
+            self.store.delete(PODS, pod_store_key(pod))
+        except KeyError:
+            pass  # victim already gone
+
+    def nominate(self, pod: t.Pod, node_name: str) -> None:
+        # status.nominatedNodeName patch — nominations live in the
+        # scheduler's nominator; the write is informational here
+        pass
+
+    def update_claim_status(self, claim: t.ResourceClaim) -> None:
+        self.store.update(RESOURCE_CLAIMS, claim.key, claim)
+
+
+class SchedulerInformers:
+    """One informer per watched kind, bound to a Scheduler's handlers."""
+
+    def __init__(self, store: MemStore, sched: Any) -> None:
+        self.store = store
+        self.sched = sched
+        self._reflectors: list[Reflector] = []
+        s = sched
+        self._bind(NODES, s.on_node_add,
+                   lambda old, new: s.on_node_update(old, new),
+                   s.on_node_delete)
+        self._bind(PODS, s.on_pod_add,
+                   lambda old, new: s.on_pod_update(old, new),
+                   s.on_pod_delete)
+        self._bind(RESOURCE_CLAIMS, s.on_resource_claim_add,
+                   s.on_resource_claim_update, s.on_resource_claim_delete)
+        self._bind(RESOURCE_SLICES, s.on_resource_slice_add,
+                   s.on_resource_slice_update, s.on_resource_slice_delete)
+        self._bind(DEVICE_CLASSES, s.on_device_class_add,
+                   s.on_device_class_update, s.on_device_class_delete)
+        self._bind(PERSISTENT_VOLUMES, s.on_pv_add, s.on_pv_update,
+                   s.on_pv_delete)
+        self._bind(PERSISTENT_VOLUME_CLAIMS, s.on_pvc_add, s.on_pvc_update,
+                   s.on_pvc_delete)
+        self._bind(STORAGE_CLASSES, s.on_storage_class_add,
+                   s.on_storage_class_update, s.on_storage_class_delete)
+        self._bind(SERVICES, s.on_service_add, s.on_service_update,
+                   s.on_service_delete)
+        self._bind(NAMESPACES, s.on_namespace_add,
+                   lambda old, new: s.on_namespace_update(new),
+                   s.on_namespace_delete)
+        self._bind(POD_GROUPS, s.on_pod_group_add,
+                   lambda old, new: s.on_pod_group_update(new),
+                   s.on_pod_group_delete)
+        self._bind(PDBS, s.on_pdb_add,
+                   lambda old, new: s.on_pdb_update(new),
+                   s.on_pdb_delete)
+
+    def _bind(self, kind: str, on_add, on_update, on_delete) -> None:
+        informer = SharedInformer(kind)
+        informer.add_handler(FuncHandler(
+            on_add=on_add, on_update=on_update, on_delete=on_delete,
+        ))
+        self._reflectors.append(Reflector(self.store, informer))
+
+    def start(self) -> None:
+        """Initial list+watch for every kind (WaitForCacheSync analog —
+        after this the scheduler's cache reflects the store)."""
+        for r in self._reflectors:
+            r.sync()
+
+    def pump(self) -> int:
+        """Drain pending watch events into the scheduler. Returns the
+        number of deliveries."""
+        total = 0
+        for r in self._reflectors:
+            total += r.step()
+        return total
+
+    @property
+    def synced(self) -> bool:
+        return all(r.informer.synced for r in self._reflectors)
+
+
+def run_scheduler_from_store(
+    store: MemStore, sched: Any, max_cycles: int = 10000
+) -> int:
+    """Convenience loop: informers → batch cycles → dispatcher writes →
+    informer echoes, until quiescent. Returns pods scheduled."""
+    informers = SchedulerInformers(store, sched)
+    informers.start()
+    total = 0
+    idle = 0
+    for _ in range(max_cycles):
+        moved = informers.pump()
+        res = sched.schedule_batch()
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        total += res["scheduled"]
+        if not moved and not res["scheduled"] and not res["unschedulable"]:
+            idle += 1
+            if idle >= 2:   # one extra spin to drain bind echoes
+                break
+        else:
+            idle = 0
+    informers.pump()
+    return total

@@ -1,0 +1,142 @@
+"""Reflector + SharedInformer — the client-go cache machinery.
+
+Reference:
+- ``Reflector.ListAndWatch`` (client-go tools/cache/reflector.go:463): list
+  at a resourceVersion, then watch from it; on a compaction error ("too old
+  resource version") relist from scratch. The relist REPLACES the local
+  store: objects present before but absent from the new list synthesize
+  DELETE deliveries (DeltaFIFO's Replace/Sync semantics).
+- ``sharedIndexInformer`` (tools/cache/shared_informer.go:588): one
+  reflector feeds a thread-safe local store plus N event handlers; handlers
+  receive (old, new) pairs for updates. **The scheduler's entire world-view
+  arrives through this** — and here too: kubetpu.client.informers binds
+  these deliveries to the scheduler's ``on_*`` seam.
+
+Pump-driven: ``step()`` drains available watch events and dispatches;
+owners fold it into their loops (the framework's no-goroutine shape).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Protocol
+
+from ..store.memstore import (
+    ADDED,
+    DELETED,
+    MODIFIED,
+    CompactedError,
+    MemStore,
+)
+
+
+class Handler(Protocol):  # informer event handler (ResourceEventHandler)
+    def on_add(self, obj: Any) -> None: ...
+    def on_update(self, old: Any, new: Any) -> None: ...
+    def on_delete(self, obj: Any) -> None: ...
+
+
+class FuncHandler:
+    """ResourceEventHandlerFuncs: build a handler from callables."""
+
+    def __init__(
+        self,
+        on_add: Callable[[Any], None] | None = None,
+        on_update: Callable[[Any, Any], None] | None = None,
+        on_delete: Callable[[Any], None] | None = None,
+    ) -> None:
+        self._add, self._update, self._delete = on_add, on_update, on_delete
+
+    def on_add(self, obj: Any) -> None:
+        if self._add:
+            self._add(obj)
+
+    def on_update(self, old: Any, new: Any) -> None:
+        if self._update:
+            self._update(old, new)
+
+    def on_delete(self, obj: Any) -> None:
+        if self._delete:
+            self._delete(obj)
+
+
+class SharedInformer:
+    """Local indexed store + handler fan-out for ONE resource kind."""
+
+    def __init__(self, kind: str) -> None:
+        self.kind = kind
+        self.store: dict[str, Any] = {}
+        self._handlers: list[Handler] = []
+        self.synced = False
+
+    def add_handler(self, handler: Handler) -> None:
+        self._handlers.append(handler)
+        # late registrations replay the current store (shared_informer.go
+        # AddEventHandler delivers synthetic adds for existing objects)
+        for obj in self.store.values():
+            handler.on_add(obj)
+
+    # deliveries from the reflector
+    def _replace(self, items: list[tuple[str, Any]]) -> None:
+        new_keys = {k for k, _ in items}
+        for key in list(self.store):
+            if key not in new_keys:
+                gone = self.store.pop(key)
+                for h in self._handlers:
+                    h.on_delete(gone)
+        for key, obj in items:
+            old = self.store.get(key)
+            self.store[key] = obj
+            for h in self._handlers:
+                if old is None:
+                    h.on_add(obj)
+                elif old is not obj:
+                    h.on_update(old, obj)
+        self.synced = True
+
+    def _apply(self, ev_type: str, key: str, obj: Any) -> None:
+        if ev_type == DELETED:
+            old = self.store.pop(key, None)
+            if old is not None:
+                for h in self._handlers:
+                    h.on_delete(old)
+            return
+        old = self.store.get(key)
+        self.store[key] = obj
+        for h in self._handlers:
+            if old is None:
+                h.on_add(obj)
+            else:
+                h.on_update(old, obj)
+
+
+class Reflector:
+    """ListAndWatch over one store bucket into a SharedInformer."""
+
+    def __init__(self, store: MemStore, informer: SharedInformer) -> None:
+        self._store = store
+        self.informer = informer
+        self._watcher = None
+        self.relists = 0    # metrics: compaction-forced relists
+
+    def sync(self) -> None:
+        """Initial (or compaction-forced) list + watch-from-revision."""
+        items, rv = self._store.list(self.informer.kind)
+        self.informer._replace(items)
+        self._watcher = self._store.watch(self.informer.kind, rv)
+
+    def step(self) -> int:
+        """Drain available watch events; relist on compaction. Returns the
+        number of deliveries dispatched."""
+        if self._watcher is None:
+            self.sync()
+            return len(self.informer.store)
+        try:
+            events = self._watcher.poll()
+        except CompactedError:
+            # reflector.go: watch too old → full relist
+            self.relists += 1
+            self.sync()
+            return len(self.informer.store)
+        for ev in events:
+            self.informer._apply(ev.type, ev.key, ev.obj)
+        return len(events)

@@ -1,0 +1,8 @@
+"""Controllers: informer + reconcile loops over the store (pkg/controller)."""
+
+from .nodelifecycle import (  # noqa: F401
+    NodeHeartbeat,
+    NodeLifecycleController,
+    TAINT_UNREACHABLE,
+    heartbeat,
+)

@@ -247,18 +247,30 @@ def _decode_profile(obj: Mapping, idx: int) -> C.Profile:
     )
 
 
-_DURATION_RE = re.compile(r"^(\d+(?:\.\d+)?)(ms|s|m|h)$")
-_DURATION_UNIT = {"ms": 0.001, "s": 1.0, "m": 60.0, "h": 3600.0}
+_DURATION_SEG = re.compile(r"(\d+(?:\.\d+)?)(ms|s|m|h|us|µs|ns)")
+_DURATION_UNIT = {
+    "ns": 1e-9, "us": 1e-6, "µs": 1e-6, "ms": 0.001,
+    "s": 1.0, "m": 60.0, "h": 3600.0,
+}
 
 
 def _duration_s(v, path: str) -> float:
-    """metav1.Duration: "30s" / "1m" strings or bare seconds."""
+    """metav1.Duration: bare seconds, or Go duration strings INCLUDING the
+    compound forms time.Duration.String() emits ("1m0s", "1h30m5s") — a
+    config round-tripped through kubectl/configz must load unmodified."""
     if isinstance(v, (int, float)):
         return float(v)
-    m = _DURATION_RE.match(str(v))
-    if not m:
+    text = str(v).strip()
+    pos = 0
+    total = 0.0
+    for m in _DURATION_SEG.finditer(text):
+        if m.start() != pos:
+            raise ConfigError(f"{path}: bad duration {v!r}")
+        total += float(m.group(1)) * _DURATION_UNIT[m.group(2)]
+        pos = m.end()
+    if pos != len(text) or pos == 0:
         raise ConfigError(f"{path}: bad duration {v!r}")
-    return float(m.group(1)) * _DURATION_UNIT[m.group(2)]
+    return total
 
 
 def _decode_extender(obj: Mapping, idx: int) -> C.ExtenderConfig:
@@ -286,6 +298,20 @@ def _decode_extender(obj: Mapping, idx: int) -> C.ExtenderConfig:
 
 
 def decode_config(obj: Mapping) -> C.SchedulerConfiguration:
+    """Decode + validate; EVERY failure surfaces as ConfigError (structural
+    surprises — wrong types where mappings/ints were expected — are
+    rewrapped so the CLI never shows a traceback)."""
+    try:
+        return _decode_config(obj)
+    except ConfigError:
+        raise
+    except (AttributeError, TypeError, ValueError, KeyError) as e:
+        raise ConfigError(
+            f"malformed configuration: {type(e).__name__}: {e}"
+        ) from None
+
+
+def _decode_config(obj: Mapping) -> C.SchedulerConfiguration:
     api = obj.get("apiVersion", "")
     if api not in ACCEPTED_API_VERSIONS:
         raise ConfigError(

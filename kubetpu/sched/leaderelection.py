@@ -48,33 +48,41 @@ class InMemoryLeaseClient:
     object-tracker analog for tests and single-process deployments."""
 
     def __init__(self) -> None:
+        import threading
+
+        # electors may be threads of one process — the CAS must be atomic
+        # under concurrency or two replicas can both "win" (split brain)
+        self._mu = threading.Lock()
         self._leases: dict[tuple[str, str], tuple[LeaderElectionRecord, int]] = {}
 
     def get_lease(self, namespace: str, name: str):
-        got = self._leases.get((namespace, name))
-        if got is None:
-            return None, 0
-        return got
+        with self._mu:
+            got = self._leases.get((namespace, name))
+            if got is None:
+                return None, 0
+            return got
 
     def create_lease(
         self, namespace: str, name: str, record: LeaderElectionRecord
     ) -> bool:
         key = (namespace, name)
-        if key in self._leases:
-            return False
-        self._leases[key] = (record, 1)
-        return True
+        with self._mu:
+            if key in self._leases:
+                return False
+            self._leases[key] = (record, 1)
+            return True
 
     def update_lease(
         self, namespace: str, name: str, record: LeaderElectionRecord,
         version: int,
     ) -> bool:
         key = (namespace, name)
-        got = self._leases.get(key)
-        if got is None or got[1] != version:
-            return False   # CAS conflict
-        self._leases[key] = (record, version + 1)
-        return True
+        with self._mu:
+            got = self._leases.get(key)
+            if got is None or got[1] != version:
+                return False   # CAS conflict
+            self._leases[key] = (record, version + 1)
+            return True
 
 
 @dataclass

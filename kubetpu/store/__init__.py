@@ -1,0 +1,3 @@
+"""Versioned object storage + watch (the etcd3 / watch-cache layer)."""
+
+from .memstore import CompactedError, MemStore, WatchEvent, Watcher  # noqa: F401

@@ -1,0 +1,182 @@
+"""Versioned in-memory object store with watch — layer 0 of the stack.
+
+Reference semantics mirrored (storage is host-side by design, SURVEY §2.9 —
+the device-resident tensors are the hot store; THIS layer is the source of
+truth every component watches):
+
+- etcd3 store (apiserver/pkg/storage/etcd3/store.go): every write bumps one
+  monotonically increasing resourceVersion; Create fails on exists (:269),
+  ``GuaranteedUpdate`` does optimistic CAS on resourceVersion (:458);
+  GetList returns the store's current revision (:733).
+- Watch cache (apiserver/pkg/storage/cacher/cacher.go:263): one ring buffer
+  of events fans out to N watchers; a watcher asking for a revision older
+  than the buffer gets "too old" (HTTP 410 Gone) and must relist —
+  ``CompactedError`` here, consumed by the Reflector's relist loop
+  (client-go reflector.go ListAndWatch).
+
+Watchers are PULL-based (``Watcher.poll``): the schedulers/controllers in
+this framework fold their pumps into their loops (same shape as the queue's
+flush timers); ``wait_for`` provides the blocking form for threads.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from dataclasses import dataclass
+from typing import Any, Iterable
+
+ADDED = "ADDED"
+MODIFIED = "MODIFIED"
+DELETED = "DELETED"
+
+
+class CompactedError(Exception):
+    """The requested resourceVersion predates the event buffer (the watch
+    cache's 'too old resource version' / HTTP 410 — relist required)."""
+
+
+class ConflictError(Exception):
+    """CAS failure: the object moved past the expected resourceVersion."""
+
+
+@dataclass(frozen=True)
+class WatchEvent:
+    type: str              # ADDED | MODIFIED | DELETED
+    kind: str              # resource bucket ("nodes", "pods", …)
+    key: str
+    obj: Any               # the object AFTER the change (before, for DELETED)
+    resource_version: int
+
+
+class MemStore:
+    """See module docstring. Thread-safe; writes are serialized."""
+
+    def __init__(self, history: int = 8192) -> None:
+        self._lock = threading.Condition()
+        self._rv = 0
+        # (kind, key) -> (obj, rv)
+        self._objects: dict[tuple[str, str], tuple[Any, int]] = {}
+        self._events: collections.deque[WatchEvent] = collections.deque(
+            maxlen=history
+        )
+        self._compacted_through = 0   # highest rv dropped from the buffer
+
+    # ------------------------------------------------------------- writes
+    def _emit(self, ev: WatchEvent) -> None:
+        if len(self._events) == self._events.maxlen:
+            self._compacted_through = self._events[0].resource_version
+        self._events.append(ev)
+        self._lock.notify_all()
+
+    def create(self, kind: str, key: str, obj: Any) -> int:
+        with self._lock:
+            if (kind, key) in self._objects:
+                raise ConflictError(f"{kind}/{key} already exists")
+            self._rv += 1
+            self._objects[(kind, key)] = (obj, self._rv)
+            self._emit(WatchEvent(ADDED, kind, key, obj, self._rv))
+            return self._rv
+
+    def update(
+        self, kind: str, key: str, obj: Any, expect_rv: int | None = None
+    ) -> int:
+        """GuaranteedUpdate: CAS when ``expect_rv`` is given; upsert when the
+        object is absent and no CAS was requested."""
+        with self._lock:
+            got = self._objects.get((kind, key))
+            if expect_rv is not None:
+                if got is None or got[1] != expect_rv:
+                    raise ConflictError(
+                        f"{kind}/{key}: expected rv {expect_rv}, "
+                        f"have {got[1] if got else 'absent'}"
+                    )
+            self._rv += 1
+            self._objects[(kind, key)] = (obj, self._rv)
+            self._emit(WatchEvent(
+                ADDED if got is None else MODIFIED, kind, key, obj, self._rv
+            ))
+            return self._rv
+
+    def delete(self, kind: str, key: str) -> int:
+        with self._lock:
+            got = self._objects.pop((kind, key), None)
+            if got is None:
+                raise KeyError(f"{kind}/{key} not found")
+            self._rv += 1
+            self._emit(WatchEvent(DELETED, kind, key, got[0], self._rv))
+            return self._rv
+
+    # -------------------------------------------------------------- reads
+    def get(self, kind: str, key: str):
+        with self._lock:
+            got = self._objects.get((kind, key))
+            return (None, 0) if got is None else got
+
+    def list(self, kind: str) -> tuple[list[tuple[str, Any]], int]:
+        """GetList: items + the revision the list is consistent at."""
+        with self._lock:
+            items = [
+                (key, obj)
+                for (k, key), (obj, _rv) in self._objects.items()
+                if k == kind
+            ]
+            return items, self._rv
+
+    @property
+    def resource_version(self) -> int:
+        with self._lock:
+            return self._rv
+
+    # -------------------------------------------------------------- watch
+    def watch(self, kind: str | None, since_rv: int) -> "Watcher":
+        """A pull watcher for events AFTER ``since_rv`` (``kind`` None =
+        all buckets). Raises CompactedError immediately when the start
+        revision predates the buffer."""
+        with self._lock:
+            if since_rv < self._compacted_through:
+                raise CompactedError(
+                    f"rv {since_rv} compacted (through "
+                    f"{self._compacted_through})"
+                )
+        return Watcher(self, kind, since_rv)
+
+    def _events_since(self, kind: str | None, rv: int) -> list[WatchEvent]:
+        with self._lock:
+            if rv < self._compacted_through:
+                raise CompactedError(
+                    f"rv {rv} compacted (through {self._compacted_through})"
+                )
+            return [
+                e for e in self._events
+                if e.resource_version > rv
+                and (kind is None or e.kind == kind)
+            ]
+
+    def wait_for(self, rv: int, timeout: float | None = None) -> bool:
+        """Block until the store moves past ``rv`` (thread form)."""
+        with self._lock:
+            return self._lock.wait_for(
+                lambda: self._rv > rv, timeout=timeout
+            )
+
+
+class Watcher:
+    """One watch stream: ``poll()`` drains events after the cursor."""
+
+    def __init__(self, store: MemStore, kind: str | None, since_rv: int) -> None:
+        self._store = store
+        self._kind = kind
+        self._rv = since_rv
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    def poll(self) -> list[WatchEvent]:
+        """New events since the cursor; raises CompactedError when the
+        cursor fell behind the ring buffer (caller relists)."""
+        events = self._store._events_since(self._kind, self._rv)
+        if events:
+            self._rv = events[-1].resource_version
+        return events

@@ -425,3 +425,51 @@ def test_leader_tick_throttles_renew_api_traffic():
     clock[0] += 3                 # past retry_period_s (2s)
     assert a.tick()
     assert len(calls) == n0 + 1   # exactly one renewal
+
+
+def test_go_compound_durations_and_malformed_structure():
+    """time.Duration.String() compound forms load; structural garbage
+    surfaces as ConfigError, never a raw traceback."""
+    cfg = decode_config({
+        **HEADER,
+        "podInitialBackoffSeconds": "1m0s",
+        "podMaxBackoffSeconds": "1m30s",
+    })
+    assert cfg.pod_initial_backoff_seconds == 60.0
+    assert cfg.pod_max_backoff_seconds == 90.0
+    with pytest.raises(ConfigError):
+        decode_config({**HEADER, "profiles": ["not-a-mapping"]})
+    with pytest.raises(ConfigError):
+        decode_config({**HEADER, "extenders": [
+            {"urlPrefix": "http://x", "weight": "abc"},
+        ]})
+    with pytest.raises(ConfigError):
+        decode_config({**HEADER, "podMaxBackoffSeconds": "10 parsecs"})
+
+
+def test_inmemory_lease_cas_is_atomic_under_threads():
+    """Two electors racing from threads: exactly one may hold the lease."""
+    import threading
+
+    from kubetpu.sched.leaderelection import (
+        InMemoryLeaseClient,
+        LeaderElector,
+    )
+
+    for _ in range(20):
+        client = InMemoryLeaseClient()
+        barrier = threading.Barrier(2)
+        winners = []
+
+        def race(ident):
+            e = LeaderElector(client=client, identity=ident)
+            barrier.wait()
+            if e.tick():
+                winners.append(ident)
+
+        ts = [threading.Thread(target=race, args=(i,)) for i in ("a", "b")]
+        for th in ts:
+            th.start()
+        for th in ts:
+            th.join()
+        assert len(winners) == 1, winners
