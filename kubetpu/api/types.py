@@ -136,6 +136,9 @@ class Toleration:
     operator: TolerationOperator = TolerationOperator.EQUAL
     value: str = ""
     effect: TaintEffect | None = None  # None = all effects
+    # v1 TolerationSeconds: how long a NoExecute taint is tolerated before
+    # eviction (None = forever; consumed by the tainteviction controller)
+    toleration_seconds: float | None = None
 
 
 @dataclass(frozen=True)
@@ -242,6 +245,12 @@ class Pod:
     # spec.schedulerName — selects the profile (profile.go:46 Map); pods
     # naming an unknown profile are not this scheduler's to place
     scheduler_name: str = "default-scheduler"
+    # status.phase slice (Pending/Running/Succeeded/Failed) — maintained by
+    # the node agent (kubetpu.kubelet), consumed by podgc
+    phase: str = "Pending"
+    # metadata.ownerReferences slice: the controller that stamped this pod
+    # ("kind/namespace/name"), consumed by replicaset adoption
+    owner: str = ""
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -523,6 +532,23 @@ class PodGroup:
 
 
 @dataclass(frozen=True)
+class ReplicaSet:
+    """The scheduling-relevant slice of apps/v1 ReplicaSet: desired replica
+    count, the selector that claims pods, and the pod template to stamp
+    (pkg/controller/replicaset syncReplicaSet's inputs)."""
+
+    name: str
+    namespace: str = "default"
+    replicas: int = 1
+    selector: LabelSelector | None = None
+    template: "Pod | None" = None     # prototype; name/uid/owner stamped
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
 class Namespace:
     """The slice of v1.Namespace affinity needs: its labels, matched by
     PodAffinityTerm.namespace_selector (framework/types.go
@@ -548,6 +574,14 @@ class PodDisruptionBudget:
     selector: LabelSelector | None = None
     disruptions_allowed: int = 0
     disrupted_pods: tuple[str, ...] = ()
+    # spec (policy/v1): exactly one of the two; the disruption controller
+    # derives status.disruptionsAllowed from it
+    min_available: int | None = None
+    max_unavailable: int | None = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
 
 
 @dataclass(frozen=True)

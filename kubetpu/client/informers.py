@@ -78,7 +78,13 @@ class StoreClient:
         pass
 
     def update_claim_status(self, claim: t.ResourceClaim) -> None:
-        self.store.update(RESOURCE_CLAIMS, claim.key, claim)
+        # a claim deleted mid-binding must NOT be resurrected by the status
+        # write (the bind() deleted-pod rule, applied to claims): CAS
+        # against the live object, skip if gone
+        current, rv = self.store.get(RESOURCE_CLAIMS, claim.key)
+        if current is None:
+            return
+        self.store.update(RESOURCE_CLAIMS, claim.key, claim, expect_rv=rv)
 
 
 class SchedulerInformers:

@@ -1,8 +1,12 @@
 """Controllers: informer + reconcile loops over the store (pkg/controller)."""
 
+from .disruption import DisruptionController  # noqa: F401
 from .nodelifecycle import (  # noqa: F401
     NodeHeartbeat,
     NodeLifecycleController,
     TAINT_UNREACHABLE,
     heartbeat,
 )
+from .podgc import PodGCController  # noqa: F401
+from .replicaset import REPLICA_SETS, ReplicaSetController  # noqa: F401
+from .tainteviction import TaintEvictionController  # noqa: F401

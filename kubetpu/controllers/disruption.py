@@ -1,0 +1,88 @@
+"""Disruption controller — PDB status maintenance.
+
+Reference: ``pkg/controller/disruption`` (disruption.go trySync/updatePdb
+Status): for each PodDisruptionBudget, count the healthy pods its selector
+matches, derive ``status.disruptionsAllowed`` from the spec
+(minAvailable: allowed = healthy − minAvailable; maxUnavailable:
+desiredHealthy = expected − maxUnavailable, allowed = healthy −
+desiredHealthy), floor 0, and write the status back. The scheduler's
+PDB-aware preemption (framework/preemption PDB counting) consumes exactly
+this field — with this controller running, that input is LIVE, not
+hand-set.
+
+"Healthy" here = bound and non-terminal (the envelope has pod phase but no
+readiness conditions); "expected" = all non-terminal matching pods. Writes
+go through store CAS.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from ..api.selectors import label_selector_matches
+from ..client.informers import PDBS, PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import ConflictError, MemStore
+
+
+def compute_allowed(pdb: t.PodDisruptionBudget, healthy: int, expected: int) -> int:
+    if pdb.min_available is not None:
+        allowed = healthy - pdb.min_available
+    elif pdb.max_unavailable is not None:
+        desired_healthy = expected - pdb.max_unavailable
+        allowed = healthy - desired_healthy
+    else:
+        allowed = 0
+    return max(0, allowed)
+
+
+class DisruptionController:
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self._pdbs = SharedInformer(PDBS)
+        self._pods = SharedInformer(PODS)
+        self._r = [Reflector(store, self._pdbs), Reflector(store, self._pods)]
+        self.updates = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    def step(self) -> int:
+        self.pump()
+        wrote = 0
+        for key, pdb in list(self._pdbs.store.items()):
+            healthy = expected = 0
+            for pod in self._pods.store.values():
+                if pod.namespace != pdb.namespace:
+                    continue
+                if pod.phase in ("Succeeded", "Failed"):
+                    continue   # terminal pods are neither expected nor healthy
+                if pdb.selector is None or not label_selector_matches(
+                    pdb.selector, pod.labels_dict()
+                ):
+                    continue
+                expected += 1
+                if pod.node_name:
+                    healthy += 1
+            allowed = compute_allowed(pdb, healthy, expected)
+            if allowed == pdb.disruptions_allowed:
+                continue
+            _, rv = self.store.get(PDBS, key)
+            if rv == 0:
+                continue
+            try:
+                self.store.update(
+                    PDBS, key,
+                    dataclasses.replace(pdb, disruptions_allowed=allowed),
+                    expect_rv=rv,
+                )
+            except ConflictError:
+                continue
+            wrote += 1
+            self.updates += 1
+        return wrote

@@ -80,10 +80,13 @@ class NodeLifecycleController:
         self._r_leases.sync()
         self._mark_first_seen(self.clock())
 
-    def pump(self) -> int:
+    def pump(self, now: float | None = None) -> int:
+        """``now`` keeps discovery timestamps on the caller's timebase when
+        reconciliation is driven via ``step(now=…)`` — mixing a simulated
+        'now' with the wall clock would skew no-lease staleness."""
         n = self._r_nodes.step() + self._r_leases.step()
         if n:
-            self._mark_first_seen(self.clock())
+            self._mark_first_seen(self.clock() if now is None else now)
         return n
 
     def _mark_first_seen(self, now: float) -> None:
@@ -103,7 +106,7 @@ class NodeLifecycleController:
     def step(self, now: float | None = None) -> int:
         """One reconcile pass; returns taint transitions written."""
         now = self.clock() if now is None else now
-        self.pump()
+        self.pump(now)
         wrote = 0
         for name, node in list(self._nodes.store.items()):
             stale = self._stale(name, now)

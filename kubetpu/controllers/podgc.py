@@ -1,0 +1,61 @@
+"""Pod garbage collector — orphans and terminated pods.
+
+Reference: ``pkg/controller/podgc`` (gc_controller.go): deletes (a) orphaned
+pods — bound to a node that no longer exists (gcOrphaned), and (b)
+terminated pods (Succeeded/Failed) beyond a retention threshold
+(gcTerminated, --terminated-pod-gc-threshold; 0 disables). Unscheduled
+terminating pods are out of scope here (no deletionTimestamp model).
+"""
+
+from __future__ import annotations
+
+from ..client.informers import NODES, PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import MemStore
+
+TERMINAL_PHASES = ("Succeeded", "Failed")
+
+
+class PodGCController:
+    def __init__(
+        self, store: MemStore, terminated_threshold: int = 0
+    ) -> None:
+        self.store = store
+        self.terminated_threshold = terminated_threshold
+        self._nodes = SharedInformer(NODES)
+        self._pods = SharedInformer(PODS)
+        self._r = [Reflector(store, self._nodes), Reflector(store, self._pods)]
+        self.deleted = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    def step(self) -> int:
+        self.pump()
+        known_nodes = set(self._nodes.store)
+        removed = 0
+        terminated: list[tuple[int, str]] = []
+        for key, pod in list(self._pods.store.items()):
+            if pod.node_name and pod.node_name not in known_nodes:
+                removed += self._delete(key)
+            elif pod.phase in TERMINAL_PHASES:
+                terminated.append((pod.creation_index, key))
+        if self.terminated_threshold and len(terminated) > self.terminated_threshold:
+            # oldest first, down to the threshold (gcTerminated)
+            terminated.sort()
+            excess = len(terminated) - self.terminated_threshold
+            for _, key in terminated[:excess]:
+                removed += self._delete(key)
+        return removed
+
+    def _delete(self, key: str) -> int:
+        try:
+            self.store.delete(PODS, key)
+        except KeyError:
+            return 0
+        self.deleted += 1
+        return 1

@@ -1,0 +1,125 @@
+"""ReplicaSet controller — the canonical reconcile loop.
+
+Reference: ``pkg/controller/replicaset`` (replica_set.go:755
+``syncReplicaSet``): diff desired replicas against the filtered actual pods
+(selector match + ownership), then batched create/delete through the API.
+Deletion prefers the pods a user would miss least — unscheduled before
+running (getPodsToDelete's ActivePods ranking); creation stamps the pod
+template with a unique name and the owner reference.
+
+Ownership here is the ``owner`` slice ("ReplicaSet/<ns>/<name>"); pods
+matching the selector without an owner are adopted
+(controller_ref_manager.go's adoption), pods owned by someone else are
+ignored.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from ..api.selectors import label_selector_matches
+from ..client.informers import PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import ConflictError, MemStore
+
+REPLICA_SETS = "replicasets"
+
+
+def _owner_ref(rs: t.ReplicaSet) -> str:
+    return f"ReplicaSet/{rs.namespace}/{rs.name}"
+
+
+class ReplicaSetController:
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self._rs = SharedInformer(REPLICA_SETS)
+        self._pods = SharedInformer(PODS)
+        self._r = [Reflector(store, self._rs), Reflector(store, self._pods)]
+        self._seq: dict[str, int] = {}   # per-RS name sequence
+        self.creates = 0
+        self.deletes = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    # ----------------------------------------------------------- reconcile
+    def step(self) -> int:
+        """One pass of syncReplicaSet over every RS; returns write count."""
+        self.pump()
+        wrote = 0
+        for key, rs in list(self._rs.store.items()):
+            wrote += self._sync(rs)
+        return wrote
+
+    def _claimed(self, rs: t.ReplicaSet) -> list[tuple[str, t.Pod]]:
+        ref = _owner_ref(rs)
+        out = []
+        for key, pod in self._pods.store.items():
+            if pod.namespace != rs.namespace:
+                continue
+            if pod.phase in ("Succeeded", "Failed"):
+                # FilterActivePods (controller_utils.go): terminal pods do
+                # not count toward replicas — a Failed pod gets replaced
+                continue
+            if pod.owner and pod.owner != ref:
+                continue
+            if rs.selector is not None and not label_selector_matches(
+                rs.selector, pod.labels_dict()
+            ):
+                continue
+            if not pod.owner:
+                # adoption: claim the orphan (controller_ref_manager)
+                adopted = dataclasses.replace(pod, owner=ref)
+                _, rv = self.store.get(PODS, key)
+                if rv:
+                    try:
+                        self.store.update(PODS, key, adopted, expect_rv=rv)
+                        pod = adopted
+                    except ConflictError:
+                        continue
+            out.append((key, pod))
+        return out
+
+    def _sync(self, rs: t.ReplicaSet) -> int:
+        pods = self._claimed(rs)
+        diff = rs.replicas - len(pods)
+        wrote = 0
+        if diff > 0 and rs.template is not None:
+            ref = _owner_ref(rs)
+            for _ in range(diff):
+                self._seq[rs.key] = self._seq.get(rs.key, 0) + 1
+                name = f"{rs.name}-{self._seq[rs.key]}"
+                pod = dataclasses.replace(
+                    rs.template,
+                    name=name,
+                    namespace=rs.namespace,
+                    uid=f"{rs.namespace}/{name}",
+                    owner=ref,
+                    node_name="",
+                    phase="Pending",
+                )
+                try:
+                    self.store.create(PODS, f"{rs.namespace}/{name}", pod)
+                except ConflictError:
+                    continue
+                self.creates += 1
+                wrote += 1
+        elif diff < 0:
+            # scale down: unscheduled first, then newest (ActivePods rank)
+            ranked = sorted(
+                pods,
+                key=lambda kv: (bool(kv[1].node_name), -kv[1].creation_index),
+            )
+            for key, _pod in ranked[: -diff]:
+                try:
+                    self.store.delete(PODS, key)
+                except KeyError:
+                    continue
+                self.deletes += 1
+                wrote += 1
+        return wrote

@@ -1,0 +1,120 @@
+"""Taint eviction controller — NoExecute taints evict intolerant pods.
+
+Reference: ``pkg/controller/tainteviction`` (taint_eviction.go): when a node
+carries NoExecute taints, every pod on it either tolerates ALL of them
+(possibly with a ``tolerationSeconds`` deadline — the pod is evicted when
+the shortest deadline fires) or is evicted immediately. Recovery (taints
+removed) cancels pending evictions.
+
+Same controller shape as nodelifecycle: informers over nodes + pods,
+``step(now)`` reconciles, deletions go through the store so the eviction is
+one more watch event every other component observes.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+from ..api import types as t
+from ..api.selectors import tolerates
+from ..client.informers import NODES, PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import MemStore
+
+
+def _no_execute(node: t.Node) -> tuple[t.Taint, ...]:
+    return tuple(
+        tt for tt in node.taints if tt.effect == t.TaintEffect.NO_EXECUTE
+    )
+
+
+def min_toleration_seconds(
+    pod: t.Pod, taints: tuple[t.Taint, ...]
+) -> float | None:
+    """The eviction deadline: None = evict NOW (some taint intolerated);
+    +inf = never; otherwise the MINIMUM tolerationSeconds across every USED
+    toleration with one set (getMinTolerationTime :161 over the
+    usedTolerations — nil-seconds tolerations are skipped, all-nil means
+    infinite, non-positive means immediate)."""
+    used: list[t.Toleration] = []
+    for taint in taints:
+        matching = [
+            tol for tol in pod.tolerations if tolerates(tol, taint)
+        ]
+        if not matching:
+            return None
+        used.extend(matching)
+    deadline = float("inf")
+    for tol in used:
+        if tol.toleration_seconds is None:
+            continue
+        if tol.toleration_seconds <= 0:
+            return 0.0
+        deadline = min(deadline, tol.toleration_seconds)
+    return deadline
+
+
+class TaintEvictionController:
+    """See module docstring."""
+
+    def __init__(
+        self, store: MemStore, clock: Callable[[], float] | None = None
+    ) -> None:
+        import time
+
+        self.store = store
+        self.clock = clock or time.monotonic
+        self._nodes = SharedInformer(NODES)
+        self._pods = SharedInformer(PODS)
+        self._r = [Reflector(store, self._nodes), Reflector(store, self._pods)]
+        # (pod key) -> absolute eviction deadline
+        self._pending: dict[str, float] = {}
+        self.evictions = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    def step(self, now: float | None = None) -> int:
+        now = self.clock() if now is None else now
+        self.pump()
+        taints_by_node: dict[str, tuple[t.Taint, ...]] = {}
+        for name, node in self._nodes.store.items():
+            ne = _no_execute(node)
+            if ne:
+                taints_by_node[name] = ne
+        evicted = 0
+        seen: set[str] = set()
+        for key, pod in list(self._pods.store.items()):
+            if not pod.node_name:
+                continue
+            taints = taints_by_node.get(pod.node_name)
+            if not taints:
+                self._pending.pop(key, None)   # recovery cancels
+                continue
+            seen.add(key)
+            wait = min_toleration_seconds(pod, taints)
+            if wait is None:
+                evicted += self._evict(key)
+            elif wait == float("inf"):
+                self._pending.pop(key, None)
+            else:
+                deadline = self._pending.setdefault(key, now + wait)
+                if now >= deadline:
+                    evicted += self._evict(key)
+        for key in list(self._pending):
+            if key not in seen:
+                del self._pending[key]
+        return evicted
+
+    def _evict(self, key: str) -> int:
+        self._pending.pop(key, None)
+        try:
+            self.store.delete(PODS, key)
+        except KeyError:
+            return 0
+        self.evictions += 1
+        return 1

@@ -1,0 +1,3 @@
+"""Node agent tier: the hollow kubelet (kubemark analog)."""
+
+from .hollow import HollowKubelet, HollowCluster  # noqa: F401

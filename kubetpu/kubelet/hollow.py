@@ -1,0 +1,130 @@
+"""Hollow kubelet — the kubemark tier (layer 7, scale-test shape).
+
+Reference: ``pkg/kubemark/hollow_kubelet.go:62`` — real kubelet wiring
+against a fake CRI so thousands of nodes run on a few machines; the
+scheduler-facing duties are what matter: register the Node object,
+heartbeat its lease (pkg/kubelet/nodelease), watch for pods bound to it,
+and report them Running (status sync, pkg/kubelet/status). That envelope is
+exactly what this HollowKubelet implements over the store — enough to run a
+full closed loop (scheduler + controllers + N hollow nodes) in one process,
+the way scheduler_perf/kubemark test multi-node behavior without a cluster
+(SURVEY §4 'Multi-node without a real cluster').
+
+A DRA-capable hollow node also publishes its ResourceSlice (the node
+driver's kubelet plugin half).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+from ..api import types as t
+from ..client.informers import NODES, PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..controllers.nodelifecycle import heartbeat as nl_heartbeat
+from ..store.memstore import ConflictError, MemStore
+
+
+class HollowKubelet:
+    def __init__(
+        self,
+        store: MemStore,
+        node: t.Node,
+        resource_slice: t.ResourceSlice | None = None,
+        clock: Callable[[], float] | None = None,
+    ) -> None:
+        import time
+
+        self.store = store
+        self.node = node
+        self.resource_slice = resource_slice
+        self.clock = clock or time.monotonic
+        self._pods = SharedInformer(PODS)
+        self._r = Reflector(store, self._pods)
+        self.alive = True
+        self.running: set[str] = set()
+
+    # ---------------------------------------------------------- lifecycle
+    def start(self) -> None:
+        """Register the node (+ its device inventory) and begin watching."""
+        self.store.update(NODES, self.node.name, self.node)
+        if self.resource_slice is not None:
+            self.store.update(
+                "resourceslices", self.resource_slice.name,
+                self.resource_slice,
+            )
+        self._r.sync()
+        self.heartbeat()
+
+    def stop(self) -> None:
+        """Simulate kubelet death: heartbeats cease (the node object
+        remains — nodelifecycle will taint it)."""
+        self.alive = False
+
+    def heartbeat(self) -> None:
+        if self.alive:
+            nl_heartbeat(self.store, self.node.name, self.clock())
+
+    # --------------------------------------------------------------- sync
+    def pump(self) -> int:
+        """One syncLoop iteration: heartbeat + mark newly bound pods
+        Running (syncLoopIteration's HandlePodAdditions → status sync)."""
+        self.heartbeat()
+        if not self.alive:
+            return 0
+        self._r.step()
+        moved = 0
+        for key, pod in list(self._pods.store.items()):
+            if pod.node_name != self.node.name:
+                self.running.discard(key)
+                continue
+            if key in self.running or pod.phase != "Pending":
+                continue
+            _, rv = self.store.get(PODS, key)
+            if rv == 0:
+                continue
+            try:
+                self.store.update(
+                    PODS, key,
+                    dataclasses.replace(pod, phase="Running"),
+                    expect_rv=rv,
+                )
+            except ConflictError:
+                continue
+            self.running.add(key)
+            moved += 1
+        return moved
+
+
+class HollowCluster:
+    """N hollow nodes + one pump loop (start-kubemark.sh in a for-loop)."""
+
+    def __init__(
+        self,
+        store: MemStore,
+        nodes: list[t.Node],
+        slices: dict[str, t.ResourceSlice] | None = None,
+        clock: Callable[[], float] | None = None,
+    ) -> None:
+        self.kubelets = [
+            HollowKubelet(
+                store, n,
+                resource_slice=(slices or {}).get(n.name),
+                clock=clock,
+            )
+            for n in nodes
+        ]
+
+    def start(self) -> None:
+        for k in self.kubelets:
+            k.start()
+
+    def pump(self) -> int:
+        return sum(k.pump() for k in self.kubelets)
+
+    def kubelet(self, node_name: str) -> HollowKubelet:
+        for k in self.kubelets:
+            if k.node.name == node_name:
+                return k
+        raise KeyError(node_name)

@@ -141,17 +141,31 @@ class MemStore:
                 )
         return Watcher(self, kind, since_rv)
 
-    def _events_since(self, kind: str | None, rv: int) -> list[WatchEvent]:
+    def _events_since(
+        self, kind: str | None, rv: int
+    ) -> tuple[list[WatchEvent], int]:
+        """Returns ``(matching events, new cursor)`` — the cursor covers
+        every event examined (matching or not), so a kind-filtered watcher
+        never re-scans other kinds' events."""
         with self._lock:
             if rv < self._compacted_through:
                 raise CompactedError(
                     f"rv {rv} compacted (through {self._compacted_through})"
                 )
-            return [
-                e for e in self._events
-                if e.resource_version > rv
-                and (kind is None or e.kind == kind)
-            ]
+            # hot path: N reflectors poll every cycle; an up-to-date cursor
+            # must be O(1), and a behind cursor must only touch events NEWER
+            # than it (events are rv-ordered) — never the whole ring buffer
+            if not self._events or self._events[-1].resource_version <= rv:
+                return [], rv
+            cursor = self._events[-1].resource_version
+            out: list[WatchEvent] = []
+            for e in reversed(self._events):
+                if e.resource_version <= rv:
+                    break
+                if kind is None or e.kind == kind:
+                    out.append(e)
+            out.reverse()
+            return out, cursor
 
     def wait_for(self, rv: int, timeout: float | None = None) -> bool:
         """Block until the store moves past ``rv`` (thread form)."""
@@ -176,7 +190,5 @@ class Watcher:
     def poll(self) -> list[WatchEvent]:
         """New events since the cursor; raises CompactedError when the
         cursor fell behind the ring buffer (caller relists)."""
-        events = self._store._events_since(self._kind, self._rv)
-        if events:
-            self._rv = events[-1].resource_version
+        events, self._rv = self._store._events_since(self._kind, self._rv)
         return events

@@ -1,0 +1,353 @@
+"""Controllers (tainteviction, podgc, disruption, replicaset) + the hollow
+kubelet tier, culminating in the closed-loop cluster test: ReplicaSet →
+pods → scheduler → hollow kubelets → node death → taint → eviction →
+reschedule — every transition flowing through the store's watch.
+
+Reference semantics: pkg/controller/tainteviction (tolerationSeconds
+deadlines), pkg/controller/podgc (gcOrphaned/gcTerminated),
+pkg/controller/disruption (status.disruptionsAllowed math),
+pkg/controller/replicaset (syncReplicaSet diff + ActivePods deletion
+ranking), pkg/kubemark/hollow_kubelet.go (the hollow node tier).
+"""
+
+import dataclasses
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.client import SchedulerInformers, StoreClient
+from kubetpu.client.informers import NODES, PDBS, PODS
+from kubetpu.controllers import (
+    REPLICA_SETS,
+    DisruptionController,
+    NodeLifecycleController,
+    PodGCController,
+    ReplicaSetController,
+    TaintEvictionController,
+    heartbeat,
+)
+from kubetpu.framework import config as C
+from kubetpu.kubelet import HollowCluster
+from kubetpu.sched import Scheduler
+from kubetpu.store import MemStore
+
+from .test_scheduler import FakeClock
+
+UNREACHABLE = t.Taint(
+    key="node.kubernetes.io/unreachable", effect=t.TaintEffect.NO_EXECUTE
+)
+
+
+# ------------------------------------------------------------ tainteviction
+
+def test_tainteviction_immediate_and_deadline():
+    st = MemStore()
+    clock = [0.0]
+    st.create(NODES, "n0", make_node("n0", taints=(UNREACHABLE,)))
+    st.create(PODS, "default/bare", make_pod("bare", node_name="n0"))
+    tolerant = make_pod(
+        "patient", node_name="n0",
+        tolerations=(t.Toleration(
+            key=UNREACHABLE.key, operator=t.TolerationOperator.EXISTS,
+            toleration_seconds=30.0,
+        ),),
+    )
+    st.create(PODS, "default/patient", tolerant)
+    forever = make_pod(
+        "forever", node_name="n0",
+        tolerations=(t.Toleration(
+            key=UNREACHABLE.key, operator=t.TolerationOperator.EXISTS,
+        ),),
+    )
+    st.create(PODS, "default/forever", forever)
+    ctrl = TaintEvictionController(st, clock=lambda: clock[0])
+    ctrl.start()
+    assert ctrl.step() == 1            # bare pod evicted immediately
+    assert st.get(PODS, "default/bare")[0] is None
+    clock[0] += 29
+    assert ctrl.step() == 0            # deadline not reached
+    clock[0] += 2
+    assert ctrl.step() == 1            # tolerationSeconds expired
+    assert st.get(PODS, "default/patient")[0] is None
+    assert st.get(PODS, "default/forever")[0] is not None
+
+
+def test_tainteviction_recovery_cancels_pending():
+    st = MemStore()
+    clock = [0.0]
+    node = make_node("n0", taints=(UNREACHABLE,))
+    st.create(NODES, "n0", node)
+    st.create(PODS, "default/p", make_pod(
+        "p", node_name="n0",
+        tolerations=(t.Toleration(
+            key=UNREACHABLE.key, operator=t.TolerationOperator.EXISTS,
+            toleration_seconds=10.0,
+        ),),
+    ))
+    ctrl = TaintEvictionController(st, clock=lambda: clock[0])
+    ctrl.start()
+    ctrl.step()
+    # taint removed before the deadline
+    st.update(NODES, "n0", dataclasses.replace(node, taints=()))
+    clock[0] += 60
+    assert ctrl.step() == 0
+    assert st.get(PODS, "default/p")[0] is not None
+
+
+# -------------------------------------------------------------------- podgc
+
+def test_podgc_orphans_and_terminated():
+    st = MemStore()
+    st.create(NODES, "n0", make_node("n0"))
+    st.create(PODS, "default/orphan", make_pod("orphan", node_name="gone"))
+    st.create(PODS, "default/ok", make_pod("ok", node_name="n0"))
+    for i in range(4):
+        st.create(PODS, f"default/done{i}", dataclasses.replace(
+            make_pod(f"done{i}", node_name="n0", creation_index=i),
+            phase="Succeeded",
+        ))
+    gc = PodGCController(st, terminated_threshold=2)
+    gc.start()
+    removed = gc.step()
+    assert removed == 3        # 1 orphan + 2 oldest terminated
+    assert st.get(PODS, "default/orphan")[0] is None
+    assert st.get(PODS, "default/done0")[0] is None
+    assert st.get(PODS, "default/done3")[0] is not None
+    assert st.get(PODS, "default/ok")[0] is not None
+
+
+# --------------------------------------------------------------- disruption
+
+def test_disruption_controller_maintains_allowed():
+    st = MemStore()
+    pdb = t.PodDisruptionBudget(
+        name="web-pdb", selector=t.LabelSelector.of({"app": "web"}),
+        min_available=2,
+    )
+    st.create(PDBS, pdb.key, pdb)
+    for i in range(3):
+        st.create(PODS, f"default/w{i}", make_pod(
+            f"w{i}", labels={"app": "web"}, node_name="n0",
+        ))
+    ctrl = DisruptionController(st)
+    ctrl.start()
+    assert ctrl.step() == 1
+    assert st.get(PDBS, "default/web-pdb")[0].disruptions_allowed == 1
+    # one pod dies → allowed drops to 0
+    st.delete(PODS, "default/w0")
+    assert ctrl.step() == 1
+    assert st.get(PDBS, "default/web-pdb")[0].disruptions_allowed == 0
+    # maxUnavailable form
+    pdb2 = t.PodDisruptionBudget(
+        name="mu", selector=t.LabelSelector.of({"app": "web"}),
+        max_unavailable=1,
+    )
+    st.create(PDBS, pdb2.key, pdb2)
+    assert ctrl.step() == 1
+    assert st.get(PDBS, "default/mu")[0].disruptions_allowed == 1
+
+
+# --------------------------------------------------------------- replicaset
+
+def test_replicaset_scales_up_adopts_and_scales_down():
+    st = MemStore()
+    rs = t.ReplicaSet(
+        name="web", replicas=3,
+        selector=t.LabelSelector.of({"app": "web"}),
+        template=make_pod("tpl", labels={"app": "web"}, cpu_milli=100),
+    )
+    st.create(REPLICA_SETS, rs.key, rs)
+    # one matching orphan pre-exists: adopted, only 2 created
+    st.create(PODS, "default/stray", make_pod("stray", labels={"app": "web"}))
+    ctrl = ReplicaSetController(st)
+    ctrl.start()
+    ctrl.step()
+    pods, _ = st.list(PODS)
+    assert len(pods) == 3
+    assert st.get(PODS, "default/stray")[0].owner == "ReplicaSet/default/web"
+    assert ctrl.creates == 2
+    # scale down to 1: unscheduled pods go first
+    st.update(PODS, "default/stray",
+              st.get(PODS, "default/stray")[0].with_node("n0"))
+    st.update(REPLICA_SETS, rs.key, dataclasses.replace(rs, replicas=1))
+    ctrl.step()
+    pods, _ = st.list(PODS)
+    assert [p.name for _, p in pods] == ["stray"]   # the bound one survives
+
+
+def test_replicaset_steady_state_is_quiet():
+    st = MemStore()
+    rs = t.ReplicaSet(
+        name="quiet", replicas=2,
+        selector=t.LabelSelector.of({"app": "q"}),
+        template=make_pod("tpl", labels={"app": "q"}),
+    )
+    st.create(REPLICA_SETS, rs.key, rs)
+    ctrl = ReplicaSetController(st)
+    ctrl.start()
+    assert ctrl.step() == 2
+    assert ctrl.step() == 0    # converged: no churn
+    assert ctrl.step() == 0
+
+
+# ------------------------------------------------------------ hollow kubelet
+
+def test_hollow_kubelet_runs_bound_pods():
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(
+        st, [make_node("n0", cpu_milli=2000)], clock=lambda: clock[0]
+    )
+    cluster.start()
+    assert st.get(NODES, "n0")[0] is not None
+    st.create(PODS, "default/p", make_pod("p", node_name="n0"))
+    assert cluster.pump() == 1
+    assert st.get(PODS, "default/p")[0].phase == "Running"
+    assert cluster.pump() == 0   # idempotent
+
+
+# ----------------------------------------------------- the closed-loop test
+
+def test_closed_loop_cluster_node_death_and_reschedule():
+    """The whole control plane in one process: ReplicaSet stamps pods, the
+    scheduler binds them, hollow kubelets run them; one node dies →
+    nodelifecycle taints → tainteviction evicts → replicaset re-creates →
+    scheduler places the replacements on surviving nodes."""
+    st = MemStore()
+    clock = [0.0]
+    nodes = [make_node(f"n{i}", cpu_milli=4000, pods=16) for i in range(3)]
+    cluster = HollowCluster(st, nodes, clock=lambda: clock[0])
+    cluster.start()
+    rs = t.ReplicaSet(
+        name="app", replicas=6,
+        selector=t.LabelSelector.of({"app": "demo"}),
+        template=make_pod("tpl", labels={"app": "demo"}, cpu_milli=200),
+    )
+    st.create(REPLICA_SETS, rs.key, rs)
+
+    rs_ctrl = ReplicaSetController(st)
+    nl_ctrl = NodeLifecycleController(st, grace_s=40.0, clock=lambda: clock[0])
+    te_ctrl = TaintEvictionController(st, clock=lambda: clock[0])
+    for c in (rs_ctrl, nl_ctrl, te_ctrl):
+        c.start()
+
+    sched_clock = FakeClock()
+    sched = Scheduler(
+        StoreClient(st), profile=C.Profile(),
+        dispatcher_workers=0, clock=sched_clock,
+    )
+    informers = SchedulerInformers(st, sched)
+    informers.start()
+
+    def converge(steps: int = 12) -> None:
+        for _ in range(steps):
+            rs_ctrl.step()
+            nl_ctrl.step()
+            te_ctrl.step()
+            cluster.pump()
+            informers.pump()
+            sched.schedule_batch()
+            sched.dispatcher.sync()
+            sched._drain_bind_completions()
+            sched_clock.tick(2)   # clear backoffs between passes
+
+    converge()
+    pods, _ = st.list(PODS)
+    assert len(pods) == 6
+    assert all(p.node_name and p.phase == "Running" for _, p in pods)
+    per_node = {}
+    for _, p in pods:
+        per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+
+    # n2's kubelet dies
+    cluster.kubelet("n2").stop()
+    lost = per_node.get("n2", 0)
+    clock[0] += 41     # past the monitor grace period
+    cluster.pump()     # survivors heartbeat before the monitor looks (the
+    #                    test's discrete clock jump would otherwise stale
+    #                    EVERY lease at once — real heartbeats are continuous)
+    converge()
+    pods, _ = st.list(PODS)
+    assert len(pods) == 6
+    assert all(p.node_name in ("n0", "n1") for _, p in pods), [
+        (p.name, p.node_name) for _, p in pods
+    ]
+    assert all(p.phase == "Running" for _, p in pods)
+    assert te_ctrl.evictions == lost
+    assert rs_ctrl.creates == 6 + lost
+
+
+# ---------------------------------------------- review-fix regression tests
+
+def test_replicaset_replaces_failed_pods():
+    """FilterActivePods: a Failed pod does not count toward replicas."""
+    st = MemStore()
+    rs = t.ReplicaSet(
+        name="r", replicas=2, selector=t.LabelSelector.of({"app": "r"}),
+        template=make_pod("tpl", labels={"app": "r"}),
+    )
+    st.create(REPLICA_SETS, rs.key, rs)
+    ctrl = ReplicaSetController(st)
+    ctrl.start()
+    ctrl.step()
+    pods, _ = st.list(PODS)
+    key = pods[0][0]
+    st.update(PODS, key, dataclasses.replace(pods[0][1], phase="Failed"))
+    assert ctrl.step() == 1   # replacement created
+    live = [
+        p for _, p in st.list(PODS)[0] if p.phase != "Failed"
+    ]
+    assert len(live) == 2
+
+
+def test_min_toleration_seconds_takes_minimum():
+    from kubetpu.controllers.tainteviction import min_toleration_seconds
+
+    pod = make_pod("p", tolerations=(
+        t.Toleration(key=UNREACHABLE.key,
+                     operator=t.TolerationOperator.EXISTS,
+                     toleration_seconds=300.0),
+        t.Toleration(key=UNREACHABLE.key,
+                     operator=t.TolerationOperator.EXISTS,
+                     toleration_seconds=5.0),
+    ))
+    assert min_toleration_seconds(pod, (UNREACHABLE,)) == 5.0
+    # all-nil seconds = forever; any unmatched taint = evict now
+    pod2 = make_pod("p2", tolerations=(
+        t.Toleration(key=UNREACHABLE.key,
+                     operator=t.TolerationOperator.EXISTS),
+    ))
+    assert min_toleration_seconds(pod2, (UNREACHABLE,)) == float("inf")
+    assert min_toleration_seconds(make_pod("p3"), (UNREACHABLE,)) is None
+
+
+def test_disruption_ignores_terminal_pods():
+    st = MemStore()
+    pdb = t.PodDisruptionBudget(
+        name="x", selector=t.LabelSelector.of({"app": "x"}), min_available=1,
+    )
+    st.create(PDBS, pdb.key, pdb)
+    st.create(PODS, "default/live", make_pod(
+        "live", labels={"app": "x"}, node_name="n0"))
+    st.create(PODS, "default/done", dataclasses.replace(make_pod(
+        "done", labels={"app": "x"}, node_name="n0"), phase="Succeeded"))
+    ctrl = DisruptionController(st)
+    ctrl.start()
+    ctrl.step()
+    # healthy=1 (the Succeeded pod is excluded): no disruption headroom
+    assert st.get(PDBS, "default/x")[0].disruptions_allowed == 0
+
+
+def test_nodelifecycle_simulated_clock_only():
+    """Driving step(now=...) with a simulated epoch must not mix in the
+    wall clock for first-seen discovery."""
+    st = MemStore()
+    ctrl = NodeLifecycleController(st, grace_s=40.0, clock=lambda: 0.0)
+    ctrl.start()
+    st.create(NODES, "late", make_node("late"))
+    assert ctrl.step(now=5.0) == 0     # discovered at simulated t=5
+    assert ctrl.step(now=44.0) == 0    # 39s since discovery: not stale
+    assert ctrl.step(now=46.0) == 1    # 41s: tainted
