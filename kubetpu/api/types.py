@@ -251,6 +251,11 @@ class Pod:
     # metadata.ownerReferences slice: the controller that stamped this pod
     # ("kind/namespace/name"), consumed by replicaset adoption
     owner: str = ""
+    # the feature set InferForPodScheduling derives from the spec
+    # (component-helpers/nodedeclaredfeatures) — explicit here because the
+    # envelope carries aggregated specs; NodeDeclaredFeatures Filter
+    # requires it to be a subset of the node's declared_features
+    required_node_features: tuple[str, ...] = ()
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -600,6 +605,9 @@ class Node:
     taints: tuple[Taint, ...] = ()
     unschedulable: bool = False
     images: tuple[tuple[str, ImageState], ...] = ()
+    # status.declaredFeatures (core/v1 types.go:6828, +featureGate=
+    # NodeDeclaredFeatures): kubelet-declared feature names
+    declared_features: tuple[str, ...] = ()
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)

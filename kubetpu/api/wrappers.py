@@ -20,6 +20,7 @@ def make_node(
     extended: Mapping[str, int] | None = None,
     unschedulable: bool = False,
     images: Mapping[str, t.ImageState] | None = None,
+    declared_features: Sequence[str] = (),
 ) -> t.Node:
     alloc: dict[str, int] = {t.CPU: cpu_milli, t.MEMORY: memory, t.PODS: pods}
     if ephemeral:
@@ -30,6 +31,7 @@ def make_node(
         name=name,
         labels=t.freeze_map(labels),
         allocatable=t.freeze_map(alloc),
+        declared_features=tuple(sorted(declared_features)),
         taints=tuple(taints),
         unschedulable=unschedulable,
         images=tuple(sorted((images or {}).items())),
@@ -62,6 +64,7 @@ def make_pod(
     scheduling_group: str = "",
     pvcs: Sequence[str] = (),
     claims: Sequence[str] = (),
+    required_features: Sequence[str] = (),
     scheduler_name: str = "default-scheduler",
 ) -> t.Pod:
     nonzero = None
@@ -113,6 +116,7 @@ def make_pod(
             t.PodResourceClaim(name=f"claim-{i}", claim_name=c)
             for i, c in enumerate(claims)
         ),
+        required_node_features=tuple(sorted(required_features)),
         scheduler_name=scheduler_name,
     )
 

@@ -80,11 +80,21 @@ class StoreClient:
     def update_claim_status(self, claim: t.ResourceClaim) -> None:
         # a claim deleted mid-binding must NOT be resurrected by the status
         # write (the bind() deleted-pod rule, applied to claims): CAS
-        # against the live object, skip if gone
-        current, rv = self.store.get(RESOURCE_CLAIMS, claim.key)
-        if current is None:
-            return
-        self.store.update(RESOURCE_CLAIMS, claim.key, claim, expect_rv=rv)
+        # against the live object, skip if gone — including the race where
+        # it vanishes between the get and the update
+        from ..store.memstore import ConflictError
+
+        for _ in range(3):
+            current, rv = self.store.get(RESOURCE_CLAIMS, claim.key)
+            if current is None:
+                return
+            try:
+                self.store.update(
+                    RESOURCE_CLAIMS, claim.key, claim, expect_rv=rv
+                )
+                return
+            except ConflictError:
+                continue
 
 
 class SchedulerInformers:

@@ -72,13 +72,15 @@ class DisruptionController:
             allowed = compute_allowed(pdb, healthy, expected)
             if allowed == pdb.disruptions_allowed:
                 continue
-            _, rv = self.store.get(PDBS, key)
-            if rv == 0:
+            # CAS against the LIVE object — basing the write on the stale
+            # informer copy would silently revert concurrent spec changes
+            live, rv = self.store.get(PDBS, key)
+            if live is None:
                 continue
             try:
                 self.store.update(
                     PDBS, key,
-                    dataclasses.replace(pdb, disruptions_allowed=allowed),
+                    dataclasses.replace(live, disruptions_allowed=allowed),
                     expect_rv=rv,
                 )
             except ConflictError:

@@ -41,6 +41,12 @@ class PodGCController:
         terminated: list[tuple[int, str]] = []
         for key, pod in list(self._pods.store.items()):
             if pod.node_name and pod.node_name not in known_nodes:
+                # re-check the LIVE store before deleting: the pods poll may
+                # have seen a bind to a node registered after the nodes
+                # poll (the reference quarantines orphan candidates and
+                # re-checks the node for the same reason)
+                if self.store.get(NODES, pod.node_name)[0] is not None:
+                    continue
                 removed += self._delete(key)
             elif pod.phase in TERMINAL_PHASES:
                 terminated.append((pod.creation_index, key))

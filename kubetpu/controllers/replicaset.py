@@ -73,15 +73,18 @@ class ReplicaSetController:
             ):
                 continue
             if not pod.owner:
-                # adoption: claim the orphan (controller_ref_manager)
-                adopted = dataclasses.replace(pod, owner=ref)
-                _, rv = self.store.get(PODS, key)
-                if rv:
-                    try:
-                        self.store.update(PODS, key, adopted, expect_rv=rv)
-                        pod = adopted
-                    except ConflictError:
-                        continue
+                # adoption: claim the orphan (controller_ref_manager),
+                # writing through the LIVE object so a concurrent spec
+                # change isn't clobbered
+                live, rv = self.store.get(PODS, key)
+                if live is None:
+                    continue   # deleted concurrently: not a replica
+                try:
+                    adopted = dataclasses.replace(live, owner=ref)
+                    self.store.update(PODS, key, adopted, expect_rv=rv)
+                    pod = adopted
+                except ConflictError:
+                    pass       # still counts; next sync retries adoption
             out.append((key, pod))
         return out
 
@@ -102,6 +105,9 @@ class ReplicaSetController:
                     owner=ref,
                     node_name="",
                     phase="Pending",
+                    # creation order feeds the scale-down newest-first rank,
+                    # podgc's oldest-first GC, and the queue tiebreak
+                    creation_index=self._seq[rs.key],
                 )
                 try:
                     self.store.create(PODS, f"{rs.namespace}/{name}", pod)

@@ -67,8 +67,11 @@ class TaintEvictionController:
         self._nodes = SharedInformer(NODES)
         self._pods = SharedInformer(PODS)
         self._r = [Reflector(store, self._nodes), Reflector(store, self._pods)]
-        # (pod key) -> absolute eviction deadline
-        self._pending: dict[str, float] = {}
+        # pod key -> (absolute eviction deadline, the wait it was based on):
+        # a changed taint set / toleration changes the wait, which CANCELS
+        # and reschedules the eviction (the reference's CancelWork +
+        # re-schedule on taint updates)
+        self._pending: dict[str, tuple[float, float]] = {}
         self.evictions = 0
 
     def start(self) -> None:
@@ -102,8 +105,12 @@ class TaintEvictionController:
             elif wait == float("inf"):
                 self._pending.pop(key, None)
             else:
-                deadline = self._pending.setdefault(key, now + wait)
-                if now >= deadline:
+                prev = self._pending.get(key)
+                if prev is None or prev[1] != wait:
+                    # first sight, or the effective wait changed: reschedule
+                    prev = (now + wait, wait)
+                    self._pending[key] = prev
+                if now >= prev[0]:
                     evicted += self._evict(key)
         for key in list(self._pending):
             if key not in seen:

@@ -44,6 +44,7 @@ KNOWN_FEATURES: dict[str, FeatureSpec] = {
     # DRA core is GA (resource.k8s.io/v1, kube_features.go DynamicResource-
     # Allocation); the prioritized-list extension is beta default-on
     "DynamicResourceAllocation": FeatureSpec(True, GA),
+    "NodeDeclaredFeatures": FeatureSpec(False, ALPHA),
     "DRAPrioritizedList": FeatureSpec(True, BETA),
 }
 

@@ -81,13 +81,15 @@ class HollowKubelet:
                 continue
             if key in self.running or pod.phase != "Pending":
                 continue
-            _, rv = self.store.get(PODS, key)
-            if rv == 0:
+            # status write through the LIVE object (not the informer copy),
+            # and only if the pod is still bound here
+            live, rv = self.store.get(PODS, key)
+            if live is None or live.node_name != self.node.name:
                 continue
             try:
                 self.store.update(
                     PODS, key,
-                    dataclasses.replace(pod, phase="Running"),
+                    dataclasses.replace(live, phase="Running"),
                     expect_rv=rv,
                 )
             except ConflictError:

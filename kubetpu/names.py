@@ -25,6 +25,7 @@ NODE_VOLUME_LIMITS = "NodeVolumeLimits"
 VOLUME_BINDING = "VolumeBinding"
 DYNAMIC_RESOURCES = "DynamicResources"
 GANG_SCHEDULING = "GangScheduling"
+NODE_DECLARED_FEATURES = "NodeDeclaredFeatures"
 POD_GROUP_PODS_COUNT = "PodGroupPodsCount"
 
 ALL_FILTERS = frozenset({
@@ -41,4 +42,5 @@ ALL_FILTERS = frozenset({
     NODE_VOLUME_LIMITS,
     VOLUME_BINDING,
     DYNAMIC_RESOURCES,
+    NODE_DECLARED_FEATURES,
 })

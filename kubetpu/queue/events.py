@@ -50,11 +50,12 @@ class ActionType(enum.IntFlag):
     UPDATE_POD_SCALE_DOWN = 1 << 8
     UPDATE_POD_TOLERATION = 1 << 9
     UPDATE_POD_GATES_ELIMINATED = 1 << 10
+    UPDATE_NODE_FEATURE = 1 << 11     # status.declaredFeatures changed
     UPDATE = (
         UPDATE_NODE_ALLOCATABLE | UPDATE_NODE_LABEL | UPDATE_NODE_TAINT
         | UPDATE_NODE_CONDITION | UPDATE_NODE_ANNOTATION | UPDATE_POD_LABEL
         | UPDATE_POD_SCALE_DOWN | UPDATE_POD_TOLERATION
-        | UPDATE_POD_GATES_ELIMINATED
+        | UPDATE_POD_GATES_ELIMINATED | UPDATE_NODE_FEATURE
     )
     ALL = ADD | DELETE | UPDATE
 
@@ -155,6 +156,8 @@ def node_update_event(old: Any, new: Any) -> ClusterEvent:
         getattr(old, "unschedulable", False) != getattr(new, "unschedulable", False)
     ):
         action |= ActionType.UPDATE_NODE_TAINT
+    if getattr(old, "declared_features", ()) != getattr(new, "declared_features", ()):
+        action |= ActionType.UPDATE_NODE_FEATURE
     return ClusterEvent(EventResource.NODE, action)
 
 
@@ -236,6 +239,12 @@ def default_queueing_hints(filter_names: Sequence[str]) -> dict[str, list[HintRe
         N.NODE_VOLUME_LIMITS,
         ClusterEvent(EventResource.CSI_NODE, ActionType.ADD | ActionType.UPDATE),
         ClusterEvent(EventResource.ASSIGNED_POD, ActionType.DELETE),
+    )
+    add(
+        N.NODE_DECLARED_FEATURES,
+        # nodedeclaredfeatures EventsToRegister: a node add or a kubelet
+        # upgrade changing status.declaredFeatures can un-reject
+        ClusterEvent(EventResource.NODE, ActionType.ADD | ActionType.UPDATE_NODE_FEATURE),
     )
     add(
         N.DYNAMIC_RESOURCES,

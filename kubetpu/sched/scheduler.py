@@ -139,6 +139,17 @@ class Scheduler:
         # plugin — otherwise the gating rejector would have no registered
         # queueing hints and a gated pod could never wake
         self._dra_enabled = N.DYNAMIC_RESOURCES in filters
+        if (
+            N.NODE_DECLARED_FEATURES in filters
+            and not self.feature_gates.enabled("NodeDeclaredFeatures")
+        ):
+            # the reference only registers the plugin when its gate is on
+            # (default_plugins.go:60-73), so gate-off + plugin-enabled is a
+            # configuration error, not a silent no-op
+            raise ValueError(
+                "profile enables NodeDeclaredFeatures but the "
+                "NodeDeclaredFeatures feature gate is off"
+            )
         self.queue = PriorityQueue(
             hints=default_queueing_hints(filters),
             pre_enqueue=[self._scheduling_gates, self._dra_pre_enqueue],

@@ -567,6 +567,12 @@ def encode_pod_batch(
     node_unsched = np.array(
         [info.node.unschedulable for info in nt.infos], dtype=bool
     )
+    # hoisted once per batch (not per signature): declared-feature sets
+    # participate only when some node declares any
+    node_feature_sets = (
+        [set(info.node.declared_features) for info in nt.infos]
+        if any(info.node.declared_features for info in nt.infos) else None
+    )
     sig_ids: dict = {}
     sig_rows: list[np.ndarray] = []
     sig_trivial: list[bool] = []
@@ -629,8 +635,13 @@ def encode_pod_batch(
         dra_sig = (
             (d.blocked, d.pin, d.host_specs) if d is not None else None
         )
+        feat_req = (
+            p.required_node_features
+            if names.NODE_DECLARED_FEATURES in f else ()
+        )
         sig = (
             _static_filter_signature(p),
+            feat_req,
             p.node_name if names.NODE_NAME in f else "",
             bool(unknown_resource[i]) and names.NODE_RESOURCES_FIT in f,
             vol_sig,
@@ -668,6 +679,17 @@ def encode_pod_batch(
                 )
                 if not tolerated:
                     m &= ~node_unsched
+            if feat_req:
+                # NodeDeclaredFeatures Filter (nodedeclaredfeatures.go:
+                # reqs ⊆ node.status.declaredFeatures, failures
+                # UnschedulableAndUnresolvable)
+                want = set(feat_req)
+                if node_feature_sets is None:
+                    m[:] = False   # no node declares anything
+                else:
+                    m &= np.array(
+                        [want <= s for s in node_feature_sets], dtype=bool
+                    )
             # NodeName (spec.nodeName pre-assignment) — exact match only
             if p.node_name and names.NODE_NAME in f:
                 m &= np.array(

@@ -351,3 +351,111 @@ def test_nodelifecycle_simulated_clock_only():
     assert ctrl.step(now=5.0) == 0     # discovered at simulated t=5
     assert ctrl.step(now=44.0) == 0    # 39s since discovery: not stale
     assert ctrl.step(now=46.0) == 1    # 41s: tainted
+
+
+def test_tainteviction_reschedules_on_taint_change():
+    """A new taint shortening the effective tolerationSeconds cancels the
+    old deadline and reschedules (the reference's CancelWork on update)."""
+    st = MemStore()
+    clock = [0.0]
+    node = make_node("n0", taints=(UNREACHABLE,))
+    st.create(NODES, "n0", node)
+    st.create(PODS, "default/p", make_pod(
+        "p", node_name="n0",
+        tolerations=(
+            t.Toleration(key=UNREACHABLE.key,
+                         operator=t.TolerationOperator.EXISTS,
+                         toleration_seconds=300.0),
+            t.Toleration(key="pressure",
+                         operator=t.TolerationOperator.EXISTS,
+                         toleration_seconds=5.0),
+        ),
+    ))
+    ctrl = TaintEvictionController(st, clock=lambda: clock[0])
+    ctrl.start()
+    ctrl.step()                      # deadline t=300
+    clock[0] = 10.0
+    st.update(NODES, "n0", dataclasses.replace(node, taints=(
+        UNREACHABLE,
+        t.Taint(key="pressure", effect=t.TaintEffect.NO_EXECUTE),
+    )))
+    ctrl.step()                      # rescheduled: min(300, 5) from t=10
+    clock[0] = 16.0
+    assert ctrl.step() == 1          # evicted at ~t=15, not t=300
+    assert st.get(PODS, "default/p")[0] is None
+
+
+def test_podgc_rechecks_live_store_before_orphan_delete():
+    """A pod bound to a node created after the nodes poll must survive."""
+    st = MemStore()
+    gc = PodGCController(st)
+    gc.start()
+    gc._r[0].step()   # nodes poll now (node absent)
+    st.create(NODES, "new", make_node("new"))
+    st.create(PODS, "default/p", make_pod("p", node_name="new"))
+    gc._r[1].step()   # pods poll sees the bind
+    # step() pumps again (node arrives), but even a stale nodes view must
+    # not delete: the live re-check guards it
+    known = set(gc._nodes.store)
+    gc._nodes.store.pop("new", None)   # simulate the stale window
+    assert gc.step() >= 0
+    assert st.get(PODS, "default/p")[0] is not None
+
+
+def test_disruption_cas_preserves_concurrent_spec_change():
+    """The status write must not clobber a spec change made after the
+    controller's informer pump."""
+    st = MemStore()
+    pdb = t.PodDisruptionBudget(
+        name="x", selector=t.LabelSelector.of({"app": "x"}), min_available=1,
+    )
+    st.create(PDBS, pdb.key, pdb)
+    st.create(PODS, "default/a", make_pod("a", labels={"app": "x"},
+                                          node_name="n0"))
+    st.create(PODS, "default/b", make_pod("b", labels={"app": "x"},
+                                          node_name="n0"))
+    ctrl = DisruptionController(st)
+    ctrl.start()
+    ctrl.pump()
+    # user raises min_available AFTER the pump, BEFORE the status write
+    live, rv = st.get(PDBS, "default/x")
+    st.update(PDBS, "default/x",
+              dataclasses.replace(live, min_available=2), expect_rv=rv)
+    ctrl.step()   # writes allowed based on stale counts — but through LIVE
+    got = st.get(PDBS, "default/x")[0]
+    assert got.min_available == 2          # spec change survived
+
+
+def test_replicaset_stamps_creation_index():
+    st = MemStore()
+    rs = t.ReplicaSet(
+        name="idx", replicas=3, selector=t.LabelSelector.of({"app": "i"}),
+        template=make_pod("tpl", labels={"app": "i"}),
+    )
+    st.create(REPLICA_SETS, rs.key, rs)
+    ctrl = ReplicaSetController(st)
+    ctrl.start()
+    ctrl.step()
+    idxs = sorted(p.creation_index for _, p in st.list(PODS)[0])
+    assert idxs == [1, 2, 3]
+
+
+def test_node_declared_features_gate_checked_at_construction():
+    from kubetpu.framework import config as C
+
+    from .test_scheduler import FakeClient, make_sched
+
+    prof = C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), ("NodeDeclaredFeatures", 1),
+        )),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+    with pytest.raises(ValueError, match="feature gate"):
+        make_sched(FakeClient(), profile=prof)
+    s, _ = make_sched(
+        FakeClient(), profile=prof,
+        feature_gates={"NodeDeclaredFeatures": True},
+    )
+    assert s is not None

@@ -1,0 +1,118 @@
+"""The determinism contract (SURVEY §5 checkpoint/resume: device tensors are
+a rebuildable cache, so the only state needing a contract is the assignment
+computation itself) — identical inputs MUST produce identical assignments:
+
+- across repeated runs in one process (no hidden RNG/iteration state),
+- across BOTH engines' re-encodes of the same cluster (encode is a pure
+  function of the snapshot + batch),
+- and for the batched engine's tie-spread hash (a deterministic projection,
+  not a seeded sample — unlike the reference's selectHost reservoir sample,
+  schedule_one.go:1037, whose randomness the parity budget documents).
+
+Plus the NodeDeclaredFeatures Filter (nodedeclaredfeatures.go: the pod's
+required feature set must be a subset of node.status.declaredFeatures).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.assign import greedy_assign
+from kubetpu.assign.batched import batched_assign_device
+from kubetpu.assign.greedy import greedy_assign_device
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, score_params
+from kubetpu.state import Cache
+
+from .cluster_gen import random_cluster
+from .test_mesh import full_profile
+
+
+@pytest.mark.parametrize("engine", ["greedy", "batched"])
+def test_assignments_identical_across_runs_and_encodes(engine):
+    rng = np.random.default_rng(42)
+    cache, pending = random_cluster(
+        rng, num_nodes=32, num_existing=40, num_pending=24, with_taints=True,
+    )
+    profile = full_profile()
+    fn = greedy_assign_device if engine == "greedy" else batched_assign_device
+
+    results = []
+    for _ in range(3):
+        snap = cache.update_snapshot()
+        batch = encode_batch(snap, pending, profile)
+        params = score_params(profile, batch.resource_names)
+        a, _ = fn(batch.device, params)
+        results.append(np.asarray(a).copy())
+    np.testing.assert_array_equal(results[0], results[1])
+    np.testing.assert_array_equal(results[0], results[2])
+
+
+def test_encode_is_a_pure_function_of_inputs():
+    """Two independent caches built from the same objects encode to
+    bit-identical device tensors (the watch-is-the-checkpoint philosophy:
+    a rebuilt cache yields the same scheduling decisions)."""
+    def build():
+        rng = np.random.default_rng(7)
+        cache, pending = random_cluster(
+            rng, num_nodes=24, num_existing=30, num_pending=12,
+        )
+        snap = cache.update_snapshot()
+        return encode_batch(snap, pending, full_profile())
+
+    b1, b2 = build(), build()
+    assert b1.resource_names == b2.resource_names
+    np.testing.assert_array_equal(
+        np.asarray(b1.device.alloc), np.asarray(b2.device.alloc)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(b1.device.requests), np.asarray(b2.device.requests)
+    )
+    if b1.device.static_mask is not None:
+        np.testing.assert_array_equal(
+            np.asarray(b1.device.static_mask),
+            np.asarray(b2.device.static_mask),
+        )
+
+
+# --------------------------------------------------- NodeDeclaredFeatures
+
+def ndf_profile():
+    return C.Profile(
+        filters=C.PluginSet(enabled=(
+            (C.NODE_RESOURCES_FIT, 1), ("NodeDeclaredFeatures", 1),
+        )),
+        scores=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        default_spread_constraints=(),
+    )
+
+
+def test_node_declared_features_filter():
+    cache = Cache()
+    cache.add_node(make_node("plain", cpu_milli=4000))
+    cache.add_node(make_node(
+        "featured", cpu_milli=4000,
+        declared_features=("InPlacePodVerticalScaling", "SidecarContainers"),
+    ))
+    demanding = make_pod(
+        "needs", cpu_milli=100,
+        required_features=("InPlacePodVerticalScaling",),
+    )
+    easy = make_pod("easy", cpu_milli=100)
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [demanding, easy], ndf_profile())
+    got = greedy_assign(batch, ndf_profile())
+    assert got[0] == "featured"          # only the declaring node passes
+    assert got[1] is not None            # featureless pods go anywhere
+
+
+def test_node_declared_features_disabled_plugin_ignores():
+    cache = Cache()
+    cache.add_node(make_node("plain", cpu_milli=4000))
+    pod = make_pod("needs", cpu_milli=100, required_features=("X",))
+    snap = cache.update_snapshot()
+    prof = C.minimal_profile()
+    batch = encode_batch(snap, [pod], prof)
+    assert greedy_assign(batch, prof) == ["plain"]
