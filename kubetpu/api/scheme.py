@@ -1,0 +1,151 @@
+"""Scheme + serializers — the apimachinery runtime.Scheme analog.
+
+Reference: ``staging/src/k8s.io/apimachinery`` — ``runtime.Scheme`` maps
+GroupVersionKinds to Go types and back; serializers encode objects with a
+``kind``/``apiVersion`` tag so any component can round-trip any registered
+object. Here the registry maps **kind names to dataclasses** and the codec
+round-trips the typed scheduling envelope (dataclasses, enums, tuples,
+nested objects) through plain JSON with a ``"kind"`` tag — the wire format
+of the apiserver layer (kubetpu.apiserver) and anything else that ships
+typed objects across a process boundary.
+
+Unknown kinds and unknown fields fail loudly (strict decoding — the
+reference's strict serializer mode); None round-trips as null; tuples of
+nested dataclasses are reconstructed from the field's type annotation.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import typing
+from typing import Any
+
+from . import types as t
+
+# kind name -> dataclass. The registered surface is every wire-visible
+# object of the framework (the "API types" layer).
+_KINDS: dict[str, type] = {}
+
+
+def register(cls: type, kind: str | None = None) -> type:
+    _KINDS[kind or cls.__name__] = cls
+    return cls
+
+
+for _cls in (
+    t.Node, t.Pod, t.Taint, t.Toleration, t.Affinity, t.NodeAffinity,
+    t.PodAffinity, t.PodAffinityTerm, t.WeightedPodAffinityTerm,
+    t.PreferredSchedulingTerm, t.NodeSelector, t.NodeSelectorTerm,
+    t.Requirement, t.LabelSelector, t.TopologySpreadConstraint,
+    t.ContainerPort, t.PodVolume, t.PersistentVolume,
+    t.PersistentVolumeClaim, t.StorageClass, t.Service, t.Namespace,
+    t.PodDisruptionBudget, t.PodGroup, t.GangPolicy, t.ImageState,
+    t.ReplicaSet, t.DeviceClass, t.CELSelector, t.ResourceSlice, t.Device,
+    t.DeviceRequest, t.DeviceSubRequest, t.DeviceConstraint,
+    t.ResourceClaim, t.ClaimAllocation, t.DeviceResult, t.PodResourceClaim,
+):
+    register(_cls)
+
+
+class SchemeError(ValueError):
+    pass
+
+
+def encode(obj: Any) -> Any:
+    """Object → JSON-safe value. Dataclasses carry a "kind" tag."""
+    if obj is None or isinstance(obj, (str, int, float, bool)):
+        if isinstance(obj, enum.Enum):   # str-enums are str instances
+            return obj.value
+        return obj
+    if isinstance(obj, enum.Enum):
+        return obj.value
+    if dataclasses.is_dataclass(obj) and not isinstance(obj, type):
+        kind = type(obj).__name__
+        if kind not in _KINDS:
+            raise SchemeError(f"kind {kind!r} is not registered")
+        out: dict[str, Any] = {"kind": kind}
+        for f in dataclasses.fields(obj):
+            out[f.name] = encode(getattr(obj, f.name))
+        return out
+    if isinstance(obj, (list, tuple)):
+        return [encode(x) for x in obj]
+    if isinstance(obj, dict):
+        return {str(k): encode(v) for k, v in obj.items()}
+    raise SchemeError(f"cannot encode {type(obj).__name__}")
+
+
+def _resolve_hints(cls: type) -> dict[str, Any]:
+    # evaluated lazily + cached on the class (postponed annotations)
+    cached = cls.__dict__.get("__kubetpu_hints__")
+    if cached is None:
+        cached = typing.get_type_hints(cls, vars(t))
+        setattr(cls, "__kubetpu_hints__", cached)
+    return cached
+
+
+def _coerce(value: Any, hint: Any) -> Any:
+    """Rebuild tuples/enums/nested dataclasses from the field annotation."""
+    if value is None:
+        return None
+    if isinstance(value, dict) and "kind" in value:
+        return decode(value)
+    origin = typing.get_origin(hint)
+    if origin in (typing.Union, getattr(__import__("types"), "UnionType", ())):
+        for arm in typing.get_args(hint):
+            if arm is type(None):
+                continue
+            try:
+                return _coerce(value, arm)
+            except (SchemeError, TypeError, ValueError):
+                continue
+        raise SchemeError(f"no union arm of {hint} accepts {value!r}")
+    if origin is tuple and isinstance(value, list):
+        args = typing.get_args(hint)
+        if len(args) == 2 and args[1] is Ellipsis:
+            return tuple(_coerce(v, args[0]) for v in value)
+        if args:
+            return tuple(
+                _coerce(v, args[i % len(args)]) for i, v in enumerate(value)
+            )
+        return tuple(value)
+    if isinstance(hint, type) and issubclass(hint, enum.Enum):
+        return hint(value)
+    if isinstance(hint, type) and dataclasses.is_dataclass(hint):
+        if isinstance(value, dict):
+            return _decode_into(hint, value)
+        raise SchemeError(f"expected object for {hint.__name__}, got {value!r}")
+    return value
+
+
+def _decode_into(cls: type, data: dict) -> Any:
+    hints = _resolve_hints(cls)
+    field_names = {f.name for f in dataclasses.fields(cls)}
+    kwargs: dict[str, Any] = {}
+    for key, raw in data.items():
+        if key == "kind":
+            continue
+        if key not in field_names:
+            raise SchemeError(
+                f"{cls.__name__}: unknown field {key!r} (strict decoding)"
+            )
+        kwargs[key] = _coerce(raw, hints[key])
+    return cls(**kwargs)
+
+
+def decode(data: Any) -> Any:
+    """JSON value → typed object (requires the "kind" tag on objects)."""
+    if isinstance(data, dict):
+        kind = data.get("kind")
+        if kind is None:
+            raise SchemeError("object has no 'kind' tag")
+        cls = _KINDS.get(kind)
+        if cls is None:
+            raise SchemeError(
+                f"kind {kind!r} is not registered "
+                f"(known: {sorted(_KINDS)})"
+            )
+        return _decode_into(cls, data)
+    if isinstance(data, list):
+        return [decode(x) for x in data]
+    return data

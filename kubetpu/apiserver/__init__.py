@@ -1,0 +1,5 @@
+"""The API server layer: REST + watch over the store, and the remote
+store client components use across process boundaries."""
+
+from .server import APIServer  # noqa: F401
+from .remote import RemoteStore  # noqa: F401

@@ -1,0 +1,131 @@
+"""RemoteStore — the store protocol over the API server's REST + watch.
+
+The client-go side of the process boundary: a RemoteStore exposes the SAME
+surface the in-process MemStore does (get/list/create/update/delete/watch),
+so ``Reflector``/``SchedulerInformers``/``StoreClient`` and every
+controller run unchanged against a remote API server — scheduler and
+control plane in separate processes, exactly the reference's deployment
+shape (components talk only to the apiserver, SURVEY §1).
+
+Watch is the pull form: ``RemoteWatcher.poll`` GETs
+``?watch=1&resourceVersion=<cursor>`` with a short long-poll; HTTP 410 maps
+back to ``CompactedError`` so the reflector's relist path fires.
+"""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+from typing import Any
+
+from ..api import scheme
+from ..store.memstore import CompactedError, ConflictError, WatchEvent
+
+
+class RemoteStoreError(Exception):
+    pass
+
+
+class RemoteStore:
+    def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+
+    # ------------------------------------------------------------ plumbing
+    def _request(self, method: str, path: str, body: dict | None = None):
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(
+            f"{self.base}{path}", data=data, method=method,
+            headers={"Content-Type": "application/json"},
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read() or b"{}")
+        except urllib.error.HTTPError as e:
+            payload = {}
+            try:
+                payload = json.loads(e.read() or b"{}")
+            except Exception:
+                pass
+            reason = payload.get("error", str(e))
+            if e.code == 409:
+                raise ConflictError(reason) from None
+            if e.code == 410:
+                raise CompactedError(reason) from None
+            if e.code == 404:
+                raise KeyError(reason) from None
+            raise RemoteStoreError(f"{e.code}: {reason}") from None
+
+    # ------------------------------------------------------ store protocol
+    def get(self, kind: str, key: str):
+        try:
+            res = self._request("GET", f"/apis/{kind}/{key}")
+        except KeyError:
+            return None, 0
+        return scheme.decode(res["object"]), res["resourceVersion"]
+
+    def list(self, kind: str):
+        res = self._request("GET", f"/apis/{kind}")
+        return (
+            [(i["key"], scheme.decode(i["object"])) for i in res["items"]],
+            res["resourceVersion"],
+        )
+
+    def create(self, kind: str, key: str, obj: Any) -> int:
+        res = self._request(
+            "POST", f"/apis/{kind}/{key}", scheme.encode(obj)
+        )
+        return res["resourceVersion"]
+
+    def update(
+        self, kind: str, key: str, obj: Any, expect_rv: int | None = None
+    ) -> int:
+        q = f"?resourceVersion={expect_rv}" if expect_rv is not None else ""
+        res = self._request(
+            "PUT", f"/apis/{kind}/{key}{q}", scheme.encode(obj)
+        )
+        return res["resourceVersion"]
+
+    def delete(self, kind: str, key: str) -> int:
+        res = self._request("DELETE", f"/apis/{kind}/{key}")
+        return res["resourceVersion"]
+
+    def watch(self, kind: str | None, since_rv: int) -> "RemoteWatcher":
+        if kind is None:
+            raise RemoteStoreError("remote watch requires a kind")
+        return RemoteWatcher(self, kind, since_rv)
+
+
+class RemoteWatcher:
+    """Pull watcher over the REST watch endpoint (Watcher protocol)."""
+
+    def __init__(
+        self, store: RemoteStore, kind: str, since_rv: int,
+        poll_timeout_s: float = 0.0,
+    ) -> None:
+        self._store = store
+        self._kind = kind
+        self._rv = since_rv
+        # 0 = non-blocking poll (loop-pump shape); raise for long-polling
+        self.poll_timeout_s = poll_timeout_s
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    def poll(self) -> list[WatchEvent]:
+        res = self._store._request(
+            "GET",
+            f"/apis/{self._kind}?watch=1&resourceVersion={self._rv}"
+            f"&timeoutSeconds={self.poll_timeout_s}",
+        )
+        self._rv = res["resourceVersion"]
+        return [
+            WatchEvent(
+                type=e["type"], kind=self._kind, key=e["key"],
+                obj=scheme.decode(e["object"]),
+                resource_version=e["resourceVersion"],
+            )
+            for e in res["events"]
+        ]

@@ -1,0 +1,205 @@
+"""API server — REST + watch over the versioned store (layer 3).
+
+Reference shape (staging/src/k8s.io/apiserver): per-resource REST verbs
+installed over generic storage (`registerResourceHandlers`,
+endpoints/installer.go:288; generic registry Store, registry/store.go:514)
+with watch streams fanned out from the watch cache (cacher.go:263). The
+envelope here:
+
+    GET    /apis/<kind>                 list → {"items": [...], "resourceVersion": N}
+    GET    /apis/<kind>?watch=1&resourceVersion=N
+                                        drain events AFTER N (long-poll up to
+                                        ``timeoutSeconds``); 410 Gone when N
+                                        predates the event buffer (relist)
+    GET    /apis/<kind>/<key…>          get → {"object": …, "resourceVersion": N}
+    POST   /apis/<kind>/<key…>          create (409 on exists)
+    PUT    /apis/<kind>/<key…>[?resourceVersion=N]
+                                        update; CAS conflict → 409
+    DELETE /apis/<kind>/<key…>          delete (404 when absent)
+
+Objects ride the Scheme codec (kubetpu.api.scheme — the "kind"-tagged JSON
+serializer), so any registered type round-trips. The watch response is the
+pull form of the reference's chunked watch stream: clients poll with their
+cursor, the server long-polls against the store's condition variable —
+the Reflector's ListAndWatch maps onto exactly these two endpoints
+(see kubetpu.apiserver.remote.RemoteStore).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs, urlsplit
+
+from ..api import scheme
+from ..store.memstore import CompactedError, ConflictError, MemStore
+
+PREFIX = "/apis/"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    store: MemStore   # bound by the server factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:
+        pass
+
+    # ------------------------------------------------------------ plumbing
+    def _reply(self, obj, status: int = 200) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(status)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _error(self, status: int, reason: str) -> None:
+        self._reply({"error": reason}, status=status)
+
+    def _route(self):
+        """(kind, key or None, query) — key may contain '/'."""
+        parts = urlsplit(self.path)
+        if not parts.path.startswith(PREFIX):
+            return None, None, {}
+        rest = parts.path[len(PREFIX):].strip("/")
+        q = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+        if not rest:
+            return None, None, q
+        kind, _, key = rest.partition("/")
+        return kind, (key or None), q
+
+    def _read_body(self):
+        length = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(length) if length else b"{}"
+        return json.loads(raw or b"{}")
+
+    # --------------------------------------------------------------- verbs
+    def do_GET(self) -> None:  # noqa: N802
+        kind, key, q = self._route()
+        if kind is None:
+            self._error(404, "unknown path")
+            return
+        try:
+            if key is None and q.get("watch"):
+                self._watch(kind, q)
+            elif key is None:
+                items, rv = self.store.list(kind)
+                self._reply({
+                    "items": [
+                        {"key": k, "object": scheme.encode(o)}
+                        for k, o in items
+                    ],
+                    "resourceVersion": rv,
+                })
+            else:
+                obj, rv = self.store.get(kind, key)
+                if obj is None:
+                    self._error(404, f"{kind}/{key} not found")
+                else:
+                    self._reply({
+                        "object": scheme.encode(obj), "resourceVersion": rv,
+                    })
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def _watch(self, kind: str, q: dict) -> None:
+        rv = int(q.get("resourceVersion", 0))
+        timeout = min(float(q.get("timeoutSeconds", 10)), 60.0)
+        try:
+            events, cursor = self.store._events_since(kind, rv)
+            if not events and timeout > 0:
+                self.store.wait_for(rv, timeout=timeout)
+                events, cursor = self.store._events_since(kind, rv)
+        except CompactedError as e:
+            # the watch cache's "too old resource version" → HTTP 410
+            self._error(410, str(e))
+            return
+        self._reply({
+            "events": [
+                {
+                    "type": e.type, "key": e.key,
+                    "object": scheme.encode(e.obj),
+                    "resourceVersion": e.resource_version,
+                }
+                for e in events
+            ],
+            "resourceVersion": cursor,
+        })
+
+    def do_POST(self) -> None:  # noqa: N802
+        kind, key, _ = self._route()
+        if kind is None or key is None:
+            self._error(404, "kind and key required")
+            return
+        try:
+            obj = scheme.decode(self._read_body())
+            rv = self.store.create(kind, key, obj)
+            self._reply({"resourceVersion": rv}, status=201)
+        except ConflictError as e:
+            self._error(409, str(e))
+        except scheme.SchemeError as e:
+            self._error(400, str(e))
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_PUT(self) -> None:  # noqa: N802
+        kind, key, q = self._route()
+        if kind is None or key is None:
+            self._error(404, "kind and key required")
+            return
+        try:
+            obj = scheme.decode(self._read_body())
+            expect = (
+                int(q["resourceVersion"]) if "resourceVersion" in q else None
+            )
+            rv = self.store.update(kind, key, obj, expect_rv=expect)
+            self._reply({"resourceVersion": rv})
+        except ConflictError as e:
+            self._error(409, str(e))
+        except scheme.SchemeError as e:
+            self._error(400, str(e))
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+
+    def do_DELETE(self) -> None:  # noqa: N802
+        kind, key, _ = self._route()
+        if kind is None or key is None:
+            self._error(404, "kind and key required")
+            return
+        try:
+            rv = self.store.delete(kind, key)
+            self._reply({"resourceVersion": rv})
+        except KeyError:
+            self._error(404, f"{kind}/{key} not found")
+        except Exception as e:
+            self._error(500, f"{type(e).__name__}: {e}")
+
+
+class APIServer:
+    """In-process HTTP front for a MemStore (httptest.NewServer shape)."""
+
+    def __init__(
+        self, store: MemStore | None = None,
+        host: str = "127.0.0.1", port: int = 0,
+    ) -> None:
+        self.store = store if store is not None else MemStore()
+        handler = type("BoundHandler", (_Handler,), {"store": self.store})
+        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "APIServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)

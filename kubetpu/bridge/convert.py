@@ -227,7 +227,37 @@ def pod_from_v1(obj: _JSON) -> t.Pod:
         ),
         scheduler_name=spec.get("schedulerName", "default-scheduler")
         or "default-scheduler",
+        # spec.resourceClaims with resolved instance names from
+        # status.resourceClaimStatuses (the resourceclaim controller fills
+        # them; pods with unresolved templates carry claim_name="")
+        resource_claims=_resource_claims(obj),
+        # the reference INFERS required features from the full spec
+        # (component-helpers/nodedeclaredfeatures InferForPodScheduling);
+        # this envelope carries aggregates, so the explicit carrier is the
+        # kubetpu.io/required-node-features annotation (comma-separated)
+        required_node_features=tuple(sorted(
+            f for f in (
+                (meta.get("annotations") or {})
+                .get("kubetpu.io/required-node-features", "")
+                .split(",")
+            ) if f
+        )),
     )
+
+
+def _resource_claims(obj: _JSON) -> tuple[t.PodResourceClaim, ...]:
+    spec = obj.get("spec") or {}
+    status = obj.get("status") or {}
+    resolved = {
+        s.get("name", ""): s.get("resourceClaimName", "")
+        for s in status.get("resourceClaimStatuses") or ()
+    }
+    out = []
+    for rc in spec.get("resourceClaims") or ():
+        name = rc.get("name", "")
+        claim = rc.get("resourceClaimName") or resolved.get(name, "")
+        out.append(t.PodResourceClaim(name=name, claim_name=claim))
+    return tuple(out)
 
 
 def pod_group_from_v1alpha3(obj: _JSON) -> t.PodGroup:
@@ -381,12 +411,24 @@ def pod_to_v1(pod: t.Pod) -> dict:
             aff[field_name] = pa_out
     if aff:
         spec["affinity"] = aff
+    if pod.resource_claims:
+        spec["resourceClaims"] = [
+            {"name": rc.name,
+             **({"resourceClaimName": rc.claim_name} if rc.claim_name else {})}
+            for rc in pod.resource_claims
+        ]
+    annotations = {}
+    if pod.required_node_features:
+        annotations["kubetpu.io/required-node-features"] = ",".join(
+            pod.required_node_features
+        )
     return {
         "metadata": {
             "name": pod.name,
             "namespace": pod.namespace,
             "uid": pod.uid,
             **({"labels": dict(pod.labels)} if pod.labels else {}),
+            **({"annotations": annotations} if annotations else {}),
         },
         "spec": spec,
     }
@@ -421,4 +463,7 @@ def node_from_v1(obj: _JSON) -> t.Node:
         taints=taints,
         unschedulable=bool(spec.get("unschedulable", False)),
         images=tuple(sorted(images)),
+        # status.declaredFeatures (core/v1 types.go:6828,
+        # +featureGate=NodeDeclaredFeatures)
+        declared_features=tuple(sorted(status.get("declaredFeatures") or ())),
     )

@@ -90,6 +90,24 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def cmd_apiserver(args) -> int:
+    from .apiserver import APIServer
+
+    server = APIServer(host=args.host, port=args.port).start()
+    print(f"kubetpu apiserver serving on {server.url} "
+          f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N)",
+          flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        server.close()
+    return 0
+
+
 def cmd_version(_args) -> int:
     from . import __version__
 
@@ -112,6 +130,14 @@ def build_parser() -> argparse.ArgumentParser:
     serve.add_argument("--host", default="127.0.0.1")
     serve.add_argument("--port", type=int, default=10259)
     serve.set_defaults(fn=cmd_serve)
+
+    api = sub.add_parser(
+        "apiserver",
+        help="serve the REST+watch object API over an in-memory store",
+    )
+    api.add_argument("--host", default="127.0.0.1")
+    api.add_argument("--port", type=int, default=10250)
+    api.set_defaults(fn=cmd_apiserver)
 
     check = sub.add_parser("check-config", help="validate a config file")
     check.add_argument("config")

@@ -78,23 +78,36 @@ class StoreClient:
         pass
 
     def update_claim_status(self, claim: t.ResourceClaim) -> None:
-        # a claim deleted mid-binding must NOT be resurrected by the status
-        # write (the bind() deleted-pod rule, applied to claims): CAS
-        # against the live object, skip if gone — including the race where
-        # it vanishes between the get and the update
+        # the scheduler owns only the claim's STATUS (allocation +
+        # reservedFor, bindClaim's patch) — merge it into the LIVE object so
+        # a concurrent spec change is never clobbered, CAS so the write is
+        # atomic, and skip a deleted claim instead of resurrecting it.
+        # Conflicts past the retry budget surface (PreBind fails the bind
+        # loudly rather than dropping the allocation record).
+        import dataclasses
+
         from ..store.memstore import ConflictError
 
-        for _ in range(3):
+        last: Exception | None = None
+        for _ in range(5):
             current, rv = self.store.get(RESOURCE_CLAIMS, claim.key)
             if current is None:
                 return
+            merged = dataclasses.replace(
+                current,
+                allocation=claim.allocation,
+                reserved_for=claim.reserved_for,
+            )
             try:
                 self.store.update(
-                    RESOURCE_CLAIMS, claim.key, claim, expect_rv=rv
+                    RESOURCE_CLAIMS, claim.key, merged, expect_rv=rv
                 )
                 return
-            except ConflictError:
-                continue
+            except ConflictError as e:
+                last = e
+        raise RuntimeError(
+            f"claim status write for {claim.key} kept conflicting: {last}"
+        )
 
 
 class SchedulerInformers:

@@ -67,10 +67,11 @@ class TaintEvictionController:
         self._nodes = SharedInformer(NODES)
         self._pods = SharedInformer(PODS)
         self._r = [Reflector(store, self._nodes), Reflector(store, self._pods)]
-        # pod key -> (absolute eviction deadline, the wait it was based on):
-        # a changed taint set / toleration changes the wait, which CANCELS
-        # and reschedules the eviction (the reference's CancelWork +
-        # re-schedule on taint updates)
+        # pod key -> (first-observed time, current wait). The deadline is
+        # ALWAYS created_at + wait: a taint change recomputes the wait but
+        # preserves the original observation time (the reference keeps
+        # scheduledEviction.CreatedAt, taint_eviction.go processPodOnNode),
+        # so flapping taints can't postpone eviction indefinitely.
         self._pending: dict[str, tuple[float, float]] = {}
         self.evictions = 0
 
@@ -105,12 +106,9 @@ class TaintEvictionController:
             elif wait == float("inf"):
                 self._pending.pop(key, None)
             else:
-                prev = self._pending.get(key)
-                if prev is None or prev[1] != wait:
-                    # first sight, or the effective wait changed: reschedule
-                    prev = (now + wait, wait)
-                    self._pending[key] = prev
-                if now >= prev[0]:
+                created_at, _prev_wait = self._pending.get(key, (now, wait))
+                self._pending[key] = (created_at, wait)
+                if now >= created_at + wait:
                     evicted += self._evict(key)
         for key in list(self._pending):
             if key not in seen:

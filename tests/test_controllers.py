@@ -373,15 +373,16 @@ def test_tainteviction_reschedules_on_taint_change():
     ))
     ctrl = TaintEvictionController(st, clock=lambda: clock[0])
     ctrl.start()
-    ctrl.step()                      # deadline t=300
+    ctrl.step()                      # observed at t=0, wait 300
     clock[0] = 10.0
     st.update(NODES, "n0", dataclasses.replace(node, taints=(
         UNREACHABLE,
         t.Taint(key="pressure", effect=t.TaintEffect.NO_EXECUTE),
     )))
-    ctrl.step()                      # rescheduled: min(300, 5) from t=10
-    clock[0] = 16.0
-    assert ctrl.step() == 1          # evicted at ~t=15, not t=300
+    # wait recomputes to min(300, 5) against the ORIGINAL observation time
+    # (CreatedAt + minTolerationTime = 0 + 5 = 5 < 10): evicted now,
+    # not at t=300 — and a flapping taint could never postpone it
+    assert ctrl.step() == 1
     assert st.get(PODS, "default/p")[0] is None
 
 
