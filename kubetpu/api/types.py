@@ -537,6 +537,27 @@ class PodGroup:
 
 
 @dataclass(frozen=True)
+class NodeHeartbeat:
+    """The coordination.k8s.io Lease slice kubelets renew per node
+    (pkg/kubelet/nodelease; consumed by the nodelifecycle controller)."""
+
+    node_name: str
+    renew_time: float
+
+
+@dataclass(frozen=True)
+class LeaderElectionRecord:
+    """The coordination Lease slice leader election CASes
+    (client-go tools/leaderelection LeaderElectionRecord)."""
+
+    holder_identity: str
+    lease_duration_s: float
+    acquire_time: float
+    renew_time: float
+    leader_transitions: int = 0
+
+
+@dataclass(frozen=True)
 class ReplicaSet:
     """The scheduling-relevant slice of apps/v1 ReplicaSet: desired replica
     count, the selector that claims pods, and the pod template to stamp

@@ -27,6 +27,13 @@ class RemoteStoreError(Exception):
     pass
 
 
+class RemoteUnavailableError(ConnectionError):
+    """Transient transport failure (connection refused/reset, timeout):
+    derives from ConnectionError so pump loops can catch-and-retry it the
+    way client-go's ListAndWatch retries — one apiserver restart must not
+    kill a component process."""
+
+
 class RemoteStore:
     def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
         self.base = base_url.rstrip("/")
@@ -56,6 +63,10 @@ class RemoteStore:
             if e.code == 404:
                 raise KeyError(reason) from None
             raise RemoteStoreError(f"{e.code}: {reason}") from None
+        except (urllib.error.URLError, TimeoutError, OSError) as e:
+            # transient transport failure → retryable (HTTPError is a
+            # URLError subclass, so it must be handled above first)
+            raise RemoteUnavailableError(str(e)) from None
 
     # ------------------------------------------------------ store protocol
     def get(self, kind: str, key: str):
@@ -115,10 +126,13 @@ class RemoteWatcher:
         return self._rv
 
     def poll(self) -> list[WatchEvent]:
+        # the long-poll must stay under the transport timeout or a quiet
+        # bucket reads as a (retryable) timeout every poll
+        wait = min(self.poll_timeout_s, max(self._store.timeout_s - 5.0, 0.0))
         res = self._store._request(
             "GET",
             f"/apis/{self._kind}?watch=1&resourceVersion={self._rv}"
-            f"&timeoutSeconds={self.poll_timeout_s}",
+            f"&timeoutSeconds={wait}",
         )
         self._rv = res["resourceVersion"]
         return [

@@ -48,7 +48,8 @@ import jax.numpy as jnp
 
 from ..framework import runtime as rt
 
-I64_MIN = jnp.int64(-(2**62))
+# plain int — a module-level jnp scalar would init the backend at import
+I64_MIN = -(2**62)
 
 
 def _tie_spread_choice(mask, score, active):

@@ -236,11 +236,11 @@ def pod_from_v1(obj: _JSON) -> t.Pod:
         # this envelope carries aggregates, so the explicit carrier is the
         # kubetpu.io/required-node-features annotation (comma-separated)
         required_node_features=tuple(sorted(
-            f for f in (
+            f.strip() for f in (
                 (meta.get("annotations") or {})
                 .get("kubetpu.io/required-node-features", "")
                 .split(",")
-            ) if f
+            ) if f.strip()
         )),
     )
 

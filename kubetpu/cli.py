@@ -108,6 +108,229 @@ def cmd_apiserver(args) -> int:
     return 0
 
 
+def _object_key(obj: Any) -> str:
+    """Store key for a typed object: namespace/name when namespaced."""
+    key = getattr(obj, "key", None)
+    if isinstance(key, str):
+        return key
+    ns = getattr(obj, "namespace", None)
+    name = getattr(obj, "name", None) or getattr(obj, "node_name", None)
+    if name is None:
+        raise ValueError(f"cannot derive a key for {type(obj).__name__}")
+    return f"{ns}/{name}" if ns else str(name)
+
+
+def _kind_buckets() -> dict:
+    """Typed object -> store bucket, built from the SHARED bucket constants
+    (one source of truth with the informers/controllers — a literal copy
+    here could silently drift into a bucket nothing watches)."""
+    from .client import informers as I
+    from .controllers.replicaset import REPLICA_SETS
+
+    return {
+        "Node": I.NODES, "Pod": I.PODS, "ReplicaSet": REPLICA_SETS,
+        "Service": I.SERVICES, "Namespace": I.NAMESPACES,
+        "PersistentVolume": I.PERSISTENT_VOLUMES,
+        "PersistentVolumeClaim": I.PERSISTENT_VOLUME_CLAIMS,
+        "StorageClass": I.STORAGE_CLASSES,
+        "PodDisruptionBudget": I.PDBS,
+        "PodGroup": I.POD_GROUPS, "DeviceClass": I.DEVICE_CLASSES,
+        "ResourceSlice": I.RESOURCE_SLICES,
+        "ResourceClaim": I.RESOURCE_CLAIMS,
+    }
+
+
+def _make_loop(run_once, period_s: float = 0.05):
+    import time
+
+    def loop() -> int:
+        try:
+            while True:
+                try:
+                    run_once()
+                except ConnectionError as e:
+                    # apiserver unreachable: back off and retry — one
+                    # restart must not kill the component
+                    print(f"apiserver unavailable, retrying: {e}",
+                          file=sys.stderr, flush=True)
+                    time.sleep(2.0)
+                    continue
+                time.sleep(period_s)
+        except KeyboardInterrupt:
+            return 0
+    return loop
+
+
+def _maybe_elect(args, store, component: str):
+    """Optional --leader-elect wrapper: returns a tick() gate."""
+    if not getattr(args, "leader_elect", False):
+        return lambda: True
+    import os
+    import socket
+    import uuid
+
+    from .sched.leaderelection import LeaderElector, StoreLeaseClient
+
+    elector = LeaderElector(
+        client=StoreLeaseClient(store),
+        # hostname + random suffix (client-go's id = hostname + "_" + uuid):
+        # a bare PID collides across containers (every replica is PID 1)
+        # and two same-identity electors would BOTH take the renew path
+        identity=(
+            f"{component}-{socket.gethostname()}-{os.getpid()}-"
+            f"{uuid.uuid4().hex[:8]}"
+        ),
+        name=component,
+    )
+    return elector.tick
+
+
+def cmd_scheduler(args) -> int:
+    """The kube-scheduler binary: informers + batch loop against a remote
+    API server (cmd/kube-scheduler/app/server.go Run shape)."""
+    from .apiserver import RemoteStore
+    from .client import SchedulerInformers, StoreClient
+    from .framework import config as C
+    from .framework.configload import ConfigError, load_config
+    from .sched import Scheduler
+
+    try:
+        cfg = load_config(args.config) if args.config else C.SchedulerConfiguration()
+    except (ConfigError, OSError) as e:
+        print(f"invalid config: {e}", file=sys.stderr)
+        return 1
+    store = RemoteStore(args.server)
+    sched = Scheduler(StoreClient(store), cfg=cfg, engine=args.engine)
+    sched.enable_preemption()
+    informers = SchedulerInformers(store, sched)
+    informers.start()
+    is_leader = _maybe_elect(args, store, "kube-scheduler")
+    print(f"kubetpu scheduler running against {args.server} "
+          f"(engine {args.engine})", flush=True)
+
+    def once():
+        if not is_leader():
+            return
+        informers.pump()
+        sched.schedule_batch()
+        sched._drain_bind_completions()
+    return _make_loop(once)()
+
+
+def cmd_controller_manager(args) -> int:
+    """kube-controller-manager: every controller stepping over the remote
+    store (cmd/kube-controller-manager controllermanager.go shape)."""
+    from .apiserver import RemoteStore
+    from .controllers import (
+        DisruptionController,
+        NodeLifecycleController,
+        PodGCController,
+        ReplicaSetController,
+        TaintEvictionController,
+    )
+
+    store = RemoteStore(args.server)
+    ctrls = [
+        ReplicaSetController(store),
+        NodeLifecycleController(store, grace_s=args.node_monitor_grace),
+        TaintEvictionController(store),
+        PodGCController(store, terminated_threshold=args.terminated_pod_gc),
+        DisruptionController(store),
+    ]
+    for c in ctrls:
+        c.start()
+    is_leader = _maybe_elect(args, store, "kube-controller-manager")
+    print(f"kubetpu controller-manager running against {args.server} "
+          f"({len(ctrls)} controllers)", flush=True)
+
+    def once():
+        if not is_leader():
+            return
+        for c in ctrls:
+            c.step()
+    return _make_loop(once, period_s=0.2)()
+
+
+def cmd_kubelet(args) -> int:
+    """The hollow node agent (kubemark tier) against a remote API server."""
+    from .api.wrappers import make_node
+    from .apiserver import RemoteStore
+    from .kubelet import HollowKubelet
+
+    store = RemoteStore(args.server)
+    kubelet = HollowKubelet(store, make_node(
+        args.node_name, cpu_milli=args.cpu_milli, memory=args.memory,
+        pods=args.pods,
+    ))
+    kubelet.start()
+    print(f"kubetpu kubelet {args.node_name} registered with {args.server}",
+          flush=True)
+    return _make_loop(kubelet.pump, period_s=0.2)()
+
+
+def cmd_get(args) -> int:
+    from .api import scheme
+    from .apiserver import RemoteStore
+
+    store = RemoteStore(args.server)
+    if args.key:
+        obj, rv = store.get(args.kind, args.key)
+        if obj is None:
+            print(f"{args.kind}/{args.key} not found", file=sys.stderr)
+            return 1
+        print(json.dumps(scheme.encode(obj), indent=2))
+    else:
+        items, _rv = store.list(args.kind)
+        for key, obj in sorted(items):
+            extra = ""
+            node = getattr(obj, "node_name", None)
+            if node is not None:
+                extra = f"\t{node or '<pending>'}\t{getattr(obj, 'phase', '')}"
+            print(f"{key}{extra}")
+    return 0
+
+
+def cmd_apply(args) -> int:
+    """Create-or-update kind-tagged YAML/JSON documents (kubectl apply)."""
+    import yaml
+
+    from .api import scheme
+    from .apiserver import RemoteStore
+    from .store.memstore import ConflictError
+
+    store = RemoteStore(args.server)
+    with open(args.file) as f:
+        docs = [d for d in yaml.safe_load_all(f.read()) if d]
+    applied = 0
+    for doc in docs:
+        obj = scheme.decode(doc)
+        kind = _kind_buckets().get(type(obj).__name__)
+        if kind is None:
+            print(f"no bucket for kind {type(obj).__name__}", file=sys.stderr)
+            return 1
+        key = _object_key(obj)
+        try:
+            store.create(kind, key, obj)
+        except ConflictError:
+            store.update(kind, key, obj)
+        applied += 1
+        print(f"{kind}/{key} applied")
+    return 0 if applied else 1
+
+
+def cmd_delete(args) -> int:
+    from .apiserver import RemoteStore
+
+    store = RemoteStore(args.server)
+    try:
+        store.delete(args.kind, args.key)
+    except KeyError:
+        print(f"{args.kind}/{args.key} not found", file=sys.stderr)
+        return 1
+    print(f"{args.kind}/{args.key} deleted")
+    return 0
+
+
 def cmd_version(_args) -> int:
     from . import __version__
 
@@ -142,6 +365,53 @@ def build_parser() -> argparse.ArgumentParser:
     check = sub.add_parser("check-config", help="validate a config file")
     check.add_argument("config")
     check.set_defaults(fn=cmd_check_config)
+
+    schd = sub.add_parser(
+        "scheduler", help="run the scheduler against a remote API server"
+    )
+    schd.add_argument("--server", required=True, help="API server base URL")
+    schd.add_argument("--config", default="", help="KubeSchedulerConfiguration file")
+    schd.add_argument("--engine", default="greedy",
+                      choices=["greedy", "batched"])
+    schd.add_argument("--leader-elect", action="store_true")
+    schd.set_defaults(fn=cmd_scheduler)
+
+    cm = sub.add_parser(
+        "controller-manager",
+        help="run the controller family against a remote API server",
+    )
+    cm.add_argument("--server", required=True)
+    cm.add_argument("--node-monitor-grace", type=float, default=40.0)
+    cm.add_argument("--terminated-pod-gc", type=int, default=0)
+    cm.add_argument("--leader-elect", action="store_true")
+    cm.set_defaults(fn=cmd_controller_manager)
+
+    kblt = sub.add_parser(
+        "kubelet", help="run a hollow node agent (kubemark tier)"
+    )
+    kblt.add_argument("--server", required=True)
+    kblt.add_argument("--node-name", required=True)
+    kblt.add_argument("--cpu-milli", type=int, default=4000)
+    kblt.add_argument("--memory", type=int, default=16 * 1024**3)
+    kblt.add_argument("--pods", type=int, default=110)
+    kblt.set_defaults(fn=cmd_kubelet)
+
+    get = sub.add_parser("get", help="list/get objects from an API server")
+    get.add_argument("kind")
+    get.add_argument("key", nargs="?", default="")
+    get.add_argument("--server", required=True)
+    get.set_defaults(fn=cmd_get)
+
+    apply = sub.add_parser("apply", help="apply kind-tagged YAML documents")
+    apply.add_argument("-f", "--file", required=True)
+    apply.add_argument("--server", required=True)
+    apply.set_defaults(fn=cmd_apply)
+
+    delete = sub.add_parser("delete", help="delete an object")
+    delete.add_argument("kind")
+    delete.add_argument("key")
+    delete.add_argument("--server", required=True)
+    delete.set_defaults(fn=cmd_delete)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=cmd_version)

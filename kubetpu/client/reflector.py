@@ -137,6 +137,10 @@ class Reflector:
             self.relists += 1
             self.sync()
             return len(self.informer.store)
+        except ConnectionError:
+            # transient transport failure (apiserver restarting): keep the
+            # local store, retry on the next pump — ListAndWatch's retry
+            return 0
         for ev in events:
             self.informer._apply(ev.type, ev.key, ev.obj)
         return len(events)

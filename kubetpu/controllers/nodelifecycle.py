@@ -38,12 +38,7 @@ TAINT_UNREACHABLE = (
 DEFAULT_GRACE_S = 40.0
 
 
-@dataclass(frozen=True)
-class NodeHeartbeat:
-    """The coordination Lease slice kubelets renew per node."""
-
-    node_name: str
-    renew_time: float
+NodeHeartbeat = t.NodeHeartbeat
 
 
 def heartbeat(store: MemStore, node_name: str, now: float) -> None:
@@ -73,6 +68,13 @@ class NodeLifecycleController:
         # first-seen times: a node with no lease yet gets the grace period
         # from when the controller first observed it
         self._first_seen: dict[str, float] = {}
+        # node -> (last renew_time VALUE seen, locally observed at).
+        # Staleness is judged on the CONTROLLER's clock against when it
+        # observed the renewal — renew_time values written by another
+        # machine's monotonic clock are treated as opaque change markers
+        # (the LeaderElector's observedTime rule; cross-host monotonic
+        # epochs are incomparable)
+        self._lease_observed: dict[str, tuple[float, float]] = {}
         self.transitions = 0   # metrics: taint add/remove writes
 
     def start(self) -> None:
@@ -99,7 +101,12 @@ class NodeLifecycleController:
     def _stale(self, name: str, now: float) -> bool:
         lease = self._leases.store.get(name)
         if lease is not None:
-            return now - lease.renew_time > self.grace_s
+            seen = self._lease_observed.get(name)
+            if seen is None or seen[0] != lease.renew_time:
+                # renewal observed NOW (on this controller's clock)
+                self._lease_observed[name] = (lease.renew_time, now)
+                return False
+            return now - seen[1] > self.grace_s
         first = self._first_seen.setdefault(name, now)
         return now - first > self.grace_s
 

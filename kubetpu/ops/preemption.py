@@ -42,9 +42,12 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 
-I64_MIN = jnp.int64(-(2**62))
-I64_MAX = jnp.int64(2**62)
-PRIO_OFFSET = jnp.int64(2**31)  # preemption.go:339 MaxInt32+1 shift
+# plain ints: jnp scalars here would initialize the backend at
+# IMPORT time (a CLI process must stay device-free until its loop);
+# ints weak-promote to i64 identically inside jit
+I64_MIN = -(2**62)
+I64_MAX = 2**62
+PRIO_OFFSET = 2**31  # preemption.go:339 MaxInt32+1 shift
 
 
 def _fits(pod_req, alloc, req_state, count_state, allowed, wants_conf, port_counts):

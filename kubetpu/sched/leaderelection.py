@@ -31,16 +31,7 @@ from dataclasses import dataclass, field, replace
 from typing import Any, Callable
 
 
-@dataclass(frozen=True)
-class LeaderElectionRecord:
-    """coordination.k8s.io Lease spec slice (leaderelection's
-    LeaderElectionRecord)."""
-
-    holder_identity: str
-    lease_duration_s: float
-    acquire_time: float
-    renew_time: float
-    leader_transitions: int = 0
+from ..api.types import LeaderElectionRecord  # noqa: E402  (wire type)
 
 
 class InMemoryLeaseClient:
@@ -83,6 +74,42 @@ class InMemoryLeaseClient:
                 return False   # CAS conflict
             self._leases[key] = (record, version + 1)
             return True
+
+
+class StoreLeaseClient:
+    """The lease protocol over any store (MemStore or RemoteStore): leases
+    are ordinary versioned objects in the ``leaderleases`` bucket, so
+    replicas in DIFFERENT processes race CAS updates through the API
+    server — the reference's coordination.k8s.io Lease shape."""
+
+    KIND = "leaderleases"
+
+    def __init__(self, store) -> None:
+        self._store = store
+
+    def get_lease(self, namespace: str, name: str):
+        obj, rv = self._store.get(self.KIND, f"{namespace}/{name}")
+        return obj, rv
+
+    def create_lease(self, namespace: str, name: str, record) -> bool:
+        from ..store.memstore import ConflictError
+
+        try:
+            self._store.create(self.KIND, f"{namespace}/{name}", record)
+            return True
+        except ConflictError:
+            return False
+
+    def update_lease(self, namespace: str, name: str, record, version) -> bool:
+        from ..store.memstore import ConflictError
+
+        try:
+            self._store.update(
+                self.KIND, f"{namespace}/{name}", record, expect_rv=version
+            )
+            return True
+        except ConflictError:
+            return False
 
 
 @dataclass

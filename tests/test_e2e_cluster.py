@@ -1,0 +1,150 @@
+"""The whole cluster as SEPARATE OS PROCESSES — apiserver, scheduler,
+controller-manager, two hollow kubelets — driven only through the CLI
+binaries and the REST API, like the reference's integration harness boots
+real binaries against a real etcd (test/integration/framework).
+
+Also covers kubectl-style get/apply/delete against the running server.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import scheme
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_pod
+from kubetpu.apiserver import RemoteStore
+from kubetpu.client.informers import NODES, PODS
+
+PORT = 19931
+SERVER = f"http://127.0.0.1:{PORT}"
+
+
+def _spawn(log_path, *cli_args: str) -> subprocess.Popen:
+    """Logs go to FILES: a PIPE nobody drains would fill and block the
+    component's trace logging mid-run."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONFAULTHANDLER="1")
+    log = open(log_path, "w")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "kubetpu", *cli_args],
+        env=env, stdout=log, stderr=subprocess.STDOUT, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    )
+    proc._log_path = log_path   # type: ignore[attr-defined]
+    return proc
+
+
+def _await_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        content = open(proc._log_path).read()   # type: ignore[attr-defined]
+        if needle in content:
+            return
+        if proc.poll() is not None:
+            raise AssertionError(
+                f"process exited {proc.returncode}: {content[-2000:]}"
+            )
+        time.sleep(0.1)
+    import signal
+
+    proc.send_signal(signal.SIGABRT)   # faulthandler dumps the hung stack
+    time.sleep(2)
+    content = open(proc._log_path).read()   # type: ignore[attr-defined]
+    raise AssertionError(
+        f"timed out waiting for {needle!r}; stack:\n{content[-3000:]}"
+    )
+
+
+def test_multi_process_cluster_end_to_end(tmp_path):
+    procs: list[subprocess.Popen] = []
+    try:
+        api = _spawn(tmp_path / "api.log", "apiserver", "--port", str(PORT))
+        procs.append(api)
+        _await_line(api, "serving on")
+
+        for node in ("worker-0", "worker-1"):
+            kb = _spawn(tmp_path / f"{node}.log", "kubelet",
+                        "--server", SERVER, "--node-name", node,
+                        "--cpu-milli", "4000")
+            procs.append(kb)
+            _await_line(kb, "registered")
+
+        cm = _spawn(tmp_path / "cm.log", "controller-manager",
+                    "--server", SERVER)
+        procs.append(cm)
+        _await_line(cm, "running against")
+
+        sched = _spawn(tmp_path / "sched.log", "scheduler",
+                       "--server", SERVER, "--engine", "greedy")
+        procs.append(sched)
+        _await_line(sched, "running against")
+
+        # kubectl apply a ReplicaSet manifest (kind-tagged YAML)
+        rs = t.ReplicaSet(
+            name="demo", replicas=6,
+            selector=t.LabelSelector.of({"app": "demo"}),
+            template=make_pod("tpl", labels={"app": "demo"}, cpu_milli=100),
+        )
+        manifest = tmp_path / "rs.json"
+        manifest.write_text(json.dumps(scheme.encode(rs)))
+        out = subprocess.run(
+            [sys.executable, "-m", "kubetpu", "apply",
+             "-f", str(manifest), "--server", SERVER],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+        assert "replicasets/default/demo applied" in out.stdout
+
+        remote = RemoteStore(SERVER)
+        deadline = time.monotonic() + 180
+        while time.monotonic() < deadline:
+            pods, _ = remote.list(PODS)
+            if len(pods) == 6 and all(
+                p.node_name and p.phase == "Running" for _, p in pods
+            ):
+                break
+            time.sleep(0.25)
+        else:
+            pods, _ = remote.list(PODS)
+            raise AssertionError(
+                f"cluster did not converge: "
+                f"{[(p.name, p.node_name, p.phase) for _, p in pods]}"
+            )
+        per_node = {}
+        for _, p in pods:
+            per_node[p.node_name] = per_node.get(p.node_name, 0) + 1
+        assert set(per_node) == {"worker-0", "worker-1"}
+
+        # kubectl get / delete round out the CLI surface
+        out = subprocess.run(
+            [sys.executable, "-m", "kubetpu", "get", "pods",
+             "--server", SERVER],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0
+        assert out.stdout.count("Running") == 6
+        out = subprocess.run(
+            [sys.executable, "-m", "kubetpu", "delete",
+             "replicasets", "default/demo", "--server", SERVER],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0
+        nodes, _ = remote.list(NODES)
+        assert len(nodes) == 2
+    finally:
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
