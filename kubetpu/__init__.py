@@ -46,15 +46,18 @@ if not os.environ.get("KUBETPU_NO_X64"):
 # sitecustomize unconditionally does jax.config.update("jax_platforms",
 # "axon,cpu") at interpreter startup, so a child process launched with
 # JAX_PLATFORMS=cpu still initializes the axon backend on its first device
-# op — and hangs forever when the TPU relay is down. Re-assert the env's
-# choice only when its PREFERRED platform differs from the active config's
-# (an ambient "axon" against "axon,cpu" is left alone, preserving the
-# site's cpu fallback).
+# op — and hangs forever when the TPU relay is down. Re-assert the env ONLY
+# over that exact site-hook signature and only when the env's preferred
+# platform isn't axon anyway — an explicit jax.config.update made by the
+# embedding process before importing kubetpu always wins (the config no
+# longer reads "axon,cpu"), and ambient axon environments are untouched.
 _env_platforms = os.environ.get("JAX_PLATFORMS", "")
-if _env_platforms:
-    _cfg = (jax.config.jax_platforms or "").split(",")
-    if _env_platforms.split(",")[0] != (_cfg[0] if _cfg else ""):
-        jax.config.update("jax_platforms", _env_platforms)
+if (
+    _env_platforms
+    and jax.config.jax_platforms == "axon,cpu"
+    and _env_platforms.split(",")[0] not in ("axon", "")
+):
+    jax.config.update("jax_platforms", _env_platforms)
 del _env_platforms
 
 __version__ = "0.4.0"

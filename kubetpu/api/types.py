@@ -537,6 +537,27 @@ class PodGroup:
 
 
 @dataclass(frozen=True)
+class Deployment:
+    """The scheduling-relevant slice of apps/v1 Deployment: desired
+    replicas, selector, pod template, and the rollout strategy knobs
+    (pkg/controller/deployment rolling.go consumes maxSurge /
+    maxUnavailable)."""
+
+    name: str
+    namespace: str = "default"
+    replicas: int = 1
+    selector: LabelSelector | None = None
+    template: "Pod | None" = None
+    strategy: str = "RollingUpdate"      # or "Recreate"
+    max_surge: int = 1
+    max_unavailable: int = 0
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
 class NodeHeartbeat:
     """The coordination.k8s.io Lease slice kubelets renew per node
     (pkg/kubelet/nodelease; consumed by the nodelifecycle controller)."""
@@ -568,6 +589,8 @@ class ReplicaSet:
     replicas: int = 1
     selector: LabelSelector | None = None
     template: "Pod | None" = None     # prototype; name/uid/owner stamped
+    # the owning controller ("Deployment/<ns>/<name>"), "" = standalone
+    owner: str = ""
 
     @property
     def key(self) -> str:

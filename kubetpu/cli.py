@@ -125,10 +125,12 @@ def _kind_buckets() -> dict:
     (one source of truth with the informers/controllers — a literal copy
     here could silently drift into a bucket nothing watches)."""
     from .client import informers as I
+    from .controllers.deployment import DEPLOYMENTS
     from .controllers.replicaset import REPLICA_SETS
 
     return {
         "Node": I.NODES, "Pod": I.PODS, "ReplicaSet": REPLICA_SETS,
+        "Deployment": DEPLOYMENTS,
         "Service": I.SERVICES, "Namespace": I.NAMESPACES,
         "PersistentVolume": I.PERSISTENT_VOLUMES,
         "PersistentVolumeClaim": I.PERSISTENT_VOLUME_CLAIMS,
@@ -138,6 +140,22 @@ def _kind_buckets() -> dict:
         "ResourceSlice": I.RESOURCE_SLICES,
         "ResourceClaim": I.RESOURCE_CLAIMS,
     }
+
+
+def _retry_start(fn, what: str) -> None:
+    """Component startup against a possibly-still-booting apiserver: retry
+    transient transport failures forever (the reference components block on
+    WaitForCacheSync the same way)."""
+    import time
+
+    while True:
+        try:
+            fn()
+            return
+        except ConnectionError as e:
+            print(f"{what}: apiserver unavailable at startup, retrying: {e}",
+                  file=sys.stderr, flush=True)
+            time.sleep(2.0)
 
 
 def _make_loop(run_once, period_s: float = 0.05):
@@ -203,7 +221,7 @@ def cmd_scheduler(args) -> int:
     sched = Scheduler(StoreClient(store), cfg=cfg, engine=args.engine)
     sched.enable_preemption()
     informers = SchedulerInformers(store, sched)
-    informers.start()
+    _retry_start(informers.start, "scheduler informers")
     is_leader = _maybe_elect(args, store, "kube-scheduler")
     print(f"kubetpu scheduler running against {args.server} "
           f"(engine {args.engine})", flush=True)
@@ -222,6 +240,7 @@ def cmd_controller_manager(args) -> int:
     store (cmd/kube-controller-manager controllermanager.go shape)."""
     from .apiserver import RemoteStore
     from .controllers import (
+        DeploymentController,
         DisruptionController,
         NodeLifecycleController,
         PodGCController,
@@ -231,6 +250,7 @@ def cmd_controller_manager(args) -> int:
 
     store = RemoteStore(args.server)
     ctrls = [
+        DeploymentController(store),
         ReplicaSetController(store),
         NodeLifecycleController(store, grace_s=args.node_monitor_grace),
         TaintEvictionController(store),
@@ -238,7 +258,7 @@ def cmd_controller_manager(args) -> int:
         DisruptionController(store),
     ]
     for c in ctrls:
-        c.start()
+        _retry_start(c.start, type(c).__name__)
     is_leader = _maybe_elect(args, store, "kube-controller-manager")
     print(f"kubetpu controller-manager running against {args.server} "
           f"({len(ctrls)} controllers)", flush=True)
@@ -262,7 +282,7 @@ def cmd_kubelet(args) -> int:
         args.node_name, cpu_milli=args.cpu_milli, memory=args.memory,
         pods=args.pods,
     ))
-    kubelet.start()
+    _retry_start(kubelet.start, f"kubelet {args.node_name}")
     print(f"kubetpu kubelet {args.node_name} registered with {args.server}",
           flush=True)
     return _make_loop(kubelet.pump, period_s=0.2)()

@@ -1,5 +1,6 @@
 """Controllers: informer + reconcile loops over the store (pkg/controller)."""
 
+from .deployment import DEPLOYMENTS, DeploymentController  # noqa: F401
 from .disruption import DisruptionController  # noqa: F401
 from .nodelifecycle import (  # noqa: F401
     NodeHeartbeat,

@@ -1,0 +1,194 @@
+"""Deployment controller — template-hashed ReplicaSets + rolling updates.
+
+Reference: ``pkg/controller/deployment`` (deployment_controller.go +
+rolling.go): a Deployment owns ReplicaSets named by a hash of the pod
+template; ``syncDeployment`` ensures the NEW template's RS exists, then the
+rolling step scales it up within ``maxSurge`` and scales the OLD RSes down
+within ``maxUnavailable`` — progress is gated on AVAILABLE (here: Running)
+pods, so a rollout never drops capacity below ``replicas − maxUnavailable``.
+``Recreate`` scales every old RS to zero first.
+
+The ReplicaSetController remains the pod-level actor: this controller only
+writes ReplicaSet objects (the reference's two-controller split).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+
+from ..api import scheme
+from ..api import types as t
+from ..client.informers import PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import ConflictError, MemStore
+from .replicaset import REPLICA_SETS
+
+DEPLOYMENTS = "deployments"
+
+
+def template_hash(template: t.Pod) -> str:
+    """Deterministic pod-template hash (the pod-template-hash label's
+    analog) — the scheme encoding is canonical for the envelope."""
+    blob = json.dumps(scheme.encode(template), sort_keys=True)
+    return hashlib.sha1(blob.encode()).hexdigest()[:10]
+
+
+def _owner_ref(d: t.Deployment) -> str:
+    return f"Deployment/{d.namespace}/{d.name}"
+
+
+class DeploymentController:
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self._deps = SharedInformer(DEPLOYMENTS)
+        self._rs = SharedInformer(REPLICA_SETS)
+        self._pods = SharedInformer(PODS)
+        self._r = [
+            Reflector(store, self._deps),
+            Reflector(store, self._rs),
+            Reflector(store, self._pods),
+        ]
+        self.rollouts = 0   # metrics: RS writes
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    # ----------------------------------------------------------- reconcile
+    def step(self) -> int:
+        self.pump()
+        wrote = 0
+        for key, dep in list(self._deps.store.items()):
+            if dep.template is None:
+                continue
+            wrote += self._sync(dep)
+        return wrote
+
+    def _owned_rs(self, dep: t.Deployment) -> dict[str, t.ReplicaSet]:
+        ref = _owner_ref(dep)
+        return {
+            key: rs for key, rs in self._rs.store.items()
+            if rs.owner == ref
+        }
+
+    def _running(self, rs: t.ReplicaSet) -> int:
+        """Available pods of one RS (phase Running — the availability gate
+        the rolling step respects)."""
+        ref = f"ReplicaSet/{rs.namespace}/{rs.name}"
+        return sum(
+            1 for p in self._pods.store.values()
+            if p.owner == ref and p.node_name and p.phase == "Running"
+        )
+
+    def _write_rs(self, key: str, rs: t.ReplicaSet) -> int:
+        live, rv = self.store.get(REPLICA_SETS, key)
+        try:
+            if live is None:
+                self.store.create(REPLICA_SETS, key, rs)
+            else:
+                if live.replicas == rs.replicas:
+                    return 0
+                self.store.update(
+                    REPLICA_SETS, key,
+                    dataclasses.replace(live, replicas=rs.replicas),
+                    expect_rv=rv,
+                )
+        except ConflictError:
+            return 0
+        self.rollouts += 1
+        return 1
+
+    def _sync(self, dep: t.Deployment) -> int:
+        new_hash = template_hash(dep.template)
+        new_name = f"{dep.name}-{new_hash}"
+        new_key = f"{dep.namespace}/{new_name}"
+        owned = self._owned_rs(dep)
+        olds = {k: rs for k, rs in owned.items() if rs.name != new_name}
+        new_rs = owned.get(new_key)
+
+        wrote = 0
+        if new_rs is None:
+            start = 0 if olds else dep.replicas
+            if dep.strategy == "RollingUpdate" and olds:
+                # surge room opens immediately
+                start = min(dep.replicas, dep.max_surge)
+            new_rs = t.ReplicaSet(
+                name=new_name, namespace=dep.namespace,
+                replicas=start, selector=dep.selector,
+                owner=_owner_ref(dep),
+                template=dataclasses.replace(
+                    dep.template,
+                    labels=dep.template.labels
+                    + (("pod-template-hash", new_hash),),
+                ),
+            )
+            wrote += self._write_rs(new_key, new_rs)
+            if dep.strategy == "Recreate" and olds:
+                for k, rs in olds.items():
+                    if rs.replicas:
+                        wrote += self._write_rs(
+                            k, dataclasses.replace(rs, replicas=0)
+                        )
+            return wrote
+
+        old_total = sum(rs.replicas for rs in olds.values())
+        if dep.strategy == "Recreate":
+            for k, rs in olds.items():
+                if rs.replicas:
+                    wrote += self._write_rs(
+                        k, dataclasses.replace(rs, replicas=0)
+                    )
+            # the new RS scales up only once the old PODS are actually gone
+            # (specs hitting zero is not enough — the pod-level actor runs
+            # asynchronously, and overlapping versions is the one thing
+            # Recreate exists to prevent)
+            old_refs = {
+                f"ReplicaSet/{rs.namespace}/{rs.name}" for rs in olds.values()
+            }
+            old_pods = sum(
+                1 for p in self._pods.store.values() if p.owner in old_refs
+            )
+            if old_pods == 0 and not any(
+                rs.replicas for rs in olds.values()
+            ):
+                wrote += self._write_rs(
+                    new_key,
+                    dataclasses.replace(new_rs, replicas=dep.replicas),
+                )
+            return wrote
+
+        # RollingUpdate (rolling.go reconcileNewReplicaSet /
+        # reconcileOldReplicaSets):
+        # scale new toward desired within the surge headroom; with no old
+        # RSes left this is a plain resize in EITHER direction (a replicas
+        # decrease must propagate too)
+        max_total = dep.replicas + dep.max_surge
+        want_new = min(dep.replicas, max_total - old_total)
+        if want_new > new_rs.replicas or (not olds and want_new != new_rs.replicas):
+            wrote += self._write_rs(
+                new_key, dataclasses.replace(new_rs, replicas=want_new)
+            )
+            new_rs = dataclasses.replace(new_rs, replicas=want_new)
+        # scale olds down within the availability budget, SPEC-accounted
+        # (rolling.go maxScaledDown = allPodsCount − minAvailable −
+        # newRSUnavailable, where allPodsCount sums SPEC replicas): spec
+        # counts drop the moment we write, so repeated steps can't
+        # re-decrement past the floor while pods are still terminating
+        min_available = dep.replicas - dep.max_unavailable
+        all_spec = new_rs.replicas + old_total
+        new_unavailable = max(0, new_rs.replicas - self._running(new_rs))
+        cleanup = max(0, all_spec - min_available - new_unavailable)
+        for k, rs in sorted(olds.items()):
+            if cleanup <= 0 or rs.replicas == 0:
+                continue
+            drop = min(rs.replicas, cleanup)
+            cleanup -= drop
+            wrote += self._write_rs(
+                k, dataclasses.replace(rs, replicas=rs.replicas - drop)
+            )
+        return wrote

@@ -93,9 +93,19 @@ class NodeLifecycleController:
 
     def _mark_first_seen(self, now: float) -> None:
         """A node's no-lease grace runs from when the controller FIRST saw
-        it — recorded at discovery, not at the first reconcile pass."""
+        it — recorded at discovery, not at the first reconcile pass.
+        Observation state for DELETED nodes is pruned here too, so a
+        recreated same-name node gets a fresh grace period instead of
+        inheriting the dead node's stale observation (and the dicts stay
+        bounded by the live node count)."""
         for name in self._nodes.store:
             self._first_seen.setdefault(name, now)
+        for name in list(self._first_seen):
+            if name not in self._nodes.store:
+                del self._first_seen[name]
+        for name in list(self._lease_observed):
+            if name not in self._nodes.store:
+                del self._lease_observed[name]
 
     # ---------------------------------------------------------- reconcile
     def _stale(self, name: str, now: float) -> bool:

@@ -133,6 +133,7 @@ class LeaderElector:
     _observed: LeaderElectionRecord | None = field(default=None, init=False)
     _observed_at: float = field(default=0.0, init=False)
     _last_renew: float = field(default=0.0, init=False)
+    _last_attempt: float = field(default=float("-inf"), init=False)
     _seen_leader: str = field(default="", init=False)
 
     @property
@@ -153,6 +154,9 @@ class LeaderElector:
             self._step_down()
         if self._is_leader and now - self._last_renew < self.retry_period_s:
             return True   # fresh enough — skip the get+CAS round trip
+        if not self._is_leader and now - self._last_attempt < self.retry_period_s:
+            return False  # followers poll on RetryPeriod too, not per wakeup
+        self._last_attempt = now
         acquired = self._try_acquire_or_renew(now)
         if acquired and not self._is_leader:
             self._is_leader = True

@@ -460,3 +460,206 @@ def test_node_declared_features_gate_checked_at_construction():
         feature_gates={"NodeDeclaredFeatures": True},
     )
     assert s is not None
+
+
+# --------------------------------------------------------------- deployment
+
+def test_deployment_creates_rs_and_rolls_out():
+    """Template change: the new hash's RS surges up, the old scales down
+    gated on Running pods (rolling.go), converging to the new template."""
+    from kubetpu.controllers import DEPLOYMENTS, DeploymentController
+    from kubetpu.kubelet import HollowCluster
+
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(
+        st, [make_node(f"n{i}", cpu_milli=8000, pods=32) for i in range(2)],
+        clock=lambda: clock[0],
+    )
+    cluster.start()
+    dep = t.Deployment(
+        name="web", replicas=4,
+        selector=t.LabelSelector.of({"app": "web"}),
+        template=make_pod("tpl", labels={"app": "web"}, cpu_milli=100),
+        max_surge=2, max_unavailable=1,
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rs_ctrl = ReplicaSetController(st)
+    sched_clock = FakeClock()
+    sched = Scheduler(
+        StoreClient(st), profile=C.minimal_profile(),
+        dispatcher_workers=0, clock=sched_clock,
+    )
+    informers = SchedulerInformers(st, sched)
+    dc.start(); rs_ctrl.start(); informers.start()
+
+    def converge(n=14):
+        for _ in range(n):
+            dc.step(); rs_ctrl.step(); cluster.pump(); informers.pump()
+            sched.schedule_batch()
+            sched.dispatcher.sync()
+            sched._drain_bind_completions()
+            sched_clock.tick(2)
+
+    converge()
+    pods, _ = st.list(PODS)
+    assert len(pods) == 4 and all(p.phase == "Running" for _, p in pods)
+    rss, _ = st.list("replicasets")
+    assert len(rss) == 1
+    hash_v1 = rss[0][1].name
+
+    # rollout: new template (different cpu) replaces every pod
+    st.update(DEPLOYMENTS, dep.key, dataclasses.replace(
+        dep, template=make_pod("tpl", labels={"app": "web"}, cpu_milli=200),
+    ))
+    converge(24)
+    pods, _ = st.list(PODS)
+    assert len(pods) == 4
+    assert all(p.requests_dict()["cpu"] == 200 for _, p in pods), [
+        p.requests for _, p in pods
+    ]
+    assert all(p.phase == "Running" for _, p in pods)
+    rss = {k: rs for k, rs in st.list("replicasets")[0]}
+    old = [rs for rs in rss.values() if rs.name == hash_v1]
+    assert old and old[0].replicas == 0          # old RS scaled to zero
+    assert sum(rs.replicas for rs in rss.values()) == 4
+
+
+def test_deployment_recreate_strategy():
+    from kubetpu.controllers import DEPLOYMENTS, DeploymentController
+
+    st = MemStore()
+    dep = t.Deployment(
+        name="rc", replicas=2, strategy="Recreate",
+        selector=t.LabelSelector.of({"app": "rc"}),
+        template=make_pod("tpl", labels={"app": "rc"}),
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rs_ctrl = ReplicaSetController(st)
+    dc.start(); rs_ctrl.start()
+    dc.step(); rs_ctrl.step()
+    assert sum(rs.replicas for _, rs in st.list("replicasets")[0]) == 2
+    # new template: old RS drops to 0 FIRST, then the new scales up
+    st.update(DEPLOYMENTS, dep.key, dataclasses.replace(
+        dep, template=make_pod("tpl2", labels={"app": "rc"}),
+    ))
+    dc.step()
+    rss = {rs.name: rs for _, rs in st.list("replicasets")[0]}
+    assert len(rss) == 2
+    news = [rs for rs in rss.values() if rs.replicas == 0]
+    assert len(news) == 2        # both at zero this instant
+    rs_ctrl.step()               # the pod-level actor removes old pods
+    dc.step()                    # only THEN may the new RS scale up
+    assert sum(rs.replicas for _, rs in st.list("replicasets")[0]) == 2
+
+
+def test_deployment_scale_down_propagates():
+    from kubetpu.controllers import DEPLOYMENTS, DeploymentController
+
+    st = MemStore()
+    dep = t.Deployment(
+        name="sd", replicas=4, selector=t.LabelSelector.of({"app": "sd"}),
+        template=make_pod("tpl", labels={"app": "sd"}),
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rs_ctrl = ReplicaSetController(st)
+    dc.start(); rs_ctrl.start()
+    dc.step(); rs_ctrl.step()
+    assert len(st.list(PODS)[0]) == 4
+    st.update(DEPLOYMENTS, dep.key, dataclasses.replace(dep, replicas=2))
+    dc.step(); rs_ctrl.step()
+    assert sum(rs.replicas for _, rs in st.list("replicasets")[0]) == 2
+    assert len(st.list(PODS)[0]) == 2
+
+
+def test_deployment_rolling_floor_holds_without_new_capacity():
+    """Repeated controller steps while the surge pods CANNOT start must not
+    scale olds below replicas - maxUnavailable (spec-accounted headroom,
+    rolling.go maxScaledDown)."""
+    from kubetpu.controllers import DEPLOYMENTS, DeploymentController
+
+    st = MemStore()
+    dep = t.Deployment(
+        name="fl", replicas=4, max_surge=1, max_unavailable=1,
+        selector=t.LabelSelector.of({"app": "fl"}),
+        template=make_pod("tpl", labels={"app": "fl"}),
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rs_ctrl = ReplicaSetController(st)
+    dc.start(); rs_ctrl.start()
+    dc.step(); rs_ctrl.step()
+    # mark the v1 pods Running (hand-rolled kubelet)
+    for key, p in st.list(PODS)[0]:
+        st.update(PODS, key, dataclasses.replace(p.with_node("n0"),
+                                                 phase="Running"))
+    # new template; its pods never start (no kubelet marks them Running)
+    st.update(DEPLOYMENTS, dep.key, dataclasses.replace(
+        dep, template=make_pod("tpl", labels={"app": "fl"}, cpu_milli=999),
+    ))
+    for _ in range(6):     # many steps: must not ratchet olds to zero
+        dc.step()
+        rs_ctrl.step()
+    rss = {rs.name: rs for _, rs in st.list("replicasets")[0]}
+    olds = [rs for rs in rss.values() if "999" not in str(rs.template)]
+    old_spec = sum(
+        rs.replicas for rs in rss.values()
+        if rs.template.requests_dict().get("cpu") != 999
+    )
+    assert old_spec >= 3, rss    # floor: 4 - 1 = 3 old pods keep serving
+
+
+def test_deployment_recreate_waits_for_old_pods_gone():
+    from kubetpu.controllers import DEPLOYMENTS, DeploymentController
+
+    st = MemStore()
+    dep = t.Deployment(
+        name="rw", replicas=2, strategy="Recreate",
+        selector=t.LabelSelector.of({"app": "rw"}),
+        template=make_pod("tpl", labels={"app": "rw"}),
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rs_ctrl = ReplicaSetController(st)
+    dc.start(); rs_ctrl.start()
+    dc.step(); rs_ctrl.step()
+    st.update(DEPLOYMENTS, dep.key, dataclasses.replace(
+        dep, template=make_pod("tpl2", labels={"app": "rw"}),
+    ))
+    dc.step()              # old spec -> 0 written, but old PODS still exist
+    dc.step()              # must NOT scale the new RS up yet
+    rss = {rs.name: rs for _, rs in st.list("replicasets")[0]}
+    assert sum(rs.replicas for rs in rss.values()) == 0
+    rs_ctrl.step()         # pod-level actor deletes the old pods
+    dc.step()              # now the new RS may scale
+    assert sum(rs.replicas for _, rs in st.list("replicasets")[0]) == 2
+
+
+def test_follower_lease_polling_is_throttled():
+    from kubetpu.sched.leaderelection import InMemoryLeaseClient, LeaderElector
+
+    def _elector(client, ident, clock):
+        return LeaderElector(
+            client=client, identity=ident, clock=lambda: clock[0],
+        )
+
+    clock = [0.0]
+    client = InMemoryLeaseClient()
+    gets = [0]
+    real_get = client.get_lease
+    client.get_lease = lambda *a: (gets.__setitem__(0, gets[0] + 1),
+                                   real_get(*a))[1]
+    a = _elector(client, "a", clock)
+    b = _elector(client, "b", clock)
+    assert a.tick()
+    b.tick()
+    n0 = gets[0]
+    for _ in range(100):   # hot loop, no time passing
+        assert b.tick() is False
+    assert gets[0] == n0   # follower did not poll within retry period
+    clock[0] += 3
+    b.tick()
+    assert gets[0] == n0 + 1
