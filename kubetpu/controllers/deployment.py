@@ -169,7 +169,12 @@ class DeploymentController:
         # decrease must propagate too)
         max_total = dep.replicas + dep.max_surge
         want_new = min(dep.replicas, max_total - old_total)
-        if want_new > new_rs.replicas or (not olds and want_new != new_rs.replicas):
+        # plain resize (either direction) is gated on old SPEC replicas being
+        # zero — completed rollouts leave zero-replica old RS objects behind,
+        # and their mere existence must not pin the new RS's size
+        if want_new > new_rs.replicas or (
+            old_total == 0 and want_new != new_rs.replicas
+        ):
             wrote += self._write_rs(
                 new_key, dataclasses.replace(new_rs, replicas=want_new)
             )

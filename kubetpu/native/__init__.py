@@ -1,0 +1,87 @@
+"""Native runtime components — compile-on-first-use C++ extensions.
+
+The reference's runtime is compiled code end to end (Go binaries + native
+etcd); kubetpu's device path is XLA-compiled, and THIS package supplies the
+native host-runtime pieces: currently the store core
+(``memstore_core.cpp`` — the versioned object map + watch ring behind
+``kubetpu.store.MemStore``).
+
+Build model: ``g++ -O2 -shared -fPIC`` against the running CPython's
+headers, cached under ``.native_cache/`` next to this package (keyed by
+source mtime + python version). No pip, no pybind11 — the CPython C API
+only (environment contract). A missing compiler or ``KUBETPU_NO_NATIVE=1``
+falls back to the pure-Python implementation with identical semantics; the
+store test suite exercises the same contract against both backends.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sys
+import sysconfig
+
+_CACHE: dict[str, object] = {}
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                     ".native_cache")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _so_path(name: str, src: str) -> str:
+    tag = f"{sys.version_info.major}{sys.version_info.minor}"
+    mtime = int(os.stat(src).st_mtime)
+    return os.path.join(_build_dir(), f"{name}.py{tag}.{mtime}.so")
+
+
+def load_extension(name: str, source_file: str):
+    """Compile (if needed) and import the named CPython extension; returns
+    the module or None when native is disabled/unbuildable."""
+    if os.environ.get("KUBETPU_NO_NATIVE"):
+        return None
+    if name in _CACHE:
+        return _CACHE[name]
+    src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       source_file)
+    so = _so_path(name, src)
+    if not os.path.exists(so):
+        include = sysconfig.get_paths()["include"]
+        cmd = [
+            "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+            f"-I{include}", src, "-o", so,
+        ]
+        try:
+            proc = subprocess.run(
+                cmd, capture_output=True, text=True, timeout=120,
+            )
+        except (OSError, subprocess.TimeoutExpired):
+            _CACHE[name] = None
+            return None
+        if proc.returncode != 0:
+            # loud once (a broken toolchain should be visible), then fall back
+            print(f"kubetpu.native: build of {name} failed:\n"
+                  f"{proc.stderr[-2000:]}", file=sys.stderr)
+            _CACHE[name] = None
+            return None
+    spec = importlib.util.spec_from_file_location(name, so)
+    if spec is None or spec.loader is None:
+        _CACHE[name] = None
+        return None
+    mod = importlib.util.module_from_spec(spec)
+    try:
+        spec.loader.exec_module(mod)
+    except ImportError:
+        _CACHE[name] = None
+        return None
+    _CACHE[name] = mod
+    return mod
+
+
+def store_core():
+    """The native StoreCore class, or None (fallback to pure Python)."""
+    mod = load_extension("_kubetpu_store", "memstore_core.cpp")
+    return getattr(mod, "StoreCore", None) if mod is not None else None

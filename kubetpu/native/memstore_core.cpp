@@ -1,0 +1,279 @@
+// Native store core — the versioned object map + watch event ring behind
+// kubetpu.store.MemStore (layer 0). The reference's storage layer is native
+// code (etcd, compiled Go, spoken over gRPC: apiserver/pkg/storage/etcd3);
+// this is the framework's equivalent: the hot create/update/get/list/
+// events-since paths in C++, exposed through the CPython C API, holding
+// opaque PyObject* values (no serialization on the in-process path).
+//
+// Concurrency contract: the Python wrapper (kubetpu.store.memstore.MemStore)
+// serializes every call under its Condition lock — and CPython extension
+// calls hold the GIL — so this core is single-writer by construction and
+// keeps no locks of its own.
+//
+// Build: kubetpu/native/__init__.py compiles this with g++ on first use and
+// caches the .so; KUBETPU_NO_NATIVE=1 (or a missing compiler) falls back to
+// the pure-Python dict implementation with identical semantics (the test
+// suite runs the same contract against both).
+
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <deque>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Event {
+  int type;  // 0 ADDED, 1 MODIFIED, 2 DELETED
+  std::string kind;
+  std::string key;
+  PyObject* obj;  // owned reference
+  long long rv;
+};
+
+struct StoreObject {
+  PyObject_HEAD
+  long long rv;
+  long long compacted_through;
+  size_t history;
+  std::unordered_map<std::string, std::pair<PyObject*, long long>>* objects;
+  std::deque<Event>* events;
+};
+
+std::string map_key(const char* kind, const char* key) {
+  std::string k(kind);
+  k.push_back('\x1f');  // unit separator — never in identifiers
+  k.append(key);
+  return k;
+}
+
+void push_event(StoreObject* self, int type, const char* kind,
+                const char* key, PyObject* obj) {
+  if (self->events->size() >= self->history) {
+    Event& old = self->events->front();
+    self->compacted_through = old.rv;
+    Py_DECREF(old.obj);
+    self->events->pop_front();
+  }
+  Py_INCREF(obj);
+  self->events->push_back(Event{type, kind, key, obj, self->rv});
+}
+
+// ---------------------------------------------------------------- methods
+
+PyObject* store_create(StoreObject* self, PyObject* args) {
+  const char* kind;
+  const char* key;
+  PyObject* obj;
+  if (!PyArg_ParseTuple(args, "ssO", &kind, &key, &obj)) return nullptr;
+  auto mk = map_key(kind, key);
+  if (self->objects->count(mk)) {
+    PyErr_Format(PyExc_KeyError, "%s/%s already exists", kind, key);
+    return nullptr;
+  }
+  self->rv += 1;
+  Py_INCREF(obj);
+  (*self->objects)[mk] = {obj, self->rv};
+  push_event(self, 0, kind, key, obj);
+  return PyLong_FromLongLong(self->rv);
+}
+
+PyObject* store_update(StoreObject* self, PyObject* args) {
+  const char* kind;
+  const char* key;
+  PyObject* obj;
+  long long expect = -1;
+  if (!PyArg_ParseTuple(args, "ssO|L", &kind, &key, &obj, &expect))
+    return nullptr;
+  auto mk = map_key(kind, key);
+  auto it = self->objects->find(mk);
+  bool existed = it != self->objects->end();
+  if (expect >= 0) {
+    long long have = existed ? it->second.second : -1;
+    if (!existed || have != expect) {
+      PyErr_Format(PyExc_ValueError, "%s/%s: expected rv %lld, have %lld",
+                   kind, key, expect, have);
+      return nullptr;
+    }
+  }
+  self->rv += 1;
+  Py_INCREF(obj);
+  if (existed) {
+    Py_DECREF(it->second.first);
+    it->second = {obj, self->rv};
+  } else {
+    (*self->objects)[mk] = {obj, self->rv};
+  }
+  push_event(self, existed ? 1 : 0, kind, key, obj);
+  return PyLong_FromLongLong(self->rv);
+}
+
+PyObject* store_delete(StoreObject* self, PyObject* args) {
+  const char* kind;
+  const char* key;
+  if (!PyArg_ParseTuple(args, "ss", &kind, &key)) return nullptr;
+  auto mk = map_key(kind, key);
+  auto it = self->objects->find(mk);
+  if (it == self->objects->end()) {
+    PyErr_Format(PyExc_KeyError, "%s/%s not found", kind, key);
+    return nullptr;
+  }
+  PyObject* old = it->second.first;
+  self->objects->erase(it);
+  self->rv += 1;
+  push_event(self, 2, kind, key, old);
+  Py_DECREF(old);
+  return PyLong_FromLongLong(self->rv);
+}
+
+PyObject* store_get(StoreObject* self, PyObject* args) {
+  const char* kind;
+  const char* key;
+  if (!PyArg_ParseTuple(args, "ss", &kind, &key)) return nullptr;
+  auto it = self->objects->find(map_key(kind, key));
+  if (it == self->objects->end()) {
+    return Py_BuildValue("(OL)", Py_None, 0LL);
+  }
+  return Py_BuildValue("(OL)", it->second.first, it->second.second);
+}
+
+PyObject* store_list(StoreObject* self, PyObject* args) {
+  const char* kind;
+  if (!PyArg_ParseTuple(args, "s", &kind)) return nullptr;
+  std::string prefix(kind);
+  prefix.push_back('\x1f');
+  PyObject* items = PyList_New(0);
+  if (!items) return nullptr;
+  for (auto& kv : *self->objects) {
+    if (kv.first.compare(0, prefix.size(), prefix) != 0) continue;
+    PyObject* entry = Py_BuildValue(
+        "(sO)", kv.first.c_str() + prefix.size(), kv.second.first);
+    if (!entry || PyList_Append(items, entry) < 0) {
+      Py_XDECREF(entry);
+      Py_DECREF(items);
+      return nullptr;
+    }
+    Py_DECREF(entry);
+  }
+  PyObject* out = Py_BuildValue("(NL)", items, self->rv);
+  return out;
+}
+
+// events_since(kind_or_None, rv) -> (list[(type, kind, key, obj, rv)], cursor)
+// raises LookupError when rv predates the ring buffer (compacted).
+PyObject* store_events_since(StoreObject* self, PyObject* args) {
+  PyObject* kind_obj;
+  long long rv;
+  if (!PyArg_ParseTuple(args, "OL", &kind_obj, &rv)) return nullptr;
+  const char* kind =
+      kind_obj == Py_None ? nullptr : PyUnicode_AsUTF8(kind_obj);
+  if (kind_obj != Py_None && !kind) return nullptr;
+  if (rv < self->compacted_through) {
+    PyErr_Format(PyExc_LookupError, "rv %lld compacted (through %lld)", rv,
+                 self->compacted_through);
+    return nullptr;
+  }
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  long long cursor = rv;
+  if (!self->events->empty() && self->events->back().rv > rv) {
+    cursor = self->events->back().rv;
+    // scan only events NEWER than rv (rv-ordered deque, from the back)
+    std::vector<const Event*> hits;
+    for (auto it = self->events->rbegin(); it != self->events->rend(); ++it) {
+      if (it->rv <= rv) break;
+      if (!kind || it->kind == kind) hits.push_back(&*it);
+    }
+    for (auto rit = hits.rbegin(); rit != hits.rend(); ++rit) {
+      const Event* e = *rit;
+      PyObject* entry =
+          Py_BuildValue("(issOL)", e->type, e->kind.c_str(), e->key.c_str(),
+                        e->obj, e->rv);
+      if (!entry || PyList_Append(out, entry) < 0) {
+        Py_XDECREF(entry);
+        Py_DECREF(out);
+        return nullptr;
+      }
+      Py_DECREF(entry);
+    }
+  }
+  return Py_BuildValue("(NL)", out, cursor);
+}
+
+PyObject* store_resource_version(StoreObject* self, PyObject*) {
+  return PyLong_FromLongLong(self->rv);
+}
+
+PyObject* store_compacted_through(StoreObject* self, PyObject*) {
+  return PyLong_FromLongLong(self->compacted_through);
+}
+
+// ----------------------------------------------------------------- type
+
+PyObject* store_new(PyTypeObject* type, PyObject* args, PyObject*) {
+  long long history = 8192;
+  if (!PyArg_ParseTuple(args, "|L", &history)) return nullptr;
+  StoreObject* self = (StoreObject*)type->tp_alloc(type, 0);
+  if (!self) return nullptr;
+  self->rv = 0;
+  self->compacted_through = 0;
+  self->history = (size_t)(history > 0 ? history : 1);
+  self->objects =
+      new std::unordered_map<std::string, std::pair<PyObject*, long long>>();
+  self->events = new std::deque<Event>();
+  return (PyObject*)self;
+}
+
+void store_dealloc(StoreObject* self) {
+  for (auto& kv : *self->objects) Py_DECREF(kv.second.first);
+  for (auto& e : *self->events) Py_DECREF(e.obj);
+  delete self->objects;
+  delete self->events;
+  Py_TYPE(self)->tp_free((PyObject*)self);
+}
+
+PyMethodDef store_methods[] = {
+    {"create", (PyCFunction)store_create, METH_VARARGS, nullptr},
+    {"update", (PyCFunction)store_update, METH_VARARGS, nullptr},
+    {"delete", (PyCFunction)store_delete, METH_VARARGS, nullptr},
+    {"get", (PyCFunction)store_get, METH_VARARGS, nullptr},
+    {"list", (PyCFunction)store_list, METH_VARARGS, nullptr},
+    {"events_since", (PyCFunction)store_events_since, METH_VARARGS, nullptr},
+    {"resource_version", (PyCFunction)store_resource_version, METH_NOARGS,
+     nullptr},
+    {"compacted_through", (PyCFunction)store_compacted_through, METH_NOARGS,
+     nullptr},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyTypeObject StoreType = {
+    PyVarObject_HEAD_INIT(nullptr, 0)
+};
+
+PyModuleDef module_def = {
+    PyModuleDef_HEAD_INIT, "_kubetpu_store",
+    "native versioned object store core", -1, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__kubetpu_store(void) {
+  StoreType.tp_name = "_kubetpu_store.StoreCore";
+  StoreType.tp_basicsize = sizeof(StoreObject);
+  StoreType.tp_flags = Py_TPFLAGS_DEFAULT;
+  StoreType.tp_new = store_new;
+  StoreType.tp_dealloc = (destructor)store_dealloc;
+  StoreType.tp_methods = store_methods;
+  if (PyType_Ready(&StoreType) < 0) return nullptr;
+  PyObject* m = PyModule_Create(&module_def);
+  if (!m) return nullptr;
+  Py_INCREF(&StoreType);
+  if (PyModule_AddObject(m, "StoreCore", (PyObject*)&StoreType) < 0) {
+    Py_DECREF(&StoreType);
+    Py_DECREF(m);
+    return nullptr;
+  }
+  return m;
+}

@@ -14,6 +14,18 @@ truth every component watches):
   ``CompactedError`` here, consumed by the Reflector's relist loop
   (client-go reflector.go ListAndWatch).
 
+Two interchangeable CORES behind one locking wrapper (the reference's
+storage engine is native code — etcd; kubetpu.native/memstore_core.cpp is
+this framework's equivalent):
+
+- the C++ ``StoreCore`` (kubetpu.native), compiled on first use, and
+- ``_PyCore``, the pure-Python fallback (``KUBETPU_NO_NATIVE=1`` or no
+  compiler).
+
+Both expose the same micro-interface and exception mapping; the wrapper
+owns the Condition lock (serializing every call — the native core is
+single-writer by construction) and the blocking ``wait_for``.
+
 Watchers are PULL-based (``Watcher.poll``): the schedulers/controllers in
 this framework fold their pumps into their loops (same shape as the queue's
 flush timers); ``wait_for`` provides the blocking form for threads.
@@ -22,13 +34,16 @@ flush timers); ``wait_for`` provides the blocking form for threads.
 from __future__ import annotations
 
 import collections
+import os
 import threading
 from dataclasses import dataclass
-from typing import Any, Iterable
+from typing import Any
 
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
+
+_EVENT_TYPES = (ADDED, MODIFIED, DELETED)
 
 
 class CompactedError(Exception):
@@ -37,7 +52,8 @@ class CompactedError(Exception):
 
 
 class ConflictError(Exception):
-    """CAS failure: the object moved past the expected resourceVersion."""
+    """CAS failure: the object moved past the expected resourceVersion, or
+    Create hit an existing object."""
 
 
 @dataclass(frozen=True)
@@ -49,34 +65,116 @@ class WatchEvent:
     resource_version: int
 
 
-class MemStore:
-    """See module docstring. Thread-safe; writes are serialized."""
+class _PyCore:
+    """Pure-Python core: the same micro-interface as the native StoreCore
+    (create/update/delete/get/list/events_since/resource_version), same
+    exception types (KeyError/ValueError/LookupError — mapped by the
+    wrapper)."""
 
     def __init__(self, history: int = 8192) -> None:
-        self._lock = threading.Condition()
         self._rv = 0
-        # (kind, key) -> (obj, rv)
         self._objects: dict[tuple[str, str], tuple[Any, int]] = {}
-        self._events: collections.deque[WatchEvent] = collections.deque(
-            maxlen=history
-        )
-        self._compacted_through = 0   # highest rv dropped from the buffer
+        self._events: collections.deque = collections.deque(maxlen=history)
+        self._compacted_through = 0
 
-    # ------------------------------------------------------------- writes
-    def _emit(self, ev: WatchEvent) -> None:
+    def _emit(self, ev_type: int, kind: str, key: str, obj: Any) -> None:
         if len(self._events) == self._events.maxlen:
-            self._compacted_through = self._events[0].resource_version
-        self._events.append(ev)
-        self._lock.notify_all()
+            self._compacted_through = self._events[0][4]
+        self._events.append((ev_type, kind, key, obj, self._rv))
 
     def create(self, kind: str, key: str, obj: Any) -> int:
+        if (kind, key) in self._objects:
+            raise KeyError(f"{kind}/{key} already exists")
+        self._rv += 1
+        self._objects[(kind, key)] = (obj, self._rv)
+        self._emit(0, kind, key, obj)
+        return self._rv
+
+    def update(self, kind: str, key: str, obj: Any, expect: int = -1) -> int:
+        got = self._objects.get((kind, key))
+        if expect >= 0:
+            have = got[1] if got is not None else -1
+            if got is None or have != expect:
+                raise ValueError(
+                    f"{kind}/{key}: expected rv {expect}, have "
+                    f"{have if got is not None else 'absent'}"
+                )
+        self._rv += 1
+        self._objects[(kind, key)] = (obj, self._rv)
+        self._emit(0 if got is None else 1, kind, key, obj)
+        return self._rv
+
+    def delete(self, kind: str, key: str) -> int:
+        got = self._objects.pop((kind, key), None)
+        if got is None:
+            raise KeyError(f"{kind}/{key} not found")
+        self._rv += 1
+        self._emit(2, kind, key, got[0])
+        return self._rv
+
+    def get(self, kind: str, key: str):
+        got = self._objects.get((kind, key))
+        return (None, 0) if got is None else got
+
+    def list(self, kind: str):
+        return (
+            [
+                (key, obj)
+                for (k, key), (obj, _rv) in self._objects.items()
+                if k == kind
+            ],
+            self._rv,
+        )
+
+    def events_since(self, kind: str | None, rv: int):
+        if rv < self._compacted_through:
+            raise LookupError(
+                f"rv {rv} compacted (through {self._compacted_through})"
+            )
+        if not self._events or self._events[-1][4] <= rv:
+            return [], rv
+        cursor = self._events[-1][4]
+        out = []
+        for e in reversed(self._events):
+            if e[4] <= rv:
+                break
+            if kind is None or e[1] == kind:
+                out.append(e)
+        out.reverse()
+        return out, cursor
+
+    def resource_version(self) -> int:
+        return self._rv
+
+    def compacted_through(self) -> int:
+        return self._compacted_through
+
+
+class MemStore:
+    """See module docstring. Thread-safe; writes are serialized under one
+    Condition, which also backs the blocking ``wait_for``."""
+
+    def __init__(self, history: int = 8192, native: bool | None = None) -> None:
+        self._lock = threading.Condition()
+        core_cls = None
+        if native is not False and not os.environ.get("KUBETPU_NO_NATIVE"):
+            from ..native import store_core
+
+            core_cls = store_core()
+        if native is True and core_cls is None:
+            raise RuntimeError("native store core unavailable")
+        self._core = core_cls(history) if core_cls is not None else _PyCore(history)
+        self.native = core_cls is not None
+
+    # ------------------------------------------------------------- writes
+    def create(self, kind: str, key: str, obj: Any) -> int:
         with self._lock:
-            if (kind, key) in self._objects:
-                raise ConflictError(f"{kind}/{key} already exists")
-            self._rv += 1
-            self._objects[(kind, key)] = (obj, self._rv)
-            self._emit(WatchEvent(ADDED, kind, key, obj, self._rv))
-            return self._rv
+            try:
+                rv = self._core.create(kind, key, obj)
+            except KeyError as e:
+                raise ConflictError(str(e).strip("'\"")) from None
+            self._lock.notify_all()
+            return rv
 
     def update(
         self, kind: str, key: str, obj: Any, expect_rv: int | None = None
@@ -84,61 +182,48 @@ class MemStore:
         """GuaranteedUpdate: CAS when ``expect_rv`` is given; upsert when the
         object is absent and no CAS was requested."""
         with self._lock:
-            got = self._objects.get((kind, key))
-            if expect_rv is not None:
-                if got is None or got[1] != expect_rv:
-                    raise ConflictError(
-                        f"{kind}/{key}: expected rv {expect_rv}, "
-                        f"have {got[1] if got else 'absent'}"
-                    )
-            self._rv += 1
-            self._objects[(kind, key)] = (obj, self._rv)
-            self._emit(WatchEvent(
-                ADDED if got is None else MODIFIED, kind, key, obj, self._rv
-            ))
-            return self._rv
+            try:
+                rv = self._core.update(
+                    kind, key, obj, -1 if expect_rv is None else expect_rv
+                )
+            except ValueError as e:
+                raise ConflictError(str(e)) from None
+            self._lock.notify_all()
+            return rv
 
     def delete(self, kind: str, key: str) -> int:
         with self._lock:
-            got = self._objects.pop((kind, key), None)
-            if got is None:
-                raise KeyError(f"{kind}/{key} not found")
-            self._rv += 1
-            self._emit(WatchEvent(DELETED, kind, key, got[0], self._rv))
-            return self._rv
+            rv = self._core.delete(kind, key)   # KeyError propagates
+            self._lock.notify_all()
+            return rv
 
     # -------------------------------------------------------------- reads
     def get(self, kind: str, key: str):
         with self._lock:
-            got = self._objects.get((kind, key))
-            return (None, 0) if got is None else got
+            return self._core.get(kind, key)
 
-    def list(self, kind: str) -> tuple[list[tuple[str, Any]], int]:
+    def list(self, kind: str):
         """GetList: items + the revision the list is consistent at."""
         with self._lock:
-            items = [
-                (key, obj)
-                for (k, key), (obj, _rv) in self._objects.items()
-                if k == kind
-            ]
-            return items, self._rv
+            return self._core.list(kind)
 
     @property
     def resource_version(self) -> int:
         with self._lock:
-            return self._rv
+            return self._core.resource_version()
 
     # -------------------------------------------------------------- watch
     def watch(self, kind: str | None, since_rv: int) -> "Watcher":
         """A pull watcher for events AFTER ``since_rv`` (``kind`` None =
         all buckets). Raises CompactedError immediately when the start
-        revision predates the buffer."""
+        revision predates the buffer (an O(1) watermark check — no event
+        materialization; the first poll() fetches them)."""
         with self._lock:
-            if since_rv < self._compacted_through:
-                raise CompactedError(
-                    f"rv {since_rv} compacted (through "
-                    f"{self._compacted_through})"
-                )
+            compacted = self._core.compacted_through()
+        if since_rv < compacted:
+            raise CompactedError(
+                f"rv {since_rv} compacted (through {compacted})"
+            )
         return Watcher(self, kind, since_rv)
 
     def _events_since(
@@ -148,30 +233,23 @@ class MemStore:
         every event examined (matching or not), so a kind-filtered watcher
         never re-scans other kinds' events."""
         with self._lock:
-            if rv < self._compacted_through:
-                raise CompactedError(
-                    f"rv {rv} compacted (through {self._compacted_through})"
-                )
-            # hot path: N reflectors poll every cycle; an up-to-date cursor
-            # must be O(1), and a behind cursor must only touch events NEWER
-            # than it (events are rv-ordered) — never the whole ring buffer
-            if not self._events or self._events[-1].resource_version <= rv:
-                return [], rv
-            cursor = self._events[-1].resource_version
-            out: list[WatchEvent] = []
-            for e in reversed(self._events):
-                if e.resource_version <= rv:
-                    break
-                if kind is None or e.kind == kind:
-                    out.append(e)
-            out.reverse()
-            return out, cursor
+            try:
+                raw, cursor = self._core.events_since(kind, rv)
+            except LookupError as e:
+                raise CompactedError(str(e)) from None
+        return (
+            [
+                WatchEvent(_EVENT_TYPES[t], k, key, obj, erv)
+                for (t, k, key, obj, erv) in raw
+            ],
+            cursor,
+        )
 
     def wait_for(self, rv: int, timeout: float | None = None) -> bool:
         """Block until the store moves past ``rv`` (thread form)."""
         with self._lock:
             return self._lock.wait_for(
-                lambda: self._rv > rv, timeout=timeout
+                lambda: self._core.resource_version() > rv, timeout=timeout
             )
 
 

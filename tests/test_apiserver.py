@@ -105,7 +105,6 @@ def test_rest_crud_cas_and_watch(server):
 
 
 def test_watch_compaction_maps_to_410(server):
-    server.store._events.clear()
     small = MemStore(history=4)
     srv2 = APIServer(small).start()
     try:

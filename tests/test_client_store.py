@@ -270,3 +270,44 @@ def test_tainted_node_filtered_by_scheduler_via_informers():
     total = run_scheduler_from_store(st, s)
     assert total == 1
     assert st.get(PODS, "default/p0")[0].node_name == "good"
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_store_contract_both_cores(native):
+    """The SAME storage contract against the pure-Python core and the C++
+    StoreCore (kubetpu.native): rv monotonicity, CAS, upsert, list
+    revisions, watch cursors, compaction."""
+    from kubetpu.native import store_core
+
+    if native and store_core() is None:
+        pytest.skip("native core unavailable")
+    st = MemStore(history=4, native=native)
+    assert st.native == native
+    rv1 = st.create(NODES, "n0", make_node("n0"))
+    with pytest.raises(ConflictError):
+        st.create(NODES, "n0", make_node("n0"))
+    rv2 = st.update(NODES, "n0", make_node("n0", cpu_milli=2), expect_rv=rv1)
+    assert rv2 == rv1 + 1
+    with pytest.raises(ConflictError):
+        st.update(NODES, "n0", make_node("n0"), expect_rv=rv1)
+    st.update(NODES, "n1", make_node("n1"))      # upsert-create
+    items, rv = st.list(NODES)
+    assert sorted(k for k, _ in items) == ["n0", "n1"] and rv == 3
+    w = st.watch(NODES, rv1)
+    evs = w.poll()
+    assert [(e.type, e.key) for e in evs] == [
+        ("MODIFIED", "n0"), ("ADDED", "n1"),
+    ]
+    assert evs[-1].resource_version == 3
+    st.delete(NODES, "n1")
+    with pytest.raises(KeyError):
+        st.delete(NODES, "n1")
+    assert [e.type for e in w.poll()] == ["DELETED"]
+    for i in range(8):
+        st.update(NODES, "n0", make_node("n0", cpu_milli=i))
+    with pytest.raises(CompactedError):
+        st.watch(NODES, 0)
+    with pytest.raises(CompactedError):
+        w.poll()
+    assert st.get(NODES, "n1") == (None, 0)
+    assert st.get(NODES, "n0")[0].allocatable_dict()["cpu"] == 7

@@ -604,7 +604,6 @@ def test_deployment_rolling_floor_holds_without_new_capacity():
         dc.step()
         rs_ctrl.step()
     rss = {rs.name: rs for _, rs in st.list("replicasets")[0]}
-    olds = [rs for rs in rss.values() if "999" not in str(rs.template)]
     old_spec = sum(
         rs.replicas for rs in rss.values()
         if rs.template.requests_dict().get("cpu") != 999
@@ -663,3 +662,38 @@ def test_follower_lease_polling_is_throttled():
     clock[0] += 3
     b.tick()
     assert gets[0] == n0 + 1
+
+
+def test_deployment_scale_down_after_completed_rollout():
+    """Zero-replica old RS objects left by a finished rollout must not pin
+    the new RS's size (gate on old SPEC replicas, not object existence)."""
+    from kubetpu.controllers import DEPLOYMENTS, DeploymentController
+
+    st = MemStore()
+    dep = t.Deployment(
+        name="pin", replicas=4, selector=t.LabelSelector.of({"app": "pin"}),
+        template=make_pod("tpl", labels={"app": "pin"}),
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rs_ctrl = ReplicaSetController(st)
+    dc.start(); rs_ctrl.start()
+    dc.step(); rs_ctrl.step()
+    # rollout to a new template, complete it (old RS remains at 0 replicas)
+    dep2 = dataclasses.replace(
+        dep, template=make_pod("tpl", labels={"app": "pin"}, cpu_milli=50),
+    )
+    st.update(DEPLOYMENTS, dep.key, dep2)
+    for _ in range(8):
+        dc.step(); rs_ctrl.step()
+        # hand-run the kubelet: mark everything Running so the roll proceeds
+        for key, p in st.list(PODS)[0]:
+            if p.phase == "Pending":
+                st.update(PODS, key, dataclasses.replace(
+                    p.with_node("n0"), phase="Running"))
+    assert len(st.list("replicasets")[0]) == 2
+    # now scale the deployment down — must reach the new RS
+    st.update(DEPLOYMENTS, dep.key, dataclasses.replace(dep2, replicas=2))
+    dc.step(); rs_ctrl.step()
+    assert sum(rs.replicas for _, rs in st.list("replicasets")[0]) == 2
+    assert len(st.list(PODS)[0]) == 2
