@@ -44,7 +44,7 @@ for _cls in (
     t.ReplicaSet, t.DeviceClass, t.CELSelector, t.ResourceSlice, t.Device,
     t.DeviceRequest, t.DeviceSubRequest, t.DeviceConstraint,
     t.ResourceClaim, t.ClaimAllocation, t.DeviceResult, t.PodResourceClaim,
-    t.NodeHeartbeat, t.LeaderElectionRecord, t.Deployment,
+    t.NodeHeartbeat, t.LeaderElectionRecord, t.Deployment, t.Job,
 ):
     register(_cls)
 

@@ -256,6 +256,9 @@ class Pod:
     # envelope carries aggregated specs; NodeDeclaredFeatures Filter
     # requires it to be a subset of the node's declared_features
     required_node_features: tuple[str, ...] = ()
+    # restartPolicy: Never + finite workload (the batch/Job shape): the
+    # node agent transitions Running -> Succeeded instead of running forever
+    terminates: bool = False
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -530,6 +533,33 @@ class PodGroup:
     namespace: str = "default"
     gang: GangPolicy | None = None
     topology_keys: tuple[str, ...] = ()
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class Job:
+    """The slice of batch/v1 Job the control loop consumes: desired
+    completions under a parallelism bound, a backoff limit on failures,
+    and the derived status (pkg/controller/job syncJob's inputs/outputs)."""
+
+    name: str
+    namespace: str = "default"
+    completions: int = 1
+    parallelism: int = 1
+    backoff_limit: int = 6
+    template: "Pod | None" = None
+    # status (written by the controller)
+    succeeded: int = 0
+    failed: int = 0
+    complete: bool = False
+    failed_state: bool = False
+    # uncountedTerminatedPods (batch/v1 JobStatus): pod keys whose
+    # termination is COUNTED in succeeded/failed but whose objects may not
+    # be removed yet — the exactly-once bridge across controller restarts
+    uncounted: tuple[str, ...] = ()
 
     @property
     def key(self) -> str:

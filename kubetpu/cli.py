@@ -126,11 +126,12 @@ def _kind_buckets() -> dict:
     here could silently drift into a bucket nothing watches)."""
     from .client import informers as I
     from .controllers.deployment import DEPLOYMENTS
+    from .controllers.job import JOBS
     from .controllers.replicaset import REPLICA_SETS
 
     return {
         "Node": I.NODES, "Pod": I.PODS, "ReplicaSet": REPLICA_SETS,
-        "Deployment": DEPLOYMENTS,
+        "Deployment": DEPLOYMENTS, "Job": JOBS,
         "Service": I.SERVICES, "Namespace": I.NAMESPACES,
         "PersistentVolume": I.PERSISTENT_VOLUMES,
         "PersistentVolumeClaim": I.PERSISTENT_VOLUME_CLAIMS,
@@ -242,6 +243,7 @@ def cmd_controller_manager(args) -> int:
     from .controllers import (
         DeploymentController,
         DisruptionController,
+        JobController,
         NodeLifecycleController,
         PodGCController,
         ReplicaSetController,
@@ -251,6 +253,7 @@ def cmd_controller_manager(args) -> int:
     store = RemoteStore(args.server)
     ctrls = [
         DeploymentController(store),
+        JobController(store),
         ReplicaSetController(store),
         NodeLifecycleController(store, grace_s=args.node_monitor_grace),
         TaintEvictionController(store),

@@ -2,6 +2,7 @@
 
 from .deployment import DEPLOYMENTS, DeploymentController  # noqa: F401
 from .disruption import DisruptionController  # noqa: F401
+from .job import JOBS, JobController  # noqa: F401
 from .nodelifecycle import (  # noqa: F401
     NodeHeartbeat,
     NodeLifecycleController,

@@ -1,0 +1,147 @@
+"""Job controller — run-to-completion workloads under a parallelism bound.
+
+Reference: ``pkg/controller/job`` (job_controller.go ``syncJob``): keep
+``min(parallelism, completions − succeeded)`` pods active, count Succeeded
+pods toward completions and Failed pods against the backoff limit; at
+``completions`` successes the Job is Complete, past ``backoffLimit``
+failures it is Failed and no more pods are created.
+
+Exactly-once termination accounting uses the reference's
+``uncountedTerminatedPods`` protocol (the pod-finalizer bridge): one CAS
+commits the new counts AND records the counted pod keys in
+``status.uncounted``; the pods are deleted afterwards and their keys
+cleared from ``uncounted`` once gone. A controller crash between the
+commit and the deletes cannot double-count — the recorded keys are skipped
+on recount — and a crash before the commit merely recounts. Pods are
+stamped ``terminates=True`` (the restartPolicy: Never shape) so the node
+agent transitions them Running → Succeeded.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from ..client.informers import PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import ConflictError, MemStore
+
+JOBS = "jobs"
+
+
+def _owner_ref(job: t.Job) -> str:
+    return f"Job/{job.namespace}/{job.name}"
+
+
+class JobController:
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self._jobs = SharedInformer(JOBS)
+        self._pods = SharedInformer(PODS)
+        self._r = [Reflector(store, self._jobs), Reflector(store, self._pods)]
+        self._seq: dict[str, int] = {}
+        self.creates = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    def step(self) -> int:
+        self.pump()
+        # one owner -> owned-pods index for the whole pass (O(pods), not
+        # O(jobs × pods))
+        by_owner: dict[str, list[tuple[str, t.Job]]] = {}
+        for key, p in self._pods.store.items():
+            if p.owner:
+                by_owner.setdefault(p.owner, []).append((key, p))
+        wrote = 0
+        for key, job in list(self._jobs.store.items()):
+            if job.template is None:
+                continue
+            wrote += self._sync(job, by_owner.get(_owner_ref(job), []))
+        return wrote
+
+    def _sync(self, job: t.Job, owned: list) -> int:
+        wrote = 0
+        uncounted = set(job.uncounted)
+        new_keys: list[str] = []
+        new_succeeded = new_failed = active = 0
+        for key, p in owned:
+            if p.phase == "Succeeded":
+                if key not in uncounted:
+                    new_succeeded += 1
+                    new_keys.append(key)
+            elif p.phase == "Failed":
+                if key not in uncounted:
+                    new_failed += 1
+                    new_keys.append(key)
+            else:
+                active += 1
+        succeeded = job.succeeded + new_succeeded
+        failed = job.failed + new_failed
+        failed_state = job.failed_state or failed > job.backoff_limit
+        complete = succeeded >= job.completions
+        if not complete and not failed_state:
+            want = min(
+                job.parallelism, job.completions - succeeded
+            ) - active
+            for _ in range(max(0, want)):
+                self._seq[job.key] = self._seq.get(job.key, 0) + 1
+                name = f"{job.name}-{self._seq[job.key]}"
+                pod = dataclasses.replace(
+                    job.template,
+                    name=name,
+                    namespace=job.namespace,
+                    uid=f"{job.namespace}/{name}",
+                    owner=_owner_ref(job),
+                    node_name="",
+                    phase="Pending",
+                    terminates=True,
+                    creation_index=self._seq[job.key],
+                )
+                try:
+                    self.store.create(PODS, f"{job.namespace}/{name}", pod)
+                except ConflictError:
+                    continue
+                self.creates += 1
+                wrote += 1
+        # uncounted entries whose pods are gone may be cleared
+        owned_keys = {k for k, _ in owned}
+        next_uncounted = tuple(
+            sorted((uncounted & owned_keys) | set(new_keys))
+        )
+        if (
+            succeeded != job.succeeded or failed != job.failed
+            or complete != job.complete or failed_state != job.failed_state
+            or next_uncounted != job.uncounted
+        ):
+            # PHASE 1 (one CAS): counts + the counted keys land TOGETHER —
+            # the exactly-once commit point
+            live, rv = self.store.get(JOBS, job.key)
+            if live is None:
+                return wrote
+            try:
+                self.store.update(
+                    JOBS, job.key,
+                    dataclasses.replace(
+                        live, succeeded=succeeded, failed=failed,
+                        complete=complete, failed_state=failed_state,
+                        uncounted=next_uncounted,
+                    ),
+                    expect_rv=rv,
+                )
+                wrote += 1
+            except ConflictError:
+                return wrote   # recount next sync (nothing was deleted)
+        # PHASE 2: remove the counted pods; their keys clear from
+        # ``uncounted`` on a later sync once the informer confirms them gone
+        for key in next_uncounted:
+            try:
+                self.store.delete(PODS, key)
+            except KeyError:
+                pass
+            self._pods.store.pop(key, None)
+        return wrote

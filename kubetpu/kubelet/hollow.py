@@ -79,6 +79,22 @@ class HollowKubelet:
             if pod.node_name != self.node.name:
                 self.running.discard(key)
                 continue
+            if pod.phase == "Running" and pod.terminates:
+                # run-to-completion workloads (restartPolicy: Never) finish
+                # on a later sync pass (kuberuntime's exited-container path)
+                live, rv = self.store.get(PODS, key)
+                if live is not None and live.phase == "Running":
+                    try:
+                        self.store.update(
+                            PODS, key,
+                            dataclasses.replace(live, phase="Succeeded"),
+                            expect_rv=rv,
+                        )
+                        self.running.discard(key)
+                        moved += 1
+                    except ConflictError:
+                        pass
+                continue
             if key in self.running or pod.phase != "Pending":
                 continue
             # status write through the LIVE object (not the informer copy),

@@ -40,44 +40,58 @@ def _so_path(name: str, src: str) -> str:
 
 def load_extension(name: str, source_file: str):
     """Compile (if needed) and import the named CPython extension; returns
-    the module or None when native is disabled/unbuildable."""
+    the module or None when native is disabled/unbuildable. EVERY failure
+    mode (read-only package dir, missing compiler, concurrent build, torn
+    artifact) degrades to the Python fallback — never a startup crash."""
     if os.environ.get("KUBETPU_NO_NATIVE"):
         return None
     if name in _CACHE:
         return _CACHE[name]
+    try:
+        mod = _load_extension(name, source_file)
+    except Exception as e:
+        print(f"kubetpu.native: {name} unavailable "
+              f"({type(e).__name__}: {e}); using the Python fallback",
+              file=sys.stderr)
+        mod = None
+    _CACHE[name] = mod
+    return mod
+
+
+def _load_extension(name: str, source_file: str):
     src = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                        source_file)
     so = _so_path(name, src)
     if not os.path.exists(so):
         include = sysconfig.get_paths()["include"]
+        # build to a per-process temp name, then atomically rename: two
+        # processes racing the first build can never leave (or load) a
+        # torn .so under the cached name
+        tmp = f"{so}.tmp.{os.getpid()}"
         cmd = [
             "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
-            f"-I{include}", src, "-o", so,
+            f"-I{include}", src, "-o", tmp,
         ]
         try:
             proc = subprocess.run(
                 cmd, capture_output=True, text=True, timeout=120,
             )
         except (OSError, subprocess.TimeoutExpired):
-            _CACHE[name] = None
             return None
         if proc.returncode != 0:
             # loud once (a broken toolchain should be visible), then fall back
             print(f"kubetpu.native: build of {name} failed:\n"
                   f"{proc.stderr[-2000:]}", file=sys.stderr)
-            _CACHE[name] = None
             return None
+        os.replace(tmp, so)
     spec = importlib.util.spec_from_file_location(name, so)
     if spec is None or spec.loader is None:
-        _CACHE[name] = None
         return None
     mod = importlib.util.module_from_spec(spec)
     try:
         spec.loader.exec_module(mod)
     except ImportError:
-        _CACHE[name] = None
         return None
-    _CACHE[name] = mod
     return mod
 
 

@@ -697,3 +697,119 @@ def test_deployment_scale_down_after_completed_rollout():
     dc.step(); rs_ctrl.step()
     assert sum(rs.replicas for _, rs in st.list("replicasets")[0]) == 2
     assert len(st.list(PODS)[0]) == 2
+
+
+# ---------------------------------------------------------------------- job
+
+def test_job_runs_to_completion_under_parallelism_bound():
+    """10 completions at parallelism 3 through the full loop: the active
+    set never exceeds 3, Succeeded pods accumulate, the Job goes Complete."""
+    from kubetpu.controllers import JOBS, JobController
+
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(
+        st, [make_node("n0", cpu_milli=8000, pods=32)],
+        clock=lambda: clock[0],
+    )
+    cluster.start()
+    job = t.Job(
+        name="batchy", completions=10, parallelism=3,
+        template=make_pod("tpl", labels={"app": "batchy"}, cpu_milli=100),
+    )
+    st.create(JOBS, job.key, job)
+    jc = JobController(st)
+    jc.start()
+    sched_clock = FakeClock()
+    sched = Scheduler(
+        StoreClient(st), profile=C.minimal_profile(),
+        dispatcher_workers=0, clock=sched_clock,
+    )
+    informers = SchedulerInformers(st, sched)
+    informers.start()
+    max_active = 0
+    for _ in range(40):
+        jc.step()
+        pods, _ = st.list(PODS)
+        active = sum(
+            1 for _, p in pods if p.phase not in ("Succeeded", "Failed")
+        )
+        max_active = max(max_active, active)
+        informers.pump()
+        sched.schedule_batch()
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        cluster.pump()
+        sched_clock.tick(2)
+        if st.get(JOBS, job.key)[0].complete:
+            break
+    final = st.get(JOBS, job.key)[0]
+    assert final.complete and final.succeeded == 10, final
+    assert max_active <= 3, max_active
+    # counted pods are removed by the controller (finalizer-accounting
+    # analog): completions live in STATUS, not in retained pod objects
+    pods, _ = st.list(PODS)
+    assert all(p.phase not in ("Succeeded", "Failed") for _, p in pods)
+
+
+def test_job_backoff_limit_marks_failed():
+    from kubetpu.controllers import JOBS, JobController
+
+    st = MemStore()
+    job = t.Job(
+        name="flaky", completions=5, parallelism=2, backoff_limit=1,
+        template=make_pod("tpl", labels={"app": "flaky"}),
+    )
+    st.create(JOBS, job.key, job)
+    jc = JobController(st)
+    jc.start()
+    jc.step()
+    # both active pods fail (hand-run node agent reporting crash loops)
+    for key, p in st.list(PODS)[0]:
+        st.update(PODS, key, dataclasses.replace(p, phase="Failed"))
+    jc.step()   # failed=2 > backoff_limit=1 -> Failed state, no new pods
+    final = st.get(JOBS, job.key)[0]
+    assert final.failed_state and final.failed == 2
+    jc.step()
+    pods, _ = st.list(PODS)
+    assert len(pods) == 0   # counted+removed; nothing new after the limit
+    assert st.get(JOBS, job.key)[0].failed == 2   # counts are cumulative
+
+
+def test_job_restart_between_commit_and_delete_does_not_double_count():
+    """The uncountedTerminatedPods protocol: a crash after the status CAS
+    but before the pod deletes must not recount on restart."""
+    from kubetpu.controllers import JOBS, JobController
+
+    st = MemStore()
+    job = t.Job(name="j", completions=2, parallelism=2,
+                template=make_pod("tpl", labels={"app": "j"}))
+    st.create(JOBS, job.key, job)
+    jc = JobController(st)
+    jc.start()
+    jc.step()                    # creates 2 pods
+    for key, p in st.list(PODS)[0]:
+        st.update(PODS, key, dataclasses.replace(p, phase="Succeeded"))
+
+    class CrashyStore:           # phase 2 (deletes) never happens
+        def __getattr__(self, n):
+            return getattr(st, n)
+
+        def delete(self, kind, key):
+            raise RuntimeError("crash before pod cleanup")
+
+    jc2 = JobController(CrashyStore())
+    jc2.start()
+    with pytest.raises(RuntimeError):
+        jc2.step()
+    mid = st.get(JOBS, job.key)[0]
+    assert mid.succeeded == 2 and len(mid.uncounted) == 2   # committed
+
+    jc3 = JobController(st)      # restart: fresh informers
+    jc3.start()
+    jc3.step()                   # must NOT recount; finishes the deletes
+    after = st.get(JOBS, job.key)[0]
+    assert after.succeeded == 2 and after.complete
+    assert st.list(PODS)[0] == []
+    jc3.step()                   # confirmed gone -> uncounted clears
+    assert st.get(JOBS, job.key)[0].uncounted == ()
