@@ -45,6 +45,7 @@ for _cls in (
     t.DeviceRequest, t.DeviceSubRequest, t.DeviceConstraint,
     t.ResourceClaim, t.ClaimAllocation, t.DeviceResult, t.PodResourceClaim,
     t.NodeHeartbeat, t.LeaderElectionRecord, t.Deployment, t.Job,
+    t.StatefulSet,
 ):
     register(_cls)
 

@@ -540,6 +540,25 @@ class PodGroup:
 
 
 @dataclass(frozen=True)
+class StatefulSet:
+    """The slice of apps/v1 StatefulSet the control loop consumes: stable
+    ordinal identities (<name>-0 … <name>-N−1), ordered scale-up (pod i
+    waits for pod i−1 Running) and reverse-ordered scale-down
+    (pkg/controller/statefulset's OrderedReady management policy)."""
+
+    name: str
+    namespace: str = "default"
+    replicas: int = 1
+    selector: LabelSelector | None = None
+    template: "Pod | None" = None
+    pod_management_policy: str = "OrderedReady"   # or "Parallel"
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
 class Job:
     """The slice of batch/v1 Job the control loop consumes: desired
     completions under a parallelism bound, a backoff limit on failures,

@@ -128,10 +128,12 @@ def _kind_buckets() -> dict:
     from .controllers.deployment import DEPLOYMENTS
     from .controllers.job import JOBS
     from .controllers.replicaset import REPLICA_SETS
+    from .controllers.statefulset import STATEFUL_SETS
 
     return {
         "Node": I.NODES, "Pod": I.PODS, "ReplicaSet": REPLICA_SETS,
         "Deployment": DEPLOYMENTS, "Job": JOBS,
+        "StatefulSet": STATEFUL_SETS,
         "Service": I.SERVICES, "Namespace": I.NAMESPACES,
         "PersistentVolume": I.PERSISTENT_VOLUMES,
         "PersistentVolumeClaim": I.PERSISTENT_VOLUME_CLAIMS,
@@ -244,6 +246,7 @@ def cmd_controller_manager(args) -> int:
         DeploymentController,
         DisruptionController,
         JobController,
+        StatefulSetController,
         NodeLifecycleController,
         PodGCController,
         ReplicaSetController,
@@ -254,6 +257,7 @@ def cmd_controller_manager(args) -> int:
     ctrls = [
         DeploymentController(store),
         JobController(store),
+        StatefulSetController(store),
         ReplicaSetController(store),
         NodeLifecycleController(store, grace_s=args.node_monitor_grace),
         TaintEvictionController(store),

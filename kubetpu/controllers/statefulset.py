@@ -1,0 +1,162 @@
+"""StatefulSet controller — stable ordinal identities, ordered operations.
+
+Reference: ``pkg/controller/statefulset`` (stateful_set_control.go,
+OrderedReady policy): pods are named ``<name>-<ordinal>`` for ordinals
+``0 … replicas−1``; scale-up creates the LOWEST missing ordinal and only
+after every lower ordinal is Running; scale-down removes the HIGHEST
+ordinal first and one at a time. A missing middle ordinal (failed pod)
+is replaced before anything above it progresses. ``Parallel`` drops the
+ordering gates. Identity is the contract: a recreated ordinal keeps its
+name (and would keep its PVCs — the volume half rides the volumebinding
+family).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from ..client.informers import PODS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import ConflictError, MemStore
+
+STATEFUL_SETS = "statefulsets"
+
+
+def _owner_ref(ss: t.StatefulSet) -> str:
+    return f"StatefulSet/{ss.namespace}/{ss.name}"
+
+
+class StatefulSetController:
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self._sets = SharedInformer(STATEFUL_SETS)
+        self._pods = SharedInformer(PODS)
+        self._r = [Reflector(store, self._sets), Reflector(store, self._pods)]
+        self.creates = 0
+        self.deletes = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    def step(self) -> int:
+        self.pump()
+        by_owner: dict[str, dict[int, tuple[str, t.Pod]]] = {}
+        orphans: list[tuple[str, t.Pod]] = []
+        for key, p in self._pods.store.items():
+            _, _, ord_str = p.name.rpartition("-")
+            if not ord_str.isdigit():
+                continue
+            if p.owner:
+                by_owner.setdefault(p.owner, {})[int(ord_str)] = (key, p)
+            else:
+                orphans.append((key, p))
+        wrote = 0
+        for key, ss in list(self._sets.store.items()):
+            owned = by_owner.get(_owner_ref(ss), {})
+            wrote += self._adopt(ss, orphans, owned)
+            wrote += self._sync(ss, owned)
+        return wrote
+
+    def _adopt(self, ss: t.StatefulSet, orphans: list, owned: dict) -> int:
+        """Selector-based claiming (controller_ref_manager): an orphan named
+        <set>-<ordinal> in the set's namespace matching its selector is
+        adopted, keeping its identity — otherwise its occupied name would
+        deadlock the ordinal forever."""
+        from ..api.selectors import label_selector_matches
+
+        wrote = 0
+        for key, p in orphans:
+            if p.namespace != ss.namespace:
+                continue
+            prefix, _, ord_str = p.name.rpartition("-")
+            if prefix != ss.name or not ord_str.isdigit():
+                continue
+            if ss.selector is not None and not label_selector_matches(
+                ss.selector, p.labels_dict()
+            ):
+                continue
+            live, rv = self.store.get(PODS, key)
+            if live is None:
+                continue
+            try:
+                adopted = dataclasses.replace(live, owner=_owner_ref(ss))
+                self.store.update(PODS, key, adopted, expect_rv=rv)
+            except ConflictError:
+                continue
+            owned[int(ord_str)] = (key, adopted)
+            wrote += 1
+        return wrote
+
+    def _create(self, ss: t.StatefulSet, ordinal: int) -> int:
+        name = f"{ss.name}-{ordinal}"
+        pod = dataclasses.replace(
+            ss.template,
+            name=name,
+            namespace=ss.namespace,
+            uid=f"{ss.namespace}/{name}",
+            owner=_owner_ref(ss),
+            node_name="",
+            phase="Pending",
+            creation_index=ordinal,
+        )
+        try:
+            self.store.create(PODS, f"{ss.namespace}/{name}", pod)
+        except ConflictError:
+            return 0
+        self.creates += 1
+        return 1
+
+    def _sync(self, ss: t.StatefulSet, by_ordinal: dict) -> int:
+        wrote = 0
+        ordered = ss.pod_management_policy != "Parallel"
+        # terminal pods vacate their ordinal: the replacement keeps the NAME.
+        # (The informer cache is NOT mutated here — the reflector delivers
+        # the DELETED event so handler fan-out stays correct; by_ordinal is
+        # this pass's consistent view.)
+        for ordinal in sorted(by_ordinal):
+            key, p = by_ordinal[ordinal]
+            if p.phase in ("Succeeded", "Failed"):
+                try:
+                    self.store.delete(PODS, key)
+                except KeyError:
+                    del by_ordinal[ordinal]
+                    continue   # already gone (e.g. podgc won the race)
+                del by_ordinal[ordinal]
+                wrote += 1
+        # scale-up: lowest missing ordinal first; OrderedReady also demands
+        # every LOWER ordinal be Running before the next is created.
+        # Creation alone needs the template — vacation/scale-down above and
+        # below still run without one.
+        if ss.template is not None:
+            for ordinal in range(ss.replicas):
+                if ordinal in by_ordinal:
+                    continue
+                if ordered and any(
+                    by_ordinal.get(lower, (None, None))[1] is None
+                    or by_ordinal[lower][1].phase != "Running"
+                    for lower in range(ordinal)
+                ):
+                    break
+                wrote += self._create(ss, ordinal)
+                if ordered:
+                    break   # one at a time; the next waits for Running
+        # scale-down: highest ordinal first, one at a time when ordered
+        excess = sorted(
+            (o for o in by_ordinal if o >= ss.replicas), reverse=True
+        )
+        for ordinal in excess:
+            key, _p = by_ordinal[ordinal]
+            try:
+                self.store.delete(PODS, key)
+            except KeyError:
+                continue
+            self.deletes += 1
+            wrote += 1
+            if ordered:
+                break
+        return wrote

@@ -813,3 +813,90 @@ def test_job_restart_between_commit_and_delete_does_not_double_count():
     assert st.list(PODS)[0] == []
     jc3.step()                   # confirmed gone -> uncounted clears
     assert st.get(JOBS, job.key)[0].uncounted == ()
+
+
+# --------------------------------------------------------------- statefulset
+
+def test_statefulset_ordered_scale_up_and_down():
+    """OrderedReady: ordinal i is created only after i-1 Runs; scale-down
+    removes the highest ordinal first, one at a time."""
+    from kubetpu.controllers import STATEFUL_SETS, StatefulSetController
+
+    st = MemStore()
+    ss = t.StatefulSet(
+        name="db", replicas=3,
+        selector=t.LabelSelector.of({"app": "db"}),
+        template=make_pod("tpl", labels={"app": "db"}),
+    )
+    st.create(STATEFUL_SETS, ss.key, ss)
+    ctrl = StatefulSetController(st)
+    ctrl.start()
+    ctrl.step()
+    pods = {p.name for _, p in st.list(PODS)[0]}
+    assert pods == {"db-0"}          # one at a time
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"db-0"}  # db-0 not Running yet
+    key0 = "default/db-0"
+    st.update(PODS, key0, dataclasses.replace(
+        st.get(PODS, key0)[0].with_node("n0"), phase="Running"))
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"db-0", "db-1"}
+    st.update(PODS, "default/db-1", dataclasses.replace(
+        st.get(PODS, "default/db-1")[0].with_node("n0"), phase="Running"))
+    ctrl.step()
+    names = {p.name for _, p in st.list(PODS)[0]}
+    assert names == {"db-0", "db-1", "db-2"}
+    # scale down to 1: db-2 goes first, then db-1
+    st.update(STATEFUL_SETS, ss.key, dataclasses.replace(ss, replicas=1))
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"db-0", "db-1"}
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"db-0"}
+
+
+def test_statefulset_replaces_failed_middle_ordinal_with_same_identity():
+    from kubetpu.controllers import STATEFUL_SETS, StatefulSetController
+
+    st = MemStore()
+    ss = t.StatefulSet(
+        name="q", replicas=3, pod_management_policy="Parallel",
+        selector=t.LabelSelector.of({"app": "q"}),
+        template=make_pod("tpl", labels={"app": "q"}),
+    )
+    st.create(STATEFUL_SETS, ss.key, ss)
+    ctrl = StatefulSetController(st)
+    ctrl.start()
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"q-0", "q-1", "q-2"}
+    st.update(PODS, "default/q-1", dataclasses.replace(
+        st.get(PODS, "default/q-1")[0], phase="Failed"))
+    ctrl.step()   # vacates the ordinal
+    ctrl.step()   # recreates the SAME identity
+    got = st.get(PODS, "default/q-1")[0]
+    assert got is not None and got.phase == "Pending"
+    assert got.name == "q-1"
+
+
+def test_statefulset_adopts_orphan_and_scales_down_without_template():
+    from kubetpu.controllers import STATEFUL_SETS, StatefulSetController
+
+    st = MemStore()
+    ss = t.StatefulSet(
+        name="ad", replicas=2, pod_management_policy="Parallel",
+        selector=t.LabelSelector.of({"app": "ad"}),
+        template=make_pod("tpl", labels={"app": "ad"}),
+    )
+    st.create(STATEFUL_SETS, ss.key, ss)
+    # an orphan occupying ordinal 0: must be ADOPTED, not deadlock creation
+    st.create(PODS, "default/ad-0", make_pod("ad-0", labels={"app": "ad"}))
+    ctrl = StatefulSetController(st)
+    ctrl.start()
+    ctrl.step()
+    assert st.get(PODS, "default/ad-0")[0].owner == "StatefulSet/default/ad"
+    assert {p.name for _, p in st.list(PODS)[0]} == {"ad-0", "ad-1"}
+    # template removed + scaled to zero: scale-down must still work
+    st.update(STATEFUL_SETS, ss.key, dataclasses.replace(
+        ss, template=None, replicas=0))
+    ctrl.step()
+    ctrl.step()
+    assert st.list(PODS)[0] == []
