@@ -481,6 +481,9 @@ class ResourceClaim:
     constraints: tuple[DeviceConstraint, ...] = ()
     allocation: ClaimAllocation | None = None
     reserved_for: tuple[str, ...] = ()   # pod uids
+    # owning pod ("Pod/<ns>/<name>") for template-stamped instances — the
+    # resourceclaim controller GCs claims whose pod is gone
+    owner: str = ""
 
     @property
     def key(self) -> str:
@@ -489,13 +492,14 @@ class ResourceClaim:
 
 @dataclass(frozen=True)
 class PodResourceClaim:
-    """spec.resourceClaims[] with the template already resolved: the pod
-    references the ResourceClaim object ``claim_name`` in its namespace
-    (the resourceclaim controller names template instances; the scheduler
-    only ever sees resolved names via status.resourceClaimStatuses)."""
+    """spec.resourceClaims[]: either a direct ``claim_name`` reference or a
+    ``template`` (resourceClaimTemplateName) the resourceclaim controller
+    resolves into a per-pod claim instance, recording the resolved name
+    here (status.resourceClaimStatuses)."""
 
     name: str
     claim_name: str = ""
+    template: str = ""
 
 
 @dataclass(frozen=True)
@@ -533,6 +537,21 @@ class PodGroup:
     namespace: str = "default"
     gang: GangPolicy | None = None
     topology_keys: tuple[str, ...] = ()
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class ResourceClaimTemplate:
+    """resource/v1 ResourceClaimTemplate: the claim spec to stamp per pod
+    (dra/templates/resourceclaimtemplate.yaml shape)."""
+
+    name: str
+    namespace: str = "default"
+    requests: tuple[DeviceRequest, ...] = ()
+    constraints: tuple[DeviceConstraint, ...] = ()
 
     @property
     def key(self) -> str:

@@ -256,7 +256,10 @@ def _resource_claims(obj: _JSON) -> tuple[t.PodResourceClaim, ...]:
     for rc in spec.get("resourceClaims") or ():
         name = rc.get("name", "")
         claim = rc.get("resourceClaimName") or resolved.get(name, "")
-        out.append(t.PodResourceClaim(name=name, claim_name=claim))
+        out.append(t.PodResourceClaim(
+            name=name, claim_name=claim,
+            template=rc.get("resourceClaimTemplateName", "") or "",
+        ))
     return tuple(out)
 
 
@@ -414,7 +417,9 @@ def pod_to_v1(pod: t.Pod) -> dict:
     if pod.resource_claims:
         spec["resourceClaims"] = [
             {"name": rc.name,
-             **({"resourceClaimName": rc.claim_name} if rc.claim_name else {})}
+             **({"resourceClaimName": rc.claim_name} if rc.claim_name else {}),
+             **({"resourceClaimTemplateName": rc.template}
+                if rc.template else {})}
             for rc in pod.resource_claims
         ]
     annotations = {}

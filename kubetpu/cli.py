@@ -128,9 +128,11 @@ def _kind_buckets() -> dict:
     from .controllers.deployment import DEPLOYMENTS
     from .controllers.job import JOBS
     from .controllers.replicaset import REPLICA_SETS
+    from .controllers.resourceclaim import RESOURCE_CLAIM_TEMPLATES
     from .controllers.statefulset import STATEFUL_SETS
 
     return {
+        "ResourceClaimTemplate": RESOURCE_CLAIM_TEMPLATES,
         "Node": I.NODES, "Pod": I.PODS, "ReplicaSet": REPLICA_SETS,
         "Deployment": DEPLOYMENTS, "Job": JOBS,
         "StatefulSet": STATEFUL_SETS,
@@ -246,6 +248,7 @@ def cmd_controller_manager(args) -> int:
         DeploymentController,
         DisruptionController,
         JobController,
+        ResourceClaimController,
         StatefulSetController,
         NodeLifecycleController,
         PodGCController,
@@ -257,6 +260,7 @@ def cmd_controller_manager(args) -> int:
     ctrls = [
         DeploymentController(store),
         JobController(store),
+        ResourceClaimController(store),
         StatefulSetController(store),
         ReplicaSetController(store),
         NodeLifecycleController(store, grace_s=args.node_monitor_grace),

@@ -10,6 +10,7 @@ from .nodelifecycle import (  # noqa: F401
     heartbeat,
 )
 from .podgc import PodGCController  # noqa: F401
+from .resourceclaim import RESOURCE_CLAIM_TEMPLATES, ResourceClaimController  # noqa: F401
 from .statefulset import STATEFUL_SETS, StatefulSetController  # noqa: F401
 from .replicaset import REPLICA_SETS, ReplicaSetController  # noqa: F401
 from .tainteviction import TaintEvictionController  # noqa: F401

@@ -1,0 +1,128 @@
+"""ResourceClaim controller — template → per-pod claim instances.
+
+Reference: ``pkg/controller/resourceclaim`` (controller.go ``syncPod``): a
+pod whose ``spec.resourceClaims[]`` entry names a ResourceClaimTemplate
+gets a dedicated ResourceClaim instance created from the template's spec,
+and the resolved name lands in ``status.resourceClaimStatuses`` — which is
+what the scheduler's DynamicResources plugin consumes. Claims owned by a
+deleted pod are garbage-collected.
+
+The resolution write updates the POD (its resource_claims entries), so the
+scheduler's DRA PreEnqueue gate — which holds pods with unresolved claims —
+re-runs on the pod-update delivery and admits the pod.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+
+from ..api import types as t
+from ..client.informers import PODS, RESOURCE_CLAIMS
+from ..client.reflector import Reflector, SharedInformer
+from ..store.memstore import ConflictError, MemStore
+
+RESOURCE_CLAIM_TEMPLATES = "resourceclaimtemplates"
+
+
+def _claim_name(pod: t.Pod, rc: t.PodResourceClaim) -> str:
+    """"<pod>-<claim>-<hash>": deterministic (idempotent across controller
+    restarts, unlike the reference's random suffix) yet collision-safe —
+    the hash binds the name to (pod uid, entry name), so "web-1"+"gpu" and
+    "web"+"1-gpu" can never derive the same claim."""
+    h = hashlib.sha1(f"{pod.uid}\x1f{rc.name}".encode()).hexdigest()[:6]
+    return f"{pod.name}-{rc.name}-{h}"
+
+
+class ResourceClaimController:
+    def __init__(self, store: MemStore) -> None:
+        self.store = store
+        self._pods = SharedInformer(PODS)
+        self._templates = SharedInformer(RESOURCE_CLAIM_TEMPLATES)
+        self._claims = SharedInformer(RESOURCE_CLAIMS)
+        self._r = [
+            Reflector(store, self._pods),
+            Reflector(store, self._templates),
+            Reflector(store, self._claims),
+        ]
+        self.creates = 0
+        self.deletes = 0
+
+    def start(self) -> None:
+        for r in self._r:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._r)
+
+    def step(self) -> int:
+        self.pump()
+        wrote = 0
+        live_uids = {p.uid for p in self._pods.store.values()}
+        for key, pod in list(self._pods.store.items()):
+            if any(
+                rc.template and not rc.claim_name
+                for rc in pod.resource_claims
+            ):
+                wrote += self._resolve(key, pod)
+        # GC: claims owned by pod UIDs that no longer exist (uid, not name —
+        # a recreated same-name pod must NOT adopt the dead pod's claim)
+        for ckey, claim in list(self._claims.store.items()):
+            owner = claim.owner
+            if owner.startswith("Pod/") and owner[4:] not in live_uids:
+                try:
+                    self.store.delete(RESOURCE_CLAIMS, ckey)
+                except KeyError:
+                    continue
+                self.deletes += 1
+                wrote += 1
+        return wrote
+
+    def _resolve(self, key: str, pod: t.Pod) -> int:
+        wrote = 0
+        resolved: list[t.PodResourceClaim] = []
+        for rc in pod.resource_claims:
+            if rc.claim_name or not rc.template:
+                resolved.append(rc)
+                continue
+            tpl = self._templates.store.get(
+                f"{pod.namespace}/{rc.template}"
+            )
+            if tpl is None:
+                resolved.append(rc)   # template not created yet: wait
+                continue
+            name = _claim_name(pod, rc)
+            ckey = f"{pod.namespace}/{name}"
+            claim = t.ResourceClaim(
+                name=name, namespace=pod.namespace, uid=ckey,
+                requests=tpl.requests, constraints=tpl.constraints,
+                owner=f"Pod/{pod.uid}",
+            )
+            live, _rv = self.store.get(RESOURCE_CLAIMS, ckey)
+            if live is None:
+                try:
+                    self.store.create(RESOURCE_CLAIMS, ckey, claim)
+                    self.creates += 1
+                    wrote += 1
+                except ConflictError:
+                    pass   # created concurrently — fine, it exists now
+            resolved.append(dataclasses.replace(rc, claim_name=name))
+        if tuple(resolved) == pod.resource_claims:
+            return wrote
+        live, rv = self.store.get(PODS, key)
+        if live is None:
+            return wrote
+        if live.resource_claims != pod.resource_claims:
+            # the spec moved under us (the resolution was computed from the
+            # cached view): bail and recompute next sync from fresh state
+            return wrote
+        try:
+            self.store.update(
+                PODS, key,
+                dataclasses.replace(live, resource_claims=tuple(resolved)),
+                expect_rv=rv,
+            )
+            wrote += 1
+        except ConflictError:
+            pass   # recompute next sync against the fresh pod
+        return wrote

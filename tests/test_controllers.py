@@ -900,3 +900,58 @@ def test_statefulset_adopts_orphan_and_scales_down_without_template():
     ctrl.step()
     ctrl.step()
     assert st.list(PODS)[0] == []
+
+
+# ------------------------------------------------------------- resourceclaim
+
+def test_resourceclaim_controller_resolves_templates_end_to_end():
+    """The full DRA template flow: pod references a ResourceClaimTemplate →
+    controller stamps a per-pod claim + resolves the pod's entry → the
+    scheduler's PreEnqueue gate lifts → device allocated → bind."""
+    from kubetpu.controllers import (
+        RESOURCE_CLAIM_TEMPLATES,
+        ResourceClaimController,
+    )
+
+    st = MemStore()
+    st.create("deviceclasses", "gpu", t.DeviceClass(
+        "gpu", selectors=(t.CELSelector('device.driver == "drv"'),),
+    ))
+    st.create(NODES, "n0", make_node("n0", cpu_milli=2000))
+    st.create("resourceslices", "sl0", t.ResourceSlice(
+        name="sl0", driver="drv", pool="n0", node_name="n0",
+        devices=(t.Device("d0"),),
+    ))
+    st.create(RESOURCE_CLAIM_TEMPLATES, "default/gpu-tpl",
+              t.ResourceClaimTemplate(
+                  name="gpu-tpl",
+                  requests=(t.DeviceRequest(
+                      name="req-0", device_class_name="gpu"),),
+              ))
+    pod = dataclasses.replace(
+        make_pod("p0", cpu_milli=100),
+        resource_claims=(t.PodResourceClaim(
+            name="gpu", template="gpu-tpl"),),
+    )
+    st.create(PODS, "default/p0", pod)
+    rc_ctrl = ResourceClaimController(st)
+    rc_ctrl.start()
+    clock = FakeClock()
+    sched = Scheduler(StoreClient(st), dispatcher_workers=0, clock=clock)
+    informers = SchedulerInformers(st, sched)
+    informers.start()
+    # unresolved: the DRA gate holds the pod
+    assert sched.queue.stats()["gated"] == 1
+    assert rc_ctrl.step() >= 2      # claim created + pod resolved
+    claim = st.get("resourceclaims", "default/p0-gpu-5bc398")[0]
+    assert claim is not None and claim.owner == "Pod/default/p0"
+    informers.pump()                # resolution re-runs the gate
+    sched.schedule_batch()
+    sched.dispatcher.sync()
+    sched._drain_bind_completions()
+    assert st.get(PODS, "default/p0")[0].node_name == "n0"
+    assert st.get("resourceclaims", "default/p0-gpu-5bc398")[0].allocation is not None
+    # pod deleted -> the owned claim is GCed
+    st.delete(PODS, "default/p0")
+    assert rc_ctrl.step() >= 1
+    assert st.get("resourceclaims", "default/p0-gpu-5bc398")[0] is None
