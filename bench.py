@@ -130,11 +130,12 @@ CPU_FALLBACK_STAGES = [
 
 def main() -> None:
     global STAGES
-    if _probe_backend() == "timeout":
-        # TPU relay unreachable: pin CPU in-process (the site hook's
-        # jax_platforms clobber would otherwise dial the relay on the first
-        # device op) and run reduced-shape stages through the same loop
-        _status("TPU relay unreachable — falling back to CPU, reduced shapes")
+    if _probe_backend() != "ok":
+        # TPU backend unusable (relay hang OR fast init error): pin CPU
+        # in-process (the site hook's jax_platforms clobber would otherwise
+        # dial the relay on the first device op) and run reduced-shape
+        # stages through the same loop — an honest number beats zeros
+        _status("TPU backend unusable — falling back to CPU, reduced shapes")
         import jax
 
         jax.config.update("jax_platforms", "cpu")

@@ -8,11 +8,16 @@ extender webhook bridge (``kubetpu.bridge.server``) — the integration seam
 a real kube-scheduler offloads Filter/Prioritize/Bind through — with the
 same side endpoints (/healthz, /metrics, /configz).
 
-Commands:
-- ``serve``        run the extender bridge from a config file
-- ``check-config`` decode + validate a config file, loudly
-- ``perf``         the scheduler_perf harness (kubetpu.perf)
-- ``version``      print the framework version
+Commands (the control-plane binaries + tooling):
+- ``apiserver``           REST+watch object API over the in-memory store
+- ``scheduler``           the scheduler against a remote API server
+- ``controller-manager``  the controller family against a remote API server
+- ``kubelet``             a hollow node agent (kubemark tier)
+- ``serve``               the extender webhook bridge from a config file
+- ``get`` / ``apply`` / ``delete``   kubectl-style object access
+- ``check-config``        decode + validate a config file, loudly
+- ``perf``                the scheduler_perf harness (kubetpu.perf)
+- ``version``             print the framework version
 """
 
 from __future__ import annotations
