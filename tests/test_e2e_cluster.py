@@ -8,6 +8,7 @@ Also covers kubectl-style get/apply/delete against the running server.
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -22,8 +23,6 @@ from kubetpu.api.wrappers import make_pod
 from kubetpu.apiserver import RemoteStore
 from kubetpu.client.informers import NODES, PODS
 
-PORT = 19931
-SERVER = f"http://127.0.0.1:{PORT}"
 
 
 def _spawn(log_path, *cli_args: str) -> subprocess.Popen:
@@ -45,7 +44,7 @@ def _await_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0):
     while time.monotonic() < deadline:
         content = open(proc._log_path).read()   # type: ignore[attr-defined]
         if needle in content:
-            return
+            return content
         if proc.poll() is not None:
             raise AssertionError(
                 f"process exited {proc.returncode}: {content[-2000:]}"
@@ -64,9 +63,13 @@ def _await_line(proc: subprocess.Popen, needle: str, timeout: float = 150.0):
 def test_multi_process_cluster_end_to_end(tmp_path):
     procs: list[subprocess.Popen] = []
     try:
-        api = _spawn(tmp_path / "api.log", "apiserver", "--port", str(PORT))
+        # ephemeral port (a stale process holding a fixed port must not
+        # fail the suite): the apiserver prints its bound URL
+        api = _spawn(tmp_path / "api.log", "apiserver", "--port", "0")
         procs.append(api)
-        _await_line(api, "serving on")
+        # wait for text AFTER the URL so a mid-write read can't truncate it
+        content = _await_line(api, "(REST:")
+        SERVER = re.search(r"serving on (http://[\d.:]+) ", content).group(1)
 
         for node in ("worker-0", "worker-1"):
             kb = _spawn(tmp_path / f"{node}.log", "kubelet",
