@@ -102,7 +102,9 @@ def _coerce(value: Any, hint: Any) -> Any:
             except (SchemeError, TypeError, ValueError):
                 continue
         raise SchemeError(f"no union arm of {hint} accepts {value!r}")
-    if origin is tuple and isinstance(value, list):
+    if origin is tuple:
+        if not isinstance(value, list):
+            raise SchemeError(f"expected array for {hint}, got {value!r}")
         args = typing.get_args(hint)
         if len(args) == 2 and args[1] is Ellipsis:
             return tuple(_coerce(v, args[0]) for v in value)
@@ -117,6 +119,33 @@ def _coerce(value: Any, hint: Any) -> Any:
         if isinstance(value, dict):
             return _decode_into(hint, value)
         raise SchemeError(f"expected object for {hint.__name__}, got {value!r}")
+    if origin is dict:
+        if not isinstance(value, dict):
+            raise SchemeError(f"expected object for {hint}, got {value!r}")
+        args = typing.get_args(hint)
+        if args:
+            return {str(k): _coerce(v, args[1]) for k, v in value.items()}
+        return value
+    # Primitive leaves are type-checked against the annotation — strict
+    # decoding covers field types, not just unknown kinds/fields. bool is
+    # checked before int (bool is an int subclass); int is accepted where
+    # float is annotated (JSON has one number type).
+    if hint is bool:
+        if not isinstance(value, bool):
+            raise SchemeError(f"expected bool, got {value!r}")
+        return value
+    if hint is int:
+        if isinstance(value, bool) or not isinstance(value, int):
+            raise SchemeError(f"expected int, got {value!r}")
+        return value
+    if hint is float:
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            raise SchemeError(f"expected float, got {value!r}")
+        return value
+    if hint is str:
+        if not isinstance(value, str):
+            raise SchemeError(f"expected str, got {value!r}")
+        return value
     return value
 
 

@@ -124,12 +124,16 @@ class SchedulerInformers:
         self._bind(PODS, s.on_pod_add,
                    lambda old, new: s.on_pod_update(old, new),
                    s.on_pod_delete)
-        self._bind(RESOURCE_CLAIMS, s.on_resource_claim_add,
-                   s.on_resource_claim_update, s.on_resource_claim_delete)
+        # slices + classes sync BEFORE claims: a pre-allocated claim
+        # consumed while the device catalog is still empty would bucket
+        # network-attached devices under the claim's node (see
+        # DraIndex._rebucket, which also heals any remaining interleave)
         self._bind(RESOURCE_SLICES, s.on_resource_slice_add,
                    s.on_resource_slice_update, s.on_resource_slice_delete)
         self._bind(DEVICE_CLASSES, s.on_device_class_add,
                    s.on_device_class_update, s.on_device_class_delete)
+        self._bind(RESOURCE_CLAIMS, s.on_resource_claim_add,
+                   s.on_resource_claim_update, s.on_resource_claim_delete)
         self._bind(PERSISTENT_VOLUMES, s.on_pv_add, s.on_pv_update,
                    s.on_pv_delete)
         self._bind(PERSISTENT_VOLUME_CLAIMS, s.on_pvc_add, s.on_pvc_update,

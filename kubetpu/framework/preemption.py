@@ -149,8 +149,17 @@ class PreemptionEvaluator:
                 failed_dep = failed_dep | ~part[0]
         return ok_independent & failed_dep
 
-    def preempt(self, i: int) -> PreemptionResult:
-        """Run preemption for pending pod ``i`` of the batch."""
+    def preempt(self, i: int, extender_hook=None) -> PreemptionResult:
+        """Run preemption for pending pod ``i`` of the batch.
+
+        ``extender_hook`` (optional) is the ProcessPreemption seam
+        (preemption.go callExtenders): called with
+        ``(pod, {node_name: (victim_pods, n_pdb_violations)})`` over the FULL
+        candidate set, it returns the trimmed
+        ``{node_name: (victim_uids, n_pdb_violations)}`` map — nodes it drops
+        become ineligible, victim lists may shrink — and the best-candidate
+        pick then runs host-side over the survivors. Raising ExtenderError
+        fails the preemption attempt (non-ignorable extender failure)."""
         pod = self.batch.pods[i]
         # PodEligibleToPreemptOthers (default_preemption.go:364): policy gate.
         # (Terminating-victims-on-nominated-node check needs pod deletion
@@ -196,7 +205,7 @@ class PreemptionEvaluator:
                         ports, self._nom_node[sel],
                         self._nom_ports[sel].astype(ports.dtype),
                     )
-        node_idx, victims = OP.dry_run_preemption(
+        node_idx, victims, ok_mask, n_pdb = OP.dry_run_preemption(
             b.requests[i],
             jnp.asarray(np.int64(pod.priority)),
             wants_conf,
@@ -214,13 +223,25 @@ class PreemptionEvaluator:
             jnp.asarray(v.pdb),
             jnp.asarray(self.pdb_allowed),
         )
-        n = int(jax.device_get(node_idx))
-        if n < 0:
-            return PreemptionResult(
-                "unschedulable",
-                message="preemption: 0/%d nodes are available" % self.batch.num_nodes,
+        if extender_hook is not None:
+            picked = self._pick_with_extenders(
+                pod, victims, ok_mask, n_pdb, extender_hook
             )
-        vrow = np.asarray(jax.device_get(victims[n]))
+            if picked is None:
+                return PreemptionResult(
+                    "unschedulable",
+                    message="preemption: no candidate survived extenders",
+                )
+            n, vrow = picked
+        else:
+            n = int(jax.device_get(node_idx))
+            if n < 0:
+                return PreemptionResult(
+                    "unschedulable",
+                    message="preemption: 0/%d nodes are available"
+                    % self.batch.num_nodes,
+                )
+            vrow = np.asarray(jax.device_get(victims[n]))
         uids = [
             v.uids[n][k] for k in np.flatnonzero(vrow) if v.uids[n][k] is not None
         ]
@@ -233,6 +254,66 @@ class PreemptionEvaluator:
             victim_uids=uids,
             victim_pods=pods,
         )
+
+    def _pick_with_extenders(
+        self, pod: t.Pod, victims, ok_mask, n_pdb, extender_hook
+    ) -> tuple[int, np.ndarray] | None:
+        """callExtenders + SelectCandidate on the host: present every dry-run
+        candidate to the extender chain, drop vetoed nodes, adopt trimmed
+        victim lists, then re-run pickOneNodeForPreemption's lexicographic
+        refinement over the survivors (preemption.go:311 — stats recomputed
+        from the FINAL victim sets, NumPDBViolations taken from the extender
+        response as the reference's MetaVictims carry it)."""
+        v = self.victims
+        okh = np.asarray(jax.device_get(ok_mask))
+        if not okh.any():
+            return None
+        vall = np.asarray(jax.device_get(victims))
+        pdbh = np.asarray(jax.device_get(n_pdb))
+        infos = self.batch.node_tensors.infos
+        cand: dict[str, tuple[list[t.Pod], int]] = {}
+        slots: dict[str, tuple[int, list[int]]] = {}
+        for n in np.flatnonzero(okh):
+            name = self.batch.node_names[n]
+            ks = [
+                int(k) for k in np.flatnonzero(vall[n])
+                if v.uids[n][k] is not None
+            ]
+            pods = [
+                infos[n].pods[v.uids[n][k]]
+                for k in ks if v.uids[n][k] in infos[n].pods
+            ]
+            cand[name] = (pods, int(pdbh[n]))
+            slots[name] = (int(n), ks)
+        trimmed = extender_hook(pod, cand)
+        best: tuple | None = None
+        for name in cand:                     # ascending node index order
+            if name not in trimmed:
+                continue                       # extender vetoed the node
+            uids, npdb = trimmed[name]
+            n, ks = slots[name]
+            keep = set(uids)
+            uid_slot = {v.uids[n][k]: k for k in ks}
+            final = [uid_slot[u] for u in keep if u in uid_slot]
+            if not final:
+                # victim list trimmed to nothing (or to unknown uids): the
+                # node is no longer a preemption candidate — the reference
+                # drops empty-victims nodes after callExtenders; keeping it
+                # would nominate onto a still-full node with zero deletions
+                continue
+            prios = v.priority[n, final]
+            max_p = int(prios.max())
+            sum_p = int((prios + OP.PRIO_OFFSET).sum())
+            highest = [k for k in final if v.priority[n, k] == max_p]
+            early = int(v.start[n, highest].min())
+            key = (-int(npdb), -max_p, -sum_p, -len(final), early)
+            if best is None or key > best[0]:
+                vrow = np.zeros(vall.shape[1], dtype=bool)
+                vrow[final] = True
+                best = (key, n, vrow)
+        if best is None:
+            return None
+        return best[1], best[2]
 
     def _apply(
         self, n: int, victim_row: np.ndarray, preemptor_index: int | None = None
@@ -258,6 +339,46 @@ class PreemptionEvaluator:
             self.port_counts[n] += self._pod_ports[preemptor_index].astype(
                 self.port_counts.dtype
             )
+
+
+def extender_chain_hook(extenders):
+    """Build the ProcessPreemption hook for ``PreemptionEvaluator.preempt``
+    from the scheduler's configured extenders, or None when no extender has
+    a preempt verb. Extenders run in order, each further trimming the
+    candidate map (preemption.go callExtenders); an uninterested extender is
+    skipped, an ignorable failing one too, and a non-ignorable failure
+    propagates (the attempt fails)."""
+    active = [e for e in extenders if e.supports_preemption()]
+    if not active:
+        return None
+
+    def hook(
+        pod: t.Pod, cand: dict[str, tuple[list[t.Pod], int]]
+    ) -> dict[str, tuple[list[str], int]]:
+        current = cand
+        for e in active:
+            if not e.is_interested(pod):
+                continue
+            try:
+                res = e.process_preemption(pod, current)
+            except Exception:
+                if e.cfg.ignorable:
+                    continue
+                raise
+            # re-materialize pods for the next extender in the chain
+            nxt: dict[str, tuple[list[t.Pod], int]] = {}
+            for node, (uids, npdb) in res.items():
+                pods_prev = {p.uid: p for p in current.get(node, ([], 0))[0]}
+                nxt[node] = (
+                    [pods_prev[u] for u in uids if u in pods_prev], npdb
+                )
+            current = nxt
+        return {
+            node: ([p.uid for p in pods], npdb)
+            for node, (pods, npdb) in current.items()
+        }
+
+    return hook
 
 
 def _one_pod_view(b: rt.DeviceBatch, i: int) -> rt.DeviceBatch:

@@ -18,6 +18,7 @@
 #define PY_SSIZE_T_CLEAN
 #include <Python.h>
 
+#include <algorithm>
 #include <deque>
 #include <string>
 #include <unordered_map>
@@ -33,12 +34,23 @@ struct Event {
   long long rv;
 };
 
+// seq is the insertion order (stable across updates) so list() returns the
+// same ordering as the pure-Python dict core — informer replace/replay
+// order, and therefore cache insertion order and score tie-breaking, must
+// not depend on which store backend is active.
+struct Entry {
+  PyObject* obj;  // owned reference
+  long long rv;
+  long long seq;
+};
+
 struct StoreObject {
   PyObject_HEAD
   long long rv;
   long long compacted_through;
+  long long seq_counter;
   size_t history;
-  std::unordered_map<std::string, std::pair<PyObject*, long long>>* objects;
+  std::unordered_map<std::string, Entry>* objects;
   std::deque<Event>* events;
 };
 
@@ -75,7 +87,7 @@ PyObject* store_create(StoreObject* self, PyObject* args) {
   }
   self->rv += 1;
   Py_INCREF(obj);
-  (*self->objects)[mk] = {obj, self->rv};
+  (*self->objects)[mk] = {obj, self->rv, ++self->seq_counter};
   push_event(self, 0, kind, key, obj);
   return PyLong_FromLongLong(self->rv);
 }
@@ -91,7 +103,7 @@ PyObject* store_update(StoreObject* self, PyObject* args) {
   auto it = self->objects->find(mk);
   bool existed = it != self->objects->end();
   if (expect >= 0) {
-    long long have = existed ? it->second.second : -1;
+    long long have = existed ? it->second.rv : -1;
     if (!existed || have != expect) {
       PyErr_Format(PyExc_ValueError, "%s/%s: expected rv %lld, have %lld",
                    kind, key, expect, have);
@@ -101,10 +113,11 @@ PyObject* store_update(StoreObject* self, PyObject* args) {
   self->rv += 1;
   Py_INCREF(obj);
   if (existed) {
-    Py_DECREF(it->second.first);
-    it->second = {obj, self->rv};
+    Py_DECREF(it->second.obj);
+    it->second.obj = obj;
+    it->second.rv = self->rv;  // seq unchanged: updates do not reorder
   } else {
-    (*self->objects)[mk] = {obj, self->rv};
+    (*self->objects)[mk] = {obj, self->rv, ++self->seq_counter};
   }
   push_event(self, existed ? 1 : 0, kind, key, obj);
   return PyLong_FromLongLong(self->rv);
@@ -120,7 +133,7 @@ PyObject* store_delete(StoreObject* self, PyObject* args) {
     PyErr_Format(PyExc_KeyError, "%s/%s not found", kind, key);
     return nullptr;
   }
-  PyObject* old = it->second.first;
+  PyObject* old = it->second.obj;
   self->objects->erase(it);
   self->rv += 1;
   push_event(self, 2, kind, key, old);
@@ -136,7 +149,7 @@ PyObject* store_get(StoreObject* self, PyObject* args) {
   if (it == self->objects->end()) {
     return Py_BuildValue("(OL)", Py_None, 0LL);
   }
-  return Py_BuildValue("(OL)", it->second.first, it->second.second);
+  return Py_BuildValue("(OL)", it->second.obj, it->second.rv);
 }
 
 PyObject* store_list(StoreObject* self, PyObject* args) {
@@ -144,12 +157,23 @@ PyObject* store_list(StoreObject* self, PyObject* args) {
   if (!PyArg_ParseTuple(args, "s", &kind)) return nullptr;
   std::string prefix(kind);
   prefix.push_back('\x1f');
-  PyObject* items = PyList_New(0);
-  if (!items) return nullptr;
+  struct Hit {
+    long long seq;
+    const std::string* key;
+    const Entry* entry;
+    bool operator<(const Hit& o) const { return seq < o.seq; }
+  };
+  std::vector<Hit> hits;
   for (auto& kv : *self->objects) {
     if (kv.first.compare(0, prefix.size(), prefix) != 0) continue;
+    hits.push_back(Hit{kv.second.seq, &kv.first, &kv.second});
+  }
+  std::sort(hits.begin(), hits.end());  // insertion order, like dict
+  PyObject* items = PyList_New(0);
+  if (!items) return nullptr;
+  for (auto& h : hits) {
     PyObject* entry = Py_BuildValue(
-        "(sO)", kv.first.c_str() + prefix.size(), kv.second.first);
+        "(sO)", h.key->c_str() + prefix.size(), h.entry->obj);
     if (!entry || PyList_Append(items, entry) < 0) {
       Py_XDECREF(entry);
       Py_DECREF(items);
@@ -219,15 +243,15 @@ PyObject* store_new(PyTypeObject* type, PyObject* args, PyObject*) {
   if (!self) return nullptr;
   self->rv = 0;
   self->compacted_through = 0;
+  self->seq_counter = 0;
   self->history = (size_t)(history > 0 ? history : 1);
-  self->objects =
-      new std::unordered_map<std::string, std::pair<PyObject*, long long>>();
+  self->objects = new std::unordered_map<std::string, Entry>();
   self->events = new std::deque<Event>();
   return (PyObject*)self;
 }
 
 void store_dealloc(StoreObject* self) {
-  for (auto& kv : *self->objects) Py_DECREF(kv.second.first);
+  for (auto& kv : *self->objects) Py_DECREF(kv.second.obj);
   for (auto& e : *self->events) Py_DECREF(e.obj);
   delete self->objects;
   delete self->events;

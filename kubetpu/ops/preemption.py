@@ -183,8 +183,11 @@ def dry_run_preemption(
     ``potential`` (N,) mask (nodes whose failure preemption could resolve —
     preemption.go:180 NodesForStatusCode(Unschedulable)), then pick_node.
 
-    Returns ``(node_idx, victims (N, K) bool)`` — victims row of the chosen
-    node is the preemption plan; host maps slots back to pod uids.
+    Returns ``(node_idx, victims (N, K) bool, ok (N,) bool, n_pdb (N,))`` —
+    victims row of the chosen node is the preemption plan; host maps slots
+    back to pod uids. ``ok``/``n_pdb`` expose the full candidate set so the
+    host can re-pick after extender ProcessPreemption trims candidates
+    (extender.go ProcessPreemption → preemption.go callExtenders).
     """
     ok, victims, n_pdb, max_p, sum_p, n_v, early = jax.vmap(
         lambda a, r, c, al, vv, vp, vs, vr, vpo, vpd, pc: select_victims_node(
@@ -195,4 +198,4 @@ def dry_run_preemption(
       v_valid, v_prio, v_start, v_req, v_ports, v_pdb, port_counts)
     ok = ok & potential
     node_idx = pick_node(ok, n_pdb, max_p, sum_p, n_v, early)
-    return node_idx, victims
+    return node_idx, victims, ok, n_pdb

@@ -116,30 +116,33 @@ class HTTPExtender:
         return bool(self.cfg.preempt_verb)
 
     def process_preemption(
-        self, pod: t.Pod, victims_by_node: dict[str, list[t.Pod]]
-    ) -> dict[str, list[str]]:
-        """extender.go ProcessPreemption: POST the candidate victim map;
-        the extender returns the (possibly trimmed) map as MetaVictims —
-        {node: [victim pod uids]}. Candidate nodes the extender drops are
-        ineligible for preemption.
-
-        NOTE: the evaluator currently picks its best candidate before this
-        seam (sched/preemption.py); wiring the trim into the dry-run
-        candidate set is tracked as a known gap — the verb, wire format and
-        bridge-server half are complete and tested."""
+        self, pod: t.Pod,
+        victims_by_node: dict[str, tuple[list[t.Pod], int]],
+    ) -> dict[str, tuple[list[str], int]]:
+        """extender.go ProcessPreemption: POST the candidate victim map
+        (node → Victims{Pods, NumPDBViolations}); the extender returns the
+        (possibly trimmed) map as MetaVictims — nodes it drops become
+        ineligible for preemption, victim lists may shrink. The evaluator's
+        best-candidate pick runs AFTER this trim
+        (framework/preemption.PreemptionEvaluator._pick_with_extenders)."""
         args = {
             "Pod": pod_to_v1(pod),
             "NodeNameToVictims": {
-                node: {"Pods": [pod_to_v1(v) for v in victims]}
-                for node, victims in victims_by_node.items()
+                node: {
+                    "Pods": [pod_to_v1(v) for v in victims],
+                    "NumPDBViolations": n_pdb,
+                }
+                for node, (victims, n_pdb) in victims_by_node.items()
             },
         }
         res = self._post(self.cfg.preempt_verb, args)
-        out: dict[str, list[str]] = {}
+        out: dict[str, tuple[list[str], int]] = {}
         for node, mv in (res.get("NodeNameToMetaVictims") or {}).items():
-            out[node] = [
-                (p or {}).get("UID", "") for p in (mv or {}).get("Pods") or ()
-            ]
+            out[node] = (
+                [(p or {}).get("UID", "")
+                 for p in (mv or {}).get("Pods") or ()],
+                int((mv or {}).get("NumPDBViolations") or 0),
+            )
         return out
 
     def prioritize(self, pod: t.Pod, node_names: list[str]) -> dict[str, int]:

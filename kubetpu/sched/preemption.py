@@ -76,7 +76,23 @@ class DefaultPreemptionPostFilter:
             self._evaluator = self._build(sched, ctx)
         ev = self._evaluator
 
-        result = ev.preempt(i)
+        from ..framework.preemption import extender_chain_hook
+        from .extender import ExtenderError
+
+        hook = extender_chain_hook(sched.extenders)
+        try:
+            result = ev.preempt(i, extender_hook=hook)
+        except (ExtenderError, OSError) as e:
+            # non-ignorable extender failure mid-ProcessPreemption: this
+            # attempt fails (preemption.go callExtenders error path);
+            # evaluator bugs propagate instead of hiding as "no candidates"
+            import sys
+
+            print(f"kubetpu.sched: preemption extender failed for "
+                  f"{info.key}: {e}", file=sys.stderr)
+            sched.nominator.remove(info.pod.uid)
+            info.nominated_node_name = None
+            return None
         if result.status != "success" or result.node_name is None:
             # clear any stale nomination (the reference's
             # NewPostFilterResultWithNominatedNode("") on no-candidates)

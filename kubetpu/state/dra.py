@@ -363,7 +363,24 @@ class DraIndex:
                     sl.node_name, sl.all_nodes, sl.node_selector, dev, sl.driver
                 )
         self._catalog = (self.generation, cat)
+        self._rebucket(cat)
         return cat
+
+    def _rebucket(self, cat: dict) -> None:
+        """Claims can be observed before their slices (informer start order
+        is best-effort; a relist can interleave kinds): a device consumed
+        against an empty catalog lands in the claim's ``node_name`` bucket.
+        On every catalog regeneration, re-derive each allocated device's
+        home so network-attached devices migrate to the global ``''``
+        bucket — otherwise other nodes still see the device free (double
+        allocation) and a later ``_release`` misses the stale entry,
+        leaking it as permanently allocated."""
+        moved: dict[str, set[_DevKey]] = {}
+        for bucket, keys in self.allocated_devices.items():
+            for key in keys:
+                home = self._home(key, cat, bucket)
+                moved.setdefault(home, set()).add(key)
+        self.allocated_devices = {b: s for b, s in moved.items() if s}
 
     def ensure_pool(self, pid: int) -> _Pool:
         pool = self._pools[pid]

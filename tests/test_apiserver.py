@@ -73,6 +73,27 @@ def test_scheme_strict_decoding_fails_loudly():
         scheme.decode({"key": "k"})
 
 
+def test_scheme_strict_decoding_checks_field_types():
+    """Strict decoding covers primitive leaf TYPES, not only unknown
+    kinds/fields (ADVICE r4): a string in an int field (and vice versa)
+    must raise, while int-where-float stays legal (JSON has one number
+    type)."""
+    ok = scheme.decode({"kind": "Namespace", "name": "ns"})
+    assert ok.name == "ns"
+    with pytest.raises(scheme.SchemeError, match="expected str"):
+        scheme.decode({"kind": "Namespace", "name": 7})
+    with pytest.raises(scheme.SchemeError, match="expected int"):
+        scheme.decode({"kind": "ContainerPort", "host_port": "eighty"})
+    with pytest.raises(scheme.SchemeError, match="expected int"):
+        scheme.decode({"kind": "ContainerPort", "host_port": True})
+    # float-annotated field accepts an integral JSON number
+    tol = scheme.decode({
+        "kind": "Toleration", "key": "k", "operator": "Exists",
+        "toleration_seconds": 5,
+    })
+    assert tol.toleration_seconds == 5
+
+
 # ----------------------------------------------------------------- REST CRUD
 
 @pytest.fixture()

@@ -311,3 +311,23 @@ def test_store_contract_both_cores(native):
         w.poll()
     assert st.get(NODES, "n1") == (None, 0)
     assert st.get(NODES, "n0")[0].allocatable_dict()["cpu"] == 7
+
+
+@pytest.mark.parametrize("native", [False, True])
+def test_store_list_order_is_insertion_order(native):
+    """list() returns insertion order on BOTH cores — informer replace /
+    replay order (and therefore cache insertion order and score
+    tie-breaking) must not depend on the store backend (ADVICE r4)."""
+    from kubetpu.native import store_core
+
+    if native and store_core() is None:
+        pytest.skip("native core unavailable")
+    st = MemStore(native=native)
+    names = ["zeta", "alpha", "mid", "beta"]
+    for n in names:
+        st.create(NODES, n, make_node(n))
+    st.update(NODES, "alpha", make_node("alpha", cpu_milli=2))  # no reorder
+    st.delete(NODES, "mid")
+    st.create(NODES, "mid", make_node("mid"))   # re-create goes to the end
+    items, _ = st.list(NODES)
+    assert [k for k, _ in items] == ["zeta", "alpha", "beta", "mid"]

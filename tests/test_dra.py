@@ -554,3 +554,36 @@ def test_perf_case_fast_schedules_everything():
         "SchedulingWithResourceClaimTemplate", "fast", timeout_s=120,
     )
     assert r.scheduled == r.measure_pods == 10
+
+
+def test_claim_before_slice_rebuckets_network_device():
+    """A pre-allocated claim observed while the device catalog is empty
+    (informer interleave) falls back to the claim's node bucket; once the
+    slice arrives and reveals the device as network-attached, the index
+    must re-home it to the global '' bucket — otherwise other nodes still
+    see it free (double allocation) and release leaks it (ADVICE r4)."""
+    idx = DraIndex()
+    key = (DRIVER, "netpool", "dev-0")
+    claim = t.ResourceClaim(
+        name="early", namespace="default", uid="default/early",
+        requests=(t.DeviceRequest(
+            name="req-0", device_class_name="gpu", count=1),),
+        allocation=t.ClaimAllocation(
+            node_name="n0",
+            results=(t.DeviceResult("req-0", DRIVER, "netpool", "dev-0"),),
+        ),
+    )
+    idx.add_claim(claim)      # catalog empty: bucketed under "n0"
+    assert key in idx.allocated_devices.get("n0", set())
+    idx.add_slice(t.ResourceSlice(
+        name="net", driver=DRIVER, pool="netpool", all_nodes=True,
+        devices=(t.Device("dev-0"),),
+    ))
+    # any catalog read re-buckets: the device must be globally consumed
+    free_elsewhere = idx.node_free_devices("n1")
+    assert all(k != key for k, _, _ in free_elsewhere)
+    assert key in idx.allocated_devices.get("", set())
+    assert key not in idx.allocated_devices.get("n0", set())
+    # release must find the migrated entry (no permanent leak)
+    idx.remove_claim(claim.key)
+    assert any(k == key for k, _, _ in idx.node_free_devices("n1"))

@@ -28,11 +28,13 @@ from .test_scheduler import FakeClient, FakeClock
 class ScriptedExtender:
     """A minimal webhook with scripted verdicts."""
 
-    def __init__(self, reject=(), prefer=None):
+    def __init__(self, reject=(), prefer=None, preempt_veto=()):
         self.reject = set(reject)
         self.prefer = prefer
+        self.preempt_veto = set(preempt_veto)
         self.filter_calls = 0
         self.prioritize_calls = 0
+        self.preempt_calls = 0
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -54,6 +56,21 @@ class ScriptedExtender:
                         "FailedAndUnresolvableNodes": {},
                         "Error": "",
                     }
+                elif self.path.endswith("/preempt"):
+                    outer.preempt_calls += 1
+                    body = {"NodeNameToMetaVictims": {
+                        node: {
+                            "Pods": [
+                                {"UID": (p.get("metadata") or {}).get("uid", "")}
+                                for p in (v or {}).get("Pods") or ()
+                            ],
+                            "NumPDBViolations":
+                                (v or {}).get("NumPDBViolations", 0),
+                        }
+                        for node, v in
+                        (args.get("NodeNameToVictims") or {}).items()
+                        if node not in outer.preempt_veto
+                    }}
                 else:
                     outer.prioritize_calls += 1
                     body = [
@@ -320,12 +337,87 @@ def test_process_preemption_round_trip_against_own_server():
         assert ext.supports_preemption()
         preemptor = make_pod("hungry", cpu_milli=1000)
         victims = {
-            "n0": [make_pod("v0", cpu_milli=500, node_name="n0")],
+            "n0": ([make_pod("v0", cpu_milli=500, node_name="n0")], 1),
             # n-gone is unknown to the server's cache -> dropped
-            "n-gone": [make_pod("v1", cpu_milli=500, node_name="n-gone")],
+            "n-gone": ([make_pod("v1", cpu_milli=500, node_name="n-gone")], 0),
         }
         out = ext.process_preemption(preemptor, victims)
         assert set(out) == {"n0"}
-        assert out["n0"] == ["default/v0"]
+        assert out["n0"] == (["default/v0"], 1)
     finally:
         srv.close()
+
+
+def test_preempt_extender_veto_redirects_nomination():
+    """ProcessPreemption trim APPLIED (preemption.go callExtenders →
+    SelectCandidate): two identical full nodes; dry-run would pick n0
+    (first-index tie-break), but the extender vetoes n0, so the evaluator
+    must nominate n1 and delete n1's victim instead (ADVICE r4)."""
+    ext = ScriptedExtender(preempt_veto={"n0"})
+    try:
+        deleted = []
+        nominated = []
+
+        class Client(FakeClient):
+            def delete_pod(self, pod, reason=""):
+                deleted.append(pod)
+
+            def nominate(self, pod, node_name):
+                nominated.append((pod.name, node_name))
+
+        client = Client()
+        s, _ = make_ext_sched(client, C.ExtenderConfig(
+            url_prefix=ext.url, preempt_verb="preempt",
+        ))
+        s.enable_preemption()
+        for i in range(2):
+            s.on_node_add(make_node(f"n{i}", cpu_milli=1000))
+            s.on_pod_add(make_pod(
+                f"low-{i}", cpu_milli=900, priority=0, node_name=f"n{i}",
+                creation_index=i,
+            ))
+        s.on_pod_add(make_pod("high", cpu_milli=800, priority=100,
+                              creation_index=10))
+        res = s.schedule_batch()
+        assert res == {"scheduled": 0, "unschedulable": 1}
+        s.dispatcher.sync()
+        assert ext.preempt_calls == 1
+        assert [p.name for p in deleted] == ["low-1"]
+        assert nominated == [("high", "n1")]
+        s.close()
+    finally:
+        ext.close()
+
+
+def test_preempt_extender_veto_all_blocks_preemption():
+    """Every candidate vetoed → the attempt fails with no victims deleted
+    and no nomination (extender may only shrink; empty = ineligible)."""
+    ext = ScriptedExtender(preempt_veto={"n0", "n1"})
+    try:
+        deleted = []
+
+        class Client(FakeClient):
+            def delete_pod(self, pod, reason=""):
+                deleted.append(pod)
+
+        client = Client()
+        s, _ = make_ext_sched(client, C.ExtenderConfig(
+            url_prefix=ext.url, preempt_verb="preempt",
+        ))
+        s.enable_preemption()
+        for i in range(2):
+            s.on_node_add(make_node(f"n{i}", cpu_milli=1000))
+            s.on_pod_add(make_pod(
+                f"low-{i}", cpu_milli=900, priority=0, node_name=f"n{i}",
+                creation_index=i,
+            ))
+        s.on_pod_add(make_pod("high", cpu_milli=800, priority=100,
+                              creation_index=10))
+        res = s.schedule_batch()
+        assert res == {"scheduled": 0, "unschedulable": 1}
+        s.dispatcher.sync()
+        assert ext.preempt_calls == 1
+        assert deleted == []
+        s.close()
+    finally:
+        ext.close()
