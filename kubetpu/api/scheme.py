@@ -45,7 +45,7 @@ for _cls in (
     t.DeviceRequest, t.DeviceSubRequest, t.DeviceConstraint,
     t.ResourceClaim, t.ClaimAllocation, t.DeviceResult, t.PodResourceClaim,
     t.NodeHeartbeat, t.LeaderElectionRecord, t.Deployment, t.Job,
-    t.StatefulSet, t.ResourceClaimTemplate,
+    t.StatefulSet, t.ResourceClaimTemplate, t.DaemonSet,
 ):
     register(_cls)
 

@@ -120,6 +120,83 @@ def find_untolerated_taint(
     return None
 
 
+def parse_simple_selector(s: str) -> tuple[tuple[str, bool, str], ...]:
+    """Parse the ``k=v,k2!=v2`` list/watch selector string (the subset of
+    labels.Parse / fields.ParseSelector the reference's list options use:
+    ``=``, ``==``, ``!=``) into ``(key, equals, value)`` terms. An empty
+    string selects everything. Malformed terms raise ValueError (the
+    apiserver's 400 on a bad selector)."""
+    terms: list[tuple[str, bool, str]] = []
+    for part in s.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "!=" in part:
+            k, _, v = part.partition("!=")
+            eq = False
+        elif "==" in part:
+            k, _, v = part.partition("==")
+            eq = True
+        elif "=" in part:
+            k, _, v = part.partition("=")
+            eq = True
+        else:
+            raise ValueError(f"malformed selector term {part!r}")
+        k = k.strip()
+        if not k:
+            raise ValueError(f"malformed selector term {part!r}")
+        terms.append((k, eq, v.strip()))
+    return tuple(terms)
+
+
+# fieldSelector paths the server understands (the reference's supported
+# fields per resource — registry strategies' GetAttrs; spec.nodeName is the
+# kubelet's pod watch, pkg/registry/core/pod/strategy.go NodeNameTriggerFunc)
+def object_field(obj, path: str) -> str | None:
+    if path == "metadata.name":
+        return getattr(obj, "name", None)
+    if path == "metadata.namespace":
+        return getattr(obj, "namespace", None)
+    if path == "spec.nodeName":
+        return getattr(obj, "node_name", None)
+    if path == "status.phase":
+        return getattr(obj, "phase", None)
+    if path == "spec.schedulerName":
+        return getattr(obj, "scheduler_name", None)
+    return None
+
+
+def simple_selector_matches(
+    terms: tuple[tuple[str, bool, str], ...], get
+) -> bool:
+    """``get(key) -> str | None``; a None field only matches ``!=``."""
+    for key, eq, value in terms:
+        got = get(key)
+        if eq:
+            if got != value:
+                return False
+        elif got == value:
+            return False
+    return True
+
+
+def object_matches_selectors(
+    obj,
+    label_terms: tuple[tuple[str, bool, str], ...] = (),
+    field_terms: tuple[tuple[str, bool, str], ...] = (),
+) -> bool:
+    if label_terms:
+        labels = getattr(obj, "labels_dict", dict)()
+        if not simple_selector_matches(label_terms, labels.get):
+            return False
+    if field_terms:
+        if not simple_selector_matches(
+            field_terms, lambda p: object_field(obj, p)
+        ):
+            return False
+    return True
+
+
 def count_intolerable_prefer_no_schedule(
     taints: tuple[Taint, ...], tolerations: tuple[Toleration, ...]
 ) -> int:

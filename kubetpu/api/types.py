@@ -259,6 +259,15 @@ class Pod:
     # restartPolicy: Never + finite workload (the batch/Job shape): the
     # node agent transitions Running -> Succeeded instead of running forever
     terminates: bool = False
+    # metadata.finalizers: a DELETE with finalizers present soft-deletes
+    # (deletion_timestamp set, object retained) until every finalizer is
+    # cleared — registry/store.go's graceful-deletion/finalizer gate; the
+    # Job controller's tracking finalizer rides this
+    finalizers: tuple[str, ...] = ()
+    # metadata.deletionTimestamp (epoch seconds): non-None = terminating;
+    # the node agent winds the pod down, and the store removes the object
+    # on the first update that sees finalizers empty
+    deletion_timestamp: float | None = None
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)
@@ -659,6 +668,25 @@ class ReplicaSet:
     template: "Pod | None" = None     # prototype; name/uid/owner stamped
     # the owning controller ("Deployment/<ns>/<name>"), "" = standalone
     owner: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class DaemonSet:
+    """The slice of apps/v1 DaemonSet the control loop consumes: one pod
+    per eligible node (pkg/controller/daemon daemon_controller.go
+    ``nodeShouldRunDaemonPod``). Daemon pods are scheduled by the default
+    scheduler pinned via required node affinity on ``metadata.name`` —
+    the reference's post-1.12 shape (util.ReplaceDaemonSetPodNodeName-
+    NodeAffinity)."""
+
+    name: str
+    namespace: str = "default"
+    selector: LabelSelector | None = None
+    template: "Pod | None" = None
 
     @property
     def key(self) -> str:

@@ -1,5 +1,6 @@
 """The API server layer: REST + watch over the store, and the remote
 store client components use across process boundaries."""
 
+from .admission import AdmissionDenied, Registry, ValidationError  # noqa: F401
 from .server import APIServer  # noqa: F401
 from .remote import RemoteStore  # noqa: F401

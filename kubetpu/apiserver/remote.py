@@ -14,6 +14,7 @@ back to ``CompactedError`` so the reflector's relist path fires.
 
 from __future__ import annotations
 
+import http.client
 import json
 import urllib.error
 import urllib.request
@@ -62,6 +63,13 @@ class RemoteStore:
                 raise CompactedError(reason) from None
             if e.code == 404:
                 raise KeyError(reason) from None
+            if e.code in (400, 422):
+                # 400: malformed request (bad selector); 422: strategy
+                # validation rejected the object (admission.py)
+                raise ValueError(reason) from None
+            if e.code == 403:
+                # validating admission hook vetoed the write
+                raise PermissionError(reason) from None
             raise RemoteStoreError(f"{e.code}: {reason}") from None
         except (urllib.error.URLError, TimeoutError, OSError) as e:
             # transient transport failure → retryable (HTTPError is a
@@ -76,8 +84,13 @@ class RemoteStore:
             return None, 0
         return scheme.decode(res["object"]), res["resourceVersion"]
 
-    def list(self, kind: str):
-        res = self._request("GET", f"/apis/{kind}")
+    def list(
+        self, kind: str,
+        label_selector: str = "", field_selector: str = "",
+    ):
+        res = self._request(
+            "GET", f"/apis/{kind}{_sel_qs('?', label_selector, field_selector)}"
+        )
         return (
             [(i["key"], scheme.decode(i["object"])) for i in res["items"]],
             res["resourceVersion"],
@@ -102,10 +115,34 @@ class RemoteStore:
         res = self._request("DELETE", f"/apis/{kind}/{key}")
         return res["resourceVersion"]
 
-    def watch(self, kind: str | None, since_rv: int) -> "RemoteWatcher":
+    def watch(
+        self, kind: str | None, since_rv: int,
+        label_selector: str = "", field_selector: str = "",
+        stream: bool = False,
+    ):
         if kind is None:
             raise RemoteStoreError("remote watch requires a kind")
-        return RemoteWatcher(self, kind, since_rv)
+        if stream:
+            return RemoteStreamWatcher(
+                self, kind, since_rv, label_selector, field_selector
+            )
+        return RemoteWatcher(
+            self, kind, since_rv,
+            label_selector=label_selector, field_selector=field_selector,
+        )
+
+
+def _sel_qs(prefix: str, label_selector: str, field_selector: str) -> str:
+    from urllib.parse import quote
+
+    parts = []
+    if label_selector:
+        parts.append(f"labelSelector={quote(label_selector)}")
+    if field_selector:
+        parts.append(f"fieldSelector={quote(field_selector)}")
+    if not parts:
+        return ""
+    return prefix + "&".join(parts)
 
 
 class RemoteWatcher:
@@ -114,10 +151,12 @@ class RemoteWatcher:
     def __init__(
         self, store: RemoteStore, kind: str, since_rv: int,
         poll_timeout_s: float = 0.0,
+        label_selector: str = "", field_selector: str = "",
     ) -> None:
         self._store = store
         self._kind = kind
         self._rv = since_rv
+        self._sel = _sel_qs("&", label_selector, field_selector)
         # 0 = non-blocking poll (loop-pump shape); raise for long-polling
         self.poll_timeout_s = poll_timeout_s
 
@@ -132,7 +171,7 @@ class RemoteWatcher:
         res = self._store._request(
             "GET",
             f"/apis/{self._kind}?watch=1&resourceVersion={self._rv}"
-            f"&timeoutSeconds={wait}",
+            f"&timeoutSeconds={wait}{self._sel}",
         )
         self._rv = res["resourceVersion"]
         return [
@@ -143,3 +182,141 @@ class RemoteWatcher:
             )
             for e in res["events"]
         ]
+
+
+class RemoteStreamWatcher:
+    """STREAMING watcher: one chunked ndjson connection held open by the
+    server (?watch=1&stream=1), a blocking reader thread decoding events as
+    lines arrive (a non-blocking line read over a buffered socket could
+    tear a line) — the reference's watch-stream shape. ``poll()`` stays
+    non-blocking (drains the decoded queue), so the Reflector pump loop
+    runs unchanged; a dropped/expired connection re-opens transparently
+    from the cursor on the next poll; an in-stream 410 raises
+    CompactedError (relist)."""
+
+    def __init__(
+        self, store: RemoteStore, kind: str, since_rv: int,
+        label_selector: str = "", field_selector: str = "",
+        stream_timeout_s: float = 120.0,
+    ) -> None:
+        import collections
+        import threading
+
+        self._store = store
+        self._kind = kind
+        self._rv = since_rv
+        self._sel = _sel_qs("&", label_selector, field_selector)
+        self._stream_timeout_s = stream_timeout_s
+        self._lock = threading.Lock()
+        self._queue: collections.deque = collections.deque()
+        self._thread: threading.Thread | None = None
+        self._sock = None
+        self._closed = False
+        self.reconnects = 0
+
+    @property
+    def resource_version(self) -> int:
+        return self._rv
+
+    def _reader(self, start_rv: int) -> None:
+        """One connection's lifetime: connect, decode lines, enqueue.
+        Ends on EOF/error; poll() restarts it from the current cursor."""
+        from urllib.parse import urlsplit
+
+        conn = resp = None
+        try:
+            u = urlsplit(self._store.base)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port,
+                timeout=self._stream_timeout_s + self._store.timeout_s,
+            )
+            conn.request(
+                "GET",
+                f"/apis/{self._kind}?watch=1&stream=1"
+                f"&resourceVersion={start_rv}"
+                f"&timeoutSeconds={self._stream_timeout_s}{self._sel}",
+            )
+            resp = conn.getresponse()
+            self._sock = conn.sock   # close() shutdowns this to wake us
+            if resp.status != 200:
+                body = resp.read()
+                self._queue.append((
+                    "error",
+                    CompactedError(body.decode(errors="replace"))
+                    if resp.status == 410
+                    else RemoteStoreError(f"{resp.status}: {body[:200]!r}"),
+                ))
+                return
+            for raw in resp:
+                line = raw.strip()
+                if not line:
+                    continue
+                try:
+                    msg = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if msg.get("code") == 410:
+                    self._queue.append(
+                        ("error", CompactedError(msg.get("error", "compacted")))
+                    )
+                    return
+                self._queue.append(("event", msg))
+        except (ConnectionError, TimeoutError, OSError,
+                http.client.HTTPException,
+                AttributeError, ValueError):
+            # stream died (or close() tore the socket out from under a
+            # buffered read): next poll reconnects from the cursor
+            pass
+        finally:
+            self._sock = None
+            sock = conn.sock if conn is not None else None
+            if sock is not None:
+                try:
+                    sock.close()
+                except OSError:
+                    pass
+
+    def poll(self) -> list[WatchEvent]:
+        import threading
+
+        out: list[WatchEvent] = []
+        while self._queue:
+            tag, payload = self._queue.popleft()
+            if tag == "error":
+                raise payload
+            self._rv = payload["resourceVersion"]
+            out.append(WatchEvent(
+                type=payload["type"], kind=self._kind, key=payload["key"],
+                obj=scheme.decode(payload["object"]),
+                resource_version=payload["resourceVersion"],
+            ))
+        if not self._closed and (
+            self._thread is None or not self._thread.is_alive()
+        ):
+            with self._lock:
+                if self._thread is None or not self._thread.is_alive():
+                    self.reconnects += 1
+                    self._thread = threading.Thread(
+                        target=self._reader, args=(self._rv,), daemon=True,
+                    )
+                    self._thread.start()
+        return out
+
+    def close(self) -> None:
+        """Tear the stream down NOW: a plain conn.close() would try to
+        drain the unfinished chunked body (blocking up to the stream
+        deadline) and would not wake the reader's blocked recv — a socket
+        shutdown does both."""
+        import socket as _socket
+
+        self._closed = True
+        sock = self._sock
+        if sock is not None:
+            try:
+                sock.shutdown(_socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                sock.close()
+            except OSError:
+                pass

@@ -11,6 +11,18 @@ envelope here:
                                         drain events AFTER N (long-poll up to
                                         ``timeoutSeconds``); 410 Gone when N
                                         predates the event buffer (relist)
+    GET    /apis/<kind>?watch=1&stream=1&resourceVersion=N
+                                        STREAMING watch: chunked ndjson, one
+                                        event per line, the connection held
+                                        open up to ``timeoutSeconds`` —
+                                        the reference's watch stream shape
+                                        (cacher.go fan-out); long-poll above
+                                        stays as the fallback
+    both list and watch accept ``labelSelector`` / ``fieldSelector``
+    (``k=v,k2!=v2``) applied SERVER-side (endpoints/installer.go:288 list
+    options; spec.nodeName is how a kubelet watches only its own pods) —
+    a non-matching ADDED/MODIFIED is delivered as a DELETED tombstone with
+    no object body
     GET    /apis/<kind>/<key…>          get → {"object": …, "resourceVersion": N}
     POST   /apis/<kind>/<key…>          create (409 on exists)
     PUT    /apis/<kind>/<key…>[?resourceVersion=N]
@@ -34,12 +46,14 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..api import scheme
 from ..store.memstore import CompactedError, ConflictError, MemStore
+from .admission import AdmissionDenied, Registry, ValidationError
 
 PREFIX = "/apis/"
 
 
 class _Handler(BaseHTTPRequestHandler):
-    store: MemStore   # bound by the server factory
+    store: MemStore     # bound by the server factory
+    registry: Registry  # admission + validation chain (bound by the factory)
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:
@@ -82,9 +96,16 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             if key is None and q.get("watch"):
-                self._watch(kind, q)
+                if q.get("stream"):
+                    self._watch_stream(kind, q)
+                else:
+                    self._watch(kind, q)
             elif key is None:
-                items, rv = self.store.list(kind)
+                items, rv = self.store.list(
+                    kind,
+                    label_selector=q.get("labelSelector", ""),
+                    field_selector=q.get("fieldSelector", ""),
+                )
                 self._reply({
                     "items": [
                         {"key": k, "object": scheme.encode(o)}
@@ -100,12 +121,46 @@ class _Handler(BaseHTTPRequestHandler):
                     self._reply({
                         "object": scheme.encode(obj), "resourceVersion": rv,
                     })
+        except ValueError as e:
+            # malformed selector / resourceVersion: the CLIENT's error —
+            # a retry-on-5xx loop must not hammer a permanently-bad request
+            self._error(400, str(e))
         except Exception as e:
             self._error(500, f"{type(e).__name__}: {e}")
+
+    @staticmethod
+    def _selector_view(q: dict):
+        """Per-watch SelectorView, or None without selectors. The streaming
+        watch holds ONE view for the connection's lifetime (repeat foreign
+        events are dropped); a long-poll request gets a fresh view each
+        time (stateless protocol — degraded to one tombstone per foreign
+        key per poll, still correct)."""
+        from ..store.memstore import SelectorView
+
+        ls = q.get("labelSelector", "")
+        fs = q.get("fieldSelector", "")
+        return SelectorView(ls, fs) if (ls or fs) else None
+
+    @staticmethod
+    def _event_json(e, scoped: bool) -> dict:
+        if scoped and e.type == "DELETED":
+            # selector-scoped stream: never ship a body on DELETED (the
+            # informer deletes by key; a tombstoned object may not even
+            # match the selector)
+            return {
+                "type": "DELETED", "key": e.key, "object": None,
+                "resourceVersion": e.resource_version,
+            }
+        return {
+            "type": e.type, "key": e.key,
+            "object": scheme.encode(e.obj),
+            "resourceVersion": e.resource_version,
+        }
 
     def _watch(self, kind: str, q: dict) -> None:
         rv = int(q.get("resourceVersion", 0))
         timeout = min(float(q.get("timeoutSeconds", 10)), 60.0)
+        view = self._selector_view(q)
         try:
             events, cursor = self.store._events_since(kind, rv)
             if not events and timeout > 0:
@@ -115,17 +170,67 @@ class _Handler(BaseHTTPRequestHandler):
             # the watch cache's "too old resource version" → HTTP 410
             self._error(410, str(e))
             return
+        if view is not None:
+            events = view.filter(events)
         self._reply({
             "events": [
-                {
-                    "type": e.type, "key": e.key,
-                    "object": scheme.encode(e.obj),
-                    "resourceVersion": e.resource_version,
-                }
-                for e in events
+                self._event_json(e, view is not None) for e in events
             ],
             "resourceVersion": cursor,
         })
+
+    def _watch_stream(self, kind: str, q: dict) -> None:
+        """Chunked ndjson stream: events written as they happen, connection
+        held open up to ``timeoutSeconds`` (capped) — the watch-stream form
+        of the same cursor protocol. A compaction mid-stream emits an error
+        line with code 410 and ends the stream (client relists)."""
+        import time as _time
+
+        rv = int(q.get("resourceVersion", 0))
+        timeout = min(float(q.get("timeoutSeconds", 30)), 300.0)
+        try:
+            view = self._selector_view(q)
+        except ValueError as e:
+            self._error(400, str(e))
+            return
+        deadline = _time.monotonic() + timeout
+        self.send_response(200)
+        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.end_headers()
+
+        def chunk(line: dict) -> bool:
+            data = (json.dumps(line) + "\n").encode()
+            try:
+                self.wfile.write(f"{len(data):x}\r\n".encode())
+                self.wfile.write(data + b"\r\n")
+                self.wfile.flush()
+                return True
+            except (BrokenPipeError, ConnectionResetError, OSError):
+                return False
+        try:
+            while True:
+                try:
+                    events, cursor = self.store._events_since(kind, rv)
+                except CompactedError as e:
+                    chunk({"error": str(e), "code": 410})
+                    break
+                if view is not None:
+                    events = view.filter(events)
+                for e in events:
+                    if not chunk(self._event_json(e, view is not None)):
+                        return   # client hung up: no terminator possible
+                rv = cursor
+                remaining = deadline - _time.monotonic()
+                if remaining <= 0 or getattr(self.server, "closing", False):
+                    break
+                self.store.wait_for(rv, timeout=min(remaining, 1.0))
+        finally:
+            try:
+                self.wfile.write(b"0\r\n\r\n")   # chunked terminator
+                self.wfile.flush()
+            except OSError:
+                pass
 
     def do_POST(self) -> None:  # noqa: N802
         kind, key, _ = self._route()
@@ -134,10 +239,18 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             obj = scheme.decode(self._read_body())
+            # decode → admission (mutating) → validate → admission
+            # (validating) → storage — the reference write path
+            # (registry/store.go:514 Create's strategy run)
+            obj = self.registry.admit(kind, key, obj, verb="create")
             rv = self.store.create(kind, key, obj)
             self._reply({"resourceVersion": rv}, status=201)
         except ConflictError as e:
             self._error(409, str(e))
+        except ValidationError as e:
+            self._error(422, str(e))
+        except AdmissionDenied as e:
+            self._error(403, str(e))
         except scheme.SchemeError as e:
             self._error(400, str(e))
         except Exception as e:
@@ -150,6 +263,8 @@ class _Handler(BaseHTTPRequestHandler):
             return
         try:
             obj = scheme.decode(self._read_body())
+            old, _old_rv = self.store.get(kind, key)
+            obj = self.registry.admit(kind, key, obj, old=old, verb="update")
             expect = (
                 int(q["resourceVersion"]) if "resourceVersion" in q else None
             )
@@ -157,6 +272,10 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply({"resourceVersion": rv})
         except ConflictError as e:
             self._error(409, str(e))
+        except ValidationError as e:
+            self._error(422, str(e))
+        except AdmissionDenied as e:
+            self._error(403, str(e))
         except scheme.SchemeError as e:
             self._error(400, str(e))
         except Exception as e:
@@ -182,10 +301,23 @@ class APIServer:
     def __init__(
         self, store: MemStore | None = None,
         host: str = "127.0.0.1", port: int = 0,
+        registry: Registry | None = None,
     ) -> None:
         self.store = store if store is not None else MemStore()
-        handler = type("BoundHandler", (_Handler,), {"store": self.store})
-        self._httpd = ThreadingHTTPServer((host, port), handler)
+        self.registry = registry if registry is not None else Registry()
+        handler = type("BoundHandler", (_Handler,), {
+            "store": self.store, "registry": self.registry,
+        })
+
+        class _Server(ThreadingHTTPServer):
+            # streaming watch handlers hold connections open (bounded by
+            # their own deadlines + the `closing` flag, checked every ≤1 s);
+            # close() must not block on them
+            daemon_threads = True
+            block_on_close = False
+            closing = False
+
+        self._httpd = _Server((host, port), handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
         )
@@ -200,6 +332,7 @@ class APIServer:
         return self
 
     def close(self) -> None:
+        self._httpd.closing = True
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)

@@ -130,6 +130,7 @@ def _kind_buckets() -> dict:
     (one source of truth with the informers/controllers — a literal copy
     here could silently drift into a bucket nothing watches)."""
     from .client import informers as I
+    from .controllers.daemonset import DAEMON_SETS
     from .controllers.deployment import DEPLOYMENTS
     from .controllers.job import JOBS
     from .controllers.replicaset import REPLICA_SETS
@@ -140,7 +141,7 @@ def _kind_buckets() -> dict:
         "ResourceClaimTemplate": RESOURCE_CLAIM_TEMPLATES,
         "Node": I.NODES, "Pod": I.PODS, "ReplicaSet": REPLICA_SETS,
         "Deployment": DEPLOYMENTS, "Job": JOBS,
-        "StatefulSet": STATEFUL_SETS,
+        "StatefulSet": STATEFUL_SETS, "DaemonSet": DAEMON_SETS,
         "Service": I.SERVICES, "Namespace": I.NAMESPACES,
         "PersistentVolume": I.PERSISTENT_VOLUMES,
         "PersistentVolumeClaim": I.PERSISTENT_VOLUME_CLAIMS,
@@ -250,8 +251,10 @@ def cmd_controller_manager(args) -> int:
     store (cmd/kube-controller-manager controllermanager.go shape)."""
     from .apiserver import RemoteStore
     from .controllers import (
+        DaemonSetController,
         DeploymentController,
         DisruptionController,
+        GarbageCollector,
         JobController,
         ResourceClaimController,
         StatefulSetController,
@@ -265,6 +268,7 @@ def cmd_controller_manager(args) -> int:
     ctrls = [
         DeploymentController(store),
         JobController(store),
+        DaemonSetController(store),
         ResourceClaimController(store),
         StatefulSetController(store),
         ReplicaSetController(store),
@@ -272,6 +276,7 @@ def cmd_controller_manager(args) -> int:
         TaintEvictionController(store),
         PodGCController(store, terminated_threshold=args.terminated_pod_gc),
         DisruptionController(store),
+        GarbageCollector(store),
     ]
     for c in ctrls:
         _retry_start(c.start, type(c).__name__)

@@ -110,19 +110,48 @@ class SharedInformer:
 
 
 class Reflector:
-    """ListAndWatch over one store bucket into a SharedInformer."""
+    """ListAndWatch over one store bucket into a SharedInformer.
 
-    def __init__(self, store: MemStore, informer: SharedInformer) -> None:
+    ``label_selector``/``field_selector`` scope BOTH the list and the watch
+    server-side (reflector.go ListAndWatch's options — e.g. the kubelet's
+    ``spec.nodeName=<node>`` pod watch); ``stream=True`` uses the streaming
+    watch where the store supports it (RemoteStore), falling back to the
+    pull watcher otherwise."""
+
+    def __init__(
+        self, store: MemStore, informer: SharedInformer,
+        label_selector: str = "", field_selector: str = "",
+        stream: bool = False,
+    ) -> None:
         self._store = store
         self.informer = informer
+        self._label_selector = label_selector
+        self._field_selector = field_selector
+        self._stream = stream
         self._watcher = None
         self.relists = 0    # metrics: compaction-forced relists
 
     def sync(self) -> None:
         """Initial (or compaction-forced) list + watch-from-revision."""
-        items, rv = self._store.list(self.informer.kind)
+        old = self._watcher
+        if old is not None and hasattr(old, "close"):
+            old.close()
+        kwargs = {}
+        if self._label_selector:
+            kwargs["label_selector"] = self._label_selector
+        if self._field_selector:
+            kwargs["field_selector"] = self._field_selector
+        items, rv = self._store.list(self.informer.kind, **kwargs)
         self.informer._replace(items)
-        self._watcher = self._store.watch(self.informer.kind, rv)
+        if self._stream:
+            try:
+                self._watcher = self._store.watch(
+                    self.informer.kind, rv, stream=True, **kwargs
+                )
+                return
+            except TypeError:
+                pass   # store without a streaming watch: pull form below
+        self._watcher = self._store.watch(self.informer.kind, rv, **kwargs)
 
     def step(self) -> int:
         """Drain available watch events; relist on compaction. Returns the

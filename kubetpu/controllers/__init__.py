@@ -1,7 +1,9 @@
 """Controllers: informer + reconcile loops over the store (pkg/controller)."""
 
+from .daemonset import DAEMON_SETS, DaemonSetController  # noqa: F401
 from .deployment import DEPLOYMENTS, DeploymentController  # noqa: F401
 from .disruption import DisruptionController  # noqa: F401
+from .garbagecollector import GarbageCollector  # noqa: F401
 from .job import JOBS, JobController  # noqa: F401
 from .nodelifecycle import (  # noqa: F401
     NodeHeartbeat,

@@ -1,0 +1,225 @@
+"""DaemonSet controller — one pod per eligible node.
+
+Reference: ``pkg/controller/daemon/daemon_controller.go`` (``syncDaemonSet``
+→ ``podsShouldBeOnNode``): for every node, decide whether the DaemonSet
+should run a daemon pod there (``nodeShouldRunDaemonPod`` — the pod
+template's nodeSelector/nodeAffinity must match and the node's
+NoSchedule/NoExecute taints must be tolerated), create the missing pods
+and delete the ones on nodes that should no longer run them.
+
+Two reference behaviors carried over exactly:
+- daemon pods are NOT placed by this controller: they go through the
+  default scheduler pinned with required node affinity on
+  ``metadata.name`` (util.ReplaceDaemonSetPodNodeNameNodeAffinity — the
+  post-1.12 ScheduleDaemonSetPods shape, which is also what the
+  scheduler_perf SchedulingDaemonset workload exercises);
+- the standard daemon tolerations are added to every daemon pod
+  (AddOrUpdateDaemonPodTolerations): unschedulable + disk/memory-pressure
+  NoSchedule, not-ready/unreachable NoExecute — a cordoned or pressured
+  node still runs its daemons.
+
+Queue-driven (daemon_controller.go:153 queue wiring): DS events enqueue
+the DS; a pod event enqueues its owning DS; a node event enqueues EVERY
+DS (addNode/updateNode — eligibility may have flipped anywhere).
+
+Adoption: selector-matching orphans named ``<ds>-<node>`` are claimed
+(controller_ref_manager), same as the other workload controllers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from ..api.selectors import (
+    find_untolerated_taint,
+    label_selector_matches,
+    node_selector_matches,
+)
+from ..client.informers import NODES, PODS
+from ..store.memstore import ConflictError, MemStore
+from .workqueue import OwnerIndex, QueueController
+
+DAEMON_SETS = "daemonsets"
+
+# AddOrUpdateDaemonPodTolerations (pkg/controller/daemon/util/daemonset_util.go)
+DAEMON_TOLERATIONS = (
+    t.Toleration(key="node.kubernetes.io/not-ready",
+                 operator=t.TolerationOperator.EXISTS,
+                 effect=t.TaintEffect.NO_EXECUTE),
+    t.Toleration(key="node.kubernetes.io/unreachable",
+                 operator=t.TolerationOperator.EXISTS,
+                 effect=t.TaintEffect.NO_EXECUTE),
+    t.Toleration(key="node.kubernetes.io/disk-pressure",
+                 operator=t.TolerationOperator.EXISTS,
+                 effect=t.TaintEffect.NO_SCHEDULE),
+    t.Toleration(key="node.kubernetes.io/memory-pressure",
+                 operator=t.TolerationOperator.EXISTS,
+                 effect=t.TaintEffect.NO_SCHEDULE),
+    t.Toleration(key="node.kubernetes.io/pid-pressure",
+                 operator=t.TolerationOperator.EXISTS,
+                 effect=t.TaintEffect.NO_SCHEDULE),
+    t.Toleration(key="node.kubernetes.io/unschedulable",
+                 operator=t.TolerationOperator.EXISTS,
+                 effect=t.TaintEffect.NO_SCHEDULE),
+)
+
+
+def _owner_ref(ds: t.DaemonSet) -> str:
+    return f"DaemonSet/{ds.namespace}/{ds.name}"
+
+
+def _pin_affinity(pod: t.Pod, node_name: str) -> t.Affinity:
+    """Required node affinity on metadata.name (ReplaceDaemonSetPodNodeName-
+    NodeAffinity): REPLACES any required node affinity in the template —
+    the template's own required terms were already evaluated by
+    ``node_should_run``; preferred terms survive."""
+    term = t.NodeSelectorTerm(match_fields=(
+        t.Requirement("metadata.name", t.Operator.IN, (node_name,)),
+    ))
+    base = pod.affinity or t.Affinity()
+    na = base.node_affinity or t.NodeAffinity()
+    return dataclasses.replace(
+        base,
+        node_affinity=dataclasses.replace(
+            na, required=t.NodeSelector(terms=(term,)),
+        ),
+    )
+
+
+def node_should_run(ds: t.DaemonSet, node: t.Node) -> bool:
+    """nodeShouldRunDaemonPod: template nodeSelector + required node
+    affinity match, and every NoSchedule/NoExecute taint is tolerated by
+    the template's tolerations + the standard daemon set."""
+    tpl = ds.template
+    if tpl is None:
+        return False
+    labels = node.labels_dict()
+    for k, v in tpl.node_selector:
+        if labels.get(k) != v:
+            return False
+    na = tpl.affinity.node_affinity if tpl.affinity else None
+    if na is not None and na.required is not None:
+        if not node_selector_matches(na.required, labels, node.name):
+            return False
+    tols = tuple(tpl.tolerations) + DAEMON_TOLERATIONS
+    return find_untolerated_taint(node.taints, tols) is None
+
+
+class DaemonSetController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, **({"clock": clock} if clock else {}))
+        self._ds = self.watch(DAEMON_SETS, lambda ds: [ds.key])
+        self._nodes = self.watch(NODES, self._node_keys)
+        self._pods = self.watch(PODS, self._pod_keys)
+        self._owned = OwnerIndex(self._pods)
+        self.creates = 0
+        self.deletes = 0
+
+    def _node_keys(self, node: t.Node) -> list[str]:
+        return list(self._ds.store.keys())
+
+    def _pod_keys(self, pod: t.Pod) -> list[str]:
+        if pod.owner:
+            kind, _, rest = pod.owner.partition("/")
+            return [rest] if kind == "DaemonSet" else []
+        return [
+            key for key, ds in self._ds.store.items()
+            if ds.namespace == pod.namespace
+            and ds.selector is not None
+            and label_selector_matches(ds.selector, pod.labels_dict())
+        ]
+
+    # ----------------------------------------------------------- reconcile
+    @staticmethod
+    def _target_node(pod: t.Pod) -> str:
+        """The node a daemon pod is pinned to: the metadata.name affinity
+        term (pre-bind), else where it actually landed."""
+        na = pod.affinity.node_affinity if pod.affinity else None
+        if na is not None and na.required is not None:
+            for term in na.required.terms:
+                for req in term.match_fields:
+                    if req.key == "metadata.name" and len(req.values) == 1:
+                        return req.values[0]
+        return pod.node_name
+
+    def sync(self, key: str) -> None:
+        ds = self._ds.store.get(key)
+        if ds is None:
+            return
+        ref = _owner_ref(ds)
+        by_node: dict[str, list[tuple[str, t.Pod]]] = {}
+        # owner index: this DS's pods + orphans — O(owned), not O(all pods)
+        for pkey in self._owned.get(ref, ""):
+            p = self._pods.store.get(pkey)
+            if p is None:
+                continue
+            if p.namespace != ds.namespace:
+                continue
+            if p.owner != ref:
+                if p.owner or ds.selector is None or not (
+                    label_selector_matches(ds.selector, p.labels_dict())
+                ):
+                    continue
+                # adopt the selector-matching orphan through the live object
+                live, rv = self.store.get(PODS, pkey)
+                if live is None:
+                    continue
+                try:
+                    p = dataclasses.replace(live, owner=ref)
+                    self.store.update(PODS, pkey, p, expect_rv=rv)
+                except ConflictError:
+                    pass
+            by_node.setdefault(self._target_node(p), []).append((pkey, p))
+
+        eligible = {
+            n.name for n in self._nodes.store.values()
+            if node_should_run(ds, n)
+        }
+        # delete FIRST — terminal pods, ineligible nodes (podsShouldBeOnNode's
+        # podsToDelete), per-node duplicates — so a same-named replacement
+        # created below does not collide with the vacating object
+        survivors: dict[str, int] = {}
+        for node_name, pods in sorted(by_node.items()):
+            live = [
+                kp for kp in pods if kp[1].phase not in ("Succeeded", "Failed")
+            ]
+            doomed = [kp for kp in pods if kp not in live]   # terminal
+            if node_name not in eligible:
+                doomed += live
+            elif len(live) > 1:
+                doomed += sorted(live)[1:]    # keep one deterministic pod
+            survivors[node_name] = len(live) - sum(
+                1 for kp in doomed if kp in live
+            )
+            for pkey, _p in doomed:
+                try:
+                    self.store.delete(PODS, pkey)
+                except KeyError:
+                    continue
+                self.deletes += 1
+        # create where missing (a terminal daemon pod is replaced in the
+        # same sync — its slot was just vacated)
+        for node_name in sorted(eligible):
+            if survivors.get(node_name, 0) == 0:
+                self._create(ds, node_name)
+
+    def _create(self, ds: t.DaemonSet, node_name: str) -> None:
+        name = f"{ds.name}-{node_name}"
+        tpl = ds.template
+        pod = dataclasses.replace(
+            tpl,
+            name=name,
+            namespace=ds.namespace,
+            uid=f"{ds.namespace}/{name}",
+            owner=_owner_ref(ds),
+            node_name="",
+            phase="Pending",
+            affinity=_pin_affinity(tpl, node_name),
+            tolerations=tuple(tpl.tolerations) + DAEMON_TOLERATIONS,
+        )
+        try:
+            self.store.create(PODS, f"{ds.namespace}/{name}", pod)
+        except ConflictError:
+            return
+        self.creates += 1

@@ -10,6 +10,11 @@ pods, so a rollout never drops capacity below ``replicas − maxUnavailable``.
 
 The ReplicaSetController remains the pod-level actor: this controller only
 writes ReplicaSet objects (the reference's two-controller split).
+
+Queue-driven (deployment_controller.go:156 queue wiring): Deployment events
+enqueue the Deployment; RS events enqueue the owning Deployment; pod events
+resolve pod → owning RS → owning Deployment (getDeploymentsForPod) — only
+dirty Deployments are synced.
 """
 
 from __future__ import annotations
@@ -21,9 +26,9 @@ import json
 from ..api import scheme
 from ..api import types as t
 from ..client.informers import PODS
-from ..client.reflector import Reflector, SharedInformer
 from ..store.memstore import ConflictError, MemStore
 from .replicaset import REPLICA_SETS
+from .workqueue import QueueController
 
 DEPLOYMENTS = "deployments"
 
@@ -39,35 +44,37 @@ def _owner_ref(d: t.Deployment) -> str:
     return f"Deployment/{d.namespace}/{d.name}"
 
 
-class DeploymentController:
-    def __init__(self, store: MemStore) -> None:
-        self.store = store
-        self._deps = SharedInformer(DEPLOYMENTS)
-        self._rs = SharedInformer(REPLICA_SETS)
-        self._pods = SharedInformer(PODS)
-        self._r = [
-            Reflector(store, self._deps),
-            Reflector(store, self._rs),
-            Reflector(store, self._pods),
-        ]
+class DeploymentController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, **({"clock": clock} if clock else {}))
+        self._deps = self.watch(DEPLOYMENTS, lambda d: [d.key])
+        self._rs = self.watch(REPLICA_SETS, self._rs_keys)
+        self._pods = self.watch(PODS, self._pod_keys)
         self.rollouts = 0   # metrics: RS writes
 
-    def start(self) -> None:
-        for r in self._r:
-            r.sync()
+    def _rs_keys(self, rs: t.ReplicaSet) -> list[str]:
+        if rs.owner:
+            kind, _, rest = rs.owner.partition("/")
+            if kind == "Deployment":
+                return [rest]
+        return []
 
-    def pump(self) -> int:
-        return sum(r.step() for r in self._r)
+    def _pod_keys(self, pod: t.Pod) -> list[str]:
+        """pod → owning RS → owning Deployment (getDeploymentsForPod —
+        availability changes gate the rolling step)."""
+        if pod.owner:
+            kind, _, rest = pod.owner.partition("/")
+            if kind == "ReplicaSet":
+                rs = self._rs.store.get(rest)
+                if rs is not None:
+                    return self._rs_keys(rs)
+        return []
 
     # ----------------------------------------------------------- reconcile
-    def step(self) -> int:
-        self.pump()
-        wrote = 0
-        for key, dep in list(self._deps.store.items()):
-            if dep.template is None:
-                continue
-            wrote += self._sync(dep)
-        return wrote
+    def sync(self, key: str) -> None:
+        dep = self._deps.store.get(key)
+        if dep is not None and dep.template is not None:
+            self._sync(dep)
 
     def _owned_rs(self, dep: t.Deployment) -> dict[str, t.ReplicaSet]:
         ref = _owner_ref(dep)

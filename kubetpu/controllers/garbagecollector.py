@@ -1,0 +1,134 @@
+"""Garbage collector — ownerRef graph + cascading deletion.
+
+Reference: ``pkg/controller/garbagecollector/garbagecollector.go``: a
+GraphBuilder watches every resource, maintains the owner→dependents graph
+(``graph_builder.go``), and ``attemptToDeleteItem`` removes dependents
+whose owners are gone (background cascading deletion — the default
+deletion propagation). Here ownership is the framework's ``owner`` slice
+("Kind/<ns>/<name>"), and the watched universe is every owner-bearing
+kind plus every kind that can BE an owner:
+
+    Deployment ─owns→ ReplicaSet ─owns→ Pod ─owns→ ResourceClaim
+    Job / StatefulSet / DaemonSet ─own→ Pod
+
+Deleting an owner cascades level by level: each deletion fires watch
+events that enqueue the next level's dependents. A dependent observed
+with a dangling owner reference at any time (including a dependent
+created after its owner died) is deleted.
+
+Queue-driven: object events enqueue the object itself (owner-existence
+check); a DELETE event additionally enqueues every known dependent of
+the deleted object (the graph's uid→dependents edge). Before deleting,
+the owner's absence is re-confirmed against the LIVE store — the graph
+is informer-lagged and the reference double-checks with the API server
+too (garbagecollector.go attemptToDeleteItem's live lookup).
+
+Orphan/foreground propagation policies are not modeled (background only
+— the framework's delete is immediate); adoption lives in the workload
+controllers, as in the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from ..client.informers import PODS, RESOURCE_CLAIMS
+from ..store.memstore import MemStore
+from .daemonset import DAEMON_SETS
+from .deployment import DEPLOYMENTS
+from .job import JOBS
+from .replicaset import REPLICA_SETS
+from .statefulset import STATEFUL_SETS
+from .workqueue import QueueController
+
+# owner-ref kind name -> store bucket (the GC's watched universe)
+KIND_BUCKETS: dict[str, str] = {
+    "Deployment": DEPLOYMENTS,
+    "ReplicaSet": REPLICA_SETS,
+    "Job": JOBS,
+    "StatefulSet": STATEFUL_SETS,
+    "DaemonSet": DAEMON_SETS,
+    "Pod": PODS,
+    "ResourceClaim": RESOURCE_CLAIMS,
+}
+_BUCKET_KINDS = {v: k for k, v in KIND_BUCKETS.items()}
+
+
+def _obj_key(obj: Any) -> str:
+    key = getattr(obj, "key", None)
+    if key is not None:
+        return key
+    return f"{obj.namespace}/{obj.name}"
+
+
+class GarbageCollector(QueueController):
+    """Queue keys are ``(bucket, key)`` pairs — one dependent to check."""
+
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, **({"clock": clock} if clock else {}))
+        # owner ref ("Kind/<ns>/<name>") -> {(bucket, key)} dependents
+        self._dependents: dict[str, set[tuple[str, str]]] = {}
+        # (bucket, key) -> owner ref currently indexed for it
+        self._owner_of: dict[tuple[str, str], str] = {}
+        self.deletes = 0
+        for bucket in KIND_BUCKETS.values():
+            self.watch(
+                bucket,
+                (lambda b: lambda obj: self._observe(b, obj))(bucket),
+                tombstone_fn=(
+                    lambda b: lambda obj: self._observe_delete(b, obj)
+                )(bucket),
+            )
+
+    # ------------------------------------------------------------- graph
+    def _observe(self, bucket: str, obj: Any) -> list[tuple[str, str]]:
+        """Index the object's owner edge; dirty the object itself so its
+        owner's existence is (re)checked."""
+        ident = (bucket, _obj_key(obj))
+        owner = getattr(obj, "owner", "") or ""
+        prev = self._owner_of.get(ident)
+        if prev is not None and prev != owner:
+            self._dependents.get(prev, set()).discard(ident)
+        if owner:
+            self._owner_of[ident] = owner
+            self._dependents.setdefault(owner, set()).add(ident)
+            return [ident]
+        self._owner_of.pop(ident, None)
+        return []
+
+    def _observe_delete(self, bucket: str, obj: Any) -> list[tuple[str, str]]:
+        """Un-index the deleted object and dirty its dependents — the
+        cascade's next level."""
+        key = _obj_key(obj)
+        ident = (bucket, key)
+        owner = self._owner_of.pop(ident, None)
+        if owner is not None:
+            self._dependents.get(owner, set()).discard(ident)
+        ref = f"{_BUCKET_KINDS[bucket]}/{key}"
+        return sorted(self._dependents.get(ref, ()))
+
+    # -------------------------------------------------------------- sync
+    def sync(self, ident: tuple[str, str]) -> None:
+        bucket, key = ident
+        obj = self._informers[bucket].store.get(key)
+        if obj is None:
+            return
+        owner = getattr(obj, "owner", "") or ""
+        if not owner:
+            return
+        kind, _, owner_key = owner.partition("/")
+        owner_bucket = KIND_BUCKETS.get(kind)
+        if owner_bucket is None:
+            return    # unknown owner kind: never collected (conservative)
+        if self._informers[owner_bucket].store.get(owner_key) is not None:
+            return    # owner alive
+        # informer-lag guard: confirm against the live store before the
+        # irreversible delete (the reference's apiserver double-check)
+        live, _rv = self.store.get(owner_bucket, owner_key)
+        if live is not None:
+            return
+        try:
+            self.store.delete(bucket, key)
+        except KeyError:
+            return
+        self.deletes += 1

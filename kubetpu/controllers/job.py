@@ -15,6 +15,10 @@ commit and the deletes cannot double-count — the recorded keys are skipped
 on recount — and a crash before the commit merely recounts. Pods are
 stamped ``terminates=True`` (the restartPolicy: Never shape) so the node
 agent transitions them Running → Succeeded.
+
+Queue-driven (job_controller.go:186 queue wiring): Job events enqueue the
+Job; pod events enqueue the owning Job — one ``sync`` reconciles ONE Job
+against its owned pods, and only dirty Jobs run.
 """
 
 from __future__ import annotations
@@ -23,46 +27,73 @@ import dataclasses
 
 from ..api import types as t
 from ..client.informers import PODS
-from ..client.reflector import Reflector, SharedInformer
 from ..store.memstore import ConflictError, MemStore
+from .workqueue import OwnerIndex, QueueController
 
 JOBS = "jobs"
+
+# batch.JobTrackingFinalizer: stamped on every job pod so a deletion can
+# never outrun the accounting — the pod object survives (soft-deleted)
+# until THIS controller has counted it and removes the finalizer
+JOB_TRACKING = "batch.kubernetes.io/job-tracking"
 
 
 def _owner_ref(job: t.Job) -> str:
     return f"Job/{job.namespace}/{job.name}"
 
 
-class JobController:
-    def __init__(self, store: MemStore) -> None:
-        self.store = store
-        self._jobs = SharedInformer(JOBS)
-        self._pods = SharedInformer(PODS)
-        self._r = [Reflector(store, self._jobs), Reflector(store, self._pods)]
+class JobController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, **({"clock": clock} if clock else {}))
+        self._jobs = self.watch(JOBS, lambda j: [j.key])
+        self._pods = self.watch(PODS, self._pod_keys)
+        self._owned = OwnerIndex(self._pods)
         self._seq: dict[str, int] = {}
         self.creates = 0
 
-    def start(self) -> None:
-        for r in self._r:
-            r.sync()
+    def _pod_keys(self, pod: t.Pod) -> list[str]:
+        if pod.owner:
+            kind, _, rest = pod.owner.partition("/")
+            if kind == "Job":
+                return [rest]
+        return []
 
-    def pump(self) -> int:
-        return sum(r.step() for r in self._r)
+    def sync(self, key: str) -> None:
+        job = self._jobs.store.get(key)
+        if job is None:
+            # the Job is gone: release its pods' tracking finalizers so the
+            # GC cascade (or a direct delete) can complete — the
+            # reference's syncOrphanPod (job_controller.go): an orphan must
+            # never be pinned by an accounting that will never happen
+            self._release_orphans(f"Job/{key}")
+            return
+        if job.template is None:
+            return
+        owned = [
+            (k, self._pods.store[k])
+            for k in self._owned.get(_owner_ref(job))
+            if k in self._pods.store
+        ]
+        self._sync(job, owned)
 
-    def step(self) -> int:
-        self.pump()
-        # one owner -> owned-pods index for the whole pass (O(pods), not
-        # O(jobs × pods))
-        by_owner: dict[str, list[tuple[str, t.Job]]] = {}
-        for key, p in self._pods.store.items():
-            if p.owner:
-                by_owner.setdefault(p.owner, []).append((key, p))
-        wrote = 0
-        for key, job in list(self._jobs.store.items()):
-            if job.template is None:
+    def _release_orphans(self, ref: str) -> None:
+        for k in self._owned.get(ref):
+            live, rv = self.store.get(PODS, k)
+            if live is None or JOB_TRACKING not in live.finalizers:
                 continue
-            wrote += self._sync(job, by_owner.get(_owner_ref(job), []))
-        return wrote
+            try:
+                self.store.update(
+                    PODS, k,
+                    dataclasses.replace(
+                        live,
+                        finalizers=tuple(
+                            f for f in live.finalizers if f != JOB_TRACKING
+                        ),
+                    ),
+                    expect_rv=rv,
+                )
+            except ConflictError:
+                pass   # next event retries
 
     def _sync(self, job: t.Job, owned: list) -> int:
         wrote = 0
@@ -100,6 +131,7 @@ class JobController:
                     node_name="",
                     phase="Pending",
                     terminates=True,
+                    finalizers=(JOB_TRACKING,),
                     creation_index=self._seq[job.key],
                 )
                 try:
@@ -136,12 +168,33 @@ class JobController:
                 wrote += 1
             except ConflictError:
                 return wrote   # recount next sync (nothing was deleted)
-        # PHASE 2: remove the counted pods; their keys clear from
-        # ``uncounted`` on a later sync once the informer confirms them gone
+        # PHASE 2: remove the counted pods. With the tracking finalizer the
+        # delete is SOFT (deletion_timestamp only); clearing the finalizer
+        # — legal exactly because the count is already committed — lets the
+        # store complete the removal (job_controller.go
+        # removeTrackingFinalizerFromPods). The informer cache is NOT
+        # touched here — the watch delivers the DELETED events, whose
+        # handlers re-enqueue this Job for the confirmation sync that
+        # clears the keys from ``uncounted``
         for key in next_uncounted:
             try:
                 self.store.delete(PODS, key)
             except KeyError:
-                pass
-            self._pods.store.pop(key, None)
+                continue
+            live, rv = self.store.get(PODS, key)
+            if live is None or JOB_TRACKING not in live.finalizers:
+                continue
+            try:
+                self.store.update(
+                    PODS, key,
+                    dataclasses.replace(
+                        live,
+                        finalizers=tuple(
+                            f for f in live.finalizers if f != JOB_TRACKING
+                        ),
+                    ),
+                    expect_rv=rv,
+                )
+            except ConflictError:
+                pass   # a concurrent writer moved it: retried next sync
         return wrote

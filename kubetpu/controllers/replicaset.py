@@ -7,6 +7,12 @@ Deletion prefers the pods a user would miss least — unscheduled before
 running (getPodsToDelete's ActivePods ranking); creation stamps the pod
 template with a unique name and the owner reference.
 
+Queue-driven like the reference (replica_set.go:214 queue wiring, :622
+worker): RS events enqueue the RS key; pod events enqueue the owning RS
+(resolved by controllerRef, or by selector match for orphans —
+getPodReplicaSets) — a sync touches ONE ReplicaSet, and only dirty keys
+are processed.
+
 Ownership here is the ``owner`` slice ("ReplicaSet/<ns>/<name>"); pods
 matching the selector without an owner are adopted
 (controller_ref_manager.go's adoption), pods owned by someone else are
@@ -20,8 +26,8 @@ import dataclasses
 from ..api import types as t
 from ..api.selectors import label_selector_matches
 from ..client.informers import PODS
-from ..client.reflector import Reflector, SharedInformer
 from ..store.memstore import ConflictError, MemStore
+from .workqueue import OwnerIndex, QueueController
 
 REPLICA_SETS = "replicasets"
 
@@ -30,36 +36,44 @@ def _owner_ref(rs: t.ReplicaSet) -> str:
     return f"ReplicaSet/{rs.namespace}/{rs.name}"
 
 
-class ReplicaSetController:
-    def __init__(self, store: MemStore) -> None:
-        self.store = store
-        self._rs = SharedInformer(REPLICA_SETS)
-        self._pods = SharedInformer(PODS)
-        self._r = [Reflector(store, self._rs), Reflector(store, self._pods)]
+class ReplicaSetController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, **({"clock": clock} if clock else {}))
+        self._rs = self.watch(REPLICA_SETS, lambda rs: [rs.key])
+        self._pods = self.watch(PODS, self._pod_keys)
+        self._owned = OwnerIndex(self._pods)
         self._seq: dict[str, int] = {}   # per-RS name sequence
         self.creates = 0
         self.deletes = 0
 
-    def start(self) -> None:
-        for r in self._r:
-            r.sync()
-
-    def pump(self) -> int:
-        return sum(r.step() for r in self._r)
+    def _pod_keys(self, pod: t.Pod) -> list[str]:
+        """Owning RS key for a pod event (getPodReplicaSets: controllerRef
+        first; an orphan dirties every selector-matching RS, which then
+        races to adopt it)."""
+        if pod.owner:
+            kind, _, rest = pod.owner.partition("/")
+            return [rest] if kind == "ReplicaSet" else []
+        return [
+            key for key, rs in self._rs.store.items()
+            if rs.namespace == pod.namespace
+            and rs.selector is not None
+            and label_selector_matches(rs.selector, pod.labels_dict())
+        ]
 
     # ----------------------------------------------------------- reconcile
-    def step(self) -> int:
-        """One pass of syncReplicaSet over every RS; returns write count."""
-        self.pump()
-        wrote = 0
-        for key, rs in list(self._rs.store.items()):
-            wrote += self._sync(rs)
-        return wrote
+    def sync(self, key: str) -> None:
+        rs = self._rs.store.get(key)
+        if rs is not None:
+            self._sync(rs)
 
     def _claimed(self, rs: t.ReplicaSet) -> list[tuple[str, t.Pod]]:
         ref = _owner_ref(rs)
         out = []
-        for key, pod in self._pods.store.items():
+        # owner index: this RS's pods + orphans — O(owned), not O(all pods)
+        for key in self._owned.get(ref, ""):
+            pod = self._pods.store.get(key)
+            if pod is None:
+                continue
             if pod.namespace != rs.namespace:
                 continue
             if pod.phase in ("Succeeded", "Failed"):

@@ -9,6 +9,11 @@ is replaced before anything above it progresses. ``Parallel`` drops the
 ordering gates. Identity is the contract: a recreated ordinal keeps its
 name (and would keep its PVCs — the volume half rides the volumebinding
 family).
+
+Queue-driven (stateful_set.go:146 queue wiring): set events enqueue the
+set; pod events enqueue the owning set (or, for an orphan named
+``<set>-<ordinal>``, the set whose name prefix it carries) — only dirty
+sets are synced.
 """
 
 from __future__ import annotations
@@ -17,8 +22,8 @@ import dataclasses
 
 from ..api import types as t
 from ..client.informers import PODS
-from ..client.reflector import Reflector, SharedInformer
 from ..store.memstore import ConflictError, MemStore
+from .workqueue import OwnerIndex, QueueController
 
 STATEFUL_SETS = "statefulsets"
 
@@ -27,40 +32,47 @@ def _owner_ref(ss: t.StatefulSet) -> str:
     return f"StatefulSet/{ss.namespace}/{ss.name}"
 
 
-class StatefulSetController:
-    def __init__(self, store: MemStore) -> None:
-        self.store = store
-        self._sets = SharedInformer(STATEFUL_SETS)
-        self._pods = SharedInformer(PODS)
-        self._r = [Reflector(store, self._sets), Reflector(store, self._pods)]
+class StatefulSetController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, **({"clock": clock} if clock else {}))
+        self._sets = self.watch(STATEFUL_SETS, lambda ss: [ss.key])
+        self._pods = self.watch(PODS, self._pod_keys)
+        self._owned = OwnerIndex(self._pods)
         self.creates = 0
         self.deletes = 0
 
-    def start(self) -> None:
-        for r in self._r:
-            r.sync()
+    def _pod_keys(self, pod: t.Pod) -> list[str]:
+        if pod.owner:
+            kind, _, rest = pod.owner.partition("/")
+            return [rest] if kind == "StatefulSet" else []
+        # orphan: the candidate adopter is the set named by the pod's
+        # <set>-<ordinal> prefix (getStatefulSetForPod's selector walk)
+        prefix, _, ord_str = pod.name.rpartition("-")
+        if prefix and ord_str.isdigit():
+            return [f"{pod.namespace}/{prefix}"]
+        return []
 
-    def pump(self) -> int:
-        return sum(r.step() for r in self._r)
-
-    def step(self) -> int:
-        self.pump()
-        by_owner: dict[str, dict[int, tuple[str, t.Pod]]] = {}
+    def sync(self, key: str) -> None:
+        ss = self._sets.store.get(key)
+        if ss is None:
+            return
+        ref = _owner_ref(ss)
+        owned: dict[int, tuple[str, t.Pod]] = {}
         orphans: list[tuple[str, t.Pod]] = []
-        for key, p in self._pods.store.items():
+        # owner index: O(owned + orphans), not O(all pods)
+        for pkey in self._owned.get(ref, ""):
+            p = self._pods.store.get(pkey)
+            if p is None:
+                continue
             _, _, ord_str = p.name.rpartition("-")
             if not ord_str.isdigit():
                 continue
-            if p.owner:
-                by_owner.setdefault(p.owner, {})[int(ord_str)] = (key, p)
-            else:
-                orphans.append((key, p))
-        wrote = 0
-        for key, ss in list(self._sets.store.items()):
-            owned = by_owner.get(_owner_ref(ss), {})
-            wrote += self._adopt(ss, orphans, owned)
-            wrote += self._sync(ss, owned)
-        return wrote
+            if p.owner == ref:
+                owned[int(ord_str)] = (pkey, p)
+            elif not p.owner:
+                orphans.append((pkey, p))
+        self._adopt(ss, orphans, owned)
+        self._sync(ss, owned)
 
     def _adopt(self, ss: t.StatefulSet, orphans: list, owned: dict) -> int:
         """Selector-based claiming (controller_ref_manager): an orphan named

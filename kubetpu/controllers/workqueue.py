@@ -1,0 +1,277 @@
+"""Rate-limited per-key work queue + the queue-driven controller base.
+
+Reference: ``client-go/util/workqueue`` — ``queue.go`` (Type = FIFO order +
+``dirty`` + ``processing`` sets: a key re-added while processing is
+re-processed exactly once after Done, never concurrently),
+``default_rate_limiters.go`` (ItemExponentialFailureRateLimiter:
+``baseDelay * 2^failures`` capped at ``maxDelay``),
+``rate_limiting_queue.go`` (AddRateLimited/Forget), and
+``delaying_queue.go`` (AddAfter). Every reference controller shares the
+shape informer events → workqueue → workers → ``sync(key)``
+(e.g. pkg/controller/replicaset/replica_set.go:214 queue wiring, :622
+worker): only DIRTY keys are processed — no full-state rescans — and a
+failing key retries with its own backoff without stalling other keys.
+
+Pump-driven (the framework's no-goroutine shape): ``QueueController.step``
+replaces the N worker goroutines; owners fold it into their loops. Clocks
+are injectable so tests drive backoff deterministically.
+"""
+
+from __future__ import annotations
+
+import heapq
+import sys
+import time
+from typing import Any, Callable, Iterable
+
+from ..client.reflector import FuncHandler, Reflector, SharedInformer
+
+
+class ExponentialBackoff:
+    """ItemExponentialFailureRateLimiter (default_rate_limiters.go:99):
+    per-key ``base * 2^failures`` seconds, capped at ``max_s``."""
+
+    def __init__(self, base_s: float = 0.005, max_s: float = 1000.0) -> None:
+        self.base_s = base_s
+        self.max_s = max_s
+        self._failures: dict[Any, int] = {}
+
+    def when(self, key: Any) -> float:
+        n = self._failures.get(key, 0)
+        self._failures[key] = n + 1
+        return min(self.base_s * (2.0 ** n), self.max_s)
+
+    def forget(self, key: Any) -> None:
+        self._failures.pop(key, None)
+
+    def retries(self, key: Any) -> int:
+        return self._failures.get(key, 0)
+
+
+class WorkQueue:
+    """Deduplicating FIFO with delayed re-adds and per-key rate limiting.
+
+    Contract (queue.go): ``add`` is a no-op while the key is dirty;
+    a key added while PROCESSING is remembered and re-queued on ``done``;
+    ``get`` hands out a key and marks it processing. ``add_after`` /
+    ``add_rate_limited`` park the key until due (delaying_queue.go) —
+    ``get`` only returns due keys.
+    """
+
+    def __init__(
+        self,
+        clock: Callable[[], float] = time.monotonic,
+        limiter: ExponentialBackoff | None = None,
+    ) -> None:
+        self.clock = clock
+        self.limiter = limiter or ExponentialBackoff()
+        self._queue: list[Any] = []           # FIFO of ready keys
+        self._dirty: set[Any] = set()
+        self._processing: set[Any] = set()
+        self._waiting: dict[Any, float] = {}  # key -> due time
+        self._heap: list[tuple[float, int, Any]] = []
+        self._seq = 0
+
+    def add(self, key: Any) -> None:
+        if key in self._dirty:
+            return
+        self._dirty.add(key)
+        self._waiting.pop(key, None)          # direct add outruns a delay
+        if key in self._processing:
+            return                            # re-queued by done()
+        self._queue.append(key)
+
+    def add_after(self, key: Any, delay_s: float) -> None:
+        if delay_s <= 0:
+            self.add(key)
+            return
+        due = self.clock() + delay_s
+        prev = self._waiting.get(key)
+        if prev is not None and prev <= due:
+            return                            # earliest due time wins
+        self._waiting[key] = due
+        self._seq += 1
+        heapq.heappush(self._heap, (due, self._seq, key))
+
+    def add_rate_limited(self, key: Any) -> None:
+        self.add_after(key, self.limiter.when(key))
+
+    def forget(self, key: Any) -> None:
+        self.limiter.forget(key)
+
+    def retries(self, key: Any) -> int:
+        return self.limiter.retries(key)
+
+    def _drain_due(self) -> None:
+        now = self.clock()
+        while self._heap and self._heap[0][0] <= now:
+            due, _, key = heapq.heappop(self._heap)
+            if self._waiting.get(key) == due:  # not superseded / cancelled
+                del self._waiting[key]
+                self.add(key)
+
+    def get(self) -> Any | None:
+        """Next due key (marked processing), or None when idle."""
+        self._drain_due()
+        while self._queue:
+            key = self._queue.pop(0)
+            if key in self._processing:        # stale duplicate entry
+                continue
+            self._dirty.discard(key)
+            self._processing.add(key)
+            return key
+        return None
+
+    def done(self, key: Any) -> None:
+        self._processing.discard(key)
+        if key in self._dirty:                 # re-added mid-processing
+            self._queue.append(key)
+
+    def next_due_in(self) -> float | None:
+        """Seconds until the earliest parked key is due (None when no key
+        is parked) — lets a host loop sleep instead of spinning."""
+        self._drain_due()
+        if not self._heap:
+            return None
+        return max(0.0, self._heap[0][0] - self.clock())
+
+    def __len__(self) -> int:
+        return len(self._queue) + len(self._waiting)
+
+
+class OwnerIndex:
+    """``owner-ref → object keys`` maintained from a SharedInformer's
+    deliveries (the reference controllers' ownerReference indexer —
+    informer indexers keep per-key syncs O(owned), not O(all objects)).
+    Orphans index under ``""`` so adoption scans stay cheap too."""
+
+    def __init__(self, informer: SharedInformer) -> None:
+        self._idx: dict[str, set[str]] = {}
+        informer.add_handler(FuncHandler(
+            on_add=self._on_add, on_update=self._on_update,
+            on_delete=self._on_delete,
+        ))
+
+    @staticmethod
+    def _key(obj: Any) -> str:
+        key = getattr(obj, "key", None)
+        if key is not None:
+            return key
+        return f"{obj.namespace}/{obj.name}"
+
+    @staticmethod
+    def _owner(obj: Any) -> str:
+        return getattr(obj, "owner", "") or ""
+
+    def _on_add(self, obj: Any) -> None:
+        self._idx.setdefault(self._owner(obj), set()).add(self._key(obj))
+
+    def _on_update(self, old: Any, new: Any) -> None:
+        oo, no = self._owner(old), self._owner(new)
+        if oo != no:
+            self._idx.get(oo, set()).discard(self._key(old))
+        self._idx.setdefault(no, set()).add(self._key(new))
+
+    def _on_delete(self, obj: Any) -> None:
+        s = self._idx.get(self._owner(obj))
+        if s is not None:
+            s.discard(self._key(obj))
+
+    def get(self, *owners: str) -> list[str]:
+        """Keys owned by any of ``owners`` (deterministic order)."""
+        out: set[str] = set()
+        for o in owners:
+            out |= self._idx.get(o, set())
+        return sorted(out)
+
+
+class QueueController:
+    """Base for queue-driven controllers: informer events enqueue KEYS, and
+    ``step`` processes only those dirty keys through ``sync(key)`` — the
+    reference's informer → workqueue → worker shape. A sync that raises is
+    retried with per-key exponential backoff; other keys keep flowing.
+
+    Subclasses call ``watch(kind, enqueue_fn)`` in ``__init__`` (enqueue_fn
+    maps a delivered object to the sync keys it dirties) and implement
+    ``sync(key)``. ``informer(kind)`` exposes the local read-only caches.
+    """
+
+    #: retries before a key is dropped with a loud report (the reference
+    #: keeps retrying forever for most controllers; a bound keeps a
+    #: poisoned key from living in the queue for the process lifetime)
+    max_retries = 15
+
+    def __init__(self, store, clock: Callable[[], float] = time.monotonic):
+        self.store = store
+        self.clock = clock
+        self.queue = WorkQueue(clock=clock)
+        self._informers: dict[str, SharedInformer] = {}
+        self._reflectors: list[Reflector] = []
+        self.sync_errors = 0
+        self.dropped_keys = 0
+
+    # ---------------------------------------------------------------- wiring
+    def watch(
+        self, kind: str,
+        enqueue_fn: Callable[[Any], Iterable[Any]],
+        tombstone_fn: Callable[[Any], Iterable[Any]] | None = None,
+    ) -> SharedInformer:
+        """Register an informer whose deliveries enqueue ``enqueue_fn(obj)``
+        keys (``tombstone_fn`` for deletes, default: same fn)."""
+        inf = SharedInformer(kind)
+        gone = tombstone_fn or enqueue_fn
+
+        def _enq(fn, obj):
+            for key in fn(obj):
+                self.queue.add(key)
+
+        inf.add_handler(FuncHandler(
+            on_add=lambda o: _enq(enqueue_fn, o),
+            on_update=lambda old, new: _enq(enqueue_fn, new),
+            on_delete=lambda o: _enq(gone, o),
+        ))
+        self._informers[kind] = inf
+        self._reflectors.append(Reflector(self.store, inf))
+        return inf
+
+    def informer(self, kind: str) -> SharedInformer:
+        return self._informers[kind]
+
+    # ----------------------------------------------------------------- loop
+    def start(self) -> None:
+        for r in self._reflectors:
+            r.sync()
+
+    def pump(self) -> int:
+        return sum(r.step() for r in self._reflectors)
+
+    def step(self, max_items: int = 256) -> int:
+        """One tick: deliver watch events, then process up to ``max_items``
+        due keys. Returns the number of keys synced."""
+        self.pump()
+        n = 0
+        while n < max_items:
+            key = self.queue.get()
+            if key is None:
+                break
+            try:
+                self.sync(key)
+            except Exception as e:
+                self.sync_errors += 1
+                if self.queue.retries(key) >= self.max_retries:
+                    self.queue.forget(key)
+                    self.dropped_keys += 1
+                    print(
+                        f"{type(self).__name__}: dropping {key!r} after "
+                        f"{self.max_retries} retries: {e}", file=sys.stderr,
+                    )
+                else:
+                    self.queue.add_rate_limited(key)
+            else:
+                self.queue.forget(key)
+            self.queue.done(key)
+            n += 1
+        return n
+
+    def sync(self, key: Any) -> None:  # pragma: no cover - interface
+        raise NotImplementedError

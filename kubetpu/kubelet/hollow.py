@@ -33,6 +33,7 @@ class HollowKubelet:
         node: t.Node,
         resource_slice: t.ResourceSlice | None = None,
         clock: Callable[[], float] | None = None,
+        start_delay_s: float = 0.0,
     ) -> None:
         import time
 
@@ -40,8 +41,20 @@ class HollowKubelet:
         self.node = node
         self.resource_slice = resource_slice
         self.clock = clock or time.monotonic
+        # probe-analog: a bound pod stays Pending for this long before the
+        # kubelet reports Running (container start + readiness window —
+        # pkg/kubelet/prober); 0 = the old immediate transition
+        self.start_delay_s = start_delay_s
+        self._pending_since: dict[str, float] = {}
         self._pods = SharedInformer(PODS)
-        self._r = Reflector(store, self._pods)
+        # spec.nodeName field selector: this kubelet receives only ITS pods
+        # (the real kubelet's apiserver pod source — config/apiserver.go
+        # NewSourceApiserver's fields.OneTermEqualSelector), so an N-node
+        # cluster doesn't ship every pod to every node agent
+        self._r = Reflector(
+            store, self._pods,
+            field_selector=f"spec.nodeName={node.name}",
+        )
         self.alive = True
         self.running: set[str] = set()
 
@@ -68,8 +81,13 @@ class HollowKubelet:
 
     # --------------------------------------------------------------- sync
     def pump(self) -> int:
-        """One syncLoop iteration: heartbeat + mark newly bound pods
-        Running (syncLoopIteration's HandlePodAdditions → status sync)."""
+        """One syncLoop iteration: heartbeat + the pod lifecycle state
+        machine (syncLoopIteration → pod workers, pod_workers.go):
+        Pending → (start_delay_s probe window) → Running →
+        Succeeded (terminates) — and for TERMINATING pods
+        (deletion_timestamp set: graceful deletion) the wind-down to a
+        terminal phase; the store removes the object once its finalizers
+        clear (the final status sync the real kubelet sends)."""
         self.heartbeat()
         if not self.alive:
             return 0
@@ -78,6 +96,35 @@ class HollowKubelet:
         for key, pod in list(self._pods.store.items()):
             if pod.node_name != self.node.name:
                 self.running.discard(key)
+                self._pending_since.pop(key, None)
+                continue
+            if pod.deletion_timestamp is not None:
+                # graceful deletion: kill the workload, report the terminal
+                # phase (the object itself lives until finalizers clear)
+                if pod.phase in ("Pending", "Running"):
+                    live, rv = self.store.get(PODS, key)
+                    if (
+                        live is None
+                        or live.node_name != self.node.name
+                        or live.phase not in ("Pending", "Running")
+                    ):
+                        continue
+                    # a gracefully-deleted pod was KILLED, not completed —
+                    # killed containers report Failed (kuberuntime's
+                    # termination status), never a phantom Succeeded that
+                    # Job accounting would count as a completion
+                    final = "Failed"
+                    try:
+                        self.store.update(
+                            PODS, key,
+                            dataclasses.replace(live, phase=final),
+                            expect_rv=rv,
+                        )
+                        moved += 1
+                    except ConflictError:
+                        pass
+                self.running.discard(key)
+                self._pending_since.pop(key, None)
                 continue
             if pod.phase == "Running" and pod.terminates:
                 # run-to-completion workloads (restartPolicy: Never) finish
@@ -97,6 +144,11 @@ class HollowKubelet:
                 continue
             if key in self.running or pod.phase != "Pending":
                 continue
+            # probe-analog startup window: observed-bound time + delay
+            if self.start_delay_s > 0:
+                since = self._pending_since.setdefault(key, self.clock())
+                if self.clock() - since < self.start_delay_s:
+                    continue
             # status write through the LIVE object (not the informer copy),
             # and only if the pod is still bound here
             live, rv = self.store.get(PODS, key)
@@ -111,7 +163,16 @@ class HollowKubelet:
             except ConflictError:
                 continue
             self.running.add(key)
+            self._pending_since.pop(key, None)
             moved += 1
+        # pods gone from the cache (DELETED events) free their slots — a
+        # same-key replacement (daemonset/statefulset identity reuse) must
+        # not be skipped by a stale `running` entry
+        live_keys = self._pods.store.keys()
+        self.running.intersection_update(live_keys)
+        for k in list(self._pending_since):
+            if k not in live_keys:
+                del self._pending_since[k]
         return moved
 
 
@@ -124,12 +185,14 @@ class HollowCluster:
         nodes: list[t.Node],
         slices: dict[str, t.ResourceSlice] | None = None,
         clock: Callable[[], float] | None = None,
+        start_delay_s: float = 0.0,
     ) -> None:
         self.kubelets = [
             HollowKubelet(
                 store, n,
                 resource_slice=(slices or {}).get(n.name),
                 clock=clock,
+                start_delay_s=start_delay_s,
             )
             for n in nodes
         ]

@@ -180,7 +180,27 @@ class MemStore:
         self, kind: str, key: str, obj: Any, expect_rv: int | None = None
     ) -> int:
         """GuaranteedUpdate: CAS when ``expect_rv`` is given; upsert when the
-        object is absent and no CAS was requested."""
+        object is absent and no CAS was requested.
+
+        Finalizer gate (registry/store.go deleteForEmptyFinalizers): an
+        update that leaves a TERMINATING object (deletion_timestamp set)
+        with no finalizers completes the deletion — the object is removed
+        and a DELETED event fires instead of MODIFIED."""
+        if (
+            getattr(obj, "deletion_timestamp", None) is not None
+            and not getattr(obj, "finalizers", ())
+        ):
+            with self._lock:
+                current, have_rv = self._core.get(kind, key)
+                if current is None:
+                    raise ConflictError(f"{kind}/{key}: gone")
+                if expect_rv is not None and have_rv != expect_rv:
+                    raise ConflictError(
+                        f"{kind}/{key}: expected rv {expect_rv}, have {have_rv}"
+                    )
+                rv = self._core.delete(kind, key)
+                self._lock.notify_all()
+                return rv
         with self._lock:
             try:
                 rv = self._core.update(
@@ -192,7 +212,26 @@ class MemStore:
             return rv
 
     def delete(self, kind: str, key: str) -> int:
+        """Remove the object. GRACEFUL path (pkg/registry/core/pod —
+        pods delete via deletionTimestamp): an object carrying finalizers
+        is soft-deleted — ``deletion_timestamp`` is stamped and the object
+        retained (MODIFIED event) until every finalizer is cleared; a
+        repeat delete of a terminating object is a no-op returning the
+        current revision."""
         with self._lock:
+            current, rv = self._core.get(kind, key)
+            if current is not None and getattr(current, "finalizers", ()):
+                import dataclasses
+                import time as _time
+
+                if getattr(current, "deletion_timestamp", None) is not None:
+                    return self._core.resource_version()   # already going
+                doomed = dataclasses.replace(
+                    current, deletion_timestamp=_time.time()
+                )
+                rv = self._core.update(kind, key, doomed, -1)
+                self._lock.notify_all()
+                return rv
             rv = self._core.delete(kind, key)   # KeyError propagates
             self._lock.notify_all()
             return rv
@@ -202,10 +241,29 @@ class MemStore:
         with self._lock:
             return self._core.get(kind, key)
 
-    def list(self, kind: str):
-        """GetList: items + the revision the list is consistent at."""
+    def list(
+        self, kind: str,
+        label_selector: str = "", field_selector: str = "",
+    ):
+        """GetList: items + the revision the list is consistent at.
+        ``label_selector``/``field_selector`` are the reference's list
+        options (``k=v,k2!=v2`` strings) applied server-side — an informer
+        with a selector never receives the objects it filtered out."""
         with self._lock:
-            return self._core.list(kind)
+            items, rv = self._core.list(kind)
+        if label_selector or field_selector:
+            from ..api.selectors import (
+                object_matches_selectors,
+                parse_simple_selector,
+            )
+
+            lt = parse_simple_selector(label_selector)
+            ft = parse_simple_selector(field_selector)
+            items = [
+                (k, o) for k, o in items
+                if object_matches_selectors(o, lt, ft)
+            ]
+        return items, rv
 
     @property
     def resource_version(self) -> int:
@@ -213,18 +271,25 @@ class MemStore:
             return self._core.resource_version()
 
     # -------------------------------------------------------------- watch
-    def watch(self, kind: str | None, since_rv: int) -> "Watcher":
+    def watch(
+        self, kind: str | None, since_rv: int,
+        label_selector: str = "", field_selector: str = "",
+    ) -> "Watcher":
         """A pull watcher for events AFTER ``since_rv`` (``kind`` None =
         all buckets). Raises CompactedError immediately when the start
         revision predates the buffer (an O(1) watermark check — no event
-        materialization; the first poll() fetches them)."""
+        materialization; the first poll() fetches them). With selectors,
+        non-matching ADDED/MODIFIED events are rewritten to DELETED
+        tombstones (the watch cache's selector watchers: an object leaving
+        the selection must vanish from the client's cache; one that never
+        matched makes the tombstone a no-op)."""
         with self._lock:
             compacted = self._core.compacted_through()
         if since_rv < compacted:
             raise CompactedError(
                 f"rv {since_rv} compacted (through {compacted})"
             )
-        return Watcher(self, kind, since_rv)
+        return Watcher(self, kind, since_rv, label_selector, field_selector)
 
     def _events_since(
         self, kind: str | None, rv: int
@@ -253,13 +318,68 @@ class MemStore:
             )
 
 
+class SelectorView:
+    """Stateful selector filter for ONE watch stream (the watch cache's
+    per-watcher selector view): matching events pass and mark the key
+    delivered; an event LEAVING the selection becomes one DELETED
+    tombstone; further events for a key the client provably does not hold
+    are dropped outright — so a kubelet watching ``spec.nodeName=<self>``
+    pays one tombstone per foreign pod, not one per foreign event.
+
+    An event for an UNKNOWN non-matching key still tombstones once: the
+    client's initial (selector-scoped) list may contain objects that left
+    the selection before their first watch event, and the view cannot
+    distinguish them from never-matched objects."""
+
+    def __init__(self, label_selector: str, field_selector: str) -> None:
+        from ..api.selectors import parse_simple_selector
+
+        self._lt = parse_simple_selector(label_selector)
+        self._ft = parse_simple_selector(field_selector)
+        self._matched: set[str] = set()     # keys delivered as matching
+        self._tombstoned: set[str] = set()  # foreign keys already tombstoned
+
+    def filter(self, events: list[WatchEvent]) -> list[WatchEvent]:
+        from ..api.selectors import object_matches_selectors
+
+        out: list[WatchEvent] = []
+        for e in events:
+            if e.type == DELETED:
+                if e.key in self._tombstoned:
+                    self._tombstoned.discard(e.key)
+                    continue               # client never held it
+                self._matched.discard(e.key)
+                out.append(e)
+                continue
+            if object_matches_selectors(e.obj, self._lt, self._ft):
+                self._matched.add(e.key)
+                self._tombstoned.discard(e.key)
+                out.append(e)
+                continue
+            if e.key in self._tombstoned:
+                continue                   # repeat foreign event: dropped
+            self._matched.discard(e.key)
+            self._tombstoned.add(e.key)
+            out.append(
+                WatchEvent(DELETED, e.kind, e.key, e.obj, e.resource_version)
+            )
+        return out
+
+
 class Watcher:
     """One watch stream: ``poll()`` drains events after the cursor."""
 
-    def __init__(self, store: MemStore, kind: str | None, since_rv: int) -> None:
+    def __init__(
+        self, store: MemStore, kind: str | None, since_rv: int,
+        label_selector: str = "", field_selector: str = "",
+    ) -> None:
         self._store = store
         self._kind = kind
         self._rv = since_rv
+        self._view = (
+            SelectorView(label_selector, field_selector)
+            if (label_selector or field_selector) else None
+        )
 
     @property
     def resource_version(self) -> int:
@@ -269,4 +389,6 @@ class Watcher:
         """New events since the cursor; raises CompactedError when the
         cursor fell behind the ring buffer (caller relists)."""
         events, self._rv = self._store._events_since(self._kind, self._rv)
+        if self._view is not None:
+            events = self._view.filter(events)
         return events

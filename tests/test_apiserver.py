@@ -9,6 +9,7 @@ on compacted revisions (cacher.go), and client-go running ListAndWatch
 against it (reflector.go:463).
 """
 
+import dataclasses
 import json
 import threading
 import time
@@ -219,3 +220,260 @@ def test_pod_v1_round_trips_claims_and_features():
     assert resolved.resource_claims == (
         t.PodResourceClaim(name="res", claim_name="p2-res-abc"),
     )
+
+
+# ----------------------------------------------------- admission / validation
+
+def test_invalid_writes_rejected_with_422(server):
+    """Strategy validation on the write path (registry/store.go:514):
+    garbage never reaches storage — the scheduler cannot see it."""
+    from kubetpu.apiserver import RemoteStore
+    from kubetpu.store.memstore import ConflictError
+
+    remote = RemoteStore(server.url)
+    # negative resource request
+    bad_pod = dataclasses.replace(make_pod("p"), requests=(("cpu", -5),))
+    with pytest.raises(ValueError, match="non-negative"):
+        remote.create("pods", "default/p", bad_pod)
+    # unknown phase
+    with pytest.raises(ValueError, match="unknown phase"):
+        remote.create("pods", "default/p",
+                      dataclasses.replace(make_pod("p"), phase="Zombie"))
+    # URL key disagreeing with the object's name
+    with pytest.raises(ValueError, match="does not match"):
+        remote.create("pods", "default/other", make_pod("p"))
+    # node with negative allocatable
+    bad_node = dataclasses.replace(
+        make_node("n0"), allocatable=(("cpu", -1),))
+    with pytest.raises(ValueError, match="non-negative"):
+        remote.create("nodes", "n0", bad_node)
+    # deployment with both rolling bounds zero
+    bad_dep = t.Deployment(
+        name="d", max_surge=0, max_unavailable=0,
+        selector=t.LabelSelector.of({"a": "b"}),
+        template=make_pod("tpl", labels={"a": "b"}),
+    )
+    with pytest.raises(ValueError, match="both be zero"):
+        remote.create("deployments", "default/d", bad_dep)
+    # template labels must satisfy the selector
+    bad_rs = t.ReplicaSet(
+        name="r", selector=t.LabelSelector.of({"app": "x"}),
+        template=make_pod("tpl", labels={"app": "y"}),
+    )
+    with pytest.raises(ValueError, match="match selector"):
+        remote.create("replicasets", "default/r", bad_rs)
+    # PDB with both thresholds
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        remote.create("poddisruptionbudgets", "default/b",
+                      t.PodDisruptionBudget(
+                          name="b", min_available=1, max_unavailable=1))
+    # nothing landed in the store
+    assert server.store.list("pods")[0] == []
+    assert server.store.list("nodes")[0] == []
+    # valid writes still flow (create + the validated update path)
+    remote.create("pods", "default/p", make_pod("p"))
+    with pytest.raises(ValueError, match="unknown phase"):
+        remote.update("pods", "default/p",
+                      dataclasses.replace(make_pod("p"), phase="Zombie"))
+    remote.update("pods", "default/p",
+                  dataclasses.replace(make_pod("p"), phase="Running"))
+    assert server.store.get("pods", "default/p")[0].phase == "Running"
+
+
+def test_admission_hooks_mutate_then_veto():
+    """The hook chain: a mutating hook stamps a default; a validating hook
+    vetoes by policy with 403 (webhook admission shape)."""
+    from kubetpu.apiserver import (
+        AdmissionDenied,
+        APIServer,
+        Registry,
+        RemoteStore,
+    )
+
+    reg = Registry()
+
+    def stamp_priority(kind, key, obj, old):
+        if obj.priority == 0:
+            return dataclasses.replace(obj, priority=7)
+        return None
+
+    def deny_kube_system(kind, key, obj, old):
+        if obj.namespace == "kube-system":
+            raise AdmissionDenied("kube-system is read-only here")
+
+    reg.add_mutating_hook(stamp_priority, kinds=("pods",))
+    reg.add_validating_hook(deny_kube_system, kinds=("pods",))
+    srv = APIServer(registry=reg).start()
+    try:
+        remote = RemoteStore(srv.url)
+        remote.create("pods", "default/p", make_pod("p"))
+        assert srv.store.get("pods", "default/p")[0].priority == 7
+        with pytest.raises(Exception, match="read-only"):
+            remote.create("pods", "kube-system/x",
+                          make_pod("x", namespace="kube-system"))
+        # nodes are outside both hooks' kind filters
+        remote.create("nodes", "n0", make_node("n0"))
+    finally:
+        srv.close()
+
+
+# ------------------------------------------------- selectors + watch stream
+
+def test_list_and_watch_selectors_server_side(server):
+    """labelSelector / fieldSelector applied at the SERVER: a scoped client
+    never receives filtered-out objects; an object leaving the selection
+    arrives as a DELETED tombstone with no body."""
+    remote = RemoteStore(server.url)
+    remote.create("pods", "default/a", make_pod(
+        "a", labels={"app": "web"}, node_name="n0"))
+    remote.create("pods", "default/b", make_pod(
+        "b", labels={"app": "db"}, node_name="n1"))
+    items, rv = remote.list("pods", label_selector="app=web")
+    assert [k for k, _ in items] == ["default/a"]
+    items, _ = remote.list("pods", field_selector="spec.nodeName=n1")
+    assert [k for k, _ in items] == ["default/b"]
+    items, _ = remote.list(
+        "pods", label_selector="app!=db", field_selector="spec.nodeName=n0")
+    assert [k for k, _ in items] == ["default/a"]
+
+    w = remote.watch("pods", rv, field_selector="spec.nodeName=n0")
+    # bind c to n0: matching MODIFIED-chain arrives; d to n1: tombstoned
+    remote.create("pods", "default/c", make_pod("c", node_name="n0"))
+    remote.create("pods", "default/d", make_pod("d", node_name="n1"))
+    evs = w.poll()
+    assert [(e.type, e.key) for e in evs] == [
+        ("ADDED", "default/c"), ("DELETED", "default/d"),
+    ]
+    assert evs[1].obj is None          # tombstone carries no object body
+    # a's node changes away: leaves the selection as DELETED
+    a, arv = remote.get("pods", "default/a")
+    remote.update("pods", "default/a", a.with_node("n9"), expect_rv=arv)
+    evs = w.poll()
+    assert [(e.type, e.key) for e in evs] == [("DELETED", "default/a")]
+
+
+def test_memstore_selectors_match_rest_semantics():
+    """The same selector surface in-process (MemStore) — one contract for
+    both deployment shapes."""
+    st = MemStore()
+    st.create("pods", "default/a", make_pod("a", labels={"app": "w"},
+                                            node_name="n0"))
+    st.create("pods", "default/b", make_pod("b", labels={"app": "w"}))
+    items, rv = st.list("pods", field_selector="spec.nodeName=n0")
+    assert [k for k, _ in items] == ["default/a"]
+    w = st.watch("pods", rv, label_selector="app=w")
+    st.create("pods", "default/c", make_pod("c", labels={"app": "x"}))
+    st.create("pods", "default/d", make_pod("d", labels={"app": "w"}))
+    assert [(e.type, e.key) for e in w.poll()] == [
+        ("DELETED", "default/c"), ("ADDED", "default/d"),
+    ]
+    with pytest.raises(ValueError, match="malformed"):
+        st.list("pods", label_selector="no-operator")
+
+
+def test_streaming_watch_delivers_incrementally(server):
+    """The chunked ndjson stream: events arrive over ONE held-open
+    connection, across multiple polls, without re-requesting."""
+    remote = RemoteStore(server.url)
+    _, rv = remote.list(NODES)
+    w = remote.watch(NODES, rv, stream=True)
+    try:
+        assert w.poll() == []              # opens the stream
+        remote.create(NODES, "s0", make_node("s0"))
+        deadline = time.monotonic() + 5
+        evs = []
+        while time.monotonic() < deadline and not evs:
+            evs = w.poll()
+            time.sleep(0.02)
+        assert [e.key for e in evs] == ["s0"]
+        remote.create(NODES, "s1", make_node("s1"))
+        remote.delete(NODES, "s0")
+        deadline = time.monotonic() + 5
+        evs = []
+        while time.monotonic() < deadline and len(evs) < 2:
+            evs += w.poll()
+            time.sleep(0.02)
+        assert [(e.type, e.key) for e in evs] == [
+            ("ADDED", "s1"), ("DELETED", "s0"),
+        ]
+        assert w.reconnects == 1           # one connection carried it all
+    finally:
+        w.close()
+
+
+def test_streaming_watch_compaction_raises_410():
+    small = MemStore(history=4)
+    srv = APIServer(small).start()
+    try:
+        remote = RemoteStore(srv.url)
+        remote.create(NODES, "n0", make_node("n0"))
+        w = remote.watch(NODES, 0, stream=True)
+        for i in range(10):
+            remote.update(NODES, "n0", make_node("n0", cpu_milli=i))
+        deadline = time.monotonic() + 5
+        with pytest.raises(CompactedError):
+            while time.monotonic() < deadline:
+                w.poll()
+                time.sleep(0.02)
+        w.close()
+    finally:
+        srv.close()
+
+
+def test_reflector_streams_with_field_selector(server):
+    """Reflector + streaming watch + field selector together: the hollow
+    kubelet shape against a remote apiserver."""
+    from kubetpu.client.reflector import Reflector, SharedInformer
+
+    remote = RemoteStore(server.url)
+    remote.create("pods", "default/mine", make_pod("mine", node_name="k0"))
+    remote.create("pods", "default/other", make_pod("other", node_name="k1"))
+    inf = SharedInformer("pods")
+    r = Reflector(remote, inf, field_selector="spec.nodeName=k0",
+                  stream=True)
+    r.sync()
+    assert set(inf.store) == {"default/mine"}
+    remote.create("pods", "default/late", make_pod("late", node_name="k0"))
+    remote.create("pods", "default/elsewhere",
+                  make_pod("elsewhere", node_name="k1"))
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and "default/late" not in inf.store:
+        r.step()
+        time.sleep(0.02)
+    assert set(inf.store) == {"default/mine", "default/late"}
+
+
+def test_selector_watch_suppresses_repeat_foreign_events(server):
+    """Per-stream selector state: a foreign key tombstones ONCE; its later
+    updates are dropped outright (the kubelet fan-out actually shrinks,
+    not just the bodies)."""
+    remote = RemoteStore(server.url)
+    _, rv = remote.list("pods")
+    w = remote.watch("pods", rv, field_selector="spec.nodeName=n0",
+                     stream=True)
+    w.poll()
+    remote.create("pods", "default/far", make_pod("far", node_name="n9"))
+    for i in range(4):
+        far, frv = remote.get("pods", "default/far")
+        remote.update("pods", "default/far",
+                      dataclasses.replace(far, priority=i + 1),
+                      expect_rv=frv)
+    remote.create("pods", "default/near", make_pod("near", node_name="n0"))
+    evs = []
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        evs += w.poll()
+        if any(e.key == "default/near" for e in evs):
+            break
+        time.sleep(0.02)
+    w.close()
+    foreign = [e for e in evs if e.key == "default/far"]
+    assert len(foreign) == 1                    # one tombstone, then silence
+    assert foreign[0].type == "DELETED" and foreign[0].obj is None
+    assert [e.key for e in evs if e.type == "ADDED"] == ["default/near"]
+
+
+def test_malformed_selector_is_400_not_500(server):
+    remote = RemoteStore(server.url)
+    with pytest.raises(ValueError, match="malformed"):
+        remote.list("pods", label_selector="no-operator")

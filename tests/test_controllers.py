@@ -188,9 +188,12 @@ def test_replicaset_steady_state_is_quiet():
     st.create(REPLICA_SETS, rs.key, rs)
     ctrl = ReplicaSetController(st)
     ctrl.start()
-    assert ctrl.step() == 2
-    assert ctrl.step() == 0    # converged: no churn
+    ctrl.step()
+    assert ctrl.creates == 2
+    ctrl.step()                # echo of our own creates dirties the key once
+    assert ctrl.step() == 0    # converged: queue empty, no keys synced
     assert ctrl.step() == 0
+    assert ctrl.creates == 2 and ctrl.deletes == 0   # no churn
 
 
 # ------------------------------------------------------------ hollow kubelet
@@ -296,7 +299,8 @@ def test_replicaset_replaces_failed_pods():
     pods, _ = st.list(PODS)
     key = pods[0][0]
     st.update(PODS, key, dataclasses.replace(pods[0][1], phase="Failed"))
-    assert ctrl.step() == 1   # replacement created
+    ctrl.step()
+    assert ctrl.creates == 3   # replacement created
     live = [
         p for _, p in st.list(PODS)[0] if p.phase != "Failed"
     ]
@@ -800,8 +804,10 @@ def test_job_restart_between_commit_and_delete_does_not_double_count():
 
     jc2 = JobController(CrashyStore())
     jc2.start()
-    with pytest.raises(RuntimeError):
-        jc2.step()
+    jc2.step()                   # delete crashes mid-sync; the queue
+    #                              captures it and schedules a retry —
+    #                              other keys would keep flowing
+    assert jc2.sync_errors == 1
     mid = st.get(JOBS, job.key)[0]
     assert mid.succeeded == 2 and len(mid.uncounted) == 2   # committed
 
@@ -955,3 +961,349 @@ def test_resourceclaim_controller_resolves_templates_end_to_end():
     st.delete(PODS, "default/p0")
     assert rc_ctrl.step() >= 1
     assert st.get("resourceclaims", "default/p0-gpu-5bc398")[0] is None
+
+
+# ---------------------------------------------------------------- daemonset
+
+def test_daemonset_one_pod_per_eligible_node_through_scheduler():
+    """Full loop: the controller stamps one affinity-pinned pod per
+    eligible node; the SCHEDULER places each on exactly its node
+    (ScheduleDaemonSetPods); an ineligible node gets nothing."""
+    from kubetpu.controllers import DAEMON_SETS, DaemonSetController
+
+    st = MemStore()
+    clock = [0.0]
+    nodes = [
+        make_node("n0", cpu_milli=4000, labels={"role": "worker"}),
+        make_node("n1", cpu_milli=4000, labels={"role": "worker"}),
+        make_node("gpu", cpu_milli=4000, labels={"role": "gpu"}),
+    ]
+    cluster = HollowCluster(st, nodes, clock=lambda: clock[0])
+    cluster.start()
+    ds = t.DaemonSet(
+        name="agent",
+        selector=t.LabelSelector.of({"app": "agent"}),
+        template=make_pod("tpl", labels={"app": "agent"}, cpu_milli=100,
+                          node_selector={"role": "worker"}),
+    )
+    st.create(DAEMON_SETS, ds.key, ds)
+    ctrl = DaemonSetController(st)
+    ctrl.start()
+    sched_clock = FakeClock()
+    sched = Scheduler(StoreClient(st), profile=C.Profile(),
+                      dispatcher_workers=0, clock=sched_clock)
+    informers = SchedulerInformers(st, sched)
+    informers.start()
+    for _ in range(6):
+        ctrl.step()
+        informers.pump()
+        sched.schedule_batch()
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        cluster.pump()
+        sched_clock.tick(2)
+    pods = {p.name: p for _, p in st.list(PODS)[0]}
+    assert set(pods) == {"agent-n0", "agent-n1"}
+    assert pods["agent-n0"].node_name == "n0"      # pinned placement
+    assert pods["agent-n1"].node_name == "n1"
+    assert all(p.phase == "Running" for p in pods.values())
+    assert ctrl.creates == 2
+
+
+def test_daemonset_tolerates_unschedulable_and_tracks_node_set():
+    """A cordoned node still runs its daemon (the standard daemon
+    tolerations); a node turning ineligible gets its daemon deleted; a new
+    node gets one created."""
+    from kubetpu.controllers import DAEMON_SETS, DaemonSetController
+    from kubetpu.controllers.daemonset import node_should_run
+
+    st = MemStore()
+    st.create(NODES, "c", make_node("c", unschedulable=True, taints=(
+        t.Taint(key="node.kubernetes.io/unschedulable",
+                effect=t.TaintEffect.NO_SCHEDULE),
+    )))
+    ds = t.DaemonSet(
+        name="d", selector=t.LabelSelector.of({"app": "d"}),
+        template=make_pod("tpl", labels={"app": "d"}),
+    )
+    st.create(DAEMON_SETS, ds.key, ds)
+    assert node_should_run(ds, st.get(NODES, "c")[0])   # cordoned: still runs
+    ctrl = DaemonSetController(st)
+    ctrl.start()
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"d-c"}
+    # an arbitrary NoSchedule taint the template does not tolerate
+    st.update(NODES, "c", make_node("c", taints=(
+        t.Taint(key="dedicated", value="db",
+                effect=t.TaintEffect.NO_SCHEDULE),
+    )))
+    ctrl.step()
+    assert st.list(PODS)[0] == []                       # daemon withdrawn
+    assert ctrl.deletes == 1
+    st.create(NODES, "fresh", make_node("fresh"))
+    ctrl.step()
+    assert {p.name for _, p in st.list(PODS)[0]} == {"d-fresh"}
+
+
+def test_daemonset_replaces_terminal_pod():
+    from kubetpu.controllers import DAEMON_SETS, DaemonSetController
+
+    st = MemStore()
+    st.create(NODES, "n0", make_node("n0"))
+    ds = t.DaemonSet(
+        name="d", selector=t.LabelSelector.of({"app": "d"}),
+        template=make_pod("tpl", labels={"app": "d"}),
+    )
+    st.create(DAEMON_SETS, ds.key, ds)
+    ctrl = DaemonSetController(st)
+    ctrl.start()
+    ctrl.step()
+    st.update(PODS, "default/d-n0", dataclasses.replace(
+        st.get(PODS, "default/d-n0")[0], phase="Failed"))
+    ctrl.step()   # deletes the terminal pod AND creates the replacement
+    got = st.get(PODS, "default/d-n0")[0]
+    assert got is not None and got.phase == "Pending"
+    assert ctrl.creates == 2 and ctrl.deletes == 1
+
+
+# ---------------------------------------------------------- garbage collector
+
+def test_gc_cascades_deployment_to_pods_and_claims():
+    """Deleting the root Deployment cascades: RS → pods → their claims —
+    each level driven by the previous level's watch events."""
+    from kubetpu.controllers import (
+        DEPLOYMENTS,
+        DeploymentController,
+        GarbageCollector,
+        ReplicaSetController,
+    )
+
+    st = MemStore()
+    dep = t.Deployment(
+        name="web", replicas=2, selector=t.LabelSelector.of({"app": "web"}),
+        template=make_pod("tpl", labels={"app": "web"}),
+    )
+    st.create(DEPLOYMENTS, dep.key, dep)
+    dc = DeploymentController(st)
+    rc = ReplicaSetController(st)
+    gc = GarbageCollector(st)
+    for c in (dc, rc, gc):
+        c.start()
+    dc.step(); rc.step(); gc.step()
+    pods, _ = st.list(PODS)
+    assert len(pods) == 2
+    # a claim owned by one of the pods
+    pkey = pods[0][0]
+    st.create("resourceclaims", "default/c0", t.ResourceClaim(
+        name="c0", owner=f"Pod/{pkey}",
+    ))
+    gc.step()
+    assert st.get("resourceclaims", "default/c0")[0] is not None  # owner alive
+    # root deleted: WITHOUT the workload controllers running (they would
+    # not recreate anyway — their owner is gone), the GC walks the chain
+    st.delete(DEPLOYMENTS, dep.key)
+    for _ in range(4):
+        gc.step()
+    assert st.list("replicasets")[0] == []
+    assert st.list(PODS)[0] == []
+    assert st.get("resourceclaims", "default/c0")[0] is None
+    assert gc.deletes == 1 + 2 + 1     # rs + 2 pods + claim
+
+
+def test_gc_deletes_dependent_born_orphaned():
+    """A dependent created AFTER its owner died (dangling ownerRef) is
+    collected on observation."""
+    from kubetpu.controllers import GarbageCollector
+
+    st = MemStore()
+    gc = GarbageCollector(st)
+    gc.start()
+    st.create(PODS, "default/ghost", make_pod(
+        "ghost", labels={"app": "x"},
+    ))
+    st.update(PODS, "default/ghost", dataclasses.replace(
+        st.get(PODS, "default/ghost")[0], owner="ReplicaSet/default/never",
+    ))
+    gc.step()
+    assert st.get(PODS, "default/ghost")[0] is None
+
+
+def test_gc_live_recheck_spares_racing_owner():
+    """Owner created between the informer pump and the delete decision:
+    the live-store re-check must spare the dependent."""
+    from kubetpu.controllers import GarbageCollector, REPLICA_SETS
+
+    st = MemStore()
+    gc = GarbageCollector(st)
+    gc.start()
+    st.create(PODS, "default/p", dataclasses.replace(
+        make_pod("p"), owner="ReplicaSet/default/rs",
+    ))
+    gc.pump()    # pod observed; rs not yet
+    st.create(REPLICA_SETS, "default/rs", t.ReplicaSet(
+        name="rs", selector=t.LabelSelector.of({}),
+    ))
+    # freeze the rs informer at the stale view: process the queue directly
+    key = gc.queue.get()
+    assert key == ("pods", "default/p")
+    gc.sync(key)
+    gc.queue.done(key)
+    assert st.get(PODS, "default/p")[0] is not None   # spared
+
+
+# --------------------------------------------------- pod lifecycle (hollow)
+
+def test_graceful_deletion_with_finalizers():
+    """DELETE of a finalized pod soft-deletes (deletionTimestamp stamped,
+    object retained); the kubelet winds it down to a terminal phase; only
+    clearing the finalizer completes the removal (registry/store.go's
+    finalizer gate + pod_workers' termination)."""
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(st, [make_node("n0")], clock=lambda: clock[0])
+    cluster.start()
+    st.create(PODS, "default/p", dataclasses.replace(
+        make_pod("p", node_name="n0"), finalizers=("example.com/guard",),
+    ))
+    cluster.pump()
+    assert st.get(PODS, "default/p")[0].phase == "Running"
+    w = st.watch(PODS, st.resource_version)
+    st.delete(PODS, "default/p")
+    got = st.get(PODS, "default/p")[0]
+    assert got is not None                       # retained: finalizer holds
+    assert got.deletion_timestamp is not None
+    assert [e.type for e in w.poll()] == ["MODIFIED"]   # soft delete
+    st.delete(PODS, "default/p")                 # repeat delete: no-op
+    cluster.pump()                               # kubelet kills the pod
+    got = st.get(PODS, "default/p")[0]
+    assert got.phase == "Failed"
+    # clearing the finalizer completes the deletion (DELETED event)
+    live, rv = st.get(PODS, "default/p")
+    st.update(PODS, "default/p",
+              dataclasses.replace(live, finalizers=()), expect_rv=rv)
+    assert st.get(PODS, "default/p")[0] is None
+    evs = w.poll()
+    assert evs[-1].type == "DELETED"
+
+
+def test_hollow_kubelet_startup_delay():
+    """The probe-analog window: a bound pod stays Pending for
+    start_delay_s before the kubelet reports Running."""
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(
+        st, [make_node("n0")], clock=lambda: clock[0], start_delay_s=5.0,
+    )
+    cluster.start()
+    st.create(PODS, "default/p", make_pod("p", node_name="n0"))
+    cluster.pump()
+    assert st.get(PODS, "default/p")[0].phase == "Pending"
+    clock[0] = 4.9
+    cluster.pump()
+    assert st.get(PODS, "default/p")[0].phase == "Pending"
+    clock[0] = 5.1
+    cluster.pump()
+    assert st.get(PODS, "default/p")[0].phase == "Running"
+
+
+def test_job_pods_carry_tracking_finalizer_and_deletion_cannot_outrun_count():
+    """A job pod deleted mid-flight survives as a soft-deleted object until
+    the controller counts it — exactly-once accounting holds even when the
+    delete lands first (the tracking finalizer's purpose)."""
+    from kubetpu.controllers import JOBS, JobController
+    from kubetpu.controllers.job import JOB_TRACKING
+
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(st, [make_node("n0")], clock=lambda: clock[0])
+    cluster.start()
+    job = t.Job(name="tracked", completions=2, parallelism=2,
+                template=make_pod("tpl", labels={"app": "t"}))
+    st.create(JOBS, job.key, job)
+    jc = JobController(st)
+    jc.start()
+    jc.step()
+    pods, _ = st.list(PODS)
+    assert all(JOB_TRACKING in p.finalizers for _, p in pods)
+    # bind + run + finish one pod via the kubelet
+    for key, p in pods:
+        st.update(PODS, key, p.with_node("n0"))
+    cluster.pump()                          # Pending -> Running
+    cluster.pump()                          # Running -> Succeeded (terminates)
+    # a user/gc DELETE races ahead of the controller's sync
+    first = st.list(PODS)[0][0][0]
+    st.delete(PODS, first)
+    assert st.get(PODS, first)[0] is not None    # finalizer held it
+    for _ in range(4):
+        jc.step()
+    final = st.get(JOBS, job.key)[0]
+    assert final.complete and final.succeeded == 2
+    assert st.list(PODS)[0] == []           # everything counted + removed
+
+
+def test_deleted_job_releases_tracking_finalizers():
+    """Deleting a Job must not leave its pods soft-deleted forever: the
+    controller strips the tracking finalizer from orphans (syncOrphanPod)
+    so the GC cascade completes."""
+    from kubetpu.controllers import GarbageCollector, JOBS, JobController
+
+    st = MemStore()
+    st.create(JOBS, "default/doomed", t.Job(
+        name="doomed", completions=4, parallelism=2,
+        template=make_pod("tpl", labels={"a": "d"})))
+    jc = JobController(st)
+    gc = GarbageCollector(st)
+    jc.start(); gc.start()
+    jc.step(); gc.step()
+    assert len(st.list(PODS)[0]) == 2
+    st.delete(JOBS, "default/doomed")
+    for _ in range(4):
+        gc.step()      # cascades: soft-deletes the finalized pods
+        jc.step()      # orphan release: strips the tracking finalizer
+    assert st.list(PODS)[0] == []
+
+
+def test_killed_running_job_pod_counts_failed_not_succeeded():
+    """A gracefully-deleted RUNNING pod was killed: it must report Failed —
+    never a phantom completion."""
+    from kubetpu.controllers import JOBS, JobController
+
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(st, [make_node("n0")], clock=lambda: clock[0])
+    cluster.start()
+    st.create(JOBS, "default/k", t.Job(
+        name="k", completions=1, parallelism=1,
+        template=make_pod("tpl", labels={"a": "k"})))
+    jc = JobController(st)
+    jc.start(); jc.step()
+    key = st.list(PODS)[0][0][0]
+    st.update(PODS, key, st.get(PODS, key)[0].with_node("n0"))
+    cluster.pump()                  # Pending -> Running
+    assert st.get(PODS, key)[0].phase == "Running"
+    st.delete(PODS, key)            # killed mid-run (soft: finalizer)
+    cluster.pump()                  # wind-down
+    assert st.get(PODS, key)[0].phase == "Failed"
+    for _ in range(4):
+        jc.step()
+    job = st.get(JOBS, "default/k")[0]
+    assert job.succeeded == 0 and job.failed == 1
+    assert not job.complete
+
+
+def test_kubelet_runs_same_key_replacement_pod():
+    """DaemonSet/StatefulSet identity reuse: after delete + re-create under
+    the SAME key, the kubelet must run the replacement (no stale `running`
+    entry skipping it)."""
+    st = MemStore()
+    clock = [0.0]
+    cluster = HollowCluster(st, [make_node("n0")], clock=lambda: clock[0])
+    cluster.start()
+    st.create(PODS, "default/d-n0", make_pod("d-n0", node_name="n0"))
+    cluster.pump()
+    assert st.get(PODS, "default/d-n0")[0].phase == "Running"
+    st.delete(PODS, "default/d-n0")
+    cluster.pump()                  # observes the delete, frees the slot
+    st.create(PODS, "default/d-n0", make_pod("d-n0", node_name="n0"))
+    cluster.pump()
+    assert st.get(PODS, "default/d-n0")[0].phase == "Running"

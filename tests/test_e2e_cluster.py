@@ -134,6 +134,53 @@ def test_multi_process_cluster_end_to_end(tmp_path):
         )
         assert out.returncode == 0
         assert out.stdout.count("Running") == 6
+
+        # a DaemonSet: one pinned pod per node, placed by the scheduler
+        ds = t.DaemonSet(
+            name="agent",
+            selector=t.LabelSelector.of({"app": "agent"}),
+            template=make_pod("tpl", labels={"app": "agent"}, cpu_milli=50),
+        )
+        ds_manifest = tmp_path / "ds.json"
+        ds_manifest.write_text(json.dumps(scheme.encode(ds)))
+        out = subprocess.run(
+            [sys.executable, "-m", "kubetpu", "apply",
+             "-f", str(ds_manifest), "--server", SERVER],
+            env=dict(os.environ, JAX_PLATFORMS="cpu"),
+            capture_output=True, text=True, timeout=60,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr
+
+        def _await_pods(want: set[tuple[str, str]], what: str):
+            deadline = time.monotonic() + 180
+            while time.monotonic() < deadline:
+                pods, _ = remote.list(PODS)
+                got = {
+                    (p.name, p.node_name) for _, p in pods
+                    if p.phase == "Running"
+                }
+                if got == want and len(pods) == len(want):
+                    return
+                time.sleep(0.25)
+            pods, _ = remote.list(PODS)
+            raise AssertionError(
+                f"{what}: {[(p.name, p.node_name, p.phase) for _, p in pods]}"
+            )
+
+        demo_running = {
+            (p.name, p.node_name) for _, p in remote.list(PODS)[0]
+            if p.name.startswith("demo-")
+        }
+        _await_pods(
+            demo_running | {
+                ("agent-worker-0", "worker-0"),
+                ("agent-worker-1", "worker-1"),
+            },
+            "daemonset did not converge",
+        )
+
+        # delete the ReplicaSet: the GARBAGE COLLECTOR cascades its pods
+        # away; the daemon pods (different owner) must survive
         out = subprocess.run(
             [sys.executable, "-m", "kubetpu", "delete",
              "replicasets", "default/demo", "--server", SERVER],
@@ -141,6 +188,10 @@ def test_multi_process_cluster_end_to_end(tmp_path):
             capture_output=True, text=True, timeout=60,
         )
         assert out.returncode == 0
+        _await_pods(
+            {("agent-worker-0", "worker-0"), ("agent-worker-1", "worker-1")},
+            "GC did not cascade the ReplicaSet's pods",
+        )
         nodes, _ = remote.list(NODES)
         assert len(nodes) == 2
     finally:

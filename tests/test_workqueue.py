@@ -1,0 +1,167 @@
+"""Rate-limited workqueue + queue-driven controller base.
+
+Reference contracts under test: client-go util/workqueue queue.go (dirty/
+processing dedup: a key re-added mid-processing re-runs exactly once,
+never concurrently), default_rate_limiters.go (ItemExponentialFailure:
+base*2^n capped), rate_limiting_queue.go (AddRateLimited/Forget), and the
+controller worker loop shape (replica_set.go:622): a failing key retries
+with its own backoff without stalling other keys.
+"""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.controllers.workqueue import (
+    ExponentialBackoff,
+    QueueController,
+    WorkQueue,
+)
+from kubetpu.store import MemStore
+
+
+class Clock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_queue_dedups_while_dirty():
+    q = WorkQueue(clock=Clock())
+    q.add("a")
+    q.add("a")
+    q.add("b")
+    assert q.get() == "a"
+    assert q.get() == "b"
+    assert q.get() is None
+
+
+def test_readd_while_processing_reprocesses_once_after_done():
+    q = WorkQueue(clock=Clock())
+    q.add("a")
+    k = q.get()
+    q.add("a")              # event lands while the worker holds the key
+    assert q.get() is None  # never concurrently
+    q.done(k)
+    assert q.get() == "a"   # exactly once more
+    q.done("a")
+    assert q.get() is None
+
+
+def test_exponential_backoff_doubles_and_caps():
+    rl = ExponentialBackoff(base_s=1.0, max_s=5.0)
+    assert [rl.when("k") for _ in range(5)] == [1.0, 2.0, 4.0, 5.0, 5.0]
+    rl.forget("k")
+    assert rl.when("k") == 1.0
+
+
+def test_add_after_parks_until_due_and_direct_add_outruns():
+    clock = Clock()
+    q = WorkQueue(clock=clock)
+    q.add_after("slow", 10.0)
+    assert q.get() is None
+    assert q.next_due_in() == 10.0
+    clock.now = 9.0
+    assert q.get() is None
+    clock.now = 10.0
+    assert q.get() == "slow"
+    q.done("slow")
+    # a direct add beats a pending delay; the stale heap entry is inert
+    q.add_after("x", 10.0)
+    q.add("x")
+    assert q.get() == "x"
+    q.done("x")
+    clock.now = 25.0
+    assert q.get() is None
+
+
+def test_rate_limited_retry_earliest_due_wins():
+    clock = Clock()
+    q = WorkQueue(clock=clock, limiter=ExponentialBackoff(base_s=2.0))
+    q.add_rate_limited("k")        # due at 2
+    q.add_after("k", 1.0)          # earlier due time replaces the later one
+    clock.now = 1.0
+    assert q.get() == "k"
+
+
+class FlakyController(QueueController):
+    """Syncs 'poison' fails ``fail_n`` times, everything else succeeds."""
+
+    def __init__(self, store, clock, fail_n=3):
+        super().__init__(store, clock=clock)
+        self.watch("widgets", lambda o: [o["key"]])
+        self.fail_n = fail_n
+        self.synced: list[str] = []
+        self.failures = 0
+
+    def sync(self, key):
+        if key == "poison" and self.failures < self.fail_n:
+            self.failures += 1
+            raise RuntimeError("boom")
+        self.synced.append(key)
+
+
+def test_failing_key_backs_off_without_stalling_others():
+    clock = Clock()
+    st = MemStore()
+    st.create("widgets", "poison", {"key": "poison"})
+    st.create("widgets", "ok1", {"key": "ok1"})
+    st.create("widgets", "ok2", {"key": "ok2"})
+    c = FlakyController(st, clock, fail_n=3)
+    c.start()
+    c.step()
+    # first pass: poison failed once, the healthy keys synced anyway
+    assert c.synced == ["ok1", "ok2"]
+    assert c.sync_errors == 1
+    # poison is parked on backoff: stepping without time passing is a no-op
+    assert c.step() == 0
+    due = c.queue.next_due_in()
+    assert due is not None and due > 0
+    # each due window retries once more (exponential spacing)
+    for expected_failures in (2, 3):
+        clock.now += c.queue.next_due_in()
+        c.step()
+        assert c.failures == expected_failures
+    clock.now += c.queue.next_due_in() or 0.0
+    c.step()                        # failures exhausted → sync succeeds
+    assert c.synced == ["ok1", "ok2", "poison"]
+    # success forgot the limiter state: a fresh failure starts at base again
+    assert c.queue.limiter.retries("poison") == 0
+
+
+def test_poisoned_key_dropped_after_max_retries():
+    clock = Clock()
+    st = MemStore()
+    st.create("widgets", "poison", {"key": "poison"})
+    c = FlakyController(st, clock, fail_n=10**9)
+    c.max_retries = 4
+    c.start()
+    for _ in range(20):
+        c.step()
+        wait = c.queue.next_due_in()
+        if wait is None:
+            break
+        clock.now += wait
+    assert c.failures == 5          # the initial attempt + 4 retries
+    assert c.dropped_keys == 1
+    assert len(c.queue) == 0        # nothing parked forever
+
+
+def test_only_dirty_keys_are_synced():
+    """The scaling contract: N objects at rest cost ZERO sync work; one
+    update dirties exactly one key."""
+    clock = Clock()
+    st = MemStore()
+    for i in range(50):
+        st.create("widgets", f"w{i}", {"key": f"w{i}"})
+    c = FlakyController(st, clock)
+    c.start()
+    c.step()
+    assert len(c.synced) == 50      # initial list syncs everything once
+    c.synced.clear()
+    assert c.step() == 0            # at rest: no rescans
+    st.update("widgets", "w7", {"key": "w7"})
+    c.step()
+    assert c.synced == ["w7"]       # exactly the dirty key
