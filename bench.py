@@ -39,14 +39,20 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
 
 import kubetpu  # noqa: F401  (enables x64)
 
-# (case, workload, engine); ordered: quadratic/batched evidence first
+# (case, workload, engine, mode, max_batch); ordered: quadratic/batched
+# evidence first. "fullstack" drives the SAME op list through an in-process
+# REST apiserver + RemoteStore + informers + HTTP binds — the reference
+# harness's own shape (util.go:96) — so the direct-vs-fullstack delta (the
+# apiserver tax) is measured, not assumed.
 STAGES = [
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched"),
-    ("TopologySpreading", "5000Nodes_5000Pods", "batched"),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "batched"),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy"),
-    ("TopologySpreading", "5000Nodes_5000Pods", "greedy"),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy"),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024),
+    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024),
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024),
 ]
 TOTAL_BUDGET_S = 1500.0     # skip remaining stages past this
 STAGE_TIMEOUT_S = 300.0     # per-phase settle timeout inside the runner
@@ -71,16 +77,22 @@ def _emit(line: dict) -> None:
     print(json.dumps(line), flush=True)
 
 
-def run_stage(case: str, workload: str, engine: str) -> dict:
-    from kubetpu.perf.runner import run_workload
+def run_stage(
+    case: str, workload: str, engine: str,
+    mode: str = "direct", max_batch: int = 1024,
+) -> dict:
+    from kubetpu.perf.runner import run_workload, run_workload_full_stack
 
+    runner = run_workload if mode == "direct" else run_workload_full_stack
     t0 = time.perf_counter()
-    r = run_workload(
+    r = runner(
         case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
+        max_batch=max_batch,
     )
     wall = time.perf_counter() - t0
+    suffix = "" if mode == "direct" else "_fullstack"
     out = {
-        "metric": f"{case}_{workload}_{engine}",
+        "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
         "unit": "pods/s",
         "vs_baseline": (
@@ -92,9 +104,12 @@ def run_stage(case: str, workload: str, engine: str) -> dict:
         "duration_s": round(r.duration_s, 2),
         "cycles": r.cycles,
         "engine": engine,
+        "mode": mode,
         "backend": _backend(),
         "wall_s": round(wall, 1),
     }
+    if r.threshold_note:
+        out["threshold_note"] = r.threshold_note
     if r.p99_attempt_latency_ms is not None:
         out["p99_attempt_latency_ms"] = round(r.p99_attempt_latency_ms, 1)
     return out
@@ -121,10 +136,15 @@ def _probe_backend(timeout_s: float = 180.0) -> str:
 CPU_FALLBACK_STAGES = [
     # reduced shapes: the point of the fallback is a REAL number from the
     # real loop when the TPU relay is down, not a zero artifact — labeled
-    # backend "cpu" so the driver/judge can tell it apart
-    ("SchedulingPodAffinity", "500Nodes", "batched"),
-    ("TopologySpreading", "500Nodes", "batched"),
-    ("SchedulingBasic", "500Nodes", "greedy"),
+    # backend "cpu" so the driver/judge can tell it apart. Every reduced
+    # workload carries a SCALED threshold (documented in its
+    # threshold_note) so vs_baseline is never null, and max_batch=128
+    # forces >= 5 measured cycles (a steady-state claim, not one batch).
+    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128),
+    ("TopologySpreading", "500Nodes", "batched", "direct", 128),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128),
 ]
 
 
@@ -143,22 +163,23 @@ def main() -> None:
     t_start = time.perf_counter()
     best_quadratic: dict | None = None
     best_any: dict | None = None
-    for case, workload, engine in STAGES:
+    for case, workload, engine, mode, max_batch in STAGES:
         elapsed = time.perf_counter() - t_start
         if elapsed > TOTAL_BUDGET_S:
             _status(f"budget exhausted ({elapsed:.0f}s); skipping {case}/{engine}")
             continue
-        _status(f"stage start: {case}/{workload}/{engine} (t={elapsed:.0f}s)")
+        _status(f"stage start: {case}/{workload}/{engine}/{mode} (t={elapsed:.0f}s)")
+        suffix = "" if mode == "direct" else "_fullstack"
         try:
-            line = run_stage(case, workload, engine)
+            line = run_stage(case, workload, engine, mode, max_batch)
         except Exception as e:
             _emit({
-                "metric": f"{case}_{workload}_{engine}", "value": 0.0,
+                "metric": f"{case}_{workload}_{engine}{suffix}", "value": 0.0,
                 "unit": "pods/s", "vs_baseline": 0.0, "engine": engine,
-                "backend": _backend(),
+                "mode": mode, "backend": _backend(),
                 "error": f"{type(e).__name__}: {e}",
             })
-            _status(f"stage FAILED: {case}/{workload}/{engine}: {e}")
+            _status(f"stage FAILED: {case}/{workload}/{engine}/{mode}: {e}")
             continue
         _emit(line)
         _status(f"stage done: {line['metric']} = {line['value']} pods/s "

@@ -89,7 +89,9 @@ def pod_nonzero_requests(
     100 mCPU / 200 MiB (getNonMissingContainerRequests, :1387), then the same
     max(sum(containers), max(init)) + overhead aggregation runs. The defaults
     are per-container, so a pod with containers [{cpu:500m}, {memory:1GiB}]
-    has Non0CPU = 600m, not 500m.
+    has Non0CPU = 600m, not 500m. A request EXPLICITLY set to zero is NOT
+    defaulted ("Override if un-set, but not if explicitly set to zero" —
+    schedutil GetRequestForResource): a present-but-zero key stays zero.
 
     When pod-level resources are set for a resource, that resource's default
     is not filled (the pod-level value wins).
@@ -98,9 +100,9 @@ def pod_nonzero_requests(
 
     def fill(c: Mapping[str, int]) -> dict[str, int]:
         out = dict(c)
-        if out.get(CPU, 0) == 0 and not (pod_level and pod_level.get(CPU, 0) > 0):
+        if CPU not in out and not (pod_level and pod_level.get(CPU, 0) > 0):
             out[CPU] = DEFAULT_MILLI_CPU_REQUEST
-        if out.get(MEMORY, 0) == 0 and not (pod_level and pod_level.get(MEMORY, 0) > 0):
+        if MEMORY not in out and not (pod_level and pod_level.get(MEMORY, 0) > 0):
             out[MEMORY] = DEFAULT_MEMORY_REQUEST
         return out
 

@@ -37,44 +37,111 @@ class RemoteUnavailableError(ConnectionError):
 
 class RemoteStore:
     def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+        import threading
+
         self.base = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        # persistent per-THREAD connections (client-go's transport reuse):
+        # a fresh TCP handshake per request would dominate the bind path
+        self._local = threading.local()
 
     # ------------------------------------------------------------ plumbing
-    def _request(self, method: str, path: str, body: dict | None = None):
-        data = json.dumps(body).encode() if body is not None else None
-        req = urllib.request.Request(
-            f"{self.base}{path}", data=data, method=method,
-            headers={"Content-Type": "application/json"},
+    def _connection(self):
+        """→ (conn, reused): ``reused`` marks a kept-alive socket — the
+        idle-close race (server dropped it between our requests) is the one
+        failure where resending is provably safe for any verb."""
+        import socket
+        from urllib.parse import urlsplit
+
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
+            return conn, True
+        u = urlsplit(self.base)
+        conn = http.client.HTTPConnection(
+            u.hostname, u.port, timeout=self.timeout_s
         )
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                return json.loads(r.read() or b"{}")
-        except urllib.error.HTTPError as e:
-            payload = {}
+        conn.connect()
+        # request bodies are small: without TCP_NODELAY, Nagle +
+        # delayed-ACK stalls every keep-alive request ~40 ms
+        conn.sock.setsockopt(
+            socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
+        )
+        self._local.conn = conn
+        return conn, False
+
+    def _drop_connection(self) -> None:
+        conn = getattr(self._local, "conn", None)
+        if conn is not None:
             try:
-                payload = json.loads(e.read() or b"{}")
-            except Exception:
+                conn.close()
+            except OSError:
                 pass
-            reason = payload.get("error", str(e))
-            if e.code == 409:
-                raise ConflictError(reason) from None
-            if e.code == 410:
-                raise CompactedError(reason) from None
-            if e.code == 404:
-                raise KeyError(reason) from None
-            if e.code in (400, 422):
-                # 400: malformed request (bad selector); 422: strategy
-                # validation rejected the object (admission.py)
-                raise ValueError(reason) from None
-            if e.code == 403:
-                # validating admission hook vetoed the write
-                raise PermissionError(reason) from None
-            raise RemoteStoreError(f"{e.code}: {reason}") from None
-        except (urllib.error.URLError, TimeoutError, OSError) as e:
-            # transient transport failure → retryable (HTTPError is a
-            # URLError subclass, so it must be handled above first)
-            raise RemoteUnavailableError(str(e)) from None
+        self._local.conn = None
+
+    def _request(self, method: str, path: str, body: dict | None = None):
+        """One request with ONE safe retry. Blindly resending a non-
+        idempotent verb after a transport error could double-apply it (a
+        create whose response was lost resends → 409 for a create that
+        SUCCEEDED), so the retry is limited to failures that prove the
+        server never processed the request: a send-phase error, or the
+        keep-alive idle-close race (RemoteDisconnected on a REUSED socket —
+        the server dropped the idle connection before reading). GETs retry
+        on any transport error; everything else surfaces as
+        RemoteUnavailableError for the caller to decide."""
+        data = json.dumps(body).encode() if body is not None else None
+        status, raw = 0, b""
+        last: Exception | None = None
+        for attempt in range(2):
+            conn, reused = self._connection()
+            try:
+                conn.request(
+                    method, path, body=data,
+                    headers={"Content-Type": "application/json"},
+                )
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                # send never completed: safe to retry any verb once
+                self._drop_connection()
+                last = e
+                continue
+            try:
+                resp = conn.getresponse()
+                status, raw = resp.status, resp.read()
+                break
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                self._drop_connection()
+                last = e
+                idle_close = reused and isinstance(
+                    e, (http.client.RemoteDisconnected, ConnectionResetError)
+                )
+                if attempt == 0 and (method == "GET" or idle_close):
+                    continue
+                raise RemoteUnavailableError(str(e)) from None
+        else:
+            raise RemoteUnavailableError(str(last)) from None
+        if status < 400:
+            return json.loads(raw or b"{}")
+        payload = {}
+        try:
+            payload = json.loads(raw or b"{}")
+        except Exception:
+            pass
+        reason = payload.get("error", f"HTTP {status}")
+        if status == 409:
+            raise ConflictError(reason)
+        if status == 410:
+            raise CompactedError(reason)
+        if status == 404:
+            raise KeyError(reason)
+        if status in (400, 422):
+            # 400: malformed request (bad selector); 422: strategy
+            # validation rejected the object (admission.py)
+            raise ValueError(reason)
+        if status == 403:
+            # validating admission hook vetoed the write
+            raise PermissionError(reason)
+        raise RemoteStoreError(f"{status}: {reason}")
 
     # ------------------------------------------------------ store protocol
     def get(self, kind: str, key: str):

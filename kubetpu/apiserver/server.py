@@ -307,6 +307,10 @@ class APIServer:
         self.registry = registry if registry is not None else Registry()
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
+            # responses are small; Nagle + the client's delayed ACK would
+            # stall every keep-alive request ~40 ms (a handler-class knob:
+            # socketserver.StreamRequestHandler.disable_nagle_algorithm)
+            "disable_nagle_algorithm": True,
         })
 
         class _Server(ThreadingHTTPServer):

@@ -108,7 +108,7 @@ def node_should_run(ds: t.DaemonSet, node: t.Node) -> bool:
 
 class DaemonSetController(QueueController):
     def __init__(self, store: MemStore, clock=None) -> None:
-        super().__init__(store, **({"clock": clock} if clock else {}))
+        super().__init__(store, clock=clock)
         self._ds = self.watch(DAEMON_SETS, lambda ds: [ds.key])
         self._nodes = self.watch(NODES, self._node_keys)
         self._pods = self.watch(PODS, self._pod_keys)

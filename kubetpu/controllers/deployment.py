@@ -46,7 +46,7 @@ def _owner_ref(d: t.Deployment) -> str:
 
 class DeploymentController(QueueController):
     def __init__(self, store: MemStore, clock=None) -> None:
-        super().__init__(store, **({"clock": clock} if clock else {}))
+        super().__init__(store, clock=clock)
         self._deps = self.watch(DEPLOYMENTS, lambda d: [d.key])
         self._rs = self.watch(REPLICA_SETS, self._rs_keys)
         self._pods = self.watch(PODS, self._pod_keys)

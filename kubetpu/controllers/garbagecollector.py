@@ -65,7 +65,7 @@ class GarbageCollector(QueueController):
     """Queue keys are ``(bucket, key)`` pairs — one dependent to check."""
 
     def __init__(self, store: MemStore, clock=None) -> None:
-        super().__init__(store, **({"clock": clock} if clock else {}))
+        super().__init__(store, clock=clock)
         # owner ref ("Kind/<ns>/<name>") -> {(bucket, key)} dependents
         self._dependents: dict[str, set[tuple[str, str]]] = {}
         # (bucket, key) -> owner ref currently indexed for it

@@ -44,7 +44,7 @@ def _owner_ref(job: t.Job) -> str:
 
 class JobController(QueueController):
     def __init__(self, store: MemStore, clock=None) -> None:
-        super().__init__(store, **({"clock": clock} if clock else {}))
+        super().__init__(store, clock=clock)
         self._jobs = self.watch(JOBS, lambda j: [j.key])
         self._pods = self.watch(PODS, self._pod_keys)
         self._owned = OwnerIndex(self._pods)
@@ -78,22 +78,28 @@ class JobController(QueueController):
 
     def _release_orphans(self, ref: str) -> None:
         for k in self._owned.get(ref):
-            live, rv = self.store.get(PODS, k)
-            if live is None or JOB_TRACKING not in live.finalizers:
-                continue
-            try:
-                self.store.update(
-                    PODS, k,
-                    dataclasses.replace(
-                        live,
-                        finalizers=tuple(
-                            f for f in live.finalizers if f != JOB_TRACKING
-                        ),
+            self._clear_tracking_finalizer(k)
+
+    def _clear_tracking_finalizer(self, key: str) -> None:
+        """Strip JOB_TRACKING from the LIVE pod (CAS); on a terminating pod
+        this completes its removal (the store's finalizer gate). Conflicts
+        are left for the next event-driven sync."""
+        live, rv = self.store.get(PODS, key)
+        if live is None or JOB_TRACKING not in live.finalizers:
+            return
+        try:
+            self.store.update(
+                PODS, key,
+                dataclasses.replace(
+                    live,
+                    finalizers=tuple(
+                        f for f in live.finalizers if f != JOB_TRACKING
                     ),
-                    expect_rv=rv,
-                )
-            except ConflictError:
-                pass   # next event retries
+                ),
+                expect_rv=rv,
+            )
+        except ConflictError:
+            pass
 
     def _sync(self, job: t.Job, owned: list) -> int:
         wrote = 0
@@ -181,20 +187,5 @@ class JobController(QueueController):
                 self.store.delete(PODS, key)
             except KeyError:
                 continue
-            live, rv = self.store.get(PODS, key)
-            if live is None or JOB_TRACKING not in live.finalizers:
-                continue
-            try:
-                self.store.update(
-                    PODS, key,
-                    dataclasses.replace(
-                        live,
-                        finalizers=tuple(
-                            f for f in live.finalizers if f != JOB_TRACKING
-                        ),
-                    ),
-                    expect_rv=rv,
-                )
-            except ConflictError:
-                pass   # a concurrent writer moved it: retried next sync
+            self._clear_tracking_finalizer(key)
         return wrote

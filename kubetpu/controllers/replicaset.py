@@ -38,7 +38,7 @@ def _owner_ref(rs: t.ReplicaSet) -> str:
 
 class ReplicaSetController(QueueController):
     def __init__(self, store: MemStore, clock=None) -> None:
-        super().__init__(store, **({"clock": clock} if clock else {}))
+        super().__init__(store, clock=clock)
         self._rs = self.watch(REPLICA_SETS, lambda rs: [rs.key])
         self._pods = self.watch(PODS, self._pod_keys)
         self._owned = OwnerIndex(self._pods)

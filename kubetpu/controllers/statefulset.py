@@ -34,7 +34,7 @@ def _owner_ref(ss: t.StatefulSet) -> str:
 
 class StatefulSetController(QueueController):
     def __init__(self, store: MemStore, clock=None) -> None:
-        super().__init__(store, **({"clock": clock} if clock else {}))
+        super().__init__(store, clock=clock)
         self._sets = self.watch(STATEFUL_SETS, lambda ss: [ss.key])
         self._pods = self.watch(PODS, self._pod_keys)
         self._owned = OwnerIndex(self._pods)

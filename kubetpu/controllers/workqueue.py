@@ -201,10 +201,12 @@ class QueueController:
     #: poisoned key from living in the queue for the process lifetime)
     max_retries = 15
 
-    def __init__(self, store, clock: Callable[[], float] = time.monotonic):
+    def __init__(
+        self, store, clock: Callable[[], float] | None = None
+    ) -> None:
         self.store = store
-        self.clock = clock
-        self.queue = WorkQueue(clock=clock)
+        self.clock = clock if clock is not None else time.monotonic
+        self.queue = WorkQueue(clock=self.clock)
         self._informers: dict[str, SharedInformer] = {}
         self._reflectors: list[Reflector] = []
         self.sync_errors = 0

@@ -45,6 +45,7 @@ class WorkloadResult:
     attempts: int
     cycles: int
     p99_attempt_latency_ms: float | None = None
+    threshold_note: str = ""          # derivation of a scaled threshold
 
     def to_json(self) -> dict:
         out = {
@@ -62,6 +63,8 @@ class WorkloadResult:
         if self.threshold is not None:
             out["threshold"] = self.threshold
             out["vs_baseline"] = round(self.vs_threshold, 2)
+        if self.threshold_note:
+            out["threshold_note"] = self.threshold_note
         if self.p99_attempt_latency_ms is not None:
             out["p99_attempt_latency_ms"] = round(self.p99_attempt_latency_ms, 2)
         return out
@@ -489,6 +492,7 @@ def run_workload(
         case_name=case.name,
         workload_name=workload.name,
         threshold=workload.threshold,
+        threshold_note=workload.threshold_note,
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops
@@ -517,6 +521,183 @@ def run_workload(
     )
     sched.close()
     return result
+
+
+def run_workload_full_stack(
+    case: W.TestCase | str,
+    workload: W.Workload | str,
+    profile: C.Profile | None = None,
+    max_batch: int = 1024,
+    timeout_s: float = 1800.0,
+    engine: str = "greedy",
+    stall_s: float = 15.0,
+    warmup: bool = True,
+) -> WorkloadResult:
+    """The same measurement through the FULL STACK: an in-process REST
+    apiserver + RemoteStore + informers + dispatcher binds over HTTP —
+    the reference harness's shape (scheduler_perf boots a real apiserver
+    and measures through it, test/integration/scheduler_perf/util.go:96).
+    Supports the simple op shapes (createNodes/createNamespaces/
+    createPods/barrier) — SchedulingBasic and the quadratic affinity/
+    spreading cases; richer ops raise.
+
+    The direct-vs-full-stack delta is the apiserver tax: run both modes on
+    one workload to measure what the REST hop costs."""
+    import collections
+
+    from ..apiserver import APIServer, RemoteStore
+    from ..client import SchedulerInformers, StoreClient
+    from ..client.informers import NAMESPACES, NODES, PODS
+
+    if isinstance(case, str):
+        case = W.TEST_CASES[case]
+    if isinstance(workload, str):
+        workload = next(w for w in case.workloads if w.name == workload)
+    params = dict(workload.params)
+    supported = (
+        W.CreateNodesOp, W.CreateNamespacesOp, W.CreatePodsOp, W.BarrierOp,
+    )
+    for op in case.ops:
+        if not isinstance(op, supported):
+            raise NotImplementedError(
+                f"full-stack mode does not drive {type(op).__name__}"
+            )
+
+    srv = APIServer().start()
+    remote = RemoteStore(srv.url)
+
+    class _CountingClient(StoreClient):
+        def __init__(self, store) -> None:
+            import threading
+
+            super().__init__(store)
+            self.bound_by_ns: collections.Counter = collections.Counter()
+            self._count_lock = threading.Lock()   # dispatcher workers bind
+            #                                       concurrently
+
+        def bind(self, pod, node_name) -> None:
+            super().bind(pod, node_name)
+            with self._count_lock:
+                self.bound_by_ns[pod.namespace] += 1
+
+    client = _CountingClient(remote)
+    sched = Scheduler(
+        client, profile=profile or C.Profile(), max_batch=max_batch,
+        engine=engine,
+        feature_gates=dict(case.feature_gates) if case.feature_gates else None,
+    )
+    informers = SchedulerInformers(remote, sched)
+    informers.start()
+
+    measured = 0
+    duration = 0.0
+    attempts0 = cycles0 = 0
+    lat0 = None
+    op_ns_counter = 0
+
+    def settle(target: int, namespaces: tuple[str, ...]) -> tuple[int, float]:
+        def bound_now() -> int:
+            return sum(client.bound_by_ns[ns] for ns in namespaces)
+
+        start = bound_now()
+        done = 0
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        last_progress = t0
+        while done < target:
+            now = time.perf_counter()
+            if now > deadline:
+                break
+            moved = informers.pump()
+            res = sched.schedule_batch()
+            sched.dispatcher.sync()
+            sched._drain_bind_completions()
+            before = done
+            done = bound_now() - start
+            if done == before and res["scheduled"] == 0 and not moved:
+                if now - last_progress > stall_s:
+                    break
+                time.sleep(0.005)
+            else:
+                last_progress = now
+        return done, time.perf_counter() - t0
+
+    try:
+        for op_i, op in enumerate(case.ops):
+            if isinstance(op, W.CreateNodesOp):
+                n = op.count or params[op.count_param]
+                factory = op.template or W.node_default
+                for i in range(n):
+                    node = factory(i, op.zones)
+                    remote.create(NODES, node.name, node)
+            elif isinstance(op, W.CreateNamespacesOp):
+                n = params[op.count_param] if op.count_param else op.count
+                for i in range(n):
+                    remote.create(NAMESPACES, f"{op.prefix}-{i}", t.Namespace(
+                        name=f"{op.prefix}-{i}", labels=op.labels,
+                    ))
+            elif isinstance(op, W.BarrierOp):
+                informers.pump()
+                sched.run_until_idle()
+            elif isinstance(op, W.CreatePodsOp):
+                count = params[op.count_param]
+                template = op.template or case.default_pod_template
+                ns = op.namespace or f"namespace-{op_ns_counter}"
+                op_ns_counter += 1
+                prefix = (
+                    f"{'measure' if op.collect_metrics else 'init'}-{op_i}"
+                )
+                informers.pump()
+                if op.collect_metrics:
+                    attempts0, cycles0, lat0 = _begin_measured_phase(
+                        sched, warmup,
+                        [
+                            template(f"warmup-{op_i}-{j}", ns)
+                            for j in range(min(count, sched.max_batch))
+                        ],
+                    )
+                for j in range(count):
+                    pod = template(f"{prefix}-{ns}-{j}", ns)
+                    remote.create(PODS, f"{ns}/{pod.name}", pod)
+                if op.skip_wait:
+                    continue
+                done, secs = settle(count, (ns,))
+                if op.collect_metrics:
+                    measured += done
+                    duration += secs
+        informers.pump()
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+    finally:
+        sched.close()
+        srv.close()
+
+    lat = None
+    if lat0 is not None:
+        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(lat0)
+        if delta.total > 0:
+            lat = float(delta.quantile(0.99) * 1000.0)
+    throughput = measured / duration if duration > 0 else 0.0
+    return WorkloadResult(
+        case_name=case.name,
+        workload_name=workload.name + "_fullstack",
+        threshold=workload.threshold,
+        threshold_note=workload.threshold_note,
+        measure_pods=sum(
+            params[op.count_param]
+            for op in case.ops
+            if isinstance(op, W.CreatePodsOp) and op.collect_metrics
+        ),
+        scheduled=measured,
+        duration_s=duration,
+        throughput=throughput,
+        vs_threshold=(
+            throughput / workload.threshold if workload.threshold else None
+        ),
+        attempts=sched.metrics.schedule_attempts - attempts0,
+        cycles=sched.metrics.cycles - cycles0,
+        p99_attempt_latency_ms=lat,
+    )
 
 
 def run_label(label: str = "performance", **kwargs) -> list[WorkloadResult]:

@@ -441,6 +441,11 @@ class Workload:
     params: Mapping[str, int]
     threshold: float | None = None          # SchedulingThroughput floor
     labels: tuple[str, ...] = ()
+    # Documented derivation when ``threshold`` is NOT a verbatim reference
+    # floor (the reduced-shape CPU-fallback workloads): how the floor was
+    # scaled from the full-shape reference number, so ``vs_baseline`` is
+    # never null and never silently flattering
+    threshold_note: str = ""
 
 
 @dataclass(frozen=True)
@@ -475,7 +480,12 @@ _case(TestCase(
         CreatePodsOp("measurePods", collect_metrics=True),
     ),
     workloads=(
-        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000}),
+        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000},
+                 threshold=680, threshold_note=(
+                     "5k floor kept verbatim: per-pod cost of the linear "
+                     "workload is ~flat in node count (the reference "
+                     "subsamples via numFeasibleNodesToFind), so its 500-"
+                     "node throughput is >= the 5k floor")),
         Workload("5000Nodes_10000Pods",
                  {"initNodes": 5000, "initPods": 1000, "measurePods": 10000},
                  threshold=680, labels=("performance",)),
@@ -530,7 +540,12 @@ _case(TestCase(
         CreatePodsOp("measurePods", collect_metrics=True, namespace="sched-1"),
     ),
     workloads=(
-        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000}),
+        Workload("500Nodes", {"initNodes": 500, "initPods": 500, "measurePods": 1000},
+                 threshold=700, threshold_note=(
+                     "70 pods/s 5k floor x10: the quadratic PreScore cost "
+                     "scales ~linearly with node count, so at 1/10 the "
+                     "nodes the reference would run ~10x its floor — the "
+                     "scaled floor keeps vs_baseline conservative")),
         Workload("5000Nodes_5000Pods",
                  {"initNodes": 5000, "initPods": 5000, "measurePods": 5000},
                  threshold=70, labels=("performance",)),
@@ -564,7 +579,11 @@ _case(TestCase(
                      collect_metrics=True),
     ),
     workloads=(
-        Workload("500Nodes", {"initNodes": 500, "initPods": 1000, "measurePods": 1000}),
+        Workload("500Nodes", {"initNodes": 500, "initPods": 1000, "measurePods": 1000},
+                 threshold=4600, threshold_note=(
+                     "460 pods/s 5k floor x10: segment-sum PreScore cost "
+                     "scales ~linearly with node count (see "
+                     "SchedulingPodAffinity scaling note)")),
         Workload("5000Nodes_5000Pods",
                  {"initNodes": 5000, "initPods": 5000, "measurePods": 5000},
                  threshold=460, labels=("performance",)),

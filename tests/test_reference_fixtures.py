@@ -1,0 +1,567 @@
+"""Golden fixtures ported from the REFERENCE's own table-driven unit tests.
+
+Every expectation below is the reference authors' — the tables are
+re-expressed as data (cited per case), then asserted against THIS
+framework's tensor kernels through the real profile/encode/score path.
+This breaks the same-author-on-both-sides loop of ``tests/oracle.py``
+(SURVEY §4: diff against recorded reference behavior): the oracle is our
+reading of the Go; these numbers are the Go project's own.
+
+Sources (file:line cite the case's location in /root/reference):
+- pkg/scheduler/framework/plugins/noderesources/least_allocated_test.go
+- pkg/scheduler/framework/plugins/noderesources/balanced_allocation_test.go
+- pkg/scheduler/framework/plugins/noderesources/fit_test.go
+- pkg/scheduler/framework/plugins/podtopologyspread/scoring_test.go
+- pkg/scheduler/framework/plugins/interpodaffinity/scoring_test.go
+
+Conventions carried over exactly: ``Req(a).Req(b)`` is a pod with TWO
+containers; memory quantities are plain byte counts; a ``MakePod().Obj()``
+with no containers has a zero request (containers=[] here — the NonZero
+per-container defaults apply only to containers that exist, and a request
+explicitly set to zero is NOT defaulted).
+"""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.framework import config as C
+from kubetpu.framework import encode_batch, runtime as rt, score_params
+from kubetpu.state import Cache
+
+HOSTNAME = "kubernetes.io/hostname"
+MAX = 100
+
+
+def run_single(profile, nodes, existing, pod):
+    """(mask_row, total_row) for ONE pending pod through the real
+    profile → encode → device filter/score program."""
+    cache = Cache()
+    for n in nodes:
+        cache.add_node(n)
+    for p in existing:
+        cache.add_pod(p)
+    snap = cache.update_snapshot()
+    batch = encode_batch(snap, [pod], profile, pad=False)
+    params = score_params(profile, batch.resource_names)
+    mask, total = rt.filter_score_batch(batch.device, params)
+    return np.asarray(mask)[0], np.asarray(total)[0]
+
+
+def score_profile(plugin):
+    """Score-only profile: an always-true filter so every node is scored
+    (the reference score tables run Score on all listed nodes)."""
+    return C.Profile(
+        filters=C.PluginSet(enabled=((C.NODE_UNSCHEDULABLE, 1),)),
+        scores=C.PluginSet(enabled=((plugin, 1),)),
+        default_spread_constraints=(),
+    )
+
+
+# --------------------------------------------------------- LeastAllocated
+# least_allocated_test.go:49 TestLeastAllocatedScoringStrategy — nodes are
+# MakeNode().Capacity({cpu: <milli>, memory: <bytes>}); requestedPod uses
+# one container per Req(); expected scores are the in-comment arithmetic.
+
+LEAST_ALLOCATED_CASES = [
+    # :58 "nothing scheduled, nothing requested" — a pod with NO containers
+    # requests zero (no per-container defaults to apply)
+    dict(
+        cite="least_allocated_test.go:58",
+        pod_containers=[],
+        nodes=[(4000, 10000), (4000, 10000)],
+        existing=[],
+        want=[MAX, MAX],
+    ),
+    # :69 "nothing scheduled, resources requested, differently sized nodes"
+    dict(
+        cite="least_allocated_test.go:69",
+        pod_containers=[{"cpu": 1000, "memory": 2000},
+                        {"cpu": 2000, "memory": 3000}],
+        nodes=[(4000, 10000), (6000, 10000)],
+        existing=[],
+        want=[37, 50],
+    ),
+    # :105 "no resources requested, pods scheduled" — existing pods with no
+    # containers contribute nothing
+    dict(
+        cite="least_allocated_test.go:105",
+        pod_containers=[],
+        nodes=[(4000, 10000), (4000, 10000)],
+        existing=[("node1", []), ("node1", []), ("node2", []), ("node2", [])],
+        want=[MAX, MAX],
+    ),
+    # :126 "no resources requested, pods scheduled with resources" — the
+    # existing pods set memory EXPLICITLY to 0 (not defaulted)
+    dict(
+        cite="least_allocated_test.go:126",
+        pod_containers=[],
+        nodes=[(10000, 20000), (10000, 20000)],
+        existing=[
+            ("node1", [{"cpu": 3000, "memory": 0}]),
+            ("node1", [{"cpu": 3000, "memory": 0}]),
+            ("node2", [{"cpu": 3000, "memory": 0}]),
+            ("node2", [{"cpu": 3000, "memory": 5000}]),
+        ],
+        want=[70, 57],
+    ),
+    # :155 "resources requested, pods scheduled with resources"
+    dict(
+        cite="least_allocated_test.go:155",
+        pod_containers=[{"cpu": 1000, "memory": 2000},
+                        {"cpu": 2000, "memory": 3000}],
+        nodes=[(10000, 20000), (10000, 20000)],
+        existing=[
+            ("node1", [{"cpu": 3000, "memory": 0}]),
+            ("node2", [{"cpu": 3000, "memory": 5000}]),
+        ],
+        want=[57, 45],
+    ),
+    # :182 "resources requested, pods scheduled with resources, differently
+    # sized nodes"
+    dict(
+        cite="least_allocated_test.go:182",
+        pod_containers=[{"cpu": 1000, "memory": 2000},
+                        {"cpu": 2000, "memory": 3000}],
+        nodes=[(10000, 20000), (10000, 50000)],
+        existing=[
+            ("node1", [{"cpu": 3000, "memory": 0}]),
+            ("node2", [{"cpu": 3000, "memory": 5000}]),
+        ],
+        want=[57, 60],
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "case", LEAST_ALLOCATED_CASES, ids=[c["cite"] for c in LEAST_ALLOCATED_CASES]
+)
+def test_least_allocated_reference_table(case):
+    nodes = [
+        make_node(f"node{i+1}", cpu_milli=cpu, memory=mem)
+        for i, (cpu, mem) in enumerate(case["nodes"])
+    ]
+    existing = [
+        make_pod(f"e{i}", node_name=node, containers=cs)
+        for i, (node, cs) in enumerate(case["existing"])
+    ]
+    pod = make_pod("p", containers=case["pod_containers"])
+    _, total = run_single(
+        score_profile(C.NODE_RESOURCES_FIT), nodes, existing, pod
+    )
+    assert list(total) == case["want"], case["cite"]
+
+
+# ---------------------------------------------------- BalancedAllocation
+# balanced_allocation_test.go:50 testNodeResourcesBalancedAllocation —
+# cpuAndMemory/cpuOnly containers; makeNode(name, milliCPU, memory).
+# cpuOnly containers omit memory entirely — irrelevant here because
+# BalancedAllocation uses EXACT requests (useRequested), not NonZero.
+
+BALANCED_CASES = [
+    # :79 "nothing scheduled, resources requested, differently sized nodes"
+    dict(
+        cite="balanced_allocation_test.go:79",
+        pod_containers=[{"cpu": 1000, "memory": 2000},
+                        {"cpu": 2000, "memory": 3000}],
+        nodes=[(4000, 10000), (6000, 10000)],
+        existing=[],
+        want=[68, 75],
+    ),
+    # :96 "resources requested, pods scheduled with resources"
+    dict(
+        cite="balanced_allocation_test.go:96",
+        pod_containers=[{"cpu": 1000, "memory": 2000},
+                        {"cpu": 2000, "memory": 3000}],
+        nodes=[(10000, 20000), (10000, 20000)],
+        existing=[
+            ("node1", [{"cpu": 1000}, {"cpu": 2000}]),
+            ("node2", [{"cpu": 1000, "memory": 2000},
+                       {"cpu": 2000, "memory": 3000}]),
+        ],
+        want=[73, 74],
+    ),
+    # :119 "…differently sized nodes"
+    dict(
+        cite="balanced_allocation_test.go:119",
+        pod_containers=[{"cpu": 1000, "memory": 2000},
+                        {"cpu": 2000, "memory": 3000}],
+        nodes=[(10000, 20000), (10000, 50000)],
+        existing=[
+            ("node1", [{"cpu": 1000}, {"cpu": 2000}]),
+            ("node2", [{"cpu": 1000, "memory": 2000},
+                       {"cpu": 2000, "memory": 3000}]),
+        ],
+        want=[73, 70],
+    ),
+    # :134 "nodes to reach min/max score"
+    dict(
+        cite="balanced_allocation_test.go:134",
+        pod_containers=[{"memory": 2000}, {"memory": 3000}],
+        nodes=[(3000, 5000), (3000, 5000)],
+        existing=[
+            ("node1", [{"cpu": 1000}, {"cpu": 2000}]),
+        ],
+        want=[100, 50],
+    ),
+    # :156 "requested resources at node capacity"
+    dict(
+        cite="balanced_allocation_test.go:156",
+        pod_containers=[{"cpu": 1000}, {"cpu": 2000}],
+        nodes=[(6000, 10000), (6000, 10000)],
+        existing=[
+            ("node1", [{"cpu": 1000}, {"cpu": 2000}]),
+            ("node2", [{"cpu": 1000, "memory": 2000},
+                       {"cpu": 2000, "memory": 3000}]),
+        ],
+        want=[62, 62],
+    ),
+]
+
+
+@pytest.mark.parametrize(
+    "case", BALANCED_CASES, ids=[c["cite"] for c in BALANCED_CASES]
+)
+def test_balanced_allocation_reference_table(case):
+    nodes = [
+        make_node(f"node{i+1}", cpu_milli=cpu, memory=mem)
+        for i, (cpu, mem) in enumerate(case["nodes"])
+    ]
+    existing = [
+        make_pod(f"e{i}", node_name=node, containers=cs)
+        for i, (node, cs) in enumerate(case["existing"])
+    ]
+    pod = make_pod("p", containers=case["pod_containers"])
+    _, total = run_single(
+        score_profile(C.NODE_RESOURCES_BALANCED),
+        nodes, existing, pod,
+    )
+    assert list(total) == case["want"], case["cite"]
+
+
+# ------------------------------------------------------ NodeResourcesFit
+# fit_test.go:162 enoughPodsTests — node capacity 10 milliCPU / 20 bytes
+# memory (makeResources(10, 20, 32)); existing usage comes from one
+# resource pod; expected = fits / does-not-fit. Init-container rows prove
+# the max(sum(containers), max(init)) aggregation.
+
+FIT_CASES = [
+    dict(cite="fit_test.go:162 'no resources requested always fits'",
+         pod=dict(containers=[]), used=(10, 20), fits=True),
+    dict(cite="fit_test.go:169 'too many resources fails'",
+         pod=dict(containers=[{"cpu": 1, "memory": 1}]),
+         used=(10, 20), fits=False),
+    dict(cite="fit_test.go:180 'too many resources fails due to init container cpu'",
+         pod=dict(containers=[{"cpu": 1, "memory": 1}],
+                  init_containers=[{"cpu": 3, "memory": 1}]),
+         used=(8, 19), fits=False),
+    dict(cite="fit_test.go:190 '…highest init container cpu'",
+         pod=dict(containers=[{"cpu": 1, "memory": 1}],
+                  init_containers=[{"cpu": 3, "memory": 1},
+                                   {"cpu": 2, "memory": 1}]),
+         used=(8, 19), fits=False),
+    dict(cite="fit_test.go:221 'init container fits because it is the max, not sum'",
+         pod=dict(containers=[{"cpu": 1, "memory": 1}],
+                  init_containers=[{"cpu": 1, "memory": 1}]),
+         used=(9, 19), fits=True),
+    dict(cite="fit_test.go:228 'multiple init containers fit…'",
+         pod=dict(containers=[{"cpu": 1, "memory": 1}],
+                  init_containers=[{"cpu": 1, "memory": 1},
+                                   {"cpu": 1, "memory": 1}]),
+         used=(9, 19), fits=True),
+    dict(cite="fit_test.go:235 'both resources fit'",
+         pod=dict(containers=[{"cpu": 1, "memory": 1}]),
+         used=(5, 5), fits=True),
+    dict(cite="fit_test.go:242 'one resource memory fits'",
+         pod=dict(containers=[{"cpu": 2, "memory": 1}]),
+         used=(9, 5), fits=False),
+    dict(cite="fit_test.go:252 'one resource cpu fits'",
+         pod=dict(containers=[{"cpu": 1, "memory": 2}]),
+         used=(5, 19), fits=False),
+    dict(cite="fit_test.go:262 'equal edge case'",
+         pod=dict(containers=[{"cpu": 5, "memory": 1}]),
+         used=(5, 19), fits=True),
+    dict(cite="fit_test.go:268 'equal edge case for init container'",
+         pod=dict(containers=[{"cpu": 4, "memory": 1}],
+                  init_containers=[{"cpu": 5, "memory": 1}]),
+         used=(5, 19), fits=True),
+]
+
+
+@pytest.mark.parametrize("case", FIT_CASES, ids=[c["cite"] for c in FIT_CASES])
+def test_fit_reference_table(case):
+    node = make_node("node1", cpu_milli=10, memory=20, pods=32)
+    used_cpu, used_mem = case["used"]
+    existing = [make_pod(
+        "used", node_name="node1",
+        containers=[{"cpu": used_cpu, "memory": used_mem}],
+    )]
+    pod = make_pod("p", **case["pod"])
+    profile = C.Profile(
+        filters=C.PluginSet(enabled=((C.NODE_RESOURCES_FIT, 1),)),
+        scores=C.PluginSet(enabled=()),
+        default_spread_constraints=(),
+    )
+    mask, _ = run_single(profile, [node], existing, pod)
+    assert bool(mask[0]) == case["fits"], case["cite"]
+
+
+# ------------------------------------------------- PodTopologySpread score
+# podtopologyspread/scoring_test.go:612 TestPodTopologySpreadScore — soft
+# hostname constraint, selector Exists("foo"); expected scores are the
+# normalized per-node values.
+
+FOO_EXISTS = t.LabelSelector(
+    match_expressions=(t.Requirement("foo", t.Operator.EXISTS, ()),)
+)
+
+
+def _spread_pod(max_skew: int) -> t.Pod:
+    return make_pod(
+        "p", labels={"foo": ""},
+        spread=(t.TopologySpreadConstraint(
+            max_skew=max_skew,
+            topology_key=HOSTNAME,
+            when_unsatisfiable=t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY,
+            selector=FOO_EXISTS,
+        ),),
+    )
+
+
+def _hostname_nodes(names):
+    return [
+        make_node(n, cpu_milli=4000, labels={HOSTNAME: n}) for n in names
+    ]
+
+
+SPREAD_CASES = [
+    # :642 "one constraint on node, no existing pods"
+    dict(cite="scoring_test.go:642", max_skew=1,
+         nodes=["node-a", "node-b"], spread=[0, 0], want=[100, 100]),
+    # :677 "all nodes have the same number of matching pods"
+    dict(cite="scoring_test.go:677", max_skew=1,
+         nodes=["node-a", "node-b"], spread=[1, 1], want=[100, 100]),
+    # :696 "all 4 nodes are candidates" — matching pods spread as 2/1/0/3
+    dict(cite="scoring_test.go:696", max_skew=1,
+         nodes=["node-a", "node-b", "node-c", "node-d"],
+         spread=[2, 1, 0, 3], want=[20, 60, 100, 0]),
+    # :749 same spread, maxSkew=2
+    dict(cite="scoring_test.go:749", max_skew=2,
+         nodes=["node-a", "node-b", "node-c", "node-d"],
+         spread=[2, 1, 0, 3], want=[33, 66, 100, 16]),
+    # :777 maxSkew=3, matching pods spread as 4/3/2/1
+    dict(cite="scoring_test.go:777", max_skew=3,
+         nodes=["node-a", "node-b", "node-c", "node-d"],
+         spread=[4, 3, 2, 1], want=[44, 66, 77, 100]),
+]
+
+
+@pytest.mark.parametrize(
+    "case", SPREAD_CASES, ids=[c["cite"] for c in SPREAD_CASES]
+)
+def test_pod_topology_spread_reference_table(case):
+    nodes = _hostname_nodes(case["nodes"])
+    existing = []
+    for node, count in zip(case["nodes"], case["spread"]):
+        for k in range(count):
+            existing.append(make_pod(
+                f"{node}-p{k}", node_name=node, labels={"foo": ""},
+            ))
+    pod = _spread_pod(case["max_skew"])
+    _, total = run_single(
+        score_profile(C.POD_TOPOLOGY_SPREAD), nodes, existing, pod
+    )
+    assert list(total) == case["want"], case["cite"]
+
+
+# ---------------------------------------------- InterPodAffinity score
+# interpodaffinity/scoring_test.go:378 TestPreferredAffinity — region/az
+# node labels, security=S1/S2 pod labels, weighted preferred terms.
+
+RG_CHINA = {"region": "China"}
+RG_INDIA = {"region": "India"}
+AZ_AZ1 = {"az": "az1"}
+RG_CHINA_AZ1 = {"region": "China", "az": "az1"}
+S1 = {"security": "S1"}
+S2 = {"security": "S2"}
+
+
+def _pref(weight, key, op, values, topology="region"):
+    return t.WeightedPodAffinityTerm(weight, t.PodAffinityTerm(
+        topology_key=topology,
+        selector=t.LabelSelector(
+            match_expressions=(t.Requirement(key, op, tuple(values)),)
+        ),
+    ))
+
+
+STAY_S1_REGION = t.Affinity(pod_affinity=t.PodAffinity(
+    preferred=(_pref(5, "security", t.Operator.IN, ["S1"]),)
+))
+STAY_S2_REGION = t.Affinity(pod_affinity=t.PodAffinity(
+    preferred=(_pref(6, "security", t.Operator.IN, ["S2"]),)
+))
+AFFINITY3 = t.Affinity(pod_affinity=t.PodAffinity(preferred=(
+    t.WeightedPodAffinityTerm(8, t.PodAffinityTerm(
+        topology_key="region",
+        selector=t.LabelSelector(match_expressions=(
+            t.Requirement("security", t.Operator.NOT_IN, ("S1",)),
+            t.Requirement("security", t.Operator.IN, ("S2",)),
+        )),
+    )),
+    t.WeightedPodAffinityTerm(2, t.PodAffinityTerm(
+        topology_key="region",
+        selector=t.LabelSelector(match_expressions=(
+            t.Requirement("security", t.Operator.EXISTS, ()),
+            t.Requirement("wrongkey", t.Operator.DOES_NOT_EXIST, ()),
+        )),
+    )),
+)))
+HATE_S1_REGION = t.Affinity(pod_anti_affinity=t.PodAffinity(
+    preferred=(_pref(5, "security", t.Operator.IN, ["S1"]),)
+))
+
+
+def test_interpod_affinity_match_topology_and_pods():
+    """scoring_test.go:400: the node matching topology key AND holding
+    selector-matching pods scores MaxNodeScore; mismatched topology or
+    mismatched pods score 0."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_INDIA),
+        make_node("node3", labels=AZ_AZ1),
+    ]
+    existing = [
+        make_pod("e1", node_name="node1", labels=S1),
+        make_pod("e2", node_name="node2", labels=S2),
+        make_pod("e3", node_name="node3", labels=S1),
+    ]
+    pod = make_pod("p", labels=S1, affinity=STAY_S1_REGION)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [MAX, 0, 0]
+
+
+def test_interpod_affinity_same_topology_value_same_score():
+    """scoring_test.go:420: every node sharing the matching topology label
+    value scores the same."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_CHINA_AZ1),
+        make_node("node3", labels=RG_INDIA),
+    ]
+    existing = [make_pod("e1", node_name="node1", labels=S1)]
+    pod = make_pod("p", affinity=STAY_S1_REGION)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [MAX, MAX, 0]
+
+
+def test_interpod_affinity_region_with_more_matches_wins():
+    """scoring_test.go:437: the region with more matching existing pods
+    scores high on ALL its nodes; the other region's nodes share the low
+    score."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_INDIA),
+        make_node("node3", labels=RG_CHINA),
+        make_node("node4", labels=RG_CHINA),
+        make_node("node5", labels=RG_INDIA),
+    ]
+    existing = [
+        make_pod("e1", node_name="node1", labels=S2),
+        make_pod("e2", node_name="node1", labels=S2),
+        make_pod("e3", node_name="node2", labels=S2),
+        make_pod("e4", node_name="node3", labels=S2),
+        make_pod("e5", node_name="node4", labels=S2),
+        make_pod("e6", node_name="node5", labels=S2),
+    ]
+    pod = make_pod("p", labels=S1, affinity=STAY_S2_REGION)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [MAX, 0, MAX, MAX, 0]
+
+
+def test_interpod_affinity_operators_and_values():
+    """scoring_test.go:458: NotIn/In/Exists operator mix over two weighted
+    terms (8×region + 2×az)."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_INDIA),
+        make_node("node3", labels=AZ_AZ1),
+    ]
+    existing = [
+        make_pod("e1", node_name="node1", labels=S1),
+        make_pod("e2", node_name="node2", labels=S2),
+        make_pod("e3", node_name="node3", labels=S1),
+    ]
+    pod = make_pod("p", labels=S1, affinity=AFFINITY3)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [20, MAX, 0]
+
+
+def test_interpod_affinity_symmetry_preferred():
+    """scoring_test.go:475: SYMMETRY — existing pods' preferred affinity
+    pulls the incoming pod (which matches their selector) toward their
+    topology."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_INDIA),
+        make_node("node3", labels=AZ_AZ1),
+    ]
+    existing = [
+        make_pod("e1", node_name="node1", labels=S1),
+        make_pod("e2", node_name="node2", labels=S2,
+                 affinity=STAY_S1_REGION),
+        make_pod("e3", node_name="node3", labels=S2),
+    ]
+    pod = make_pod("p", labels=S1)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [0, MAX, 0]
+
+
+def test_interpod_anti_affinity_unmatched_node_wins():
+    """scoring_test.go:538: preferred ANTI-affinity — the node whose pods
+    the incoming pod dislikes scores 0, the other MaxNodeScore."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_INDIA),
+    ]
+    existing = [
+        make_pod("e1", node_name="node1", labels=S1),
+        make_pod("e2", node_name="node2", labels=S2),
+    ]
+    pod = make_pod("p", labels=S1, affinity=HATE_S1_REGION)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [0, MAX]
+
+
+def test_interpod_anti_affinity_symmetry():
+    """scoring_test.go:579: ANTI-affinity symmetry — existing pods' anti
+    preference pushes the matching incoming pod away from their node."""
+    nodes = [
+        make_node("node1", labels=RG_CHINA),
+        make_node("node2", labels=RG_INDIA),
+    ]
+    existing = [
+        make_pod("e1", node_name="node1", labels=S2,
+                 affinity=HATE_S1_REGION),
+        make_pod("e2", node_name="node2", labels=S2),
+    ]
+    pod = make_pod("p", labels=S1)
+    _, total = run_single(
+        score_profile(C.INTER_POD_AFFINITY), nodes, existing, pod
+    )
+    assert list(total) == [0, MAX]
