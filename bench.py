@@ -80,15 +80,26 @@ def _emit(line: dict) -> None:
 def run_stage(
     case: str, workload: str, engine: str,
     mode: str = "direct", max_batch: int = 1024,
+    profile_dir: str | None = None,
 ) -> dict:
+    import contextlib
+
     from kubetpu.perf.runner import run_workload, run_workload_full_stack
 
     runner = run_workload if mode == "direct" else run_workload_full_stack
+    ctx: "contextlib.AbstractContextManager" = contextlib.nullcontext()
+    if profile_dir is not None:
+        # XLA device trace of the measured stage (where device time goes —
+        # view with xprof/tensorboard); recorded alongside BENCH results
+        from kubetpu.tracing import device_profile
+
+        ctx = device_profile(profile_dir)
     t0 = time.perf_counter()
-    r = runner(
-        case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
-        max_batch=max_batch,
-    )
+    with ctx:
+        r = runner(
+            case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
+            max_batch=max_batch,
+        )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
     out = {
@@ -170,8 +181,19 @@ def main() -> None:
             continue
         _status(f"stage start: {case}/{workload}/{engine}/{mode} (t={elapsed:.0f}s)")
         suffix = "" if mode == "direct" else "_fullstack"
+        # profile exactly ONE stage: the first quadratic TPU stage (the
+        # north-star workload) — the artifact lands in ./xla_profile/
+        profile_dir = None
+        if (
+            _backend() == "tpu" and case in QUADRATIC
+            and mode == "direct" and not os.path.isdir("xla_profile")
+        ):
+            profile_dir = "xla_profile"
         try:
-            line = run_stage(case, workload, engine, mode, max_batch)
+            line = run_stage(case, workload, engine, mode, max_batch,
+                             profile_dir=profile_dir)
+            if profile_dir is not None:
+                line["xla_profile"] = profile_dir
         except Exception as e:
             _emit({
                 "metric": f"{case}_{workload}_{engine}{suffix}", "value": 0.0,

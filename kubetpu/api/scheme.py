@@ -12,6 +12,18 @@ typed objects across a process boundary.
 Unknown kinds and unknown fields fail loudly (strict decoding — the
 reference's strict serializer mode); None round-trips as null; tuples of
 nested dataclasses are reconstructed from the field's type annotation.
+
+GVK VERSIONING (apimachinery runtime.Scheme's group/version surface):
+objects may carry an ``apiVersion`` tag. The registered dataclasses are
+the HUB (internal) types; per-(kind, apiVersion) CONVERTERS decode other
+versions into the hub — and the load-bearing registration is the real
+Kubernetes ``v1`` wire format: a genuine upstream Pod/Node manifest
+(``apiVersion: v1``) decodes through the bridge codecs
+(kubetpu.bridge.convert), so ``kubetpu apply -f`` accepts reference
+manifests verbatim. ``encode_versioned`` is the reverse conversion.
+Unknown apiVersions fail loudly. Per-kind DEFAULTING hooks
+(``register_defaults`` — the reference's zz_generated.defaults funcs)
+run after construction on every decode path.
 """
 
 from __future__ import annotations
@@ -45,13 +57,61 @@ for _cls in (
     t.DeviceRequest, t.DeviceSubRequest, t.DeviceConstraint,
     t.ResourceClaim, t.ClaimAllocation, t.DeviceResult, t.PodResourceClaim,
     t.NodeHeartbeat, t.LeaderElectionRecord, t.Deployment, t.Job,
-    t.StatefulSet, t.ResourceClaimTemplate, t.DaemonSet,
+    t.StatefulSet, t.ResourceClaimTemplate, t.DaemonSet, t.Event,
+    t.CronJob, t.ResourceQuota,
 ):
     register(_cls)
 
 
 class SchemeError(ValueError):
     pass
+
+
+# the hub version every plain "kind"-tagged object implicitly carries
+HUB_VERSION = "kubetpu/v1"
+
+# (kind, apiVersion) -> converter(raw dict) -> hub object
+_CONVERTERS: dict[tuple[str, str], Any] = {}
+# hub class -> defaulting fn(obj) -> obj (zz_generated.defaults analog)
+_DEFAULTERS: dict[type, Any] = {}
+
+
+def register_conversion(kind: str, api_version: str, fn) -> None:
+    """Decode ``apiVersion``-tagged wire objects of ``kind`` into the hub
+    type (runtime.Scheme.AddConversionFunc's role)."""
+    _CONVERTERS[(kind, api_version)] = fn
+
+
+def register_defaults(cls: type, fn) -> None:
+    """Run ``fn(obj) -> obj`` after every decode of ``cls``."""
+    _DEFAULTERS[cls] = fn
+
+
+def _apply_defaults(obj: Any) -> Any:
+    fn = _DEFAULTERS.get(type(obj))
+    return fn(obj) if fn is not None else obj
+
+
+def encode_versioned(obj: Any, api_version: str = HUB_VERSION) -> Any:
+    """Encode into a SPECIFIC version's wire format (the reverse
+    conversion). The hub version is the plain kind-tagged envelope;
+    ``v1`` Pods/Nodes emit the real Kubernetes JSON."""
+    if api_version == HUB_VERSION:
+        out = encode(obj)
+        if isinstance(out, dict):
+            out["apiVersion"] = HUB_VERSION
+        return out
+    kind = type(obj).__name__
+    if api_version == "v1" and kind == "Pod":
+        from ..bridge.convert import pod_to_v1
+
+        wire = pod_to_v1(obj)
+        wire.setdefault("apiVersion", "v1")
+        wire.setdefault("kind", "Pod")
+        return wire
+    raise SchemeError(
+        f"no conversion from {kind} to apiVersion {api_version!r}"
+    )
 
 
 def encode(obj: Any) -> Any:
@@ -154,7 +214,7 @@ def _decode_into(cls: type, data: dict) -> Any:
     field_names = {f.name for f in dataclasses.fields(cls)}
     kwargs: dict[str, Any] = {}
     for key, raw in data.items():
-        if key == "kind":
+        if key in ("kind", "apiVersion"):
             continue
         if key not in field_names:
             raise SchemeError(
@@ -165,18 +225,63 @@ def _decode_into(cls: type, data: dict) -> Any:
 
 
 def decode(data: Any) -> Any:
-    """JSON value → typed object (requires the "kind" tag on objects)."""
+    """JSON value → typed object (requires the "kind" tag on objects).
+    An ``apiVersion`` other than the hub's routes through the registered
+    conversion (e.g. real Kubernetes ``v1`` Pod/Node manifests)."""
     if isinstance(data, dict):
         kind = data.get("kind")
         if kind is None:
             raise SchemeError("object has no 'kind' tag")
+        version = data.get("apiVersion", HUB_VERSION)
+        if version != HUB_VERSION:
+            converter = _CONVERTERS.get((kind, version))
+            if converter is None:
+                raise SchemeError(
+                    f"no conversion registered for {kind!r} "
+                    f"apiVersion {version!r}"
+                )
+            return _apply_defaults(converter(data))
         cls = _KINDS.get(kind)
         if cls is None:
             raise SchemeError(
                 f"kind {kind!r} is not registered "
                 f"(known: {sorted(_KINDS)})"
             )
-        return _decode_into(cls, data)
+        return _apply_defaults(_decode_into(cls, data))
     if isinstance(data, list):
         return [decode(x) for x in data]
     return data
+
+
+def _register_v1_conversions() -> None:
+    """The real Kubernetes v1 wire format as a scheme version: upstream
+    Pod/Node manifests decode via the bridge codecs."""
+
+    def _pod_v1(raw: dict) -> Any:
+        from ..bridge.convert import pod_from_v1
+
+        return pod_from_v1(raw)
+
+    def _node_v1(raw: dict) -> Any:
+        from ..bridge.convert import node_from_v1
+
+        return node_from_v1(raw)
+
+    register_conversion("Pod", "v1", _pod_v1)
+    register_conversion("Node", "v1", _node_v1)
+
+
+_register_v1_conversions()
+
+
+def _default_pod(pod: Any) -> Any:
+    """pkg/apis/core/v1 defaulting slice: an empty schedulerName becomes
+    "default-scheduler" (SetDefaults_PodSpec)."""
+    if not pod.scheduler_name:
+        import dataclasses
+
+        return dataclasses.replace(pod, scheduler_name="default-scheduler")
+    return pod
+
+
+register_defaults(t.Pod, _default_pod)

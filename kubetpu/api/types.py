@@ -607,6 +607,62 @@ class Job:
     # termination is COUNTED in succeeded/failed but whose objects may not
     # be removed yet — the exactly-once bridge across controller restarts
     uncounted: tuple[str, ...] = ()
+    # spec.ttlSecondsAfterFinished (ttlafterfinished controller): delete
+    # the Job this long after it finishes; None = keep forever
+    ttl_seconds_after_finished: float | None = None
+    # status.completionTime (epoch seconds), stamped when complete/failed
+    completion_time: float | None = None
+    # owning controller ("CronJob/<ns>/<name>"), "" = standalone
+    owner: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class CronJob:
+    """The slice of batch/v1 CronJob the control loop consumes: a 5-field
+    cron ``schedule`` stamping Job instances (pkg/controller/cronjob
+    ``syncCronJob``), a ``suspend`` gate, and concurrency policy (Allow |
+    Forbid | Replace)."""
+
+    name: str
+    namespace: str = "default"
+    schedule: str = "* * * * *"
+    suspend: bool = False
+    concurrency_policy: str = "Allow"     # Allow | Forbid | Replace
+    # the Job prototype (spec.jobTemplate)
+    completions: int = 1
+    parallelism: int = 1
+    backoff_limit: int = 6
+    ttl_seconds_after_finished: float | None = None
+    template: "Pod | None" = None
+    # status
+    last_schedule_time: float | None = None
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class ResourceQuota:
+    """core/v1 ResourceQuota slice: per-namespace hard caps on object
+    counts and aggregate resource requests (pkg/controller/resourcequota
+    recomputes ``used``; the apiserver's quota admission rejects writes
+    that would exceed ``hard``)."""
+
+    name: str
+    namespace: str = "default"
+    hard: tuple[tuple[str, int], ...] = ()   # "pods" | "requests.cpu" | "requests.memory"
+    used: tuple[tuple[str, int], ...] = ()
+
+    def hard_dict(self) -> dict[str, int]:
+        return dict(self.hard)
+
+    def used_dict(self) -> dict[str, int]:
+        return dict(self.used)
 
     @property
     def key(self) -> str:
@@ -668,6 +724,29 @@ class ReplicaSet:
     template: "Pod | None" = None     # prototype; name/uid/owner stamped
     # the owning controller ("Deployment/<ns>/<name>"), "" = standalone
     owner: str = ""
+
+    @property
+    def key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+
+@dataclass(frozen=True)
+class Event:
+    """events.k8s.io/v1 Event (the slice the control plane emits):
+    what happened (``reason``/``note``/``type``) to which object
+    (``regarding`` — "Kind/<ns>/<name>"), reported by whom, how many times
+    (series aggregation — client-go tools/events' EventSeries)."""
+
+    name: str
+    namespace: str = "default"
+    regarding: str = ""                   # "Kind/<ns>/<name>"
+    reason: str = ""                      # e.g. "Scheduled", "FailedScheduling"
+    note: str = ""
+    type: str = "Normal"                  # Normal | Warning
+    reporting_controller: str = ""
+    count: int = 1
+    first_timestamp: float = 0.0
+    last_timestamp: float = 0.0
 
     @property
     def key(self) -> str:

@@ -96,9 +96,18 @@ def cmd_serve(args) -> int:
 
 
 def cmd_apiserver(args) -> int:
-    from .apiserver import APIServer
+    from .apiserver import APIServer, Registry
+    from .controllers import quota_admission
+    from .store import MemStore
 
-    server = APIServer(host=args.host, port=args.port).start()
+    store = MemStore()
+    registry = Registry()
+    # quota enforcement is admission-time (the reference's resourcequota
+    # admission plugin): pod creates past a namespace's hard caps get 403
+    registry.add_validating_hook(quota_admission(store), kinds=("pods",))
+    server = APIServer(
+        store, host=args.host, port=args.port, registry=registry,
+    ).start()
     print(f"kubetpu apiserver serving on {server.url} "
           f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N)",
           flush=True)
@@ -150,6 +159,8 @@ def _kind_buckets() -> dict:
         "PodGroup": I.POD_GROUPS, "DeviceClass": I.DEVICE_CLASSES,
         "ResourceSlice": I.RESOURCE_SLICES,
         "ResourceClaim": I.RESOURCE_CLAIMS,
+        "Event": "events", "CronJob": "cronjobs",
+        "ResourceQuota": "resourcequotas",
     }
 
 
@@ -219,6 +230,7 @@ def cmd_scheduler(args) -> int:
     API server (cmd/kube-scheduler/app/server.go Run shape)."""
     from .apiserver import RemoteStore
     from .client import SchedulerInformers, StoreClient
+    from .client.events import EventRecorder
     from .framework import config as C
     from .framework.configload import ConfigError, load_config
     from .sched import Scheduler
@@ -229,7 +241,10 @@ def cmd_scheduler(args) -> int:
         print(f"invalid config: {e}", file=sys.stderr)
         return 1
     store = RemoteStore(args.server)
-    sched = Scheduler(StoreClient(store), cfg=cfg, engine=args.engine)
+    sched = Scheduler(
+        StoreClient(store), cfg=cfg, engine=args.engine,
+        recorder=EventRecorder(store, "kubetpu-scheduler"),
+    )
     sched.enable_preemption()
     informers = SchedulerInformers(store, sched)
     _retry_start(informers.start, "scheduler informers")
@@ -251,23 +266,28 @@ def cmd_controller_manager(args) -> int:
     store (cmd/kube-controller-manager controllermanager.go shape)."""
     from .apiserver import RemoteStore
     from .controllers import (
+        CronJobController,
         DaemonSetController,
         DeploymentController,
         DisruptionController,
         GarbageCollector,
         JobController,
+        NamespaceController,
         ResourceClaimController,
+        ResourceQuotaController,
         StatefulSetController,
         NodeLifecycleController,
         PodGCController,
         ReplicaSetController,
         TaintEvictionController,
+        TTLAfterFinishedController,
     )
 
     store = RemoteStore(args.server)
     ctrls = [
         DeploymentController(store),
         JobController(store),
+        CronJobController(store),
         DaemonSetController(store),
         ResourceClaimController(store),
         StatefulSetController(store),
@@ -277,6 +297,9 @@ def cmd_controller_manager(args) -> int:
         PodGCController(store, terminated_threshold=args.terminated_pod_gc),
         DisruptionController(store),
         GarbageCollector(store),
+        TTLAfterFinishedController(store),
+        NamespaceController(store),
+        ResourceQuotaController(store),
     ]
     for c in ctrls:
         _retry_start(c.start, type(c).__name__)
@@ -309,7 +332,66 @@ def cmd_kubelet(args) -> int:
     return _make_loop(kubelet.pump, period_s=0.2)()
 
 
+# kubectl-style table printers: kind bucket -> (headers, row fn) — the
+# printers registry shape (staging/src/k8s.io/kubectl printers; server-side
+# TableConvertor columns per kind)
+def _printer_for(bucket: str):
+    def pods(key, o):
+        return (key, o.phase or "", o.node_name or "<pending>",
+                str(getattr(o, "priority", 0)))
+
+    def nodes(key, o):
+        status = "SchedulingDisabled" if o.unschedulable else "Ready"
+        alloc = o.allocatable_dict()
+        return (key, status, str(alloc.get("cpu", "")),
+                str(alloc.get("memory", "")))
+
+    def workload(key, o):
+        return (key, str(getattr(o, "replicas", "")))
+
+    def jobs(key, o):
+        status = ("Complete" if o.complete
+                  else "Failed" if o.failed_state else "Running")
+        return (key, f"{o.succeeded}/{o.completions}", status)
+
+    def events(key, o):
+        return (o.type, o.reason, o.regarding, str(o.count), o.note)
+
+    def quotas(key, o):
+        pairs = ", ".join(
+            f"{k}: {o.used_dict().get(k, 0)}/{v}" for k, v in o.hard
+        )
+        return (key, pairs)
+
+    table = {
+        "pods": (("NAME", "STATUS", "NODE", "PRIORITY"), pods),
+        "nodes": (("NAME", "STATUS", "CPU(m)", "MEMORY"), nodes),
+        "replicasets": (("NAME", "REPLICAS"), workload),
+        "deployments": (("NAME", "REPLICAS"), workload),
+        "statefulsets": (("NAME", "REPLICAS"), workload),
+        "jobs": (("NAME", "COMPLETIONS", "STATUS"), jobs),
+        "events": (("TYPE", "REASON", "REGARDING", "COUNT", "NOTE"), events),
+        "resourcequotas": (("NAME", "USAGE"), quotas),
+    }
+    return table.get(
+        bucket, (("NAME",), lambda key, o: (key,))
+    )
+
+
+def _print_table(bucket: str, items) -> None:
+    headers, row_fn = _printer_for(bucket)
+    rows = [row_fn(key, obj) for key, obj in items]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    for cols in [headers, *rows]:
+        print("  ".join(c.ljust(w) for c, w in zip(cols, widths)).rstrip())
+
+
 def cmd_get(args) -> int:
+    import yaml as _yaml
+
     from .api import scheme
     from .apiserver import RemoteStore
 
@@ -319,16 +401,41 @@ def cmd_get(args) -> int:
         if obj is None:
             print(f"{args.kind}/{args.key} not found", file=sys.stderr)
             return 1
-        print(json.dumps(scheme.encode(obj), indent=2))
+        if args.output == "yaml":
+            print(_yaml.safe_dump(scheme.encode(obj), sort_keys=False))
+        else:
+            print(json.dumps(scheme.encode(obj), indent=2))
+        return 0
+    selectors = dict(
+        label_selector=args.selector or "",
+        field_selector=args.field_selector or "",
+    )
+    items, rv = store.list(args.kind, **selectors)
+    if args.output == "json":
+        print(json.dumps([scheme.encode(o) for _, o in items], indent=2))
+    elif args.output == "yaml":
+        print(_yaml.safe_dump([scheme.encode(o) for _, o in items],
+                              sort_keys=False))
     else:
-        items, _rv = store.list(args.kind)
-        for key, obj in sorted(items):
-            extra = ""
-            node = getattr(obj, "node_name", None)
-            if node is not None:
-                extra = f"\t{node or '<pending>'}\t{getattr(obj, 'phase', '')}"
-            print(f"{key}{extra}")
-    return 0
+        _print_table(args.kind, sorted(items))
+    if not args.watch:
+        return 0
+    # kubectl get -w: follow the (selector-scoped) watch stream
+    w = store.watch(args.kind, rv, stream=True, **selectors)
+    try:
+        import time as _time
+
+        while True:
+            for ev in w.poll():
+                if ev.type == "DELETED":
+                    print(f"{ev.key}\tDELETED", flush=True)
+                else:
+                    _print_table(args.kind, [(ev.key, ev.obj)])
+            _time.sleep(0.2)
+    except KeyboardInterrupt:
+        return 0
+    finally:
+        w.close()
 
 
 def cmd_apply(args) -> int:
@@ -441,6 +548,14 @@ def build_parser() -> argparse.ArgumentParser:
     get.add_argument("kind")
     get.add_argument("key", nargs="?", default="")
     get.add_argument("--server", required=True)
+    get.add_argument("-o", "--output", default="table",
+                     choices=("table", "json", "yaml"))
+    get.add_argument("-l", "--selector", default="",
+                     help="label selector (k=v,k2!=v2)")
+    get.add_argument("--field-selector", default="",
+                     help="field selector (e.g. spec.nodeName=n0)")
+    get.add_argument("-w", "--watch", action="store_true",
+                     help="follow the watch stream after listing")
     get.set_defaults(fn=cmd_get)
 
     apply = sub.add_parser("apply", help="apply kind-tagged YAML documents")

@@ -1,10 +1,18 @@
 """Controllers: informer + reconcile loops over the store (pkg/controller)."""
 
+from .cronjob import CRON_JOBS, CronJobController  # noqa: F401
 from .daemonset import DAEMON_SETS, DaemonSetController  # noqa: F401
 from .deployment import DEPLOYMENTS, DeploymentController  # noqa: F401
 from .disruption import DisruptionController  # noqa: F401
 from .garbagecollector import GarbageCollector  # noqa: F401
 from .job import JOBS, JobController  # noqa: F401
+from .namespace import NamespaceController  # noqa: F401
+from .resourcequota import (  # noqa: F401
+    RESOURCE_QUOTAS,
+    ResourceQuotaController,
+    quota_admission,
+)
+from .ttlafterfinished import TTLAfterFinishedController  # noqa: F401
 from .nodelifecycle import (  # noqa: F401
     NodeHeartbeat,
     NodeLifecycleController,

@@ -1,0 +1,193 @@
+"""CronJob controller — cron-scheduled Job stamping.
+
+Reference: ``pkg/controller/cronjob`` (cronjob_controllerv2.go
+``syncCronJob``): parse the 5-field cron ``schedule``, and when a
+scheduled time has passed since ``lastScheduleTime``, stamp a Job named
+``<cronjob>-<scheduledTime>`` owned by the CronJob; ``suspend`` skips
+scheduling; concurrencyPolicy gates overlap (Allow stamps regardless,
+Forbid skips while an owned Job is active, Replace deletes the active
+Job first). Missed runs collapse to the MOST RECENT one (the reference's
+mostRecentScheduleTime — a controller outage does not replay history).
+
+The cron grammar is the reference's supported core: ``*``, numbers,
+``,`` lists, ``-`` ranges, ``*/N`` + ``a-b/N`` steps, with the standard
+day-of-month/day-of-week OR rule. Times are UTC epoch seconds (the
+reference schedules in the cluster's TZ; the envelope carries none).
+"""
+
+from __future__ import annotations
+
+import calendar
+import dataclasses
+import time as _time
+
+from ..api import types as t
+from ..store.memstore import ConflictError, MemStore
+from .job import JOBS
+from .workqueue import OwnerIndex, QueueController
+
+CRON_JOBS = "cronjobs"
+
+_FIELD_RANGES = ((0, 59), (0, 23), (1, 31), (1, 12), (0, 6))
+
+
+def _parse_field(spec: str, lo: int, hi: int) -> frozenset[int]:
+    out: set[int] = set()
+    for part in spec.split(","):
+        step = 1
+        if "/" in part:
+            part, _, step_s = part.partition("/")
+            step = int(step_s)
+            if step < 1:
+                raise ValueError(f"bad step in {spec!r}")
+        if part == "*":
+            lo2, hi2 = lo, hi
+        elif "-" in part:
+            a, _, b = part.partition("-")
+            lo2, hi2 = int(a), int(b)
+        else:
+            lo2 = hi2 = int(part)
+        if lo2 < lo or hi2 > hi or lo2 > hi2:
+            raise ValueError(f"{spec!r} outside [{lo},{hi}]")
+        out.update(range(lo2, hi2 + 1, step))
+    return frozenset(out)
+
+
+def parse_cron(expr: str):
+    fields = expr.split()
+    if len(fields) != 5:
+        raise ValueError(f"cron {expr!r}: want 5 fields, got {len(fields)}")
+    parsed = tuple(
+        _parse_field(f, lo, hi)
+        for f, (lo, hi) in zip(fields, _FIELD_RANGES)
+    )
+    # the dom/dow OR rule applies only when BOTH are restricted
+    dom_star = fields[2] == "*"
+    dow_star = fields[4] == "*"
+    return parsed, dom_star, dow_star
+
+
+def cron_next(expr: str, after: float) -> float:
+    """First scheduled time STRICTLY after ``after`` (UTC epoch seconds),
+    minute granularity; raises ValueError when none lands within 366
+    days (the reference rejects such schedules too)."""
+    (minute, hour, dom, mon, dow), dom_star, dow_star = parse_cron(expr)
+    ts = (int(after) // 60 + 1) * 60
+    for _ in range(366 * 24 * 60):
+        st = _time.gmtime(ts)
+        if st.tm_mon in mon and st.tm_hour in hour and st.tm_min in minute:
+            dom_ok = st.tm_mday in dom
+            # tm_wday: Monday=0; cron: Sunday=0
+            dow_ok = (st.tm_wday + 1) % 7 in dow
+            if (
+                (dom_star and dow_ok) or (dow_star and dom_ok)
+                or (dom_star and dow_star)
+                or (not dom_star and not dow_star and (dom_ok or dow_ok))
+            ):
+                return float(ts)
+        ts += 60
+    raise ValueError(f"cron {expr!r}: no run within 366 days")
+
+
+def _owner_ref(cj: t.CronJob) -> str:
+    return f"CronJob/{cj.namespace}/{cj.name}"
+
+
+class CronJobController(QueueController):
+    """Time-driven: ``step`` also re-enqueues every CronJob whose next
+    scheduled time has arrived (the controller's requeue-after timer)."""
+
+    def __init__(self, store: MemStore, clock=None) -> None:
+        # cron math needs WALL time; the queue may still use the default
+        super().__init__(store, clock=clock)
+        self.wall = clock if clock is not None else _time.time
+        self._cjs = self.watch(CRON_JOBS, lambda cj: [cj.key])
+        self._jobs = self.watch(JOBS, self._job_keys)
+        self._owned = OwnerIndex(self._jobs)
+        # first-observed time per CronJob: the schedule's earliest bound
+        # for a job that has never run (the reference anchors on
+        # creationTimestamp; the envelope carries none)
+        self._first_seen: dict[str, float] = {}
+        self.stamped = 0
+
+    def _job_keys(self, job: t.Job) -> list[str]:
+        if job.owner:
+            kind, _, rest = job.owner.partition("/")
+            return [rest] if kind == "CronJob" else []
+        return []
+
+    def _anchor(self, key: str, cj: t.CronJob, now: float) -> float:
+        if cj.last_schedule_time is not None:
+            return cj.last_schedule_time
+        return self._first_seen.setdefault(key, now)
+
+    def step(self, max_items: int = 256) -> int:
+        self.pump()    # deliveries first so _first_seen anchors at arrival
+        now = self.wall()
+        for key, cj in self._cjs.store.items():
+            if cj.suspend:
+                continue
+            try:
+                due = cron_next(cj.schedule, self._anchor(key, cj, now))
+            except ValueError:
+                continue
+            if due <= now:
+                self.queue.add(key)
+        return super().step(max_items)
+
+    def sync(self, key: str) -> None:
+        cj = self._cjs.store.get(key)
+        if cj is None or cj.suspend or cj.template is None:
+            return
+        now = self.wall()
+        # collapse missed runs to the most recent scheduled time <= now
+        due = None
+        probe = self._anchor(key, cj, now)
+        while True:
+            try:
+                nxt = cron_next(cj.schedule, probe)
+            except ValueError:
+                return
+            if nxt > now:
+                break
+            due, probe = nxt, nxt
+        if due is None:
+            return
+        ref = _owner_ref(cj)
+        active = [
+            k for k in self._owned.get(ref)
+            if (j := self._jobs.store.get(k)) is not None
+            and not j.complete and not j.failed_state
+        ]
+        if active and cj.concurrency_policy == "Forbid":
+            return     # skip this run; lastScheduleTime stays (retried next)
+        if active and cj.concurrency_policy == "Replace":
+            for k in active:
+                try:
+                    self.store.delete(JOBS, k)
+                except KeyError:
+                    pass
+        name = f"{cj.name}-{int(due) // 60}"
+        job = t.Job(
+            name=name, namespace=cj.namespace,
+            completions=cj.completions, parallelism=cj.parallelism,
+            backoff_limit=cj.backoff_limit,
+            ttl_seconds_after_finished=cj.ttl_seconds_after_finished,
+            template=cj.template, owner=ref,
+        )
+        try:
+            self.store.create(JOBS, job.key, job)
+            self.stamped += 1
+        except ConflictError:
+            pass       # this scheduled time was already stamped
+        live, rv = self.store.get(CRON_JOBS, key)
+        if live is None:
+            return
+        try:
+            self.store.update(
+                CRON_JOBS, key,
+                dataclasses.replace(live, last_schedule_time=due),
+                expect_rv=rv,
+            )
+        except ConflictError:
+            pass       # re-synced on the echo; the named Job dedups

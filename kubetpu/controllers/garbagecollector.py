@@ -48,6 +48,7 @@ KIND_BUCKETS: dict[str, str] = {
     "Job": JOBS,
     "StatefulSet": STATEFUL_SETS,
     "DaemonSet": DAEMON_SETS,
+    "CronJob": "cronjobs",
     "Pod": PODS,
     "ResourceClaim": RESOURCE_CLAIMS,
 }

@@ -44,7 +44,12 @@ def _owner_ref(job: t.Job) -> str:
 
 class JobController(QueueController):
     def __init__(self, store: MemStore, clock=None) -> None:
+        import time as _time
+
         super().__init__(store, clock=clock)
+        # completion_time is WALL time (ttlafterfinished compares against
+        # it); a test-injected clock serves both roles
+        self.wall = clock if clock is not None else _time.time
         self._jobs = self.watch(JOBS, lambda j: [j.key])
         self._pods = self.watch(PODS, self._pod_keys)
         self._owned = OwnerIndex(self._pods)
@@ -161,6 +166,9 @@ class JobController(QueueController):
             live, rv = self.store.get(JOBS, job.key)
             if live is None:
                 return wrote
+            finished_now = (complete or failed_state) and (
+                live.completion_time is None
+            )
             try:
                 self.store.update(
                     JOBS, job.key,
@@ -168,6 +176,10 @@ class JobController(QueueController):
                         live, succeeded=succeeded, failed=failed,
                         complete=complete, failed_state=failed_state,
                         uncounted=next_uncounted,
+                        completion_time=(
+                            self.wall() if finished_now
+                            else live.completion_time
+                        ),
                     ),
                     expect_rv=rv,
                 )

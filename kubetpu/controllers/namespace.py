@@ -1,0 +1,67 @@
+"""Namespace lifecycle controller — deleting a namespace drains it.
+
+Reference: ``pkg/controller/namespace`` (namespace_controller.go →
+deletion/namespaced_resources_deleter.go): when a Namespace is deleted,
+every namespaced resource inside it is deleted before the namespace
+finally goes away. Here the trigger is the Namespace DELETE event (the
+envelope's Namespace carries no finalizer phase), and the sweep covers
+every namespaced bucket the framework serves; pods under finalizers
+soft-delete and their owners' controllers finish the job.
+"""
+
+from __future__ import annotations
+
+from ..client.informers import (
+    NAMESPACES,
+    PDBS,
+    PERSISTENT_VOLUME_CLAIMS,
+    PODS,
+    POD_GROUPS,
+    RESOURCE_CLAIMS,
+    SERVICES,
+)
+from ..store.memstore import MemStore
+from .cronjob import CRON_JOBS
+from .daemonset import DAEMON_SETS
+from .deployment import DEPLOYMENTS
+from .job import JOBS
+from .replicaset import REPLICA_SETS
+from .statefulset import STATEFUL_SETS
+
+# every namespaced bucket the framework serves (cluster-scoped buckets —
+# nodes, persistentvolumes, storageclasses, deviceclasses, resourceslices —
+# are exempt, like the reference's namespaced-resource discovery)
+NAMESPACED_BUCKETS = (
+    PODS, SERVICES, PDBS, POD_GROUPS, RESOURCE_CLAIMS,
+    PERSISTENT_VOLUME_CLAIMS, REPLICA_SETS, DEPLOYMENTS, JOBS,
+    STATEFUL_SETS, DAEMON_SETS, CRON_JOBS, "resourceclaimtemplates",
+    "resourcequotas", "events",
+)
+
+from .workqueue import QueueController  # noqa: E402
+
+
+class NamespaceController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, clock=clock)
+        self._ns = self.watch(
+            NAMESPACES,
+            lambda ns: [],                       # live namespaces: nothing
+            tombstone_fn=lambda ns: [ns.name],   # deletion starts the sweep
+        )
+        self.deletes = 0
+
+    def sync(self, name: str) -> None:
+        if self._ns.store.get(name) is not None:
+            return    # recreated before the sweep: spare the contents
+        prefix = f"{name}/"
+        for bucket in NAMESPACED_BUCKETS:
+            items, _rv = self.store.list(bucket)
+            for key, _obj in items:
+                if not key.startswith(prefix):
+                    continue
+                try:
+                    self.store.delete(bucket, key)
+                    self.deletes += 1
+                except KeyError:
+                    continue

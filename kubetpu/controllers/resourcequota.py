@@ -1,0 +1,116 @@
+"""ResourceQuota controller + quota admission.
+
+Reference: ``pkg/controller/resourcequota`` (resource_quota_controller.go
+recomputes ``status.used`` from the live objects) and the apiserver's
+quota admission (``plugin/pkg/admission/resourcequota``): a write that
+would push usage past ``hard`` is rejected with 403.
+
+Tracked resources (the scheduling envelope's slice): ``pods`` (active pod
+count), ``requests.cpu`` (milli), ``requests.memory`` (bytes) — aggregated
+over non-terminal pods in the quota's namespace.
+
+``quota_admission(store)`` builds the validating hook for
+``apiserver.Registry``: on pod CREATE it recomputes usage live (the
+admission plugin's quota check is synchronous, not informer-lagged) and
+vetoes overflow.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+from ..api import types as t
+from ..client.informers import PODS
+from ..store.memstore import ConflictError, MemStore
+from .workqueue import QueueController
+
+RESOURCE_QUOTAS = "resourcequotas"
+
+_TERMINAL = ("Succeeded", "Failed")
+
+
+def _usage(pods: list[t.Pod]) -> dict[str, int]:
+    used = {"pods": 0, "requests.cpu": 0, "requests.memory": 0}
+    for p in pods:
+        if p.phase in _TERMINAL:
+            continue
+        used["pods"] += 1
+        req = p.requests_dict()
+        used["requests.cpu"] += req.get(t.CPU, 0)
+        used["requests.memory"] += req.get(t.MEMORY, 0)
+    return used
+
+
+class ResourceQuotaController(QueueController):
+    """Keeps every quota's ``status.used`` current: pod events dirty the
+    namespace's quotas; sync recomputes from the informer cache."""
+
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, clock=clock)
+        self._quotas = self.watch(RESOURCE_QUOTAS, lambda q: [q.key])
+        self._pods = self.watch(PODS, self._pod_keys)
+        self.writes = 0
+
+    def _pod_keys(self, pod: t.Pod) -> list[str]:
+        return [
+            key for key, q in self._quotas.store.items()
+            if q.namespace == pod.namespace
+        ]
+
+    def sync(self, key: str) -> None:
+        q = self._quotas.store.get(key)
+        if q is None:
+            return
+        used = _usage([
+            p for p in self._pods.store.values()
+            if p.namespace == q.namespace
+        ])
+        tracked = tuple(
+            (name, used.get(name, 0)) for name, _ in q.hard
+        )
+        if tracked == q.used:
+            return
+        live, rv = self.store.get(RESOURCE_QUOTAS, key)
+        if live is None:
+            return
+        try:
+            self.store.update(
+                RESOURCE_QUOTAS, key,
+                dataclasses.replace(live, used=tracked),
+                expect_rv=rv,
+            )
+            self.writes += 1
+        except ConflictError:
+            pass   # re-synced on the echo
+
+
+def quota_admission(store: MemStore):
+    """Validating-hook factory for apiserver.Registry: reject pod creates
+    that would exceed any ResourceQuota in the namespace (admission is
+    synchronous against the LIVE store, like the reference's quota
+    evaluator — informer lag cannot let a burst slip past hard)."""
+    from ..apiserver.admission import AdmissionDenied
+
+    def hook(kind: str, key: str, obj, old) -> None:
+        if kind != PODS or old is not None:
+            return    # creates only (updates don't add pods)
+        quotas = [
+            q for _k, q in store.list(RESOURCE_QUOTAS)[0]
+            if q.namespace == obj.namespace and q.hard
+        ]
+        if not quotas:
+            return
+        pods = [
+            p for _k, p in store.list(PODS)[0]
+            if p.namespace == obj.namespace
+        ]
+        used = _usage(pods + [obj])
+        for q in quotas:
+            for name, limit in q.hard:
+                if used.get(name, 0) > limit:
+                    raise AdmissionDenied(
+                        f"exceeded quota {q.name}: {name} "
+                        f"{used.get(name, 0)} > hard {limit}"
+                    )
+
+    return hook

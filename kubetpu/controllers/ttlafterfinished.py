@@ -1,0 +1,49 @@
+"""TTL-after-finished controller — garbage-collect finished Jobs.
+
+Reference: ``pkg/controller/ttlafterfinished`` (ttlafterfinished_
+controller.go ``processJob``): a Job with ``ttlSecondsAfterFinished``
+whose completion time + TTL has passed is deleted (its pods cascade via
+the garbage collector); one not yet expired is requeued for exactly the
+remaining interval.
+"""
+
+from __future__ import annotations
+
+import time as _time
+
+from ..store.memstore import MemStore
+from .job import JOBS
+from .workqueue import QueueController
+
+
+class TTLAfterFinishedController(QueueController):
+    def __init__(self, store: MemStore, clock=None) -> None:
+        super().__init__(store, clock=clock)
+        self.wall = clock if clock is not None else _time.time
+        self._jobs = self.watch(JOBS, self._keys)
+        self.deletes = 0
+
+    @staticmethod
+    def _keys(job) -> list[str]:
+        if getattr(job, "ttl_seconds_after_finished", None) is None:
+            return []
+        return [job.key]
+
+    def sync(self, key: str) -> None:
+        job = self._jobs.store.get(key)
+        if job is None or job.ttl_seconds_after_finished is None:
+            return
+        if not (job.complete or job.failed_state):
+            return
+        finished_at = job.completion_time
+        if finished_at is None:
+            return     # the job controller stamps it; resync on that echo
+        remaining = finished_at + job.ttl_seconds_after_finished - self.wall()
+        if remaining > 0:
+            self.queue.add_after(key, remaining)
+            return
+        try:
+            self.store.delete(JOBS, key)
+            self.deletes += 1
+        except KeyError:
+            pass

@@ -20,7 +20,6 @@ are injectable so tests drive backoff deterministically.
 from __future__ import annotations
 
 import heapq
-import sys
 import time
 from typing import Any, Callable, Iterable
 
@@ -204,9 +203,14 @@ class QueueController:
     def __init__(
         self, store, clock: Callable[[], float] | None = None
     ) -> None:
+        from ..klog import get_logger
+
         self.store = store
         self.clock = clock if clock is not None else time.monotonic
         self.queue = WorkQueue(clock=self.clock)
+        self._log = get_logger(
+            f"kubetpu.controllers.{type(self).__name__}"
+        )
         self._informers: dict[str, SharedInformer] = {}
         self._reflectors: list[Reflector] = []
         self.sync_errors = 0
@@ -263,11 +267,15 @@ class QueueController:
                 if self.queue.retries(key) >= self.max_retries:
                     self.queue.forget(key)
                     self.dropped_keys += 1
-                    print(
-                        f"{type(self).__name__}: dropping {key!r} after "
-                        f"{self.max_retries} retries: {e}", file=sys.stderr,
+                    self._log.error(
+                        "dropping key after max retries",
+                        key=str(key), retries=self.max_retries, err=str(e),
                     )
                 else:
+                    self._log.v(4).info(
+                        "sync failed, backing off",
+                        key=str(key), err=str(e),
+                    )
                     self.queue.add_rate_limited(key)
             else:
                 self.queue.forget(key)

@@ -1,0 +1,109 @@
+"""Structured, leveled, CONTEXTUAL logging — the klog v2 analog.
+
+Reference: klog v2 with contextual logging (``klog.FromContext(ctx)``
+everywhere, e.g. schedule_one.go:68): components log structured key-value
+pairs through a logger that carries bound context (pod, node, cycle …),
+gated by a verbosity level (v=2 prod default; v=10 score dumps). Here:
+
+- ``get_logger(name)`` → a component logger; ``log.with_values(pod=key)``
+  binds context for everything logged through the child (the FromContext/
+  WithValues shape — context rides the LOGGER, pump-driven code has no
+  ctx parameter to thread).
+- ``log.info/warning/error(msg, **kv)`` emit one line:
+  ``I kubetpu.sched "msg" pod="ns/p" node="n0"`` — klog's structured
+  output format (message quoted, then key=value pairs).
+- ``log.v(level)`` gates verbose paths: enabled when ``KUBETPU_V``
+  (default 2) is >= level, so ``log.v(4).info(...)`` is the
+  ``klog.V(4).InfoS`` idiom.
+
+Sink is stderr by default; ``set_sink`` redirects (tests, json shippers).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Any, Callable
+
+_SEVERITY = {"info": "I", "warning": "W", "error": "E"}
+_lock = threading.Lock()
+_sink: Callable[[str], None] | None = None
+
+
+def set_sink(fn: Callable[[str], None] | None) -> None:
+    """Redirect every logger's output (None = stderr)."""
+    global _sink
+    _sink = fn
+
+
+def verbosity() -> int:
+    try:
+        return int(os.environ.get("KUBETPU_V", "2"))
+    except ValueError:
+        return 2
+
+
+def _fmt_value(v: Any) -> str:
+    if isinstance(v, str):
+        return f'"{v}"'
+    return str(v)
+
+
+class _Nop:
+    """Disabled verbosity gate: swallow everything."""
+
+    def info(self, *a, **k) -> None:
+        pass
+
+    warning = error = info
+
+
+_NOP = _Nop()
+
+
+class Logger:
+    def __init__(self, name: str, values: tuple[tuple[str, Any], ...] = ()):
+        self.name = name
+        self._values = values
+
+    def with_values(self, **kv: Any) -> "Logger":
+        """Bind context carried by every line (klog.LoggerWithValues)."""
+        return Logger(self.name, self._values + tuple(kv.items()))
+
+    def v(self, level: int) -> "Logger | _Nop":
+        """klog.V(level): a logger when enabled, a no-op otherwise."""
+        return self if verbosity() >= level else _NOP
+
+    def _emit(self, sev: str, msg: str, kv: dict[str, Any]) -> None:
+        pairs = " ".join(
+            f"{k}={_fmt_value(v)}" for k, v in (*self._values, *kv.items())
+        )
+        line = f'{_SEVERITY[sev]} {self.name} "{msg}"' + (
+            f" {pairs}" if pairs else ""
+        )
+        sink = _sink
+        with _lock:
+            if sink is not None:
+                sink(line)
+            else:
+                print(line, file=sys.stderr, flush=True)
+
+    def info(self, msg: str, **kv: Any) -> None:
+        self._emit("info", msg, kv)
+
+    def warning(self, msg: str, **kv: Any) -> None:
+        self._emit("warning", msg, kv)
+
+    def error(self, msg: str, **kv: Any) -> None:
+        self._emit("error", msg, kv)
+
+
+_loggers: dict[str, Logger] = {}
+
+
+def get_logger(name: str) -> Logger:
+    log = _loggers.get(name)
+    if log is None:
+        log = _loggers[name] = Logger(name)
+    return log

@@ -86,10 +86,11 @@ class DefaultPreemptionPostFilter:
             # non-ignorable extender failure mid-ProcessPreemption: this
             # attempt fails (preemption.go callExtenders error path);
             # evaluator bugs propagate instead of hiding as "no candidates"
-            import sys
+            from ..klog import get_logger
 
-            print(f"kubetpu.sched: preemption extender failed for "
-                  f"{info.key}: {e}", file=sys.stderr)
+            get_logger("kubetpu.sched.preemption").error(
+                "preemption extender failed", pod=info.key, err=str(e),
+            )
             sched.nominator.remove(info.pod.uid)
             info.nominated_node_name = None
             return None

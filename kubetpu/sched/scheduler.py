@@ -95,6 +95,7 @@ class Scheduler:
         engine: str = "greedy",
         registry=None,
         feature_gates=None,
+        recorder=None,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -104,8 +105,15 @@ class Scheduler:
         defaults to the in-tree set — out-of-tree plugins register on a
         copy and pass it here (the reference's app.WithPlugin).
         ``feature_gates``: a FeatureGate or {name: bool} overrides
-        (pkg/features defaults apply; unknown names fail loudly)."""
+        (pkg/features defaults apply; unknown names fail loudly).
+        ``recorder``: an EventRecorder (client.events) — the scheduler
+        emits the reference's canonical Events (``Scheduled`` on a
+        successful bind, ``FailedScheduling`` on an unschedulable
+        attempt — schedule_one.go's recorder.Eventf calls); None = no
+        events."""
         from ..framework.featuregate import FeatureGate
+
+        self.recorder = recorder
 
         self.cfg = cfg or C.SchedulerConfiguration()
         self.profile = profile or self.cfg.profile()
@@ -858,6 +866,13 @@ class Scheduler:
             if err is None:
                 self.cache.finish_binding(assumed.uid)
                 self.queue.done(info.key)
+                if self.recorder is not None:
+                    self.recorder.event(
+                        f"Pod/{info.pod.namespace}/{info.pod.name}",
+                        "Scheduled",
+                        f"Successfully assigned {info.key} to "
+                        f"{assumed.node_name}",
+                    )
             else:
                 # bind failed: roll back the assume and retry as error status
                 # (handleSchedulingFailure, schedule_one.go:1190 analog)
@@ -906,6 +921,13 @@ class Scheduler:
             self.dispatcher.add(
                 StatusPatchCall(info.pod, reason="Unschedulable")
             )
+            if self.recorder is not None:
+                self.recorder.event(
+                    f"Pod/{info.pod.namespace}/{info.pod.name}",
+                    "FailedScheduling",
+                    "0 nodes are available for the pod's constraints",
+                    type="Warning",
+                )
 
     # ------------------------------------------------------------- running
 

@@ -473,3 +473,53 @@ def test_inmemory_lease_cas_is_atomic_under_threads():
         for th in ts:
             th.join()
         assert len(winners) == 1, winners
+
+
+def test_kubectl_printers_selectors_and_output_modes():
+    """kubectl get: table printers per kind, -l/-field selectors applied
+    SERVER-side, -o json/yaml (the kubectl printers registry shape)."""
+    import dataclasses
+    import os
+    import subprocess
+    import sys
+
+    import jax  # noqa: F401
+
+    from kubetpu.api.wrappers import make_node, make_pod
+    from kubetpu.apiserver import APIServer
+
+    srv = APIServer().start()
+    try:
+        st = srv.store
+        st.create("nodes", "n0", make_node("n0"))
+        st.create("pods", "default/a", dataclasses.replace(
+            make_pod("a", node_name="n0", labels={"app": "web"}),
+            phase="Running"))
+        st.create("pods", "default/b", make_pod("b", labels={"app": "db"}))
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+        def run(*cmd):
+            out = subprocess.run(
+                [sys.executable, "-m", "kubetpu", *cmd],
+                env=env, capture_output=True, text=True, timeout=60,
+                cwd=repo,
+            )
+            assert out.returncode == 0, out.stderr
+            return out.stdout
+
+        table = run("get", "pods", "--server", srv.url)
+        assert "NAME" in table and "STATUS" in table and "NODE" in table
+        assert "Running" in table and "<pending>" in table
+        filtered = run("get", "pods", "--server", srv.url, "-l", "app=web")
+        assert "default/a" in filtered and "default/b" not in filtered
+        by_field = run("get", "pods", "--server", srv.url,
+                       "--field-selector", "spec.nodeName=n0")
+        assert "default/a" in by_field and "default/b" not in by_field
+        as_json = json.loads(run("get", "pods", "--server", srv.url,
+                                 "-o", "json", "-l", "app=db"))
+        assert [o["name"] for o in as_json] == ["b"]
+        nodes_table = run("get", "nodes", "--server", srv.url)
+        assert "Ready" in nodes_table and "CPU(m)" in nodes_table
+    finally:
+        srv.close()

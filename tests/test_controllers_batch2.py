@@ -1,0 +1,198 @@
+"""CronJob, TTLAfterFinished, Namespace, ResourceQuota controllers + the
+quota admission hook.
+
+Reference semantics: pkg/controller/cronjob (cron schedule → owned Jobs,
+concurrency policies, missed-run collapse), pkg/controller/ttlafterfinished
+(delete finished Jobs after TTL), pkg/controller/namespace (namespace
+deletion drains its contents), pkg/controller/resourcequota +
+plugin/pkg/admission/resourcequota (status.used recompute; 403 past hard).
+"""
+
+import dataclasses
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.client.informers import NAMESPACES, PODS
+from kubetpu.controllers import (
+    CRON_JOBS,
+    JOBS,
+    RESOURCE_QUOTAS,
+    CronJobController,
+    JobController,
+    NamespaceController,
+    ResourceQuotaController,
+    TTLAfterFinishedController,
+    quota_admission,
+)
+from kubetpu.controllers.cronjob import cron_next
+from kubetpu.store import MemStore
+
+
+# -------------------------------------------------------------------- cron
+
+def test_cron_next_core_grammar():
+    # 2021-01-01 00:00:00 UTC Friday
+    base = 1609459200.0
+    assert cron_next("* * * * *", base) == base + 60
+    assert cron_next("*/15 * * * *", base + 60) == base + 900
+    assert cron_next("30 2 * * *", base) == base + 2 * 3600 + 30 * 60
+    # dom/dow OR rule: both restricted -> either matches.
+    # Jan 2 2021 is a Saturday (dow 6); dom 10 is later
+    got = cron_next("0 0 10 * 6", base)
+    assert got == base + 86400            # Saturday wins over the 10th
+    # 5-field validation
+    with pytest.raises(ValueError, match="5 fields"):
+        cron_next("* * *", base)
+    with pytest.raises(ValueError, match="outside"):
+        cron_next("99 * * * *", base)
+
+
+def test_cronjob_stamps_owned_jobs_and_collapses_missed_runs():
+    st = MemStore()
+    now = [1609459200.0]
+    cj = t.CronJob(
+        name="tick", schedule="*/10 * * * *", completions=1,
+        template=make_pod("tpl", labels={"a": "t"}),
+    )
+    st.create(CRON_JOBS, cj.key, cj)
+    ctrl = CronJobController(st, clock=lambda: now[0])
+    ctrl.start()
+    ctrl.step()
+    assert st.list(JOBS)[0] == []         # not due yet
+    now[0] += 600
+    ctrl.step()
+    jobs = st.list(JOBS)[0]
+    assert len(jobs) == 1
+    assert jobs[0][1].owner == "CronJob/default/tick"
+    assert st.get(CRON_JOBS, cj.key)[0].last_schedule_time == now[0]
+    # a long outage: THREE missed runs collapse to the most recent one
+    now[0] += 1800
+    ctrl.step()
+    jobs = st.list(JOBS)[0]
+    assert len(jobs) == 2                 # one new job, not three
+    assert st.get(CRON_JOBS, cj.key)[0].last_schedule_time == now[0]
+
+
+def test_cronjob_concurrency_forbid_and_replace():
+    st = MemStore()
+    now = [1609459200.0]
+    for name, policy in (("fb", "Forbid"), ("rp", "Replace")):
+        st.create(CRON_JOBS, f"default/{name}", t.CronJob(
+            name=name, schedule="* * * * *", concurrency_policy=policy,
+            template=make_pod("tpl", labels={"a": name}),
+        ))
+    ctrl = CronJobController(st, clock=lambda: now[0])
+    ctrl.start()
+    ctrl.step()         # observe at t0 (anchors the schedule)
+    now[0] += 60
+    ctrl.step()
+    first = {j.name for _, j in st.list(JOBS)[0]}
+    assert len(first) == 2
+    now[0] += 60        # previous jobs still active (never completed)
+    ctrl.step()
+    jobs = {j.name: j for _, j in st.list(JOBS)[0]}
+    fb = [n for n in jobs if n.startswith("fb-")]
+    rp = [n for n in jobs if n.startswith("rp-")]
+    assert len(fb) == 1                   # Forbid: skipped while active
+    assert len(rp) == 1                   # Replace: old deleted, new stamped
+    assert rp[0] not in first             # ... and it IS the new one
+
+
+def test_cronjob_suspend_holds():
+    st = MemStore()
+    now = [1609459200.0]
+    st.create(CRON_JOBS, "default/s", t.CronJob(
+        name="s", schedule="* * * * *", suspend=True,
+        template=make_pod("tpl"),
+    ))
+    ctrl = CronJobController(st, clock=lambda: now[0])
+    ctrl.start()
+    now[0] += 3600
+    ctrl.step()
+    assert st.list(JOBS)[0] == []
+
+
+# ------------------------------------------------------- ttlafterfinished
+
+def test_ttl_deletes_finished_job_after_ttl():
+    st = MemStore()
+    now = [1000.0]
+    job = t.Job(
+        name="done", completions=1, ttl_seconds_after_finished=30.0,
+        template=make_pod("tpl", labels={"a": "d"}),
+    )
+    st.create(JOBS, job.key, job)
+    jc = JobController(st, clock=lambda: now[0])
+    ttl = TTLAfterFinishedController(st, clock=lambda: now[0])
+    jc.start(); ttl.start()
+    jc.step()
+    key = st.list(PODS)[0][0][0]
+    st.update(PODS, key, dataclasses.replace(
+        st.get(PODS, key)[0], phase="Succeeded"))
+    jc.step()                              # counts + stamps completion_time
+    got = st.get(JOBS, job.key)[0]
+    assert got.complete and got.completion_time == now[0]
+    ttl.step()
+    assert st.get(JOBS, job.key)[0] is not None    # TTL not elapsed
+    now[0] += 31.0
+    ttl.step()
+    assert st.get(JOBS, job.key)[0] is None        # expired → deleted
+
+
+# ------------------------------------------------------------- namespace
+
+def test_namespace_deletion_drains_contents():
+    st = MemStore()
+    st.create(NAMESPACES, "team-a", t.Namespace(name="team-a"))
+    st.create(PODS, "team-a/p0", make_pod("p0", namespace="team-a"))
+    st.create(JOBS, "team-a/j0", t.Job(name="j0", namespace="team-a"))
+    st.create(PODS, "default/survivor", make_pod("survivor"))
+    ctrl = NamespaceController(st)
+    ctrl.start()
+    assert ctrl.step() == 0                # nothing deleted yet
+    st.delete(NAMESPACES, "team-a")
+    ctrl.step()
+    assert st.get(PODS, "team-a/p0")[0] is None
+    assert st.get(JOBS, "team-a/j0")[0] is None
+    assert st.get(PODS, "default/survivor")[0] is not None
+
+
+# ---------------------------------------------------------- resourcequota
+
+def test_quota_controller_tracks_used_and_admission_rejects():
+    from kubetpu.apiserver import APIServer, Registry, RemoteStore
+
+    st = MemStore()
+    registry = Registry()
+    registry.add_validating_hook(quota_admission(st), kinds=(PODS,))
+    srv = APIServer(st, registry=registry).start()
+    try:
+        remote = RemoteStore(srv.url)
+        remote.create(RESOURCE_QUOTAS, "default/caps", t.ResourceQuota(
+            name="caps", hard=(("pods", 2), ("requests.cpu", 1000)),
+        ))
+        ctrl = ResourceQuotaController(st)
+        ctrl.start()
+        remote.create(PODS, "default/a", make_pod("a", cpu_milli=400))
+        remote.create(PODS, "default/b", make_pod("b", cpu_milli=400))
+        ctrl.step()
+        q = st.get(RESOURCE_QUOTAS, "default/caps")[0]
+        assert q.used_dict() == {"pods": 2, "requests.cpu": 800}
+        # third pod exceeds the pods cap → 403 at admission
+        with pytest.raises(PermissionError, match="exceeded quota"):
+            remote.create(PODS, "default/c", make_pod("c", cpu_milli=100))
+        # within pod cap but over cpu → also rejected
+        st.delete(PODS, "default/b")
+        with pytest.raises(PermissionError, match="requests.cpu"):
+            remote.create(PODS, "default/d", make_pod("d", cpu_milli=700))
+        # a fitting pod passes; usage catches up
+        remote.create(PODS, "default/e", make_pod("e", cpu_milli=100))
+        ctrl.step()
+        q = st.get(RESOURCE_QUOTAS, "default/caps")[0]
+        assert q.used_dict() == {"pods": 2, "requests.cpu": 500}
+    finally:
+        srv.close()

@@ -1,0 +1,152 @@
+"""Event objects + recorder (client-go tools/events analog) and the
+structured contextual-logging (klog v2) analog."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu import klog
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.client import SchedulerInformers, StoreClient
+from kubetpu.client.events import EVENTS, EventRecorder
+from kubetpu.client.informers import NODES, PODS
+from kubetpu.store import MemStore
+
+from .test_scheduler import FakeClock
+
+
+def test_recorder_aggregates_repeats_into_series():
+    st = MemStore()
+    clock = [100.0]
+    rec = EventRecorder(st, "tester", clock=lambda: clock[0])
+    rec.event("Pod/default/p", "FailedScheduling", "no nodes",
+              type="Warning")
+    clock[0] = 140.0
+    rec.event("Pod/default/p", "FailedScheduling", "no nodes",
+              type="Warning")
+    rec.event("Pod/default/p", "Scheduled", "assigned")
+    events, _ = st.list(EVENTS)
+    by_reason = {e.reason: e for _, e in events}
+    assert len(events) == 2                      # aggregated, not appended
+    failed = by_reason["FailedScheduling"]
+    assert failed.count == 2
+    assert failed.first_timestamp == 100.0 and failed.last_timestamp == 140.0
+    assert failed.type == "Warning"
+    assert failed.regarding == "Pod/default/p"
+    assert by_reason["Scheduled"].count == 1
+
+
+def test_recorder_is_best_effort():
+    class Broken:
+        def get(self, *a):
+            raise RuntimeError("down")
+
+        def update(self, *a, **k):
+            raise RuntimeError("down")
+
+    rec = EventRecorder(Broken(), "tester")
+    rec.event("Pod/default/p", "Scheduled", "x")   # must not raise
+    assert rec.dropped == 1
+
+
+def test_scheduler_emits_canonical_events():
+    """The end-to-end shape: Scheduled on bind, FailedScheduling on an
+    unschedulable attempt — visible via the events bucket like any object."""
+    from kubetpu.sched import Scheduler
+
+    st = MemStore()
+    st.create(NODES, "n0", make_node("n0", cpu_milli=1000))
+    st.create(PODS, "default/ok", make_pod("ok", cpu_milli=100))
+    st.create(PODS, "default/huge", make_pod("huge", cpu_milli=99999))
+    clock = FakeClock()
+    sched = Scheduler(
+        StoreClient(st), dispatcher_workers=0, clock=clock,
+        recorder=EventRecorder(st, "kubetpu-scheduler"),
+    )
+    informers = SchedulerInformers(st, sched)
+    informers.start()
+    for _ in range(3):
+        informers.pump()
+        sched.schedule_batch()
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        clock.tick(2)
+    events = {e.reason: e for _, e in st.list(EVENTS)[0]}
+    assert events["Scheduled"].regarding == "Pod/default/ok"
+    assert "assigned default/ok to n0" in events["Scheduled"].note
+    assert events["FailedScheduling"].regarding == "Pod/default/huge"
+    assert events["FailedScheduling"].type == "Warning"
+    assert events["FailedScheduling"].reporting_controller == "kubetpu-scheduler"
+    # events round-trip the scheme (kubectl get events)
+    from kubetpu.api import scheme
+
+    ev = events["Scheduled"]
+    assert scheme.decode(scheme.encode(ev)) == ev
+    sched.close()
+
+
+def test_klog_structured_contextual_output():
+    lines = []
+    klog.set_sink(lines.append)
+    try:
+        log = klog.get_logger("kubetpu.test")
+        bound = log.with_values(pod="default/p", cycle=7)
+        bound.info("scheduled", node="n0")
+        bound.warning("slow cycle")
+        log.error("boom", err="nope")
+        assert lines[0] == (
+            'I kubetpu.test "scheduled" pod="default/p" cycle=7 node="n0"'
+        )
+        assert lines[1].startswith('W kubetpu.test "slow cycle"')
+        assert lines[2] == 'E kubetpu.test "boom" err="nope"'
+    finally:
+        klog.set_sink(None)
+
+
+def test_klog_verbosity_gate(monkeypatch):
+    lines = []
+    klog.set_sink(lines.append)
+    try:
+        monkeypatch.setenv("KUBETPU_V", "2")
+        log = klog.get_logger("kubetpu.vtest")
+        log.v(4).info("hidden")
+        log.v(2).info("shown")
+        assert [ln for ln in lines if "hidden" in ln] == []
+        assert any("shown" in ln for ln in lines)
+        monkeypatch.setenv("KUBETPU_V", "5")
+        log.v(4).info("now visible")
+        assert any("now visible" in ln for ln in lines)
+    finally:
+        klog.set_sink(None)
+
+
+def test_workqueue_logs_dropped_keys_structured():
+    from kubetpu.controllers.workqueue import QueueController
+
+    lines = []
+    klog.set_sink(lines.append)
+    try:
+        now = [0.0]
+
+        class Bad(QueueController):
+            max_retries = 1
+
+            def __init__(self, store):
+                super().__init__(store, clock=lambda: now[0])
+                self.watch("widgets", lambda o: [o["key"]])
+
+            def sync(self, key):
+                raise RuntimeError("always")
+
+        st = MemStore()
+        st.create("widgets", "w", {"key": "w"})
+        c = Bad(st)
+        c.start()
+        for _ in range(4):          # advance past each backoff window
+            c.step()
+            now[0] += 1e6
+        assert c.dropped_keys == 1
+        assert any("dropping key" in ln and 'key="w"' in ln for ln in lines)
+    finally:
+        klog.set_sink(None)
