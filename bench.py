@@ -94,11 +94,17 @@ def run_stage(
         from kubetpu.tracing import device_profile
 
         ctx = device_profile(profile_dir)
+    # per-stage diagnosis artifacts (Chrome trace + /metrics snapshot +
+    # device cycle records) land next to the bench JSON; set
+    # BENCH_ARTIFACTS_DIR= (empty) to disable
+    artifacts_dir = os.environ.get(
+        "BENCH_ARTIFACTS_DIR", "bench_artifacts"
+    ) or None
     t0 = time.perf_counter()
     with ctx:
         r = runner(
             case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
-            max_batch=max_batch,
+            max_batch=max_batch, artifacts_dir=artifacts_dir,
         )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
@@ -123,6 +129,13 @@ def run_stage(
         out["threshold_note"] = r.threshold_note
     if r.p99_attempt_latency_ms is not None:
         out["p99_attempt_latency_ms"] = round(r.p99_attempt_latency_ms, 1)
+    if r.metrics_snapshot is not None:
+        # post-run metrics snapshot (p50/p99 from the scheduler histograms,
+        # schedule_attempts by result): every BENCH line carries its own
+        # diagnosis instead of pointing at a scrape that no longer exists
+        out["metrics"] = r.metrics_snapshot
+    if r.artifacts:
+        out["artifacts"] = r.artifacts
     return out
 
 

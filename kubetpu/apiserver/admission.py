@@ -29,6 +29,7 @@ every separate-process component — is gated.
 
 from __future__ import annotations
 
+from contextlib import ExitStack, contextmanager
 from typing import Any, Callable, Iterable
 
 from ..api import types as t
@@ -188,6 +189,11 @@ class Registry:
         # validating raises AdmissionDenied; ``kinds=None`` = every kind
         self._mutating: list[tuple[Callable, set[str] | None]] = []
         self._validating: list[tuple[Callable, set[str] | None]] = []
+        # locker: fn(kind, key, obj, verb) -> context manager | None; the
+        # apiserver holds every matching lock across admit AND the storage
+        # write, so a usage-counting validator (quota) sees check+create as
+        # one atomic step (the reference's locked quota reservation)
+        self._lockers: list[tuple[Callable, set[str] | None]] = []
 
     def add_mutating_hook(
         self, fn: Callable, kinds: Iterable[str] | None = None
@@ -198,6 +204,26 @@ class Registry:
         self, fn: Callable, kinds: Iterable[str] | None = None
     ) -> None:
         self._validating.append((fn, set(kinds) if kinds else None))
+
+    def add_write_lock(
+        self, fn: Callable, kinds: Iterable[str] | None = None
+    ) -> None:
+        """Register a write-lock provider: ``fn(kind, key, obj, verb)``
+        returns a context manager (a ``threading.Lock`` works) scoping the
+        write, or None to pass."""
+        self._lockers.append((fn, set(kinds) if kinds else None))
+
+    @contextmanager
+    def locked(self, kind: str, key: str, obj: Any, verb: str = "create"):
+        """Every matching write lock held, in registration order, for the
+        duration of the admit + store write."""
+        with ExitStack() as stack:
+            for fn, kinds in self._lockers:
+                if kinds is None or kind in kinds:
+                    cm = fn(kind, key, obj, verb)
+                    if cm is not None:
+                        stack.enter_context(cm)
+            yield
 
     def admit(
         self, kind: str, key: str, obj: Any, old: Any = None,

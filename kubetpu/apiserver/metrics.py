@@ -1,0 +1,151 @@
+"""API server request metrics — the apiserver/pkg/endpoints/metrics slice.
+
+Reference names and shapes (metrics.go):
+
+- ``apiserver_request_duration_seconds{verb, resource, code}`` — the
+  reference's requestLatencies bucket list, 5 ms … 60 s
+- ``apiserver_request_total{verb, resource, code}``
+- ``apiserver_current_inflight_requests{request_kind}`` — readOnly vs
+  mutating, the max-in-flight filter's gauge; long-running requests
+  (watch streams) are EXCLUDED (the reference's longrunning predicate)
+  and counted on
+- ``apiserver_longrunning_requests{verb, resource}`` instead
+"""
+
+from __future__ import annotations
+
+import re
+import time
+from contextlib import contextmanager
+from typing import Callable
+
+from ..metrics.registry import Registry
+
+# apiserver/pkg/endpoints/metrics/metrics.go requestLatencies buckets
+REQUEST_DURATION_BUCKETS = [
+    0.005, 0.025, 0.05, 0.1, 0.2, 0.4, 0.6, 0.8, 1.0, 1.25, 1.5,
+    2, 3, 4, 5, 6, 8, 10, 15, 20, 30, 45, 60,
+]
+
+READ_VERBS = frozenset({"GET", "LIST", "WATCH"})
+
+#: distinct resource label values admitted before folding into "other" —
+#: the resource segment is CLIENT-supplied path text, and every unseen
+#: label tuple mints new metric children, so an unbounded scanner would
+#: otherwise grow the registry without limit (the reference only records
+#: recognized resources)
+MAX_RESOURCE_LABELS = 64
+
+#: resource path segments are CLIENT text; only lowercase-DNS-label names
+#: (the shape of every real resource: "pods", "poddisruptionbudgets") may
+#: ever become a label value — anything else folds to "other" before it
+#: can reach the exposition
+_RESOURCE_RE = re.compile(r"[a-z0-9]([a-z0-9-]{0,61}[a-z0-9])?$")
+
+#: verbs whose 2xx proves the resource kind really exists: a write decoded
+#: through the scheme, or a keyed GET that found an object. LIST/WATCH of
+#: an unknown kind "succeed" with an empty result, so their 200s admit
+#: nothing — the list handler admits explicitly once it returns items.
+_PROVING_VERBS = frozenset({"GET", "CREATE", "UPDATE", "PATCH", "DELETE"})
+
+
+class APIServerMetrics:
+    """Owns a Registry with the apiserver request metric set; the handler
+    observes through ``track``."""
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        import threading
+
+        r = registry if registry is not None else Registry()
+        self.registry = r
+        self._resources: set[str] = set()
+        self._resources_lock = threading.Lock()
+        self.request_duration = r.histogram(
+            "apiserver_request_duration_seconds",
+            "Response latency distribution in seconds for each verb and "
+            "resource.",
+            labels=("verb", "resource", "code"),
+            buckets=REQUEST_DURATION_BUCKETS,
+        )
+        self.request_total = r.counter(
+            "apiserver_request_total",
+            "Counter of apiserver requests broken out for each verb, "
+            "resource and HTTP response code.",
+            labels=("verb", "resource", "code"),
+        )
+        self.inflight = r.gauge(
+            "apiserver_current_inflight_requests",
+            "Maximal number of currently used inflight request limit of "
+            "this apiserver per request kind in last second.",
+            labels=("request_kind",),
+        )
+        self.longrunning = r.gauge(
+            "apiserver_longrunning_requests",
+            "Gauge of all active long-running apiserver requests "
+            "(watch streams).",
+            labels=("verb", "resource"),
+        )
+
+    def admit_resource(self, resource: str) -> str:
+        """Admit ``resource`` as a label value once the caller has PROOF
+        the kind exists (a keyed read/write succeeded, or a list returned
+        items). Malformed names and overflow beyond MAX_RESOURCE_LABELS
+        fold to "other"."""
+        if not _RESOURCE_RE.fullmatch(resource):
+            return "other"
+        with self._resources_lock:
+            if resource in self._resources:
+                return resource
+            if len(self._resources) < MAX_RESOURCE_LABELS:
+                self._resources.add(resource)
+                return resource
+        return "other"
+
+    def _resource_label(self, resource: str, succeeded: bool) -> str:
+        """Admission is gated on a response that PROVES the kind exists:
+        a scanner's junk paths fail (404/400) or prove nothing (empty
+        LIST) and fold into "other", so they can never squat the slots
+        real resources need."""
+        if succeeded:
+            return self.admit_resource(resource)
+        if not _RESOURCE_RE.fullmatch(resource):
+            return "other"
+        with self._resources_lock:
+            if resource in self._resources:
+                return resource
+        return "other"
+
+    @contextmanager
+    def track(self, verb: str, resource: str, status: Callable[[], int],
+              long_running: bool = False):
+        """Instrument one request: in-flight (or long-running) gauge for
+        the request's lifetime, duration + total observed at completion
+        with the status ``status()`` reports then."""
+        kind = "readOnly" if verb in READ_VERBS else "mutating"
+        gauge = (
+            # gauge label resolves on entry: already-admitted resources
+            # keep their name, never-seen ones ride "other" until a
+            # success admits them
+            self.longrunning.labels(
+                verb, self._resource_label(resource, succeeded=False)
+            )
+            if long_running else self.inflight.labels(kind)
+        )
+        gauge.inc()
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            gauge.dec()
+            code = status()
+            label = self._resource_label(
+                resource,
+                succeeded=(verb in _PROVING_VERBS and 200 <= code < 400),
+            )
+            self.request_duration.labels(verb, label, str(code)).observe(
+                time.perf_counter() - t0
+            )
+            self.request_total.labels(verb, label, str(code)).inc()
+
+    def expose(self) -> str:
+        return self.registry.expose()

@@ -45,8 +45,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
 from ..api import scheme
+from ..metrics.health import HealthChecks
 from ..store.memstore import CompactedError, ConflictError, MemStore
 from .admission import AdmissionDenied, Registry, ValidationError
+from .metrics import APIServerMetrics
 
 PREFIX = "/apis/"
 
@@ -54,6 +56,9 @@ PREFIX = "/apis/"
 class _Handler(BaseHTTPRequestHandler):
     store: MemStore     # bound by the server factory
     registry: Registry  # admission + validation chain (bound by the factory)
+    metrics: APIServerMetrics   # request instrumentation (bound by factory)
+    health: HealthChecks        # /healthz /readyz /livez (bound by factory)
+    metrics_sources: tuple = ()  # extra Prometheus-text providers
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:
@@ -61,12 +66,23 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ plumbing
     def _reply(self, obj, status: int = 200) -> None:
+        self._status = status
         body = json.dumps(obj).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
+
+    def _reply_text(self, body: str, status: int = 200,
+                    content_type: str = "text/plain; charset=utf-8") -> None:
+        self._status = status
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
 
     def _error(self, status: int, reason: str) -> None:
         self._reply({"error": reason}, status=status)
@@ -88,12 +104,56 @@ class _Handler(BaseHTTPRequestHandler):
         raw = self.rfile.read(length) if length else b"{}"
         return json.loads(raw or b"{}")
 
+    # -------------------------------------------------------- diagnostics
+    def _serve_diagnostics(self) -> None:
+        """GET outside /apis/: /metrics (Prometheus text 0.0.4, the server
+        set plus any extra bound sources) and the component-base-style
+        /healthz /readyz /livez named-check endpoints — served through the
+        shared mux (kubetpu.metrics.diagmux) the scheduler listener also
+        mounts."""
+        from ..metrics.diagmux import diagnostics_response
+
+        parts = urlsplit(self.path)
+        try:
+            res = diagnostics_response(
+                parts.path, parse_qs(parts.query, keep_blank_values=True),
+                metrics_sources=(self.metrics.expose, *self.metrics_sources),
+                health=self.health,
+            )
+        except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+            self._error(500, f"{type(e).__name__}: {e}")
+            return
+        if res is None:
+            self._error(404, "unknown path")
+            return
+        status, content_type, body = res
+        self._reply_text(body, status=status, content_type=content_type)
+
     # --------------------------------------------------------------- verbs
     def do_GET(self) -> None:  # noqa: N802
+        if not urlsplit(self.path).path.startswith(PREFIX):
+            self._serve_diagnostics()
+            return
         kind, key, q = self._route()
         if kind is None:
             self._error(404, "unknown path")
             return
+        if key is None and q.get("watch"):
+            verb = "WATCH"
+        elif key is None:
+            verb = "LIST"
+        else:
+            verb = "GET"
+        with self.metrics.track(
+            verb, kind, lambda: getattr(self, "_status", 0),
+            # EVERY watch is long-running (the reference's longrunning
+            # predicate covers long-polls too): a blocked wait_for must not
+            # hold the in-flight gauge
+            long_running=(verb == "WATCH"),
+        ):
+            self._do_get(kind, key, q)
+
+    def _do_get(self, kind, key, q) -> None:
         try:
             if key is None and q.get("watch"):
                 if q.get("stream"):
@@ -106,6 +166,11 @@ class _Handler(BaseHTTPRequestHandler):
                     label_selector=q.get("labelSelector", ""),
                     field_selector=q.get("fieldSelector", ""),
                 )
+                if items:
+                    # a non-empty list proves the kind exists; an empty
+                    # 200 proves nothing (MemStore lists unknown kinds as
+                    # empty), so bare LIST successes never admit labels
+                    self.metrics.admit_resource(kind)
                 self._reply({
                     "items": [
                         {"key": k, "object": scheme.encode(o)}
@@ -194,6 +259,7 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, str(e))
             return
         deadline = _time.monotonic() + timeout
+        self._status = 200
         self.send_response(200)
         self.send_header("Content-Type", "application/x-ndjson")
         self.send_header("Transfer-Encoding", "chunked")
@@ -237,62 +303,79 @@ class _Handler(BaseHTTPRequestHandler):
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
-        try:
-            obj = scheme.decode(self._read_body())
-            # decode → admission (mutating) → validate → admission
-            # (validating) → storage — the reference write path
-            # (registry/store.go:514 Create's strategy run)
-            obj = self.registry.admit(kind, key, obj, verb="create")
-            rv = self.store.create(kind, key, obj)
-            self._reply({"resourceVersion": rv}, status=201)
-        except ConflictError as e:
-            self._error(409, str(e))
-        except ValidationError as e:
-            self._error(422, str(e))
-        except AdmissionDenied as e:
-            self._error(403, str(e))
-        except scheme.SchemeError as e:
-            self._error(400, str(e))
-        except Exception as e:
-            self._error(500, f"{type(e).__name__}: {e}")
+        with self.metrics.track(
+            "CREATE", kind, lambda: getattr(self, "_status", 0)
+        ):
+            try:
+                obj = scheme.decode(self._read_body())
+                # decode → admission (mutating) → validate → admission
+                # (validating) → storage — the reference write path
+                # (registry/store.go:514 Create's strategy run). The
+                # admission chain's write locks span admit AND create so a
+                # usage-counting validator (quota) cannot race a concurrent
+                # create of the same scope.
+                with self.registry.locked(kind, key, obj, verb="create"):
+                    obj = self.registry.admit(kind, key, obj, verb="create")
+                    rv = self.store.create(kind, key, obj)
+                self._reply({"resourceVersion": rv}, status=201)
+            except ConflictError as e:
+                self._error(409, str(e))
+            except ValidationError as e:
+                self._error(422, str(e))
+            except AdmissionDenied as e:
+                self._error(403, str(e))
+            except scheme.SchemeError as e:
+                self._error(400, str(e))
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
 
     def do_PUT(self) -> None:  # noqa: N802
         kind, key, q = self._route()
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
-        try:
-            obj = scheme.decode(self._read_body())
-            old, _old_rv = self.store.get(kind, key)
-            obj = self.registry.admit(kind, key, obj, old=old, verb="update")
-            expect = (
-                int(q["resourceVersion"]) if "resourceVersion" in q else None
-            )
-            rv = self.store.update(kind, key, obj, expect_rv=expect)
-            self._reply({"resourceVersion": rv})
-        except ConflictError as e:
-            self._error(409, str(e))
-        except ValidationError as e:
-            self._error(422, str(e))
-        except AdmissionDenied as e:
-            self._error(403, str(e))
-        except scheme.SchemeError as e:
-            self._error(400, str(e))
-        except Exception as e:
-            self._error(500, f"{type(e).__name__}: {e}")
+        with self.metrics.track(
+            "UPDATE", kind, lambda: getattr(self, "_status", 0)
+        ):
+            try:
+                obj = scheme.decode(self._read_body())
+                with self.registry.locked(kind, key, obj, verb="update"):
+                    old, _old_rv = self.store.get(kind, key)
+                    obj = self.registry.admit(
+                        kind, key, obj, old=old, verb="update"
+                    )
+                    expect = (
+                        int(q["resourceVersion"])
+                        if "resourceVersion" in q else None
+                    )
+                    rv = self.store.update(kind, key, obj, expect_rv=expect)
+                self._reply({"resourceVersion": rv})
+            except ConflictError as e:
+                self._error(409, str(e))
+            except ValidationError as e:
+                self._error(422, str(e))
+            except AdmissionDenied as e:
+                self._error(403, str(e))
+            except scheme.SchemeError as e:
+                self._error(400, str(e))
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
 
     def do_DELETE(self) -> None:  # noqa: N802
         kind, key, _ = self._route()
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
-        try:
-            rv = self.store.delete(kind, key)
-            self._reply({"resourceVersion": rv})
-        except KeyError:
-            self._error(404, f"{kind}/{key} not found")
-        except Exception as e:
-            self._error(500, f"{type(e).__name__}: {e}")
+        with self.metrics.track(
+            "DELETE", kind, lambda: getattr(self, "_status", 0)
+        ):
+            try:
+                rv = self.store.delete(kind, key)
+                self._reply({"resourceVersion": rv})
+            except KeyError:
+                self._error(404, f"{kind}/{key} not found")
+            except Exception as e:
+                self._error(500, f"{type(e).__name__}: {e}")
 
 
 class APIServer:
@@ -302,11 +385,32 @@ class APIServer:
         self, store: MemStore | None = None,
         host: str = "127.0.0.1", port: int = 0,
         registry: Registry | None = None,
+        metrics_sources: tuple = (),
     ) -> None:
+        """``metrics_sources``: extra Prometheus-text providers appended to
+        GET /metrics (e.g. a co-hosted controller family's workqueue set)."""
         self.store = store if store is not None else MemStore()
         self.registry = registry if registry is not None else Registry()
+        self.metrics = APIServerMetrics()
+        self.health = HealthChecks()
+        # the storage-backend check (the reference's etcd check): probing
+        # the store's revision counter exercises its lock + native core
+        def _store_check() -> None:
+            rv = self.store.resource_version   # property on MemStore
+            if callable(rv):                   # method on store stand-ins
+                rv()
+
+        # healthz/readyz only — the reference excludes its etcd check
+        # from /livez: a storage outage must mark the server NOT-READY,
+        # not not-alive, or a liveness probe restart-loops a process
+        # that is still serving watches
+        self.health.add_check(
+            "store", _store_check, endpoints=("healthz", "readyz")
+        )
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
+            "metrics": self.metrics, "health": self.health,
+            "metrics_sources": tuple(metrics_sources),
             # responses are small; Nagle + the client's delayed ACK would
             # stall every keep-alive request ~40 ms (a handler-class knob:
             # socketserver.StreamRequestHandler.disable_nagle_algorithm)

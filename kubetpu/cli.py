@@ -97,19 +97,22 @@ def cmd_serve(args) -> int:
 
 def cmd_apiserver(args) -> int:
     from .apiserver import APIServer, Registry
-    from .controllers import quota_admission
+    from .controllers import install_quota_admission
     from .store import MemStore
 
     store = MemStore()
     registry = Registry()
     # quota enforcement is admission-time (the reference's resourcequota
-    # admission plugin): pod creates past a namespace's hard caps get 403
-    registry.add_validating_hook(quota_admission(store), kinds=("pods",))
+    # admission plugin): pod creates past a namespace's hard caps get 403;
+    # the install also takes the per-namespace write lock so concurrent
+    # creates cannot race past hard
+    install_quota_admission(registry, store)
     server = APIServer(
         store, host=args.host, port=args.port, registry=registry,
     ).start()
     print(f"kubetpu apiserver serving on {server.url} "
-          f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N)",
+          f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N; "
+          f"diagnostics: /metrics /healthz /readyz /livez)",
           flush=True)
     try:
         import threading
@@ -249,8 +252,27 @@ def cmd_scheduler(args) -> int:
     informers = SchedulerInformers(store, sched)
     _retry_start(informers.start, "scheduler informers")
     is_leader = _maybe_elect(args, store, "kube-scheduler")
+    diag = None
+    if getattr(args, "diagnostics_port", 0):
+        from .sched.diagnostics import DiagnosticsServer
+
+        try:
+            diag = DiagnosticsServer(sched, port=args.diagnostics_port)
+        except OSError as e:
+            # a second scheduler on the host (HA standby) must not die on
+            # the diagnostics side port; it just runs unobserved
+            print(
+                f"diagnostics port {args.diagnostics_port} unavailable "
+                f"({e}); continuing without the diagnostics listener",
+                file=sys.stderr, flush=True,
+            )
+        else:
+            diag.add_informers(informers)
+            diag.start()
     print(f"kubetpu scheduler running against {args.server} "
-          f"(engine {args.engine})", flush=True)
+          f"(engine {args.engine}"
+          + (f"; diagnostics on {diag.url}" if diag is not None else "")
+          + ")", flush=True)
 
     def once():
         if not is_leader():
@@ -258,7 +280,11 @@ def cmd_scheduler(args) -> int:
         informers.pump()
         sched.schedule_batch()
         sched._drain_bind_completions()
-    return _make_loop(once)()
+    try:
+        return _make_loop(once)()
+    finally:
+        if diag is not None:
+            diag.close()
 
 
 def cmd_controller_manager(args) -> int:
@@ -522,6 +548,9 @@ def build_parser() -> argparse.ArgumentParser:
     schd.add_argument("--engine", default="greedy",
                       choices=["greedy", "batched"])
     schd.add_argument("--leader-elect", action="store_true")
+    schd.add_argument("--diagnostics-port", type=int, default=10251,
+                      help="side port for /metrics /healthz /readyz /livez "
+                           "/trace (0 disables)")
     schd.set_defaults(fn=cmd_scheduler)
 
     cm = sub.add_parser(

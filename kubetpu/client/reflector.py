@@ -131,6 +131,26 @@ class Reflector:
         self._watcher = None
         self.relists = 0    # metrics: compaction-forced relists
 
+    def _store_supports_stream(self) -> bool:
+        """Explicit capability detection for the streaming watch — an
+        advertised ``supports_stream`` attribute, else a NAMED ``stream``
+        parameter in ``watch``'s signature. A bare **kwargs proves
+        nothing (a transparent delegating wrapper over a pull-only store
+        has one), so it does not count — such a wrapper must advertise
+        ``supports_stream`` itself. Probing by catching TypeError would
+        also swallow REAL TypeErrors raised inside a stream-capable
+        store's watch()."""
+        import inspect
+
+        cap = getattr(self._store, "supports_stream", None)
+        if cap is not None:
+            return bool(cap)
+        try:
+            sig = inspect.signature(self._store.watch)
+        except (TypeError, ValueError):
+            return False
+        return "stream" in sig.parameters
+
     def sync(self) -> None:
         """Initial (or compaction-forced) list + watch-from-revision."""
         old = self._watcher
@@ -143,14 +163,11 @@ class Reflector:
             kwargs["field_selector"] = self._field_selector
         items, rv = self._store.list(self.informer.kind, **kwargs)
         self.informer._replace(items)
-        if self._stream:
-            try:
-                self._watcher = self._store.watch(
-                    self.informer.kind, rv, stream=True, **kwargs
-                )
-                return
-            except TypeError:
-                pass   # store without a streaming watch: pull form below
+        if self._stream and self._store_supports_stream():
+            self._watcher = self._store.watch(
+                self.informer.kind, rv, stream=True, **kwargs
+            )
+            return
         self._watcher = self._store.watch(self.informer.kind, rv, **kwargs)
 
     def step(self) -> int:

@@ -10,6 +10,7 @@ from .namespace import NamespaceController  # noqa: F401
 from .resourcequota import (  # noqa: F401
     RESOURCE_QUOTAS,
     ResourceQuotaController,
+    install_quota_admission,
     quota_admission,
 )
 from .ttlafterfinished import TTLAfterFinishedController  # noqa: F401

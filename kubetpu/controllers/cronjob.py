@@ -135,13 +135,46 @@ class CronJobController(QueueController):
                 self.queue.add(key)
         return super().step(max_items)
 
+    #: missed-occurrence walk bound (cronjob/utils.go:170's tooManyMissed
+    #: cap): past this many missed runs the anchor is months stale (or the
+    #: schedule is pathological) and walking every occurrence would pin the
+    #: controller queue — bisect straight to the most recent run instead
+    max_missed_runs = 100
+
+    def _most_recent_run(self, schedule: str, known: float, now: float) -> float:
+        """Latest scheduled time <= ``now``, given ``known`` is one such
+        run: bisection over cron_next (monotone), O(log) calls — the O(1)
+        arithmetic shortcut the reference uses, schedule-grammar-agnostic."""
+        lo, hi = known, now
+        while hi - lo > 60:
+            mid = float((int(lo) + int(hi)) // 2)
+            try:
+                nxt = cron_next(schedule, mid)
+            except ValueError:
+                break
+            if nxt <= now:
+                lo = nxt            # a later run exists; jump to it
+            else:
+                hi = mid            # no runs in (mid, now]
+        try:
+            # the closing window is < one minute wide; runs are minute-
+            # granular, so at most one later run can still fit
+            nxt = cron_next(schedule, lo)
+            if nxt <= now:
+                lo = nxt
+        except ValueError:
+            pass
+        return lo
+
     def sync(self, key: str) -> None:
         cj = self._cjs.store.get(key)
         if cj is None or cj.suspend or cj.template is None:
             return
         now = self.wall()
-        # collapse missed runs to the most recent scheduled time <= now
+        # collapse missed runs to the most recent scheduled time <= now,
+        # walking at most ``max_missed_runs`` occurrences before jumping
         due = None
+        missed = 0
         probe = self._anchor(key, cj, now)
         while True:
             try:
@@ -151,6 +184,20 @@ class CronJobController(QueueController):
             if nxt > now:
                 break
             due, probe = nxt, nxt
+            missed += 1
+            if missed >= self.max_missed_runs:
+                # tooManyMissed: stop walking the backlog and jump straight
+                # to the MOST RECENT missed run — the reference warns but
+                # still schedules the latest time (nextScheduleTime returns
+                # mostRecentTime alongside the tooManyMissed error), and
+                # stamping it re-anchors lastScheduleTime near now so later
+                # syncs never re-walk the stale history
+                self._log.warning(
+                    "too many missed start times; jumping to the most "
+                    "recent", cronjob=key, missed_at_least=missed,
+                )
+                due = self._most_recent_run(cj.schedule, due, now)
+                break
         if due is None:
             return
         ref = _owner_ref(cj)

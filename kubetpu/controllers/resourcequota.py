@@ -88,7 +88,13 @@ def quota_admission(store: MemStore):
     """Validating-hook factory for apiserver.Registry: reject pod creates
     that would exceed any ResourceQuota in the namespace (admission is
     synchronous against the LIVE store, like the reference's quota
-    evaluator — informer lag cannot let a burst slip past hard)."""
+    evaluator — informer lag cannot let a burst slip past hard).
+
+    The check alone is NOT race-free: two concurrent POSTs can both read
+    usage below ``hard`` and both create. Install via
+    ``install_quota_admission`` so the registry also holds a per-namespace
+    write lock across check+create (the reference quota admission
+    serializes through its locked quota accessor the same way)."""
     from ..apiserver.admission import AdmissionDenied
 
     def hook(kind: str, key: str, obj, old) -> None:
@@ -114,3 +120,38 @@ def quota_admission(store: MemStore):
                     )
 
     return hook
+
+
+def quota_write_lock():
+    """Per-namespace write-lock provider for apiserver.Registry: serializes
+    the quota check with the create it gates, so concurrent POSTs in one
+    namespace cannot both pass the usage check and overflow ``hard``."""
+    import threading
+
+    # one entry per namespace ever seen, retained for the process lifetime:
+    # eviction cannot be made safe without reopening the race (a thread
+    # holding an evicted lock no longer excludes a thread that minted a
+    # fresh one), and a Lock is ~100 bytes — bounded by distinct
+    # namespaces, not by request volume
+    locks: dict[str, threading.Lock] = {}
+    meta = threading.Lock()
+
+    def provider(kind: str, key: str, obj, verb: str):
+        if kind != PODS or verb != "create":
+            return None
+        ns = getattr(obj, "namespace", "") or ""
+        with meta:
+            lock = locks.get(ns)
+            if lock is None:
+                lock = locks[ns] = threading.Lock()
+        return lock
+
+    return provider
+
+
+def install_quota_admission(registry, store: MemStore) -> None:
+    """Wire quota enforcement onto an apiserver admission registry: the
+    live-usage validating hook plus the per-namespace write lock that makes
+    check+create atomic under concurrency."""
+    registry.add_validating_hook(quota_admission(store), kinds=(PODS,))
+    registry.add_write_lock(quota_write_lock(), kinds=(PODS,))

@@ -61,9 +61,17 @@ class WorkQueue:
         self,
         clock: Callable[[], float] = time.monotonic,
         limiter: ExponentialBackoff | None = None,
+        name: str = "",
+        metrics: "QueueMetrics | None" = None,
     ) -> None:
+        """``metrics``: an optional ``kubetpu.metrics.workqueue``
+        ``QueueMetrics`` recorder — instrumented exactly at client-go's
+        seams (add/get/done/retry), so depth/adds/latency land under the
+        reference names with zero cost when unwired."""
         self.clock = clock
         self.limiter = limiter or ExponentialBackoff()
+        self.name = name
+        self.metrics = metrics
         self._queue: list[Any] = []           # FIFO of ready keys
         self._dirty: set[Any] = set()
         self._processing: set[Any] = set()
@@ -77,8 +85,12 @@ class WorkQueue:
         self._dirty.add(key)
         self._waiting.pop(key, None)          # direct add outruns a delay
         if key in self._processing:
+            if self.metrics is not None:      # dirty insert still counts
+                self.metrics.add(key, len(self._queue))
             return                            # re-queued by done()
         self._queue.append(key)
+        if self.metrics is not None:
+            self.metrics.add(key, len(self._queue))
 
     def add_after(self, key: Any, delay_s: float) -> None:
         if delay_s <= 0:
@@ -93,6 +105,8 @@ class WorkQueue:
         heapq.heappush(self._heap, (due, self._seq, key))
 
     def add_rate_limited(self, key: Any) -> None:
+        if self.metrics is not None:
+            self.metrics.retry(key)
         self.add_after(key, self.limiter.when(key))
 
     def forget(self, key: Any) -> None:
@@ -118,13 +132,19 @@ class WorkQueue:
                 continue
             self._dirty.discard(key)
             self._processing.add(key)
+            if self.metrics is not None:
+                self.metrics.get(key, len(self._queue))
             return key
         return None
 
     def done(self, key: Any) -> None:
         self._processing.discard(key)
         if key in self._dirty:                 # re-added mid-processing
+            # its queue wait keeps the timestamp recorded when the dirty
+            # add happened (that add() already counted it)
             self._queue.append(key)
+        if self.metrics is not None:           # depth AFTER any requeue
+            self.metrics.done(key, len(self._queue))
 
     def next_due_in(self) -> float | None:
         """Seconds until the earliest parked key is due (None when no key
@@ -201,13 +221,35 @@ class QueueController:
     max_retries = 15
 
     def __init__(
-        self, store, clock: Callable[[], float] | None = None
+        self, store, clock: Callable[[], float] | None = None,
+        metrics_provider=None, queue_name: str | None = None,
     ) -> None:
+        """``metrics_provider``: a ``WorkqueueMetricsProvider`` for this
+        controller's queue metrics; defaults to the process-wide provider
+        (``kubetpu.metrics.workqueue.default_provider``) so one /metrics
+        exposition covers every controller, client-go's global-provider
+        shape. Pass ``False`` to run unmetered.
+
+        ``queue_name``: metrics label for this controller's queue
+        (default: the class name). Two instances of one controller class
+        sharing a process (an HA harness, a multi-stack test) MUST pass
+        distinct names — the depth/unfinished gauges are set()-style, so
+        same-named queues clobber each other's samples."""
         from ..klog import get_logger
+        from ..metrics.workqueue import default_provider
 
         self.store = store
         self.clock = clock if clock is not None else time.monotonic
-        self.queue = WorkQueue(clock=self.clock)
+        qname = queue_name or type(self).__name__
+        if metrics_provider is None:
+            metrics_provider = default_provider()
+        queue_metrics = (
+            metrics_provider.for_queue(qname, clock=self.clock)
+            if metrics_provider else None
+        )
+        self.queue = WorkQueue(
+            clock=self.clock, name=qname, metrics=queue_metrics,
+        )
         self._log = get_logger(
             f"kubetpu.controllers.{type(self).__name__}"
         )

@@ -1,5 +1,8 @@
 """Metrics — Prometheus-shaped counters/gauges/histograms with a registry
-and text exposition (the component-base/metrics analog, SURVEY §5)."""
+and text exposition (the component-base/metrics analog, SURVEY §5), plus
+the rest of the observability plane: named health checks (healthz/readyz/
+livez), the client-go workqueue metric set, device-side TPU counters, and
+a minimal exposition-text parser for scrape round-trips."""
 
 from .registry import (  # noqa: F401
     Counter,
@@ -8,4 +11,12 @@ from .registry import (  # noqa: F401
     Registry,
     exponential_buckets,
 )
+from .health import CheckResult, HealthChecks  # noqa: F401
 from .scheduler_metrics import SchedulerMetricsRegistry  # noqa: F401
+from .textparse import ParsedMetrics, parse_prometheus_text  # noqa: F401
+from .tpu import TPUBackendMetrics, batch_nbytes, jit_cache_size  # noqa: F401
+from .workqueue import (  # noqa: F401
+    QueueMetrics,
+    WorkqueueMetricsProvider,
+    default_provider,
+)

@@ -1,0 +1,42 @@
+"""Shared diagnostics mux — ONE implementation of the /metrics + health
+endpoint surface that both HTTP fronts mount (the apiserver's sidecar
+routes and the scheduler's DiagnosticsServer), so content types, path
+normalization, and health dispatch cannot drift between them."""
+
+from __future__ import annotations
+
+from typing import Callable, Iterable, Mapping
+
+from .health import HealthChecks
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+TEXT_CONTENT_TYPE = "text/plain; charset=utf-8"
+
+
+def diagnostics_response(
+    path: str,
+    query: Mapping | None = None,
+    metrics_sources: Iterable[Callable[[], str]] = (),
+    health: HealthChecks | None = None,
+    extra: Mapping[str, Callable[[], tuple[str, str]]] | None = None,
+) -> tuple[int, str, str] | None:
+    """Answer one diagnostics request: ``/metrics`` (the joined Prometheus
+    text of every source), the health endpoints (delegated to
+    ``health.handle``), or an ``extra`` route mapping path →
+    ``() -> (content_type, body)``. Returns (status, content_type, body),
+    or None when the path belongs to none of them (the caller keeps its
+    own 404 shape)."""
+    path = "/" + path.strip("/")
+    if path == "/metrics":
+        return 200, PROM_CONTENT_TYPE, "".join(s() for s in metrics_sources)
+    if extra is not None:
+        fn = extra.get(path)
+        if fn is not None:
+            content_type, body = fn()
+            return 200, content_type, body
+    if health is not None:
+        res = health.handle(path, query)
+        if res is not None:
+            status, body = res
+            return status, TEXT_CONTENT_TYPE, body
+    return None

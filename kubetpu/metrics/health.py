@@ -1,0 +1,147 @@
+"""Named health checks — the component-base/healthz analog.
+
+Reference: ``staging/src/k8s.io/apiserver/pkg/server/healthz`` serves
+``/healthz``, ``/readyz`` and ``/livez``, each an ordered set of NAMED
+checks (``PingHealthz``, ``InformerSync``, shutdown hooks …) rendered as
+
+    [+]ping ok
+    [-]informer-sync failed: reason withheld
+    healthz check failed
+
+with per-check sub-paths (``/healthz/<check>``) and ``?verbose`` forcing
+the breakdown even when healthy, and ``?exclude=<name>`` dropping a check
+from one probe. Here one ``HealthChecks`` object backs all three endpoints:
+checks register with the endpoint groups they participate in (a not-ready
+server is still alive, so readyz usually carries more checks than livez —
+the reference's ``installable`` split).
+
+A check is any callable: return None (or True) = healthy; raise, or return
+False / an error string = unhealthy. Checks run on the serving thread, so
+they must be cheap (the reference's contract too).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import Callable, Iterable
+
+ENDPOINTS = ("healthz", "readyz", "livez")
+
+
+@dataclass(frozen=True)
+class CheckResult:
+    name: str
+    healthy: bool
+    reason: str = ""
+
+
+class HealthChecks:
+    """Named, registrable health checks behind /healthz /readyz /livez."""
+
+    def __init__(self, ping: bool = True) -> None:
+        # endpoint -> ordered {name: fn}; registration order is render order
+        self._checks: dict[str, dict[str, Callable]] = {
+            ep: {} for ep in ENDPOINTS
+        }
+        self._lock = threading.Lock()
+        if ping:
+            self.add_check("ping", lambda: None)
+
+    def add_check(
+        self,
+        name: str,
+        fn: Callable[[], object],
+        endpoints: Iterable[str] = ENDPOINTS,
+    ) -> None:
+        """Register ``fn`` under ``name`` on the given endpoint groups
+        (default: all three). Re-registering a name replaces the check."""
+        with self._lock:
+            for ep in endpoints:
+                if ep not in self._checks:
+                    raise ValueError(f"unknown endpoint {ep!r}")
+                self._checks[ep][name] = fn
+
+    def names(self, endpoint: str = "healthz") -> list[str]:
+        with self._lock:
+            return list(self._checks[endpoint])
+
+    # ------------------------------------------------------------- running
+    @staticmethod
+    def _run_one(name: str, fn: Callable[[], object]) -> CheckResult:
+        try:
+            out = fn()
+        except Exception as e:  # noqa: BLE001 — an unhealthy check
+            return CheckResult(name, False, f"{type(e).__name__}: {e}")
+        if out is None or out is True:
+            return CheckResult(name, True)
+        if out is False:
+            return CheckResult(name, False, "check returned false")
+        return CheckResult(name, False, str(out))
+
+    def run(
+        self, endpoint: str = "healthz", exclude: Iterable[str] = ()
+    ) -> list[CheckResult]:
+        skip = set(exclude)
+        with self._lock:
+            checks = list(self._checks[endpoint].items())
+        return [
+            self._run_one(name, fn)
+            for name, fn in checks
+            if name not in skip
+        ]
+
+    # ------------------------------------------------------------- serving
+    def handle(
+        self, path: str, query: dict | None = None
+    ) -> tuple[int, str] | None:
+        """Answer one health request: ``path`` is ``/healthz``,
+        ``/healthz/<check>``, ``/readyz``, ``/livez`` (+ sub-checks).
+        Returns (status, text/plain body), or None when the path is not a
+        health endpoint. 200 when every check passes, 503 otherwise —
+        the component-base response shape."""
+        q = query or {}
+        parts = path.strip("/").split("/")
+        if not parts or parts[0] not in ENDPOINTS:
+            return None
+        endpoint = parts[0]
+        if len(parts) > 2:            # /healthz/<check>/extra: not a thing
+            return 404, "unknown health path\n"
+        if len(parts) == 2:           # /healthz/<check>: one check, terse
+            with self._lock:
+                fn = self._checks[endpoint].get(parts[1])
+            if fn is None:
+                return 404, f"no check named {parts[1]!r}\n"
+            res = self._run_one(parts[1], fn)
+            if res.healthy:
+                return 200, "ok\n"
+            return 503, f"internal server error: {res.reason}\n"
+        exclude = [
+            e for raw in _as_list(q.get("exclude")) for e in raw.split(",") if e
+        ]
+        results = self.run(endpoint, exclude=exclude)
+        healthy = all(r.healthy for r in results)
+        verbose = "verbose" in q or not healthy
+        if not verbose:
+            return 200, "ok\n"
+        lines = [
+            f"[+]{r.name} ok" if r.healthy
+            # aggregate endpoints withhold the reason (component-base does
+            # too — they may face unauthenticated probers); the per-check
+            # sub-path /<endpoint>/<name> carries the real error
+            else f"[-]{r.name} failed: reason withheld"
+            for r in results
+        ]
+        lines.append(
+            f"{endpoint} check passed" if healthy
+            else f"{endpoint} check failed"
+        )
+        return (200 if healthy else 503), "\n".join(lines) + "\n"
+
+
+def _as_list(v) -> list[str]:
+    if v is None:
+        return []
+    if isinstance(v, str):
+        return [v]
+    return [str(x) for x in v]

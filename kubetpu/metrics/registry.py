@@ -74,7 +74,11 @@ class Counter(_Metric):
         return Counter(self.name)
 
     def inc(self, amount: float = 1.0) -> None:
-        self.value += amount
+        # locked: apiserver handler threads and the scheduler loop mutate
+        # concurrently (ThreadingHTTPServer); a bare += is a lost-update
+        # race across threads
+        with self._lock:
+            self.value += amount
 
     def samples(self):
         if self.label_names:
@@ -91,10 +95,12 @@ class Gauge(Counter):
         return Gauge(self.name)
 
     def set(self, value: float) -> None:
-        self.value = value
+        with self._lock:
+            self.value = value
 
     def dec(self, amount: float = 1.0) -> None:
-        self.value -= amount
+        with self._lock:
+            self.value -= amount
 
 
 class Histogram(_Metric):
@@ -113,9 +119,10 @@ class Histogram(_Metric):
 
     def observe(self, value: float) -> None:
         i = bisect.bisect_left(self.buckets, value)
-        self.counts[i] += 1
-        self.total += 1
-        self.sum += value
+        with self._lock:
+            self.counts[i] += 1
+            self.total += 1
+            self.sum += value
 
     def observe_n(self, value: float, n: int) -> None:
         """n identical observations in O(1) — batch cycles record one
@@ -123,9 +130,17 @@ class Histogram(_Metric):
         if n <= 0:
             return
         i = bisect.bisect_left(self.buckets, value)
-        self.counts[i] += n
-        self.total += n
-        self.sum += value * n
+        with self._lock:
+            self.counts[i] += n
+            self.total += n
+            self.sum += value * n
+
+    def _consistent_state(self) -> tuple[list[int], int, float]:
+        """counts/total/sum copied under the lock — a reader racing an
+        observe() must not see counts moved but total not (a torn,
+        non-monotonic histogram breaks histogram_quantile)."""
+        with self._lock:
+            return list(self.counts), self.total, self.sum
 
     def merged(self) -> "Histogram":
         """Aggregate across children (and self) — what a PromQL sum() over
@@ -136,17 +151,18 @@ class Histogram(_Metric):
         if children and self.total:
             sources.append(self)
         for src in sources:
-            for i, c in enumerate(src.counts):
+            counts, total, s = src._consistent_state()
+            for i, c in enumerate(counts):
                 out.counts[i] += c
-            out.total += src.total
-            out.sum += src.sum
+            out.total += total
+            out.sum += s
         return out
 
     def since(self, earlier: "Histogram") -> "Histogram":
         """The delta histogram vs an earlier ``merged()`` snapshot — scopes
         quantiles to a measurement window (the perf harness's per-workload
         p99)."""
-        h = self.merged() if self._children else self
+        h = self.merged()
         out = Histogram(self.name, buckets=self.buckets)
         out.counts = [a - b for a, b in zip(h.counts, earlier.counts)]
         out.total = h.total - earlier.total
@@ -156,7 +172,9 @@ class Histogram(_Metric):
     def quantile(self, q: float) -> float:
         """histogram_quantile(q, …): linear interpolation inside the target
         bucket; NaN when empty; the last bucket's upper bound caps +Inf."""
-        h = self.merged() if self._children_snapshot() else self
+        # merged() copies under the lock even without children, so a racing
+        # observe() cannot tear the read
+        h = self.merged()
         if h.total == 0:
             return float("nan")
         rank = q * h.total
@@ -172,13 +190,14 @@ class Histogram(_Metric):
 
     def samples(self):
         def rows(child, key):
+            counts, total, s = child._consistent_state()
             acc = 0
             for i, ub in enumerate(child.buckets):
-                acc += child.counts[i]
+                acc += counts[i]
                 yield "_bucket", key + (("le", _fmt(ub)),), acc
-            yield "_bucket", key + (("le", "+Inf"),), child.total
-            yield "_sum", key, child.sum
-            yield "_count", key, child.total
+            yield "_bucket", key + (("le", "+Inf"),), total
+            yield "_sum", key, s
+            yield "_count", key, total
 
         if self.label_names:
             for key, child in self._children_snapshot():
@@ -236,12 +255,24 @@ class Registry:
                     label_pairs = tuple(zip(m.label_names, label_pairs))
                 if label_pairs:
                     body = ",".join(
-                        f'{k}="{v}"' for k, v in label_pairs
+                        f'{k}="{_esc_label(v)}"' for k, v in label_pairs
                     )
                     out.append(f"{name}{suffix}{{{body}}} {_num(value)}")
                 else:
                     out.append(f"{name}{suffix} {_num(value)}")
         return "\n".join(out) + "\n"
+
+
+def _esc_label(v) -> str:
+    """Exposition-format label-value escaping (text format 0.0.4): label
+    values may carry any UTF-8, so backslash, double-quote, and newline
+    must be escaped or one hostile value corrupts the whole scrape page."""
+    return (
+        str(v)
+        .replace("\\", "\\\\")
+        .replace('"', '\\"')
+        .replace("\n", "\\n")
+    )
 
 
 def _num(v) -> str:

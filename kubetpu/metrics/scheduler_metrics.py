@@ -9,11 +9,18 @@ layouts (pkg/scheduler/metrics/metrics.go):
 - pod_scheduling_attempts (:327, ExponentialBuckets(1, 2, 5))
 - framework_extension_point_duration_seconds{extension_point, status,
   profile} (:344, ExponentialBuckets(0.0001, 2, 12))
+- plugin_execution_duration_seconds{plugin, extension_point, status}
+  (:353, ExponentialBuckets(0.00001, 1.5, 20)) — per host-side lifecycle
+  plugin call; the fused device Filter+Score program cannot be timed
+  per-plugin (it is ONE XLA program), so its wall time lands on
+  extension_point="Filter+Score" at the framework level instead
 - schedule_attempts_total{result, profile}, preemption_attempts_total,
   preemption_victims (:267 ExponentialBuckets(1, 2, 7)), pending_pods{queue}
 """
 
 from __future__ import annotations
+
+import math
 
 from .registry import Registry, exponential_buckets
 
@@ -55,6 +62,12 @@ class SchedulerMetricsRegistry:
             labels=("extension_point", "status", "profile"),
             buckets=exponential_buckets(0.0001, 2, 12),
         )
+        self.plugin_execution_duration = r.histogram(
+            "scheduler_plugin_execution_duration_seconds",
+            "Duration for running a plugin at a specific extension point.",
+            labels=("plugin", "extension_point", "status"),
+            buckets=exponential_buckets(0.00001, 1.5, 20),
+        )
         self.schedule_attempts = r.counter(
             "scheduler_schedule_attempts_total",
             "Number of attempts to schedule pods, by the result.",
@@ -88,3 +101,64 @@ class SchedulerMetricsRegistry:
         """p99 of pod_scheduling_sli_duration_seconds across attempt labels
         (histogram_quantile over the summed buckets)."""
         return self.pod_scheduling_sli_duration.quantile(0.99)
+
+    def _attempts_by_result(self) -> dict:
+        attempts: dict[str, int] = {}
+        for key, child in self.schedule_attempts._children_snapshot():
+            result = key[0] if key else "unknown"
+            attempts[result] = attempts.get(result, 0) + int(child.value)
+        return attempts
+
+    def snapshot_baseline(self) -> dict:
+        """Capture the current histogram/counter state; pass to
+        ``snapshot(baseline=...)`` so the summary covers only the window
+        since (the perf harness scopes to its measured phase — embedded
+        numbers must describe the same population as the measurement
+        fields beside them)."""
+        return {
+            "attempt_duration": self.scheduling_attempt_duration.merged(),
+            "sli_duration": self.pod_scheduling_sli_duration.merged(),
+            "algorithm_duration": self.scheduling_algorithm_duration.merged(),
+            "schedule_attempts": self._attempts_by_result(),
+        }
+
+    def snapshot(self, baseline: dict | None = None) -> dict:
+        """Post-run summary embedded in BENCH artifacts: p50/p99 from the
+        histograms plus schedule_attempts by result — the numbers a
+        dashboard would derive from a scrape, pre-derived so every bench
+        JSON is self-describing. With ``baseline`` (a
+        ``snapshot_baseline``), everything is the DELTA since it."""
+
+        def q(hist, quantile: float) -> float | None:
+            v = hist.quantile(quantile)
+            return None if math.isnan(v) else round(float(v), 6)
+
+        attempt_h = self.scheduling_attempt_duration
+        sli_h = self.pod_scheduling_sli_duration
+        algo_h = self.scheduling_algorithm_duration
+        attempts = self._attempts_by_result()
+        if baseline is not None:
+            attempt_h = attempt_h.since(baseline["attempt_duration"])
+            sli_h = sli_h.since(baseline["sli_duration"])
+            algo_h = algo_h.since(baseline["algorithm_duration"])
+            base_attempts = baseline["schedule_attempts"]
+            attempts = {
+                k: v - base_attempts.get(k, 0)
+                for k, v in attempts.items()
+                if v - base_attempts.get(k, 0)
+            }
+        return {
+            "schedule_attempts": attempts,
+            "attempt_duration_s": {
+                "p50": q(attempt_h, 0.50),
+                "p99": q(attempt_h, 0.99),
+            },
+            "sli_duration_s": {
+                "p50": q(sli_h, 0.50),
+                "p99": q(sli_h, 0.99),
+            },
+            "algorithm_duration_s": {
+                "p50": q(algo_h, 0.50),
+                "p99": q(algo_h, 0.99),
+            },
+        }

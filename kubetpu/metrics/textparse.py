@@ -1,0 +1,171 @@
+"""Minimal Prometheus text-format (0.0.4) parser.
+
+The consumer side of ``Registry.expose()``: enough of
+``prometheus/common/expfmt`` to round-trip a scrape in tests and to build
+the perf harness's post-run metric snapshots from the same text a real
+Prometheus server would ingest — names, HELP/TYPE metadata, label sets
+(with escaped quotes), and float values (incl. ``+Inf``/``NaN``).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+
+@dataclass(frozen=True)
+class Sample:
+    name: str                       # full sample name incl. _bucket/_sum/_count
+    labels: tuple[tuple[str, str], ...]
+    value: float
+
+    def label(self, key: str) -> str | None:
+        for k, v in self.labels:
+            if k == key:
+                return v
+        return None
+
+
+@dataclass
+class MetricFamily:
+    name: str                       # family name (no histogram suffixes)
+    kind: str = "untyped"
+    help: str = ""
+    samples: list[Sample] = field(default_factory=list)
+
+
+class ParseError(ValueError):
+    pass
+
+
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family_name(sample_name: str, families: dict[str, MetricFamily]) -> str:
+    if sample_name in families:
+        return sample_name
+    for suf in _SUFFIXES:
+        if sample_name.endswith(suf) and sample_name[: -len(suf)] in families:
+            return sample_name[: -len(suf)]
+    return sample_name
+
+
+def _parse_value(raw: str) -> float:
+    raw = raw.strip()
+    if raw == "+Inf":
+        return math.inf
+    if raw == "-Inf":
+        return -math.inf
+    if raw == "NaN":
+        return math.nan
+    return float(raw)
+
+
+def _parse_labels(body: str, line: str) -> tuple[tuple[str, str], ...]:
+    out: list[tuple[str, str]] = []
+    i = 0
+    n = len(body)
+    while i < n:
+        while i < n and body[i] in ", \t":
+            i += 1              # separators; a trailing comma is legal 0.0.4
+        if i >= n:
+            break
+        try:
+            eq = body.index("=", i)
+        except ValueError as e:
+            raise ParseError(f"malformed labels in: {line}") from e
+        key = body[i:eq].strip()
+        if eq + 1 >= n or body[eq + 1] != '"':
+            raise ParseError(f"unquoted label value in: {line}")
+        j = eq + 2
+        buf: list[str] = []
+        while j < n:
+            c = body[j]
+            if c == "\\" and j + 1 < n:
+                nxt = body[j + 1]
+                buf.append({"n": "\n", "\\": "\\", '"': '"'}.get(nxt, nxt))
+                j += 2
+                continue
+            if c == '"':
+                break
+            buf.append(c)
+            j += 1
+        else:
+            raise ParseError(f"unterminated label value in: {line}")
+        out.append((key, "".join(buf)))
+        i = j + 1
+    return tuple(out)
+
+
+class ParsedMetrics:
+    """Scrape result: metric families keyed by family name."""
+
+    def __init__(self, families: dict[str, MetricFamily]) -> None:
+        self.families = families
+
+    def __contains__(self, name: str) -> bool:
+        return name in self.families
+
+    def samples(self, name: str) -> list[Sample]:
+        fam = self.families.get(name)
+        return list(fam.samples) if fam else []
+
+    def value(self, sample_name: str, **labels: str) -> float | None:
+        """The value of the first sample matching ``sample_name`` whose
+        label set CONTAINS ``labels`` (a PromQL instant-selector lookup)."""
+        fam = self.families.get(_family_name(sample_name, self.families))
+        if fam is None:
+            return None
+        want = {(k, str(v)) for k, v in labels.items()}
+        for s in fam.samples:
+            if s.name == sample_name and want <= set(s.labels):
+                return s.value
+        return None
+
+
+def parse_prometheus_text(text: str) -> ParsedMetrics:
+    """Parse exposition text into families; malformed lines raise
+    ``ParseError`` (a scrape either round-trips or fails loudly)."""
+    families: dict[str, MetricFamily] = {}
+
+    def family(name: str) -> MetricFamily:
+        fam = families.get(name)
+        if fam is None:
+            fam = families[name] = MetricFamily(name)
+        return fam
+
+    for line in text.splitlines():
+        line = line.strip()
+        if not line:
+            continue
+        if line.startswith("# HELP "):
+            _, _, rest = line.partition("# HELP ")
+            name, _, help_text = rest.partition(" ")
+            family(name).help = help_text
+            continue
+        if line.startswith("# TYPE "):
+            _, _, rest = line.partition("# TYPE ")
+            name, _, kind = rest.partition(" ")
+            family(name).kind = kind.strip()
+            continue
+        if line.startswith("#"):
+            continue
+        # sample: name[{labels}] value
+        if "{" in line:
+            name, _, rest = line.partition("{")
+            body, _, value_part = rest.rpartition("}")
+            labels = _parse_labels(body, line)
+        else:
+            name, _, value_part = line.partition(" ")
+            labels = ()
+        name = name.strip()
+        if not name or not value_part.strip():
+            raise ParseError(f"malformed sample line: {line}")
+        try:
+            value = _parse_value(value_part)
+        except ValueError as e:
+            raise ParseError(f"bad value in: {line}") from e
+        family(_family_name(name, families)).samples.append(
+            Sample(name, labels, value)
+        )
+    return ParsedMetrics(families)

@@ -1,0 +1,151 @@
+"""Device-side counters — the TPU half of the observability plane.
+
+The reference judges itself on host-side scheduler histograms; kubetpu's
+hot path is a fused XLA program, so the equivalent diagnosis surface is
+device-shaped: how big the batches are, whether the XLA compile cache is
+hitting (a miss stalls a cycle by seconds), how many bytes the host→device
+encode ships, and where device wall time goes per cycle. ``SURVEY §5``'s
+span-per-cycle design joins these to the host trace by CYCLE ID: every
+``record_cycle`` keeps a join record the trace exporter and the perf
+harness dump next to the bench JSON.
+
+Metric set (labels ``engine`` = greedy | batched):
+
+- ``tpu_batch_size`` histogram — pods per device cycle
+- ``tpu_jit_cache_hits_total`` / ``tpu_jit_cache_misses_total`` counters —
+  per-cycle compile-cache outcome of the assignment program (a miss means
+  XLA compiled a new (shape, params) variant this cycle)
+- ``tpu_host_to_device_transfer_bytes_total`` counter — encoded batch bytes
+  shipped to the device (signature compression is what keeps this small)
+- ``tpu_device_kernel_wall_seconds`` histogram — wall time of the device
+  assignment program incl. the blocking fetch of its outputs
+"""
+
+from __future__ import annotations
+
+import collections
+from dataclasses import asdict, dataclass
+
+from .registry import Registry, exponential_buckets
+
+
+@dataclass(frozen=True)
+class CycleRecord:
+    """Per-cycle device-side observation, joined to host spans by cycle id
+    (+ ``profile``: a mixed-profile batch runs one device program per
+    profile under ONE cycle id, and the matching scheduling-cycle span
+    carries the same profile attribute). ``compile_miss`` is None when the
+    backend exposes no compile-cache introspection — unmeasured, not a
+    hit."""
+
+    cycle: int
+    engine: str
+    batch_size: int
+    transfer_bytes: int
+    kernel_wall_s: float
+    compile_miss: bool | None
+    profile: str = ""
+
+    def to_json(self) -> dict:
+        return asdict(self)
+
+
+def batch_nbytes(device_batch) -> int:
+    """Total bytes of a device pytree's array leaves — the host→device
+    transfer upper bound for one encoded batch (every leaf is shipped by
+    ``jnp.asarray`` at encode time; cached node rows make this an upper
+    bound, which is the honest direction for a transfer budget)."""
+    import jax
+
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(device_batch):
+        nbytes = getattr(leaf, "nbytes", None)
+        if nbytes is not None:
+            total += int(nbytes)
+    return total
+
+
+def jit_cache_size(fn) -> int | None:
+    """Compiled-variant count of a jitted callable (None when the backend
+    does not expose it) — sampled before/after a call to classify the call
+    as compile-cache hit or miss."""
+    size = getattr(fn, "_cache_size", None)
+    if size is None:
+        return None
+    try:
+        return int(size())
+    except Exception:  # pragma: no cover - backend quirk
+        return None
+
+
+class TPUBackendMetrics:
+    """See module docstring. Registers on a shared Registry so one
+    /metrics exposition carries host and device metrics together."""
+
+    def __init__(self, registry: Registry | None = None,
+                 max_records: int = 4096) -> None:
+        r = registry if registry is not None else Registry()
+        self.registry = r
+        self.batch_size = r.histogram(
+            "tpu_batch_size",
+            "Pods per device scheduling cycle.",
+            labels=("engine",),
+            buckets=exponential_buckets(1, 2, 14),
+        )
+        self.jit_cache_hits = r.counter(
+            "tpu_jit_cache_hits_total",
+            "Device cycles served from the XLA compile cache.",
+            labels=("engine",),
+        )
+        self.jit_cache_misses = r.counter(
+            "tpu_jit_cache_misses_total",
+            "Device cycles that compiled a new XLA program variant.",
+            labels=("engine",),
+        )
+        self.transfer_bytes = r.counter(
+            "tpu_host_to_device_transfer_bytes_total",
+            "Encoded batch bytes shipped host to device.",
+            labels=("engine",),
+        )
+        self.kernel_wall = r.histogram(
+            "tpu_device_kernel_wall_seconds",
+            "Wall time of the device assignment program per cycle, "
+            "including the blocking output fetch.",
+            labels=("engine",),
+            buckets=exponential_buckets(0.0001, 2, 18),
+        )
+        self.records: collections.deque[CycleRecord] = collections.deque(
+            maxlen=max_records
+        )
+
+    def record_cycle(
+        self,
+        cycle: int,
+        engine: str,
+        batch_size: int,
+        transfer_bytes: int,
+        kernel_wall_s: float,
+        compile_miss: bool | None,
+        profile: str = "",
+    ) -> CycleRecord:
+        self.batch_size.labels(engine).observe(batch_size)
+        self.transfer_bytes.labels(engine).inc(transfer_bytes)
+        self.kernel_wall.labels(engine).observe(kernel_wall_s)
+        if compile_miss is not None:
+            if compile_miss:
+                self.jit_cache_misses.labels(engine).inc()
+            else:
+                self.jit_cache_hits.labels(engine).inc()
+        rec = CycleRecord(
+            cycle=cycle, engine=engine, batch_size=batch_size,
+            transfer_bytes=transfer_bytes, kernel_wall_s=kernel_wall_s,
+            compile_miss=(
+                None if compile_miss is None else bool(compile_miss)
+            ),
+            profile=profile,
+        )
+        self.records.append(rec)
+        return rec
+
+    def records_json(self) -> list[dict]:
+        return [r.to_json() for r in self.records]

@@ -1,0 +1,184 @@
+"""client-go workqueue metrics (util/workqueue/metrics.go + the
+prometheus provider in k8s.io/component-base/metrics/prometheus/workqueue).
+
+The reference metric set, per queue ``name``:
+
+- ``workqueue_depth`` — current READY depth (client-go's gauge is
+  ready-only too; keys parked in backoff surface when they drain)
+- ``workqueue_adds_total`` — keys accepted by Add (dirty dedup excluded)
+- ``workqueue_queue_duration_seconds`` — Add → Get latency
+  (ExponentialBuckets(1e-08, 10, 10), nanoseconds → ~100 s)
+- ``workqueue_work_duration_seconds`` — Get → Done latency (same buckets)
+- ``workqueue_retries_total`` — AddRateLimited calls
+- ``workqueue_unfinished_work_seconds`` — summed age of in-flight keys
+- ``workqueue_longest_running_processor_seconds`` — oldest in-flight key
+
+One process-wide provider (``default_provider``) mirrors client-go's
+global ``metrics.SetProvider``: every ``QueueController`` queue lands in
+it unless the owner injects its own, so a single /metrics exposition
+covers the whole controller family.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+import weakref
+from typing import Callable
+
+from .registry import Registry, exponential_buckets
+
+# prometheus.ExponentialBuckets(10e-9, 10, 10): 10 ns … 100 s
+QUEUE_LATENCY_BUCKETS = exponential_buckets(1e-08, 10, 10)
+
+
+class QueueMetrics:
+    """Per-queue recorder the WorkQueue calls into — the reference's
+    ``queueMetrics``. Tracks per-key add/processing timestamps so the
+    latency histograms and the in-flight gauges need no queue internals."""
+
+    def __init__(self, name: str, provider: "WorkqueueMetricsProvider",
+                 clock: Callable[[], float] = time.monotonic) -> None:
+        self.name = name
+        self.clock = clock
+        p = provider
+        self._depth = p.depth.labels(name)
+        self._adds = p.adds.labels(name)
+        self._retries = p.retries.labels(name)
+        self._queue_duration = p.queue_duration.labels(name)
+        self._work_duration = p.work_duration.labels(name)
+        self._unfinished = p.unfinished_work.labels(name)
+        self._longest = p.longest_running.labels(name)
+        self._added_at: dict = {}
+        self._started_at: dict = {}
+        # a scrape thread refreshes the in-flight gauges while the owner
+        # loop mutates the timestamp dicts
+        self._lock = threading.Lock()
+
+    def add(self, key, depth: int) -> None:
+        self._adds.inc()
+        with self._lock:
+            self._added_at.setdefault(key, self.clock())
+        self._depth.set(depth)
+
+    def retry(self, key) -> None:
+        self._retries.inc()
+
+    def get(self, key, depth: int) -> None:
+        self._depth.set(depth)
+        now = self.clock()
+        with self._lock:
+            added = self._added_at.pop(key, None)
+            self._started_at[key] = now
+            self._update_inflight(now)
+        if added is not None:
+            self._queue_duration.observe(max(now - added, 0.0))
+
+    def done(self, key, depth: int) -> None:
+        now = self.clock()
+        with self._lock:
+            started = self._started_at.pop(key, None)
+            self._update_inflight(now)
+        if started is not None:
+            self._work_duration.observe(max(now - started, 0.0))
+        self._depth.set(depth)
+
+    def refresh_inflight(self) -> None:
+        """Recompute the in-flight gauges NOW — called at scrape time so a
+        wedged processor's age keeps growing on the dashboard instead of
+        freezing at its last get() (client-go's updateUnfinishedWorkLoop
+        tick)."""
+        with self._lock:
+            self._update_inflight(self.clock())
+
+    def _update_inflight(self, now: float) -> None:
+        if self._started_at:
+            ages = [max(now - t0, 0.0) for t0 in self._started_at.values()]
+            self._unfinished.set(sum(ages))
+            self._longest.set(max(ages))
+        else:
+            self._unfinished.set(0.0)
+            self._longest.set(0.0)
+
+
+class WorkqueueMetricsProvider:
+    """Owns the workqueue metric vectors on one Registry; ``for_queue``
+    hands out per-name recorders (client-go's MetricsProvider)."""
+
+    def __init__(self, registry: Registry | None = None) -> None:
+        r = registry if registry is not None else Registry()
+        self.registry = r
+        # live recorders, refreshed at scrape time (weak: a recorder dies
+        # with its queue); WeakSet is not thread-safe and scrape threads
+        # iterate while owners register, so guard it
+        self._recorders: "weakref.WeakSet[QueueMetrics]" = weakref.WeakSet()
+        self._recorders_lock = threading.Lock()
+        self.depth = r.gauge(
+            "workqueue_depth", "Current depth of workqueue", labels=("name",)
+        )
+        self.adds = r.counter(
+            "workqueue_adds_total",
+            "Total number of adds handled by workqueue",
+            labels=("name",),
+        )
+        self.queue_duration = r.histogram(
+            "workqueue_queue_duration_seconds",
+            "How long in seconds an item stays in workqueue before being "
+            "requested.",
+            labels=("name",),
+            buckets=QUEUE_LATENCY_BUCKETS,
+        )
+        self.work_duration = r.histogram(
+            "workqueue_work_duration_seconds",
+            "How long in seconds processing an item from workqueue takes.",
+            labels=("name",),
+            buckets=QUEUE_LATENCY_BUCKETS,
+        )
+        self.retries = r.counter(
+            "workqueue_retries_total",
+            "Total number of retries handled by workqueue",
+            labels=("name",),
+        )
+        self.unfinished_work = r.gauge(
+            "workqueue_unfinished_work_seconds",
+            "How many seconds of work has been done that is in progress and "
+            "hasn't been observed by work_duration.",
+            labels=("name",),
+        )
+        self.longest_running = r.gauge(
+            "workqueue_longest_running_processor_seconds",
+            "How many seconds has the longest running processor for "
+            "workqueue been running.",
+            labels=("name",),
+        )
+
+    def for_queue(
+        self, name: str, clock: Callable[[], float] = time.monotonic
+    ) -> QueueMetrics:
+        m = QueueMetrics(name, self, clock=clock)
+        with self._recorders_lock:
+            self._recorders.add(m)
+        return m
+
+    def expose(self) -> str:
+        with self._recorders_lock:
+            recorders = list(self._recorders)
+        for rec in recorders:
+            rec.refresh_inflight()
+        return self.registry.expose()
+
+
+_default: WorkqueueMetricsProvider | None = None
+_default_lock = threading.Lock()
+
+
+def default_provider() -> WorkqueueMetricsProvider:
+    """The process-wide provider every controller queue registers with by
+    default (client-go's global prometheus provider). Locked: two
+    controllers constructed concurrently must not mint two providers, or
+    the loser's queues record into a registry no scrape ever exposes."""
+    global _default
+    with _default_lock:
+        if _default is None:
+            _default = WorkqueueMetricsProvider()
+        return _default

@@ -24,6 +24,11 @@ def main(argv=None) -> None:
                     choices=["greedy", "batched"],
                     help="assignment engine (assign.greedy scan vs "
                          "assign.batched capacity-coupled rounds)")
+    ap.add_argument("--artifacts-dir", default=None,
+                    help="dump per-workload diagnosis artifacts here: the "
+                         "cycle trace as Perfetto-loadable Chrome-trace "
+                         "JSON, a /metrics snapshot, and the device-side "
+                         "per-cycle counter records (joined by cycle id)")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -33,9 +38,12 @@ def main(argv=None) -> None:
                 print(f"{case.name}/{wl.name}{extra} {list(wl.labels)}")
         return
 
+    kwargs = dict(
+        max_batch=args.max_batch, timeout_s=args.timeout,
+        engine=args.engine, artifacts_dir=args.artifacts_dir,
+    )
     if args.label:
-        for r in run_label(args.label, max_batch=args.max_batch,
-                           timeout_s=args.timeout, engine=args.engine):
+        for r in run_label(args.label, **kwargs):
             print(json.dumps(r.to_json()))
         return
 
@@ -45,8 +53,7 @@ def main(argv=None) -> None:
         if args.workload else list(case.workloads)
     )
     for wl in workloads:
-        r = run_workload(case, wl, max_batch=args.max_batch,
-                         timeout_s=args.timeout, engine=args.engine)
+        r = run_workload(case, wl, **kwargs)
         print(json.dumps(r.to_json()))
 
 

@@ -46,6 +46,13 @@ class WorkloadResult:
     cycles: int
     p99_attempt_latency_ms: float | None = None
     threshold_note: str = ""          # derivation of a scaled threshold
+    # post-run metric snapshot (SchedulerMetricsRegistry.snapshot): p50/p99
+    # from the histograms + schedule_attempts by result — every BENCH json
+    # carries its own diagnosis
+    metrics_snapshot: dict | None = None
+    # artifact paths written next to the bench JSON when tracing is on:
+    # chrome trace, /metrics text, device-side cycle records
+    artifacts: dict = field(default_factory=dict)
 
     def to_json(self) -> dict:
         out = {
@@ -67,7 +74,37 @@ class WorkloadResult:
             out["threshold_note"] = self.threshold_note
         if self.p99_attempt_latency_ms is not None:
             out["p99_attempt_latency_ms"] = round(self.p99_attempt_latency_ms, 2)
+        if self.metrics_snapshot is not None:
+            out["metrics"] = self.metrics_snapshot
+        if self.artifacts:
+            out["artifacts"] = self.artifacts
         return out
+
+
+def dump_diagnosis_artifacts(
+    sched: "Scheduler", artifacts_dir: str, prefix: str
+) -> dict[str, str]:
+    """Write the run's diagnosis artifacts next to the bench JSON: the
+    cycle trace as Perfetto-loadable Chrome-trace JSON, a /metrics text
+    snapshot, and the device-side per-cycle counter records (joined to the
+    trace spans by cycle id). Returns {artifact: path}."""
+    import json as _json
+    import os
+
+    os.makedirs(artifacts_dir, exist_ok=True)
+    base = os.path.join(artifacts_dir, prefix)
+    trace_path = sched.tracer.dump_chrome_trace(base + ".trace.json")
+    metrics_path = base + ".metrics.prom"
+    with open(metrics_path, "w") as f:
+        f.write(sched.metrics_text())
+    cycles_path = base + ".tpu_cycles.json"
+    with open(cycles_path, "w") as f:
+        _json.dump(sched.metrics.tpu.records_json(), f)
+    return {
+        "trace": trace_path,
+        "metrics": metrics_path,
+        "tpu_cycles": cycles_path,
+    }
 
 
 class _Client:
@@ -114,14 +151,15 @@ class _Client:
 
 def _begin_measured_phase(sched, warmup: bool, warm_pods):
     """Optionally compile the measured phase's device program, then snapshot
-    the metric counters (and the SLI histogram) the measurement is scoped
-    to."""
+    the metric counters (and the histograms, via a prom baseline) so the
+    measurement AND the embedded metrics snapshot are scoped to the same
+    window — a large init phase must not dominate the reported p99s."""
     if warmup:
         sched.warmup(warm_pods)
     return (
         sched.metrics.schedule_attempts,
         sched.metrics.cycles,
-        sched.metrics.prom.pod_scheduling_sli_duration.merged(),
+        sched.metrics.prom.snapshot_baseline(),
     )
 
 
@@ -177,6 +215,7 @@ def run_workload(
     engine: str = "greedy",
     stall_s: float = 15.0,
     warmup: bool = True,
+    artifacts_dir: str | None = None,
 ) -> WorkloadResult:
     """Execute one (test case, workload) pair and return the measurement.
     ``engine`` selects the assignment engine ("greedy" scan or "batched"
@@ -186,7 +225,9 @@ def run_workload(
     phase's device program (via ``Scheduler.warmup``, no state mutation)
     before its clock starts — a long-lived scheduler compiles once at
     startup, so measured throughput is steady-state, like the reference's
-    precompiled binary."""
+    precompiled binary. ``artifacts_dir`` dumps the run's Chrome-trace
+    JSON, /metrics snapshot, and device-side cycle records there (see
+    ``dump_diagnosis_artifacts``)."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -208,7 +249,7 @@ def run_workload(
     measured = 0
     duration = 0.0
     attempts0 = cycles0 = 0
-    lat0 = None
+    prom_base = None
     op_ns_counter = 0
 
     def settle(target: int, namespaces: tuple[str, ...] = ()) -> tuple[int, float]:
@@ -306,7 +347,7 @@ def run_workload(
             if op.collect_metrics:
                 # warmup shape: plain pods (the PVC mask is a static-sig
                 # column; shapes match the measured batch)
-                attempts0, cycles0, lat0 = _begin_measured_phase(
+                attempts0, cycles0, prom_base = _begin_measured_phase(
                     sched, warmup,
                     [
                         make_pod(f"warmup-pv-{j}", namespace=ns,
@@ -341,7 +382,7 @@ def run_workload(
             count = params[op.count_param]
             ns = op.namespace
             if op.collect_metrics:
-                attempts0, cycles0, lat0 = _begin_measured_phase(
+                attempts0, cycles0, prom_base = _begin_measured_phase(
                     sched, warmup,
                     [
                         make_pod(
@@ -368,7 +409,7 @@ def run_workload(
             count = groups * per
             if op.collect_metrics:
                 # group-lane shapes: one coalesced batch of plain pods
-                attempts0, cycles0, lat0 = _begin_measured_phase(
+                attempts0, cycles0, prom_base = _begin_measured_phase(
                     sched, warmup,
                     [
                         make_pod(
@@ -427,7 +468,7 @@ def run_workload(
                 )
 
             if op.collect_metrics:
-                attempts0, cycles0, lat0 = _begin_measured_phase(
+                attempts0, cycles0, prom_base = _begin_measured_phase(
                     sched, warmup,
                     [
                         claim_pod(f"warmup-dra-{j}")
@@ -456,7 +497,7 @@ def run_workload(
             # share one namespace (MixedSchedulingBasePod does)
             prefix = f"{'measure' if op.collect_metrics else 'init'}-{op_i}"
             if op.collect_metrics:
-                attempts0, cycles0, lat0 = _begin_measured_phase(
+                attempts0, cycles0, prom_base = _begin_measured_phase(
                     sched, warmup,
                     [
                         template(f"warmup-{op_i}-{j}", ns)
@@ -483,10 +524,18 @@ def run_workload(
     # the measured phase (the reference's perf harness reads the scheduler
     # histograms the same way; histogram_quantile estimation)
     lat = None
-    if lat0 is not None:
-        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(lat0)
+    if prom_base is not None:
+        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(
+            prom_base["sli_duration"]
+        )
         if delta.total > 0:
             lat = float(delta.quantile(0.99) * 1000.0)
+    artifacts: dict[str, str] = {}
+    if artifacts_dir is not None:
+        artifacts = dump_diagnosis_artifacts(
+            sched, artifacts_dir,
+            f"{case.name}_{workload.name}_{engine}",
+        )
     throughput = measured / duration if duration > 0 else 0.0
     result = WorkloadResult(
         case_name=case.name,
@@ -518,6 +567,8 @@ def run_workload(
         attempts=sched.metrics.schedule_attempts - attempts0,
         cycles=sched.metrics.cycles - cycles0,
         p99_attempt_latency_ms=lat,
+        metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
+        artifacts=artifacts,
     )
     sched.close()
     return result
@@ -532,6 +583,7 @@ def run_workload_full_stack(
     engine: str = "greedy",
     stall_s: float = 15.0,
     warmup: bool = True,
+    artifacts_dir: str | None = None,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -592,7 +644,7 @@ def run_workload_full_stack(
     measured = 0
     duration = 0.0
     attempts0 = cycles0 = 0
-    lat0 = None
+    prom_base = None
     op_ns_counter = 0
 
     def settle(target: int, namespaces: tuple[str, ...]) -> tuple[int, float]:
@@ -649,7 +701,7 @@ def run_workload_full_stack(
                 )
                 informers.pump()
                 if op.collect_metrics:
-                    attempts0, cycles0, lat0 = _begin_measured_phase(
+                    attempts0, cycles0, prom_base = _begin_measured_phase(
                         sched, warmup,
                         [
                             template(f"warmup-{op_i}-{j}", ns)
@@ -673,10 +725,18 @@ def run_workload_full_stack(
         srv.close()
 
     lat = None
-    if lat0 is not None:
-        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(lat0)
+    if prom_base is not None:
+        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(
+            prom_base["sli_duration"]
+        )
         if delta.total > 0:
             lat = float(delta.quantile(0.99) * 1000.0)
+    artifacts: dict[str, str] = {}
+    if artifacts_dir is not None:
+        artifacts = dump_diagnosis_artifacts(
+            sched, artifacts_dir,
+            f"{case.name}_{workload.name}_{engine}_fullstack",
+        )
     throughput = measured / duration if duration > 0 else 0.0
     return WorkloadResult(
         case_name=case.name,
@@ -697,6 +757,8 @@ def run_workload_full_stack(
         attempts=sched.metrics.schedule_attempts - attempts0,
         cycles=sched.metrics.cycles - cycles0,
         p99_attempt_latency_ms=lat,
+        metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
+        artifacts=artifacts,
     )
 
 

@@ -69,6 +69,9 @@ class QueuedPodInfo:
     # preemption nominated this node; victims are terminating (the
     # reference's pod.Status.NominatedNodeName + nominator view)
     nominated_node_name: str | None = None
+    # scheduling cycle that assumed this pod — stamps the async bind span
+    # so queue→score→assign→bind traces join on one cycle id
+    cycle_id: int = 0
 
     @property
     def key(self) -> str:

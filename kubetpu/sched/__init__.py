@@ -10,6 +10,7 @@ binds stream out through the API dispatcher off the hot loop.
 """
 
 from .api_dispatcher import APICall, APIDispatcher, BindCall, StatusPatchCall
+from .diagnostics import DiagnosticsServer
 from .scheduler import Scheduler, SchedulerMetrics
 
 __all__ = [
@@ -17,6 +18,7 @@ __all__ = [
     "APIDispatcher",
     "BindCall",
     "StatusPatchCall",
+    "DiagnosticsServer",
     "Scheduler",
     "SchedulerMetrics",
 ]

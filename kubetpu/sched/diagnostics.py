@@ -1,0 +1,196 @@
+"""Scheduler diagnostics listener — /metrics, /healthz//readyz//livez,
+and /trace on a side port.
+
+Every reference binary serves component-base's metrics + healthz mux next
+to its real work (kube-scheduler's --secure-port mux installs /metrics,
+/healthz, /livez, /readyz and debug handlers). The kubetpu scheduler is a
+library object driven by an owner loop, so the serving surface is this
+small listener bound to one ``Scheduler``:
+
+- ``GET /metrics``      Prometheus text 0.0.4: the scheduler set
+  (reference-named histograms + plugin/extension-point durations), the
+  device-side TPU counters (same registry), and any extra bound sources —
+  by default the process-wide workqueue provider, so a co-hosted
+  controller family is scraped through the same port.
+- ``GET /healthz|/readyz|/livez[/<check>]``   named, registrable checks
+  (kubetpu.metrics.health): ``ping`` plus the scheduler's own
+  ``dispatcher`` (binding pipeline alive) and, when informers are bound,
+  ``informers-synced`` (readyz only — a resyncing scheduler is alive but
+  not ready, the reference's install split).
+- ``GET /trace``        the tracer's buffered spans as Chrome-trace JSON
+  (Perfetto-loadable; cycle ids join the device counter records).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Iterable
+from urllib.parse import parse_qs, urlsplit
+
+from ..metrics.health import HealthChecks
+
+
+class _DiagHandler(BaseHTTPRequestHandler):
+    server_ref: "DiagnosticsServer"     # bound by the factory
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, *args) -> None:
+        pass
+
+    def _reply(self, body: str, status: int = 200,
+               content_type: str = "text/plain; charset=utf-8") -> None:
+        data = body.encode()
+        self.send_response(status)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self) -> None:  # noqa: N802
+        from ..metrics.diagmux import diagnostics_response
+
+        parts = urlsplit(self.path)
+        diag = self.server_ref
+        try:
+            res = diagnostics_response(
+                parts.path, parse_qs(parts.query, keep_blank_values=True),
+                metrics_sources=(diag.metrics_text,),
+                health=diag.health,
+                extra={
+                    "/trace": lambda: (
+                        "application/json", json.dumps(diag.trace_json())
+                    ),
+                },
+            )
+            if res is None:
+                self._reply("404 page not found\n", status=404)
+                return
+            status, content_type, body = res
+            self._reply(body, status=status, content_type=content_type)
+        except Exception as e:  # noqa: BLE001 — diagnostics must not crash
+            self._reply(f"internal error: {type(e).__name__}: {e}\n",
+                        status=500)
+
+
+class DiagnosticsServer:
+    """See module docstring. ``metrics_sources`` are extra Prometheus-text
+    providers appended after the scheduler set."""
+
+    def __init__(
+        self,
+        scheduler=None,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        metrics_sources: Iterable[Callable[[], str]] = (),
+        include_workqueues: bool = True,
+        health: HealthChecks | None = None,
+    ) -> None:
+        self.scheduler = scheduler
+        self.health = health if health is not None else HealthChecks()
+        self._sources: list[Callable[[], str]] = list(metrics_sources)
+        if include_workqueues:
+            from ..metrics.workqueue import default_provider
+
+            self._sources.append(lambda: default_provider().expose())
+        if scheduler is not None:
+            self._install_scheduler_checks(scheduler)
+        handler = type("BoundDiagHandler", (_DiagHandler,), {
+            "server_ref": self,
+            "disable_nagle_algorithm": True,
+        })
+
+        class _Server(ThreadingHTTPServer):
+            daemon_threads = True
+            block_on_close = False
+
+        self._httpd = _Server((host, port), handler)
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True
+        )
+
+    def _install_scheduler_checks(self, sched) -> None:
+        def dispatcher_alive() -> None:
+            if getattr(sched.dispatcher, "_closed", False):
+                raise RuntimeError("api dispatcher is closed")
+
+        self.health.add_check("dispatcher", dispatcher_alive)
+
+    def add_informers(self, informers) -> None:
+        """Register the informer-synced READINESS check: healthy once every
+        informer's initial list landed (WaitForCacheSync's condition).
+        readyz only — healthz/livez may back liveness probes, and a
+        relisting scheduler is alive, just not ready. Accepts a
+        ``SchedulerInformers`` bundle (its ``synced`` property), a dict of
+        SharedInformers, or an iterable of them."""
+        def informers_synced() -> object:
+            synced = getattr(informers, "synced", None)
+            if isinstance(synced, bool):
+                return None if synced else "informer caches not yet synced"
+            pending = [
+                str(getattr(inf, "kind", inf))
+                for inf in _iter_informers(informers)
+                if not getattr(inf, "synced", False)
+            ]
+            if pending:
+                return "not synced: " + ", ".join(sorted(pending))
+            return None
+
+        self.health.add_check(
+            "informers-synced", informers_synced, endpoints=("readyz",),
+        )
+
+    def add_check(self, name: str, fn, endpoints=None) -> None:
+        if endpoints is None:
+            self.health.add_check(name, fn)
+        else:
+            self.health.add_check(name, fn, endpoints=endpoints)
+
+    # --------------------------------------------------------------- bodies
+    def metrics_text(self) -> str:
+        chunks = []
+        if self.scheduler is not None:
+            chunks.append(self.scheduler.metrics_text())
+        for source in self._sources:
+            chunks.append(source())
+        return "".join(chunks)
+
+    def trace_json(self) -> dict:
+        if self.scheduler is None:
+            return {"traceEvents": [], "displayTimeUnit": "ms"}
+        return self.scheduler.tracer.chrome_trace()
+
+    # -------------------------------------------------------------- control
+    @property
+    def url(self) -> str:
+        host, port = self._httpd.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "DiagnosticsServer":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        # shutdown() blocks on an event only serve_forever() sets — if
+        # start() never ran, skip straight to releasing the socket
+        if self._thread.is_alive():
+            self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+
+def _iter_informers(informers):
+    """Accept an owner holding informers (``_informers`` dict or
+    ``_reflectors`` list), a dict, or a plain iterable of SharedInformers."""
+    inner = getattr(informers, "_informers", None)
+    if inner is not None:
+        informers = inner
+    else:
+        reflectors = getattr(informers, "_reflectors", None)
+        if reflectors is not None:
+            informers = [r.informer for r in reflectors]
+    if isinstance(informers, dict):
+        return list(informers.values())
+    return list(informers)

@@ -69,12 +69,19 @@ class SchedulerMetrics:
         default_factory=lambda: collections.deque(maxlen=10000)
     )
     prom: "object" = None               # SchedulerMetricsRegistry
+    tpu: "object" = None                # TPUBackendMetrics (device counters)
 
     def __post_init__(self) -> None:
         if self.prom is None:
             from ..metrics import SchedulerMetricsRegistry
 
             self.prom = SchedulerMetricsRegistry()
+        if self.tpu is None:
+            from ..metrics import TPUBackendMetrics
+
+            # same Registry: one /metrics exposition carries host histograms
+            # and device counters together, joined per cycle by cycle id
+            self.tpu = TPUBackendMetrics(registry=self.prom.registry)
 
 
 class Scheduler:
@@ -228,7 +235,7 @@ class Scheduler:
             if id(prof) not in built:
                 must_validate(prof, self.registry)
                 built[id(prof)] = self.registry.build(
-                    prof.lifecycle.names(), prof
+                    prof.lifecycle.names(), prof, metrics=self.metrics.prom
                 )
             self._lifecycles[pname] = built[id(prof)]
         # the default profile's runner (single-profile back-compat surface)
@@ -581,7 +588,19 @@ class Scheduler:
         self._drain_bind_completions()
         self._flush_timers()
         limit = max_batch or self.max_batch
+        # cycle-id propagation starts here: the pop span, the cycle's
+        # score/assign spans, and the async bind spans all carry the same
+        # cycle id, which also keys the device-side counter records. An
+        # EMPTY pop records no span — an idle 20 Hz loop would otherwise
+        # evict every real cycle from the bounded buffer within minutes
+        cycle_id = self.metrics.cycles + 1
+        t_pop = time.perf_counter()
         batch_infos = self.queue.pop_batch(limit)
+        if batch_infos:
+            self.tracer.record(
+                "queue-pop", start=t_pop, end=time.perf_counter(),
+                cycle=cycle_id, pods=len(batch_infos),
+            )
         self.metrics.cycles += 1
         if not batch_infos:
             # group lane: ready gangs run when the per-pod lane is drained
@@ -616,31 +635,68 @@ class Scheduler:
     def _profile_cycle(
         self, profile: C.Profile, batch_infos: list[QueuedPodInfo]
     ) -> dict[str, int]:
+        from ..metrics.tpu import batch_nbytes, jit_cache_size
+
         t0 = self.clock()
+        prom = self.metrics.prom
+        cycle_id = self.metrics.cycles
 
         try:
             with self.tracer.span(
                 "scheduling-cycle", profile=profile.name,
-                pods=len(batch_infos), cycle=self.metrics.cycles,
+                pods=len(batch_infos), cycle=cycle_id,
             ):
-                with self.tracer.span("snapshot"):
+                with self.tracer.span("snapshot", cycle=cycle_id):
                     self._snapshot = self.cache.update_snapshot(self._snapshot)
                 pods = [info.pod for info in batch_infos]
-                with self.tracer.span("encode"):
+                t_enc = time.perf_counter()
+                with self.tracer.span("encode", cycle=cycle_id):
                     batch = rt.encode_batch(
                         self._snapshot, pods, profile,
                         nominated=self.nominator.entries(),
                         prev_nt=self._prev_nt,
                     )
+                # the host encode builds per-pod state ahead of filtering —
+                # the PreFilter role in the reference's extension-point map
+                prom.framework_extension_point_duration.labels(
+                    "PreFilter", "Success", profile.name
+                ).observe(time.perf_counter() - t_enc)
                 self._prev_nt = batch.node_tensors
-                with self.tracer.span("extenders"):
+                with self.tracer.span("extenders", cycle=cycle_id):
                     device_batch = self._apply_extenders(batch, pods)
                 params = rt.score_params(profile, batch.resource_names)
-                with self.tracer.span("assign"):
+                with self.tracer.span("assign", cycle=cycle_id) as sp_assign:
+                    cache0 = jit_cache_size(self._assign_device)
+                    t_dev = time.perf_counter()
                     assignments, final_state = self._assign_device(
                         device_batch, params
                     )
                     idx = np.asarray(jax.device_get(assignments))
+                    kernel_wall_s = time.perf_counter() - t_dev
+                    cache1 = jit_cache_size(self._assign_device)
+                # device-side counters, joined to the spans by cycle id
+                compile_miss = (
+                    None if cache0 is None or cache1 is None
+                    else cache1 > cache0
+                )
+                transfer_bytes = batch_nbytes(device_batch)
+                self.metrics.tpu.record_cycle(
+                    cycle=cycle_id, engine=self.engine,
+                    batch_size=len(pods), transfer_bytes=transfer_bytes,
+                    kernel_wall_s=kernel_wall_s, compile_miss=compile_miss,
+                    profile=profile.name,
+                )
+                if sp_assign is not None:
+                    sp_assign.attrs.update(
+                        kernel_wall_s=round(kernel_wall_s, 6),
+                        transfer_bytes=transfer_bytes,
+                        compile_miss=compile_miss,
+                    )
+                # the fused device program IS Filter+Score (one XLA
+                # program — per-plugin splits don't exist on device)
+                prom.framework_extension_point_duration.labels(
+                    "Filter+Score", "Success", profile.name
+                ).observe(kernel_wall_s)
             self._cycle_ctx = (
                 batch, params, final_state,
                 {info.key: k for k, info in enumerate(batch_infos)},
@@ -735,6 +791,7 @@ class Scheduler:
         the pod (it was forgotten and requeued)."""
         assumed = info.pod.with_node(node_name)
         self.cache.assume_pod(assumed)
+        info.cycle_id = self.metrics.cycles
         # a scheduled pod's nomination (if any) is spent
         self.nominator.remove(info.pod.uid)
         self._preempting.pop(info.key, None)
@@ -780,9 +837,18 @@ class Scheduler:
 
     def _dispatch_bind(self, info: QueuedPodInfo, assumed: t.Pod) -> None:
         node_name = assumed.node_name
+        t_dispatch = time.perf_counter()
 
-        def on_done(err: Exception | None, info=info, assumed=assumed) -> None:
-            self._bind_completions.append((info, assumed, err))
+        def on_done(
+            err: Exception | None, info=info, assumed=assumed,
+            t_dispatch=t_dispatch,
+        ) -> None:
+            # completion time stamped HERE on the dispatcher thread — the
+            # loop drains later, and drain time would inflate the bind span
+            # by up to a whole loop interval
+            self._bind_completions.append(
+                (info, assumed, err, t_dispatch, time.perf_counter())
+            )
 
         lifecycle = self._lifecycle_for(info.pod)
         pre = post = None
@@ -858,11 +924,20 @@ class Scheduler:
         this in the per-pod binding goroutine; we serialize into the cycle)."""
         while True:
             try:
-                info, assumed, err = self._bind_completions.popleft()
+                info, assumed, err, t_dispatch, t_done = (
+                    self._bind_completions.popleft()
+                )
             except IndexError:
                 break
             if isinstance(err, CallSkipped):
                 continue  # superseded bind: the newer call's completion rules
+            # the bind ran off-thread: record its dispatch→completion span
+            # here on the loop thread, joined to the cycle by cycle id
+            self.tracer.record(
+                "bind", start=t_dispatch, end=t_done,
+                cycle=getattr(info, "cycle_id", 0), pod=info.key,
+                status="error" if err is not None else "bound",
+            )
             if err is None:
                 self.cache.finish_binding(assumed.uid)
                 self.queue.done(info.key)

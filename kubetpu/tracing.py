@@ -36,6 +36,10 @@ class Span:
     start: float
     end: float = 0.0
     attrs: dict[str, Any] = field(default_factory=dict)
+    # recorded out-of-stack (Tracer.record): overlaps loop spans and other
+    # off-stack spans, so the Chrome-trace exporter lays it out on its own
+    # non-overlapping lane (tid >= 2)
+    off_stack: bool = False
 
     @property
     def duration_s(self) -> float:
@@ -89,18 +93,111 @@ class Tracer:
             if parent is None and sp.duration_s >= self.threshold_s:
                 self._log_long(sp)
 
+    def record(
+        self,
+        name: str,
+        start: float,
+        end: float,
+        parent_id: int | None = None,
+        **attrs,
+    ) -> Span | None:
+        """Record a span whose timing happened OFF the loop thread's span
+        stack (an async bind measured dispatch→completion): the caller
+        supplies start/end on this tracer's clock; the span lands in the
+        buffer like any other but never touches the parent stack."""
+        if not self.enabled:
+            return None
+        sp = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=parent_id,
+            start=start,
+            end=end,
+            attrs=dict(attrs),
+            off_stack=True,
+        )
+        self._spans.append(sp)
+        return sp
+
     # ---- inspection ------------------------------------------------------
+    def _snapshot_spans(self) -> list[Span]:
+        """Copy the buffer tolerating concurrent appends: a diagnostics
+        HTTP thread snapshots while the loop thread records (deque appends
+        are atomic, but iterating during an append raises RuntimeError —
+        retry instead of locking the hot path)."""
+        while True:
+            try:
+                return list(self._spans)
+            except RuntimeError:
+                continue
+
     def recent(self, n: int = 100) -> list[Span]:
-        return list(self._spans)[-n:]
+        return self._snapshot_spans()[-n:]
 
     def drain(self) -> list[Span]:
         """Hand the buffered spans to an exporter and clear the buffer."""
-        out = list(self._spans)
+        out = self._snapshot_spans()
         self._spans.clear()
         return out
 
+    # ---- export ----------------------------------------------------------
+    def chrome_trace(self, spans: list[Span] | None = None) -> dict:
+        """The buffered spans as Chrome-trace-format JSON (Perfetto /
+        chrome://tracing loadable): one complete ("X") event per span,
+        µs timestamps on the tracer's monotonic clock, span/parent ids and
+        attributes (incl. the cycle id the device-side counter records
+        join on) under ``args``. Non-destructive — ``drain`` separately to
+        clear the buffer."""
+        src = self._snapshot_spans() if spans is None else spans
+        events = []
+        # off-stack spans (async binds) overlap the loop's spans AND each
+        # other; complete events on one tid must nest properly or Perfetto
+        # misnests/drops them, so each off-stack span takes the first free
+        # LANE (tid >= 2) whose previous span already ended
+        lane_ends: list[float] = []
+        for sp in sorted(src, key=lambda s: s.start):
+            if sp.off_stack:
+                for lane, end in enumerate(lane_ends):
+                    if end <= sp.start:
+                        lane_ends[lane] = sp.end
+                        break
+                else:
+                    lane = len(lane_ends)
+                    lane_ends.append(sp.end)
+                tid = 2 + lane
+            else:
+                tid = 1
+            events.append({
+                "name": sp.name,
+                "cat": "kubetpu",
+                "ph": "X",
+                "ts": sp.start * 1e6,
+                "dur": sp.duration_s * 1e6,
+                "pid": 1,
+                "tid": tid,
+                "args": {
+                    "span_id": sp.span_id,
+                    "parent_id": sp.parent_id,
+                    **sp.attrs,
+                },
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def dump_chrome_trace(
+        self, path: str, spans: list[Span] | None = None
+    ) -> str:
+        """Write ``chrome_trace`` to ``path``; returns the path."""
+        import json
+
+        with open(path, "w") as f:
+            json.dump(self.chrome_trace(spans), f)
+        return path
+
     def children_of(self, span: Span) -> list[Span]:
-        return [s for s in self._spans if s.parent_id == span.span_id]
+        return [
+            s for s in self._snapshot_spans()
+            if s.parent_id == span.span_id
+        ]
 
     # ---- threshold logging ----------------------------------------------
     def _log_long(self, sp: Span) -> None:

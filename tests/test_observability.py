@@ -1,0 +1,784 @@
+"""The end-to-end observability plane: golden metric names/buckets vs the
+reference set, /metrics scrape round-trips through the minimal Prometheus
+text parser, named healthz/readyz/livez checks (registration + failure
+paths), per-plugin and workqueue instrumentation, device-side TPU counters
+joined to Chrome-trace cycle spans by cycle id, and the perf runner's
+diagnosis artifacts. Plus the satellite fixes: the quota admission race,
+the CronJob missed-run bound, and Reflector stream feature detection."""
+
+import json
+import math
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api import types as t
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.metrics import (
+    HealthChecks,
+    Registry,
+    SchedulerMetricsRegistry,
+    TPUBackendMetrics,
+    WorkqueueMetricsProvider,
+    exponential_buckets,
+    parse_prometheus_text,
+)
+from kubetpu.metrics.workqueue import QUEUE_LATENCY_BUCKETS
+
+from .test_scheduler import FakeClient, make_sched
+
+
+class Clock:
+    def __init__(self, now=0.0):
+        self.now = now
+
+    def __call__(self):
+        return self.now
+
+
+# ---------------------------------------------------------------- golden set
+
+def test_golden_scheduler_metric_names_and_buckets():
+    """The exposed names and bucket layouts must match
+    pkg/scheduler/metrics/metrics.go so reference dashboards map 1:1."""
+    m = SchedulerMetricsRegistry()
+    names = set(m.registry.metrics)
+    assert {
+        "scheduler_scheduling_attempt_duration_seconds",
+        "scheduler_scheduling_algorithm_duration_seconds",
+        "scheduler_pod_scheduling_sli_duration_seconds",
+        "scheduler_pod_scheduling_attempts",
+        "scheduler_framework_extension_point_duration_seconds",
+        "scheduler_plugin_execution_duration_seconds",
+        "scheduler_schedule_attempts_total",
+        "scheduler_preemption_attempts_total",
+        "scheduler_preemption_victims",
+        "scheduler_pending_pods",
+        "scheduler_queue_incoming_pods_total",
+    } <= names
+    assert m.scheduling_attempt_duration.buckets == exponential_buckets(0.001, 2, 15)
+    assert m.pod_scheduling_sli_duration.buckets == exponential_buckets(0.01, 2, 20)
+    assert m.framework_extension_point_duration.buckets == exponential_buckets(0.0001, 2, 12)
+    assert m.plugin_execution_duration.buckets == exponential_buckets(0.00001, 1.5, 20)
+    assert m.plugin_execution_duration.label_names == (
+        "plugin", "extension_point", "status",
+    )
+    assert m.preemption_victims.buckets == exponential_buckets(1, 2, 7)
+
+
+def test_golden_workqueue_and_apiserver_metric_names():
+    from kubetpu.apiserver.metrics import (
+        REQUEST_DURATION_BUCKETS,
+        APIServerMetrics,
+    )
+
+    wq = WorkqueueMetricsProvider()
+    assert {
+        "workqueue_depth", "workqueue_adds_total",
+        "workqueue_queue_duration_seconds", "workqueue_work_duration_seconds",
+        "workqueue_retries_total", "workqueue_unfinished_work_seconds",
+        "workqueue_longest_running_processor_seconds",
+    } <= set(wq.registry.metrics)
+    # client-go: prometheus.ExponentialBuckets(10e-9, 10, 10)
+    assert QUEUE_LATENCY_BUCKETS == pytest.approx(
+        exponential_buckets(1e-08, 10, 10)
+    )
+    api = APIServerMetrics()
+    assert {
+        "apiserver_request_duration_seconds", "apiserver_request_total",
+        "apiserver_current_inflight_requests", "apiserver_longrunning_requests",
+    } <= set(api.registry.metrics)
+    assert api.request_duration.buckets == REQUEST_DURATION_BUCKETS
+    assert api.request_duration.label_names == ("verb", "resource", "code")
+
+
+def test_golden_tpu_metric_names():
+    tpu = TPUBackendMetrics()
+    assert {
+        "tpu_batch_size", "tpu_jit_cache_hits_total",
+        "tpu_jit_cache_misses_total",
+        "tpu_host_to_device_transfer_bytes_total",
+        "tpu_device_kernel_wall_seconds",
+    } <= set(tpu.registry.metrics)
+    # an unmeasurable compile-cache outcome stays None in the records
+    # (unmeasured, not a hit) and increments neither counter
+    rec = tpu.record_cycle(
+        cycle=1, engine="greedy", batch_size=4, transfer_bytes=100,
+        kernel_wall_s=0.01, compile_miss=None,
+    )
+    assert rec.to_json()["compile_miss"] is None
+    assert tpu.jit_cache_hits._children == {}
+    assert tpu.jit_cache_misses._children == {}
+
+
+# ------------------------------------------------------------- text parser
+
+def test_parser_roundtrips_exposition_text():
+    r = Registry()
+    c = r.counter("requests_total", "reqs", labels=("code", "verb"))
+    c.labels("200", "GET").inc(3)
+    c.labels("404", "GET").inc()
+    g = r.gauge("depth", "queue depth")
+    g.set(7)
+    h = r.histogram("lat_seconds", "lat", buckets=[0.1, 1])
+    h.observe(0.05)
+    h.observe(5)
+    pm = parse_prometheus_text(r.expose())
+    assert pm.value("requests_total", code="200", verb="GET") == 3
+    assert pm.value("requests_total", code="404") == 1
+    assert pm.value("depth") == 7
+    assert pm.families["lat_seconds"].kind == "histogram"
+    assert pm.value("lat_seconds_bucket", le="0.1") == 1
+    assert pm.value("lat_seconds_bucket", le="+Inf") == 2
+    assert pm.value("lat_seconds_count") == 2
+    assert pm.value("lat_seconds_sum") == pytest.approx(5.05)
+    assert pm.value("nope") is None
+
+
+def test_parser_handles_escaped_label_values():
+    pm = parse_prometheus_text(
+        '# TYPE weird counter\n'
+        'weird{msg="a \\"quoted\\" value",n="1"} 2\n'
+    )
+    (s,) = pm.samples("weird")
+    assert dict(s.labels)["msg"] == 'a "quoted" value'
+    assert s.value == 2
+    # trailing label comma is legal 0.0.4; bare garbage raises ParseError
+    pm = parse_prometheus_text('m{a="1",} 1\n')
+    assert pm.value("m", a="1") == 1
+    from kubetpu.metrics.textparse import ParseError
+
+    with pytest.raises(ParseError):
+        parse_prometheus_text('m{garbage} 1\n')
+
+
+# ------------------------------------------------------------------ healthz
+
+def test_health_checks_registration_and_failure_paths():
+    hc = HealthChecks()
+    hits = []
+    hc.add_check("store", lambda: hits.append(1))
+    status, body = hc.handle("/healthz", {"verbose": [""]})
+    assert status == 200
+    assert "[+]ping ok" in body and "[+]store ok" in body
+    assert body.strip().endswith("healthz check passed")
+
+    hc.add_check(
+        "informer-sync", lambda: "still listing pods",
+        endpoints=("healthz", "readyz"),
+    )
+    status, body = hc.handle("/healthz")
+    assert status == 503
+    # aggregate output names the failing check but withholds the reason
+    # (component-base healthz); the sub-path carries it
+    assert "[-]informer-sync failed: reason withheld" in body
+    assert "still listing pods" not in body
+    # per-check sub-path: a healthy check still answers 200
+    assert hc.handle("/healthz/store") == (200, "ok\n")
+    status, body = hc.handle("/healthz/informer-sync")
+    assert status == 503
+    assert "still listing pods" in body
+    assert hc.handle("/healthz/nope")[0] == 404
+    assert hc.handle("/healthz/store/extra")[0] == 404
+    # exclude drops the failing check from one probe
+    status, _ = hc.handle("/healthz", {"exclude": ["informer-sync"]})
+    assert status == 200
+    # endpoint grouping: the readiness-only failure leaves livez healthy
+    assert hc.handle("/livez")[0] == 200
+    # a raising check is unhealthy; the exception surfaces on the sub-path
+    hc.add_check("boom", lambda: 1 / 0, endpoints=("readyz",))
+    status, body = hc.handle("/readyz")
+    assert status == 503 and "[-]boom failed" in body
+    assert "ZeroDivisionError" in hc.handle("/readyz/boom")[1]
+    assert hc.handle("/livez")[0] == 200
+    assert hc.handle("/not-a-health-path") is None
+
+
+# ------------------------------------------------- apiserver /metrics+health
+
+def _get(url: str) -> tuple[int, str]:
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_apiserver_serves_metrics_and_health():
+    from kubetpu.api import scheme
+    from kubetpu.apiserver import APIServer
+
+    srv = APIServer().start()
+    try:
+        body = json.dumps(scheme.encode(make_pod("x"))).encode()
+        req = urllib.request.Request(
+            srv.url + "/apis/pods/default/x", method="POST", data=body
+        )
+        assert urllib.request.urlopen(req).status == 201
+        assert _get(srv.url + "/apis/pods")[0] == 200
+        assert _get(srv.url + "/apis/pods/default/missing")[0] == 404
+
+        status, text = _get(srv.url + "/metrics")
+        assert status == 200
+        pm = parse_prometheus_text(text)
+        assert pm.value(
+            "apiserver_request_total", verb="CREATE", resource="pods",
+            code="201",
+        ) == 1
+        assert pm.value(
+            "apiserver_request_total", verb="LIST", resource="pods",
+            code="200",
+        ) == 1
+        assert pm.value(
+            "apiserver_request_total", verb="GET", resource="pods",
+            code="404",
+        ) == 1
+        assert pm.value(
+            "apiserver_request_duration_seconds_count", verb="CREATE",
+            resource="pods", code="201",
+        ) == 1
+        # nothing in flight after the requests completed
+        assert pm.value(
+            "apiserver_current_inflight_requests", request_kind="mutating"
+        ) == 0
+
+        status, text = _get(srv.url + "/healthz?verbose")
+        assert status == 200
+        assert "[+]ping ok" in text and "[+]store ok" in text
+        assert _get(srv.url + "/readyz")[0] == 200
+        assert _get(srv.url + "/livez/ping") == (200, "ok\n")
+        # the store check rides healthz/readyz but NOT livez (the
+        # reference's etcd-check exclusion): a storage outage must not
+        # trip liveness-probe restarts of a still-serving process
+        status, text = _get(srv.url + "/livez?verbose")
+        assert status == 200 and "store" not in text
+
+        # a registered failing check flips healthz to 503 with its name;
+        # the reason only shows on the per-check sub-path
+        srv.health.add_check("shutdown", lambda: "draining")
+        status, text = _get(srv.url + "/healthz")
+        assert status == 503
+        assert "[-]shutdown failed: reason withheld" in text
+        status, text = _get(srv.url + "/healthz/shutdown")
+        assert status == 503 and "draining" in text
+    finally:
+        srv.close()
+
+
+def test_resource_label_resists_hostile_path_segments():
+    """Client-controlled path text must never corrupt the exposition or
+    squat the bounded resource-label slots: malformed names and
+    empty-LIST 200s of unknown kinds fold to "other"; real resources
+    admitted later keep their own label."""
+    from kubetpu.api import scheme
+    from kubetpu.apiserver import APIServer
+
+    srv = APIServer().start()
+    try:
+        # quote/backslash/newline in the resource segment: 200 (empty
+        # list) but the scrape must still parse and never echo the value
+        for bad in ("x%22y", "Evil%5Cpath", "a%0Ab"):
+            assert _get(srv.url + "/apis/" + bad)[0] == 200
+        # 70 well-formed junk kinds: empty LISTs prove nothing, so none
+        # may claim one of the MAX_RESOURCE_LABELS slots
+        for i in range(70):
+            assert _get(srv.url + f"/apis/junkkind{i}")[0] == 200
+        body = json.dumps(scheme.encode(make_pod("x"))).encode()
+        req = urllib.request.Request(
+            srv.url + "/apis/pods/default/x", method="POST", data=body
+        )
+        assert urllib.request.urlopen(req).status == 201
+        assert _get(srv.url + "/apis/pods")[0] == 200
+
+        pm = parse_prometheus_text(_get(srv.url + "/metrics")[1])
+        assert pm.value("apiserver_request_total", verb="CREATE",
+                        resource="pods", code="201") == 1
+        assert pm.value("apiserver_request_total", verb="LIST",
+                        resource="pods", code="200") == 1
+        assert pm.value("apiserver_request_total", verb="LIST",
+                        resource="other", code="200") >= 70
+        assert pm.value("apiserver_request_total",
+                        resource="junkkind0") is None
+        assert pm.value("apiserver_request_total", resource='x"y') is None
+    finally:
+        srv.close()
+
+
+def test_expose_escapes_label_values():
+    r = Registry()
+    c = r.counter("esc_total", "escape check", labels=("who",))
+    c.labels('a"b\\c\nd').inc()
+    text = r.expose()
+    assert '\\"' in text and "\\\\" in text and "\\n" in text
+    pm = parse_prometheus_text(text)
+    assert pm.value("esc_total", who='a"b\\c\nd') == 1
+
+
+def test_queue_controller_accepts_distinct_queue_names():
+    """Two instances of one controller class in a process must be able to
+    keep their set()-style gauges apart via ``queue_name``."""
+    from kubetpu.controllers.workqueue import QueueController
+    from kubetpu.metrics.workqueue import WorkqueueMetricsProvider
+
+    class C(QueueController):
+        def sync(self, key):
+            pass
+
+    provider = WorkqueueMetricsProvider()
+    a = C(store=None, metrics_provider=provider, queue_name="c-a")
+    b = C(store=None, metrics_provider=provider, queue_name="c-b")
+    a.queue.add("k1")
+    a.queue.add("k2")
+    b.queue.add("k3")
+    assert b.queue.get() == "k3"
+    b.queue.done("k3")
+    pm = parse_prometheus_text(provider.expose())
+    assert pm.value("workqueue_depth", name="c-a") == 2
+    assert pm.value("workqueue_depth", name="c-b") == 0
+
+
+def test_default_workqueue_provider_is_singleton_under_races():
+    from kubetpu.metrics import workqueue as wq
+
+    old = wq._default
+    wq._default = None
+    try:
+        seen = []
+        barrier = threading.Barrier(8)
+
+        def grab():
+            barrier.wait()
+            seen.append(wq.default_provider())
+
+        threads = [threading.Thread(target=grab) for _ in range(8)]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+        assert len({id(p) for p in seen}) == 1
+    finally:
+        wq._default = old
+
+
+# --------------------------------------------- scheduler cycle + trace join
+
+def _run_cycles(n_pods: int = 3):
+    client = FakeClient()
+    s, _ = make_sched(client)
+    s.on_node_add(make_node("n0", cpu_milli=4000))
+    for i in range(n_pods):
+        s.on_pod_add(make_pod(f"p{i}", cpu_milli=100, creation_index=i))
+    s.schedule_batch()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    return s, client
+
+
+def test_scheduler_exposes_tpu_and_plugin_metrics():
+    s, _ = _run_cycles()
+    pm = parse_prometheus_text(s.metrics_text())
+    assert pm.value("tpu_batch_size_count", engine="greedy") == 1
+    assert pm.value("tpu_host_to_device_transfer_bytes_total",
+                    engine="greedy") > 0
+    assert pm.value("tpu_device_kernel_wall_seconds_count",
+                    engine="greedy") == 1
+    # the fused device program reports as extension_point="Filter+Score";
+    # the host encode as "PreFilter"
+    assert pm.value(
+        "scheduler_framework_extension_point_duration_seconds_count",
+        extension_point="Filter+Score",
+    ) == 1
+    assert pm.value(
+        "scheduler_framework_extension_point_duration_seconds_count",
+        extension_point="PreFilter",
+    ) == 1
+    rec = s.metrics.tpu.records_json()
+    assert len(rec) == 1 and rec[0]["batch_size"] == 3
+
+
+def test_chrome_trace_export_valid_and_joined_by_cycle_id():
+    s, client = _run_cycles()
+    trace = s.tracer.chrome_trace()
+    # must validate as JSON with numeric, monotonic ts and non-negative dur
+    parsed = json.loads(json.dumps(trace))
+    events = parsed["traceEvents"]
+    assert events
+    ts = [e["ts"] for e in events]
+    assert ts == sorted(ts)
+    for e in events:
+        assert isinstance(e["ts"], (int, float)) and not math.isnan(e["ts"])
+        assert e["dur"] >= 0
+        assert e["ph"] == "X"
+    names = {e["name"] for e in events}
+    assert {"queue-pop", "scheduling-cycle", "encode", "assign",
+            "bind"} <= names
+    # cycle-id propagation queue→cycle→assign→bind, matching the
+    # device-side counter records
+    cycle_ids = {
+        e["args"]["cycle"] for e in events if e["name"] == "scheduling-cycle"
+    }
+    record_ids = {r["cycle"] for r in s.metrics.tpu.records_json()}
+    assert record_ids and record_ids <= cycle_ids
+    for name in ("queue-pop", "assign", "bind"):
+        spans = [e for e in events if e["name"] == name]
+        assert spans and all("cycle" in e["args"] for e in spans)
+    bind_cycles = {e["args"]["cycle"] for e in events if e["name"] == "bind"}
+    assert bind_cycles <= cycle_ids
+    # async binds overlap the loop's spans: they ride their own lanes
+    # (tid >= 2), and within EVERY tid the complete events must nest
+    # properly (no partial overlap) or Perfetto drops them
+    assert all(e["tid"] >= 2 for e in events if e["name"] == "bind")
+    assert all(
+        e["tid"] == 1 for e in events if e["name"] == "scheduling-cycle"
+    )
+    by_tid: dict = {}
+    for e in events:
+        by_tid.setdefault(e["tid"], []).append(e)
+    for tid, evs in by_tid.items():
+        stack = []
+        for e in evs:                       # already sorted by ts
+            end = e["ts"] + e["dur"]
+            while stack and stack[-1] <= e["ts"]:
+                stack.pop()
+            assert all(end <= open_end for open_end in stack), (
+                f"partial overlap on tid {tid}"
+            )
+            stack.append(end)
+    assert len(client.bound) == 3
+
+
+def test_tracer_record_out_of_stack_span():
+    from kubetpu.tracing import Tracer
+
+    tr = Tracer()
+    sp = tr.record("bind", start=1.0, end=1.5, cycle=7)
+    assert sp.duration_s == pytest.approx(0.5)
+    ev = tr.chrome_trace()["traceEvents"]
+    assert ev[0]["args"]["cycle"] == 7
+
+
+def test_diagnostics_listener_serves_metrics_health_trace():
+    from kubetpu.sched import DiagnosticsServer
+
+    s, _ = _run_cycles()
+    diag = DiagnosticsServer(s).start()
+    try:
+        status, text = _get(diag.url + "/metrics")
+        assert status == 200
+        pm = parse_prometheus_text(text)
+        assert "scheduler_schedule_attempts_total" in pm
+        assert "tpu_batch_size" in pm
+        assert "workqueue_depth" in pm      # process-wide provider included
+
+        status, text = _get(diag.url + "/healthz?verbose")
+        assert status == 200
+        assert "[+]ping ok" in text and "[+]dispatcher ok" in text
+
+        status, text = _get(diag.url + "/trace")
+        assert status == 200
+        assert {e["name"] for e in json.loads(text)["traceEvents"]} >= {
+            "scheduling-cycle"
+        }
+
+        # informer-synced is a READINESS check: not ready until synced,
+        # alive throughout
+        class FakeInformer:
+            kind = "pods"
+            synced = False
+
+        inf = FakeInformer()
+        diag.add_informers([inf])
+        assert _get(diag.url + "/readyz")[0] == 503
+        assert _get(diag.url + "/livez")[0] == 200
+        inf.synced = True
+        assert _get(diag.url + "/readyz")[0] == 200
+    finally:
+        diag.close()
+
+
+def test_lifecycle_runner_observes_plugin_execution():
+    from kubetpu.framework import lifecycle as lc
+
+    class Gate(lc.LifecyclePlugin):
+        def reserve(self, handle, pod, node_name):
+            return lc.Status()
+
+        def permit(self, handle, pod, node_name):
+            return lc.Status(lc.UNSCHEDULABLE, "no", "Gate"), 0.0
+
+    plugin = Gate()
+    plugin.name = "Gate"
+    m = SchedulerMetricsRegistry()
+    runner = lc.LifecycleRunner([plugin], metrics=m, profile="prof")
+    pod = make_pod("p")
+    assert runner.run_reserve(None, pod, "n0").ok
+    st, _, _ = runner.run_permit(None, pod, "n0", now=0.0)
+    assert not st.ok
+    pm = parse_prometheus_text(m.expose())
+    assert pm.value(
+        "scheduler_plugin_execution_duration_seconds_count",
+        plugin="Gate", extension_point="Reserve", status="Success",
+    ) == 1
+    assert pm.value(
+        "scheduler_plugin_execution_duration_seconds_count",
+        plugin="Gate", extension_point="Permit", status="Unschedulable",
+    ) == 1
+    assert pm.value(
+        "scheduler_framework_extension_point_duration_seconds_count",
+        extension_point="Permit", status="Unschedulable", profile="prof",
+    ) == 1
+
+
+# ------------------------------------------------------- workqueue metrics
+
+def test_workqueue_records_reference_metric_set():
+    from kubetpu.controllers.workqueue import WorkQueue
+
+    clock = Clock()
+    provider = WorkqueueMetricsProvider()
+    q = WorkQueue(
+        clock=clock, name="testq",
+        metrics=provider.for_queue("testq", clock=clock),
+    )
+    q.add("a")
+    q.add("b")
+    q.add("a")                       # dirty dedup: NOT a second add
+    clock.now = 2.0
+    assert q.get() == "a"
+    clock.now = 5.0
+    q.done("a")
+    q.add_rate_limited("a")          # retry
+    pm = parse_prometheus_text(provider.expose())
+    assert pm.value("workqueue_adds_total", name="testq") == 2
+    assert pm.value("workqueue_retries_total", name="testq") == 1
+    assert pm.value("workqueue_depth", name="testq") == 1       # b waiting
+    # a waited 2 s in queue, worked 3 s
+    assert pm.value(
+        "workqueue_queue_duration_seconds_sum", name="testq"
+    ) == pytest.approx(2.0)
+    assert pm.value(
+        "workqueue_work_duration_seconds_sum", name="testq"
+    ) == pytest.approx(3.0)
+    # in-flight gauges refresh at SCRAPE time: a wedged processor's age
+    # keeps growing even with no other queue traffic
+    assert q.get() == "b"
+    clock.now = 9.0
+    pm = parse_prometheus_text(provider.expose())
+    assert pm.value(
+        "workqueue_longest_running_processor_seconds", name="testq"
+    ) == pytest.approx(4.0)
+    assert pm.value(
+        "workqueue_unfinished_work_seconds", name="testq"
+    ) == pytest.approx(4.0)
+    q.done("b")
+    pm = parse_prometheus_text(provider.expose())
+    assert pm.value(
+        "workqueue_longest_running_processor_seconds", name="testq"
+    ) == 0.0
+
+
+def test_queue_controller_wires_default_provider():
+    from kubetpu.controllers import ResourceQuotaController
+    from kubetpu.controllers.workqueue import QueueController
+    from kubetpu.metrics.workqueue import default_provider
+    from kubetpu.store import MemStore
+
+    ctrl = ResourceQuotaController(MemStore())
+    assert ctrl.queue.metrics is not None
+    assert ctrl.queue.name == "ResourceQuotaController"
+    assert "workqueue_depth" in default_provider().registry.metrics
+
+    class Unmetered(QueueController):
+        def sync(self, key):
+            pass
+
+    # opting out is possible for hot loops
+    assert Unmetered(
+        MemStore(), metrics_provider=False
+    ).queue.metrics is None
+
+
+# ------------------------------------------------------ perf artifacts/bench
+
+def test_perf_runner_dumps_diagnosis_artifacts(tmp_path):
+    from kubetpu.perf import run_workload
+    from kubetpu.perf.workloads import Workload
+
+    r = run_workload(
+        "SchedulingBasic",
+        Workload("tiny", {"initNodes": 10, "initPods": 5, "measurePods": 20}),
+        timeout_s=120, artifacts_dir=str(tmp_path),
+    )
+    assert r.scheduled == 20
+    # the embedded snapshot is the bench JSON's self-diagnosis
+    snap = r.metrics_snapshot
+    assert snap is not None
+    assert snap["schedule_attempts"].get("scheduled", 0) >= 20
+    assert snap["attempt_duration_s"]["p99"] is not None
+    out = r.to_json()
+    assert out["metrics"] is snap and out["artifacts"] == r.artifacts
+    # trace: Perfetto-loadable, cycle spans join the device records
+    trace = json.loads((tmp_path / r.artifacts["trace"].split("/")[-1]).read_text())
+    cycle_ids = {
+        e["args"]["cycle"]
+        for e in trace["traceEvents"] if e["name"] == "scheduling-cycle"
+    }
+    records = json.loads(
+        (tmp_path / r.artifacts["tpu_cycles"].split("/")[-1]).read_text()
+    )
+    assert records and {rec["cycle"] for rec in records} <= cycle_ids
+    # metrics snapshot parses as exposition text with the scheduler set
+    pm = parse_prometheus_text(
+        (tmp_path / r.artifacts["metrics"].split("/")[-1]).read_text()
+    )
+    assert "scheduler_schedule_attempts_total" in pm
+    assert "tpu_batch_size" in pm
+
+
+# ------------------------------------------------------------- satellites
+
+def test_quota_admission_is_race_free_under_concurrent_posts():
+    """Concurrent POSTs must not exceed hard: the per-namespace write lock
+    serializes check+create (the quota race fix)."""
+    from kubetpu.api import scheme
+    from kubetpu.apiserver import APIServer, Registry
+    from kubetpu.client.informers import PODS
+    from kubetpu.controllers import install_quota_admission
+    from kubetpu.controllers.resourcequota import RESOURCE_QUOTAS
+    from kubetpu.store import MemStore
+
+    st = MemStore()
+    registry = Registry()
+    install_quota_admission(registry, st)
+    st.create(RESOURCE_QUOTAS, "default/caps", t.ResourceQuota(
+        name="caps", hard=(("pods", 5),),
+    ))
+    srv = APIServer(st, registry=registry).start()
+    results = []
+
+    def post(i: int) -> None:
+        body = json.dumps(scheme.encode(make_pod(f"p{i}"))).encode()
+        req = urllib.request.Request(
+            srv.url + f"/apis/pods/default/p{i}", method="POST", data=body
+        )
+        try:
+            results.append(urllib.request.urlopen(req, timeout=10).status)
+        except urllib.error.HTTPError as e:
+            results.append(e.code)
+
+    try:
+        threads = [
+            threading.Thread(target=post, args=(i,)) for i in range(16)
+        ]
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join()
+    finally:
+        srv.close()
+    stored = len(st.list(PODS)[0])
+    assert stored == 5, f"quota overflow: {stored} pods past hard=5"
+    assert results.count(201) == 5 and results.count(403) == 11
+
+
+def test_cronjob_bounds_missed_run_collapse():
+    """A months-stale anchor must not stall sync: past ~100 missed runs the
+    controller jumps to the MOST RECENT missed run (tooManyMissed) instead
+    of walking every occurrence — the latest run still fires, the backlog
+    is skipped, and the anchor lands near now."""
+    from kubetpu.controllers.cronjob import CRON_JOBS, CronJobController
+    from kubetpu.controllers.job import JOBS
+    from kubetpu.store import MemStore
+
+    st = MemStore()
+    now = [1609459200.0 + 120 * 86400]     # anchor is 120 days stale
+    cj = t.CronJob(
+        name="stale", schedule="* * * * *",
+        template=make_pod("tpl", labels={"a": "s"}),
+        last_schedule_time=1609459200.0,
+    )
+    st.create(CRON_JOBS, cj.key, cj)
+    ctrl = CronJobController(st, clock=lambda: now[0])
+    ctrl.start()
+    ctrl.step()
+    # exactly ONE job — the most recent occurrence, not the ~172k backlog
+    jobs = st.list(JOBS)[0]
+    assert len(jobs) == 1
+    assert st.get(CRON_JOBS, cj.key)[0].last_schedule_time == now[0]
+    # from the fresh anchor, the next due run stamps normally
+    now[0] += 60
+    ctrl.step()
+    assert len(st.list(JOBS)[0]) == 2
+
+
+def test_reflector_stream_feature_detection():
+    from kubetpu.client.reflector import Reflector, SharedInformer
+    from kubetpu.store import MemStore
+
+    class PullOnlyWatcher:
+        def poll(self):
+            return []
+
+    class PullOnlyStore:
+        """watch() without a stream parameter: detected, silently degraded."""
+
+        def list(self, kind, **kw):
+            return [], 0
+
+        def watch(self, kind, since_rv):
+            return PullOnlyWatcher()
+
+    r = Reflector(PullOnlyStore(), SharedInformer("pods"), stream=True)
+    r.sync()                                  # no TypeError probing needed
+    assert isinstance(r._watcher, PullOnlyWatcher)
+
+    class BuggyStreamStore:
+        """Stream-capable signature whose watch() raises a REAL TypeError:
+        it must surface, not silently degrade to long-poll."""
+
+        def list(self, kind, **kw):
+            return [], 0
+
+        def watch(self, kind, since_rv, stream=False):
+            if stream:
+                raise TypeError("real bug inside streaming watch")
+            return PullOnlyWatcher()
+
+    r2 = Reflector(BuggyStreamStore(), SharedInformer("pods"), stream=True)
+    with pytest.raises(TypeError, match="real bug"):
+        r2.sync()
+
+    class OptOutStore(BuggyStreamStore):
+        """An advertised capability attribute overrides the signature."""
+
+        supports_stream = False
+
+    r3 = Reflector(OptOutStore(), SharedInformer("pods"), stream=True)
+    r3.sync()
+    assert isinstance(r3._watcher, PullOnlyWatcher)
+
+    # MemStore (no stream parameter) still syncs under stream=True
+    r4 = Reflector(MemStore(), SharedInformer("pods"), stream=True)
+    r4.sync()
+    assert r4._watcher is not None
+
+    class DelegatingStore:
+        """A transparent **kwargs wrapper over a pull-only store: the
+        bare **kwargs proves nothing, so it must degrade, not crash."""
+
+        def __init__(self):
+            self.inner = PullOnlyStore()
+
+        def list(self, *args, **kwargs):
+            return self.inner.list(*args, **kwargs)
+
+        def watch(self, *args, **kwargs):
+            return self.inner.watch(*args, **kwargs)
+
+    r5 = Reflector(DelegatingStore(), SharedInformer("pods"), stream=True)
+    r5.sync()
+    assert isinstance(r5._watcher, PullOnlyWatcher)
