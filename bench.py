@@ -39,20 +39,26 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
 
 import kubetpu  # noqa: F401  (enables x64)
 
-# (case, workload, engine, mode, max_batch); ordered: quadratic/batched
-# evidence first. "fullstack" drives the SAME op list through an in-process
-# REST apiserver + RemoteStore + informers + HTTP binds — the reference
-# harness's own shape (util.go:96) — so the direct-vs-fullstack delta (the
-# apiserver tax) is measured, not assumed.
+# (case, workload, engine, mode, max_batch, pipeline); ordered: quadratic/
+# batched evidence first. "fullstack" drives the SAME op list through an
+# in-process REST apiserver + RemoteStore + informers + HTTP binds — the
+# reference harness's own shape (util.go:96) — so the direct-vs-fullstack
+# delta (the apiserver tax) is measured, not assumed. pipeline=True runs the
+# two-stage pipelined cycle (device-resident node block + delta uploads);
+# each serial/pipelined pair on the same workload feeds one
+# PipelineComparison line (cycles/sec up, transfer-bytes/cycle down).
 STAGES = [
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024),
-    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024),
-    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, False),
+    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024, False),
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024, False),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, True),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, False),
 ]
 TOTAL_BUDGET_S = 1500.0     # skip remaining stages past this
 STAGE_TIMEOUT_S = 300.0     # per-phase settle timeout inside the runner
@@ -81,6 +87,7 @@ def run_stage(
     case: str, workload: str, engine: str,
     mode: str = "direct", max_batch: int = 1024,
     profile_dir: str | None = None,
+    pipeline: bool = False,
 ) -> dict:
     import contextlib
 
@@ -105,9 +112,12 @@ def run_stage(
         r = runner(
             case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
             max_batch=max_batch, artifacts_dir=artifacts_dir,
+            pipeline=pipeline,
         )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
+    if pipeline:
+        suffix += "_pipelined"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -125,6 +135,18 @@ def run_stage(
         "backend": _backend(),
         "wall_s": round(wall, 1),
     }
+    if pipeline:
+        out["pipeline"] = True
+    if r.cycles_per_sec is not None:
+        out["cycles_per_sec"] = round(r.cycles_per_sec, 2)
+    if r.transfer_bytes_per_cycle is not None:
+        out["transfer_bytes_per_cycle"] = round(r.transfer_bytes_per_cycle)
+    if r.batch_bytes_per_cycle is not None:
+        out["batch_bytes_per_cycle"] = round(r.batch_bytes_per_cycle)
+    if r.resident_bytes:
+        out["resident_bytes"] = r.resident_bytes
+    if r.pipeline_replays:
+        out["pipeline_replays"] = r.pipeline_replays
     if r.threshold_note:
         out["threshold_note"] = r.threshold_note
     if r.p99_attempt_latency_ms is not None:
@@ -164,12 +186,57 @@ CPU_FALLBACK_STAGES = [
     # workload carries a SCALED threshold (documented in its
     # threshold_note) so vs_baseline is never null, and max_batch=128
     # forces >= 5 measured cycles (a steady-state claim, not one batch).
-    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128),
-    ("TopologySpreading", "500Nodes", "batched", "direct", 128),
-    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128),
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128),
-    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128, False),
+    ("TopologySpreading", "500Nodes", "batched", "direct", 128, False),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, False),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False),
+    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True),
+    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False),
 ]
+
+
+def _emit_pipeline_comparisons(done: dict) -> None:
+    """One PipelineComparison line per (case, workload, engine, mode) that
+    ran BOTH serial and pipelined: the tentpole's acceptance evidence —
+    cycles/sec up, transfer-bytes/cycle down, throughput side by side —
+    embedded in the bench artifact itself."""
+    for key, pair in sorted(done.items()):
+        ser, pipe = pair.get(False), pair.get(True)
+        if not ser or not pipe or "error" in ser or "error" in pipe:
+            continue
+        case, workload, engine, mode = key
+        line = {
+            "metric": f"PipelineComparison_{case}_{workload}_{engine}",
+            "unit": "ratio",
+            "mode": mode,
+            "backend": ser.get("backend"),
+            "serial": {
+                k: ser.get(k) for k in (
+                    "value", "cycles_per_sec", "transfer_bytes_per_cycle",
+                    "batch_bytes_per_cycle", "duration_s",
+                ) if ser.get(k) is not None
+            },
+            "pipelined": {
+                k: pipe.get(k) for k in (
+                    "value", "cycles_per_sec", "transfer_bytes_per_cycle",
+                    "batch_bytes_per_cycle", "resident_bytes",
+                    "pipeline_replays", "duration_s",
+                ) if pipe.get(k) is not None
+            },
+        }
+        s_cps, p_cps = ser.get("cycles_per_sec"), pipe.get("cycles_per_sec")
+        if s_cps and p_cps:
+            line["cycles_per_sec_speedup"] = round(p_cps / s_cps, 3)
+            line["value"] = round(p_cps / s_cps, 3)
+        s_tb = ser.get("transfer_bytes_per_cycle")
+        p_tb = pipe.get("transfer_bytes_per_cycle")
+        if s_tb and p_tb:
+            line["transfer_bytes_ratio"] = round(p_tb / s_tb, 4)
+        if ser.get("value") and pipe.get("value"):
+            line["throughput_speedup"] = round(pipe["value"] / ser["value"], 3)
+        _emit(line)
 
 
 def main() -> None:
@@ -187,13 +254,18 @@ def main() -> None:
     t_start = time.perf_counter()
     best_quadratic: dict | None = None
     best_any: dict | None = None
-    for case, workload, engine, mode, max_batch in STAGES:
+    # (case, workload, engine, mode) -> {pipeline: result line}
+    pairs: dict = {}
+    for case, workload, engine, mode, max_batch, pipeline in STAGES:
         elapsed = time.perf_counter() - t_start
         if elapsed > TOTAL_BUDGET_S:
             _status(f"budget exhausted ({elapsed:.0f}s); skipping {case}/{engine}")
             continue
-        _status(f"stage start: {case}/{workload}/{engine}/{mode} (t={elapsed:.0f}s)")
+        _status(f"stage start: {case}/{workload}/{engine}/{mode}"
+                f"{'/pipelined' if pipeline else ''} (t={elapsed:.0f}s)")
         suffix = "" if mode == "direct" else "_fullstack"
+        if pipeline:
+            suffix += "_pipelined"
         # profile exactly ONE stage: the first quadratic TPU stage (the
         # north-star workload) — the artifact lands in ./xla_profile/
         profile_dir = None
@@ -204,7 +276,7 @@ def main() -> None:
             profile_dir = "xla_profile"
         try:
             line = run_stage(case, workload, engine, mode, max_batch,
-                             profile_dir=profile_dir)
+                             profile_dir=profile_dir, pipeline=pipeline)
             if profile_dir is not None:
                 line["xla_profile"] = profile_dir
         except Exception as e:
@@ -216,6 +288,7 @@ def main() -> None:
             })
             _status(f"stage FAILED: {case}/{workload}/{engine}/{mode}: {e}")
             continue
+        pairs.setdefault((case, workload, engine, mode), {})[pipeline] = line
         _emit(line)
         _status(f"stage done: {line['metric']} = {line['value']} pods/s "
                 f"({line['vs_baseline']}x baseline)")
@@ -227,6 +300,7 @@ def main() -> None:
             or vb > (best_quadratic.get("vs_baseline") or 0.0)
         ):
             best_quadratic = line
+    _emit_pipeline_comparisons(pairs)
     final = best_quadratic or best_any
     if final is None:
         _emit({

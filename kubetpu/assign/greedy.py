@@ -36,12 +36,9 @@ def _pod_view(b: rt.DeviceBatch, i) -> rt.DeviceBatch:
         return None if a is None else a[i][None]
 
     return rt.DeviceBatch(
-        alloc=b.alloc,
-        requested=b.requested,
-        nonzero_requested=b.nonzero_requested,
-        pod_count=b.pod_count,
-        allowed_pods=b.allowed_pods,
-        node_valid=b.node_valid,
+        # the persistent node block passes through whole (the scan threads
+        # its own running node state via the feasible_and_scores overrides)
+        nodes=b.nodes,
         requests=b.requests[i][None],
         nonzero_requests=b.nonzero_requests[i][None],
         pod_valid=b.pod_valid[i][None],
@@ -111,7 +108,17 @@ def greedy_assign_device(b: rt.DeviceBatch, params: rt.ScoreParams):
     """Run the greedy scan. Returns ``(assignments (P,) int32 node index or
     -1, final_state)`` where final_state is the post-batch
     ``(requested, nonzero_requested, pod_count)`` — the cache applies it as
-    the batch's assume step."""
+    the batch's assume step.
+
+    Buffer-donation note: the scan CARRY is double-buffered by XLA itself
+    (loop state aliases in place inside the compiled program), so the hot
+    per-step node-state updates never copy. The INPUT node block must NOT
+    be donated here: in pipeline mode those buffers are the device-resident
+    cluster state (runtime.ResidentNodeState) reused by the next cycle's
+    delta scatter, and the post-cycle preemption PostFilter re-reads them
+    through the cycle context. Donation of the node-state buffers happens
+    at the one seam where they are provably unreferenced — the resident
+    scatter (runtime._scatter_node_rows)."""
 
     n = b.alloc.shape[0]
     node_iota = jnp.arange(n, dtype=jnp.int32)

@@ -53,7 +53,12 @@ def placement_assign_device(
         from .greedy import greedy_assign_device as assign
 
     def one(mask):
-        bb = dataclasses.replace(b, node_valid=b.node_valid & mask)
+        bb = dataclasses.replace(
+            b,
+            nodes=dataclasses.replace(
+                b.nodes, node_valid=b.node_valid & mask
+            ),
+        )
         assignments, _ = assign(bb, params)
         return assignments
 

@@ -246,11 +246,18 @@ def cmd_scheduler(args) -> int:
     store = RemoteStore(args.server)
     sched = Scheduler(
         StoreClient(store), cfg=cfg, engine=args.engine,
+        pipeline=(args.pipeline == "on"),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
     informers = SchedulerInformers(store, sched)
     _retry_start(informers.start, "scheduler informers")
+    if args.prewarm:
+        # pay the XLA bucket ladder up front so the first real cycles never
+        # stall on compilation (the informers have already synced the node
+        # set, so the warmed shapes match the live cluster)
+        informers.pump()
+        sched.prewarm()
     is_leader = _maybe_elect(args, store, "kube-scheduler")
     diag = None
     if getattr(args, "diagnostics_port", 0):
@@ -547,6 +554,17 @@ def build_parser() -> argparse.ArgumentParser:
     schd.add_argument("--config", default="", help="KubeSchedulerConfiguration file")
     schd.add_argument("--engine", default="greedy",
                       choices=["greedy", "batched"])
+    schd.add_argument("--pipeline", default="off", choices=["on", "off"],
+                      help="two-stage pipelined cycles with a device-"
+                           "resident node block and dirty-row delta "
+                           "uploads; assignments stay pod-for-pod "
+                           "identical to the serial loop ('off' is the "
+                           "debugging escape hatch)")
+    schd.add_argument("--prewarm", action="store_true",
+                      help="compile the assign program for the full "
+                           "batch-size bucket ladder at startup, so "
+                           "steady state never pays XLA compilation "
+                           "mid-cycle")
     schd.add_argument("--leader-elect", action="store_true")
     schd.add_argument("--diagnostics-port", type=int, default=10251,
                       help="side port for /metrics /healthz /readyz /livez "

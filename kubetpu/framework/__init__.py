@@ -15,9 +15,13 @@ from .lifecycle import (  # noqa: F401
 )
 from .runtime import (  # noqa: F401
     DeviceBatch,
+    DeviceNodeState,
     EncodedBatch,
+    ResidentNodeState,
     ScoreParams,
     encode_batch,
+    encode_batch_static,
     filter_score_batch,
+    finalize_batch,
     score_params,
 )

@@ -37,18 +37,34 @@ from . import config as C
 
 @jax.tree_util.register_dataclass
 @dataclass(frozen=True)
-class DeviceBatch:
-    """Padded device-resident scheduling problem: P pods × N nodes × R
-    resources. Padding rows/cols are masked out (``node_valid``/``pod_valid``
-    False, ``static_mask`` False on pads) so kernels need no special cases."""
+class DeviceNodeState:
+    """The persistent node-state block of a scheduling problem: everything
+    on the node axis that survives from cycle to cycle. In pipeline mode
+    these arrays LIVE on device across cycles (``ResidentNodeState``) and
+    only dirty rows are re-uploaded; a ``DeviceBatch`` composes this block
+    with the per-batch pod block."""
 
-    # nodes
     alloc: jnp.ndarray              # (N, R) int64
     requested: jnp.ndarray          # (N, R) int64 exact
     nonzero_requested: jnp.ndarray  # (N, R) int64 scoring view
     pod_count: jnp.ndarray          # (N,) int32
     allowed_pods: jnp.ndarray       # (N,) int32
     node_valid: jnp.ndarray         # (N,) bool
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class DeviceBatch:
+    """Padded device-resident scheduling problem: P pods × N nodes × R
+    resources. Padding rows/cols are masked out (``node_valid``/``pod_valid``
+    False, ``static_mask`` False on pads) so kernels need no special cases.
+
+    Split into the persistent ``nodes`` block (device-resident across cycles
+    in pipeline mode) and the per-batch pod block; the node-field properties
+    keep every kernel reading ``b.alloc`` etc. unchanged."""
+
+    # persistent node-state block
+    nodes: DeviceNodeState
     # pods
     requests: jnp.ndarray           # (P, R) int64 exact
     nonzero_requests: jnp.ndarray   # (P, R) int64
@@ -94,6 +110,32 @@ class DeviceBatch:
     # computeScore), signature-compressed like the other static raws
     dra_score_raw: jnp.ndarray | None = None   # (S5, N) int64
     dra_score_sig: jnp.ndarray | None = None   # (P,) int32
+
+    # node-block accessors (kernels read b.alloc etc. — the split into a
+    # persistent node block is invisible to them)
+    @property
+    def alloc(self) -> jnp.ndarray:
+        return self.nodes.alloc
+
+    @property
+    def requested(self) -> jnp.ndarray:
+        return self.nodes.requested
+
+    @property
+    def nonzero_requested(self) -> jnp.ndarray:
+        return self.nodes.nonzero_requested
+
+    @property
+    def pod_count(self) -> jnp.ndarray:
+        return self.nodes.pod_count
+
+    @property
+    def allowed_pods(self) -> jnp.ndarray:
+        return self.nodes.allowed_pods
+
+    @property
+    def node_valid(self) -> jnp.ndarray:
+        return self.nodes.node_valid
 
 
 @jax.tree_util.register_dataclass
@@ -151,6 +193,137 @@ class EncodedBatch:
     # host-side references preemption/extender paths reuse (not device data)
     node_tensors: "enc.NodeTensors | None" = None
     port_vocab: object | None = None
+    # actual host→device bytes this encode shipped (pod block + node delta;
+    # equals the full pytree bytes when no resident node state was used)
+    upload_bytes: int = 0
+    # bytes of the device-resident node block backing this batch (0 when the
+    # node block was a one-shot upload, i.e. no residency)
+    resident_bytes: int = 0
+
+
+class StaleStaticEncode(Exception):
+    """A pre-encoded StaticBatch can no longer be finalized against the
+    current cluster state (e.g. an assumed pod introduced a host-port triple
+    outside the batch's interned vocabulary, or the nomination set changed).
+    Callers fall back to a full re-encode."""
+
+
+def _node_block_nbytes(nodes: DeviceNodeState) -> int:
+    return sum(
+        int(leaf.nbytes) for leaf in jax.tree_util.tree_leaves(nodes)
+    )
+
+
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+def _scatter_node_rows(
+    alloc, requested, nonzero, pod_count, allowed,
+    idx, u_alloc, u_req, u_nz, u_pc, u_al,
+):
+    """Write the dirty node rows into the device-resident block. The five
+    state buffers are DONATED: each output aliases its input (same
+    shape/dtype), so the update is in-place on device and the old buffers
+    are invalidated — the ResidentNodeState owner is the only holder by
+    contract. ``idx`` is padded to a compile bucket with out-of-range
+    indices; mode="drop" discards those writes."""
+    return (
+        alloc.at[idx].set(u_alloc, mode="drop"),
+        requested.at[idx].set(u_req, mode="drop"),
+        nonzero.at[idx].set(u_nz, mode="drop"),
+        pod_count.at[idx].set(u_pc, mode="drop"),
+        allowed.at[idx].set(u_al, mode="drop"),
+    )
+
+
+class ResidentNodeState:
+    """Owner of the persistent device-resident node block (pipeline mode).
+
+    ``refresh(nt, num_nodes)`` brings the device block up to date with the
+    host ``NodeTensors``: a full upload when the block doesn't exist yet or
+    the encode was rebuilt (axis/order/capacity change), otherwise a dirty-
+    row scatter consuming ``nt.pending_device_rows`` — steady-state
+    host→device traffic is O(Δ rows · R), not O(N · R). The scatter donates
+    the old buffers (see ``_scatter_node_rows``), so after a refresh any
+    previously returned DeviceNodeState is dead; callers must not hold
+    device batches across a refresh (the scheduler refreshes only between
+    completed cycles)."""
+
+    def __init__(self) -> None:
+        self.device: DeviceNodeState | None = None
+        self._nt_token: object | None = None
+        self._num_nodes = -1
+        self.last_upload_bytes = 0
+
+    @property
+    def nbytes(self) -> int:
+        return _node_block_nbytes(self.device) if self.device is not None else 0
+
+    def _full_upload(self, nt: "enc.NodeTensors", num_nodes: int) -> DeviceNodeState:
+        NC = nt.alloc.shape[0]
+        node_valid = np.zeros(NC, dtype=bool)
+        node_valid[:num_nodes] = True
+        dev = DeviceNodeState(
+            alloc=jnp.asarray(nt.alloc),
+            requested=jnp.asarray(nt.requested),
+            nonzero_requested=jnp.asarray(nt.nonzero_requested),
+            pod_count=jnp.asarray(nt.pod_count),
+            allowed_pods=jnp.asarray(nt.allowed_pods),
+            node_valid=jnp.asarray(node_valid),
+        )
+        self.device = dev
+        self._nt_token = nt
+        self._num_nodes = num_nodes
+        nt.pending_device_rows = set()   # start delta accumulation
+        self.last_upload_bytes = _node_block_nbytes(dev)
+        return dev
+
+    def refresh(self, nt: "enc.NodeTensors", num_nodes: int) -> DeviceNodeState:
+        pending = nt.pending_device_rows
+        if (
+            self.device is None
+            or self._nt_token is not nt
+            or pending is None
+            or self._num_nodes != num_nodes
+        ):
+            return self._full_upload(nt, num_nodes)
+        if not pending:
+            self.last_upload_bytes = 0
+            return self.device
+        rows = sorted(pending)
+        nt.pending_device_rows = set()
+        if 2 * len(rows) >= num_nodes:
+            # dense update: a full contiguous upload beats a scatter
+            return self._full_upload(nt, num_nodes)
+        NC = nt.alloc.shape[0]
+        pad = enc.round_up(len(rows))
+        idx = np.full(pad, NC, dtype=np.int32)   # pad rows → dropped writes
+        idx[: len(rows)] = rows
+
+        def deltas(a: np.ndarray) -> np.ndarray:
+            u = np.zeros((pad,) + a.shape[1:], dtype=a.dtype)
+            u[: len(rows)] = a[rows]
+            return u
+
+        u_alloc = deltas(nt.alloc)
+        u_req = deltas(nt.requested)
+        u_nz = deltas(nt.nonzero_requested)
+        u_pc = deltas(nt.pod_count)
+        u_al = deltas(nt.allowed_pods)
+        dev = self.device
+        alloc, req, nz, pc, al = _scatter_node_rows(
+            dev.alloc, dev.requested, dev.nonzero_requested,
+            dev.pod_count, dev.allowed_pods,
+            jnp.asarray(idx), jnp.asarray(u_alloc), jnp.asarray(u_req),
+            jnp.asarray(u_nz), jnp.asarray(u_pc), jnp.asarray(u_al),
+        )
+        self.device = DeviceNodeState(
+            alloc=alloc, requested=req, nonzero_requested=nz,
+            pod_count=pc, allowed_pods=al, node_valid=dev.node_valid,
+        )
+        self.last_upload_bytes = int(
+            idx.nbytes + u_alloc.nbytes + u_req.nbytes + u_nz.nbytes
+            + u_pc.nbytes + u_al.nbytes
+        )
+        return self.device
 
 
 def _resource_weights(
@@ -216,6 +389,52 @@ def _image_tensors(
     return sums, sig, counts
 
 
+@dataclass
+class StaticBatch:
+    """The assume-independent half of an encoded batch (pipeline stage 1).
+
+    Everything here is a function of the node set's static facts (labels,
+    taints, images, ports vocabulary) and the pending pods — NOT of which
+    pods are assigned where. The pipelined scheduler builds this while the
+    previous cycle's device program runs, then ``finalize_batch`` patches in
+    the assume-dependent slice (node resource rows via delta upload, spread
+    counts, affinity sums, nominations, in-use ports) after that cycle's
+    assumes land."""
+
+    pods: list
+    profile: "C.Profile | None"
+    nt: "enc.NodeTensors"
+    pb: "enc.PodBatch"
+    resource_names: list[str]
+    num_nodes: int
+    num_pods: int
+    pad_nodes: int
+    pad_pods: int
+    folded: frozenset
+    want_na: bool
+    want_tt: bool
+    want_img: bool
+    want_spread: bool
+    want_interpod: bool
+    dra_score_raw: "np.ndarray | None"
+    dra_score_sig: "np.ndarray | None"
+    img_sums: "np.ndarray | None"
+    img_sig: "np.ndarray | None"
+    img_counts: "np.ndarray | None"
+    node_valid: np.ndarray
+    pod_valid: np.ndarray
+    nominated_key: tuple
+    # True when the static encode itself already depends on assignment state
+    # (folded singleton scalars, volumes, DRA) — a pre-encoded StaticBatch
+    # with this set must not be reused across an assume boundary
+    assume_coupled: bool = False
+    # set by refresh_static when node rows moved since stage 1: the in-use
+    # port rows baked into ``pb`` are then stale and finalize re-derives
+    # them from the current NodeInfos (the one-shot encode path keeps
+    # pb.node_ports as-is — nothing ran in between)
+    ports_stale: bool = False
+
+
 def encode_batch(
     snapshot: Snapshot,
     pods: Sequence[t.Pod],
@@ -224,6 +443,7 @@ def encode_batch(
     resource_names: Sequence[str] | None = None,
     nominated: Sequence = (),
     prev_nt: "enc.NodeTensors | None" = None,
+    resident: "ResidentNodeState | None" = None,
 ) -> EncodedBatch:
     """Snapshot + pending pods → padded device batch.
 
@@ -235,7 +455,27 @@ def encode_batch(
     ``prev_nt``: the previous cycle's ``EncodedBatch.node_tensors`` — lets
     ``encode_snapshot`` refresh only the node rows whose generation moved
     (the loop's per-cycle host encode becomes O(Δ + batch)).
+
+    ``resident``: a ResidentNodeState — the node block is delta-uploaded
+    into the device-resident buffers instead of shipped whole.
     """
+    sb = encode_batch_static(
+        snapshot, pods, profile, pad=pad, resource_names=resource_names,
+        nominated=nominated, prev_nt=prev_nt,
+    )
+    return finalize_batch(sb, snapshot, nominated=nominated, resident=resident)
+
+
+def encode_batch_static(
+    snapshot: Snapshot,
+    pods: Sequence[t.Pod],
+    profile: C.Profile | None = None,
+    pad: bool = True,
+    resource_names: Sequence[str] | None = None,
+    nominated: Sequence = (),
+    prev_nt: "enc.NodeTensors | None" = None,
+) -> StaticBatch:
+    """Stage 1: the assume-independent host encode (see StaticBatch)."""
     N, P = snapshot.num_nodes(), len(pods)
     NP = enc.round_up(N) if pad else N
     PP = enc.round_up(P) if pad else P
@@ -350,8 +590,99 @@ def encode_batch(
         profile.has_filter(C.INTER_POD_AFFINITY)
         or profile.has_score(C.INTER_POD_AFFINITY)
     )
+    img_sums, img_sig, img_counts = (
+        _image_tensors(nt, pods, pad_pods=PP)
+        if want_img else (None, None, None)
+    )
+    node_valid = np.zeros(nt.alloc.shape[0], dtype=bool)
+    node_valid[:N] = True
+    pod_valid = np.zeros(PP, dtype=bool)
+    pod_valid[:P] = True
+    return StaticBatch(
+        pods=list(pods),
+        profile=profile,
+        nt=nt,
+        pb=pb,
+        resource_names=nt.resource_names,
+        num_nodes=N,
+        num_pods=P,
+        pad_nodes=nt.alloc.shape[0],
+        pad_pods=PP,
+        folded=folded,
+        want_na=want_na,
+        want_tt=want_tt,
+        want_img=want_img,
+        want_spread=want_spread,
+        want_interpod=want_interpod,
+        dra_score_raw=dra_score_raw,
+        dra_score_sig=dra_score_sig,
+        img_sums=img_sums,
+        img_sig=img_sig,
+        img_counts=img_counts,
+        node_valid=node_valid,
+        pod_valid=pod_valid,
+        nominated_key=tuple(id(e) for e in nominated),
+        assume_coupled=bool(folded) or dra_state is not None
+        or vol_state is not None,
+    )
+
+
+def refresh_static(sb: StaticBatch, snapshot: Snapshot) -> bool:
+    """Re-encode the node resource rows of a pre-encoded StaticBatch on its
+    own axis (stage-2 entry: fold in the assumes that landed since stage 1).
+    Returns False when the incremental encode could not keep the same
+    NodeTensors (node set/order changed) — the StaticBatch is then unusable
+    and the caller must re-encode from scratch."""
+    nt = enc.encode_snapshot(
+        snapshot, resource_names=sb.resource_names, pods=(),
+        pad_nodes=sb.pad_nodes, prev=sb.nt,
+    )
+    if nt is not sb.nt:
+        return False
+    if nt.last_dirty_rows:
+        # node accounting moved (the assumes this refresh folds in) — the
+        # stage-1 port rows no longer reflect in-use triples
+        sb.ports_stale = True
+    return True
+
+
+def _node_port_rows(
+    nt: "enc.NodeTensors", vocab, NC: int, K: int
+) -> np.ndarray:
+    """(NC, K) in-use port-triple rows from the CURRENT NodeInfo state —
+    the assume-dependent half of the NodePorts tensors. Raises
+    StaleStaticEncode when a node holds a triple outside the batch's
+    interned vocabulary (an assume introduced a new triple; the conflict
+    matrix can't express it)."""
+    rows = np.zeros((NC, K), dtype=bool)
+    for i, info in enumerate(nt.infos):
+        for tr in info.port_triples:
+            tid = vocab.get(tr)
+            if tid < 0:
+                raise StaleStaticEncode(f"port triple {tr} not in batch vocab")
+            rows[i, tid] = True
+    return rows
+
+
+def finalize_batch(
+    sb: StaticBatch,
+    snapshot: Snapshot,
+    nominated: Sequence = (),
+    resident: "ResidentNodeState | None" = None,
+) -> EncodedBatch:
+    """Stage 2: patch the assume-dependent slice onto a StaticBatch and
+    build the device pytree — spread counts and affinity sums re-derived
+    from the CURRENT NodeInfo state, nominations re-encoded, in-use ports
+    recomputed, and the node block delta-uploaded when ``resident`` is
+    given. Raises StaleStaticEncode when the StaticBatch can't be patched
+    (nomination set changed since stage 1, or an unknown port triple)."""
+    if tuple(id(e) for e in nominated) != sb.nominated_key:
+        raise StaleStaticEncode("nomination set changed since static encode")
+    profile, pods, nt, pb = sb.profile, sb.pods, sb.nt, sb.pb
+    N, P, PP = sb.num_nodes, sb.num_pods, sb.pad_pods
+    NC = sb.pad_nodes
     pa_dev = None
-    if want_interpod:
+    if sb.want_interpod:
         pa = enc_podaffinity.encode_pod_affinity(
             nt, pods,
             hard_pod_affinity_weight=(
@@ -376,7 +707,7 @@ def encode_batch(
                 has_score_work=pa.has_score_work,
             )
     spread_dev = None
-    if want_spread:
+    if sb.want_spread:
         defaults = (
             profile.default_spread_constraints if profile is not None else ()
         )
@@ -407,14 +738,17 @@ def encode_batch(
                 has_hard=sp.has_hard,
                 has_soft=sp.has_soft,
             )
-    img_sums, img_sig, img_counts = (
-        _image_tensors(nt, pods, pad_pods=PP)
-        if want_img else (None, None, None)
+    img_sums, img_sig, img_counts = sb.img_sums, sb.img_sig, sb.img_counts
+    node_valid, pod_valid = sb.node_valid, sb.pod_valid
+
+    # in-use ports: the stage-1 rows are reused verbatim unless node state
+    # moved since (refresh_static flags it) — then they are re-derived from
+    # the current NodeInfos (assumes occupy ports)
+    K = pb.port_conflict.shape[0]
+    node_ports = (
+        _node_port_rows(nt, pb.port_vocab, NC, K)
+        if sb.ports_stale else pb.node_ports
     )
-    node_valid = np.zeros(NP, dtype=bool)
-    node_valid[:N] = True
-    pod_valid = np.zeros(PP, dtype=bool)
-    pod_valid[:P] = True
 
     # Nominator reservations (queue/nominator.py): the gate row for pod p
     # enables nomination g iff g's priority >= p's and g is not p itself
@@ -424,7 +758,6 @@ def encode_batch(
         name_to_idx = {n: j for j, n in enumerate(nt.node_names)}
         uid_to_idx = {p_.uid: i for i, p_ in enumerate(pods)}
         G = len(nominated)
-        K = pb.port_conflict.shape[0]
         nom_node = np.full(G, -1, dtype=np.int32)
         nom_req = np.zeros((G, len(nt.resource_names)), dtype=np.int64)
         nom_gate = np.zeros((PP, G), dtype=bool)
@@ -445,13 +778,24 @@ def encode_batch(
             for i, p_ in enumerate(pods):
                 nom_gate[i, g] = e.priority >= p_.priority and e.uid != p_.uid
 
+    if resident is not None:
+        nodes_block = resident.refresh(nt, N)
+        node_upload = resident.last_upload_bytes
+        resident_bytes = resident.nbytes
+    else:
+        nodes_block = DeviceNodeState(
+            alloc=jnp.asarray(nt.alloc),
+            requested=jnp.asarray(nt.requested),
+            nonzero_requested=jnp.asarray(nt.nonzero_requested),
+            pod_count=jnp.asarray(nt.pod_count),
+            allowed_pods=jnp.asarray(nt.allowed_pods),
+            node_valid=jnp.asarray(node_valid),
+        )
+        node_upload = _node_block_nbytes(nodes_block)
+        resident_bytes = 0
+
     dev = DeviceBatch(
-        alloc=jnp.asarray(nt.alloc),
-        requested=jnp.asarray(nt.requested),
-        nonzero_requested=jnp.asarray(nt.nonzero_requested),
-        pod_count=jnp.asarray(nt.pod_count),
-        allowed_pods=jnp.asarray(nt.allowed_pods),
-        node_valid=jnp.asarray(node_valid),
+        nodes=nodes_block,
         requests=jnp.asarray(pb.requests),
         nonzero_requests=jnp.asarray(pb.nonzero_requests),
         pod_valid=jnp.asarray(pod_valid),
@@ -463,24 +807,24 @@ def encode_batch(
         ),
         node_affinity_raw=(
             jnp.asarray(pb.node_affinity_raw)
-            if want_na and pb.node_affinity_raw is not None else None
+            if sb.want_na and pb.node_affinity_raw is not None else None
         ),
         taint_prefer_raw=(
             jnp.asarray(pb.taint_prefer_raw)
-            if want_tt and pb.taint_prefer_raw is not None else None
+            if sb.want_tt and pb.taint_prefer_raw is not None else None
         ),
         score_sig=(
             jnp.asarray(pb.score_sig)
             if pb.score_sig is not None
-            and ((want_na and pb.node_affinity_raw is not None)
-                 or (want_tt and pb.taint_prefer_raw is not None))
+            and ((sb.want_na and pb.node_affinity_raw is not None)
+                 or (sb.want_tt and pb.taint_prefer_raw is not None))
             else None
         ),
-        image_sum_scores=jnp.asarray(img_sums) if want_img else None,
-        image_sig=jnp.asarray(img_sig) if want_img else None,
-        image_count=jnp.asarray(img_counts) if want_img else None,
+        image_sum_scores=jnp.asarray(img_sums) if sb.want_img else None,
+        image_sig=jnp.asarray(img_sig) if sb.want_img else None,
+        image_count=jnp.asarray(img_counts) if sb.want_img else None,
         pod_ports=jnp.asarray(pb.pod_ports),
-        node_ports=jnp.asarray(pb.node_ports),
+        node_ports=jnp.asarray(node_ports),
         port_conflict=jnp.asarray(pb.port_conflict),
         nominated_node=jnp.asarray(nom_node) if nom_node is not None else None,
         nominated_req=jnp.asarray(nom_req) if nom_req is not None else None,
@@ -492,12 +836,18 @@ def encode_batch(
         spread=spread_dev,
         podaffinity=pa_dev,
         dra_score_raw=(
-            jnp.asarray(dra_score_raw) if dra_score_raw is not None else None
+            jnp.asarray(sb.dra_score_raw)
+            if sb.dra_score_raw is not None else None
         ),
         dra_score_sig=(
-            jnp.asarray(dra_score_sig) if dra_score_raw is not None else None
+            jnp.asarray(sb.dra_score_sig)
+            if sb.dra_score_raw is not None else None
         ),
     )
+    from ..metrics.tpu import batch_nbytes
+
+    total_bytes = batch_nbytes(dev)
+    pod_block_bytes = total_bytes - _node_block_nbytes(nodes_block)
     return EncodedBatch(
         device=dev,
         node_names=nt.node_names,
@@ -507,6 +857,8 @@ def encode_batch(
         num_pods=P,
         node_tensors=nt,
         port_vocab=pb.port_vocab,
+        upload_bytes=pod_block_bytes + node_upload,
+        resident_bytes=resident_bytes,
     )
 
 

@@ -15,8 +15,12 @@ Metric set (labels ``engine`` = greedy | batched):
 - ``tpu_jit_cache_hits_total`` / ``tpu_jit_cache_misses_total`` counters —
   per-cycle compile-cache outcome of the assignment program (a miss means
   XLA compiled a new (shape, params) variant this cycle)
-- ``tpu_host_to_device_transfer_bytes_total`` counter — encoded batch bytes
-  shipped to the device (signature compression is what keeps this small)
+- ``tpu_host_to_device_transfer_bytes_total`` counter — bytes ACTUALLY
+  shipped host→device for the cycle (pod block + node-state delta rows;
+  signature compression and device residency are what keep this small)
+- ``scheduler_device_resident_bytes`` gauge — bytes of cluster node state
+  living on device ACROSS cycles (pipeline mode); dashboards read resident
+  state and per-cycle traffic as separate series
 - ``tpu_device_kernel_wall_seconds`` histogram — wall time of the device
   assignment program incl. the blocking fetch of its outputs
 """
@@ -45,6 +49,14 @@ class CycleRecord:
     kernel_wall_s: float
     compile_miss: bool | None
     profile: str = ""
+    # full encoded-batch pytree bytes — what a residency-less cycle would
+    # have shipped; transfer_bytes < batch_bytes is the delta-upload win
+    batch_bytes: int = 0
+    # device-resident node-state bytes backing this cycle (0 = no residency)
+    resident_bytes: int = 0
+    # True when this cycle ran in the two-stage pipeline (encode overlapped
+    # the previous cycle's device program)
+    pipelined: bool = False
 
     def to_json(self) -> dict:
         return asdict(self)
@@ -104,7 +116,13 @@ class TPUBackendMetrics:
         )
         self.transfer_bytes = r.counter(
             "tpu_host_to_device_transfer_bytes_total",
-            "Encoded batch bytes shipped host to device.",
+            "Bytes actually shipped host to device per cycle "
+            "(pod block + node-state delta).",
+            labels=("engine",),
+        )
+        self.resident_bytes = r.gauge(
+            "scheduler_device_resident_bytes",
+            "Cluster node-state bytes resident on device across cycles.",
             labels=("engine",),
         )
         self.kernel_wall = r.histogram(
@@ -127,9 +145,13 @@ class TPUBackendMetrics:
         kernel_wall_s: float,
         compile_miss: bool | None,
         profile: str = "",
+        batch_bytes: int = 0,
+        resident_bytes: int = 0,
+        pipelined: bool = False,
     ) -> CycleRecord:
         self.batch_size.labels(engine).observe(batch_size)
         self.transfer_bytes.labels(engine).inc(transfer_bytes)
+        self.resident_bytes.labels(engine).set(resident_bytes)
         self.kernel_wall.labels(engine).observe(kernel_wall_s)
         if compile_miss is not None:
             if compile_miss:
@@ -143,6 +165,9 @@ class TPUBackendMetrics:
                 None if compile_miss is None else bool(compile_miss)
             ),
             profile=profile,
+            batch_bytes=batch_bytes or transfer_bytes,
+            resident_bytes=resident_bytes,
+            pipelined=pipelined,
         )
         self.records.append(rec)
         return rec

@@ -173,7 +173,16 @@ def pick_node(ok, n_pdb_viol, max_prio, sum_prio, n_victims, earliest_start):
     return jnp.where(any_ok, idx, jnp.int32(-1))
 
 
-@partial(jax.jit, donate_argnums=())
+# Donated: ``potential`` (N,) bool aliases the ``ok`` output and ``v_valid``
+# (N, K) bool aliases the ``victims`` output — both are built fresh on every
+# call (the potential mask is computed per pod; v_valid is re-uploaded from
+# the host victim tensors), so invalidating them is safe and the two largest
+# bool outputs reuse their input buffers instead of allocating. The other
+# inputs either persist across preempt() calls (alloc, requests, the
+# host-mirrored usage state) or cannot alias any output shape/dtype —
+# donating those would draw "donated buffers were not usable" warnings,
+# which the test suite asserts never happen.
+@partial(jax.jit, donate_argnums=(3, 9))
 def dry_run_preemption(
     pod_req, pod_prio, wants_conf, potential,
     alloc, requested, pod_count, allowed, port_counts,

@@ -24,6 +24,10 @@ def main(argv=None) -> None:
                     choices=["greedy", "batched"],
                     help="assignment engine (assign.greedy scan vs "
                          "assign.batched capacity-coupled rounds)")
+    ap.add_argument("--pipeline", default="off", choices=["on", "off"],
+                    help="two-stage pipelined cycles with device-resident "
+                         "node state + delta uploads (parity with the "
+                         "serial loop is guaranteed; 'off' to debug)")
     ap.add_argument("--artifacts-dir", default=None,
                     help="dump per-workload diagnosis artifacts here: the "
                          "cycle trace as Perfetto-loadable Chrome-trace "
@@ -41,6 +45,7 @@ def main(argv=None) -> None:
     kwargs = dict(
         max_batch=args.max_batch, timeout_s=args.timeout,
         engine=args.engine, artifacts_dir=args.artifacts_dir,
+        pipeline=(args.pipeline == "on"),
     )
     if args.label:
         for r in run_label(args.label, **kwargs):

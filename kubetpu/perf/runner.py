@@ -46,6 +46,16 @@ class WorkloadResult:
     cycles: int
     p99_attempt_latency_ms: float | None = None
     threshold_note: str = ""          # derivation of a scaled threshold
+    # device-traffic view of the measured phase (from the per-cycle TPU
+    # records): cycle rate, ACTUAL host→device bytes per cycle vs what a
+    # residency-less encode would have shipped, resident-state size, and
+    # how many pipelined cycles were replayed for parity
+    cycles_per_sec: float | None = None
+    transfer_bytes_per_cycle: float | None = None
+    batch_bytes_per_cycle: float | None = None
+    resident_bytes: int = 0
+    compile_misses: int = 0
+    pipeline_replays: int = 0
     # post-run metric snapshot (SchedulerMetricsRegistry.snapshot): p50/p99
     # from the histograms + schedule_attempts by result — every BENCH json
     # carries its own diagnosis
@@ -74,6 +84,16 @@ class WorkloadResult:
             out["threshold_note"] = self.threshold_note
         if self.p99_attempt_latency_ms is not None:
             out["p99_attempt_latency_ms"] = round(self.p99_attempt_latency_ms, 2)
+        if self.cycles_per_sec is not None:
+            out["cycles_per_sec"] = round(self.cycles_per_sec, 2)
+        if self.transfer_bytes_per_cycle is not None:
+            out["transfer_bytes_per_cycle"] = round(self.transfer_bytes_per_cycle)
+        if self.batch_bytes_per_cycle is not None:
+            out["batch_bytes_per_cycle"] = round(self.batch_bytes_per_cycle)
+        if self.resident_bytes:
+            out["resident_bytes"] = self.resident_bytes
+        if self.pipeline_replays:
+            out["pipeline_replays"] = self.pipeline_replays
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -150,17 +170,48 @@ class _Client:
 
 
 def _begin_measured_phase(sched, warmup: bool, warm_pods):
-    """Optionally compile the measured phase's device program, then snapshot
-    the metric counters (and the histograms, via a prom baseline) so the
-    measurement AND the embedded metrics snapshot are scoped to the same
-    window — a large init phase must not dominate the reported p99s."""
+    """Optionally compile the measured phase's device program (the full
+    bucket ladder, so remainder batches hit the compile cache too), then
+    snapshot the metric counters (and the histograms, via a prom baseline)
+    so the measurement AND the embedded metrics snapshot are scoped to the
+    same window — a large init phase must not dominate the reported p99s."""
     if warmup:
         sched.warmup(warm_pods)
+    # measured-window baseline for the replay counter (init-phase churn —
+    # PV/namespace creation — replays in-flight init cycles and must not
+    # pollute the measured-phase evidence)
+    sched._measure_replays0 = sched.metrics.pipeline_replays
     return (
         sched.metrics.schedule_attempts,
         sched.metrics.cycles,
         sched.metrics.prom.snapshot_baseline(),
     )
+
+
+def _device_traffic_stats(sched, cycles0: int, duration: float) -> dict:
+    """Measured-phase device-traffic summary from the per-cycle TPU
+    records (joined to the window by cycle id)."""
+    recs = [r for r in sched.metrics.tpu.records if r.cycle > cycles0]
+    out = dict(
+        cycles_per_sec=None, transfer_bytes_per_cycle=None,
+        batch_bytes_per_cycle=None, resident_bytes=0,
+        compile_misses=sum(1 for r in recs if r.compile_miss),
+        pipeline_replays=(
+            sched.metrics.pipeline_replays
+            - getattr(sched, "_measure_replays0", 0)
+        ),
+    )
+    if recs:
+        out["transfer_bytes_per_cycle"] = (
+            sum(r.transfer_bytes for r in recs) / len(recs)
+        )
+        out["batch_bytes_per_cycle"] = (
+            sum(r.batch_bytes for r in recs) / len(recs)
+        )
+        out["resident_bytes"] = max(r.resident_bytes for r in recs)
+        if duration > 0:
+            out["cycles_per_sec"] = len(recs) / duration
+    return out
 
 
 @dataclass
@@ -216,18 +267,21 @@ def run_workload(
     stall_s: float = 15.0,
     warmup: bool = True,
     artifacts_dir: str | None = None,
+    pipeline: bool = False,
 ) -> WorkloadResult:
     """Execute one (test case, workload) pair and return the measurement.
     ``engine`` selects the assignment engine ("greedy" scan or "batched"
     rounds); ``stall_s`` is how long zero progress must persist before a
     phase gives up (must exceed the queue's max backoff, default 10 s, or
     backed-off pods read as stalls). ``warmup`` compiles the measured
-    phase's device program (via ``Scheduler.warmup``, no state mutation)
-    before its clock starts — a long-lived scheduler compiles once at
-    startup, so measured throughput is steady-state, like the reference's
-    precompiled binary. ``artifacts_dir`` dumps the run's Chrome-trace
-    JSON, /metrics snapshot, and device-side cycle records there (see
-    ``dump_diagnosis_artifacts``)."""
+    phase's device programs — the whole bucket ladder — before its clock
+    starts (via ``Scheduler.warmup``; no scheduling-state mutation) — a
+    long-lived scheduler compiles once at startup, so measured throughput
+    is steady-state, like the reference's precompiled binary. ``pipeline``
+    runs the two-stage pipelined cycle with the device-resident node block
+    (Scheduler(pipeline=True)). ``artifacts_dir`` dumps the run's
+    Chrome-trace JSON, /metrics snapshot, and device-side cycle records
+    there (see ``dump_diagnosis_artifacts``)."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -237,7 +291,7 @@ def run_workload(
     client = _Client()
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
-        engine=engine,
+        engine=engine, pipeline=pipeline,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     client.sched = sched
@@ -537,11 +591,13 @@ def run_workload(
             f"{case.name}_{workload.name}_{engine}",
         )
     throughput = measured / duration if duration > 0 else 0.0
+    traffic = _device_traffic_stats(sched, cycles0, duration)
     result = WorkloadResult(
         case_name=case.name,
         workload_name=workload.name,
         threshold=workload.threshold,
         threshold_note=workload.threshold_note,
+        **traffic,
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops
@@ -584,6 +640,7 @@ def run_workload_full_stack(
     stall_s: float = 15.0,
     warmup: bool = True,
     artifacts_dir: str | None = None,
+    pipeline: bool = False,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -635,7 +692,7 @@ def run_workload_full_stack(
     client = _CountingClient(remote)
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
-        engine=engine,
+        engine=engine, pipeline=pipeline,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     informers = SchedulerInformers(remote, sched)
@@ -738,11 +795,13 @@ def run_workload_full_stack(
             f"{case.name}_{workload.name}_{engine}_fullstack",
         )
     throughput = measured / duration if duration > 0 else 0.0
+    traffic = _device_traffic_stats(sched, cycles0, duration)
     return WorkloadResult(
         case_name=case.name,
         workload_name=workload.name + "_fullstack",
         threshold=workload.threshold,
         threshold_note=workload.threshold_note,
+        **traffic,
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops

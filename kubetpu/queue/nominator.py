@@ -42,6 +42,10 @@ class Nominator:
 
     def __init__(self) -> None:
         self._by_uid: dict[str, NominatedPod] = {}
+        # bumped on every mutation — the pipelined scheduler compares it
+        # across a dispatched cycle to detect that an informer event changed
+        # the reservation set the in-flight encode was built against
+        self.version = 0
 
     def add(self, pod: t.Pod, node_name: str) -> None:
         from ..state.encoder import _pod_port_triples
@@ -53,9 +57,11 @@ class Nominator:
             requests=pod.requests,
             ports=tuple(_pod_port_triples(pod)),
         )
+        self.version += 1
 
     def remove(self, uid: str) -> None:
-        self._by_uid.pop(uid, None)
+        if self._by_uid.pop(uid, None) is not None:
+            self.version += 1
 
     def get(self, uid: str) -> NominatedPod | None:
         return self._by_uid.get(uid)

@@ -204,6 +204,10 @@ class DraIndex:
         self.slices: dict[str, t.ResourceSlice] = {}
         self.claims: dict[str, t.ResourceClaim] = {}
         self.generation = 0          # bumped on slice/class topology changes
+        # bumped on claim add/remove/update — cheap change signal for the
+        # pipelined scheduler's staleness check (claim churn must not
+        # invalidate the pool catalogs the way `generation` does)
+        self.claims_version = 0
         self._class_terms: dict[str, tuple | None] = {}  # None = bad CEL
         self._pool_ids: dict[tuple, int] = {}
         self._pools: list[_Pool] = []
@@ -233,11 +237,13 @@ class DraIndex:
     def add_claim(self, claim: t.ResourceClaim) -> None:
         old = self.claims.get(claim.key)
         self.claims[claim.key] = claim
+        self.claims_version += 1
         self._reconcile_allocation(old, claim)
 
     def remove_claim(self, key: str) -> None:
         old = self.claims.pop(key, None)
         if old is not None:
+            self.claims_version += 1
             self._reconcile_allocation(old, None)
 
     # ---- allocation bookkeeping -----------------------------------------

@@ -64,6 +64,19 @@ def round_up(n: int, minimum: int = 8) -> int:
     return (n + 1023) // 1024 * 1024
 
 
+def bucket_ladder(n: int, minimum: int = 8) -> list[int]:
+    """Every padded size ``round_up`` can produce for inputs in [1, n] —
+    the compile-cache bucket ladder. Warming all of them at startup means a
+    churning queue (whose batch sizes wander the ladder) never pays XLA
+    compilation mid-cycle."""
+    top = round_up(n, minimum)
+    out = [minimum]
+    while out[-1] < top:
+        v = out[-1]
+        out.append(v << 1 if v < 1024 else v + 1024)
+    return out
+
+
 def resource_axis(snapshot: Snapshot, pods: Sequence[t.Pod]) -> list[str]:
     """Fixed resource vocabulary: base resources then sorted scalars seen in
     node allocatable or pod requests."""
@@ -146,6 +159,28 @@ class NodeTensors:
     # incremental ``encode_snapshot(…, prev=…)`` refresh (only rows whose
     # generation moved are rewritten, the UpdateSnapshot O(Δ) philosophy)
     node_gens: dict = field(repr=False, default_factory=dict)
+    # --- delta-upload + pipeline-staleness bookkeeping -------------------
+    # row indices re-encoded but not yet shipped to the device-resident
+    # node block (runtime.ResidentNodeState consumes + clears); None means
+    # "freshly (re)built — everything needs a full upload"
+    pending_device_rows: set | None = field(repr=False, default=None)
+    # outcome of the LAST encode_snapshot call on this object: which rows it
+    # re-encoded, whether any re-encoded row's VALUES actually differ from
+    # what was there before (a bind confirmation replaces a pod with
+    # identical accounting → rows re-encode to the same values), and whether
+    # any node OBJECT was replaced (labels/taints/images may differ — facts
+    # outside the resource rows). The pipelined scheduler uses these to
+    # decide whether a dispatched-but-unsynced cycle saw stale state.
+    last_dirty_rows: tuple = field(repr=False, default=())
+    last_values_changed: bool = field(repr=False, default=False)
+    last_nodes_replaced: bool = field(repr=False, default=False)
+    # a dirty row whose POD SET content (uids, labels, host ports) changed —
+    # facts that feed affinity/spread/port tensors without moving the
+    # resource rows (a bind confirmation replaces a pod with identical
+    # content and does NOT set this)
+    last_pods_mutated: bool = field(repr=False, default=False)
+    # per-node content signature backing the check above
+    pod_content_sigs: dict = field(repr=False, default_factory=dict)
 
     @property
     def num_nodes(self) -> int:
@@ -255,6 +290,18 @@ def _encode_node_row(
     nt.pod_count[i] = len(info.pods)
 
 
+def _pod_content_sig(info: NodeInfo) -> int:
+    """Order-independent signature of the node's pod-set facts that feed
+    tensors OUTSIDE the resource rows: uids (membership), labels (affinity/
+    spread selectors) and ports (NodePorts). Resource changes are covered by
+    the row-value diff; this catches a label or hostPort mutation on an
+    otherwise resource-identical pod."""
+    return hash(tuple(sorted(
+        ((uid, p.labels, p.ports) for uid, p in info.pods.items()),
+        key=lambda x: x[0],
+    )))
+
+
 def encode_snapshot(
     snapshot: Snapshot, resource_names: Sequence[str] | None = None,
     pods: Sequence[t.Pod] = (),
@@ -286,13 +333,36 @@ def encode_snapshot(
     ):
         ridx = {r: i for i, r in enumerate(rnames)}
         gens = prev.node_gens
+        dirty: list[int] = []
+        values_changed = False
+        nodes_replaced = False
+        pods_mutated = False
         for i, info in enumerate(infos):
             name = node_names[i]
             gen = snapshot.node_generation.get(name)
             if gens.get(name) == gen:
                 continue
+            dirty.append(i)
+            psig = _pod_content_sig(info)
+            if prev.pod_content_sigs.get(name) != psig:
+                pods_mutated = True
+                prev.pod_content_sigs[name] = psig
+            old_row = (
+                prev.alloc[i].copy(), prev.requested[i].copy(),
+                prev.nonzero_requested[i].copy(),
+                int(prev.pod_count[i]), int(prev.allowed_pods[i]),
+            )
             _encode_node_row(prev, i, info, ridx)
+            if not values_changed and not (
+                int(prev.pod_count[i]) == old_row[3]
+                and int(prev.allowed_pods[i]) == old_row[4]
+                and np.array_equal(prev.alloc[i], old_row[0])
+                and np.array_equal(prev.requested[i], old_row[1])
+                and np.array_equal(prev.nonzero_requested[i], old_row[2])
+            ):
+                values_changed = True
             if prev.infos[i].node is not info.node:
+                nodes_replaced = True
                 # node object replaced: labels may differ — refresh vocab and
                 # the label-matrix row (new keys force a lazy full rebuild)
                 kv, vv = prev.key_vocab, prev.val_vocab
@@ -309,6 +379,12 @@ def encode_snapshot(
                             prev.node_label[i, kv.get(k)] = vv.intern(v)
             gens[name] = gen
         prev.infos = infos
+        prev.last_dirty_rows = tuple(dirty)
+        prev.last_values_changed = values_changed
+        prev.last_nodes_replaced = nodes_replaced
+        prev.last_pods_mutated = pods_mutated
+        if prev.pending_device_rows is not None:
+            prev.pending_device_rows.update(dirty)
         return prev
 
     ridx = {r: i for i, r in enumerate(rnames)}
@@ -335,6 +411,9 @@ def encode_snapshot(
     )
     for i, info in enumerate(infos):
         _encode_node_row(nt, i, info, ridx)
+        # seed the content signatures so a post-rebuild bind confirmation
+        # (identical content) doesn't read as a pod mutation
+        nt.pod_content_sigs[info.node.name] = _pod_content_sig(info)
         for k, v in info.node.labels:
             key_vocab.intern(k)
             val_vocab.intern(v)

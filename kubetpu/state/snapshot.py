@@ -10,7 +10,9 @@ Semantics mirrored from the reference:
   nominated node before the bind API call lands; ``finish_binding`` starts the
   expiry clock; ``forget_pod`` rolls back.
 - ``update_snapshot`` (cache.go:190): incremental — only nodes whose
-  generation advanced since the last snapshot are re-copied.
+  generation advanced since the last snapshot are re-copied. The cache keeps
+  a recency-ordered index of touched nodes so the per-cycle refresh walks
+  only the Δ touched since the snapshot's watermark, not all N nodes.
 - NodeInfo aggregates: ``requested`` (exact) and ``nonzero_requested``
   (scoring view with 100 mCPU / 200 MiB defaults,
   pkg/scheduler/util/pod_resources.go) are maintained on add/remove.
@@ -18,7 +20,7 @@ Semantics mirrored from the reference:
 
 from __future__ import annotations
 
-import itertools
+import collections
 import time
 from dataclasses import dataclass, field
 
@@ -93,6 +95,13 @@ class Snapshot:
     # per-node cache generation this snapshot last copied (owned by this
     # snapshot so several snapshots can be refreshed independently)
     node_generation: dict[str, int] = field(default_factory=dict)
+    # O(Δ) refresh bookkeeping: the cache this snapshot came from, the
+    # highest cache generation it has folded in, and the cache's node-set
+    # epoch at that time (any add/remove invalidates the fast path)
+    cache_token: object = None
+    cache_watermark: int = 0
+    order_epoch: int = -1
+    namespaces_generation: int = -1
     # namespace name → labels (the nsLister view affinity terms match)
     namespaces: dict[str, dict[str, str]] = field(default_factory=dict)
     # object listers' view (pv/pvc/storageclass/service), copied on change only
@@ -126,7 +135,16 @@ class Cache:
         self._node_order: list[str] = []
         self._pods: dict[str, t.Pod] = {}       # uid -> pod (assigned or assumed)
         self._assumed: dict[str, float | None] = {}  # uid -> bind-finished deadline
-        self._gen = itertools.count(1)
+        self._last_gen = 0
+        # recency-ordered dirty-node index: node name -> generation at last
+        # touch, most recent LAST — update_snapshot walks it backwards and
+        # stops at the snapshot's watermark, so the per-cycle refresh is
+        # O(nodes touched since last refresh), not O(all nodes)
+        self._touched: "collections.OrderedDict[str, int]" = collections.OrderedDict()
+        # bumped on every node add/remove (the snapshot fast path requires an
+        # unchanged node set + order)
+        self._order_epoch = 0
+        self._ns_gen = 0
         self._ttl = ttl_seconds
         self._clock = clock
         self._deleted_nodes: dict[str, NodeInfo] = {}
@@ -186,11 +204,25 @@ class Cache:
     # --- namespaces ------------------------------------------------------
     def add_namespace(self, ns: "t.Namespace") -> None:
         self._namespaces[ns.name] = ns.labels_dict()
+        self._ns_gen += 1
 
     update_namespace = add_namespace
 
     def remove_namespace(self, name: str) -> None:
-        self._namespaces.pop(name, None)
+        if self._namespaces.pop(name, None) is not None:
+            self._ns_gen += 1
+
+    # --- generations -----------------------------------------------------
+    def _next_gen(self) -> int:
+        self._last_gen += 1
+        return self._last_gen
+
+    def _touch(self, info: NodeInfo) -> None:
+        """Advance the node's generation and move it to the tail of the
+        recency index (the snapshot fast path's work list)."""
+        info.generation = self._next_gen()
+        self._touched[info.node.name] = info.generation
+        self._touched.move_to_end(info.node.name)
 
     # --- nodes -----------------------------------------------------------
     def add_node(self, node: t.Node) -> None:
@@ -203,8 +235,9 @@ class Cache:
                 info = NodeInfo(node=node)
             self._nodes[node.name] = info
             self._node_order.append(node.name)
+            self._order_epoch += 1
         info.node = node
-        info.generation = next(self._gen)
+        self._touch(info)
 
     def update_node(self, node: t.Node) -> None:
         self.add_node(node)
@@ -239,6 +272,8 @@ class Cache:
         if info is None:
             return
         self._node_order.remove(name)
+        self._order_epoch += 1
+        self._touched.pop(name, None)
         if info.pods:
             self._deleted_nodes[name] = info
 
@@ -324,8 +359,9 @@ class Cache:
             info = NodeInfo(node=t.Node(name=pod.node_name))
             self._nodes[pod.node_name] = info
             self._node_order.append(pod.node_name)
+            self._order_epoch += 1
         info.add_pod(pod)
-        info.generation = next(self._gen)
+        self._touch(info)
 
     def _remove_pod_internal(self, pod: t.Pod) -> None:
         self._pods.pop(pod.uid, None)
@@ -334,30 +370,60 @@ class Cache:
             info = self._deleted_nodes.get(pod.node_name)
         if info is not None:
             info.remove_pod(pod)
-            info.generation = next(self._gen)
+            self._touch(info)
             if not info.pods and pod.node_name in self._deleted_nodes:
                 del self._deleted_nodes[pod.node_name]
 
     # --- snapshot --------------------------------------------------------
     def update_snapshot(self, snapshot: Snapshot | None = None) -> Snapshot:
         """Incremental snapshot refresh (cache.go:190): clone only nodes whose
-        generation moved; preserve node order."""
+        generation moved; preserve node order.
+
+        Fast path: a snapshot previously refreshed from THIS cache whose node
+        set/order hasn't changed walks the recency index backwards from the
+        newest touch down to its watermark — O(nodes touched since the last
+        refresh). Any node add/remove (or a foreign snapshot) falls back to
+        the full O(N) scan."""
         if snapshot is None:
             snapshot = Snapshot()
-        new_nodes: dict[str, NodeInfo] = {}
-        new_gens: dict[str, int] = {}
-        for name in self._node_order:
-            info = self._nodes[name]
-            prev = snapshot.nodes.get(name)
-            if prev is not None and snapshot.node_generation.get(name) == info.generation:
-                new_nodes[name] = prev
-            else:
-                new_nodes[name] = info.clone()
-            new_gens[name] = info.generation
-        snapshot.nodes = new_nodes
-        snapshot.node_generation = new_gens
-        snapshot.node_order = list(self._node_order)
-        snapshot.namespaces = {k: dict(v) for k, v in self._namespaces.items()}
+        if (
+            snapshot.cache_token is self
+            and snapshot.order_epoch == self._order_epoch
+        ):
+            # O(Δ): only nodes touched past the watermark need a re-clone
+            for name in reversed(self._touched):
+                gen = self._touched[name]
+                if gen <= snapshot.cache_watermark:
+                    break
+                info = self._nodes.get(name)
+                if info is None:
+                    continue  # deleted-node accounting (not snapshotted)
+                snapshot.nodes[name] = info.clone()
+                snapshot.node_generation[name] = info.generation
+        else:
+            new_nodes: dict[str, NodeInfo] = {}
+            new_gens: dict[str, int] = {}
+            for name in self._node_order:
+                info = self._nodes[name]
+                prev = snapshot.nodes.get(name)
+                if prev is not None and snapshot.node_generation.get(name) == info.generation:
+                    new_nodes[name] = prev
+                else:
+                    new_nodes[name] = info.clone()
+                new_gens[name] = info.generation
+            snapshot.nodes = new_nodes
+            snapshot.node_generation = new_gens
+            snapshot.node_order = list(self._node_order)
+            snapshot.cache_token = self
+            snapshot.order_epoch = self._order_epoch
+        snapshot.cache_watermark = self._last_gen
+        if snapshot.namespaces_generation != self._ns_gen:
+            # namespace labels are read-only per object: copy per CHANGE,
+            # not per refresh (the per-cycle dict rebuild was hot-loop waste)
+            snapshot.namespaces = {
+                k: dict(v) for k, v in self._namespaces.items()
+            }
+            snapshot.namespaces_generation = self._ns_gen
         if snapshot.volumes_generation != self._volumes_gen:
             # lister objects are immutable values: a shallow dict copy per
             # CHANGE (not per refresh) gives the snapshot a stable view
@@ -367,5 +433,5 @@ class Cache:
             snapshot.services = dict(self._services)
             snapshot.volumes_generation = self._volumes_gen
         snapshot.dra = self.dra
-        snapshot.generation = next(self._gen)
+        snapshot.generation = self._next_gen()
         return snapshot

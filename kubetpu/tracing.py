@@ -99,12 +99,18 @@ class Tracer:
         start: float,
         end: float,
         parent_id: int | None = None,
+        off_stack: bool = True,
         **attrs,
     ) -> Span | None:
         """Record a span whose timing happened OFF the loop thread's span
         stack (an async bind measured dispatch→completion): the caller
         supplies start/end on this tracer's clock; the span lands in the
-        buffer like any other but never touches the parent stack."""
+        buffer like any other but never touches the parent stack.
+        ``off_stack=False`` places it on the loop lane (tid 1) in the
+        Chrome-trace export — for loop-owned phases whose start/end bracket
+        other calls (the pipelined scheduling cycle spans dispatch→sync
+        across two loop iterations), provided the caller guarantees proper
+        nesting with the lane's other spans."""
         if not self.enabled:
             return None
         sp = Span(
@@ -114,7 +120,7 @@ class Tracer:
             start=start,
             end=end,
             attrs=dict(attrs),
-            off_stack=True,
+            off_stack=off_stack,
         )
         self._spans.append(sp)
         return sp

@@ -1,0 +1,369 @@
+"""Pipelined scheduling cycles + device-resident cluster state.
+
+Covers the PR-2 tentpole properties:
+
+- **Parity**: the two-stage pipeline (dispatch cycle k, host-encode k+1
+  while the device runs, patch the assume-dependent slice after the k-sync)
+  produces pod-for-pod identical assignments to the serial loop, on the
+  SchedulingBasic, topology-spread and inter-pod-affinity workload shapes —
+  including when a node update lands mid-pipeline (the stale in-flight
+  cycle is replayed against fresh state, exactly what serial computes).
+- **Delta uploads**: the dirty-row scatter into the resident node block
+  produces device tensors identical to a full re-encode, and ships fewer
+  bytes than the full batch.
+- **Donation hygiene**: no "donated buffers were not usable" warnings over
+  a pipelined run (donation is wired only where outputs alias).
+- **Perf smoke** (regression gate on both tentpole properties): a few
+  hundred pods through BOTH engines with the pipeline on — zero compile
+  misses after the bucket-ladder prewarm, and steady-state transfer bytes
+  strictly below the full-batch bytes.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.framework import config as C
+from kubetpu.framework import runtime as rt
+from kubetpu.perf import workloads as W
+from kubetpu.sched import Scheduler
+from kubetpu.state import Cache
+
+from .test_scheduler import FakeClient, make_sched
+
+
+def _cluster(s: Scheduler, num_nodes: int = 12):
+    for i in range(num_nodes):
+        s.on_node_add(W.node_default(i, zones=("zone-a", "zone-b", "zone-c")))
+
+
+def _drive(s: Scheduler, client: FakeClient, pods, max_batch=None,
+           events=None):
+    """Feed pods, then run schedule_batch cycles to completion, delivering
+    bind confirmations between cycles like the informer seam does.
+    ``events``: {call_index: fn(s)} fired BEFORE that schedule_batch call —
+    with the pipeline on, a fn firing while a cycle is in flight exercises
+    the mid-pipeline staleness/replay path."""
+    for p in pods:
+        s.on_pod_add(p)
+    calls = 0
+    idle = 0
+    while idle < 3 and calls < 200:
+        if events and calls in events:
+            events[calls](s)
+        res = s.schedule_batch(max_batch)
+        s.dispatcher.sync()
+        calls += 1
+        if res["scheduled"] == 0 and res["unschedulable"] == 0:
+            idle += 1
+        else:
+            idle = 0
+    if s._inflight is not None:
+        s._complete_inflight()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    return dict(client.bound)
+
+
+def _parity_case(pod_factory, num_pods=40, num_nodes=12, max_batch=8,
+                 events=None, profile=None):
+    """Run the same cluster + pod set through serial and pipelined
+    schedulers; return both bound maps."""
+    results = {}
+    for pipeline in (False, True):
+        client = FakeClient()
+        s, _ = make_sched(
+            client, profile=profile or C.Profile(), pipeline=pipeline,
+            max_batch=max_batch,
+        )
+        _cluster(s, num_nodes)
+        # a seed pod matching the affinity templates' color=blue zone term
+        # (the perf workloads' init-pods role): affinity batches need an
+        # existing match or every pod is unschedulable
+        seed = make_pod(
+            "seed-0", namespace="sched-0", labels={"color": "blue"},
+            cpu_milli=100, memory=100 * 1024**2,
+            node_name=s.cache.get_node_info(
+                next(iter(s.cache._nodes))
+            ).node.name,
+        )
+        s.on_pod_add(seed)
+        # pods live in sched-0 so the zone-affinity namespaces match
+        pods = [
+            pod_factory(f"p-{j}", "sched-0") for j in range(num_pods)
+        ]
+        results[pipeline] = _drive(
+            s, client, pods, max_batch=max_batch, events=events,
+        )
+        s.close()
+    return results[False], results[True]
+
+
+@pytest.mark.parametrize("factory", [
+    W.pod_default,
+    W.pod_with_topology_spreading,
+    W.pod_with_pod_affinity,
+], ids=["basic", "spread", "interpod-affinity"])
+def test_pipelined_matches_serial_pod_for_pod(factory):
+    serial, pipelined = _parity_case(factory)
+    assert pipelined == serial
+    assert len(serial) > 0
+
+
+def test_pipelined_parity_with_mid_pipeline_node_update():
+    """A node update delivered BETWEEN cycles — while a cycle is in flight
+    in pipeline mode — must not change assignments vs the serial loop: the
+    stale in-flight cycle is detected (replaced node object) and replayed
+    against the updated state."""
+    bigger = make_node(
+        "updated-node", cpu_milli=64000, memory=512 * 1024**3, pods=500,
+        labels={
+            "kubernetes.io/hostname": "updated-node",
+            "topology.kubernetes.io/zone": "zone-a",
+        },
+    )
+
+    def fire(s: Scheduler):
+        s.on_node_add(bigger)   # add_node == update path in the cache
+
+    # fire on call 2: with max_batch=8 and 40 pods the pipeline has a cycle
+    # in flight then; serial sees the update before its call-2 encode
+    events = {2: fire}
+    serial, pipelined = _parity_case(W.pod_default, events=events)
+    assert pipelined == serial
+    # and the update actually took effect (the big node absorbed pods)
+    assert "updated-node" in set(serial.values())
+
+
+def test_mid_pipeline_update_triggers_replay_counter():
+    client = FakeClient()
+    s, _ = make_sched(client, profile=C.Profile(), pipeline=True, max_batch=4)
+    _cluster(s, 6)
+    pods = [W.pod_default(f"p-{j}", "ns") for j in range(16)]
+    fired = []
+
+    def fire(sched):
+        if sched._inflight is not None:
+            fired.append(True)
+            sched.on_node_add(make_node(
+                "n-new", cpu_milli=32000, memory=64 * 1024**3, pods=200,
+            ))
+
+    _drive(s, client, pods, max_batch=4, events={2: fire})
+    assert fired, "test setup: no cycle was in flight at the event"
+    assert s.metrics.pipeline_replays >= 1
+    s.close()
+
+
+def test_mid_pipeline_pod_label_mutation_triggers_replay():
+    """A running pod's LABELS changing under an in-flight cycle moves no
+    resource row (identical requests) but feeds affinity/spread tensors —
+    the pod-content signature must catch it and replay."""
+    client = FakeClient()
+    s, _ = make_sched(client, profile=C.Profile(), pipeline=True, max_batch=4)
+    _cluster(s, 6)
+    node = s.cache.get_node_info(next(iter(s.cache._nodes))).node.name
+    old = make_pod("squatter", namespace="ns", labels={"color": "blue"},
+                   cpu_milli=100, memory=100 * 1024**2, node_name=node)
+    s.on_pod_add(old)
+    pods = [W.pod_default(f"p-{j}", "ns") for j in range(16)]
+    fired = []
+
+    def fire(sched):
+        if sched._inflight is not None:
+            fired.append(True)
+            new = make_pod("squatter", namespace="ns",
+                           labels={"color": "red"}, cpu_milli=100,
+                           memory=100 * 1024**2, node_name=node)
+            sched.on_pod_update(old, new)
+
+    _drive(s, client, pods, max_batch=4, events={2: fire})
+    assert fired
+    assert s.metrics.pipeline_replays >= 1
+    s.close()
+
+
+def test_mid_pipeline_dra_churn_triggers_replay():
+    """DRA slice/claim churn landing under an in-flight cycle is a stale
+    signal too (the dispatched encode may have baked in a device catalog
+    that no longer exists) — the cycle must replay."""
+    from kubetpu.api import types as t
+
+    client = FakeClient()
+    s, _ = make_sched(client, profile=C.Profile(), pipeline=True, max_batch=4)
+    _cluster(s, 6)
+    pods = [W.pod_default(f"p-{j}", "ns") for j in range(16)]
+    fired = []
+
+    def fire(sched):
+        if sched._inflight is not None:
+            fired.append(True)
+            sched.on_resource_slice_add(t.ResourceSlice(
+                name="slice-x", driver="d", pool="n-0", node_name="node-0",
+                devices=(t.Device(name="dev-0"),),
+            ))
+
+    _drive(s, client, pods, max_batch=4, events={2: fire})
+    assert fired
+    assert s.metrics.pipeline_replays >= 1
+    assert len(client.bound) == 16
+    s.close()
+
+
+def test_bind_confirmations_do_not_replay():
+    """The steady-state informer traffic — bind confirmations replacing our
+    own assumed pods with identical accounting — must NOT trigger replays
+    (rows re-encode to equal values)."""
+    client = _ConfirmingClient()
+    s, _ = make_sched(client, profile=C.Profile(), pipeline=True, max_batch=4)
+    client.sched = s
+    _cluster(s, 6)
+    for j in range(24):
+        s.on_pod_add(make_pod(f"p-{j}", cpu_milli=100,
+                              memory=100 * 1024**2, creation_index=j))
+    for _ in range(30):
+        res = s.schedule_batch(4)
+        s.dispatcher.sync()
+        client.deliver()
+        if res["scheduled"] == 0 and res["unschedulable"] == 0:
+            break
+    if s._inflight is not None:
+        s._complete_inflight()
+    s.dispatcher.sync()
+    assert len(client.bound) == 24
+    assert s.metrics.pipeline_replays == 0
+    s.close()
+
+
+class _ConfirmingClient(FakeClient):
+    """FakeClient that also replays the bind back through the informer seam
+    (pending → assigned update), like the perf runner's client."""
+
+    def __init__(self):
+        super().__init__()
+        self.sched = None
+        self._pending = []
+
+    def bind(self, pod, node_name):
+        super().bind(pod, node_name)
+        self._pending.append((pod, node_name))
+
+    def deliver(self):
+        while self._pending:
+            pod, node_name = self._pending.pop(0)
+            self.sched.on_pod_update(pod, pod.with_node(node_name))
+
+
+# ---------------------------------------------------------------- residency
+
+def _encode_state(num_nodes=10, num_pods=6):
+    cache = Cache()
+    for i in range(num_nodes):
+        cache.add_node(make_node(f"n{i}", cpu_milli=8000,
+                                 memory=16 * 1024**3))
+    pods = [make_pod(f"p{j}", cpu_milli=500, memory=512 * 1024**2)
+            for j in range(num_pods)]
+    return cache, pods
+
+
+def test_delta_upload_equals_full_reencode():
+    """Dirty-row scatter into the resident block must produce device
+    tensors identical to a from-scratch encode of the same snapshot."""
+    cache, pods = _encode_state()
+    profile = C.Profile()
+    resident = rt.ResidentNodeState()
+    snap = cache.update_snapshot()
+    b1 = rt.encode_batch(snap, pods, profile, resident=resident)
+    assert b1.resident_bytes > 0
+
+    # mutate a couple of nodes: one assigned pod, one capacity update
+    cache.add_pod(make_pod("placed", cpu_milli=1500,
+                           memory=1024**3, node_name="n3"))
+    cache.add_node(make_node("n7", cpu_milli=2000, memory=4 * 1024**3))
+    snap = cache.update_snapshot(snap)
+    b2 = rt.encode_batch(snap, pods, profile, prev_nt=b1.node_tensors,
+                         resident=resident)
+    # delta path engaged: strictly fewer bytes than a full node block
+    node_block_full = sum(
+        int(x.nbytes) for x in (
+            b2.device.nodes.alloc, b2.device.nodes.requested,
+            b2.device.nodes.nonzero_requested, b2.device.nodes.pod_count,
+            b2.device.nodes.allowed_pods, b2.device.nodes.node_valid,
+        )
+    )
+    assert 0 < resident.last_upload_bytes < node_block_full
+
+    # ground truth: full re-encode without residency
+    ref = rt.encode_batch(cache.update_snapshot(), pods, profile)
+    for field in ("alloc", "requested", "nonzero_requested", "pod_count",
+                  "allowed_pods", "node_valid"):
+        got = np.asarray(getattr(b2.device.nodes, field))
+        want = np.asarray(getattr(ref.device.nodes, field))
+        np.testing.assert_array_equal(got, want, err_msg=field)
+
+
+def test_delta_upload_zero_when_clean():
+    cache, pods = _encode_state()
+    resident = rt.ResidentNodeState()
+    snap = cache.update_snapshot()
+    b1 = rt.encode_batch(snap, pods, C.Profile(), resident=resident)
+    b2 = rt.encode_batch(cache.update_snapshot(snap), pods, C.Profile(),
+                         prev_nt=b1.node_tensors, resident=resident)
+    assert resident.last_upload_bytes == 0
+    assert b2.upload_bytes < sum(
+        int(leaf.nbytes)
+        for leaf in __import__("jax").tree_util.tree_leaves(b2.device)
+    )
+
+
+def test_no_donation_warnings_over_pipelined_run():
+    """Buffer donation is wired only where outputs alias their inputs; an
+    unusable donation draws a UserWarning from JAX — assert a full
+    pipelined run (including a preemption attempt) emits none."""
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        client = FakeClient()
+        s, _ = make_sched(client, profile=C.Profile(), pipeline=True,
+                          max_batch=4)
+        s.enable_preemption()
+        _cluster(s, 4)
+        pods = [W.pod_default(f"p-{j}", "ns") for j in range(12)]
+        # one low-priority squatter + an oversubscribed queue to tickle the
+        # preemption kernel too
+        _drive(s, client, pods, max_batch=4)
+        s.close()
+    donation = [
+        w for w in caught
+        if "donated" in str(w.message).lower()
+    ]
+    assert not donation, [str(w.message) for w in donation]
+
+
+# -------------------------------------------------------------- perf smoke
+
+@pytest.mark.parametrize("engine", ["greedy", "batched"])
+def test_perf_smoke_pipeline_regression_gate(engine):
+    """Cheap steady-state gate on both tentpole properties: after the
+    bucket-ladder prewarm, (a) steady-state cycles trigger ZERO compile
+    misses of the assign program and (b) per-cycle transfer bytes stay
+    strictly below the full-batch bytes (delta uploads engaged)."""
+    from kubetpu.perf.runner import run_workload
+    from kubetpu.perf.workloads import Workload
+
+    r = run_workload(
+        "SchedulingBasic",
+        Workload("smoke", {"initNodes": 30, "initPods": 20,
+                           "measurePods": 200}),
+        timeout_s=180, max_batch=64, engine=engine, pipeline=True,
+    )
+    assert r.scheduled == 200
+    assert r.compile_misses == 0, (
+        f"{r.compile_misses} compile misses after prewarm"
+    )
+    assert r.transfer_bytes_per_cycle is not None
+    assert r.transfer_bytes_per_cycle < r.batch_bytes_per_cycle
+    assert r.resident_bytes > 0
