@@ -56,6 +56,16 @@ STAGES = [
     ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, False),
     ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False),
     ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024, False),
+    # the r05-comparable fullstack rows (the encode-cache acceptance is
+    # judged against r05's 500-node fallback numbers: 503.7 and 279.9)
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False),
+    # the encode-cache win measured beyond the 2 classic fullstack rows:
+    # spreading through the stack, and recreate-churn driving the
+    # informer→invalidate→re-encode path end to end
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "fullstack", 1024, False),
+    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False),
+    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "direct", 1024, False),
     ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024, False),
     ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, True),
     ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, False),
@@ -79,8 +89,15 @@ def _backend() -> str:
         return "unknown"
 
 
+# backend-probe outcome, stamped into EVERY emitted record: two rounds of
+# TPU evidence were lost because the probe verdict lived in a stderr line
+# the driver's tail truncated — the JSON itself must say why a fallback
+# happened (VERDICT r05 weak #1)
+PROBE: dict = {}
+
+
 def _emit(line: dict) -> None:
-    print(json.dumps(line), flush=True)
+    print(json.dumps({**line, **PROBE}), flush=True)
 
 
 def run_stage(
@@ -147,6 +164,14 @@ def run_stage(
         out["resident_bytes"] = r.resident_bytes
     if r.pipeline_replays:
         out["pipeline_replays"] = r.pipeline_replays
+    # host-encode evidence: per-cycle encode wall, its share of the cycle
+    # (tentpole target ≤ 0.40; r05 fullstack trace showed 0.86), hit rate
+    if r.encode_ms_per_cycle is not None:
+        out["encode_ms_per_cycle"] = round(r.encode_ms_per_cycle, 2)
+    if r.encode_wall_frac is not None:
+        out["encode_wall_frac"] = round(r.encode_wall_frac, 3)
+    if r.encode_cache_hit_rate is not None:
+        out["encode_cache_hit_rate"] = round(r.encode_cache_hit_rate, 4)
     if r.threshold_note:
         out["threshold_note"] = r.threshold_note
     if r.p99_attempt_latency_ms is not None:
@@ -161,22 +186,24 @@ def run_stage(
     return out
 
 
-def _probe_backend(timeout_s: float = 180.0) -> str:
+def _probe_backend(timeout_s: float = 180.0) -> tuple[str, float]:
     """Probe backend init in a SUBPROCESS. If the TPU relay is down, init
     hangs forever in make_c_api_client — and a hung in-process probe thread
     would hold jax's backend-init lock, deadlocking the CPU fallback too.
-    Returns "ok", "timeout", or "error"."""
+    Returns ("ok" | "timeout" | "error", probe seconds)."""
     import subprocess
     import sys as _sys
 
+    t0 = time.perf_counter()
     try:
         p = subprocess.run(
             [_sys.executable, "-c", "import jax; jax.devices()"],
             capture_output=True, timeout=timeout_s,
         )
-        return "ok" if p.returncode == 0 else "error"
+        return ("ok" if p.returncode == 0 else "error",
+                time.perf_counter() - t0)
     except subprocess.TimeoutExpired:
-        return "timeout"
+        return "timeout", time.perf_counter() - t0
 
 
 CPU_FALLBACK_STAGES = [
@@ -192,6 +219,11 @@ CPU_FALLBACK_STAGES = [
     ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, False),
     ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False),
     ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False),
+    # encode-cache acceptance rows: spreading through the stack + recreate
+    # churn (informer→invalidate→re-encode) in both modes
+    ("TopologySpreading", "500Nodes", "greedy", "fullstack", 128, False),
+    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "fullstack", 128, False),
+    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "direct", 128, False),
     ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True),
     ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False),
 ]
@@ -241,7 +273,10 @@ def _emit_pipeline_comparisons(done: dict) -> None:
 
 def main() -> None:
     global STAGES
-    if _probe_backend() != "ok":
+    probe, probe_s = _probe_backend()
+    PROBE["backend_probe"] = probe
+    PROBE["backend_probe_s"] = round(probe_s, 1)
+    if probe != "ok":
         # TPU backend unusable (relay hang OR fast init error): pin CPU
         # in-process (the site hook's jax_platforms clobber would otherwise
         # dial the relay on the first device op) and run reduced-shape

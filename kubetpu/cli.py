@@ -247,6 +247,7 @@ def cmd_scheduler(args) -> int:
     sched = Scheduler(
         StoreClient(store), cfg=cfg, engine=args.engine,
         pipeline=(args.pipeline == "on"),
+        encode_cache=(args.encode_cache == "on"),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
@@ -559,6 +560,12 @@ def build_parser() -> argparse.ArgumentParser:
                            "resident node block and dirty-row delta "
                            "uploads; assignments stay pod-for-pod "
                            "identical to the serial loop ('off' is the "
+                           "debugging escape hatch)")
+    schd.add_argument("--encode-cache", default="on", choices=["on", "off"],
+                      help="event-time template-keyed pod encoding: static "
+                           "tensor rows built at informer delivery and "
+                           "gathered at cycle time; cached encodes are "
+                           "bit-identical to fresh ones ('off' is the "
                            "debugging escape hatch)")
     schd.add_argument("--prewarm", action="store_true",
                       help="compile the assign program for the full "

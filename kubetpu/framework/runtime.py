@@ -357,10 +357,12 @@ def _image_tensors(
     P = len(pods)
     PP = max(pad_pods or P, P)
     total = max(N, 1)
+    if not any(p.images for p in pods):
+        # no image anywhere → the raw score is identically zero; skip the
+        # three device leaves entirely (feasible_and_scores None-guards)
+        return None, None, None
     counts = np.zeros(PP, dtype=np.int32)
     sig = np.zeros(PP, dtype=np.int32)
-    if not any(p.images for p in pods):
-        return np.zeros((1, NC), dtype=np.int64), sig, counts
     node_images: list[dict[str, t.ImageState]] = [
         dict(info.node.images) for info in nt.infos
     ]
@@ -433,6 +435,9 @@ class StaticBatch:
     # them from the current NodeInfos (the one-shot encode path keeps
     # pb.node_ports as-is — nothing ran in between)
     ports_stale: bool = False
+    # the EncodeCache (state.encode_cache) stage 1 encoded against; stage 2
+    # reuses its persistent affinity/spread term caches
+    cache: object | None = None
 
 
 def encode_batch(
@@ -444,6 +449,8 @@ def encode_batch(
     nominated: Sequence = (),
     prev_nt: "enc.NodeTensors | None" = None,
     resident: "ResidentNodeState | None" = None,
+    cache=None,
+    track_changes: bool = True,
 ) -> EncodedBatch:
     """Snapshot + pending pods → padded device batch.
 
@@ -458,10 +465,15 @@ def encode_batch(
 
     ``resident``: a ResidentNodeState — the node block is delta-uploaded
     into the device-resident buffers instead of shipped whole.
+
+    ``cache``: an ``encode_cache.EncodeCache`` — static pod rows become
+    gathers over template-keyed rows shared across pods and cycles (the
+    host-side O(Δ) twin of ``prev_nt``/``resident``).
     """
     sb = encode_batch_static(
         snapshot, pods, profile, pad=pad, resource_names=resource_names,
-        nominated=nominated, prev_nt=prev_nt,
+        nominated=nominated, prev_nt=prev_nt, cache=cache,
+        track_changes=track_changes,
     )
     return finalize_batch(sb, snapshot, nominated=nominated, resident=resident)
 
@@ -474,8 +486,12 @@ def encode_batch_static(
     resource_names: Sequence[str] | None = None,
     nominated: Sequence = (),
     prev_nt: "enc.NodeTensors | None" = None,
+    cache=None,
+    track_changes: bool = True,
 ) -> StaticBatch:
-    """Stage 1: the assume-independent host encode (see StaticBatch)."""
+    """Stage 1: the assume-independent host encode (see StaticBatch).
+    ``track_changes=False`` (serial loop) skips the pipeline-only
+    staleness diff in the incremental snapshot encode."""
     N, P = snapshot.num_nodes(), len(pods)
     NP = enc.round_up(N) if pad else N
     PP = enc.round_up(P) if pad else P
@@ -505,7 +521,7 @@ def encode_batch_static(
             resource_names = list(resource_names) + pool_names
     nt = enc.encode_snapshot(
         snapshot, resource_names=resource_names, pods=pods, pad_nodes=NP,
-        prev=prev_nt,
+        prev=prev_nt, track_changes=track_changes,
     )
     if dra_state is not None and dra_state.used_pools:
         dra_state.fill_node_columns(
@@ -547,6 +563,7 @@ def encode_batch_static(
         folded_resources=folded,
         folded_nominated=folded_nominated,
         dra_state=dra_state,
+        cache=cache,
     )
     # DRA prioritized-list score rows (per distinct host-spec set)
     dra_score_raw = dra_score_sig = None
@@ -624,6 +641,7 @@ def encode_batch_static(
         nominated_key=tuple(id(e) for e in nominated),
         assume_coupled=bool(folded) or dra_state is not None
         or vol_state is not None,
+        cache=cache,
     )
 
 
@@ -681,8 +699,32 @@ def finalize_batch(
     profile, pods, nt, pb = sb.profile, sb.pods, sb.nt, sb.pb
     N, P, PP = sb.num_nodes, sb.num_pods, sb.pad_pods
     NC = sb.pad_nodes
+    cache = sb.cache
+    if cache is not None:
+        # namespace labels feed affinity namespaceSelectors: a moved
+        # generation clears the cache's persistent match verdicts
+        cache.sync_namespaces(snapshot.namespaces_generation)
+    # template groups of the existing pods, shared by the spread and
+    # affinity encoders (one O(pods) pass, built only if either needs it)
+    _groups_memo: list = []
+
+    def groups_of():
+        if not _groups_memo:
+            from ..state.encode_cache import groups_for
+
+            _groups_memo.append(groups_for(nt, cache))
+        return _groups_memo[0]
+
     pa_dev = None
-    if sb.want_interpod:
+    # affinity-free cluster fast path: the cache maintains a count of
+    # assigned pods carrying any (anti)affinity, so a SchedulingBasic-shaped
+    # steady state skips the template-group pass AND the affinity encoder
+    # in O(pending) attribute checks
+    want_pa = sb.want_interpod and not (
+        snapshot.pods_with_affinity == 0
+        and not any(enc_podaffinity.has_any_affinity(p) for p in pods)
+    )
+    if want_pa:
         pa = enc_podaffinity.encode_pod_affinity(
             nt, pods,
             hard_pod_affinity_weight=(
@@ -690,19 +732,23 @@ def finalize_batch(
             ),
             pad_pods=PP,
             namespaces=snapshot.namespaces,
+            cache=cache,
+            groups=groups_of(),
         )
         if pa is not None:
+            # host numpy leaves — the single batched device_put below ships
+            # the whole pytree in one dispatch instead of ~30
             pa_dev = PodAffinityDevice(
-                node_domain=jnp.asarray(pa.node_domain),
-                has_key=jnp.asarray(pa.has_key),
-                base_sums=jnp.asarray(pa.base_sums),
-                update=jnp.asarray(pa.update),
-                fa_rows=jnp.asarray(pa.fa_rows),
-                fa_self=jnp.asarray(pa.fa_self),
-                ra_rows=jnp.asarray(pa.ra_rows),
-                ea_rows=jnp.asarray(pa.ea_rows),
-                score_rows=jnp.asarray(pa.score_rows),
-                score_vals=jnp.asarray(pa.score_vals),
+                node_domain=pa.node_domain,
+                has_key=pa.has_key,
+                base_sums=pa.base_sums,
+                update=pa.update,
+                fa_rows=pa.fa_rows,
+                fa_self=pa.fa_self,
+                ra_rows=pa.ra_rows,
+                ea_rows=pa.ea_rows,
+                score_rows=pa.score_rows,
+                score_vals=pa.score_vals,
                 has_filter_work=pa.has_filter_work,
                 has_score_work=pa.has_score_work,
             )
@@ -718,23 +764,27 @@ def finalize_batch(
                 enc_spread.default_selector_from_services(snapshot)
                 if defaults and snapshot.services else None
             ),
+            cache=cache,
+            # reuse the affinity encoder's group pass when it ran; spread
+            # builds its own only past its cheap no-constraints early-out
+            groups=_groups_memo[0] if _groups_memo else None,
         )
         if sp is not None:
             spread_dev = SpreadDevice(
-                eligible=jnp.asarray(sp.eligible),
-                node_domain=jnp.asarray(sp.node_domain),
-                node_count=jnp.asarray(sp.node_count),
-                has_key=jnp.asarray(sp.has_key),
-                domain_present=jnp.asarray(sp.domain_present),
-                num_domains=jnp.asarray(sp.num_domains),
-                is_hostname=jnp.asarray(sp.is_hostname),
-                sig_idx=jnp.asarray(sp.sig_idx),
-                action=jnp.asarray(sp.action),
-                max_skew=jnp.asarray(sp.max_skew),
-                min_domains=jnp.asarray(sp.min_domains),
-                self_match=jnp.asarray(sp.self_match),
-                pod_match_sig=jnp.asarray(sp.pod_match_sig),
-                ignored=jnp.asarray(sp.ignored),
+                eligible=sp.eligible,
+                node_domain=sp.node_domain,
+                node_count=sp.node_count,
+                has_key=sp.has_key,
+                domain_present=sp.domain_present,
+                num_domains=sp.num_domains,
+                is_hostname=sp.is_hostname,
+                sig_idx=sp.sig_idx,
+                action=sp.action,
+                max_skew=sp.max_skew,
+                min_domains=sp.min_domains,
+                self_match=sp.self_match,
+                pod_match_sig=sp.pod_match_sig,
+                ignored=sp.ignored,
                 has_hard=sp.has_hard,
                 has_soft=sp.has_soft,
             )
@@ -784,66 +834,63 @@ def finalize_batch(
         resident_bytes = resident.nbytes
     else:
         nodes_block = DeviceNodeState(
-            alloc=jnp.asarray(nt.alloc),
-            requested=jnp.asarray(nt.requested),
-            nonzero_requested=jnp.asarray(nt.nonzero_requested),
-            pod_count=jnp.asarray(nt.pod_count),
-            allowed_pods=jnp.asarray(nt.allowed_pods),
-            node_valid=jnp.asarray(node_valid),
+            alloc=nt.alloc,
+            requested=nt.requested,
+            nonzero_requested=nt.nonzero_requested,
+            pod_count=nt.pod_count,
+            allowed_pods=nt.allowed_pods,
+            node_valid=node_valid,
         )
         node_upload = _node_block_nbytes(nodes_block)
         resident_bytes = 0
 
+    # host numpy leaves throughout; ONE batched device_put ships the whole
+    # pytree (leaf-by-leaf jnp.asarray was ~30 separate dispatches per
+    # cycle). Resident-path node buffers are already on device — device_put
+    # passes them through untouched.
     dev = DeviceBatch(
         nodes=nodes_block,
-        requests=jnp.asarray(pb.requests),
-        nonzero_requests=jnp.asarray(pb.nonzero_requests),
-        pod_valid=jnp.asarray(pod_valid),
-        static_mask=(
-            jnp.asarray(pb.static_mask) if pb.static_mask is not None else None
-        ),
+        requests=pb.requests,
+        nonzero_requests=pb.nonzero_requests,
+        pod_valid=pod_valid,
+        static_mask=pb.static_mask,
         static_sig=(
-            jnp.asarray(pb.static_sig) if pb.static_mask is not None else None
+            pb.static_sig if pb.static_mask is not None else None
         ),
         node_affinity_raw=(
-            jnp.asarray(pb.node_affinity_raw)
+            pb.node_affinity_raw
             if sb.want_na and pb.node_affinity_raw is not None else None
         ),
         taint_prefer_raw=(
-            jnp.asarray(pb.taint_prefer_raw)
+            pb.taint_prefer_raw
             if sb.want_tt and pb.taint_prefer_raw is not None else None
         ),
         score_sig=(
-            jnp.asarray(pb.score_sig)
+            pb.score_sig
             if pb.score_sig is not None
             and ((sb.want_na and pb.node_affinity_raw is not None)
                  or (sb.want_tt and pb.taint_prefer_raw is not None))
             else None
         ),
-        image_sum_scores=jnp.asarray(img_sums) if sb.want_img else None,
-        image_sig=jnp.asarray(img_sig) if sb.want_img else None,
-        image_count=jnp.asarray(img_counts) if sb.want_img else None,
-        pod_ports=jnp.asarray(pb.pod_ports),
-        node_ports=jnp.asarray(node_ports),
-        port_conflict=jnp.asarray(pb.port_conflict),
-        nominated_node=jnp.asarray(nom_node) if nom_node is not None else None,
-        nominated_req=jnp.asarray(nom_req) if nom_req is not None else None,
-        nominated_gate=jnp.asarray(nom_gate) if nom_gate is not None else None,
-        nominated_ports=jnp.asarray(nom_ports) if nom_ports is not None else None,
-        nominated_pod_idx=(
-            jnp.asarray(nom_pod_idx) if nom_pod_idx is not None else None
-        ),
+        image_sum_scores=img_sums if sb.want_img else None,
+        image_sig=img_sig if sb.want_img else None,
+        image_count=img_counts if sb.want_img else None,
+        pod_ports=pb.pod_ports,
+        node_ports=node_ports,
+        port_conflict=pb.port_conflict,
+        nominated_node=nom_node,
+        nominated_req=nom_req,
+        nominated_gate=nom_gate,
+        nominated_ports=nom_ports,
+        nominated_pod_idx=nom_pod_idx,
         spread=spread_dev,
         podaffinity=pa_dev,
-        dra_score_raw=(
-            jnp.asarray(sb.dra_score_raw)
-            if sb.dra_score_raw is not None else None
-        ),
+        dra_score_raw=sb.dra_score_raw,
         dra_score_sig=(
-            jnp.asarray(sb.dra_score_sig)
-            if sb.dra_score_raw is not None else None
+            sb.dra_score_sig if sb.dra_score_raw is not None else None
         ),
     )
+    dev = jax.device_put(dev)
     from ..metrics.tpu import batch_nbytes
 
     total_bytes = batch_nbytes(dev)

@@ -23,6 +23,11 @@ Metric set (labels ``engine`` = greedy | batched):
   state and per-cycle traffic as separate series
 - ``tpu_device_kernel_wall_seconds`` histogram — wall time of the device
   assignment program incl. the blocking fetch of its outputs
+- ``scheduler_encode_cache_hits_total`` / ``…_misses_total`` counters
+  (label ``kind`` = filter | score | request | pod_sig) and
+  ``scheduler_encode_cache_entries`` gauge — the template-keyed encode
+  cache (state.encode_cache): a high steady-state hit rate is what keeps
+  host encode off the cycle critical path
 """
 
 from __future__ import annotations
@@ -131,6 +136,22 @@ class TPUBackendMetrics:
             "including the blocking output fetch.",
             labels=("engine",),
             buckets=exponential_buckets(0.0001, 2, 18),
+        )
+        self.encode_cache_hits = r.counter(
+            "scheduler_encode_cache_hits_total",
+            "Static encode rows served from the template-keyed encode "
+            "cache (gathered, not rebuilt).",
+            labels=("kind",),
+        )
+        self.encode_cache_misses = r.counter(
+            "scheduler_encode_cache_misses_total",
+            "Static encode rows built fresh (first sight of a template, "
+            "or after a node-event invalidation).",
+            labels=("kind",),
+        )
+        self.encode_cache_entries = r.gauge(
+            "scheduler_encode_cache_entries",
+            "Entries resident in the encode cache (LRU-bounded).",
         )
         self.records: collections.deque[CycleRecord] = collections.deque(
             maxlen=max_records

@@ -28,6 +28,9 @@ def main(argv=None) -> None:
                     help="two-stage pipelined cycles with device-resident "
                          "node state + delta uploads (parity with the "
                          "serial loop is guaranteed; 'off' to debug)")
+    ap.add_argument("--encode-cache", default="on", choices=["on", "off"],
+                    help="event-time template-keyed pod encoding (bit-"
+                         "identical to fresh encode; 'off' to debug)")
     ap.add_argument("--artifacts-dir", default=None,
                     help="dump per-workload diagnosis artifacts here: the "
                          "cycle trace as Perfetto-loadable Chrome-trace "
@@ -46,6 +49,7 @@ def main(argv=None) -> None:
         max_batch=args.max_batch, timeout_s=args.timeout,
         engine=args.engine, artifacts_dir=args.artifacts_dir,
         pipeline=(args.pipeline == "on"),
+        encode_cache=(args.encode_cache == "on"),
     )
     if args.label:
         for r in run_label(args.label, **kwargs):

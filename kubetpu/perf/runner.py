@@ -56,6 +56,12 @@ class WorkloadResult:
     resident_bytes: int = 0
     compile_misses: int = 0
     pipeline_replays: int = 0
+    # host-encode view of the measured phase: encode-span wall per cycle,
+    # its share of the scheduling-cycle wall (the r05 trace showed 86% —
+    # the tentpole's target is ≤ 40%), and the encode-cache hit rate
+    encode_ms_per_cycle: float | None = None
+    encode_wall_frac: float | None = None
+    encode_cache_hit_rate: float | None = None
     # post-run metric snapshot (SchedulerMetricsRegistry.snapshot): p50/p99
     # from the histograms + schedule_attempts by result — every BENCH json
     # carries its own diagnosis
@@ -94,6 +100,12 @@ class WorkloadResult:
             out["resident_bytes"] = self.resident_bytes
         if self.pipeline_replays:
             out["pipeline_replays"] = self.pipeline_replays
+        if self.encode_ms_per_cycle is not None:
+            out["encode_ms_per_cycle"] = round(self.encode_ms_per_cycle, 2)
+        if self.encode_wall_frac is not None:
+            out["encode_wall_frac"] = round(self.encode_wall_frac, 3)
+        if self.encode_cache_hit_rate is not None:
+            out["encode_cache_hit_rate"] = round(self.encode_cache_hit_rate, 4)
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -181,11 +193,50 @@ def _begin_measured_phase(sched, warmup: bool, warm_pods):
     # PV/namespace creation — replays in-flight init cycles and must not
     # pollute the measured-phase evidence)
     sched._measure_replays0 = sched.metrics.pipeline_replays
+    # encode-cache hit/miss baseline: the init/warmup misses (first sight
+    # of every template) must not dilute the steady-state hit rate
+    if sched.encode_cache is not None:
+        kinds = ("filter", "score", "request")
+        sched._measure_cache0 = (
+            sum(sched.encode_cache.hits[k] for k in kinds),
+            sum(sched.encode_cache.misses[k] for k in kinds),
+        )
     return (
         sched.metrics.schedule_attempts,
         sched.metrics.cycles,
         sched.metrics.prom.snapshot_baseline(),
     )
+
+
+def _encode_stats(sched, cycles0: int) -> dict:
+    """Measured-phase host-encode summary from the cycle trace spans
+    (scoped by cycle id) + the encode-cache counters."""
+    out = dict(
+        encode_ms_per_cycle=None, encode_wall_frac=None,
+        encode_cache_hit_rate=None,
+    )
+    spans = sched.tracer.recent(1 << 30)
+    enc_s = [
+        s.duration_s for s in spans
+        if s.name == "encode" and s.attrs.get("cycle", 0) > cycles0
+    ]
+    cyc_s = [
+        s.duration_s for s in spans
+        if s.name == "scheduling-cycle" and s.attrs.get("cycle", 0) > cycles0
+    ]
+    if enc_s:
+        out["encode_ms_per_cycle"] = 1000.0 * sum(enc_s) / len(enc_s)
+    if enc_s and cyc_s and sum(cyc_s) > 0:
+        out["encode_wall_frac"] = sum(enc_s) / sum(cyc_s)
+    if sched.encode_cache is not None:
+        kinds = ("filter", "score", "request")
+        h = sum(sched.encode_cache.hits[k] for k in kinds)
+        m = sum(sched.encode_cache.misses[k] for k in kinds)
+        h0, m0 = getattr(sched, "_measure_cache0", (0, 0))
+        dh, dm = h - h0, m - m0
+        if dh + dm:
+            out["encode_cache_hit_rate"] = dh / (dh + dm)
+    return out
 
 
 def _device_traffic_stats(sched, cycles0: int, duration: float) -> dict:
@@ -257,6 +308,67 @@ class _Churn:
                 self.live.append(pod)
 
 
+@dataclass
+class _FsChurn:
+    """churnOp through the REST stack: interfering pods are created (and
+    in recreate mode deleted) via the remote store, so the scheduler sees
+    them through the informer seam — the informer→invalidate→re-encode
+    path end to end, exactly the reference's churn goroutine shape."""
+
+    op: W.ChurnOp
+    namespace: str
+    remote: object
+    next_at: float = 0.0
+    seq: int = 0
+    live: list = field(default_factory=list)   # recreate-mode pool (keys)
+
+    def maybe_fire(self, now: float) -> None:
+        from ..client.informers import PODS
+
+        while now >= self.next_at:
+            self.next_at = (self.next_at or now) + self.op.interval_ms / 1000.0
+            if self.op.mode == "recreate" and self.op.number and (
+                len(self.live) >= self.op.number
+            ):
+                victim = self.live.pop(0)
+                try:
+                    self.remote.delete(PODS, victim)
+                except Exception:
+                    pass   # already bound+mutated or gone — churn goes on
+            pod = self.op.template(f"churn-{self.seq}", self.namespace)
+            self.seq += 1
+            key = f"{self.namespace}/{pod.name}"
+            self.remote.create(PODS, key, pod)
+            if self.op.mode == "recreate":
+                self.live.append(key)
+
+
+@dataclass
+class _FsDeleter:
+    """deletePodsOp through the REST stack: drain a namespace's created
+    pods at ``per_second`` via remote deletes (each one becomes an
+    AssignedPodDelete informer event for the scheduler)."""
+
+    keys: list
+    per_second: int
+    remote: object
+    started_at: float = -1.0
+    deleted: int = 0
+
+    def maybe_fire(self, now: float) -> None:
+        from ..client.informers import PODS
+
+        if self.started_at < 0:
+            self.started_at = now
+        due = int((now - self.started_at) * self.per_second)
+        while self.deleted < min(due, len(self.keys)):
+            try:
+                self.remote.delete(PODS, self.keys[self.deleted])
+            except Exception:
+                pass
+            self.deleted += 1
+
+
 def run_workload(
     case: W.TestCase | str,
     workload: W.Workload | str,
@@ -268,6 +380,7 @@ def run_workload(
     warmup: bool = True,
     artifacts_dir: str | None = None,
     pipeline: bool = False,
+    encode_cache: bool = True,
 ) -> WorkloadResult:
     """Execute one (test case, workload) pair and return the measurement.
     ``engine`` selects the assignment engine ("greedy" scan or "batched"
@@ -281,7 +394,9 @@ def run_workload(
     runs the two-stage pipelined cycle with the device-resident node block
     (Scheduler(pipeline=True)). ``artifacts_dir`` dumps the run's
     Chrome-trace JSON, /metrics snapshot, and device-side cycle records
-    there (see ``dump_diagnosis_artifacts``)."""
+    there (see ``dump_diagnosis_artifacts``). ``encode_cache`` toggles the
+    event-time template-keyed encode cache (``--encode-cache off`` escape
+    hatch — cached and fresh encodes are bit-identical)."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -291,7 +406,7 @@ def run_workload(
     client = _Client()
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
-        engine=engine, pipeline=pipeline,
+        engine=engine, pipeline=pipeline, encode_cache=encode_cache,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     client.sched = sched
@@ -598,6 +713,7 @@ def run_workload(
         threshold=workload.threshold,
         threshold_note=workload.threshold_note,
         **traffic,
+        **_encode_stats(sched, cycles0),
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops
@@ -641,14 +757,18 @@ def run_workload_full_stack(
     warmup: bool = True,
     artifacts_dir: str | None = None,
     pipeline: bool = False,
+    encode_cache: bool = True,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
     the reference harness's shape (scheduler_perf boots a real apiserver
     and measures through it, test/integration/scheduler_perf/util.go:96).
-    Supports the simple op shapes (createNodes/createNamespaces/
-    createPods/barrier) — SchedulingBasic and the quadratic affinity/
-    spreading cases; richer ops raise.
+    Supports createNodes/createNamespaces/createPods/barrier PLUS churn
+    and pod-delete recycling (churnOp / deletePodsOp create and delete
+    through the REST store, so the informer→invalidate→re-encode path is
+    exercised end to end) — SchedulingBasic, the quadratic affinity/
+    spreading cases, and the churn workloads; richer ops (PV/DRA/gang)
+    still raise.
 
     The direct-vs-full-stack delta is the apiserver tax: run both modes on
     one workload to measure what the REST hop costs."""
@@ -665,6 +785,7 @@ def run_workload_full_stack(
     params = dict(workload.params)
     supported = (
         W.CreateNodesOp, W.CreateNamespacesOp, W.CreatePodsOp, W.BarrierOp,
+        W.ChurnOp, W.DeletePodsOp,
     )
     for op in case.ops:
         if not isinstance(op, supported):
@@ -692,7 +813,7 @@ def run_workload_full_stack(
     client = _CountingClient(remote)
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
-        engine=engine, pipeline=pipeline,
+        engine=engine, pipeline=pipeline, encode_cache=encode_cache,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     informers = SchedulerInformers(remote, sched)
@@ -703,6 +824,9 @@ def run_workload_full_stack(
     attempts0 = cycles0 = 0
     prom_base = None
     op_ns_counter = 0
+    churns: list[_FsChurn] = []
+    deleters: list[_FsDeleter] = []
+    created_keys_by_ns: dict[str, list[str]] = {}
 
     def settle(target: int, namespaces: tuple[str, ...]) -> tuple[int, float]:
         def bound_now() -> int:
@@ -717,6 +841,10 @@ def run_workload_full_stack(
             now = time.perf_counter()
             if now > deadline:
                 break
+            for ch in churns:
+                ch.maybe_fire(now)
+            for d in deleters:
+                d.maybe_fire(now)
             moved = informers.pump()
             res = sched.schedule_batch()
             sched.dispatcher.sync()
@@ -748,6 +876,15 @@ def run_workload_full_stack(
             elif isinstance(op, W.BarrierOp):
                 informers.pump()
                 sched.run_until_idle()
+            elif isinstance(op, W.ChurnOp):
+                churns.append(_FsChurn(
+                    op=op, namespace=f"churn-{len(churns)}", remote=remote,
+                ))
+            elif isinstance(op, W.DeletePodsOp):
+                deleters.append(_FsDeleter(
+                    keys=list(created_keys_by_ns.get(op.namespace, ())),
+                    per_second=op.per_second, remote=remote,
+                ))
             elif isinstance(op, W.CreatePodsOp):
                 count = params[op.count_param]
                 template = op.template or case.default_pod_template
@@ -767,7 +904,9 @@ def run_workload_full_stack(
                     )
                 for j in range(count):
                     pod = template(f"{prefix}-{ns}-{j}", ns)
-                    remote.create(PODS, f"{ns}/{pod.name}", pod)
+                    key = f"{ns}/{pod.name}"
+                    created_keys_by_ns.setdefault(ns, []).append(key)
+                    remote.create(PODS, key, pod)
                 if op.skip_wait:
                     continue
                 done, secs = settle(count, (ns,))
@@ -802,6 +941,7 @@ def run_workload_full_stack(
         threshold=workload.threshold,
         threshold_note=workload.threshold_note,
         **traffic,
+        **_encode_stats(sched, cycles0),
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops

@@ -876,7 +876,12 @@ _case(TestCase(
                      collect_metrics=True),
     ),
     workloads=(
-        Workload("1000Nodes", {"initNodes": 1000, "measurePods": 1000}),
+        Workload("1000Nodes", {"initNodes": 1000, "measurePods": 1000},
+                 threshold=710, threshold_note=(
+                     "5k floor kept verbatim: like SchedulingBasic, the "
+                     "per-pod cost of the linear churn workload is ~flat "
+                     "in node count, so the 1000-node throughput is >= "
+                     "the 5k floor")),
         Workload("5000Nodes_10000Pods",
                  {"initNodes": 5000, "measurePods": 10000},
                  threshold=710, labels=("performance",)),

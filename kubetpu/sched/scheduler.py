@@ -141,6 +141,7 @@ class Scheduler:
         feature_gates=None,
         recorder=None,
         pipeline: bool = False,
+        encode_cache: bool = True,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -161,7 +162,13 @@ class Scheduler:
         dispatch overlaps the next batch's host encode with the current
         batch's device program). Assignments are pod-for-pod identical to
         the serial loop — a cycle whose state changed under it is replayed
-        — so ``pipeline=False`` is purely a debugging escape hatch."""
+        — so ``pipeline=False`` is purely a debugging escape hatch.
+        ``encode_cache``: event-time incremental pod encoding — static
+        tensor rows are template-keyed, built when the informer delivers
+        the pod, and gathered (not rebuilt) at cycle time; node events
+        invalidate by epoch. Cached encodes are bit-identical to fresh
+        ones, so ``encode_cache=False`` is a debugging escape hatch like
+        ``pipeline=False``."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
@@ -218,6 +225,19 @@ class Scheduler:
         )
         self.dispatcher = APIDispatcher(client, workers=dispatcher_workers)
         self.metrics = SchedulerMetrics()
+        # event-time incremental pod encoding (state.encode_cache): static
+        # rows pre-built at informer delivery, template-shared across pods
+        # and cycles; None = rebuild-per-batch (the escape hatch)
+        if encode_cache:
+            from ..state.encode_cache import EncodeCache
+
+            self.encode_cache = EncodeCache(metrics=self.metrics.tpu)
+        else:
+            self.encode_cache = None
+        # per-profile (filter-set, score-set) frozensets for the per-event
+        # pre-encode hook (rebuilt-per-event frozensets were informer-path
+        # allocation churn)
+        self._prof_sets: dict[int, tuple] = {}
         from ..tracing import Tracer
 
         # cycle tracing (utiltrace analog): top-level span per profile
@@ -230,7 +250,12 @@ class Scheduler:
         self._prev_nt = None
         # --- pipeline state (see class docstring of _InflightCycle) ------
         self.pipeline = bool(pipeline)
-        self._resident = rt.ResidentNodeState() if self.pipeline else None
+        # the device-resident node block serves the SERIAL loop too (PR 2
+        # introduced it for pipeline mode): every cycle completes before
+        # the next encode's dirty-row scatter donates the old buffers, so
+        # the donation contract holds in both modes — steady-state
+        # host→device traffic is O(Δ·R) regardless of pipelining
+        self._resident = rt.ResidentNodeState()
         self._inflight: _InflightCycle | None = None
         # sticky: any host-state refresh between dispatch and sync that
         # found the cluster materially changed flips this; sync replays
@@ -354,6 +379,9 @@ class Scheduler:
 
     def on_node_add(self, node: t.Node) -> None:
         self.cache.add_node(node)
+        if self.encode_cache is not None:
+            # node labels/taints/features feed every cached static row
+            self.encode_cache.invalidate_nodes()
         self.queue.on_event(
             ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
         )
@@ -361,12 +389,16 @@ class Scheduler:
 
     def on_node_update(self, old: t.Node | None, new: t.Node) -> None:
         self.cache.update_node(new)
+        if self.encode_cache is not None:
+            self.encode_cache.invalidate_nodes()
         ev = node_update_event(old, new)
         if ev.action:
             self.queue.on_event(ev, old, new)
 
     def on_node_delete(self, node: t.Node) -> None:
         self.cache.remove_node(node.name)
+        if self.encode_cache is not None:
+            self.encode_cache.invalidate_nodes()
         self.queue.on_event(
             ClusterEvent(EventResource.NODE, ActionType.DELETE), node, None
         )
@@ -397,6 +429,7 @@ class Scheduler:
             self.podgroups.add_pod(info)
         else:
             self.queue.add(pod)
+            self._pre_encode_pod(pod)
 
     def on_pod_update(self, old: t.Pod | None, new: t.Pod) -> None:
         if not new.node_name and self._profile_for(new) is None:
@@ -434,9 +467,15 @@ class Scheduler:
             self.podgroups.update_pod(new)
         else:
             self.queue.update(old, new)
+            # a mutated pod hashes to NEW signature keys — pre-build its
+            # rows now; the per-uid signature memo is identity-checked, so
+            # the old object's entries can never answer for the new one
+            self._pre_encode_pod(new)
 
     def on_pod_delete(self, pod: t.Pod) -> None:
         self.nominator.remove(pod.uid)
+        if self.encode_cache is not None:
+            self.encode_cache.drop_pod(pod.uid)
         # a preemptor deleted while awaiting victim deletes must not leave a
         # stale pending-victims record for a later same-ns/name pod
         self._preempting.pop(pod_key(pod), None)
@@ -463,6 +502,33 @@ class Scheduler:
             self.podgroups.wake_all()   # freed capacity may fit a gang
         else:
             self.queue.delete(pod)
+
+    def _pre_encode_pod(self, pod: t.Pod) -> None:
+        """Event-time tensorization (the informer half of the encode
+        cache): build the pod's static rows while the delivery is being
+        handled — OFF the scheduling cycle's critical path — so cycle-time
+        ``encode_batch_static`` gathers instead of rebuilding. No-op when
+        the cache is off, no cycle has established node tensors yet, or a
+        node event invalidated them (the next cycle re-adopts)."""
+        cache = self.encode_cache
+        if cache is None or self._prev_nt is None:
+            return
+        prof = self._profile_for(pod)
+        if prof is None:
+            return
+        sets = self._prof_sets.get(id(prof))
+        if sets is None:
+            sets = (
+                frozenset(prof.filters.names()),
+                frozenset(prof.scores.names()),
+            )
+            self._prof_sets[id(prof)] = sets
+        try:
+            cache.precompute_pod(self._prev_nt, pod, sets[0], sets[1])
+        except Exception:
+            # pre-encoding is an optimization; the cycle-time encode is the
+            # correctness path and surfaces real bugs loudly
+            pass
 
     # ----------------------------------------------------- service informers
     def on_service_add(self, svc: t.Service) -> None:
@@ -648,6 +714,8 @@ class Scheduler:
                 nominated=self.nominator.entries(),
                 prev_nt=self._prev_nt,
                 resident=self._resident,
+                cache=self.encode_cache,
+                track_changes=self.pipeline,
             )
             self._prev_nt = batch.node_tensors
             params = rt.score_params(self.profile, batch.resource_names)
@@ -840,6 +908,7 @@ class Scheduler:
             sb = rt.encode_batch_static(
                 self._snapshot, pods, profile,
                 nominated=(), prev_nt=self._prev_nt,
+                cache=self.encode_cache,
             )
         except Exception:
             # stage 1 is an optimization: any failure falls back to the
@@ -944,7 +1013,7 @@ class Scheduler:
                 self._snapshot = self.cache.update_snapshot(self._snapshot)
             pods = [info.pod for info in batch_infos]
             t_enc = time.perf_counter()
-            with self.tracer.span("encode", cycle=cycle_id):
+            with self.tracer.span("encode", cycle=cycle_id) as enc_sp:
                 batch = None
                 if static is not None:
                     batch = self._finalize_static(static)
@@ -954,7 +1023,21 @@ class Scheduler:
                         nominated=self.nominator.entries(),
                         prev_nt=self._prev_nt,
                         resident=self._resident,
+                        cache=self.encode_cache,
+                        track_changes=self.pipeline,
                     )
+                if self.encode_cache is not None and enc_sp is not None:
+                    # gather-vs-fresh-vs-invalidate: how this cycle's rows
+                    # were obtained, joined to the device counters by cycle
+                    delta = self.encode_cache.flush_metrics()
+                    enc_sp.attrs["gather_rows"] = delta.get("hits", 0)
+                    enc_sp.attrs["fresh_rows"] = delta.get("misses", 0)
+                    if delta.get("invalidations"):
+                        enc_sp.attrs["invalidated"] = True
+                        self.tracer.instant(
+                            "encode-cache-invalidate", cycle=cycle_id,
+                            count=delta["invalidations"],
+                        )
             # the host encode builds per-pod state ahead of filtering —
             # the PreFilter role in the reference's extension-point map
             prom.framework_extension_point_duration.labels(

@@ -45,6 +45,9 @@ from .vocab import Vocab
 
 BASE_RESOURCES = (t.CPU, t.MEMORY, t.EPHEMERAL_STORAGE)
 
+# the default static-score plugin set (profile=None callers)
+DEFAULT_SCORES = frozenset({names.NODE_AFFINITY, names.TAINT_TOLERATION})
+
 _UNSCHEDULABLE_TAINT = t.Taint(
     key="node.kubernetes.io/unschedulable", effect=t.TaintEffect.NO_SCHEDULE
 )
@@ -295,11 +298,12 @@ def _pod_content_sig(info: NodeInfo) -> int:
     tensors OUTSIDE the resource rows: uids (membership), labels (affinity/
     spread selectors) and ports (NodePorts). Resource changes are covered by
     the row-value diff; this catches a label or hostPort mutation on an
-    otherwise resource-identical pod."""
-    return hash(tuple(sorted(
-        ((uid, p.labels, p.ports) for uid, p in info.pods.items()),
-        key=lambda x: x[0],
-    )))
+    otherwise resource-identical pod. XOR-combined so no sort is needed —
+    the per-dirty-row cost is O(pods on the node) hashes flat."""
+    h = 0
+    for uid, p in info.pods.items():
+        h ^= hash((uid, p.labels, p.ports))
+    return h
 
 
 def encode_snapshot(
@@ -307,6 +311,7 @@ def encode_snapshot(
     pods: Sequence[t.Pod] = (),
     pad_nodes: int | None = None,
     prev: NodeTensors | None = None,
+    track_changes: bool = True,
 ) -> NodeTensors:
     """``pad_nodes``: allocate node-axis arrays at this capacity up front
     (rows past the real node count stay zero = infeasible), avoiding a
@@ -317,7 +322,13 @@ def encode_snapshot(
     whose cache generation moved are re-encoded (cache.go:190 UpdateSnapshot
     O(Δ) semantics on the tensor side). The returned object may BE ``prev``,
     mutated in place; device uploads copy, so this is safe once the previous
-    cycle's arrays are on device."""
+    cycle's arrays are on device.
+
+    ``track_changes``: maintain the value-diff / pod-content-signature
+    staleness flags (``last_values_changed`` / ``last_pods_mutated``) the
+    PIPELINED scheduler consumes. The serial loop never reads them — False
+    skips the per-dirty-row copies, comparisons and content hashing, and
+    sets the flags conservatively True whenever any row was dirty."""
     rnames = list(resource_names) if resource_names else resource_axis(snapshot, pods)
     infos = snapshot.node_infos()
     N, R = len(infos), len(rnames)
@@ -343,17 +354,20 @@ def encode_snapshot(
             if gens.get(name) == gen:
                 continue
             dirty.append(i)
-            psig = _pod_content_sig(info)
-            if prev.pod_content_sigs.get(name) != psig:
-                pods_mutated = True
-                prev.pod_content_sigs[name] = psig
-            old_row = (
-                prev.alloc[i].copy(), prev.requested[i].copy(),
-                prev.nonzero_requested[i].copy(),
-                int(prev.pod_count[i]), int(prev.allowed_pods[i]),
-            )
+            old_row = None
+            if track_changes:
+                psig = _pod_content_sig(info)
+                if prev.pod_content_sigs.get(name) != psig:
+                    pods_mutated = True
+                    prev.pod_content_sigs[name] = psig
+                if not values_changed:
+                    old_row = (
+                        prev.alloc[i].copy(), prev.requested[i].copy(),
+                        prev.nonzero_requested[i].copy(),
+                        int(prev.pod_count[i]), int(prev.allowed_pods[i]),
+                    )
             _encode_node_row(prev, i, info, ridx)
-            if not values_changed and not (
+            if old_row is not None and not (
                 int(prev.pod_count[i]) == old_row[3]
                 and int(prev.allowed_pods[i]) == old_row[4]
                 and np.array_equal(prev.alloc[i], old_row[0])
@@ -380,6 +394,11 @@ def encode_snapshot(
             gens[name] = gen
         prev.infos = infos
         prev.last_dirty_rows = tuple(dirty)
+        if not track_changes and dirty:
+            # flags not maintained: report "changed" so a consumer that
+            # does read them errs toward a replay, never toward staleness
+            values_changed = True
+            pods_mutated = True
         prev.last_values_changed = values_changed
         prev.last_nodes_replaced = nodes_replaced
         prev.last_pods_mutated = pods_mutated
@@ -411,9 +430,10 @@ def encode_snapshot(
     )
     for i, info in enumerate(infos):
         _encode_node_row(nt, i, info, ridx)
-        # seed the content signatures so a post-rebuild bind confirmation
-        # (identical content) doesn't read as a pod mutation
-        nt.pod_content_sigs[info.node.name] = _pod_content_sig(info)
+        if track_changes:
+            # seed the content signatures so a post-rebuild bind
+            # confirmation (identical content) doesn't read as a mutation
+            nt.pod_content_sigs[info.node.name] = _pod_content_sig(info)
         for k, v in info.node.labels:
             key_vocab.intern(k)
             val_vocab.intern(v)
@@ -439,6 +459,125 @@ def _static_filter_signature(pod: t.Pod):
 def _static_score_signature(pod: t.Pod):
     na = pod.affinity.node_affinity if pod.affinity else None
     return (na.preferred if na else (), pod.tolerations)
+
+
+# --------------------------------------------------------------------------
+# Template-keyed row builders — pure functions of (node static facts, pod
+# signature), shared by the batch encoder and the event-time encode cache
+# (state.encode_cache): one build per distinct TEMPLATE, gathered by every
+# pod stamped from it, across pods and across cycles.
+# --------------------------------------------------------------------------
+
+def build_request_row(
+    pod: t.Pod, ridx: dict, R: int, folded_resources: frozenset,
+    dense_items: Sequence[tuple[int, int]] = (),
+) -> tuple[np.ndarray, np.ndarray, bool]:
+    """``(requests (R,), nonzero (R,), unknown)`` on the given resource
+    axis. ``unknown``: the pod requests a resource absent from the axis
+    (and not folded) — statically infeasible everywhere."""
+    req_row = np.zeros(R, dtype=np.int64)
+    nz_row = np.zeros(R, dtype=np.int64)
+    unknown = False
+    for k, v in pod.requests:
+        j = ridx.get(k)
+        if j is not None:
+            req_row[j] = v
+        elif v > 0 and k != t.PODS and k not in folded_resources:
+            unknown = True
+    for k, v in pod.nonzero_requests().items():
+        j = ridx.get(k)
+        if j is not None:
+            nz_row[j] = v
+    for pid, count in dense_items:
+        j = ridx.get(f"dra/pool{pid}")
+        if j is not None:
+            req_row[j] = count
+            nz_row[j] = count
+    return req_row, nz_row, unknown
+
+
+def build_static_filter_row(
+    nt: "NodeTensors", ctx, pod: t.Pod, f: frozenset,
+    feat_req: tuple, unknown: bool,
+) -> np.ndarray:
+    """The PURE-STATIC (N,) feasibility row for a pod signature: node
+    selector + required node affinity, taints, unschedulable, declared
+    features, spec.nodeName, unknown-resource. Batch-coupled parts
+    (volumes, DRA, folded scalars, in-batch RWOP) are layered onto a COPY
+    by the batch encoder — they never enter the cached row. ``ctx`` is an
+    ``encode_cache.NodeCtx`` (taint/unschedulable/feature hoists)."""
+    N = nt.num_nodes
+    m = np.ones(N, dtype=bool)
+    if names.NODE_AFFINITY in f:
+        # spec.nodeSelector — ANDed equality terms (NodeAffinity Filter)
+        for k, v in pod.node_selector:
+            m &= nt.requirement_mask(t.Requirement(k, t.Operator.IN, (v,)))
+        # required node affinity
+        na = pod.affinity.node_affinity if pod.affinity else None
+        if na and na.required is not None:
+            m &= nt.node_selector_mask(na.required)
+    if names.TAINT_TOLERATION in f and ctx.tainted_nodes:
+        # taints (NoSchedule/NoExecute) — dedupe by node taint tuple
+        taint_ok: dict[tuple, bool] = {}
+        for n_i, taints in ctx.tainted_nodes:
+            ok = taint_ok.get(taints)
+            if ok is None:
+                ok = find_untolerated_taint(taints, pod.tolerations) is None
+                taint_ok[taints] = ok
+            if not ok:
+                m[n_i] = False
+    if names.NODE_UNSCHEDULABLE in f and ctx.any_unsched:
+        # unschedulable nodes pass only if the pod tolerates the taint
+        tolerated = any(
+            tolerates(tol, _UNSCHEDULABLE_TAINT) for tol in pod.tolerations
+        )
+        if not tolerated:
+            m &= ~ctx.node_unsched
+    if feat_req:
+        # NodeDeclaredFeatures Filter (nodedeclaredfeatures.go:
+        # reqs ⊆ node.status.declaredFeatures, failures
+        # UnschedulableAndUnresolvable)
+        want = set(feat_req)
+        if ctx.node_feature_sets is None:
+            m[:] = False   # no node declares anything
+        else:
+            m &= np.array(
+                [want <= s for s in ctx.node_feature_sets], dtype=bool
+            )
+    # NodeName (spec.nodeName pre-assignment) — exact match only
+    if pod.node_name and names.NODE_NAME in f:
+        m &= np.array(
+            [n == pod.node_name for n in nt.node_names], dtype=bool
+        )
+    if unknown:
+        m[:] = False
+    return m
+
+
+def build_static_score_rows(
+    nt: "NodeTensors", ctx, pod: t.Pod, want_na: bool, want_tt: bool,
+) -> tuple[np.ndarray, np.ndarray]:
+    """``(node_affinity_raw (N,), taint_prefer_raw (N,))`` for a static
+    score signature."""
+    N = nt.num_nodes
+    na_vec = np.zeros(N, dtype=np.int64)
+    na = pod.affinity.node_affinity if pod.affinity else None
+    if na and want_na:
+        for pref in na.preferred:
+            tm = nt.term_mask(pref.term)
+            na_vec += pref.weight * tm.astype(np.int64)
+    tt_vec = np.zeros(N, dtype=np.int64)
+    if want_tt and ctx.tainted_nodes:
+        prefer_cache: dict[tuple, int] = {}
+        for n_i, taints in ctx.tainted_nodes:
+            c = prefer_cache.get(taints)
+            if c is None:
+                c = count_intolerable_prefer_no_schedule(
+                    taints, pod.tolerations
+                )
+                prefer_cache[taints] = c
+            tt_vec[n_i] = c
+    return na_vec, tt_vec
 
 
 @dataclass
@@ -522,34 +661,48 @@ def _encode_ports(
     callers can build their own rows against it."""
     vocab = Vocab()
     P, N = len(pods), nt.num_nodes
-    pod_rows: list[list[int]] = []
-    for p in pods:
-        pod_rows.append(vocab.intern_all(_pod_port_triples(p)))
-    node_rows: list[list[int]] = []
-    for info in nt.infos:
-        # NodeInfo refcounts its in-use triples incrementally (UsedPorts),
-        # so this is O(triples), not O(pods on the node)
-        node_rows.append(
-            sorted(vocab.intern(tr) for tr in info.port_triples)
-        )
+    pod_rows: list[tuple[int, list[int]]] = []
+    for i, p in enumerate(pods):
+        if p.ports:
+            row = vocab.intern_all(_pod_port_triples(p))
+            if row:
+                pod_rows.append((i, row))
+    # NodeInfo refcounts its in-use triples incrementally (UsedPorts), so
+    # this is O(nodes-with-ports × triples): port-free nodes (the perf
+    # workloads' steady state) cost one truthiness check
+    node_rows: list[tuple[int, list[int]]] = []
+    for i, info in enumerate(nt.infos):
+        if info.port_triples:
+            node_rows.append(
+                (i, [vocab.intern(tr) for tr in info.port_triples])
+            )
     for tr in extra_triples:
         vocab.intern(tr)
 
     K = max(len(vocab), 1)
     pod_ports = np.zeros((max(pad_pods or P, P), K), dtype=bool)
     node_ports = np.zeros((max(pad_nodes or N, N), K), dtype=bool)
-    for i, row in enumerate(pod_rows):
+    for i, row in pod_rows:
         pod_ports[i, row] = True
-    for i, row in enumerate(node_rows):
+    for i, row in node_rows:
         node_ports[i, row] = True
     conflict = np.zeros((K, K), dtype=bool)
-    items = [(vocab.lookup(k), k) for k in range(len(vocab))]
-    for (pa, ra, ia), ka in items:
-        for (pb, rb, ib), kb in items:
-            if pa == pb and ra == rb and (
-                ia == "0.0.0.0" or ib == "0.0.0.0" or ia == ib
-            ):
-                conflict[ka, kb] = True
+    if len(vocab):
+        # vectorized triple-vs-triple conflict: same port+protocol, and
+        # equal hostIP or either side the 0.0.0.0 wildcard
+        items = [vocab.lookup(k) for k in range(len(vocab))]
+        port_a = np.array([p_ for p_, _, _ in items])
+        proto_a = np.array([r_ for _, r_, _ in items])
+        ip_a = np.array([i_ for _, _, i_ in items])
+        same = (port_a[:, None] == port_a[None, :]) & (
+            proto_a[:, None] == proto_a[None, :]
+        )
+        wild = (
+            (ip_a[:, None] == "0.0.0.0")
+            | (ip_a[None, :] == "0.0.0.0")
+            | (ip_a[:, None] == ip_a[None, :])
+        )
+        conflict[: len(items), : len(items)] = same & wild
     return pod_ports, node_ports, conflict, vocab
 
 
@@ -564,6 +717,7 @@ def encode_pod_batch(
     folded_resources: frozenset = frozenset(),
     folded_nominated: Sequence[tuple[str, Sequence[tuple[str, int]]]] = (),
     dra_state=None,
+    cache=None,
 ) -> PodBatch:
     """``enabled_filters`` is the profile's Filter plugin set (names from
     ``kubetpu.names``); None enables everything. Disabled static predicates
@@ -574,16 +728,33 @@ def encode_pod_batch(
     ``pad_pods``: allocate pod-axis arrays at this capacity (rows past the
     real pod count stay zero / all-False-mask = never assigned). The node
     axis inherits ``nt``'s capacity. Avoids ``np.pad`` copies downstream.
+
+    ``cache``: an ``encode_cache.EncodeCache`` — static filter/score/request
+    rows become gathers over template-keyed rows that persist across pods
+    AND cycles (pre-built at informer delivery when the scheduler wires the
+    event-time hooks). None = the original build-per-batch behavior; the
+    per-batch signature dedupe below is retained either way, so cached and
+    fresh encodes are bit-identical by construction.
     """
     f = names.ALL_FILTERS if enabled_filters is None else enabled_filters
-    sc = (
-        frozenset({names.NODE_AFFINITY, names.TAINT_TOLERATION})
-        if enabled_scores is None else enabled_scores
-    )
+    sc = DEFAULT_SCORES if enabled_scores is None else enabled_scores
     ridx = {r: i for i, r in enumerate(nt.resource_names)}
     P, N, R = len(pods), nt.num_nodes, nt.num_resources
     PP = max(pad_pods or P, P)
     NC = nt.alloc.shape[0]  # node capacity (≥ N)
+    if cache is not None:
+        cache.sync_nodes(nt)
+        cache.sync_request_axis(tuple(nt.resource_names), folded_resources)
+        ctx = cache.node_ctx(nt)
+        sigs = [cache.pod_sigs(p) for p in pods]
+    else:
+        from .encode_cache import build_node_ctx
+
+        ctx = build_node_ctx(nt)
+        sigs = [
+            (_static_filter_signature(p), _static_score_signature(p))
+            for p in pods
+        ]
     requests = np.zeros((PP, R), dtype=np.int64)
     nonzero = np.zeros((PP, R), dtype=np.int64)
     priority = np.zeros(PP, dtype=np.int32)
@@ -602,7 +773,9 @@ def encode_pod_batch(
             if d.any_work:
                 dra_of[i] = d
     # Request rows dedupe heavily across a batch (replicated workloads) —
-    # build each distinct (requests, nonzero) row once.
+    # build each distinct (requests, nonzero) row once per batch, and per
+    # TEMPLATE across cycles when the encode cache is on (DRA-coupled rows
+    # depend on the allocator state and stay per-batch).
     row_cache: dict[tuple, tuple[np.ndarray, np.ndarray, bool]] = {}
     for i, p in enumerate(pods):
         d = dra_of.get(i)
@@ -610,25 +783,17 @@ def encode_pod_batch(
         key = (p.requests, p.nonzero, dense_items)
         entry = row_cache.get(key)
         if entry is None:
-            req_row = np.zeros(R, dtype=np.int64)
-            nz_row = np.zeros(R, dtype=np.int64)
-            unknown = False
-            for k, v in p.requests:
-                j = ridx.get(k)
-                if j is not None:
-                    req_row[j] = v
-                elif v > 0 and k != t.PODS and k not in folded_resources:
-                    unknown = True
-            for k, v in p.nonzero_requests().items():
-                j = ridx.get(k)
-                if j is not None:
-                    nz_row[j] = v
-            for pid, count in dense_items:
-                j = ridx.get(f"dra/pool{pid}")
-                if j is not None:
-                    req_row[j] = count
-                    nz_row[j] = count
-            entry = (req_row, nz_row, unknown)
+            if cache is not None and not dense_items:
+                entry = cache.request_row(
+                    key,
+                    lambda p=p: build_request_row(
+                        p, ridx, R, folded_resources, ()
+                    ),
+                )
+            else:
+                entry = build_request_row(
+                    p, ridx, R, folded_resources, dense_items
+                )
             row_cache[key] = entry
         requests[i], nonzero[i], unknown_resource[i] = entry
         priority[i] = p.priority
@@ -636,22 +801,9 @@ def encode_pod_batch(
     # distinct static-filter signatures → one (N,) mask ROW each; pods carry
     # the row index. Pod-specific deviations (spec.nodeName, unknown
     # resources) fold into the signature key so a row is a pure function of
-    # its key.
-    node_taints = [info.node.taints for info in nt.infos]
-    # only tainted nodes participate in the per-signature taint loop — a
-    # taint-free cluster (the scheduler_perf default) pays nothing per sig
-    tainted_nodes = [
-        (n_i, taints) for n_i, taints in enumerate(node_taints) if taints
-    ]
-    node_unsched = np.array(
-        [info.node.unschedulable for info in nt.infos], dtype=bool
-    )
-    # hoisted once per batch (not per signature): declared-feature sets
-    # participate only when some node declares any
-    node_feature_sets = (
-        [set(info.node.declared_features) for info in nt.infos]
-        if any(info.node.declared_features for info in nt.infos) else None
-    )
+    # its key. The PURE-STATIC part of the row (build_static_filter_row) is
+    # cacheable across cycles; batch-coupled extras (volumes, DRA, folded
+    # scalars, in-batch RWOP) are layered onto a copy.
     sig_ids: dict = {}
     sig_rows: list[np.ndarray] = []
     sig_trivial: list[bool] = []
@@ -718,97 +870,73 @@ def encode_pod_batch(
             p.required_node_features
             if names.NODE_DECLARED_FEATURES in f else ()
         )
-        sig = (
-            _static_filter_signature(p),
+        # the cacheable half of the key: everything build_static_filter_row
+        # consumes (pure function of node static facts + these parts)
+        base_key = (
+            sigs[i][0],
             feat_req,
             p.node_name if names.NODE_NAME in f else "",
             bool(unknown_resource[i]) and names.NODE_RESOURCES_FIT in f,
-            vol_sig,
-            rwop_dup,
-            folded_items,
-            dra_sig,
+            f,
         )
+        sig = (base_key, vol_sig, rwop_dup, folded_items, dra_sig)
         sid = sig_ids.get(sig)
         if sid is None:
-            m = np.ones(N, dtype=bool)
-            if names.NODE_AFFINITY in f:
-                # spec.nodeSelector — ANDed equality terms (NodeAffinity Filter)
-                for k, v in p.node_selector:
-                    m &= nt.requirement_mask(
-                        t.Requirement(k, t.Operator.IN, (v,))
-                    )
-                # required node affinity
-                na = p.affinity.node_affinity if p.affinity else None
-                if na and na.required is not None:
-                    m &= nt.node_selector_mask(na.required)
-            if names.TAINT_TOLERATION in f and tainted_nodes:
-                # taints (NoSchedule/NoExecute) — dedupe by node taint tuple
-                taint_ok: dict[tuple, bool] = {}
-                for n_i, taints in tainted_nodes:
-                    ok = taint_ok.get(taints)
-                    if ok is None:
-                        ok = find_untolerated_taint(taints, p.tolerations) is None
-                        taint_ok[taints] = ok
-                    if not ok:
-                        m[n_i] = False
-            if names.NODE_UNSCHEDULABLE in f and node_unsched.any():
-                # unschedulable nodes pass only if the pod tolerates the taint
-                tolerated = any(
-                    tolerates(tol, _UNSCHEDULABLE_TAINT) for tol in p.tolerations
+            def build(p=p, base_key=base_key):
+                return build_static_filter_row(
+                    nt, ctx, p, f, base_key[1], base_key[3]
                 )
-                if not tolerated:
-                    m &= ~node_unsched
-            if feat_req:
-                # NodeDeclaredFeatures Filter (nodedeclaredfeatures.go:
-                # reqs ⊆ node.status.declaredFeatures, failures
-                # UnschedulableAndUnresolvable)
-                want = set(feat_req)
-                if node_feature_sets is None:
-                    m[:] = False   # no node declares anything
-                else:
-                    m &= np.array(
-                        [want <= s for s in node_feature_sets], dtype=bool
-                    )
-            # NodeName (spec.nodeName pre-assignment) — exact match only
-            if p.node_name and names.NODE_NAME in f:
-                m &= np.array(
-                    [n == p.node_name for n in nt.node_names], dtype=bool
-                )
-            if unknown_resource[i] and names.NODE_RESOURCES_FIT in f:
-                m[:] = False
-            if vol_sig is not None:
-                # the volume plugin family (zone/binding/restrictions/limits)
-                vm = volume_state.mask_for(p.namespace, p.volumes, nt, f)
-                if vm is not None:
-                    m &= vm
-            if rwop_dup:
-                m[:] = False
-            if dra_sig is not None:
-                # DynamicResources static contributions (dynamicresources.go
-                # Filter :734): blocked claims reject everywhere; an
-                # allocated claim pins to its node; host-path specs AND in
-                # the exact allocator's per-node feasibility
-                blocked_, pin_, host_specs_ = dra_sig
-                if blocked_:
+
+            if cache is not None:
+                base, base_trivial = cache.filter_row(base_key, build)
+            else:
+                base = build()
+                base_trivial = bool(base.all())
+            extras = (
+                vol_sig is not None or rwop_dup or dra_sig is not None
+                or (folded_items and names.NODE_RESOURCES_FIT in f)
+            )
+            if extras:
+                m = base.copy()
+                if vol_sig is not None:
+                    # the volume plugin family (zone/binding/restrictions/
+                    # limits)
+                    vm = volume_state.mask_for(p.namespace, p.volumes, nt, f)
+                    if vm is not None:
+                        m &= vm
+                if rwop_dup:
                     m[:] = False
-                else:
-                    if pin_:
-                        m &= np.array(
-                            [n == pin_ for n in nt.node_names], dtype=bool
-                        )
-                    for spec in host_specs_:
-                        m &= dra_state.spec_mask(spec, nt)
-            if folded_items and names.NODE_RESOURCES_FIT in f:
-                for k, v in folded_items:
-                    fm = np.zeros(N, dtype=bool)
-                    for n_i, avail in fold_avail.get(k, ()):
-                        if avail >= v:
-                            fm[n_i] = True
-                    m &= fm
+                if dra_sig is not None:
+                    # DynamicResources static contributions
+                    # (dynamicresources.go Filter :734): blocked claims
+                    # reject everywhere; an allocated claim pins to its
+                    # node; host-path specs AND in the exact allocator's
+                    # per-node feasibility
+                    blocked_, pin_, host_specs_ = dra_sig
+                    if blocked_:
+                        m[:] = False
+                    else:
+                        if pin_:
+                            m &= np.array(
+                                [n == pin_ for n in nt.node_names], dtype=bool
+                            )
+                        for spec in host_specs_:
+                            m &= dra_state.spec_mask(spec, nt)
+                if folded_items and names.NODE_RESOURCES_FIT in f:
+                    for k, v in folded_items:
+                        fm = np.zeros(N, dtype=bool)
+                        for n_i, avail in fold_avail.get(k, ()):
+                            if avail >= v:
+                                fm[n_i] = True
+                        m &= fm
+                trivial = bool(m.all())
+            else:
+                m = base
+                trivial = base_trivial
             sid = len(sig_rows)
             sig_ids[sig] = sid
             sig_rows.append(m)
-            sig_trivial.append(bool(m.all()))
+            sig_trivial.append(trivial)
         static_sig[i] = sid
         if not sig_trivial[sid]:
             any_nontrivial = True
@@ -830,31 +958,19 @@ def encode_pod_batch(
         score_rows: list[tuple[np.ndarray, np.ndarray]] = []
         score_sig = np.zeros(PP, dtype=np.int32)
         for i, p in enumerate(pods):
-            sig = _static_score_signature(p)
-            sid = score_ids.get(sig)
+            ssig = sigs[i][1]
+            sid = score_ids.get(ssig)
             if sid is None:
-                na_vec = np.zeros(N, dtype=np.int64)
-                na = p.affinity.node_affinity if p.affinity else None
-                if na and want_na:
-                    for pref in na.preferred:
-                        tm = nt.term_mask(pref.term)
-                        na_vec += pref.weight * tm.astype(np.int64)
-                tt_vec = np.zeros(N, dtype=np.int64)
-                if want_tt:
-                    prefer_cache: dict[tuple, int] = {}
-                    for n_i, taints in enumerate(node_taints):
-                        if not taints:
-                            continue
-                        c = prefer_cache.get(taints)
-                        if c is None:
-                            c = count_intolerable_prefer_no_schedule(
-                                taints, p.tolerations
-                            )
-                            prefer_cache[taints] = c
-                        tt_vec[n_i] = c
+                def build_sc(p=p):
+                    return build_static_score_rows(nt, ctx, p, want_na, want_tt)
+
+                if cache is not None:
+                    entry = cache.score_row((ssig, want_na, want_tt), build_sc)
+                else:
+                    entry = build_sc()
                 sid = len(score_rows)
-                score_ids[sig] = sid
-                score_rows.append((na_vec, tt_vec))
+                score_ids[ssig] = sid
+                score_rows.append(entry)
             score_sig[i] = sid
         S2 = max(len(score_rows), 1)
         if want_na:

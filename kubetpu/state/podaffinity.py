@@ -61,24 +61,37 @@ from .encoder import NodeTensors
 from .vocab import Vocab
 
 
-def term_matches_pod(
+def term_matches(
     term: t.PodAffinityTerm,
     owner_ns: str,
-    pod: t.Pod,
+    target_ns: str,
+    target_labels: dict,
     ns_labels: "dict[str, str] | None" = None,
 ) -> bool:
-    """AffinityTerm.Matches (framework/types.go): namespace membership OR
-    namespace-selector match (against the labels of the TARGET pod's
-    namespace), AND label selector match."""
+    """AffinityTerm.Matches (framework/types.go) against a (labels,
+    namespace) TEMPLATE rather than a pod object: namespace membership OR
+    namespace-selector match (against the labels of the target's
+    namespace), AND label selector match. Pods stamped from one controller
+    template share (labels, namespace), so match verdicts are per-template
+    facts — the encode cache memoizes them across cycles."""
     namespaces = term.namespaces or (owner_ns,)
-    ns_ok = pod.namespace in namespaces
+    ns_ok = target_ns in namespaces
     if not ns_ok and term.namespace_selector is not None:
         ns_ok = sel.label_selector_matches(term.namespace_selector, ns_labels or {})
     if not ns_ok:
         return False
     if term.selector is None:
         return False
-    return sel.label_selector_matches(term.selector, pod.labels_dict())
+    return sel.label_selector_matches(term.selector, target_labels)
+
+
+def term_matches_pod(
+    term: t.PodAffinityTerm,
+    owner_ns: str,
+    pod: t.Pod,
+    ns_labels: "dict[str, str] | None" = None,
+) -> bool:
+    return term_matches(term, owner_ns, pod.namespace, pod.labels_dict(), ns_labels)
 
 
 def _req_affinity_terms(pod: t.Pod) -> tuple[t.PodAffinityTerm, ...]:
@@ -101,8 +114,7 @@ def _pref_anti_terms(pod: t.Pod) -> tuple[t.WeightedPodAffinityTerm, ...]:
     return a.preferred if a else ()
 
 
-def has_any_affinity(pod: t.Pod) -> bool:
-    a = pod.affinity
+def affinity_has_terms(a: "t.Affinity | None") -> bool:
     if a is None:
         return False
     pa, paa = a.pod_affinity, a.pod_anti_affinity
@@ -110,6 +122,44 @@ def has_any_affinity(pod: t.Pod) -> bool:
         (pa is not None and (pa.required or pa.preferred))
         or (paa is not None and (paa.required or paa.preferred))
     )
+
+
+def has_any_affinity(pod: t.Pod) -> bool:
+    return affinity_has_terms(pod.affinity)
+
+
+def source_row_specs(aff: "t.Affinity | None", ns: str) -> tuple:
+    """The rows a pod shaped ``(affinity, namespace)`` maintains as an
+    existing/assigned pod, as ``(vocab_key, meta, inc)`` specs: EA (its
+    required anti-affinity terms), SCH (its required affinity terms,
+    scored × HardPodAffinityWeight), SCP (its preferred terms, signed).
+    A pure function of the TEMPLATE — the encode cache memoizes it, so a
+    1000-pod deployment contributes one spec computation, not 1000
+    per-pod ``existing_rows`` walks per cycle."""
+    pa = aff.pod_affinity if aff else None
+    paa = aff.pod_anti_affinity if aff else None
+    out: list[tuple] = []
+    for term in (paa.required if paa else ()):
+        out.append((
+            ("EA", term.topology_key, ("eterm", term, ns)),
+            dict(term=term, ns=ns), 1,
+        ))
+    for term in (pa.required if pa else ()):
+        out.append((
+            ("SCH", term.topology_key, ("hterm", term, ns)),
+            dict(term=term, ns=ns), 1,
+        ))
+    for wt in (pa.preferred if pa else ()):
+        out.append((
+            ("SCP", wt.term.topology_key, ("pterm", wt.term, ns, wt.weight, 1)),
+            dict(term=wt.term, ns=ns, weight=wt.weight, sign=1), 1,
+        ))
+    for wt in (paa.preferred if paa else ()):
+        out.append((
+            ("SCP", wt.term.topology_key, ("pterm", wt.term, ns, wt.weight, -1)),
+            dict(term=wt.term, ns=ns, weight=wt.weight, sign=-1), 1,
+        ))
+    return tuple(out)
 
 
 @dataclass
@@ -149,10 +199,23 @@ def encode_pod_affinity(
     hard_pod_affinity_weight: int = 1,
     pad_pods: int | None = None,
     namespaces: "dict[str, dict[str, str]] | None" = None,
+    cache=None,
+    groups: dict | None = None,
 ) -> PodAffinityTensors | None:
     """Build affinity tensors; None when neither pending pods nor existing
     pods carry any (anti)affinity. ``namespaces`` is the snapshot's
-    namespace→labels map, matched by namespace selectors."""
+    namespace→labels map, matched by namespace selectors.
+
+    ``groups``: precomputed template groups
+    (``encode_cache.collect_pod_groups``) — ``{template_key(pod):
+    (N,) counts}`` with key[0:3] = (labels, ns, affinity); None builds
+    them here. The base-sum accumulation is
+    per (row × template) numpy segment sums over these count vectors, not
+    per (row × existing pod) Python — the r05 fullstack trace's dominant
+    encode cost. ``cache``: an ``encode_cache.EncodeCache`` whose
+    persistent term-spec and match-verdict stores carry the per-template
+    facts across cycles (the caller must have synced its namespace
+    generation — ``runtime.finalize_batch`` does)."""
     ns_map = namespaces or {}
 
     def ns_labels_of(q: t.Pod) -> dict[str, str]:
@@ -163,177 +226,238 @@ def encode_pod_affinity(
     NC = nt.alloc.shape[0]
     PP = max(pad_pods or P, P)
 
-    existing: list[tuple[t.Pod, int]] = []       # (pod, node index)
-    for n_i, info in enumerate(nt.infos):
-        for ex in info.pods.values():
-            existing.append((ex, n_i))
-    any_existing_aff = any(has_any_affinity(ex) for ex, _ in existing)
+    from .encode_cache import collapse_label_groups, groups_for, pod_gids_for
+
+    groups = groups_for(nt, cache, groups)
+    any_existing_aff = any(
+        affinity_has_terms(key[2]) for key in groups
+    )
     any_pending_aff = any(has_any_affinity(p) for p in pods)
     if not any_existing_aff and not any_pending_aff:
         return None
 
     row_vocab = Vocab()
     row_meta: list[dict] = []
+    row_keys: list[tuple] = []   # interned vocab key per row — the STABLE
+    #                              identity the cross-cycle match cache keys on
 
     def row(kind: str, key: str, match_fn_sig, meta) -> int:
-        rid = row_vocab.intern((kind, key, match_fn_sig))
+        vk = (kind, key, match_fn_sig)
+        rid = row_vocab.intern(vk)
         if rid == len(row_meta):
             row_meta.append(dict(kind=kind, key=key, **meta))
+            row_keys.append(vk)
         return rid
+
+    def row_from_spec(spec) -> int:
+        vk, meta, _inc = spec
+        rid = row_vocab.intern(vk)
+        if rid == len(row_meta):
+            row_meta.append(dict(kind=vk[0], key=vk[1], **meta))
+            row_keys.append(vk)
+        return rid
+
+    # per-pod TEMPLATE ids: the whole pending-pod side (incoming rows,
+    # fa_self, update row, EA/SC slots) is a pure function of the template,
+    # so it is computed once per distinct template in the batch and copied
+    # to every pod stamped from it
+    pod_gid = pod_gids_for(pods, cache)
 
     # ---- collect rows ----------------------------------------------------
     fa_slots: list[list[int]] = [[] for _ in range(P)]
     ra_slots: list[list[int]] = [[] for _ in range(P)]
     fa_self = np.zeros(PP, dtype=bool)
 
+    tmpl_in: dict[int, tuple] = {}   # gid -> (fa rids, fa_self, ra rids)
     for i, p in enumerate(pods):
-        aff = _req_affinity_terms(p)
-        if aff:
-            set_sig = (tuple(aff), p.namespace)
-            for term in aff:
-                rid = row(
-                    "FA", term.topology_key, ("set", set_sig),
-                    dict(terms=aff, ns=p.namespace),
+        ent = tmpl_in.get(pod_gid[i])
+        if ent is None:
+            fa_list: list[int] = []
+            ra_list: list[int] = []
+            fself = False
+            aff = _req_affinity_terms(p)
+            if aff:
+                set_sig = (tuple(aff), p.namespace)
+                for term in aff:
+                    rid = row(
+                        "FA", term.topology_key, ("set", set_sig),
+                        dict(terms=aff, ns=p.namespace),
+                    )
+                    fa_list.append(rid)
+                fself = all(
+                    term_matches_pod(tm, p.namespace, p, ns_labels_of(p))
+                    for tm in aff
                 )
-                fa_slots[i].append(rid)
-            fa_self[i] = all(
-                term_matches_pod(tm, p.namespace, p, ns_labels_of(p))
-                for tm in aff
-            )
-        for term in _req_anti_terms(p):
-            rid = row(
-                "RA", term.topology_key, ("term", term, p.namespace),
-                dict(term=term, ns=p.namespace),
-            )
-            ra_slots[i].append(rid)
-        for wt in _pref_affinity_terms(p):
-            row(
-                "SCI", wt.term.topology_key,
-                ("pref", wt.term, p.namespace),
-                dict(term=wt.term, ns=p.namespace),
-            )
-        for wt in _pref_anti_terms(p):
-            row(
-                "SCI", wt.term.topology_key,
-                ("pref", wt.term, p.namespace),
-                dict(term=wt.term, ns=p.namespace),
-            )
+            for term in _req_anti_terms(p):
+                rid = row(
+                    "RA", term.topology_key, ("term", term, p.namespace),
+                    dict(term=term, ns=p.namespace),
+                )
+                ra_list.append(rid)
+            for wt in _pref_affinity_terms(p):
+                row(
+                    "SCI", wt.term.topology_key,
+                    ("pref", wt.term, p.namespace),
+                    dict(term=wt.term, ns=p.namespace),
+                )
+            for wt in _pref_anti_terms(p):
+                row(
+                    "SCI", wt.term.topology_key,
+                    ("pref", wt.term, p.namespace),
+                    dict(term=wt.term, ns=p.namespace),
+                )
+            ent = (fa_list, fself, ra_list)
+            tmpl_in[pod_gid[i]] = ent
+        fa_slots[i] = list(ent[0])
+        fa_self[i] = ent[1]
+        ra_slots[i] = list(ent[2])
 
-    # rows driven by existing/assignable pods' own terms. Pending pods also
-    # contribute rows here: once assigned in-batch they become "existing" for
-    # later pods.
-    def existing_rows(pod: t.Pod) -> list[tuple[int, int]]:
-        """Rows this pod's own terms maintain, with the per-assignment
-        increment (1 for counts; weight is applied at score time via
-        score_w, so SC rows also increment by their weight here)."""
-        out: list[tuple[int, int]] = []
-        for term in _req_anti_terms(pod):
-            rid = row(
-                "EA", term.topology_key, ("eterm", term, pod.namespace),
-                dict(term=term, ns=pod.namespace),
-            )
-            out.append((rid, 1))
-        for term in _req_affinity_terms(pod):
-            rid = row(
-                "SCH", term.topology_key, ("hterm", term, pod.namespace),
-                dict(term=term, ns=pod.namespace),
-            )
-            out.append((rid, 1))
-        for wt in _pref_affinity_terms(pod):
-            rid = row(
-                "SCP", wt.term.topology_key,
-                ("pterm", wt.term, pod.namespace, wt.weight, 1),
-                dict(term=wt.term, ns=pod.namespace, weight=wt.weight, sign=1),
-            )
-            out.append((rid, 1))
-        for wt in _pref_anti_terms(pod):
-            rid = row(
-                "SCP", wt.term.topology_key,
-                ("pterm", wt.term, pod.namespace, wt.weight, -1),
-                dict(term=wt.term, ns=pod.namespace, weight=wt.weight, sign=-1),
-            )
-            out.append((rid, 1))
-        return out
+    # rows driven by existing/assignable pods' own terms, per TEMPLATE
+    # (source_row_specs — memoized across cycles by the encode cache).
+    # Pending pods also contribute: once assigned in-batch they become
+    # "existing" for later pods.
+    def specs_of(aff, ns: str) -> tuple:
+        if not affinity_has_terms(aff):
+            return ()
+        if cache is not None:
+            key = (aff, ns)
+            got = cache.aff_row_specs.get(key)
+            if got is None:
+                got = source_row_specs(aff, ns)
+                cache.aff_row_specs.put(key, got)
+            return got
+        return source_row_specs(aff, ns)
 
-    ex_rows: list[list[tuple[int, int]]] = [existing_rows(ex) for ex, _ in existing]
-    pend_rows: list[list[tuple[int, int]]] = [existing_rows(p) for p in pods]
+    group_list: list[tuple] = []   # (labels, ns, specs, counts vec)
+    for key, vec in groups.items():
+        labels, ns, aff = key[0], key[1], key[2]
+        specs = specs_of(aff, ns)
+        for spec in specs:
+            row_from_spec(spec)
+        group_list.append((labels, ns, specs, vec))
+    # per-template pending source specs (the per-pod specs_of lookup was a
+    # deep (affinity, ns) hash per pod per cycle)
+    _specs_of_gid: dict[int, tuple] = {}
+    pend_specs: list[tuple] = []
+    for i, p in enumerate(pods):
+        sp_ = _specs_of_gid.get(pod_gid[i])
+        if sp_ is None:
+            sp_ = specs_of(p.affinity, p.namespace)
+            _specs_of_gid[pod_gid[i]] = sp_
+        pend_specs.append(sp_)
+    for sp_ in _specs_of_gid.values():
+        for spec in sp_:
+            row_from_spec(spec)
 
     R = len(row_meta)
     if R == 0:
         return None
 
     # ---- per-row node domains + base sums --------------------------------
-    key_domains: dict[str, tuple[np.ndarray, Vocab]] = {}
+    key_domains: dict[str, tuple[np.ndarray, int]] = {}
 
-    def domains_for(key: str) -> tuple[np.ndarray, Vocab]:
+    def domains_for(key: str) -> tuple[np.ndarray, int]:
         got = key_domains.get(key)
         if got is None:
             vals = nt.topology_values(key)          # (N,) interned label ids
-            dv = Vocab()
             dom = np.full(N, -1, dtype=np.int32)
-            for n_i in range(N):
-                if vals[n_i] >= 0:
-                    dom[n_i] = dv.intern(int(vals[n_i]))
-            got = (dom, dv)
+            present = vals >= 0
+            n_dom = 0
+            if present.any():
+                uniq, first, inv = np.unique(
+                    vals[present], return_index=True, return_inverse=True
+                )
+                # first-seen (node-order) domain ids — the same ids the
+                # per-node Vocab interning loop used to produce
+                rank = np.empty(len(uniq), dtype=np.int32)
+                rank[np.argsort(first, kind="stable")] = np.arange(
+                    len(uniq), dtype=np.int32
+                )
+                dom[present] = rank[inv]
+                n_dom = len(uniq)
+            got = (dom, n_dom)
             key_domains[key] = got
         return got
 
     row_domains = [domains_for(m["key"]) for m in row_meta]
-    D = max((len(dv) for _, dv in row_domains), default=1) or 1
+    D = max((n for _, n in row_domains), default=1) or 1
 
     node_domain = np.full((R, NC), -1, dtype=np.int32)
     has_key = np.zeros((R, NC), dtype=bool)
     base_sums = np.zeros((R, D), dtype=np.int64)
-    for r, (dom, _dv) in enumerate(row_domains):
+    for r, (dom, _n) in enumerate(row_domains):
         node_domain[r, :N] = dom
         has_key[r, :N] = dom >= 0
 
-    # does pod q "drive" row r's count (as an existing/assigned pod)?
-    def count_match(meta: dict, q: t.Pod) -> bool:
-        kind = meta["kind"]
-        if kind == "FA":
-            return all(
-                term_matches_pod(tm, meta["ns"], q, ns_labels_of(q))
-                for tm in meta["terms"]
-            )
-        if kind in ("RA", "SCI"):
-            return term_matches_pod(meta["term"], meta["ns"], q, ns_labels_of(q))
-        # EA/SCH/SCP rows count pods that HAVE the term — membership was
-        # resolved when the row was appended for that pod, so here we only
-        # get called for base sums via ex_rows/pend_rows, not a predicate.
-        raise AssertionError("count_match only for FA/RA/SCI rows")
+    # does a pod shaped (labels, ns) drive row r's count — as the TARGET of
+    # the row's incoming terms (FA/RA/SCI) or of an existing pod's own term
+    # (EA/SCH/SCP)? One verdict per (row, template), persisted across
+    # cycles by the encode cache (keyed on the stable row vocab key).
+    local_match: dict = {}
 
-    match_cache: dict[tuple, bool] = {}
-
-    def cached_count_match(r: int, q: t.Pod) -> bool:
-        key = (r, q.labels, q.namespace)
-        got = match_cache.get(key)
+    def match_group(r: int, labels, ns: str, ld: dict) -> bool:
+        key = (row_keys[r], labels, ns)
+        store = cache.match if cache is not None else None
+        got = store.get(key) if store is not None else local_match.get(key)
         if got is None:
-            got = count_match(row_meta[r], q)
-            match_cache[key] = got
+            meta = row_meta[r]
+            nsl = ns_map.get(ns, {})
+            if meta["kind"] == "FA":
+                got = all(
+                    term_matches(tm, meta["ns"], ns, ld, nsl)
+                    for tm in meta["terms"]
+                )
+            else:   # single-term rows: RA/SCI/EA/SCH/SCP
+                got = term_matches(meta["term"], meta["ns"], ns, ld, nsl)
+            if store is not None:
+                store.put(key, got)
+            else:
+                local_match[key] = got
         return got
 
-    for (ex, n_i), rows_of_ex in zip(existing, ex_rows):
-        # rows where the existing pod is the TARGET (incoming pod's terms)
-        for r, meta in enumerate(row_meta):
-            if meta["kind"] in ("FA", "RA", "SCI"):
-                d = node_domain[r, n_i]
-                if d >= 0 and cached_count_match(r, ex):
-                    base_sums[r, d] += 1
-        # rows where the existing pod is the SOURCE (its own terms)
-        for r, inc in rows_of_ex:
-            d = node_domain[r, n_i]
-            if d >= 0:
-                base_sums[r, d] += inc
+    # target side: FA/RA/SCI rows count matching existing pods — segment-sum
+    # each matching template's per-node counts into the row's domains
+    lgroups = collapse_label_groups(groups)
+    for r, meta in enumerate(row_meta):
+        if meta["kind"] not in ("FA", "RA", "SCI"):
+            continue
+        dom, _n = row_domains[r]
+        valid = dom >= 0
+        if not valid.any():
+            continue
+        agg = None
+        for (labels, ns), (vec, ld) in lgroups.items():
+            if match_group(r, labels, ns, ld):
+                agg = vec if agg is None else agg + vec
+        if agg is not None:
+            np.add.at(base_sums[r], dom[valid], agg[valid])
+    # source side: rows maintained by existing pods' OWN terms — per
+    # template, inc × its per-node counts into the row's domains
+    for _labels, _ns, specs, vec in group_list:
+        for vk, _meta, inc in specs:
+            rid = row_vocab.get(vk)
+            dom, _n = row_domains[rid]
+            valid = dom >= 0
+            if valid.any():
+                np.add.at(base_sums[rid], dom[valid], inc * vec[valid])
 
     # ---- update matrix (in-batch assignment increments) ------------------
     update = np.zeros((PP, R), dtype=np.int64)
+    tmpl_update: dict[int, np.ndarray] = {}
     for i, p in enumerate(pods):
-        for r, meta in enumerate(row_meta):
-            if meta["kind"] in ("FA", "RA", "SCI") and cached_count_match(r, p):
-                update[i, r] += 1
-        for r, inc in pend_rows[i]:
-            update[i, r] += inc
+        row_u = tmpl_update.get(pod_gid[i])
+        if row_u is None:
+            ld = p.labels_dict()
+            row_u = np.zeros(R, dtype=np.int64)
+            for r, meta in enumerate(row_meta):
+                if meta["kind"] in ("FA", "RA", "SCI") and match_group(
+                    r, p.labels, p.namespace, ld
+                ):
+                    row_u[r] += 1
+            for vk, _meta, inc in pend_specs[i]:
+                row_u[row_vocab.get(vk)] += inc
+            tmpl_update[pod_gid[i]] = row_u
+        update[i] = row_u
 
     # ---- filtering tensors ----------------------------------------------
     CA = max((len(s) for s in fa_slots), default=1) or 1
@@ -347,12 +471,17 @@ def encode_pod_affinity(
             ra_rows[i, c] = rid
 
     ea_lists: list[list[int]] = []
+    tmpl_ea: dict[int, list[int]] = {}
     for i, p in enumerate(pods):
-        lst = [
-            r for r, meta in enumerate(row_meta)
-            if meta["kind"] == "EA"
-            and term_matches_pod(meta["term"], meta["ns"], p, ns_labels_of(p))
-        ]
+        lst = tmpl_ea.get(pod_gid[i])
+        if lst is None:
+            ld = p.labels_dict()
+            lst = [
+                r for r, meta in enumerate(row_meta)
+                if meta["kind"] == "EA"
+                and match_group(r, p.labels, p.namespace, ld)
+            ]
+            tmpl_ea[pod_gid[i]] = lst
         ea_lists.append(lst)
     CE = max((len(x) for x in ea_lists), default=1) or 1
     ea_rows = np.full((PP, CE), -1, dtype=np.int32)
@@ -361,7 +490,13 @@ def encode_pod_affinity(
 
     # ---- scoring slots ---------------------------------------------------
     sc_lists: list[list[tuple[int, int]]] = []
+    tmpl_sc: dict[int, list] = {}
     for i, p in enumerate(pods):
+        got_sc = tmpl_sc.get(pod_gid[i])
+        if got_sc is not None:
+            sc_lists.append(got_sc)
+            continue
+        ld = p.labels_dict()
         w: dict[int, int] = {}
         # incoming preferred terms: row counts matching existing pods; the
         # pod's own weight applies (scoring.go:98/:105)
@@ -376,12 +511,14 @@ def encode_pod_affinity(
         # existing pods' terms vs this pod (scoring.go:110-124)
         for r, meta in enumerate(row_meta):
             if meta["kind"] == "SCH" and hard_pod_affinity_weight > 0:
-                if term_matches_pod(meta["term"], meta["ns"], p, ns_labels_of(p)):
+                if match_group(r, p.labels, p.namespace, ld):
                     w[r] = w.get(r, 0) + hard_pod_affinity_weight
             elif meta["kind"] == "SCP":
-                if term_matches_pod(meta["term"], meta["ns"], p, ns_labels_of(p)):
+                if match_group(r, p.labels, p.namespace, ld):
                     w[r] = w.get(r, 0) + meta["sign"] * meta["weight"]
-        sc_lists.append(sorted(w.items()))
+        lst = sorted(w.items())
+        tmpl_sc[pod_gid[i]] = lst
+        sc_lists.append(lst)
     CS = max((len(x) for x in sc_lists), default=1) or 1
     score_rows = np.full((PP, CS), -1, dtype=np.int32)
     score_vals = np.zeros((PP, CS), dtype=np.int64)

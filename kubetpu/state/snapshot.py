@@ -80,6 +80,19 @@ class NodeInfo:
         )
 
 
+def _pod_has_affinity(pod: "t.Pod") -> bool:
+    """podaffinity.has_any_affinity, inlined to avoid a cycle with the
+    encoder import chain."""
+    a = pod.affinity
+    if a is None:
+        return False
+    pa, paa = a.pod_affinity, a.pod_anti_affinity
+    return bool(
+        (pa is not None and (pa.required or pa.preferred))
+        or (paa is not None and (paa.required or paa.preferred))
+    )
+
+
 @dataclass
 class Snapshot:
     """Immutable point-in-time view handed to the tensorizer.
@@ -113,6 +126,10 @@ class Snapshot:
     # the Cache's DRA index, SHARED by reference (single-owner loop thread:
     # encode and Reserve both run on it, like the volume listers' dicts)
     dra: object = None
+    # assigned/assumed pods carrying any (anti)affinity — lets the encoder
+    # skip the whole template-group/affinity pass in O(1) on affinity-free
+    # clusters (the SchedulingBasic steady state)
+    pods_with_affinity: int = 0
 
     def node_infos(self) -> list[NodeInfo]:
         return [self.nodes[n] for n in self.node_order]
@@ -148,6 +165,7 @@ class Cache:
         self._ttl = ttl_seconds
         self._clock = clock
         self._deleted_nodes: dict[str, NodeInfo] = {}
+        self._aff_pods = 0   # cached pods carrying any (anti)affinity
         self._namespaces: dict[str, dict[str, str]] = {}
         self._pvs: dict[str, t.PersistentVolume] = {}
         self._pvcs: dict[str, t.PersistentVolumeClaim] = {}
@@ -349,6 +367,8 @@ class Cache:
     def _add_pod_internal(self, pod: t.Pod) -> None:
         if not pod.node_name:
             raise ValueError(f"cached pod {pod.uid} must have node_name set")
+        if _pod_has_affinity(pod):
+            self._aff_pods += 1
         self._pods[pod.uid] = pod
         info = self._nodes.get(pod.node_name)
         if info is None and pod.node_name in self._deleted_nodes:
@@ -364,7 +384,9 @@ class Cache:
         self._touch(info)
 
     def _remove_pod_internal(self, pod: t.Pod) -> None:
-        self._pods.pop(pod.uid, None)
+        known = self._pods.pop(pod.uid, None)
+        if known is not None and _pod_has_affinity(known):
+            self._aff_pods -= 1
         info = self._nodes.get(pod.node_name)
         if info is None:
             info = self._deleted_nodes.get(pod.node_name)
@@ -433,5 +455,6 @@ class Cache:
             snapshot.services = dict(self._services)
             snapshot.volumes_generation = self._volumes_gen
         snapshot.dra = self.dra
+        snapshot.pods_with_affinity = self._aff_pods
         snapshot.generation = self._next_gen()
         return snapshot

@@ -157,6 +157,8 @@ def encode_spread(
     default_constraints: Sequence[t.TopologySpreadConstraint] = (),
     pad_pods: int | None = None,
     default_selector_of=None,
+    cache=None,
+    groups: dict | None = None,
 ) -> SpreadTensors | None:
     """Build spread tensors for the batch; None when no pending pod has (or
     inherits) topology spread constraints.
@@ -167,6 +169,13 @@ def encode_spread(
     (common.go:62 buildDefaultConstraints). A pod whose default selector is
     empty/None gets no constraints, exactly like the reference (common.go's
     ``if selector.Empty() { return nil }``).
+
+    ``groups``: precomputed template groups
+    (``encode_cache.collect_pod_groups``); None builds them here. The
+    per-node matching-pod counts become one selector verdict per (selector,
+    template) — persisted across cycles by ``cache`` (EncodeCache) — plus a
+    vector add per matching template, instead of a Python walk over every
+    existing pod per signature.
     """
     import dataclasses
 
@@ -200,12 +209,28 @@ def encode_spread(
     NC = nt.alloc.shape[0]
     PP = max(pad_pods or P, P)
 
+    from .encode_cache import collapse_label_groups, groups_for, pod_gids_for
+
+    lgroups = collapse_label_groups(groups_for(nt, cache, groups))
+    sel_store = cache.sel_counts if cache is not None else None
+    local_sel: dict = {}
+
+    # per-pod TEMPLATE ids: the pod-side tensors (constraint slots, soft
+    # ignored rows, selector-match rows) are pure functions of the
+    # template, computed once per distinct template in the batch
+    pod_gid = pod_gids_for(pods, cache)
+
     sig_vocab = Vocab()
     sig_info: list[dict] = []           # per sig id: everything host-side
     pod_slots: list[list[tuple]] = []   # per pod: (sig id, action, c)
 
     aff_cache: dict[tuple, np.ndarray] = {}
+    tmpl_slots: dict[int, list] = {}
     for p_i, p in enumerate(pods):
+        got_slots = tmpl_slots.get(pod_gid[p_i])
+        if got_slots is not None:
+            pod_slots.append(got_slots)
+            continue
         slots: list[tuple] = []
         constraints = eff[p_i]
         if constraints:
@@ -275,6 +300,7 @@ def encode_spread(
                 slots.append(
                     (sid, HARD if hard else SOFT, c.max_skew, kwargs_min, self_match)
                 )
+        tmpl_slots[pod_gid[p_i]] = slots
         pod_slots.append(slots)
 
     S = len(sig_info)
@@ -344,16 +370,30 @@ def encode_spread(
         ck = (info["selector"], info["namespace"])
         counts = count_cache.get(ck)
         if counts is None:
-            counts = np.zeros(N, dtype=np.int32)
+            counts = np.zeros(N, dtype=np.int64)
             selector, ns = ck
-            for n_i, ninfo in enumerate(nt.infos):
-                c = 0
-                for pod in ninfo.pods.values():
-                    if pod.namespace != ns:
+            # countPodsMatchSelector semantics (common.go:145): a nil or
+            # EMPTY selector counts nothing — and a non-empty one is
+            # evaluated once per TEMPLATE, not per pod
+            if selector is not None and (
+                selector.match_labels or selector.match_expressions
+            ):
+                for (labels, ns_g), (vec, ld) in lgroups.items():
+                    if ns_g != ns:
                         continue
-                    if _selector_counts(selector, pod.labels_dict()):
-                        c += 1
-                counts[n_i] = c
+                    mkey = (selector, labels)
+                    ok = (
+                        sel_store.get(mkey) if sel_store is not None
+                        else local_sel.get(mkey)
+                    )
+                    if ok is None:
+                        ok = sel.label_selector_matches(selector, ld)
+                        if sel_store is not None:
+                            sel_store.put(mkey, ok)
+                        else:
+                            local_sel[mkey] = ok
+                    if ok:
+                        counts = counts + vec
             count_cache[ck] = counts
         # counts participate only on eligible nodes (processNode early-returns)
         node_count[s_id, :N] = np.where(elig, counts, 0)
@@ -373,17 +413,37 @@ def encode_spread(
     pod_match_sig = np.zeros((PP, S), dtype=bool)
     ignored = np.zeros((PP, NC), dtype=bool)
     has_hard = has_soft = False
+    tmpl_rows: dict[int, tuple] = {}
     for i, slots in enumerate(pod_slots):
-        soft_keys = [
-            c.topology_key
-            for c in eff[i]
-            if c.when_unsatisfiable == t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY
-        ]
-        if soft_keys:
-            ig = np.zeros(N, dtype=bool)
-            for k in soft_keys:
-                ig |= nt.topology_values(k) < 0
+        ent = tmpl_rows.get(pod_gid[i])
+        if ent is None:
+            soft_keys = [
+                c.topology_key
+                for c in eff[i]
+                if c.when_unsatisfiable
+                == t.UnsatisfiableConstraintAction.SCHEDULE_ANYWAY
+            ]
+            ig = None
+            if soft_keys:
+                ig = np.zeros(N, dtype=bool)
+                for k in soft_keys:
+                    ig |= nt.topology_values(k) < 0
+            pod = pods[i]
+            match_row = np.zeros(S, dtype=bool)
+            for s_id, info in enumerate(sig_info):
+                # counting semantics, not Matches: a batch-assigned pod
+                # changes the counts exactly as a from-scratch
+                # calPreFilterState would
+                if pod.namespace == info["namespace"] and _selector_counts(
+                    info["selector"], pod.labels_dict()
+                ):
+                    match_row[s_id] = True
+            ent = (ig, match_row)
+            tmpl_rows[pod_gid[i]] = ent
+        ig, match_row = ent
+        if ig is not None:
             ignored[i, :N] = ig
+        pod_match_sig[i, :S] = match_row
         for c_i, (sid, act, skew, mind, selfm) in enumerate(slots):
             sig_idx[i, c_i] = sid
             action[i, c_i] = act
@@ -392,14 +452,6 @@ def encode_spread(
             self_match[i, c_i] = selfm
             has_hard = has_hard or act == HARD
             has_soft = has_soft or act == SOFT
-        pod = pods[i]
-        for s_id, info in enumerate(sig_info):
-            # counting semantics, not Matches: a batch-assigned pod changes
-            # the counts exactly as a from-scratch calPreFilterState would
-            if pod.namespace == info["namespace"] and _selector_counts(
-                info["selector"], pod.labels_dict()
-            ):
-                pod_match_sig[i, s_id] = True
 
     return SpreadTensors(
         eligible=eligible,

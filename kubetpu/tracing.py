@@ -40,6 +40,9 @@ class Span:
     # off-stack spans, so the Chrome-trace exporter lays it out on its own
     # non-overlapping lane (tid >= 2)
     off_stack: bool = False
+    # a zero-duration marker (Tracer.instant) — exported as a Chrome-trace
+    # instant ("i") event instead of a complete span
+    instant: bool = False
 
     @property
     def duration_s(self) -> float:
@@ -125,6 +128,26 @@ class Tracer:
         self._spans.append(sp)
         return sp
 
+    def instant(self, name: str, **attrs) -> Span | None:
+        """Record a zero-duration marker at 'now' (an event, not a phase —
+        e.g. an encode-cache invalidation). Lands in the buffer like any
+        span; the Chrome-trace export renders it as an instant event."""
+        if not self.enabled:
+            return None
+        now = self._clock()
+        sp = Span(
+            name=name,
+            span_id=next(self._ids),
+            parent_id=None,
+            start=now,
+            end=now,
+            attrs=dict(attrs),
+            off_stack=True,
+            instant=True,
+        )
+        self._spans.append(sp)
+        return sp
+
     # ---- inspection ------------------------------------------------------
     def _snapshot_spans(self) -> list[Span]:
         """Copy the buffer tolerating concurrent appends: a diagnostics
@@ -162,6 +185,19 @@ class Tracer:
         # LANE (tid >= 2) whose previous span already ended
         lane_ends: list[float] = []
         for sp in sorted(src, key=lambda s: s.start):
+            if sp.instant:
+                # marker events take no lane — process-scoped instants
+                events.append({
+                    "name": sp.name,
+                    "cat": "kubetpu",
+                    "ph": "i",
+                    "s": "p",
+                    "ts": sp.start * 1e6,
+                    "pid": 1,
+                    "tid": 1,
+                    "args": {"span_id": sp.span_id, **sp.attrs},
+                })
+                continue
             if sp.off_stack:
                 for lane, end in enumerate(lane_ends):
                     if end <= sp.start:
