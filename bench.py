@@ -39,36 +39,40 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
 
 import kubetpu  # noqa: F401  (enables x64)
 
-# (case, workload, engine, mode, max_batch, pipeline); ordered: quadratic/
+# (case, workload, engine, mode, max_batch, pipeline, bulk); ordered: quadratic/
 # batched evidence first. "fullstack" drives the SAME op list through an
 # in-process REST apiserver + RemoteStore + informers + HTTP binds — the
 # reference harness's own shape (util.go:96) — so the direct-vs-fullstack
 # delta (the apiserver tax) is measured, not assumed. pipeline=True runs the
 # two-stage pipelined cycle (device-resident node block + delta uploads);
 # each serial/pipelined pair on the same workload feeds one
-# PipelineComparison line (cycles/sec up, transfer-bytes/cycle down).
+# PipelineComparison line (cycles/sec up, transfer-bytes/cycle down), and
+# each bulk/nobulk fullstack pair feeds one APIPlaneComparison line
+# (rpcs_per_scheduled_pod down ≥5×, the API-plane acceptance evidence).
 STAGES = [
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024, False),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, False),
-    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024, False),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, False),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024, False),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024, False, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, True, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, False, True),
+    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024, False, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, True, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024, False, True),
     # the r05-comparable fullstack rows (the encode-cache acceptance is
-    # judged against r05's 500-node fallback numbers: 503.7 and 279.9)
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False),
-    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False),
+    # judged against r05's 500-node fallback numbers: 503.7 and 279.9);
+    # the bulk/nobulk 500Nodes pair is the APIPlaneComparison evidence
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True),
     # the encode-cache win measured beyond the 2 classic fullstack rows:
     # spreading through the stack, and recreate-churn driving the
     # informer→invalidate→re-encode path end to end
-    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "fullstack", 1024, False),
-    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False),
-    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "direct", 1024, False),
-    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024, False),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, True),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, False),
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "fullstack", 1024, False, True),
+    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True),
+    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True),
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024, False, True),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, True, True),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, False, True),
 ]
 TOTAL_BUDGET_S = 1500.0     # skip remaining stages past this
 STAGE_TIMEOUT_S = 300.0     # per-phase settle timeout inside the runner
@@ -105,6 +109,7 @@ def run_stage(
     mode: str = "direct", max_batch: int = 1024,
     profile_dir: str | None = None,
     pipeline: bool = False,
+    bulk: bool = True,
 ) -> dict:
     import contextlib
 
@@ -129,12 +134,14 @@ def run_stage(
         r = runner(
             case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
             max_batch=max_batch, artifacts_dir=artifacts_dir,
-            pipeline=pipeline,
+            pipeline=pipeline, bulk=bulk,
         )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
     if pipeline:
         suffix += "_pipelined"
+    if not bulk:
+        suffix += "_nobulk"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -154,6 +161,18 @@ def run_stage(
     }
     if pipeline:
         out["pipeline"] = True
+    if not bulk:
+        out["bulk"] = False
+    # the API-plane acceptance metrics (fullstack): round trips per
+    # scheduled pod + the dispatcher's mean bulk micro-batch size
+    if r.rpcs_per_scheduled_pod is not None:
+        # 4 decimals: the best bulk runs land WELL under 0.01 RPCs/pod and
+        # a 2-decimal round would zero out the comparison's denominator
+        out["rpcs_per_scheduled_pod"] = round(r.rpcs_per_scheduled_pod, 4)
+    if r.dispatcher_batch_mean is not None:
+        out["dispatcher_batch_mean"] = round(r.dispatcher_batch_mean, 1)
+    if r.dispatcher_errors:
+        out["dispatcher_errors"] = r.dispatcher_errors
     if r.cycles_per_sec is not None:
         out["cycles_per_sec"] = round(r.cycles_per_sec, 2)
     if r.transfer_bytes_per_cycle is not None:
@@ -213,19 +232,22 @@ CPU_FALLBACK_STAGES = [
     # workload carries a SCALED threshold (documented in its
     # threshold_note) so vs_baseline is never null, and max_batch=128
     # forces >= 5 measured cycles (a steady-state claim, not one batch).
-    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128, False),
-    ("TopologySpreading", "500Nodes", "batched", "direct", 128, False),
-    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, True),
-    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, False),
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False),
-    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128, False, True),
+    ("TopologySpreading", "500Nodes", "batched", "direct", 128, False, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, True, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, False, True),
+    # the APIPlaneComparison pair: the r05-judged fullstack row with and
+    # without the bulk API plane (rpcs_per_scheduled_pod before/after)
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True),
     # encode-cache acceptance rows: spreading through the stack + recreate
     # churn (informer→invalidate→re-encode) in both modes
-    ("TopologySpreading", "500Nodes", "greedy", "fullstack", 128, False),
-    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "fullstack", 128, False),
-    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "direct", 128, False),
-    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True),
-    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False),
+    ("TopologySpreading", "500Nodes", "greedy", "fullstack", 128, False, True),
+    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "fullstack", 128, False, True),
+    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "direct", 128, False, True),
+    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True, True),
+    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False, True),
 ]
 
 
@@ -238,7 +260,7 @@ def _emit_pipeline_comparisons(done: dict) -> None:
         ser, pipe = pair.get(False), pair.get(True)
         if not ser or not pipe or "error" in ser or "error" in pipe:
             continue
-        case, workload, engine, mode = key
+        case, workload, engine, mode, _bulk = key
         line = {
             "metric": f"PipelineComparison_{case}_{workload}_{engine}",
             "unit": "ratio",
@@ -271,6 +293,49 @@ def _emit_pipeline_comparisons(done: dict) -> None:
         _emit(line)
 
 
+def _emit_api_plane_comparisons(done: dict) -> None:
+    """One APIPlaneComparison line per fullstack (case, workload, engine)
+    that ran BOTH bulk and single-op: the API-plane acceptance evidence —
+    rpcs_per_scheduled_pod dropping (target ≥5×) and throughput side by
+    side — embedded in the bench artifact itself."""
+    for key, pair in sorted(done.items()):
+        single, bulked = pair.get(False), pair.get(True)
+        if not single or not bulked or "error" in single or "error" in bulked:
+            continue
+        case, workload, engine, mode, _pipeline = key
+        if mode != "fullstack":
+            continue
+        fields = (
+            "value", "rpcs_per_scheduled_pod", "dispatcher_batch_mean",
+            "duration_s",
+        )
+        line = {
+            "metric": f"APIPlaneComparison_{case}_{workload}_{engine}",
+            "unit": "ratio",
+            "mode": mode,
+            "backend": bulked.get("backend"),
+            "single": {
+                k: single.get(k) for k in fields
+                if single.get(k) is not None
+            },
+            "bulk": {
+                k: bulked.get(k) for k in fields
+                if bulked.get(k) is not None
+            },
+        }
+        s_rpc = single.get("rpcs_per_scheduled_pod")
+        b_rpc = bulked.get("rpcs_per_scheduled_pod")
+        if s_rpc is not None and b_rpc:   # b_rpc kept at 4 decimals; a
+            #                               truthy check only guards ÷0
+            line["rpcs_reduction"] = round(s_rpc / b_rpc, 2)
+            line["value"] = round(s_rpc / b_rpc, 2)
+        if single.get("value") and bulked.get("value"):
+            line["throughput_speedup"] = round(
+                bulked["value"] / single["value"], 3
+            )
+        _emit(line)
+
+
 def main() -> None:
     global STAGES
     probe, probe_s = _probe_backend()
@@ -289,18 +354,23 @@ def main() -> None:
     t_start = time.perf_counter()
     best_quadratic: dict | None = None
     best_any: dict | None = None
-    # (case, workload, engine, mode) -> {pipeline: result line}
+    # (case, workload, engine, mode, bulk) -> {pipeline: result line}
     pairs: dict = {}
-    for case, workload, engine, mode, max_batch, pipeline in STAGES:
+    # (case, workload, engine, mode, pipeline) -> {bulk: result line}
+    api_pairs: dict = {}
+    for case, workload, engine, mode, max_batch, pipeline, bulk in STAGES:
         elapsed = time.perf_counter() - t_start
         if elapsed > TOTAL_BUDGET_S:
             _status(f"budget exhausted ({elapsed:.0f}s); skipping {case}/{engine}")
             continue
         _status(f"stage start: {case}/{workload}/{engine}/{mode}"
-                f"{'/pipelined' if pipeline else ''} (t={elapsed:.0f}s)")
+                f"{'/pipelined' if pipeline else ''}"
+                f"{'/nobulk' if not bulk else ''} (t={elapsed:.0f}s)")
         suffix = "" if mode == "direct" else "_fullstack"
         if pipeline:
             suffix += "_pipelined"
+        if not bulk:
+            suffix += "_nobulk"
         # profile exactly ONE stage: the first quadratic TPU stage (the
         # north-star workload) — the artifact lands in ./xla_profile/
         profile_dir = None
@@ -311,7 +381,8 @@ def main() -> None:
             profile_dir = "xla_profile"
         try:
             line = run_stage(case, workload, engine, mode, max_batch,
-                             profile_dir=profile_dir, pipeline=pipeline)
+                             profile_dir=profile_dir, pipeline=pipeline,
+                             bulk=bulk)
             if profile_dir is not None:
                 line["xla_profile"] = profile_dir
         except Exception as e:
@@ -323,7 +394,12 @@ def main() -> None:
             })
             _status(f"stage FAILED: {case}/{workload}/{engine}/{mode}: {e}")
             continue
-        pairs.setdefault((case, workload, engine, mode), {})[pipeline] = line
+        pairs.setdefault(
+            (case, workload, engine, mode, bulk), {}
+        )[pipeline] = line
+        api_pairs.setdefault(
+            (case, workload, engine, mode, pipeline), {}
+        )[bulk] = line
         _emit(line)
         _status(f"stage done: {line['metric']} = {line['value']} pods/s "
                 f"({line['vs_baseline']}x baseline)")
@@ -336,6 +412,7 @@ def main() -> None:
         ):
             best_quadratic = line
     _emit_pipeline_comparisons(pairs)
+    _emit_api_plane_comparisons(api_pairs)
     final = best_quadratic or best_any
     if final is None:
         _emit({

@@ -213,6 +213,21 @@ class Registry:
         write, or None to pass."""
         self._lockers.append((fn, set(kinds) if kinds else None))
 
+    def has_dynamic_admission(self, kind: str) -> bool:
+        """True when any mutating/validating hook or write-lock provider
+        matches ``kind``. The bulk verb's one-lock storage fast path is
+        only sound for kinds WITHOUT dynamic admission (a usage-counting
+        validator like quota must see each admit+write as one atomic step,
+        and an update hook's ``old`` must reflect earlier ops in the same
+        batch) — such kinds run the batch through the sequential
+        single-verb chain instead."""
+        for _fn, kinds in (
+            *self._mutating, *self._validating, *self._lockers,
+        ):
+            if kinds is None or kind in kinds:
+                return True
+        return False
+
     @contextmanager
     def locked(self, kind: str, key: str, obj: Any, verb: str = "create"):
         """Every matching write lock held, in registration order, for the

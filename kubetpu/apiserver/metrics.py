@@ -147,5 +147,15 @@ class APIServerMetrics:
             )
             self.request_total.labels(verb, label, str(code)).inc()
 
+    def total_requests(self) -> int:
+        """Lifetime completed-request count across every verb/resource/code
+        — the perf harness's numerator for API round trips per scheduled
+        pod (watch long-polls complete per poll, so they count; a held-open
+        stream counts once at close)."""
+        return int(sum(
+            child.value
+            for _key, child in self.request_total._children_snapshot()
+        ))
+
     def expose(self) -> str:
         return self.registry.expose()

@@ -23,6 +23,8 @@ from typing import Any
 from ..api import scheme
 from ..store.memstore import CompactedError, ConflictError, WatchEvent
 
+BULK_SUFFIX = ":bulk"
+
 
 class RemoteStoreError(Exception):
     pass
@@ -182,6 +184,62 @@ class RemoteStore:
         res = self._request("DELETE", f"/apis/{kind}/{key}")
         return res["resourceVersion"]
 
+    def bulk(self, kind: str, ops: list[dict]) -> list[dict]:
+        """POST /apis/<kind>:bulk — N ops, ONE round trip, positional
+        per-op results (``MemStore.bulk``'s shape: {"status",
+        "resourceVersion", "error"?, "object"?}, objects decoded). Per-op
+        failures ride the result list — only transport / whole-request
+        errors raise. The one-safe-retry discipline applies per BATCH
+        (``_request``'s send-phase / idle-close rules), so a batch is
+        never double-applied."""
+        wire = []
+        for op in ops:
+            w = {"op": op["op"], "key": op["key"]}
+            if "object" in op:
+                w["object"] = scheme.encode(op["object"])
+            if op.get("expect_rv") is not None:
+                w["resourceVersion"] = op["expect_rv"]
+            wire.append(w)
+        res = self._request("POST", f"/apis/{kind}{BULK_SUFFIX}",
+                            {"ops": wire})
+        out = []
+        for r in res["results"]:
+            if r.get("object") is not None:
+                r = dict(r, object=scheme.decode(r["object"]))
+            out.append(r)
+        return out
+
+    def watch_bulk(
+        self, cursors: dict[str, int], timeout_s: float = 0.0
+    ) -> dict:
+        """Batched watch poll: every kind's cursor drained in ONE request
+        (GET /apis/?watch=1&buckets=…). Returns {kind: (events, cursor)}
+        with a CompactedError VALUE for a compacted kind (the caller
+        relists just that kind — the other buckets' deliveries still
+        land)."""
+        qs = ",".join(f"{k}:{rv}" for k, rv in cursors.items())
+        res = self._request(
+            "GET",
+            f"/apis/?watch=1&buckets={qs}&timeoutSeconds={timeout_s}",
+        )
+        out: dict = {}
+        for kind, bucket in res["buckets"].items():
+            if bucket.get("code") == 410:
+                out[kind] = CompactedError(bucket.get("error", "compacted"))
+                continue
+            out[kind] = (
+                [
+                    WatchEvent(
+                        type=e["type"], kind=kind, key=e["key"],
+                        obj=scheme.decode(e["object"]),
+                        resource_version=e["resourceVersion"],
+                    )
+                    for e in bucket["events"]
+                ],
+                bucket["resourceVersion"],
+            )
+        return out
+
     def watch(
         self, kind: str | None, since_rv: int,
         label_selector: str = "", field_selector: str = "",
@@ -230,6 +288,18 @@ class RemoteWatcher:
     @property
     def resource_version(self) -> int:
         return self._rv
+
+    @property
+    def bulk_pollable(self) -> bool:
+        """Eligible for the informer bundle's batched multi-kind poll —
+        only an unscoped watcher (the batched endpoint carries no
+        selector state)."""
+        return not self._sel
+
+    def advance(self, cursor: int) -> None:
+        """Move the cursor after a batched poll delivered this kind's
+        events out-of-band."""
+        self._rv = cursor
 
     def poll(self) -> list[WatchEvent]:
         # the long-poll must stay under the transport timeout or a quiet

@@ -23,11 +23,36 @@ envelope here:
     options; spec.nodeName is how a kubelet watches only its own pods) —
     a non-matching ADDED/MODIFIED is delivered as a DELETED tombstone with
     no object body
+    GET    /apis/?watch=1&buckets=pods:12,nodes:7[&timeoutSeconds=T]
+                                        BATCHED watch poll: drain several
+                                        kinds' cursors in ONE round trip;
+                                        per-kind {"events", "resourceVersion"}
+                                        (or {"code": 410} — only that kind
+                                        relists). One request replaces the
+                                        informer bundle's N per-kind polls.
     GET    /apis/<kind>/<key…>          get → {"object": …, "resourceVersion": N}
     POST   /apis/<kind>/<key…>          create (409 on exists)
+    POST   /apis/<kind>:bulk            BULK verb: {"ops": [{"op": "create|
+                                        update|patch|delete|get", "key": …,
+                                        "object": …, "resourceVersion": N?},
+                                        …]} applied under ONE store lock
+                                        acquisition → {"results": [{"status",
+                                        "resourceVersion", "error"?,
+                                        "object"?}, …]} positional, per-op
+                                        conflict/admission semantics
+                                        identical to the single-op verbs
+                                        (a mid-batch 409 fails only its op)
     PUT    /apis/<kind>/<key…>[?resourceVersion=N]
                                         update; CAS conflict → 409
     DELETE /apis/<kind>/<key…>          delete (404 when absent)
+
+Watch responses are assembled from a serialize-once event cache (the
+reference watch cache's CachingObject): each event's JSON is encoded once
+per (kind, resourceVersion) and the cached bytes are shared across every
+watcher poll, batched poll, and stream frame — N watchers pay one encode,
+not N. Staleness is impossible by construction: every store write mints a
+fresh resourceVersion, so a mutated object can never be served from an old
+entry.
 
 Objects ride the Scheme codec (kubetpu.api.scheme — the "kind"-tagged JSON
 serializer), so any registered type round-trips. The watch response is the
@@ -49,8 +74,86 @@ from ..metrics.health import HealthChecks
 from ..store.memstore import CompactedError, ConflictError, MemStore
 from .admission import AdmissionDenied, Registry, ValidationError
 from .metrics import APIServerMetrics
+from .remote import BULK_SUFFIX   # ONE wire constant for both sides
 
 PREFIX = "/apis/"
+
+#: the bulk paths' exception ladder: ONE copy of the per-op
+#: exception→status mapping (the inverse of memstore.bulk_result_error),
+#: so the fast path, the sequential path, and the single verbs cannot
+#: drift. Order matters: ValidationError IS a ValueError.
+_OP_ERROR_STATUS: tuple = (
+    (ConflictError, 409),
+    (ValidationError, 422),
+    (AdmissionDenied, 403),
+    (KeyError, 404),
+    ((scheme.SchemeError, ValueError), 400),
+)
+
+#: the union, for except clauses
+_OP_ERRORS = (
+    ConflictError, ValidationError, AdmissionDenied, KeyError,
+    scheme.SchemeError, ValueError,
+)
+
+
+def _op_error_result(e: Exception) -> dict:
+    """Map one bulk-op exception to its per-op result dict."""
+    for types, status in _OP_ERROR_STATUS:
+        if isinstance(e, types):
+            reason = (
+                str(e).strip("'\"") if isinstance(e, KeyError) else str(e)
+            )
+            return {"status": status, "resourceVersion": 0, "error": reason}
+    raise e  # unmapped: let the request-level 500 handler see it
+
+
+class EventEncodeCache:
+    """Serialize-once watch fan-out (the reference watch cache's
+    CachingObject, cacher/caching_object.go): one JSON encoding per event,
+    keyed by (kind, resourceVersion) — unique per event because every
+    store write bumps the global revision exactly once — and shared by
+    every long-poll reply, batched poll bucket, and stream frame. Bounded
+    LRU sized to the store's event history; hit/miss counters feed the
+    apiserver metric set."""
+
+    def __init__(self, maxsize: int = 8192) -> None:
+        import collections
+        import threading
+
+        self._maxsize = maxsize
+        self._lock = threading.Lock()
+        self._entries: "collections.OrderedDict[tuple, bytes]" = (
+            collections.OrderedDict()
+        )
+        self.hits = 0
+        self.misses = 0
+
+    def event_bytes(self, e) -> bytes:
+        key = (e.kind, e.resource_version)
+        with self._lock:
+            cached = self._entries.get(key)
+            if cached is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                return cached
+        # encode OUTSIDE the lock, last-writer-wins on insert: when a
+        # write wakes N long-poll watchers at once, the worst case is a
+        # handful of concurrent encodes of one small event — cheaper than
+        # ever blocking a request thread on another's encode. The steady
+        # win (every later poll/stream frame reuses the bytes) is carried
+        # by the LRU.
+        body = json.dumps({
+            "type": e.type, "key": e.key,
+            "object": scheme.encode(e.obj),
+            "resourceVersion": e.resource_version,
+        }, separators=(",", ":")).encode()
+        with self._lock:
+            self.misses += 1
+            self._entries[key] = body
+            while len(self._entries) > self._maxsize:
+                self._entries.popitem(last=False)
+        return body
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -58,6 +161,7 @@ class _Handler(BaseHTTPRequestHandler):
     registry: Registry  # admission + validation chain (bound by the factory)
     metrics: APIServerMetrics   # request instrumentation (bound by factory)
     health: HealthChecks        # /healthz /readyz /livez (bound by factory)
+    event_cache: EventEncodeCache   # serialize-once fan-out (bound by factory)
     metrics_sources: tuple = ()  # extra Prometheus-text providers
     protocol_version = "HTTP/1.1"
 
@@ -66,8 +170,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     # ------------------------------------------------------------ plumbing
     def _reply(self, obj, status: int = 200) -> None:
+        self._reply_bytes(json.dumps(obj).encode(), status=status)
+
+    def _reply_bytes(self, body: bytes, status: int = 200) -> None:
+        """Pre-serialized JSON reply — the serialize-once watch paths hand
+        cached event bytes straight to the socket."""
         self._status = status
-        body = json.dumps(obj).encode()
         self.send_response(status)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
@@ -136,6 +244,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         kind, key, q = self._route()
         if kind is None:
+            if q.get("watch") and q.get("buckets"):
+                # batched multi-kind watch poll: N informer cursors, one
+                # round trip (long-running like every watch)
+                with self.metrics.track(
+                    "WATCH", "multi", lambda: getattr(self, "_status", 0),
+                    long_running=True,
+                ):
+                    try:
+                        self._watch_bulk(q)
+                    except ValueError as e:
+                        self._error(400, str(e))
+                    except Exception as e:
+                        self._error(500, f"{type(e).__name__}: {e}")
+                return
             self._error(404, "unknown path")
             return
         if key is None and q.get("watch"):
@@ -206,21 +328,29 @@ class _Handler(BaseHTTPRequestHandler):
         fs = q.get("fieldSelector", "")
         return SelectorView(ls, fs) if (ls or fs) else None
 
-    @staticmethod
-    def _event_json(e, scoped: bool) -> dict:
+    def _event_bytes(self, e, scoped: bool) -> bytes:
+        """One event's wire JSON. Unscoped (and scoped non-DELETED) events
+        ride the serialize-once cache; a scoped DELETED is a per-view
+        tombstone — possibly a selector REWRITE sharing the original
+        event's (kind, rv) — so it must never touch the shared cache."""
         if scoped and e.type == "DELETED":
             # selector-scoped stream: never ship a body on DELETED (the
             # informer deletes by key; a tombstoned object may not even
             # match the selector)
-            return {
+            return json.dumps({
                 "type": "DELETED", "key": e.key, "object": None,
                 "resourceVersion": e.resource_version,
-            }
-        return {
-            "type": e.type, "key": e.key,
-            "object": scheme.encode(e.obj),
-            "resourceVersion": e.resource_version,
-        }
+            }, separators=(",", ":")).encode()
+        return self.event_cache.event_bytes(e)
+
+    def _events_body(self, events, cursor: int, scoped: bool) -> bytes:
+        """The long-poll reply (and a batched-poll bucket) assembled from
+        cached event bytes."""
+        return (
+            b'{"events":['
+            + b",".join(self._event_bytes(e, scoped) for e in events)
+            + b'],"resourceVersion":' + str(cursor).encode() + b"}"
+        )
 
     def _watch(self, kind: str, q: dict) -> None:
         rv = int(q.get("resourceVersion", 0))
@@ -237,12 +367,43 @@ class _Handler(BaseHTTPRequestHandler):
             return
         if view is not None:
             events = view.filter(events)
-        self._reply({
-            "events": [
-                self._event_json(e, view is not None) for e in events
-            ],
-            "resourceVersion": cursor,
-        })
+        self._reply_bytes(self._events_body(events, cursor, view is not None))
+
+    def _watch_bulk(self, q: dict) -> None:
+        """Batched watch poll: ``buckets=pods:12,nodes:7`` drains every
+        kind's cursor — ONE store lock acquisition, ONE HTTP round trip —
+        with per-kind results (a compacted cursor 410s only its own
+        bucket). Selectors are not supported on the batched poll (the
+        per-kind endpoint remains for scoped watchers)."""
+        buckets: dict[str, int] = {}
+        for part in q["buckets"].split(","):
+            kind, sep, rv = part.rpartition(":")
+            if not sep or not kind:
+                raise ValueError(f"malformed bucket {part!r} (want kind:rv)")
+            buckets[kind] = int(rv)
+        timeout = min(float(q.get("timeoutSeconds", 0)), 60.0)
+        results, drain_rv = self.store.events_since_bulk(buckets)
+        if timeout > 0 and not any(
+            isinstance(r, CompactedError) or r[0]
+            for r in results.values()
+        ):
+            # wait on the revision captured AT the drain (same lock round):
+            # a write landing after the drain wakes this immediately
+            self.store.wait_for(drain_rv, timeout=timeout)
+            results, _ = self.store.events_since_bulk(buckets)
+        parts = []
+        for kind in buckets:
+            res = results[kind]
+            if isinstance(res, CompactedError):
+                body = json.dumps(
+                    {"error": str(res), "code": 410},
+                    separators=(",", ":"),
+                ).encode()
+            else:
+                events, cursor = res
+                body = self._events_body(events, cursor, scoped=False)
+            parts.append(json.dumps(kind).encode() + b":" + body)
+        self._reply_bytes(b'{"buckets":{' + b",".join(parts) + b"}}")
 
     def _watch_stream(self, kind: str, q: dict) -> None:
         """Chunked ndjson stream: events written as they happen, connection
@@ -265,8 +426,7 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
-        def chunk(line: dict) -> bool:
-            data = (json.dumps(line) + "\n").encode()
+        def chunk_bytes(data: bytes) -> bool:
             try:
                 self.wfile.write(f"{len(data):x}\r\n".encode())
                 self.wfile.write(data + b"\r\n")
@@ -274,6 +434,9 @@ class _Handler(BaseHTTPRequestHandler):
                 return True
             except (BrokenPipeError, ConnectionResetError, OSError):
                 return False
+
+        def chunk(line: dict) -> bool:
+            return chunk_bytes((json.dumps(line) + "\n").encode())
         try:
             while True:
                 try:
@@ -284,7 +447,11 @@ class _Handler(BaseHTTPRequestHandler):
                 if view is not None:
                     events = view.filter(events)
                 for e in events:
-                    if not chunk(self._event_json(e, view is not None)):
+                    # stream frames share the serialize-once cache with the
+                    # poll paths — one encode serves every watcher
+                    if not chunk_bytes(
+                        self._event_bytes(e, view is not None) + b"\n"
+                    ):
                         return   # client hung up: no terminator possible
                 rv = cursor
                 remaining = deadline - _time.monotonic()
@@ -298,8 +465,41 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
+    # -------------------------------------------------- shared verb cores
+    # decode → admission → storage for one object, shared verbatim by the
+    # single-op handlers and the bulk sequential path (the write path of
+    # registry/store.go:514) — one copy, so the two surfaces cannot drift
+
+    def _apply_create(self, kind: str, key: str, payload) -> int:
+        obj = scheme.decode(payload)
+        # the admission chain's write locks span admit AND create so a
+        # usage-counting validator (quota) cannot race a concurrent
+        # create of the same scope
+        with self.registry.locked(kind, key, obj, verb="create"):
+            obj = self.registry.admit(kind, key, obj, verb="create")
+            return self.store.create(kind, key, obj)
+
+    def _apply_update(
+        self, kind: str, key: str, payload, expect_rv: int | None
+    ) -> int:
+        obj = scheme.decode(payload)
+        with self.registry.locked(kind, key, obj, verb="update"):
+            old, _old_rv = self.store.get(kind, key)
+            obj = self.registry.admit(kind, key, obj, old=old, verb="update")
+            return self.store.update(kind, key, obj, expect_rv=expect_rv)
+
     def do_POST(self) -> None:  # noqa: N802
         kind, key, _ = self._route()
+        if kind is not None and key is None and kind.endswith(BULK_SUFFIX):
+            resource = kind[: -len(BULK_SUFFIX)]
+            with self.metrics.track(
+                "BULK", resource, lambda: getattr(self, "_status", 0)
+            ):
+                try:
+                    self._do_bulk(resource)
+                except Exception as e:
+                    self._error(500, f"{type(e).__name__}: {e}")
+            return
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
@@ -307,16 +507,7 @@ class _Handler(BaseHTTPRequestHandler):
             "CREATE", kind, lambda: getattr(self, "_status", 0)
         ):
             try:
-                obj = scheme.decode(self._read_body())
-                # decode → admission (mutating) → validate → admission
-                # (validating) → storage — the reference write path
-                # (registry/store.go:514 Create's strategy run). The
-                # admission chain's write locks span admit AND create so a
-                # usage-counting validator (quota) cannot race a concurrent
-                # create of the same scope.
-                with self.registry.locked(kind, key, obj, verb="create"):
-                    obj = self.registry.admit(kind, key, obj, verb="create")
-                    rv = self.store.create(kind, key, obj)
+                rv = self._apply_create(kind, key, self._read_body())
                 self._reply({"resourceVersion": rv}, status=201)
             except ConflictError as e:
                 self._error(409, str(e))
@@ -338,17 +529,11 @@ class _Handler(BaseHTTPRequestHandler):
             "UPDATE", kind, lambda: getattr(self, "_status", 0)
         ):
             try:
-                obj = scheme.decode(self._read_body())
-                with self.registry.locked(kind, key, obj, verb="update"):
-                    old, _old_rv = self.store.get(kind, key)
-                    obj = self.registry.admit(
-                        kind, key, obj, old=old, verb="update"
-                    )
-                    expect = (
-                        int(q["resourceVersion"])
-                        if "resourceVersion" in q else None
-                    )
-                    rv = self.store.update(kind, key, obj, expect_rv=expect)
+                expect = (
+                    int(q["resourceVersion"])
+                    if "resourceVersion" in q else None
+                )
+                rv = self._apply_update(kind, key, self._read_body(), expect)
                 self._reply({"resourceVersion": rv})
             except ConflictError as e:
                 self._error(409, str(e))
@@ -360,6 +545,121 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(400, str(e))
             except Exception as e:
                 self._error(500, f"{type(e).__name__}: {e}")
+
+    def _do_bulk(self, kind: str) -> None:
+        """POST /apis/<kind>:bulk — results are positional; each op's
+        status/resourceVersion/error matches what its single-op verb would
+        have returned, so a mid-batch conflict or admission veto fails only
+        its own op. Two execution paths, chosen by the kind's admission
+        shape:
+
+        - no dynamic admission (no hooks, no write locks — the scheduler's
+          bind/status traffic): decode + strategy-validate per op, then
+          apply every surviving storage write under ONE store lock
+          acquisition (``MemStore.bulk``);
+        - dynamic admission present (quota locks, webhooks): each op runs
+          the EXACT single-verb chain sequentially — lock spans admit AND
+          write, and an update's ``old`` reflects earlier ops in the same
+          batch — trading the one-lock storage pass for unchanged
+          admission atomicity (the round trip is still one)."""
+        body = self._read_body()
+        ops = body.get("ops")
+        if not isinstance(ops, list):
+            self._error(400, "body must carry an ops list")
+            return
+        if self.registry.has_dynamic_admission(kind):
+            out = [self._bulk_op_sequential(kind, op) for op in ops]
+            if any(r.get("status", 500) < 400 for r in out):
+                self.metrics.admit_resource(kind)
+            self._reply({"results": out})
+            return
+        results: list[dict | None] = []
+        prepared: list[dict | None] = []
+        for op in ops:
+            verb = op.get("op") if isinstance(op, dict) else None
+            key = op.get("key") if isinstance(op, dict) else None
+            try:
+                if not key or verb not in (
+                    "create", "update", "patch", "delete", "get"
+                ):
+                    raise ValueError(
+                        "op must carry a key and one of "
+                        "create/update/patch/delete/get"
+                    )
+                if verb in ("create", "update", "patch"):
+                    obj = scheme.decode(op.get("object") or {})
+                    real = "create" if verb == "create" else "update"
+                    # this path only runs WITHOUT dynamic admission, so
+                    # admit() is pure strategy validation — no locker to
+                    # hold, no hook to feed `old`, no per-op store read
+                    obj = self.registry.admit(kind, key, obj, verb=real)
+                    prepared.append({
+                        "op": real, "key": key, "object": obj,
+                        "expect_rv": op.get("resourceVersion"),
+                    })
+                else:
+                    prepared.append({"op": verb, "key": key})
+                results.append(None)     # filled from the storage pass
+            except _OP_ERRORS as e:
+                results.append(_op_error_result(e))
+                prepared.append(None)
+        store_ops = [p for p in prepared if p is not None]
+        store_res = iter(self.store.bulk(kind, store_ops))
+        any_ok = False
+        out = []
+        for res, prep in zip(results, prepared):
+            if res is None:
+                res = dict(next(store_res))
+                if "object" in res:
+                    res["object"] = scheme.encode(res["object"])
+            if res.get("status", 500) < 400:
+                any_ok = True
+            res.setdefault("resourceVersion", 0)
+            out.append(res)
+        if any_ok:
+            # a 2xx op proves the kind exists (same gate as the single
+            # verbs' proving responses)
+            self.metrics.admit_resource(kind)
+        self._reply({"results": out})
+
+    def _bulk_op_sequential(self, kind: str, op) -> dict:
+        """One bulk op through the exact single-verb chain (the dynamic-
+        admission path): write lock spanning admit AND storage write,
+        ``old`` read inside the lock after every earlier op applied."""
+        verb = op.get("op") if isinstance(op, dict) else None
+        key = op.get("key") if isinstance(op, dict) else None
+        try:
+            if not key or verb not in (
+                "create", "update", "patch", "delete", "get"
+            ):
+                raise ValueError(
+                    "op must carry a key and one of "
+                    "create/update/patch/delete/get"
+                )
+            if verb == "create":
+                rv = self._apply_create(kind, key, op.get("object") or {})
+                return {"status": 201, "resourceVersion": rv}
+            if verb in ("update", "patch"):
+                rv = self._apply_update(
+                    kind, key, op.get("object") or {},
+                    op.get("resourceVersion"),
+                )
+                return {"status": 200, "resourceVersion": rv}
+            if verb == "delete":
+                rv = self.store.delete(kind, key)
+                return {"status": 200, "resourceVersion": rv}
+            obj, rv = self.store.get(kind, key)      # verb == "get"
+            if obj is None:
+                return {
+                    "status": 404, "resourceVersion": 0,
+                    "error": f"{kind}/{key} not found",
+                }
+            return {
+                "status": 200, "resourceVersion": rv,
+                "object": scheme.encode(obj),
+            }
+        except _OP_ERRORS as e:
+            return _op_error_result(e)
 
     def do_DELETE(self) -> None:  # noqa: N802
         kind, key, _ = self._route()
@@ -407,10 +707,30 @@ class APIServer:
         self.health.add_check(
             "store", _store_check, endpoints=("healthz", "readyz")
         )
+        # serialize-once watch fan-out: one JSON encode per event, shared
+        # across every watcher poll, batched poll, and stream frame
+        self.event_cache = EventEncodeCache()
+
+        def _event_cache_metrics() -> str:
+            c = self.event_cache
+            return (
+                "# HELP apiserver_watch_event_encodings_total Watch event "
+                "JSON serializations by outcome (hit = cached bytes "
+                "reused across watchers).\n"
+                "# TYPE apiserver_watch_event_encodings_total counter\n"
+                "apiserver_watch_event_encodings_total{result=\"hit\"} "
+                f"{c.hits}\n"
+                "apiserver_watch_event_encodings_total{result=\"miss\"} "
+                f"{c.misses}\n"
+            )
+
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
             "metrics": self.metrics, "health": self.health,
-            "metrics_sources": tuple(metrics_sources),
+            "event_cache": self.event_cache,
+            "metrics_sources": (
+                _event_cache_metrics, *metrics_sources,
+            ),
             # responses are small; Nagle + the client's delayed ACK would
             # stall every keep-alive request ~40 ms (a handler-class knob:
             # socketserver.StreamRequestHandler.disable_nagle_algorithm)

@@ -399,7 +399,13 @@ class ExtenderServer:
         port: int = 0,
     ) -> None:
         self.backend = backend or ExtenderBackend()
-        handler = type("BoundHandler", (_Handler,), {"backend": self.backend})
+        handler = type("BoundHandler", (_Handler,), {
+            "backend": self.backend,
+            # webhook request/response bodies are small: without
+            # TCP_NODELAY, Nagle + the scheduler's delayed ACK stalls every
+            # keep-alive extender call ~40 ms (same knob as the apiserver)
+            "disable_nagle_algorithm": True,
+        })
         self._httpd = ThreadingHTTPServer((host, port), handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True

@@ -248,10 +248,11 @@ def cmd_scheduler(args) -> int:
         StoreClient(store), cfg=cfg, engine=args.engine,
         pipeline=(args.pipeline == "on"),
         encode_cache=(args.encode_cache == "on"),
+        bulk=(args.bulk == "on"),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
-    informers = SchedulerInformers(store, sched)
+    informers = SchedulerInformers(store, sched, bulk=(args.bulk == "on"))
     _retry_start(informers.start, "scheduler informers")
     if args.prewarm:
         # pay the XLA bucket ladder up front so the first real cycles never
@@ -567,6 +568,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "gathered at cycle time; cached encodes are "
                            "bit-identical to fresh ones ('off' is the "
                            "debugging escape hatch)")
+    schd.add_argument("--bulk", default="on", choices=["on", "off"],
+                      help="opportunistic API-plane batching: a cycle's "
+                           "binds/status patches flush as bulk RPCs at the "
+                           "cycle boundary and the informer bundle polls "
+                           "all kinds in one batched request; bindings "
+                           "stay pod-for-pod identical to per-call mode "
+                           "('off' is the debugging escape hatch)")
     schd.add_argument("--prewarm", action="store_true",
                       help="compile the assign program for the full "
                            "batch-size bucket ladder at startup, so "

@@ -61,16 +61,85 @@ class StoreClient:
             )
         self.store.update(PODS, key, current.with_node(node_name), expect_rv=rv)
 
+    def bulk_bind(
+        self, pairs: "list[tuple[t.Pod, str]]"
+    ) -> "list[Exception | None]":
+        """One scheduling cycle's binds as TWO bulk round trips (one bulk
+        GET for current objects + CAS revisions, one bulk UPDATE) instead
+        of 2·N single-op requests — the dispatcher's micro-batch path.
+        Positional results: None for a landed bind, else the exception the
+        single-op ``bind`` would have raised for that pod (the dispatcher
+        falls back to per-call execution for those, so the bind-error →
+        forget-assumed → requeue path is unchanged pod for pod)."""
+        from ..store.memstore import bulk_result_error
+
+        store = self.store
+        if not hasattr(store, "bulk"):
+            raise NotImplementedError("store has no bulk verb")
+        keys = [pod_store_key(pod) for pod, _ in pairs]
+        gets = store.bulk(PODS, [{"op": "get", "key": k} for k in keys])
+        errs: "list[Exception | None]" = [None] * len(pairs)
+        upd_idx: list[int] = []
+        upd_ops: list[dict] = []
+        for i, ((pod, node_name), res) in enumerate(zip(pairs, gets)):
+            current = res.get("object")
+            if res.get("status", 500) >= 400 or current is None:
+                errs[i] = RuntimeError(
+                    f"bind conflict: pod {keys[i]} is gone"
+                )
+                continue
+            if current.node_name and current.node_name != node_name:
+                errs[i] = RuntimeError(
+                    f"bind conflict: pod {keys[i]} already on "
+                    f"{current.node_name}"
+                )
+                continue
+            upd_idx.append(i)
+            upd_ops.append({
+                "op": "update", "key": keys[i],
+                "object": current.with_node(node_name),
+                "expect_rv": res["resourceVersion"],
+            })
+        if upd_ops:
+            for i, res in zip(upd_idx, store.bulk(PODS, upd_ops)):
+                errs[i] = bulk_result_error(res)
+        return errs
+
     def patch_status(self, pod: t.Pod, reason: str, message: str = "") -> None:
         # PodScheduled=False condition patch; conditions aren't part of the
         # scheduling envelope, so record without a store write
         self.status_patches.append((pod_store_key(pod), reason))
 
-    def delete_pod(self, pod: t.Pod) -> None:
+    def bulk_status_patch(
+        self, items: "list[tuple[t.Pod, str, str]]"
+    ) -> "list[Exception | None]":
+        for pod, reason, _message in items:
+            self.status_patches.append((pod_store_key(pod), reason))
+        return [None] * len(items)
+
+    def delete_pod(self, pod: t.Pod, reason: str = "") -> None:
         try:
             self.store.delete(PODS, pod_store_key(pod))
         except KeyError:
             pass  # victim already gone
+
+    def bulk_delete_victim(
+        self, items: "list[tuple[t.Pod, str]]"
+    ) -> "list[Exception | None]":
+        """Preemption victims deleted in one bulk round trip; a 404 is a
+        victim already gone — the single-op path's pass."""
+        from ..store.memstore import bulk_result_error
+
+        store = self.store
+        if not hasattr(store, "bulk"):
+            raise NotImplementedError("store has no bulk verb")
+        res = store.bulk(PODS, [
+            {"op": "delete", "key": pod_store_key(pod)} for pod, _ in items
+        ])
+        return [
+            None if (r.get("status") == 404) else bulk_result_error(r)
+            for r in res
+        ]
 
     def nominate(self, pod: t.Pod, node_name: str) -> None:
         # status.nominatedNodeName patch — nominations live in the
@@ -111,11 +180,19 @@ class StoreClient:
 
 
 class SchedulerInformers:
-    """One informer per watched kind, bound to a Scheduler's handlers."""
+    """One informer per watched kind, bound to a Scheduler's handlers.
 
-    def __init__(self, store: MemStore, sched: Any) -> None:
+    ``bulk`` (default on, effective only when the store exposes
+    ``watch_bulk`` — RemoteStore): ``pump()`` drains EVERY kind's watch
+    cursor in one batched round trip instead of one poll per kind, each
+    kind's frame delivered to its informer under a single lock acquisition.
+    Deliveries are event-for-event identical to per-kind polling — the
+    ``--bulk off`` escape hatch restores the per-kind path."""
+
+    def __init__(self, store: MemStore, sched: Any, bulk: bool = True) -> None:
         self.store = store
         self.sched = sched
+        self._bulk = bulk and hasattr(store, "watch_bulk")
         self._reflectors: list[Reflector] = []
         s = sched
         self._bind(NODES, s.on_node_add,
@@ -167,10 +244,51 @@ class SchedulerInformers:
 
     def pump(self) -> int:
         """Drain pending watch events into the scheduler. Returns the
-        number of deliveries."""
+        number of deliveries. With ``bulk`` on, all kinds ride one batched
+        poll; any reflector the batched path cannot serve (not yet synced,
+        scoped, or pull-only watcher) falls the whole pump back to
+        per-kind stepping."""
+        if self._bulk:
+            pumped = self._pump_bulk()
+            if pumped is not None:
+                return pumped
         total = 0
         for r in self._reflectors:
             total += r.step()
+        return total
+
+    def _pump_bulk(self) -> int | None:
+        """One batched watch poll for every reflector's cursor. None =
+        ineligible (caller falls back to per-kind steps)."""
+        from ..store.memstore import CompactedError
+
+        cursors: dict[str, int] = {}
+        for r in self._reflectors:
+            w = r._watcher
+            if w is None or not getattr(w, "bulk_pollable", False):
+                return None
+            cursors[r.informer.kind] = w.resource_version
+        try:
+            buckets = self.store.watch_bulk(cursors)
+        except ConnectionError:
+            # transient transport failure: same retry-next-pump shape as
+            # Reflector.step's
+            return 0
+        total = 0
+        for r in self._reflectors:
+            res = buckets.get(r.informer.kind)
+            if res is None:
+                continue
+            if isinstance(res, CompactedError):
+                # only this kind relists (reflector.go's too-old handling)
+                r.relists += 1
+                r.sync()
+                total += len(r.informer.store)
+                continue
+            events, cursor = res
+            r._watcher.advance(cursor)
+            r.informer._apply_batch(events)
+            total += len(events)
         return total
 
     @property

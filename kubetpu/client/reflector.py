@@ -60,40 +60,62 @@ class FuncHandler:
 
 
 class SharedInformer:
-    """Local indexed store + handler fan-out for ONE resource kind."""
+    """Local indexed store + handler fan-out for ONE resource kind.
+
+    Deliveries are serialized under one lock (sharedIndexInformer's
+    ``blockDeltas`` mutex): a whole watch-frame BATCH is dispatched under a
+    single acquisition (``_apply_batch``) instead of locking per event, so
+    the batched poll's N-event frame pays one lock round."""
 
     def __init__(self, kind: str) -> None:
+        import threading
+
         self.kind = kind
         self.store: dict[str, Any] = {}
         self._handlers: list[Handler] = []
+        self._lock = threading.Lock()
         self.synced = False
 
     def add_handler(self, handler: Handler) -> None:
-        self._handlers.append(handler)
-        # late registrations replay the current store (shared_informer.go
-        # AddEventHandler delivers synthetic adds for existing objects)
-        for obj in self.store.values():
-            handler.on_add(obj)
+        with self._lock:
+            self._handlers.append(handler)
+            # late registrations replay the current store
+            # (shared_informer.go AddEventHandler delivers synthetic adds
+            # for existing objects)
+            for obj in self.store.values():
+                handler.on_add(obj)
 
     # deliveries from the reflector
     def _replace(self, items: list[tuple[str, Any]]) -> None:
-        new_keys = {k for k, _ in items}
-        for key in list(self.store):
-            if key not in new_keys:
-                gone = self.store.pop(key)
+        with self._lock:
+            new_keys = {k for k, _ in items}
+            for key in list(self.store):
+                if key not in new_keys:
+                    gone = self.store.pop(key)
+                    for h in self._handlers:
+                        h.on_delete(gone)
+            for key, obj in items:
+                old = self.store.get(key)
+                self.store[key] = obj
                 for h in self._handlers:
-                    h.on_delete(gone)
-        for key, obj in items:
-            old = self.store.get(key)
-            self.store[key] = obj
-            for h in self._handlers:
-                if old is None:
-                    h.on_add(obj)
-                elif old is not obj:
-                    h.on_update(old, obj)
-        self.synced = True
+                    if old is None:
+                        h.on_add(obj)
+                    elif old is not obj:
+                        h.on_update(old, obj)
+            self.synced = True
 
     def _apply(self, ev_type: str, key: str, obj: Any) -> None:
+        with self._lock:
+            self._apply_locked(ev_type, key, obj)
+
+    def _apply_batch(self, events) -> None:
+        """One watch frame's events dispatched under a SINGLE lock
+        acquisition."""
+        with self._lock:
+            for ev in events:
+                self._apply_locked(ev.type, ev.key, ev.obj)
+
+    def _apply_locked(self, ev_type: str, key: str, obj: Any) -> None:
         if ev_type == DELETED:
             old = self.store.pop(key, None)
             if old is not None:
@@ -187,6 +209,5 @@ class Reflector:
             # transient transport failure (apiserver restarting): keep the
             # local store, retry on the next pump — ListAndWatch's retry
             return 0
-        for ev in events:
-            self.informer._apply(ev.type, ev.key, ev.obj)
+        self.informer._apply_batch(events)
         return len(events)

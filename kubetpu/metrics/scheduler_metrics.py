@@ -92,6 +92,21 @@ class SchedulerMetricsRegistry:
             "Number of pods added to scheduling queues by event and queue type.",
             labels=("queue", "event"),
         )
+        # API dispatcher lifetime counts, set at scrape time from
+        # APIDispatcher.stats() (a gauge because the dispatcher owns the
+        # monotonic counters; "errors" is the satellite's failed-API-write
+        # signal, "batches"/"batched_calls" size the bulk micro-batches)
+        self.api_dispatcher_calls = r.gauge(
+            "scheduler_api_dispatcher_calls",
+            "API dispatcher lifetime call counts by event: added, executed, "
+            "errors, batches (bulk RPCs issued), batched_calls (calls that "
+            "rode a bulk RPC).",
+            labels=("event",),
+        )
+
+    def set_dispatcher_stats(self, stats: dict) -> None:
+        for event, value in stats.items():
+            self.api_dispatcher_calls.labels(event).set(value)
 
     def expose(self) -> str:
         return self.registry.expose()

@@ -31,6 +31,10 @@ def main(argv=None) -> None:
     ap.add_argument("--encode-cache", default="on", choices=["on", "off"],
                     help="event-time template-keyed pod encoding (bit-"
                          "identical to fresh encode; 'off' to debug)")
+    ap.add_argument("--bulk", default="on", choices=["on", "off"],
+                    help="opportunistic API-plane batching: cycle-boundary "
+                         "bulk bind/status RPCs + batched informer polls "
+                         "(bindings identical to per-call; 'off' to debug)")
     ap.add_argument("--artifacts-dir", default=None,
                     help="dump per-workload diagnosis artifacts here: the "
                          "cycle trace as Perfetto-loadable Chrome-trace "
@@ -50,6 +54,7 @@ def main(argv=None) -> None:
         engine=args.engine, artifacts_dir=args.artifacts_dir,
         pipeline=(args.pipeline == "on"),
         encode_cache=(args.encode_cache == "on"),
+        bulk=(args.bulk == "on"),
     )
     if args.label:
         for r in run_label(args.label, **kwargs):

@@ -62,6 +62,12 @@ class WorkloadResult:
     encode_ms_per_cycle: float | None = None
     encode_wall_frac: float | None = None
     encode_cache_hit_rate: float | None = None
+    # API-plane view of the measured phase (fullstack only for rpcs): HTTP
+    # round trips per scheduled pod — the tentpole's acceptance metric —
+    # plus the dispatcher's mean bulk micro-batch size and error count
+    rpcs_per_scheduled_pod: float | None = None
+    dispatcher_batch_mean: float | None = None
+    dispatcher_errors: int = 0
     # post-run metric snapshot (SchedulerMetricsRegistry.snapshot): p50/p99
     # from the histograms + schedule_attempts by result — every BENCH json
     # carries its own diagnosis
@@ -106,6 +112,12 @@ class WorkloadResult:
             out["encode_wall_frac"] = round(self.encode_wall_frac, 3)
         if self.encode_cache_hit_rate is not None:
             out["encode_cache_hit_rate"] = round(self.encode_cache_hit_rate, 4)
+        if self.rpcs_per_scheduled_pod is not None:
+            out["rpcs_per_scheduled_pod"] = round(self.rpcs_per_scheduled_pod, 4)
+        if self.dispatcher_batch_mean is not None:
+            out["dispatcher_batch_mean"] = round(self.dispatcher_batch_mean, 1)
+        if self.dispatcher_errors:
+            out["dispatcher_errors"] = self.dispatcher_errors
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -159,6 +171,14 @@ class _Client:
         self.bound_by_ns[pod.namespace] += 1
         self._events.append(("update", pod, pod.with_node(node_name)))
 
+    def bulk_bind(self, pairs) -> list:
+        # direct mode has no RPC to amortize; accepting the micro-batch
+        # keeps the dispatch shape (and its batch-size stats) identical to
+        # fullstack
+        for pod, node_name in pairs:
+            self.bind(pod, node_name)
+        return [None] * len(pairs)
+
     def delete_pod(self, pod: t.Pod, reason: str = "") -> None:
         self._events.append(("delete", pod, None))
 
@@ -201,6 +221,9 @@ def _begin_measured_phase(sched, warmup: bool, warm_pods):
             sum(sched.encode_cache.hits[k] for k in kinds),
             sum(sched.encode_cache.misses[k] for k in kinds),
         )
+    # dispatcher baseline: mean bulk batch size + errors scoped to the
+    # measured phase, not the init churn
+    sched._measure_disp0 = sched.dispatcher.stats()
     return (
         sched.metrics.schedule_attempts,
         sched.metrics.cycles,
@@ -237,6 +260,20 @@ def _encode_stats(sched, cycles0: int) -> dict:
         if dh + dm:
             out["encode_cache_hit_rate"] = dh / (dh + dm)
     return out
+
+
+def _dispatcher_stats(sched) -> dict:
+    """Measured-phase dispatcher summary: mean bulk micro-batch size and
+    API-write error count (deltas against the ``_begin_measured_phase``
+    baseline)."""
+    stats = sched.dispatcher.stats()
+    base = getattr(sched, "_measure_disp0", None) or {}
+    d_batches = stats["batches"] - base.get("batches", 0)
+    d_calls = stats["batched_calls"] - base.get("batched_calls", 0)
+    return dict(
+        dispatcher_batch_mean=(d_calls / d_batches) if d_batches else None,
+        dispatcher_errors=stats["errors"] - base.get("errors", 0),
+    )
 
 
 def _device_traffic_stats(sched, cycles0: int, duration: float) -> dict:
@@ -318,6 +355,7 @@ class _FsChurn:
     op: W.ChurnOp
     namespace: str
     remote: object
+    bulk: bool = True
     next_at: float = 0.0
     seq: int = 0
     live: list = field(default_factory=list)   # recreate-mode pool (keys)
@@ -325,11 +363,18 @@ class _FsChurn:
     def maybe_fire(self, now: float) -> None:
         from ..client.informers import PODS
 
+        creates: list[tuple[str, t.Pod]] = []
         while now >= self.next_at:
             self.next_at = (self.next_at or now) + self.op.interval_ms / 1000.0
             if self.op.mode == "recreate" and self.op.number and (
                 len(self.live) >= self.op.number
             ):
+                # a catch-up burst can wrap past ``number``: the victim may
+                # still be sitting in the unflushed create queue — flush
+                # first so every popped key exists before its delete
+                if creates:
+                    _bulk_create(self.remote, PODS, creates, bulk=self.bulk)
+                    creates = []
                 victim = self.live.pop(0)
                 try:
                     self.remote.delete(PODS, victim)
@@ -338,9 +383,37 @@ class _FsChurn:
             pod = self.op.template(f"churn-{self.seq}", self.namespace)
             self.seq += 1
             key = f"{self.namespace}/{pod.name}"
-            self.remote.create(PODS, key, pod)
+            creates.append((key, pod))
             if self.op.mode == "recreate":
                 self.live.append(key)
+        # everything due this fire rides one bulk create (a stalled loop
+        # catching up pays one RPC, not one per missed interval)
+        _bulk_create(self.remote, PODS, creates, bulk=self.bulk)
+
+
+def _bulk_create(
+    remote, kind: str, items: "list[tuple[str, object]]",
+    bulk: bool = True, chunk: int = 256,
+) -> None:
+    """Create ``items`` through the REST store — one bulk request per
+    ``chunk`` when the store has the bulk verb (the perf runner's
+    create-path RPC amortization), falling back to per-object creates
+    (and always for ``bulk=False``, the escape hatch's single-op path)."""
+    if bulk and len(items) > 1 and hasattr(remote, "bulk"):
+        from ..store.memstore import bulk_result_error
+
+        for i in range(0, len(items), chunk):
+            ops = [
+                {"op": "create", "key": k, "object": o}
+                for k, o in items[i:i + chunk]
+            ]
+            for res in remote.bulk(kind, ops):
+                err = bulk_result_error(res)
+                if err is not None:
+                    raise err
+        return
+    for k, o in items:
+        remote.create(kind, k, o)
 
 
 @dataclass
@@ -381,6 +454,7 @@ def run_workload(
     artifacts_dir: str | None = None,
     pipeline: bool = False,
     encode_cache: bool = True,
+    bulk: bool = True,
 ) -> WorkloadResult:
     """Execute one (test case, workload) pair and return the measurement.
     ``engine`` selects the assignment engine ("greedy" scan or "batched"
@@ -396,7 +470,9 @@ def run_workload(
     Chrome-trace JSON, /metrics snapshot, and device-side cycle records
     there (see ``dump_diagnosis_artifacts``). ``encode_cache`` toggles the
     event-time template-keyed encode cache (``--encode-cache off`` escape
-    hatch — cached and fresh encodes are bit-identical)."""
+    hatch — cached and fresh encodes are bit-identical). ``bulk`` toggles
+    the dispatcher's cycle-boundary micro-batching (``--bulk off`` escape
+    hatch — the off path is pod-for-pod identical)."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -407,6 +483,7 @@ def run_workload(
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
+        bulk=bulk,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     client.sched = sched
@@ -714,6 +791,7 @@ def run_workload(
         threshold_note=workload.threshold_note,
         **traffic,
         **_encode_stats(sched, cycles0),
+        **_dispatcher_stats(sched),
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops
@@ -758,6 +836,7 @@ def run_workload_full_stack(
     artifacts_dir: str | None = None,
     pipeline: bool = False,
     encode_cache: bool = True,
+    bulk: bool = True,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -810,13 +889,23 @@ def run_workload_full_stack(
             with self._count_lock:
                 self.bound_by_ns[pod.namespace] += 1
 
+        def bulk_bind(self, pairs) -> list:
+            errs = super().bulk_bind(pairs)
+            with self._count_lock:
+                for (pod, _node), err in zip(pairs, errs):
+                    # failed ops fall back through bind(), which counts
+                    if err is None:
+                        self.bound_by_ns[pod.namespace] += 1
+            return errs
+
     client = _CountingClient(remote)
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
+        bulk=bulk,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
-    informers = SchedulerInformers(remote, sched)
+    informers = SchedulerInformers(remote, sched, bulk=bulk)
     informers.start()
 
     measured = 0
@@ -824,6 +913,8 @@ def run_workload_full_stack(
     attempts0 = cycles0 = 0
     prom_base = None
     op_ns_counter = 0
+    requests0 = 0
+    rpcs_total = 0        # measured-phase apiserver round trips
     churns: list[_FsChurn] = []
     deleters: list[_FsDeleter] = []
     created_keys_by_ns: dict[str, list[str]] = {}
@@ -864,21 +955,25 @@ def run_workload_full_stack(
             if isinstance(op, W.CreateNodesOp):
                 n = op.count or params[op.count_param]
                 factory = op.template or W.node_default
-                for i in range(n):
-                    node = factory(i, op.zones)
-                    remote.create(NODES, node.name, node)
+                nodes = [factory(i, op.zones) for i in range(n)]
+                _bulk_create(
+                    remote, NODES, [(nd.name, nd) for nd in nodes], bulk=bulk,
+                )
             elif isinstance(op, W.CreateNamespacesOp):
                 n = params[op.count_param] if op.count_param else op.count
-                for i in range(n):
-                    remote.create(NAMESPACES, f"{op.prefix}-{i}", t.Namespace(
+                _bulk_create(remote, NAMESPACES, [
+                    (f"{op.prefix}-{i}", t.Namespace(
                         name=f"{op.prefix}-{i}", labels=op.labels,
                     ))
+                    for i in range(n)
+                ], bulk=bulk)
             elif isinstance(op, W.BarrierOp):
                 informers.pump()
                 sched.run_until_idle()
             elif isinstance(op, W.ChurnOp):
                 churns.append(_FsChurn(
                     op=op, namespace=f"churn-{len(churns)}", remote=remote,
+                    bulk=bulk,
                 ))
             elif isinstance(op, W.DeletePodsOp):
                 deleters.append(_FsDeleter(
@@ -902,17 +997,23 @@ def run_workload_full_stack(
                             for j in range(min(count, sched.max_batch))
                         ],
                     )
+                    requests0 = srv.metrics.total_requests()
+                items = []
                 for j in range(count):
                     pod = template(f"{prefix}-{ns}-{j}", ns)
                     key = f"{ns}/{pod.name}"
                     created_keys_by_ns.setdefault(ns, []).append(key)
-                    remote.create(PODS, key, pod)
+                    items.append((key, pod))
+                _bulk_create(remote, PODS, items, bulk=bulk)
                 if op.skip_wait:
                     continue
                 done, secs = settle(count, (ns,))
                 if op.collect_metrics:
                     measured += done
                     duration += secs
+                    # everything the measured phase cost the API plane:
+                    # pod creates, informer polls, binds, status patches
+                    rpcs_total += srv.metrics.total_requests() - requests0
         informers.pump()
         sched.dispatcher.sync()
         sched._drain_bind_completions()
@@ -942,6 +1043,10 @@ def run_workload_full_stack(
         threshold_note=workload.threshold_note,
         **traffic,
         **_encode_stats(sched, cycles0),
+        **_dispatcher_stats(sched),
+        rpcs_per_scheduled_pod=(
+            rpcs_total / measured if measured else None
+        ),
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops

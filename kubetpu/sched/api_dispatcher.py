@@ -10,6 +10,11 @@ machinery).
 
 ``workers=0`` runs calls inline at ``add`` time — deterministic mode for
 tests and single-threaded harnesses.
+
+Bulk mode (the reference's opportunistic cycle batching,
+framework/runtime/batch.go, riding the same pending-map machinery): calls
+accumulate across a scheduling cycle and ``flush`` drains them into
+per-call-type bulk RPCs — see the APIDispatcher docstring.
 """
 
 from __future__ import annotations
@@ -66,12 +71,18 @@ class BindCall:
     def execute(self, client: Any) -> None:
         if self.pre is not None:
             self.pre()
+        self.execute_api(client)
+        if self.post is not None:
+            self.post()
+
+    def execute_api(self, client: Any) -> None:
+        """Just the API write — the slice a bulk micro-batch replaces
+        (``pre``/``post`` run per-call around it either way, so PreBind
+        plugin effects are never re-applied by a bulk fallback)."""
         if self.bind_fn is not None:
             self.bind_fn(self.pod, self.node_name)
         else:
             client.bind(self.pod, self.node_name)
-        if self.post is not None:
-            self.post()
 
     def merge(self, older: "BindCall") -> None:
         # a second bind for the same pod supersedes the first
@@ -153,12 +164,58 @@ class NominateCall:
 _CLOSE = object()
 
 
-class APIDispatcher:
-    """See module docstring."""
+@dataclass
+class _BatchJob:
+    """One flushed micro-batch: every pending call of one call type,
+    handed to a worker as a single work item."""
 
-    def __init__(self, client: Any, workers: int = 2) -> None:
+    call_type: str
+    calls: list
+
+
+#: call_type → (client bulk method name, call → bulk-op argument). A client
+#: exposing the named method gets the whole micro-batch in ONE invocation
+#: (e.g. StoreClient.bulk_bind turns a cycle's binds into two bulk RPCs);
+#: clients without it fall back to per-call execution unchanged.
+_BULK_ADAPTERS: dict[str, tuple] = {
+    "bind": ("bulk_bind", lambda c: (c.pod, c.node_name)),
+    "status_patch": (
+        "bulk_status_patch", lambda c: (c.pod, c.reason, c.message)
+    ),
+    "delete_victim": (
+        "bulk_delete_victim", lambda c: (c.pod, c.preemptor_key)
+    ),
+}
+
+
+def _bulkable(call: APICall) -> bool:
+    """Only the standard API write may merge into a bulk RPC: a call whose
+    bind is owned by an extender webhook (``bind_fn``) executes per-call.
+    Host-side ``pre``/``post`` hooks do NOT disqualify — the batch runs
+    them per-call around the bulked API phase (``execute_api``)."""
+    return getattr(call, "bind_fn", None) is None
+
+
+class APIDispatcher:
+    """See module docstring.
+
+    ``bulk=True`` turns on opportunistic micro-batching: ``add`` only
+    accumulates into the mergeable pending map, and ``flush`` — called by
+    the scheduler at cycle boundaries (and by ``sync``/``close``) — drains
+    it into per-call-type batch jobs. A worker executes a whole batch
+    through the client's ``bulk_<call_type>`` method when it has one
+    (a cycle's 128 BindCalls become one bulk request); per-op failures,
+    a missing bulk method, or calls carrying host hooks fall back to
+    per-call ``execute``, so every pod's error path is exactly the
+    non-bulk path's. ``bulk=False`` is byte-for-byte the previous
+    dispatch behavior (the ``--bulk off`` escape hatch)."""
+
+    def __init__(
+        self, client: Any, workers: int = 2, bulk: bool = False
+    ) -> None:
         self._client = client
         self._workers = workers
+        self._bulk = bulk
         self._pending: dict[tuple[str, str], APICall] = {}
         self._lock = threading.Lock()
         self._q: _queue.Queue = _queue.Queue()
@@ -166,6 +223,8 @@ class APIDispatcher:
         self._added = 0
         self._executed = 0
         self._errors = 0
+        self._batches = 0          # bulk RPCs issued
+        self._batched_calls = 0    # calls that rode a bulk RPC
         self._closed = False
         if workers > 0:
             for i in range(workers):
@@ -183,7 +242,7 @@ class APIDispatcher:
         return self._client
 
     def add(self, call: APICall) -> None:
-        if self._workers == 0 or self._closed:
+        if self._closed or (self._workers == 0 and not self._bulk):
             self._execute(call)  # inline: no pool, or pool already drained
             return
         with self._lock:
@@ -196,21 +255,42 @@ class APIDispatcher:
                 older_skipped = False
             self._pending[key] = call
             self._added += 1
-            if not older_skipped:
+            if not self._bulk and not older_skipped:
                 self._q.put(key)
+
+    def flush(self) -> None:
+        """Drain the pending map into per-call-type batch jobs (the
+        micro-batch window closes here — the scheduler calls this at cycle
+        boundaries). No-op without ``bulk``: per-call dispatch already
+        queued everything at ``add`` time."""
+        if not self._bulk:
+            return
+        with self._lock:
+            if not self._pending:
+                return
+            pending = list(self._pending.values())
+            self._pending.clear()
+        groups: dict[str, list] = {}
+        for call in pending:
+            groups.setdefault(call.call_type, []).append(call)
+        for call_type, calls in groups.items():
+            if self._workers == 0 or self._closed:
+                self._execute_batch(call_type, calls)
+            else:
+                self._q.put(_BatchJob(call_type, calls))
 
     def _pop(self, key: tuple[str, str]) -> APICall | None:
         with self._lock:
             return self._pending.pop(key, None)
 
-    def _execute(self, call: APICall) -> None:
-        err: Exception | None = None
-        try:
-            call.execute(self._client)
-        except Exception as e:  # noqa: BLE001 — surfaced via on_done
-            err = e
-            self._errors += 1
-        self._executed += 1
+    def _finish(self, call: APICall, err: Exception | None) -> None:
+        # counters under the lock: workers resolve calls concurrently and a
+        # bare read-modify-write tears (the stats()/metrics reader would
+        # see undercounts forever)
+        with self._lock:
+            self._executed += 1
+            if err is not None:
+                self._errors += 1
         on_done = getattr(call, "on_done", None)
         if on_done is not None:
             try:
@@ -218,35 +298,142 @@ class APIDispatcher:
             except Exception:
                 pass
 
+    def _execute(self, call: APICall) -> None:
+        err: Exception | None = None
+        try:
+            call.execute(self._client)
+        except Exception as e:  # noqa: BLE001 — surfaced via on_done
+            err = e
+        self._finish(call, err)
+
+    def _execute_api(self, call: APICall) -> None:
+        """Per-call fallback AFTER a bulk attempt: the call's ``pre`` hook
+        already ran (PreBind effects must not re-apply), so only the API
+        phase + ``post`` re-execute — exactly the single-op path's
+        remainder."""
+        err: Exception | None = None
+        try:
+            api = getattr(call, "execute_api", None)
+            if api is not None:
+                api(self._client)
+            else:
+                call.execute(self._client)
+            post = getattr(call, "post", None)
+            if post is not None:
+                post()
+        except Exception as e:  # noqa: BLE001 — surfaced via on_done
+            err = e
+        self._finish(call, err)
+
+    def _execute_batch(self, call_type: str, calls: list) -> None:
+        """One micro-batch: bulk-eligible calls ride the client's
+        ``bulk_<call_type>`` in ONE invocation, their ``pre``/``post``
+        hooks still running per-call around the bulked API phase;
+        everything else — and any op the bulk response failed — executes
+        per-call, so per-pod error semantics (bind-error → forget-assumed
+        → requeue) are identical to the non-bulk path."""
+        spec = _BULK_ADAPTERS.get(call_type)
+        fn = getattr(self._client, spec[0], None) if spec else None
+        eligible: list = []
+        singles: list = []
+        for call in calls:
+            (eligible if fn is not None and _bulkable(call)
+             else singles).append(call)
+        if len(eligible) < 2:
+            # nothing to amortize: a lone call pays less as a single op
+            singles = calls
+            eligible = []
+        ready: list = []
+        for call in eligible:
+            pre = getattr(call, "pre", None)
+            if pre is not None:
+                try:
+                    pre()
+                except Exception as e:  # noqa: BLE001 — surfaced via on_done
+                    # a failing PreBind aborts before the API write — the
+                    # same resolution order as the single-op execute
+                    self._finish(call, e)
+                    continue
+            ready.append(call)
+        if len(ready) >= 2:
+            try:
+                errs = fn([spec[1](c) for c in ready])
+                if len(errs) != len(ready):
+                    raise RuntimeError("bulk result length mismatch")
+            except Exception:
+                # the whole batch failed to go bulk (no transport, missing
+                # verb, malformed reply): per-call fallback for everything
+                # (pre already ran — resume at the API phase)
+                for call in ready:
+                    self._execute_api(call)
+            else:
+                with self._lock:
+                    self._batches += 1
+                    self._batched_calls += len(ready)
+                for call, err in zip(ready, errs):
+                    if err is not None:
+                        # partial failure: re-run just this op per-call so
+                        # its error (or late success) is exactly what the
+                        # single-op path would have produced
+                        self._execute_api(call)
+                        continue
+                    post_err: Exception | None = None
+                    post = getattr(call, "post", None)
+                    if post is not None:
+                        try:
+                            post()
+                        except Exception as e:  # noqa: BLE001
+                            post_err = e
+                    self._finish(call, post_err)
+        else:
+            for call in ready:
+                self._execute_api(call)
+        for call in singles:
+            self._execute(call)
+
     def _worker(self) -> None:
         while True:
-            key = self._q.get()
-            if key is _CLOSE:
+            item = self._q.get()
+            if item is _CLOSE:
                 self._q.task_done()  # keep join() balanced after close
                 return
-            call = self._pop(key)
-            if call is not None:
-                self._execute(call)
+            if isinstance(item, _BatchJob):
+                self._execute_batch(item.call_type, item.calls)
+            else:
+                call = self._pop(item)
+                if call is not None:
+                    self._execute(call)
             self._q.task_done()
 
     def sync(self) -> None:
         """Barrier: wait until every queued call has executed (tests and
-        harness measurement boundaries)."""
+        harness measurement boundaries). Flushes the micro-batch window
+        first so a pending bulk batch cannot outlive the barrier."""
+        self.flush()
         if self._workers > 0:
             self._q.join()
 
     def close(self) -> None:
-        if self._workers > 0 and not self._closed:
-            self.sync()
-            self._closed = True
+        if self._closed:
+            return
+        # flush + drain regardless of worker count: a workers=0 bulk
+        # dispatcher still holds a pending micro-batch window, and a close
+        # that skipped the flush would silently drop the final cycle's
+        # calls (later add()s execute inline once _closed is set)
+        self.sync()
+        self._closed = True
+        if self._workers > 0:
             for _ in self._threads:  # one sentinel per worker, each acked
                 self._q.put(_CLOSE)
             for th in self._threads:
                 th.join(timeout=5)
 
     def stats(self) -> dict[str, int]:
-        return {
-            "added": self._added,
-            "executed": self._executed,
-            "errors": self._errors,
-        }
+        with self._lock:
+            return {
+                "added": self._added,
+                "executed": self._executed,
+                "errors": self._errors,
+                "batches": self._batches,
+                "batched_calls": self._batched_calls,
+            }

@@ -142,6 +142,7 @@ class Scheduler:
         recorder=None,
         pipeline: bool = False,
         encode_cache: bool = True,
+        bulk: bool = True,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -168,7 +169,13 @@ class Scheduler:
         the pod, and gathered (not rebuilt) at cycle time; node events
         invalidate by epoch. Cached encodes are bit-identical to fresh
         ones, so ``encode_cache=False`` is a debugging escape hatch like
-        ``pipeline=False``."""
+        ``pipeline=False``.
+        ``bulk``: opportunistic API-plane micro-batching — the dispatcher
+        accumulates a cycle's API writes and flushes them at the cycle
+        boundary as per-call-type bulk RPCs (a cycle's binds become one
+        request); partial failures fall back to per-call execution, so
+        every pod's bind-error path is unchanged and ``bulk=False``
+        (``--bulk off``) is pod-for-pod identical."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
@@ -223,7 +230,9 @@ class Scheduler:
             initial_backoff_seconds=self.cfg.pod_initial_backoff_seconds,
             max_backoff_seconds=self.cfg.pod_max_backoff_seconds,
         )
-        self.dispatcher = APIDispatcher(client, workers=dispatcher_workers)
+        self.dispatcher = APIDispatcher(
+            client, workers=dispatcher_workers, bulk=bulk
+        )
         self.metrics = SchedulerMetrics()
         # event-time incremental pod encoding (state.encode_cache): static
         # rows pre-built at informer delivery, template-shared across pods
@@ -751,7 +760,19 @@ class Scheduler:
         the next batch → host-encode its assume-independent half while the
         in-flight device program runs → sync + apply the in-flight cycle →
         patch the assume-dependent slice → dispatch. The trailing call (pop
-        empty, one cycle still in flight) drains the pipeline."""
+        empty, one cycle still in flight) drains the pipeline.
+
+        The cycle boundary is the dispatcher's micro-batch window: every
+        API write the cycle enqueued (binds, status patches, victim
+        deletes) is flushed as per-call-type bulk RPCs on the way out."""
+        try:
+            return self._schedule_batch_inner(max_batch)
+        finally:
+            self.dispatcher.flush()
+
+    def _schedule_batch_inner(
+        self, max_batch: int | None = None
+    ) -> dict[str, int]:
         self._drain_bind_completions()
         self._flush_timers()
         limit = max_batch or self.max_batch
@@ -1475,7 +1496,10 @@ class Scheduler:
 
     def metrics_text(self) -> str:
         """Prometheus text exposition of the scheduler metric set (the
-        /metrics endpoint body)."""
+        /metrics endpoint body). Dispatcher lifetime counters (added/
+        executed/errors + bulk batch counts) are folded in at scrape time
+        so the DiagnosticsServer surfaces API-write failures."""
+        self.metrics.prom.set_dispatcher_stats(self.dispatcher.stats())
         return self.metrics.prom.expose()
 
     def run_until_idle(self, max_cycles: int = 10000) -> int:

@@ -56,6 +56,27 @@ class ConflictError(Exception):
     Create hit an existing object."""
 
 
+def bulk_result_error(res: dict) -> Exception | None:
+    """Map one bulk-op result (the ``{"status": …, "error": …}`` dicts
+    ``MemStore.bulk``/``RemoteStore.bulk`` return) to the exception the
+    matching single-op verb would have raised — one mapping for both
+    deployment shapes, so callers of either surface handle conflicts and
+    absences identically."""
+    status = res.get("status", 500)
+    if status < 400:
+        return None
+    reason = res.get("error", f"status {status}")
+    if status == 409:
+        return ConflictError(reason)
+    if status == 404:
+        return KeyError(reason)
+    if status in (400, 422):
+        return ValueError(reason)
+    if status == 403:
+        return PermissionError(reason)
+    return RuntimeError(f"{status}: {reason}")
+
+
 @dataclass(frozen=True)
 class WatchEvent:
     type: str              # ADDED | MODIFIED | DELETED
@@ -186,30 +207,34 @@ class MemStore:
         update that leaves a TERMINATING object (deletion_timestamp set)
         with no finalizers completes the deletion — the object is removed
         and a DELETED event fires instead of MODIFIED."""
+        with self._lock:
+            rv = self._update_locked(kind, key, obj, expect_rv)
+            self._lock.notify_all()
+            return rv
+
+    def _update_locked(
+        self, kind: str, key: str, obj: Any, expect_rv: int | None
+    ) -> int:
+        """The update body, caller holds the lock (shared by the single-op
+        verb and ``bulk``; the caller notifies)."""
         if (
             getattr(obj, "deletion_timestamp", None) is not None
             and not getattr(obj, "finalizers", ())
         ):
-            with self._lock:
-                current, have_rv = self._core.get(kind, key)
-                if current is None:
-                    raise ConflictError(f"{kind}/{key}: gone")
-                if expect_rv is not None and have_rv != expect_rv:
-                    raise ConflictError(
-                        f"{kind}/{key}: expected rv {expect_rv}, have {have_rv}"
-                    )
-                rv = self._core.delete(kind, key)
-                self._lock.notify_all()
-                return rv
-        with self._lock:
-            try:
-                rv = self._core.update(
-                    kind, key, obj, -1 if expect_rv is None else expect_rv
+            current, have_rv = self._core.get(kind, key)
+            if current is None:
+                raise ConflictError(f"{kind}/{key}: gone")
+            if expect_rv is not None and have_rv != expect_rv:
+                raise ConflictError(
+                    f"{kind}/{key}: expected rv {expect_rv}, have {have_rv}"
                 )
-            except ValueError as e:
-                raise ConflictError(str(e)) from None
-            self._lock.notify_all()
-            return rv
+            return self._core.delete(kind, key)
+        try:
+            return self._core.update(
+                kind, key, obj, -1 if expect_rv is None else expect_rv
+            )
+        except ValueError as e:
+            raise ConflictError(str(e)) from None
 
     def delete(self, kind: str, key: str) -> int:
         """Remove the object. GRACEFUL path (pkg/registry/core/pod —
@@ -219,22 +244,120 @@ class MemStore:
         repeat delete of a terminating object is a no-op returning the
         current revision."""
         with self._lock:
-            current, rv = self._core.get(kind, key)
-            if current is not None and getattr(current, "finalizers", ()):
-                import dataclasses
-                import time as _time
-
-                if getattr(current, "deletion_timestamp", None) is not None:
-                    return self._core.resource_version()   # already going
-                doomed = dataclasses.replace(
-                    current, deletion_timestamp=_time.time()
-                )
-                rv = self._core.update(kind, key, doomed, -1)
-                self._lock.notify_all()
-                return rv
-            rv = self._core.delete(kind, key)   # KeyError propagates
+            rv = self._delete_locked(kind, key)
             self._lock.notify_all()
             return rv
+
+    def _delete_locked(self, kind: str, key: str) -> int:
+        """The delete body, caller holds the lock (shared by the single-op
+        verb and ``bulk``; the caller notifies)."""
+        current, rv = self._core.get(kind, key)
+        if current is not None and getattr(current, "finalizers", ()):
+            import dataclasses
+            import time as _time
+
+            if getattr(current, "deletion_timestamp", None) is not None:
+                return self._core.resource_version()   # already going
+            doomed = dataclasses.replace(
+                current, deletion_timestamp=_time.time()
+            )
+            return self._core.update(kind, key, doomed, -1)
+        return self._core.delete(kind, key)   # KeyError propagates
+
+    # --------------------------------------------------------------- bulk
+    def bulk(self, kind: str, ops: list[dict]) -> list[dict]:
+        """Apply a list of create/update/delete/get ops under ONE lock
+        acquisition (the bulk verb's storage half: N writes pay one lock
+        round instead of N). Ops are dicts ``{"op": "create|update|delete|
+        get", "key": …, "object": …, "expect_rv": …}``; the result list is
+        positional, one ``{"status", "resourceVersion", "error"?,
+        "object"?}`` per op with the SAME per-object conflict/absence
+        semantics as the single-op verbs (a mid-batch conflict fails only
+        its own op — later ops still apply)."""
+        out: list[dict] = []
+        with self._lock:
+            for op in ops:
+                verb, key = op.get("op"), op.get("key")
+                try:
+                    if verb == "create":
+                        try:
+                            rv = self._core.create(kind, key, op["object"])
+                        except KeyError as e:
+                            raise ConflictError(
+                                str(e).strip("'\"")
+                            ) from None
+                        out.append({"status": 201, "resourceVersion": rv})
+                    elif verb == "update":
+                        rv = self._update_locked(
+                            kind, key, op["object"], op.get("expect_rv")
+                        )
+                        out.append({"status": 200, "resourceVersion": rv})
+                    elif verb == "delete":
+                        rv = self._delete_locked(kind, key)
+                        out.append({"status": 200, "resourceVersion": rv})
+                    elif verb == "get":
+                        obj, rv = self._core.get(kind, key)
+                        if obj is None:
+                            out.append({
+                                "status": 404, "resourceVersion": 0,
+                                "error": f"{kind}/{key} not found",
+                            })
+                        else:
+                            out.append({
+                                "status": 200, "resourceVersion": rv,
+                                "object": obj,
+                            })
+                    else:
+                        out.append({
+                            "status": 400, "resourceVersion": 0,
+                            "error": f"unknown bulk op {verb!r}",
+                        })
+                except ConflictError as e:
+                    out.append({
+                        "status": 409, "resourceVersion": 0, "error": str(e),
+                    })
+                except KeyError as e:
+                    out.append({
+                        "status": 404, "resourceVersion": 0,
+                        "error": str(e).strip("'\""),
+                    })
+            self._lock.notify_all()
+        return out
+
+    def events_since_bulk(
+        self, cursors: dict[str, int]
+    ) -> tuple[dict, int]:
+        """Drain several kinds' watch cursors under ONE lock acquisition
+        (the server half of the batched watch poll): per kind, the same
+        (events, new cursor) a ``_events_since`` would return — or a
+        CompactedError value (not raised: one compacted kind relists,
+        the others' deliveries still land). The second return value is the
+        store's revision AT THE DRAIN, captured under the same lock — the
+        long-poll must wait on this, not on a revision read afterwards, or
+        a write landing between drain and wait stalls for the full
+        timeout."""
+        raw: dict[str, Any] = {}
+        with self._lock:
+            drain_rv = self._core.resource_version()
+            for kind, rv in cursors.items():
+                try:
+                    raw[kind] = self._core.events_since(kind, rv)
+                except LookupError as e:
+                    raw[kind] = CompactedError(str(e))
+        out: dict[str, Any] = {}
+        for kind, res in raw.items():
+            if isinstance(res, CompactedError):
+                out[kind] = res
+                continue
+            events, cursor = res
+            out[kind] = (
+                [
+                    WatchEvent(_EVENT_TYPES[t], k, key, obj, erv)
+                    for (t, k, key, obj, erv) in events
+                ],
+                cursor,
+            )
+        return out, drain_rv
 
     # -------------------------------------------------------------- reads
     def get(self, kind: str, key: str):
