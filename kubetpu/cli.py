@@ -243,12 +243,22 @@ def cmd_scheduler(args) -> int:
     except (ConfigError, OSError) as e:
         print(f"invalid config: {e}", file=sys.stderr)
         return 1
+    from .parallel.mesh import resolve_mesh
+
+    try:
+        mesh = resolve_mesh(args.mesh)
+    except ValueError as e:
+        # --mesh on with a single visible device is a config error, not a
+        # silent single-chip run misreported as multichip
+        print(f"invalid --mesh: {e}", file=sys.stderr)
+        return 1
     store = RemoteStore(args.server)
     sched = Scheduler(
         StoreClient(store), cfg=cfg, engine=args.engine,
         pipeline=(args.pipeline == "on"),
         encode_cache=(args.encode_cache == "on"),
         bulk=(args.bulk == "on"),
+        mesh=mesh,
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
@@ -575,6 +585,15 @@ def build_parser() -> argparse.ArgumentParser:
                            "all kinds in one batched request; bindings "
                            "stay pod-for-pod identical to per-call mode "
                            "('off' is the debugging escape hatch)")
+    schd.add_argument("--mesh", default="off", choices=["on", "off", "auto"],
+                      help="shard the node axis of the scheduling tensors "
+                           "over a device mesh (parallel.mesh rules): the "
+                           "resident node block becomes a sharded resident "
+                           "block with per-shard routed delta uploads, and "
+                           "both engines run SPMD with XLA-inserted "
+                           "collectives. 'auto' engages when >1 device is "
+                           "visible; 'on' requires one; assignments are "
+                           "bit-identical to single-device either way")
     schd.add_argument("--prewarm", action="store_true",
                       help="compile the assign program for the full "
                            "batch-size bucket ladder at startup, so "

@@ -214,24 +214,58 @@ def _node_block_nbytes(nodes: DeviceNodeState) -> int:
     )
 
 
-@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4))
+@partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
 def _scatter_node_rows(
-    alloc, requested, nonzero, pod_count, allowed,
-    idx, u_alloc, u_req, u_nz, u_pc, u_al,
+    alloc, requested, nonzero, pod_count, allowed, valid,
+    idx, u_alloc, u_req, u_nz, u_pc, u_al, u_vd,
 ):
-    """Write the dirty node rows into the device-resident block. The five
+    """Write the dirty node rows into the device-resident block. The six
     state buffers are DONATED: each output aliases its input (same
     shape/dtype), so the update is in-place on device and the old buffers
     are invalidated — the ResidentNodeState owner is the only holder by
     contract. ``idx`` is padded to a compile bucket with out-of-range
-    indices; mode="drop" discards those writes."""
+    indices; mode="drop" discards those writes. ``valid`` rides along so an
+    incremental reshard (node add/delete within the same padded capacity)
+    can flip validity rows without a full re-upload."""
     return (
         alloc.at[idx].set(u_alloc, mode="drop"),
         requested.at[idx].set(u_req, mode="drop"),
         nonzero.at[idx].set(u_nz, mode="drop"),
         pod_count.at[idx].set(u_pc, mode="drop"),
         allowed.at[idx].set(u_al, mode="drop"),
+        valid.at[idx].set(u_vd, mode="drop"),
     )
+
+
+def _make_routed_scatter(mesh, axis: str):
+    """Build the per-shard routed twin of ``_scatter_node_rows`` for a
+    sharded resident block: every input is sharded on its leading (shard)
+    axis, so each device receives ONLY its own update block — the
+    host→device routing happened at ``device_put`` — and the scatter body
+    runs shard-local (indices are shard-local; no collectives). Donation
+    aliases each state buffer in place, like the single-device scatter."""
+    from jax.experimental.shard_map import shard_map
+
+    spec = jax.sharding.PartitionSpec(axis)
+
+    @partial(jax.jit, donate_argnums=(0, 1, 2, 3, 4, 5))
+    @partial(
+        shard_map, mesh=mesh,
+        in_specs=(spec,) * 13, out_specs=(spec,) * 6,
+    )
+    def scatter(alloc, requested, nonzero, pod_count, allowed, valid,
+                idx, u_alloc, u_req, u_nz, u_pc, u_al, u_vd):
+        i = idx[0]
+        return (
+            alloc.at[i].set(u_alloc[0], mode="drop"),
+            requested.at[i].set(u_req[0], mode="drop"),
+            nonzero.at[i].set(u_nz[0], mode="drop"),
+            pod_count.at[i].set(u_pc[0], mode="drop"),
+            allowed.at[i].set(u_al[0], mode="drop"),
+            valid.at[i].set(u_vd[0], mode="drop"),
+        )
+
+    return scatter
 
 
 class ResidentNodeState:
@@ -239,61 +273,184 @@ class ResidentNodeState:
 
     ``refresh(nt, num_nodes)`` brings the device block up to date with the
     host ``NodeTensors``: a full upload when the block doesn't exist yet or
-    the encode was rebuilt (axis/order/capacity change), otherwise a dirty-
-    row scatter consuming ``nt.pending_device_rows`` — steady-state
-    host→device traffic is O(Δ rows · R), not O(N · R). The scatter donates
-    the old buffers (see ``_scatter_node_rows``), so after a refresh any
-    previously returned DeviceNodeState is dead; callers must not hold
-    device batches across a refresh (the scheduler refreshes only between
-    completed cycles)."""
+    is not comparable (resource axis / padded capacity change), a dirty-row
+    scatter consuming ``nt.pending_device_rows`` in steady state — host→
+    device traffic O(Δ rows · R), not O(N · R) — and, when the encode was
+    REBUILT but kept the same shape (node add/delete within a padding
+    bucket), an *incremental reshard*: the old and new NodeTensors are
+    diffed row-wise and only the rows that actually changed (plus the
+    validity boundary) are scattered. The scatter donates the old buffers
+    (see ``_scatter_node_rows``), so after a refresh any previously
+    returned DeviceNodeState is dead; callers must not hold device batches
+    across a refresh (the scheduler refreshes only between completed
+    cycles).
 
-    def __init__(self) -> None:
+    ``mesh``: a 1-D node-axis ``jax.sharding.Mesh`` — the block then lives
+    SHARDED across the mesh (each device owns ``NC / n_shards`` contiguous
+    node rows), full uploads place each shard's rows on its owner only, and
+    delta uploads are ROUTED: dirty rows are grouped by owning shard on the
+    host, shipped as a shard-axis-sharded update block (each device
+    receives only its own rows), and scattered shard-locally via shard_map
+    — no collectives on the upload path."""
+
+    def __init__(self, mesh=None, axis=None) -> None:
         self.device: DeviceNodeState | None = None
         self._nt_token: object | None = None
         self._num_nodes = -1
         self.last_upload_bytes = 0
+        self.mesh = mesh
+        self.axis = axis if axis is not None else "nodes"
+        self._n_shards = 1
+        self._shardings = None
+        self._routed_scatter = None
+        self._block_sharded = False
+        if mesh is not None:
+            from ..parallel.mesh import (
+                _axis_size,
+                node_axes_of,
+                node_state_shardings,
+            )
+
+            if axis is None:
+                self.axis, _ = node_axes_of(mesh)
+            self._n_shards = _axis_size(mesh, self.axis)
+            self._shardings = node_state_shardings(mesh, self.axis)
+            self._routed_scatter = _make_routed_scatter(mesh, self.axis)
+        # per-shard view of the LAST refresh (length n_shards): bytes each
+        # shard received and how many real dirty rows were routed to it —
+        # the feed for the shard-labeled transfer metrics / trace instants
+        self.last_upload_bytes_per_shard: list[int] = [0] * self._n_shards
+        self.last_rows_per_shard: list[int] = [0] * self._n_shards
 
     @property
     def nbytes(self) -> int:
         return _node_block_nbytes(self.device) if self.device is not None else 0
+
+    @property
+    def nbytes_per_shard(self) -> list[int]:
+        """Per-shard resident bytes, honest about placement: an even split
+        when the block really is sharded, everything on shard 0 when the
+        single-device fallback placed it there."""
+        total = self.nbytes
+        if total and self._block_sharded and self._n_shards > 1:
+            return [total // self._n_shards] * self._n_shards
+        return [total] + [0] * (self._n_shards - 1)
 
     def _full_upload(self, nt: "enc.NodeTensors", num_nodes: int) -> DeviceNodeState:
         NC = nt.alloc.shape[0]
         node_valid = np.zeros(NC, dtype=bool)
         node_valid[:num_nodes] = True
         dev = DeviceNodeState(
-            alloc=jnp.asarray(nt.alloc),
-            requested=jnp.asarray(nt.requested),
-            nonzero_requested=jnp.asarray(nt.nonzero_requested),
-            pod_count=jnp.asarray(nt.pod_count),
-            allowed_pods=jnp.asarray(nt.allowed_pods),
-            node_valid=jnp.asarray(node_valid),
+            alloc=nt.alloc,
+            requested=nt.requested,
+            nonzero_requested=nt.nonzero_requested,
+            pod_count=nt.pod_count,
+            allowed_pods=nt.allowed_pods,
+            node_valid=node_valid,
         )
+        sharded = self._shardings is not None and NC % self._n_shards == 0
+        if sharded:
+            dev = jax.device_put(dev, self._shardings)
+        else:
+            dev = jax.device_put(dev)
+        self._block_sharded = sharded
         self.device = dev
         self._nt_token = nt
         self._num_nodes = num_nodes
         nt.pending_device_rows = set()   # start delta accumulation
         self.last_upload_bytes = _node_block_nbytes(dev)
+        if sharded:
+            per = self.last_upload_bytes // self._n_shards
+            self.last_upload_bytes_per_shard = [per] * self._n_shards
+            self.last_rows_per_shard = [NC // self._n_shards] * self._n_shards
+        else:
+            # single-device fallback (shard count does not divide NC):
+            # everything landed on one device — attribute it there, like
+            # _scatter_single, so per-chip metrics never claim an even
+            # split that didn't happen
+            self.last_upload_bytes_per_shard = (
+                [self.last_upload_bytes] + [0] * (self._n_shards - 1)
+            )
+            self.last_rows_per_shard = [NC] + [0] * (self._n_shards - 1)
         return dev
+
+    def _reshard_rows(
+        self, nt: "enc.NodeTensors", num_nodes: int
+    ) -> "list[int] | None":
+        """Dirty rows for an incremental reshard: the encode was rebuilt
+        (new NodeTensors object — node add/delete/reorder) but padded
+        capacity and resource axis still match the resident block. Diff the
+        old tensors (what the device holds, modulo their un-flushed pending
+        rows) against the new ones and return the union of value-changed
+        rows, the old pending set, and the validity boundary. None = not
+        comparable (full upload)."""
+        old = self._nt_token
+        if old is None or getattr(old, "alloc", None) is None:
+            return None
+        diff = nt.diff_rows(old)
+        if diff is None:
+            return None
+        rows = set(diff)
+        if old.pending_device_rows:
+            # rows dirty on the OLD tensors but never shipped: the device
+            # copy differs from old AND possibly from new — re-send them
+            rows.update(old.pending_device_rows)
+        lo, hi = sorted((self._num_nodes, num_nodes))
+        rows.update(range(lo, hi))   # validity flips on the boundary
+        return sorted(rows)
 
     def refresh(self, nt: "enc.NodeTensors", num_nodes: int) -> DeviceNodeState:
         pending = nt.pending_device_rows
-        if (
-            self.device is None
-            or self._nt_token is not nt
-            or pending is None
-            or self._num_nodes != num_nodes
-        ):
+        if self.device is None or self._nt_token is None:
             return self._full_upload(nt, num_nodes)
-        if not pending:
-            self.last_upload_bytes = 0
-            return self.device
-        rows = sorted(pending)
+        if self._nt_token is not nt:
+            # the encode was REBUILT (node add/delete/reorder): incremental
+            # reshard when the block is still comparable, else full upload
+            rows = self._reshard_rows(nt, num_nodes)
+            if rows is None:
+                return self._full_upload(nt, num_nodes)
+        elif pending is None or self._num_nodes != num_nodes:
+            # same tensors object but no delta bookkeeping (or a real-node
+            # count drift, which a node-set change should have rebuilt
+            # away): be safe, not clever
+            return self._full_upload(nt, num_nodes)
+        else:
+            if not pending:
+                self.last_upload_bytes = 0
+                self.last_upload_bytes_per_shard = [0] * self._n_shards
+                self.last_rows_per_shard = [0] * self._n_shards
+                return self.device
+            rows = sorted(pending)
         nt.pending_device_rows = set()
+        self._nt_token = nt
+        if not rows:
+            # reshard diff found nothing to ship (values identical)
+            self.last_upload_bytes = 0
+            self.last_upload_bytes_per_shard = [0] * self._n_shards
+            self.last_rows_per_shard = [0] * self._n_shards
+            self._num_nodes = num_nodes
+            return self.device
         if 2 * len(rows) >= num_nodes:
             # dense update: a full contiguous upload beats a scatter
             return self._full_upload(nt, num_nodes)
         NC = nt.alloc.shape[0]
+        valid_of = np.asarray(rows, dtype=np.int64) < num_nodes
+        self._num_nodes = num_nodes
+        if self._shardings is not None and NC % self._n_shards == 0:
+            dev = self._scatter_routed(nt, rows, valid_of, NC)
+            if dev is None:
+                # routing would ship >= the full block (dirty rows
+                # clustered in few shards → every shard bucket-padded to
+                # the max): a contiguous full upload is strictly smaller
+                return self._full_upload(nt, num_nodes)
+        else:
+            dev = self._scatter_single(nt, rows, valid_of, NC)
+        self.device = dev
+        return dev
+
+    def _scatter_single(
+        self, nt: "enc.NodeTensors", rows: list, valid_of: np.ndarray, NC: int
+    ) -> DeviceNodeState:
         pad = enc.round_up(len(rows))
         idx = np.full(pad, NC, dtype=np.int32)   # pad rows → dropped writes
         idx[: len(rows)] = rows
@@ -308,22 +465,95 @@ class ResidentNodeState:
         u_nz = deltas(nt.nonzero_requested)
         u_pc = deltas(nt.pod_count)
         u_al = deltas(nt.allowed_pods)
+        u_vd = np.zeros(pad, dtype=bool)
+        u_vd[: len(rows)] = valid_of
         dev = self.device
-        alloc, req, nz, pc, al = _scatter_node_rows(
+        alloc, req, nz, pc, al, vd = _scatter_node_rows(
             dev.alloc, dev.requested, dev.nonzero_requested,
-            dev.pod_count, dev.allowed_pods,
+            dev.pod_count, dev.allowed_pods, dev.node_valid,
             jnp.asarray(idx), jnp.asarray(u_alloc), jnp.asarray(u_req),
             jnp.asarray(u_nz), jnp.asarray(u_pc), jnp.asarray(u_al),
-        )
-        self.device = DeviceNodeState(
-            alloc=alloc, requested=req, nonzero_requested=nz,
-            pod_count=pc, allowed_pods=al, node_valid=dev.node_valid,
+            jnp.asarray(u_vd),
         )
         self.last_upload_bytes = int(
             idx.nbytes + u_alloc.nbytes + u_req.nbytes + u_nz.nbytes
-            + u_pc.nbytes + u_al.nbytes
+            + u_pc.nbytes + u_al.nbytes + u_vd.nbytes
         )
-        return self.device
+        # keep the per-shard arrays n_shards long even on the (shouldn't-
+        # happen: encode pads NC to a shard multiple) unsharded fallback,
+        # so shard-labeled metrics never disagree with mesh_shape
+        self.last_upload_bytes_per_shard = (
+            [self.last_upload_bytes] + [0] * (self._n_shards - 1)
+        )
+        self.last_rows_per_shard = [len(rows)] + [0] * (self._n_shards - 1)
+        return DeviceNodeState(
+            alloc=alloc, requested=req, nonzero_requested=nz,
+            pod_count=pc, allowed_pods=al, node_valid=vd,
+        )
+
+    def _scatter_routed(
+        self, nt: "enc.NodeTensors", rows: list, valid_of: np.ndarray, NC: int
+    ) -> "DeviceNodeState | None":
+        """Per-shard routed delta upload (see class docstring): group dirty
+        rows by owning shard, pad each shard's group to a common bucket,
+        ship the blocks shard-axis-sharded (each device receives only its
+        rows) and scatter shard-locally with LOCAL indices. Returns None
+        when the bucket-padded slot count reaches the full row count (the
+        caller full-uploads instead — routing would not ship less)."""
+        n_sh = self._n_shards
+        rows_per_shard = NC // n_sh
+        rows_arr = np.asarray(rows, dtype=np.int64)   # sorted ascending
+        shard_of = rows_arr // rows_per_shard
+        counts = np.bincount(shard_of, minlength=n_sh)
+        bucket = enc.round_up(int(counts.max()), minimum=1)
+        if n_sh * bucket >= NC:
+            return None
+        # rows are sorted, so each shard's rows are contiguous: the flat
+        # slot of row j inside the (n_sh, bucket) block is
+        # shard * bucket + (j - first index of its shard)
+        starts = np.zeros(n_sh + 1, dtype=np.int64)
+        starts[1:] = np.cumsum(counts)
+        flat = shard_of * bucket + (np.arange(len(rows_arr)) - starts[shard_of])
+        # local out-of-range sentinel → shard-local mode="drop"
+        idx = np.full(n_sh * bucket, rows_per_shard, dtype=np.int32)
+        idx[flat] = rows_arr - shard_of * rows_per_shard
+
+        def blocks(a: np.ndarray) -> np.ndarray:
+            u = np.zeros((n_sh * bucket,) + a.shape[1:], dtype=a.dtype)
+            u[flat] = a[rows_arr]
+            return u.reshape((n_sh, bucket) + a.shape[1:])
+
+        u_alloc = blocks(nt.alloc)
+        u_req = blocks(nt.requested)
+        u_nz = blocks(nt.nonzero_requested)
+        u_pc = blocks(nt.pod_count)
+        u_al = blocks(nt.allowed_pods)
+        u_vd = np.zeros(n_sh * bucket, dtype=bool)
+        u_vd[flat] = valid_of
+        u_vd = u_vd.reshape(n_sh, bucket)
+        idx = idx.reshape(n_sh, bucket)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        row_sh = NamedSharding(self.mesh, P(self.axis))
+        put = partial(jax.device_put, device=row_sh)
+        dev = self.device
+        alloc, req, nz, pc, al, vd = self._routed_scatter(
+            dev.alloc, dev.requested, dev.nonzero_requested,
+            dev.pod_count, dev.allowed_pods, dev.node_valid,
+            put(idx), put(u_alloc), put(u_req), put(u_nz), put(u_pc),
+            put(u_al), put(u_vd),
+        )
+        per_row_bytes = (
+            u_alloc.nbytes + u_req.nbytes + u_nz.nbytes + u_pc.nbytes
+            + u_al.nbytes + u_vd.nbytes + idx.nbytes
+        ) // (n_sh * bucket)
+        self.last_upload_bytes = per_row_bytes * n_sh * bucket
+        self.last_upload_bytes_per_shard = [per_row_bytes * bucket] * n_sh
+        self.last_rows_per_shard = counts.tolist()
+        return DeviceNodeState(
+            alloc=alloc, requested=req, nonzero_requested=nz,
+            pod_count=pc, allowed_pods=al, node_valid=vd,
+        )
 
 
 def _resource_weights(
@@ -451,6 +681,7 @@ def encode_batch(
     resident: "ResidentNodeState | None" = None,
     cache=None,
     track_changes: bool = True,
+    mesh=None,
 ) -> EncodedBatch:
     """Snapshot + pending pods → padded device batch.
 
@@ -469,13 +700,27 @@ def encode_batch(
     ``cache``: an ``encode_cache.EncodeCache`` — static pod rows become
     gathers over template-keyed rows shared across pods and cycles (the
     host-side O(Δ) twin of ``prev_nt``/``resident``).
+
+    ``mesh``: a node-axis ``jax.sharding.Mesh`` — the device pytree is
+    placed with the parallel.mesh sharding rules (node-axis leaves sharded,
+    pod leaves replicated) in the same single ``device_put``, so the
+    assignment engines run SPMD with XLA-inserted collectives.
     """
+    if mesh is None and resident is not None:
+        mesh = resident.mesh
+    pad_multiple = 1
+    if mesh is not None:
+        from ..parallel.mesh import node_pad_multiple
+
+        pad_multiple = node_pad_multiple(mesh)
     sb = encode_batch_static(
         snapshot, pods, profile, pad=pad, resource_names=resource_names,
         nominated=nominated, prev_nt=prev_nt, cache=cache,
-        track_changes=track_changes,
+        track_changes=track_changes, pad_multiple=pad_multiple,
     )
-    return finalize_batch(sb, snapshot, nominated=nominated, resident=resident)
+    return finalize_batch(
+        sb, snapshot, nominated=nominated, resident=resident, mesh=mesh
+    )
 
 
 def encode_batch_static(
@@ -488,12 +733,19 @@ def encode_batch_static(
     prev_nt: "enc.NodeTensors | None" = None,
     cache=None,
     track_changes: bool = True,
+    pad_multiple: int = 1,
 ) -> StaticBatch:
     """Stage 1: the assume-independent host encode (see StaticBatch).
     ``track_changes=False`` (serial loop) skips the pipeline-only
-    staleness diff in the incremental snapshot encode."""
+    staleness diff in the incremental snapshot encode. ``pad_multiple``:
+    round the padded NODE capacity up to this multiple — a mesh of
+    n_shards devices needs NC % n_shards == 0 or the sharded resident
+    block degrades to per-cycle replication (round_up's buckets are
+    multiples of 8, so this only bites past 8 shards on tiny clusters)."""
     N, P = snapshot.num_nodes(), len(pods)
     NP = enc.round_up(N) if pad else N
+    if pad and pad_multiple > 1:
+        NP = (NP + pad_multiple - 1) // pad_multiple * pad_multiple
     PP = enc.round_up(P) if pad else P
     folded: frozenset = frozenset()
     if resource_names is None:
@@ -687,6 +939,7 @@ def finalize_batch(
     snapshot: Snapshot,
     nominated: Sequence = (),
     resident: "ResidentNodeState | None" = None,
+    mesh=None,
 ) -> EncodedBatch:
     """Stage 2: patch the assume-dependent slice onto a StaticBatch and
     build the device pytree — spread counts and affinity sums re-derived
@@ -844,10 +1097,13 @@ def finalize_batch(
         node_upload = _node_block_nbytes(nodes_block)
         resident_bytes = 0
 
+    if mesh is None and resident is not None:
+        mesh = resident.mesh
     # host numpy leaves throughout; ONE batched device_put ships the whole
     # pytree (leaf-by-leaf jnp.asarray was ~30 separate dispatches per
-    # cycle). Resident-path node buffers are already on device — device_put
-    # passes them through untouched.
+    # cycle). Resident-path node buffers are already on device — and, under
+    # a mesh, already sharded with the same rules — device_put passes them
+    # through untouched.
     dev = DeviceBatch(
         nodes=nodes_block,
         requests=pb.requests,
@@ -890,7 +1146,15 @@ def finalize_batch(
             sb.dra_score_sig if sb.dra_score_raw is not None else None
         ),
     )
-    dev = jax.device_put(dev)
+    if mesh is not None:
+        from ..parallel.mesh import batch_shardings, node_axes_of
+
+        axis, pod_axis = node_axes_of(mesh)
+        dev = jax.device_put(
+            dev, batch_shardings(dev, mesh, axis, pod_axis, guard=True)
+        )
+    else:
+        dev = jax.device_put(dev)
     from ..metrics.tpu import batch_nbytes
 
     total_bytes = batch_nbytes(dev)

@@ -10,9 +10,14 @@ node-sharded; per-pod tensors are replicated. XLA inserts the collectives
 """
 
 from .mesh import (  # noqa: F401
+    batch_shardings,
     make_mesh,
     make_mesh_2d,
     make_multislice_mesh,
+    measure_collective_wall,
+    node_state_shardings,
+    pod_scan_collective_ok,
+    resolve_mesh,
     shard_batch,
     sharded_batched,
     sharded_greedy,

@@ -138,20 +138,30 @@ def _spec_for(field: str, node_axis, pod_axis) -> P:
     return P()
 
 
-def shard_batch(
-    b: rt.DeviceBatch, mesh: Mesh, axis: Axis = "nodes",
-    pod_axis: str | None = None,
-) -> rt.DeviceBatch:
-    """Place every leaf with its mesh sharding. The padded node count must
-    divide the node-axis size, and (when ``pod_axis`` is given) the padded
-    pod count must divide the pod-axis size (encode_batch pads both to ≥8).
+def _axis_size(mesh: Mesh, axis) -> int:
+    """Shard count along ``axis`` (a name, a tuple of names, or None)."""
+    if axis is None:
+        return 1
+    names = axis if isinstance(axis, tuple) else (axis,)
+    size = 1
+    for name in names:
+        size *= mesh.shape[name]
+    return size
 
-    ``axis`` may be a tuple (multi-slice: the node dimension shards over
-    all named axes). Registered-dataclass pytree flattening already excludes
-    ``None`` leaves and static metadata fields, so one sharding pytree + one
-    ``device_put`` covers the whole batch, nested quadratic-kernel pytrees
-    included.
-    """
+
+def batch_shardings(
+    b: rt.DeviceBatch, mesh: Mesh, axis: Axis = "nodes",
+    pod_axis: str | None = None, guard: bool = False,
+):
+    """The sharding pytree for a DeviceBatch (the rules table in the module
+    docstring). Callers ship the batch in ONE ``device_put`` against it —
+    encode-time placement (``finalize_batch(mesh=…)``) and post-hoc
+    resharding (``shard_batch``) use the same rules, so the resident node
+    block and a freshly encoded pod block always agree on layout.
+
+    ``guard=True`` degrades any leaf whose sharded dimension does not divide
+    the shard count to replicated instead of erroring — the scheduler path
+    uses it so an odd device count can never kill a cycle."""
 
     def spec(path, leaf) -> NamedSharding:
         names = [p.name for p in path if hasattr(p, "name")]
@@ -169,10 +179,31 @@ def shard_batch(
                 s = P()
         else:
             s = _spec_for(field, axis, pod_axis)
+        if guard and any(
+            a is not None and leaf.shape[d] % _axis_size(mesh, a)
+            for d, a in enumerate(s)
+        ):
+            s = P()
         return NamedSharding(mesh, s)
 
-    shardings = jax.tree_util.tree_map_with_path(spec, b)
-    return jax.device_put(b, shardings)
+    return jax.tree_util.tree_map_with_path(spec, b)
+
+
+def shard_batch(
+    b: rt.DeviceBatch, mesh: Mesh, axis: Axis = "nodes",
+    pod_axis: str | None = None,
+) -> rt.DeviceBatch:
+    """Place every leaf with its mesh sharding. The padded node count must
+    divide the node-axis size, and (when ``pod_axis`` is given) the padded
+    pod count must divide the pod-axis size (encode_batch pads both to ≥8).
+
+    ``axis`` may be a tuple (multi-slice: the node dimension shards over
+    all named axes). Registered-dataclass pytree flattening already excludes
+    ``None`` leaves and static metadata fields, so one sharding pytree + one
+    ``device_put`` covers the whole batch, nested quadratic-kernel pytrees
+    included.
+    """
+    return jax.device_put(b, batch_shardings(b, mesh, axis, pod_axis))
 
 
 def _axes_of(mesh: Mesh, axis, pod_axis):
@@ -200,6 +231,111 @@ def sharded_greedy(
     axis, pod_axis = _axes_of(mesh, axis, pod_axis)
     sb = shard_batch(b, mesh, axis, pod_axis)
     return greedy_assign_device(sb, params)
+
+
+def resolve_mesh(spec) -> "Mesh | None":
+    """Normalize the user-facing mesh switch into a Mesh (or None).
+
+    - ``None`` / ``"off"`` / ``False`` — single-device (no mesh).
+    - a ``Mesh`` — used as-is.
+    - ``"auto"`` — a 1-D node-axis mesh over the largest power-of-two
+      device count, or None when only one device is visible.
+    - ``"on"`` / ``True`` — like "auto" but raises when there is nothing to
+      shard over (the operator asked for a mesh; silently running
+      single-device would misreport every MULTICHIP number).
+
+    The power-of-two trim keeps the node axis divisible: ``round_up`` pads
+    every node count to a multiple of 8, so meshes of 2/4/8 (and any larger
+    power of two once padding crosses 1024-multiples) always divide."""
+    if spec is None or spec is False or spec == "off":
+        return None
+    if isinstance(spec, Mesh):
+        return spec
+    if spec not in ("auto", "on", True):
+        raise ValueError(f"unknown mesh spec {spec!r}")
+    devs = jax.devices()
+    n = 1
+    while n * 2 <= len(devs):
+        n *= 2
+    if n < 2:
+        if spec in ("on", True):
+            raise ValueError(
+                f"mesh requested but only {len(devs)} device(s) visible"
+            )
+        return None
+    return make_mesh(devs[:n])
+
+
+def node_axes_of(mesh: Mesh) -> "tuple[Axis, str | None]":
+    """The (node_axis, pod_axis) a mesh implies under default inference —
+    the one place callers (Scheduler, encode_batch, ResidentNodeState) get
+    their axis names and shard counts from, so a 2-D or multi-slice mesh
+    never hits a hard-coded "nodes" lookup."""
+    return _axes_of(mesh, "nodes", None)
+
+
+def node_pad_multiple(mesh: Mesh) -> int:
+    """Shard count of the mesh's node axis: the padded node capacity must
+    be a multiple of this or the sharded resident block degrades to
+    replication (see encode_batch_static(pad_multiple=…))."""
+    axis, _ = node_axes_of(mesh)
+    return _axis_size(mesh, axis)
+
+
+def node_state_shardings(mesh: Mesh, axis: Axis = "nodes"):
+    """Shardings for the persistent ``DeviceNodeState`` block: every leaf
+    shards its node (first) axis. Returned as a DeviceNodeState-shaped
+    pytree of NamedSharding (rank-2 leaves get ``P(axis, None)``)."""
+    row2 = NamedSharding(mesh, P(axis))
+    return rt.DeviceNodeState(
+        alloc=row2, requested=row2, nonzero_requested=row2,
+        pod_count=row2, allowed_pods=row2, node_valid=row2,
+    )
+
+
+def pod_scan_collective_ok(mesh: Mesh, axis: str = "pods") -> bool:
+    """Capability probe for the known-environmental 2-D-mesh failure: the
+    batched engine's tie-spread rank rides ``jax.lax.associative_scan``
+    along the POD axis, and some hosts' virtual CPU meshes miscompute the
+    cross-pod-shard scan collective (``lax.sort`` across the same shards is
+    fine — the scan is the misbehaving collective; verified against the
+    unmodified seed tree). True = the environment computes it correctly, so
+    2-D batched parity checks must run and a failure is a REAL regression.
+    Shared by tests/test_mesh.py and the MULTICHIP dryrun gate."""
+    import jax.numpy as jnp
+
+    x = np.random.default_rng(0).integers(0, 100, size=64).astype(np.int32)
+    fn = jax.jit(lambda v: jax.lax.associative_scan(jnp.maximum, v))
+    ref = np.asarray(fn(jnp.asarray(x)))
+    got = np.asarray(fn(jax.device_put(
+        jnp.asarray(x), NamedSharding(mesh, P(axis))
+    )))
+    return bool(np.array_equal(ref, got))
+
+
+def measure_collective_wall(mesh: Mesh, axis: Axis = "nodes",
+                            n: int = 1 << 14, repeats: int = 3) -> float:
+    """One-shot probe of the cross-shard reduction cost on this mesh: an
+    argmax over a node-axis-sharded vector — the exact collective the
+    engines' host-visible decisions ride on. Returns best-of-``repeats``
+    wall seconds (compile excluded); the scheduler exposes it as the
+    ``tpu_mesh_collective_wall_seconds`` gauge so MULTICHIP numbers carry
+    the collective tax they were measured under."""
+    import time
+
+    import jax.numpy as jnp
+
+    x = jax.device_put(
+        jnp.arange(n, dtype=jnp.int64), NamedSharding(mesh, P(axis))
+    )
+    fn = jax.jit(lambda v: jnp.argmax(v))
+    jax.block_until_ready(fn(x))   # compile
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(x))
+        best = min(best, time.perf_counter() - t0)
+    return best
 
 
 def sharded_batched(

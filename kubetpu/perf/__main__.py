@@ -35,6 +35,11 @@ def main(argv=None) -> None:
                     help="opportunistic API-plane batching: cycle-boundary "
                          "bulk bind/status RPCs + batched informer polls "
                          "(bindings identical to per-call; 'off' to debug)")
+    ap.add_argument("--mesh", default="off", choices=["on", "off", "auto"],
+                    help="shard the node axis over a device mesh "
+                         "(Scheduler(mesh=…)): sharded resident node block "
+                         "+ SPMD engines; assignments bit-identical to "
+                         "single-device, 'on' requires >1 device")
     ap.add_argument("--artifacts-dir", default=None,
                     help="dump per-workload diagnosis artifacts here: the "
                          "cycle trace as Perfetto-loadable Chrome-trace "
@@ -55,6 +60,7 @@ def main(argv=None) -> None:
         pipeline=(args.pipeline == "on"),
         encode_cache=(args.encode_cache == "on"),
         bulk=(args.bulk == "on"),
+        mesh=args.mesh,   # resolve_mesh handles on/off/auto
     )
     if args.label:
         for r in run_label(args.label, **kwargs):

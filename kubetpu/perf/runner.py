@@ -68,6 +68,12 @@ class WorkloadResult:
     rpcs_per_scheduled_pod: float | None = None
     dispatcher_batch_mean: float | None = None
     dispatcher_errors: int = 0
+    # mesh-sharded assignment (parallel.mesh): device count + mesh shape the
+    # run was sharded over ((), 1 = single device) and the cross-shard
+    # reduction probe — MULTICHIP records must carry their own context
+    n_devices: int = 1
+    mesh_shape: tuple = ()
+    collective_wall_s: float | None = None
     # post-run metric snapshot (SchedulerMetricsRegistry.snapshot): p50/p99
     # from the histograms + schedule_attempts by result — every BENCH json
     # carries its own diagnosis
@@ -118,6 +124,11 @@ class WorkloadResult:
             out["dispatcher_batch_mean"] = round(self.dispatcher_batch_mean, 1)
         if self.dispatcher_errors:
             out["dispatcher_errors"] = self.dispatcher_errors
+        if self.mesh_shape:
+            out["n_devices"] = self.n_devices
+            out["mesh_shape"] = list(self.mesh_shape)
+            if self.collective_wall_s is not None:
+                out["collective_wall_s"] = round(self.collective_wall_s, 6)
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -260,6 +271,20 @@ def _encode_stats(sched, cycles0: int) -> dict:
         if dh + dm:
             out["encode_cache_hit_rate"] = dh / (dh + dm)
     return out
+
+
+def _mesh_stats(sched) -> dict:
+    """Mesh context of the run (device count / shape / collective probe) —
+    stamped into every record so multichip numbers are self-describing."""
+    shape = sched.mesh_shape
+    n = 1
+    for d in shape:
+        n *= d
+    return dict(
+        n_devices=n,
+        mesh_shape=shape,
+        collective_wall_s=sched._collective_wall_s,
+    )
 
 
 def _dispatcher_stats(sched) -> dict:
@@ -455,6 +480,7 @@ def run_workload(
     pipeline: bool = False,
     encode_cache: bool = True,
     bulk: bool = True,
+    mesh=None,
 ) -> WorkloadResult:
     """Execute one (test case, workload) pair and return the measurement.
     ``engine`` selects the assignment engine ("greedy" scan or "batched"
@@ -472,7 +498,10 @@ def run_workload(
     event-time template-keyed encode cache (``--encode-cache off`` escape
     hatch — cached and fresh encodes are bit-identical). ``bulk`` toggles
     the dispatcher's cycle-boundary micro-batching (``--bulk off`` escape
-    hatch — the off path is pod-for-pod identical)."""
+    hatch — the off path is pod-for-pod identical). ``mesh`` shards the
+    node axis over a device mesh (Scheduler(mesh=…): None/"off", "auto",
+    "on", or a jax.sharding.Mesh) — bit-identical assignments, N-chip
+    capacity."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -483,7 +512,7 @@ def run_workload(
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
-        bulk=bulk,
+        bulk=bulk, mesh=mesh,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     client.sched = sched
@@ -792,6 +821,7 @@ def run_workload(
         **traffic,
         **_encode_stats(sched, cycles0),
         **_dispatcher_stats(sched),
+        **_mesh_stats(sched),
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops
@@ -837,6 +867,7 @@ def run_workload_full_stack(
     pipeline: bool = False,
     encode_cache: bool = True,
     bulk: bool = True,
+    mesh=None,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -902,7 +933,7 @@ def run_workload_full_stack(
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
-        bulk=bulk,
+        bulk=bulk, mesh=mesh,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     informers = SchedulerInformers(remote, sched, bulk=bulk)
@@ -1044,6 +1075,7 @@ def run_workload_full_stack(
         **traffic,
         **_encode_stats(sched, cycles0),
         **_dispatcher_stats(sched),
+        **_mesh_stats(sched),
         rpcs_per_scheduled_pod=(
             rpcs_total / measured if measured else None
         ),

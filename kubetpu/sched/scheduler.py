@@ -143,6 +143,7 @@ class Scheduler:
         pipeline: bool = False,
         encode_cache: bool = True,
         bulk: bool = True,
+        mesh=None,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -175,7 +176,16 @@ class Scheduler:
         boundary as per-call-type bulk RPCs (a cycle's binds become one
         request); partial failures fall back to per-call execution, so
         every pod's bind-error path is unchanged and ``bulk=False``
-        (``--bulk off``) is pod-for-pod identical."""
+        (``--bulk off``) is pod-for-pod identical.
+        ``mesh``: shard the node axis of every device tensor over a TPU
+        mesh (``parallel.mesh`` rules): a ``jax.sharding.Mesh``, ``"auto"``
+        (mesh when >1 device is visible), ``"on"`` (require one) or
+        None/``"off"``. The resident node block becomes a SHARDED resident
+        block (per-shard routed delta uploads, incremental reshard on node
+        add/delete) and both engines run SPMD with XLA-inserted collectives
+        for the cross-shard argmax/sort — assignments are bit-identical to
+        single-device, so ``mesh=None`` is a capacity choice, not a
+        semantics one."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
@@ -257,14 +267,42 @@ class Scheduler:
         # previous cycle's NodeTensors — encode_snapshot refreshes only the
         # rows whose generation moved (O(Δ) per-cycle host encode)
         self._prev_nt = None
+        # --- mesh sharding (parallel.mesh) -------------------------------
+        from ..parallel.mesh import resolve_mesh
+
+        self.mesh = resolve_mesh(mesh)
+        # mesh shape attribute stamped on cycle spans/records so MULTICHIP
+        # numbers are attributable ("2x4" style, "" when single-device)
+        self.mesh_shape: tuple = (
+            tuple(self.mesh.devices.shape) if self.mesh is not None else ()
+        )
+        # padded node capacity must divide the shard count or the sharded
+        # resident block degrades to replication (encode_batch_static)
+        self._pad_multiple = 1
+        if self.mesh is not None:
+            from ..parallel.mesh import node_pad_multiple
+
+            self._pad_multiple = node_pad_multiple(self.mesh)
+        self._collective_wall_s: float | None = None
+        if self.mesh is not None:
+            from ..parallel.mesh import measure_collective_wall
+
+            # one-shot cross-shard reduction probe: the collective tax this
+            # mesh pays per argmax, exposed as a gauge next to the per-cycle
+            # kernel walls (MULTICHIP evidence carries its own context)
+            try:
+                self._collective_wall_s = measure_collective_wall(self.mesh)
+            except Exception:
+                self._collective_wall_s = None
         # --- pipeline state (see class docstring of _InflightCycle) ------
         self.pipeline = bool(pipeline)
         # the device-resident node block serves the SERIAL loop too (PR 2
         # introduced it for pipeline mode): every cycle completes before
         # the next encode's dirty-row scatter donates the old buffers, so
         # the donation contract holds in both modes — steady-state
-        # host→device traffic is O(Δ·R) regardless of pipelining
-        self._resident = rt.ResidentNodeState()
+        # host→device traffic is O(Δ·R) regardless of pipelining. Under a
+        # mesh it is the SHARDED resident block (per-shard routed deltas).
+        self._resident = rt.ResidentNodeState(mesh=self.mesh)
         self._inflight: _InflightCycle | None = None
         # sticky: any host-state refresh between dispatch and sync that
         # found the cluster materially changed flips this; sync replays
@@ -725,6 +763,7 @@ class Scheduler:
                 resident=self._resident,
                 cache=self.encode_cache,
                 track_changes=self.pipeline,
+                mesh=self.mesh,
             )
             self._prev_nt = batch.node_tensors
             params = rt.score_params(self.profile, batch.resource_names)
@@ -930,6 +969,7 @@ class Scheduler:
                 self._snapshot, pods, profile,
                 nominated=(), prev_nt=self._prev_nt,
                 cache=self.encode_cache,
+                pad_multiple=self._pad_multiple,
             )
         except Exception:
             # stage 1 is an optimization: any failure falls back to the
@@ -1046,6 +1086,7 @@ class Scheduler:
                         resident=self._resident,
                         cache=self.encode_cache,
                         track_changes=self.pipeline,
+                        mesh=self.mesh,
                     )
                 if self.encode_cache is not None and enc_sp is not None:
                     # gather-vs-fresh-vs-invalidate: how this cycle's rows
@@ -1110,7 +1151,8 @@ class Scheduler:
             return None
         try:
             return rt.finalize_batch(
-                static, self._snapshot, nominated=(), resident=self._resident
+                static, self._snapshot, nominated=(),
+                resident=self._resident, mesh=self.mesh,
             )
         except rt.StaleStaticEncode:
             return None
@@ -1138,11 +1180,17 @@ class Scheduler:
             wall_start = t_sync if inflight.pipelined else inflight.t_dev
             kernel_wall_s = t_done - wall_start
             cache1 = jit_cache_size(self._assign_device)
-            self.tracer.record(
-                "assign", start=wall_start, end=t_done,
+            assign_attrs = dict(
                 cycle=cycle_id, sync_wait_s=round(t_done - t_sync, 6),
                 kernel_wall_s=round(kernel_wall_s, 6),
             )
+            if self.mesh_shape:
+                # mesh shape + shard count on every device span: MULTICHIP
+                # traces stay attributable per chip
+                assign_attrs["mesh"] = "x".join(map(str, self.mesh_shape))
+                assign_attrs["shards"] = self._resident._n_shards
+            self.tracer.record("assign", start=wall_start, end=t_done,
+                               **assign_attrs)
             # device-side counters, joined to the spans by cycle id
             compile_miss = (
                 None if inflight.cache0 is None or cache1 is None
@@ -1162,17 +1210,43 @@ class Scheduler:
                 batch_bytes=full_bytes,
                 resident_bytes=batch.resident_bytes,
                 pipelined=inflight.pipelined,
+                mesh_shape=self.mesh_shape,
+                shard_transfer_bytes=(
+                    list(self._resident.last_upload_bytes_per_shard)
+                    if self.mesh_shape else None
+                ),
+                shard_resident_bytes=(
+                    self._resident.nbytes_per_shard
+                    if self.mesh_shape else None
+                ),
+                collective_wall_s=self._collective_wall_s,
             )
+            if self.mesh_shape:
+                # per-shard routed-delta attribution, joined by cycle id
+                for s_i, (b_s, r_s) in enumerate(zip(
+                    self._resident.last_upload_bytes_per_shard,
+                    self._resident.last_rows_per_shard,
+                )):
+                    if r_s:
+                        self.tracer.instant(
+                            "shard-upload", cycle=cycle_id, shard=s_i,
+                            bytes=b_s, rows=r_s,
+                        )
             # the fused device program IS Filter+Score (one XLA
             # program — per-plugin splits don't exist on device)
             prom.framework_extension_point_duration.labels(
                 "Filter+Score", "Success", profile.name
             ).observe(kernel_wall_s)
+            cycle_attrs = dict(
+                cycle=cycle_id, profile=profile.name,
+                pods=len(batch_infos), pipelined=inflight.pipelined,
+                off_stack=False,
+            )
+            if self.mesh_shape:
+                cycle_attrs["mesh"] = "x".join(map(str, self.mesh_shape))
             self.tracer.record(
                 "scheduling-cycle", start=inflight.t_start,
-                end=time.perf_counter(), cycle=cycle_id,
-                profile=profile.name, pods=len(batch_infos),
-                pipelined=inflight.pipelined, off_stack=False,
+                end=time.perf_counter(), **cycle_attrs,
             )
             self._cycle_ctx = (
                 batch, inflight.params, inflight.final_state,

@@ -193,6 +193,27 @@ class NodeTensors:
     def num_resources(self) -> int:
         return len(self.resource_names)
 
+    def diff_rows(self, other: "NodeTensors") -> "list[int] | None":
+        """Row indices whose resource/count values differ from ``other``
+        (vectorized over the full padded capacity). None when the two are
+        not comparable — different padded capacity or resource axis. The
+        incremental-reshard path of ``runtime.ResidentNodeState`` uses this
+        to turn a node add/delete (which rebuilds the NodeTensors object)
+        into a dirty-row delta upload instead of a full re-upload."""
+        if (
+            other.alloc.shape != self.alloc.shape
+            or other.resource_names != self.resource_names
+        ):
+            return None
+        changed = (
+            np.any(self.alloc != other.alloc, axis=1)
+            | np.any(self.requested != other.requested, axis=1)
+            | np.any(self.nonzero_requested != other.nonzero_requested, axis=1)
+            | (self.pod_count != other.pod_count)
+            | (self.allowed_pods != other.allowed_pods)
+        )
+        return np.flatnonzero(changed).tolist()
+
     # ---- label machinery -------------------------------------------------
     def _ensure_label_matrix(self) -> np.ndarray:
         if self.node_label is None or self.node_label.shape[1] < len(self.key_vocab):
