@@ -1,0 +1,43 @@
+"""graftcheck — project-invariant static analysis for kubetpu.
+
+Every perf PR so far re-earned the same invariants by hand: PR 5 fixed
+torn read-modify-write counters in the API dispatcher, PR 2/6 hand-audited
+jit donation and device-transfer discipline, PR 3 hand-checked encode-cache
+invalidation. This package makes that correctness envelope machine-checked:
+an AST-based checker suite (``python -m kubetpu.analysis kubetpu/``) with a
+registry, per-file parallel walk, and a baseline/allowlist file for the
+rare justified exception — plus a runtime lock-order witness
+(``kubetpu.analysis.witness``) the concurrency tests enable.
+
+Checker catalog (``--explain CODE`` prints the full rationale):
+
+- LD001/LD002/LD003  lock discipline (the PR-5 dispatcher race shape)
+- JP001              jit purity — no host side effects inside jit bodies
+- DS001              donation safety — donated buffers are dead after call
+- HT001/HT002        hot-path transfer — device traffic only at the seams
+- MR001/MR002/MR003  metrics-registry consistency
+- TS001/TS002        trace-span balance — spans close on exception paths
+
+Import surface: ``analyze_paths`` runs the suite programmatically (the
+tier-1 test ``tests/test_static_analysis.py`` gates on it), ``CHECKERS``
+is the registry, ``Violation`` the finding record.
+"""
+
+from .core import (  # noqa: F401
+    CHECKERS,
+    AnalysisResult,
+    Checker,
+    ModuleInfo,
+    Violation,
+    all_checkers,
+    analyze_paths,
+    get_checker,
+)
+
+# importing the checker modules registers them on CHECKERS
+from . import lockcheck  # noqa: F401,E402
+from . import jitpure  # noqa: F401,E402
+from . import donation  # noqa: F401,E402
+from . import transfer  # noqa: F401,E402
+from . import metriccheck  # noqa: F401,E402
+from . import spancheck  # noqa: F401,E402

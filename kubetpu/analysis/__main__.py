@@ -1,0 +1,149 @@
+"""CLI: ``python -m kubetpu.analysis [paths…]``.
+
+Exit codes: 0 clean (or fully baselined), 1 new violations or a broken
+baseline, 2 usage error. ``--format=json`` emits a machine-readable
+report (the CI artifact); ``--explain CODE`` prints a checker's invariant
+rationale and the historical bug behind it; ``--write-baseline`` emits a
+baseline document for the current findings to stdout (each entry still
+needs a human-written reason before the next run accepts it).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+import os
+
+from . import all_checkers, analyze_paths, get_checker
+from .baseline import DEFAULT_BASELINE, Baseline, find_default_baseline
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m kubetpu.analysis",
+        description="graftcheck: project-invariant static analysis",
+    )
+    p.add_argument("paths", nargs="*", default=None,
+                   help="files/directories to analyze (default: kubetpu/)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--baseline", default=None, metavar="FILE",
+                   help=f"allowlist file (default: {DEFAULT_BASELINE} "
+                        f"when present)")
+    p.add_argument("--no-baseline", action="store_true",
+                   help="ignore any baseline file")
+    p.add_argument("--explain", metavar="CODE", default=None,
+                   help="print the invariant behind CODE and exit")
+    p.add_argument("--list-checkers", action="store_true")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="emit a baseline doc for current findings to "
+                        "stdout (entries still need human reasons)")
+    p.add_argument("--jobs", type=int, default=None)
+    p.add_argument("--select", metavar="CODES", default=None,
+                   help="comma-separated checker codes to run")
+    return p
+
+
+def main(argv: "list[str] | None" = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.explain:
+        ck = get_checker(args.explain.upper())
+        if ck is None:
+            print(f"unknown checker code {args.explain!r}; known: "
+                  + ", ".join(c.code for c in all_checkers()),
+                  file=sys.stderr)
+            return 2
+        print(f"{ck.code}: {ck.title}\n")
+        print(ck.rationale)
+        return 0
+
+    if args.list_checkers:
+        for ck in all_checkers():
+            print(f"{ck.code}  {ck.title}")
+        return 0
+
+    checkers = None
+    if args.select:
+        want = {c.strip().upper() for c in args.select.split(",") if c.strip()}
+        checkers = [c for c in all_checkers() if c.code in want]
+        unknown = want - {c.code for c in checkers}
+        if unknown:
+            print(f"unknown checker codes: {sorted(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    paths = args.paths or ["kubetpu"]
+    baseline_path = args.baseline
+    if baseline_path is None and not args.no_baseline:
+        baseline_path = find_default_baseline(paths[0])
+    # repo-relative finding paths must match the baseline's keys no
+    # matter where the tool is invoked from: when the baseline lives in
+    # an ancestor of the analyzed tree, that directory IS the repo root
+    root = None
+    if baseline_path is not None:
+        bl_dir = os.path.dirname(os.path.abspath(baseline_path)) or "."
+        first = os.path.abspath(paths[0])
+        if (first + os.sep).startswith(bl_dir + os.sep) or first == bl_dir:
+            root = bl_dir
+    result = analyze_paths(paths, root=root, checkers=checkers,
+                           jobs=args.jobs)
+    if not result.files:
+        # a typo'd path or wrong CWD must not greenlight the CI gate
+        print(
+            f"error: no Python files matched {paths!r} "
+            f"(cwd: {os.getcwd()})",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        baseline = (
+            Baseline() if args.no_baseline
+            else Baseline.load(baseline_path)
+        )
+    except (OSError, ValueError) as e:
+        print(f"baseline: {e}", file=sys.stderr)
+        return 2
+    baseline_problems = baseline.problems()
+    new, suppressed, stale = baseline.split(result.violations)
+
+    if args.write_baseline:
+        print(json.dumps(Baseline.render(new), indent=2))
+        return 0
+
+    if args.format == "json":
+        print(json.dumps({
+            "files": len(result.files),
+            "checkers": [c.code for c in (checkers or all_checkers())],
+            "violations": [v.to_json() for v in new],
+            "suppressed": [v.to_json() for v in suppressed],
+            "stale_baseline": stale,
+            "baseline_problems": baseline_problems,
+            "errors": result.errors,
+        }, indent=2))
+    else:
+        for v in new:
+            print(v.render())
+        for v in suppressed:
+            print(f"baselined: {v.render()}")
+        for e in stale:
+            print(f"stale baseline entry (fixed? remove it): "
+                  f"{e.get('code')} {e.get('path')} {e.get('symbol', '')}")
+        for msg in baseline_problems:
+            print(f"error: {msg}")
+        for msg in result.errors:
+            print(f"error: {msg}")
+        n = len(new)
+        print(f"{len(result.files)} files, "
+              f"{n} violation{'s' if n != 1 else ''}"
+              + (f", {len(suppressed)} baselined" if suppressed else ""))
+
+    if new or baseline_problems or result.errors:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,301 @@
+"""Shared AST plumbing for the checkers.
+
+Everything here is dependency-free stdlib ``ast`` work: dotted-name
+rendering, decorator classification (is this function jit-wrapped? with
+which donate_argnums?), class scans (which attributes look like locks),
+and a small walker that tracks the enclosing class/function/with-lock
+context — the shape every lock/span checker needs.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+
+def dotted(node: ast.AST) -> str | None:
+    """Render a Name/Attribute chain as ``a.b.c``; None when the chain
+    bottoms out in anything else (a call, a subscript…)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def terminal_attr(node: ast.AST) -> str | None:
+    """The last attribute segment of a dotted chain (``a.b.c`` -> ``c``)."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+_LOCK_CTORS = {"Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore"}
+
+
+def is_lock_ctor(call: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Lock()`` / ``threading.Condition()`` …"""
+    if not isinstance(call, ast.Call):
+        return False
+    name = terminal_attr(call.func)
+    return name in _LOCK_CTORS
+
+
+@dataclass
+class JitInfo:
+    """One jit-wrapped function found in a module."""
+
+    name: str                      # plain function name
+    qualname: str                  # Class.name when nested in a class
+    lineno: int
+    donate: tuple[int, ...] = ()   # donate_argnums, () when absent
+    node: ast.AST = None           # the FunctionDef / Lambda
+    has_shard_map: bool = False
+
+
+def _donate_from_call(call: ast.Call) -> tuple[int, ...]:
+    for kw in call.keywords:
+        if kw.arg in ("donate_argnums", "donate_argnames"):
+            v = kw.value
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for elt in v.elts:
+                    if isinstance(elt, ast.Constant) and isinstance(
+                        elt.value, int
+                    ):
+                        out.append(elt.value)
+                return tuple(out)
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+    return ()
+
+
+def jit_decorator_info(dec: ast.AST) -> tuple[bool, tuple[int, ...]] | None:
+    """Classify one decorator: returns (is_jit, donate_argnums) or None
+    when it is not a jit wrapper. Recognized shapes::
+
+        @jax.jit
+        @jit
+        @partial(jax.jit, donate_argnums=(0, 1))
+        @functools.partial(jax.jit, static_argnames=("params",))
+    """
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        if terminal_attr(dec) == "jit":
+            return True, ()
+        return None
+    if isinstance(dec, ast.Call):
+        fname = terminal_attr(dec.func)
+        if fname == "jit":
+            return True, _donate_from_call(dec)
+        if fname == "partial" and dec.args:
+            inner = terminal_attr(dec.args[0])
+            if inner == "jit":
+                return True, _donate_from_call(dec)
+        if fname == "shard_map" or (
+            fname == "partial" and dec.args
+            and terminal_attr(dec.args[0]) == "shard_map"
+        ):
+            # shard_map alone is a device-program body too (jit usually
+            # stacks on top); report as jit-shaped without donation
+            return True, ()
+    return None
+
+
+def is_shard_map_decorator(dec: ast.AST) -> bool:
+    if isinstance(dec, (ast.Name, ast.Attribute)):
+        return terminal_attr(dec) == "shard_map"
+    if isinstance(dec, ast.Call):
+        fname = terminal_attr(dec.func)
+        if fname == "shard_map":
+            return True
+        if fname == "partial" and dec.args:
+            return terminal_attr(dec.args[0]) == "shard_map"
+    return False
+
+
+def collect_jitted(tree: ast.AST) -> list[JitInfo]:
+    """Every jit/shard_map-decorated FunctionDef plus ``name = jax.jit(fn,
+    donate_argnums=…)`` assignment, with their donation tuples. Also
+    catches jitted lambdas assigned to a name (``fn = jax.jit(lambda …)``)."""
+    out: list[JitInfo] = []
+
+    class V(ast.NodeVisitor):
+        def __init__(self) -> None:
+            self.klass: list[str] = []
+
+        def visit_ClassDef(self, node: ast.ClassDef) -> None:
+            self.klass.append(node.name)
+            self.generic_visit(node)
+            self.klass.pop()
+
+        def _qual(self, name: str) -> str:
+            return ".".join(self.klass + [name]) if self.klass else name
+
+        def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+            donate: tuple[int, ...] = ()
+            jitted = False
+            shard = any(
+                is_shard_map_decorator(d) for d in node.decorator_list
+            )
+            for dec in node.decorator_list:
+                info = jit_decorator_info(dec)
+                if info is not None:
+                    jitted = True
+                    if info[1]:
+                        donate = info[1]
+            if jitted:
+                out.append(JitInfo(
+                    name=node.name, qualname=self._qual(node.name),
+                    lineno=node.lineno, donate=donate, node=node,
+                    has_shard_map=shard,
+                ))
+            self.generic_visit(node)
+
+        visit_AsyncFunctionDef = visit_FunctionDef
+
+        def visit_Assign(self, node: ast.Assign) -> None:
+            # fn = jax.jit(target, donate_argnums=…)
+            v = node.value
+            if isinstance(v, ast.Call) and terminal_attr(v.func) == "jit":
+                for tgt in node.targets:
+                    name = terminal_attr(tgt)
+                    if name is None:
+                        continue
+                    body = v.args[0] if v.args else None
+                    out.append(JitInfo(
+                        name=name, qualname=self._qual(name),
+                        lineno=node.lineno, donate=_donate_from_call(v),
+                        node=body if isinstance(
+                            body, (ast.Lambda, ast.Name)
+                        ) else v,
+                    ))
+            self.generic_visit(node)
+
+    V().visit(tree)
+    return out
+
+
+@dataclass
+class ClassScan:
+    """Per-class facts the lock checkers consume."""
+
+    name: str
+    lineno: int
+    lock_attrs: set[str] = field(default_factory=set)
+    #: attrs assigned a numeric literal in __init__ or as a dataclass
+    #: field default — the "counter-like" set
+    counter_attrs: set[str] = field(default_factory=set)
+    #: every attr this class assigns on self anywhere
+    defined_attrs: set[str] = field(default_factory=set)
+    #: attr -> list of (lineno, method, locked, is_aug) write sites
+    writes: dict[str, list] = field(default_factory=dict)
+
+
+def _self_attr_target(node: ast.AST) -> str | None:
+    """``self.X`` or ``self.X[i]`` as a write target -> ``X``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and isinstance(
+        node.value, ast.Name
+    ) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def scan_classes(tree: ast.AST) -> list[ClassScan]:
+    """Walk every class: find its lock attributes, its counter-like
+    attributes, and every ``self.X`` write site annotated with whether it
+    ran under ``with self.<lock>`` and in which method."""
+    scans: list[ClassScan] = []
+
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cs = ClassScan(name=node.name, lineno=node.lineno)
+
+        # dataclass-style numeric field defaults are counters too
+        for stmt in node.body:
+            if isinstance(stmt, ast.AnnAssign) and isinstance(
+                stmt.target, ast.Name
+            ):
+                cs.defined_attrs.add(stmt.target.id)
+                if isinstance(stmt.value, ast.Constant) and isinstance(
+                    stmt.value.value, (int, float)
+                ) and not isinstance(stmt.value.value, bool):
+                    cs.counter_attrs.add(stmt.target.id)
+
+        # first pass: find the lock attrs (any method may create one)
+        for fn in (n for n in node.body if isinstance(n, ast.FunctionDef)):
+            for sub in ast.walk(fn):
+                if isinstance(sub, ast.Assign) and is_lock_ctor(sub.value):
+                    for tgt in sub.targets:
+                        attr = _self_attr_target(tgt)
+                        if attr is not None:
+                            cs.lock_attrs.add(attr)
+
+        # second pass: annotate every self.X write with lock context
+        for fn in (n for n in node.body if isinstance(n, ast.FunctionDef)):
+            _scan_method(cs, fn)
+
+        scans.append(cs)
+    return scans
+
+
+def _scan_method(cs: ClassScan, fn: ast.FunctionDef) -> None:
+    method = fn.name
+
+    def is_lock_ctx(item: ast.withitem) -> bool:
+        expr = item.context_expr
+        # with self._lock:  /  with self._lock, other:  /  cond-style
+        attr = _self_attr_target(expr) or (
+            _self_attr_target(expr.func)
+            if isinstance(expr, ast.Call) else None
+        )
+        return attr in cs.lock_attrs
+
+    def walk(node: ast.AST, locked: bool) -> None:
+        for child in ast.iter_child_nodes(node):
+            child_locked = locked
+            if isinstance(child, ast.With):
+                if any(is_lock_ctx(i) for i in child.items):
+                    child_locked = True
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda)):
+                # nested defs run later, on an unknown thread, outside
+                # the current lock scope
+                walk(child, False)
+                continue
+            if isinstance(child, (ast.Assign, ast.AugAssign)):
+                targets = (
+                    child.targets if isinstance(child, ast.Assign)
+                    else [child.target]
+                )
+                for tgt in targets:
+                    attr = _self_attr_target(tgt)
+                    if attr is None:
+                        continue
+                    cs.defined_attrs.add(attr)
+                    if method == "__init__" and isinstance(
+                        child, ast.Assign
+                    ) and isinstance(child.value, ast.Constant) and (
+                        isinstance(child.value.value, (int, float))
+                        and not isinstance(child.value.value, bool)
+                    ):
+                        cs.counter_attrs.add(attr)
+                    cs.writes.setdefault(attr, []).append((
+                        getattr(child, "lineno", fn.lineno), method,
+                        child_locked, isinstance(child, ast.AugAssign),
+                    ))
+            walk(child, child_locked)
+
+    walk(fn, False)
+
+
+def call_name(node: ast.Call) -> str | None:
+    """Dotted name of a call's callee (``a.b.c(…)`` -> ``a.b.c``)."""
+    return dotted(node.func)

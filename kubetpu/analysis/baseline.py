@@ -1,0 +1,121 @@
+"""Baseline / allowlist file for deliberate, justified exceptions.
+
+Shape (``analysis_baseline.json`` at the repo root)::
+
+    {
+      "version": 1,
+      "entries": [
+        {"code": "DS001", "path": "kubetpu/…", "symbol": "fn:arg",
+         "reason": "why this one is deliberately allowed"}
+      ]
+    }
+
+Entries match findings by (code, path, symbol) — line-independent, so
+unrelated edits don't churn the file. Every entry MUST carry a non-empty
+``reason``; an entry without one is itself an error (the allowlist is for
+justified exceptions, not for muting). Stale entries (matching nothing)
+are reported so the file shrinks as fixes land.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from .core import Violation
+
+DEFAULT_BASELINE = "analysis_baseline.json"
+
+
+def find_default_baseline(first_path: str) -> str | None:
+    """Locate ``analysis_baseline.json``: the cwd first, then walking up
+    the parents of the first analyzed path — so running the tool from
+    outside the repo root still finds (and key-matches) the repo's
+    baseline instead of silently checking against nothing."""
+    if os.path.exists(DEFAULT_BASELINE):
+        return DEFAULT_BASELINE
+    cur = os.path.abspath(first_path)
+    if os.path.isfile(cur):
+        cur = os.path.dirname(cur)
+    for _ in range(32):
+        cand = os.path.join(cur, DEFAULT_BASELINE)
+        if os.path.exists(cand):
+            return cand
+        parent = os.path.dirname(cur)
+        if parent == cur:
+            return None
+        cur = parent
+    return None
+
+
+@dataclass
+class Baseline:
+    entries: list[dict] = field(default_factory=list)
+    path: str | None = None
+
+    @classmethod
+    def load(cls, path: str | None) -> "Baseline":
+        """Load ``path``; a missing default file is an empty baseline, a
+        missing EXPLICIT file is an error the caller surfaces."""
+        if path is None:
+            if os.path.exists(DEFAULT_BASELINE):
+                path = DEFAULT_BASELINE
+            else:
+                return cls()
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+        entries = data.get("entries", []) if isinstance(data, dict) else data
+        return cls(entries=list(entries), path=path)
+
+    def problems(self) -> list[str]:
+        out = []
+        for i, e in enumerate(self.entries):
+            if not isinstance(e, dict) or not e.get("code") or not e.get(
+                "path"
+            ):
+                out.append(f"baseline entry {i}: missing code/path")
+                continue
+            if not str(e.get("reason", "")).strip():
+                out.append(
+                    f"baseline entry {i} ({e['code']} {e['path']}): no "
+                    f"reason — the allowlist is for justified exceptions"
+                )
+        return out
+
+    def _key(self, e: dict) -> tuple:
+        return (e.get("code"), e.get("path"), e.get("symbol", ""))
+
+    def split(
+        self, violations: list[Violation]
+    ) -> tuple[list[Violation], list[Violation], list[dict]]:
+        """(new, suppressed, stale_entries)."""
+        keys = {self._key(e): e for e in self.entries}
+        matched: set = set()
+        new: list[Violation] = []
+        suppressed: list[Violation] = []
+        for v in violations:
+            k = v.key()
+            if k in keys:
+                matched.add(k)
+                suppressed.append(v)
+            else:
+                new.append(v)
+        stale = [e for e in self.entries if self._key(e) not in matched]
+        return new, suppressed, stale
+
+    @staticmethod
+    def render(violations: list[Violation], reason: str = "TODO: justify") -> dict:
+        """A baseline document covering ``violations`` — the
+        ``--write-baseline`` output a reviewer then justifies entry by
+        entry (an unjustified entry fails the next run)."""
+        return {
+            "version": 1,
+            "entries": [
+                {
+                    "code": v.code, "path": v.path, "symbol": v.symbol,
+                    "reason": reason,
+                }
+                for v in sorted(set(violations))
+            ],
+        }

@@ -1,0 +1,244 @@
+"""Checker registry + per-file parallel walk + the analysis driver.
+
+Shape: a ``Checker`` declares a ``code``, a one-line ``title``, and a
+multi-paragraph ``rationale`` (the invariant and the historical bug that
+motivated it — ``--explain`` prints this). The driver parses every file
+once (parallel across files), hands each checker the per-module facts via
+``collect``, then runs each checker's ``report`` over the whole project's
+collected facts — so cross-module checkers (metric registry consistency,
+cross-module counter mutation) see everything while per-file checkers just
+emit from their own module.
+
+Findings are ``Violation`` records keyed (code, path, symbol) — line
+numbers are carried for display but baseline matching is line-independent
+so unrelated edits don't churn the allowlist (``baseline.py``).
+"""
+
+from __future__ import annotations
+
+import ast
+import concurrent.futures as _futures
+import os
+from dataclasses import dataclass, field
+from typing import Any, Callable, Iterable
+
+
+@dataclass(frozen=True, order=True)
+class Violation:
+    """One finding. ``symbol`` is the dotted context (Class.method or
+    Class.attr) the finding anchors to — the stable half of the baseline
+    key; ``line`` is display-only."""
+
+    path: str          # repo-relative, forward slashes
+    line: int
+    code: str
+    message: str
+    symbol: str = ""
+
+    def key(self) -> tuple[str, str, str]:
+        return (self.code, self.path, self.symbol)
+
+    def render(self) -> str:
+        sym = f" [{self.symbol}]" if self.symbol else ""
+        return f"{self.path}:{self.line}: {self.code}: {self.message}{sym}"
+
+    def to_json(self) -> dict:
+        return {
+            "code": self.code, "path": self.path, "line": self.line,
+            "symbol": self.symbol, "message": self.message,
+        }
+
+
+@dataclass
+class ModuleInfo:
+    """One parsed source file."""
+
+    path: str              # absolute
+    relpath: str           # relative to the analysis root, forward slashes
+    tree: ast.AST
+    source: str
+
+    @property
+    def modname(self) -> str:
+        return self.relpath[:-3].replace("/", ".") if (
+            self.relpath.endswith(".py")
+        ) else self.relpath.replace("/", ".")
+
+
+class Checker:
+    """Base class. Subclasses set ``code``/``title``/``rationale`` and
+    override ``collect`` (per-module, runs in the parallel walk) and
+    ``report`` (whole-project, sequential). A purely per-file checker can
+    return violations straight from ``collect``; ``report`` then just
+    flattens them (the default)."""
+
+    code: str = ""
+    title: str = ""
+    rationale: str = ""
+
+    def covers(self, relpath: str) -> bool:
+        """Whether this checker examines ``relpath`` at all — the
+        coverage contract the perf smoke gates assert on (a file move
+        must not silently drop a hot file out of a checker's scope)."""
+        return relpath.endswith(".py")
+
+    def collect(self, mod: ModuleInfo) -> Any:
+        return []
+
+    def report(self, collected: "list[tuple[ModuleInfo, Any]]") -> list[Violation]:
+        out: list[Violation] = []
+        for _mod, facts in collected:
+            out.extend(facts)
+        return out
+
+
+#: registry: code -> checker instance (populated by @register at import)
+CHECKERS: dict[str, Checker] = {}
+
+
+def register(checker_cls: "type[Checker]") -> "type[Checker]":
+    inst = checker_cls()
+    if inst.code in CHECKERS:
+        raise ValueError(f"checker code {inst.code!r} already registered")
+    CHECKERS[inst.code] = inst
+    return checker_cls
+
+
+def all_checkers() -> list[Checker]:
+    return [CHECKERS[c] for c in sorted(CHECKERS)]
+
+
+def get_checker(code: str) -> Checker | None:
+    return CHECKERS.get(code)
+
+
+# --------------------------------------------------------------- file walk
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", "build", "dist"}
+
+
+def iter_py_files(paths: Iterable[str]) -> list[str]:
+    out: list[str] = []
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(os.path.abspath(p))
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = [d for d in dirs if d not in _SKIP_DIRS]
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.abspath(os.path.join(root, f)))
+    # stable order for deterministic output
+    return sorted(set(out))
+
+
+def _relpath(path: str, root: str) -> str:
+    try:
+        rel = os.path.relpath(path, root)
+    except ValueError:          # different drive (windows)
+        rel = path
+    if rel.startswith(".."):
+        rel = path
+    return rel.replace(os.sep, "/")
+
+
+def load_module(path: str, root: str) -> ModuleInfo | None:
+    """Parse one file; unparseable files are skipped (they are somebody
+    else's build problem, not a checker finding)."""
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            src = f.read()
+        tree = ast.parse(src, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    return ModuleInfo(
+        path=path, relpath=_relpath(path, root), tree=tree, source=src
+    )
+
+
+@dataclass
+class AnalysisResult:
+    """Everything one run produced, pre-baseline: the tier-1 test and the
+    CLI both consume this."""
+
+    violations: list[Violation] = field(default_factory=list)
+    files: list[str] = field(default_factory=list)          # relpaths walked
+    #: checker code -> relpaths that checker actually examined (its
+    #: ``covers`` contract evaluated against the walked set)
+    coverage: dict[str, list[str]] = field(default_factory=dict)
+    errors: list[str] = field(default_factory=list)
+
+    def by_code(self) -> dict[str, list[Violation]]:
+        out: dict[str, list[Violation]] = {}
+        for v in self.violations:
+            out.setdefault(v.code, []).append(v)
+        return out
+
+
+def analyze_paths(
+    paths: Iterable[str],
+    root: str | None = None,
+    checkers: Iterable[Checker] | None = None,
+    jobs: int | None = None,
+) -> AnalysisResult:
+    """Run the suite: parse + per-checker ``collect`` per file (parallel
+    across files), then each checker's whole-project ``report``. ``root``
+    anchors the repo-relative paths in findings (default: cwd)."""
+    root = os.path.abspath(root if root is not None else os.getcwd())
+    active = list(checkers) if checkers is not None else all_checkers()
+    files = iter_py_files(paths)
+    result = AnalysisResult()
+
+    def _load_and_collect(path: str):
+        mod = load_module(path, root)
+        if mod is None:
+            return path, None, {}
+        facts: dict[str, Any] = {}
+        for ck in active:
+            if not ck.covers(mod.relpath):
+                continue
+            try:
+                facts[ck.code] = ck.collect(mod)
+            except Exception as e:  # noqa: BLE001 — one bad file must not
+                # kill the run; surfaced as a driver error. The file is
+                # OMITTED from this checker's collected set (no dummy []:
+                # checkers returning tuples would crash unpacking it in
+                # report(), silently dropping the whole project's findings
+                # for that checker)
+                result.errors.append(
+                    f"{mod.relpath}: {ck.code} collect failed: "
+                    f"{type(e).__name__}: {e}"
+                )
+        return path, mod, facts
+
+    n_jobs = jobs if jobs and jobs > 0 else min(8, (os.cpu_count() or 2))
+    loaded: list[tuple[ModuleInfo, dict]] = []
+    if n_jobs > 1 and len(files) > 1:
+        with _futures.ThreadPoolExecutor(max_workers=n_jobs) as ex:
+            for _path, mod, facts in ex.map(_load_and_collect, files):
+                if mod is not None:
+                    loaded.append((mod, facts))
+    else:
+        for path in files:
+            _path, mod, facts = _load_and_collect(path)
+            if mod is not None:
+                loaded.append((mod, facts))
+
+    # parse order == path order regardless of executor completion order
+    loaded.sort(key=lambda mf: mf[0].relpath)
+    result.files = [m.relpath for m, _ in loaded]
+
+    for ck in active:
+        per_mod = [
+            (mod, facts[ck.code]) for mod, facts in loaded
+            if ck.code in facts
+        ]
+        result.coverage[ck.code] = [m.relpath for m, _ in per_mod]
+        try:
+            result.violations.extend(ck.report(per_mod))
+        except Exception as e:  # noqa: BLE001 — same containment as collect
+            result.errors.append(
+                f"{ck.code} report failed: {type(e).__name__}: {e}"
+            )
+    result.violations.sort()
+    return result

@@ -1,0 +1,143 @@
+"""Donation-safety checker (DS001).
+
+The historical bug class: ``donate_argnums`` invalidates the donated
+buffer — XLA aliases the output onto it. Touching a donated array after
+the jitted call raises ``RuntimeError: Array has been deleted`` at best,
+or silently reads aliased memory under some backends. PR 2 earned this
+invariant by hand when it made the preemption kernel donate only
+aliasable outputs; DS001 checks every call site of every donated jit in
+the project.
+
+Static approximation: within the calling function, any LOAD of the exact
+name or dotted path that was passed in a donated position, on a line
+after the call, is a violation — unless the path (or its base name) was
+reassigned in between. Statement order is approximated by line number;
+the known limitation (a loop body re-using a donated name on an earlier
+line) is accepted and covered by the runtime tests instead.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import collect_jitted, dotted
+from .core import Checker, ModuleInfo, Violation, register
+
+@register
+class DonationSafety(Checker):
+    code = "DS001"
+    title = "donated argument used after the jitted call"
+    rationale = (
+        "donate_argnums hands the argument's buffer to XLA: the output "
+        "aliases it and the input array is DELETED on completion. Any "
+        "later read of the same array object raises (or, on backends "
+        "without the poisoning check, reads aliased memory). After a "
+        "donating call, the donated names are dead — rebind them from "
+        "the call's result or never touch them again. The resident-block "
+        "scatter (_scatter_node_rows) and the preemption kernel both "
+        "rely on this being enforced at every call site."
+    )
+
+    # covers(): every .py file (the base class default) — the donors map
+    # is project-global, so call sites anywhere (perf harness, client,
+    # apiserver) are checked, matching the documented "every call site"
+    # contract.
+
+    def collect(self, mod: ModuleInfo):
+        jits = {
+            j.name: j.donate for j in collect_jitted(mod.tree) if j.donate
+        }
+        return jits, mod.tree
+
+    def report(self, collected):
+        # global map: function name -> donated positions (name collision
+        # across modules with different donations -> skip as ambiguous)
+        donors: dict[str, tuple[int, ...]] = {}
+        ambiguous: set[str] = set()
+        for _mod, (jits, _tree) in collected:
+            for name, donate in jits.items():
+                if name in donors and donors[name] != donate:
+                    ambiguous.add(name)
+                donors.setdefault(name, donate)
+        for name in ambiguous:
+            donors.pop(name, None)
+        out: list[Violation] = []
+        for mod, (_jits, tree) in collected:
+            out.extend(self._check_module(mod, tree, donors))
+        return out
+
+    def _check_module(self, mod, tree, donors) -> list[Violation]:
+        out: list[Violation] = []
+        for fn in ast.walk(tree):
+            if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            out.extend(self._check_function(mod, fn, donors))
+        return out
+
+    def _check_function(self, mod, fn, donors) -> list[Violation]:
+        out: list[Violation] = []
+        calls: list[tuple[ast.Call, str, list[str]]] = []
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            callee = dotted(node.func)
+            if callee is None:
+                continue
+            name = callee.split(".")[-1]
+            donate = donors.get(name)
+            if donate is None:
+                continue
+            paths = []
+            for pos in donate:
+                if pos < len(node.args):
+                    p = dotted(node.args[pos])
+                    if p is not None:
+                        paths.append(p)
+            if paths:
+                calls.append((node, name, paths))
+        if not calls:
+            return out
+
+        loads: list[tuple[int, str]] = []       # (line, path)
+        stores: list[tuple[int, str]] = []      # (line, path or base)
+        for node in ast.walk(fn):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                p = dotted(node)
+                if p is None:
+                    continue
+                ctx = getattr(node, "ctx", None)
+                if isinstance(ctx, ast.Store):
+                    stores.append((node.lineno, p))
+                elif isinstance(ctx, ast.Load):
+                    loads.append((node.lineno, p))
+
+        for call, name, paths in calls:
+            call_line = getattr(call, "end_lineno", call.lineno)
+            for path in paths:
+                base = path.split(".")[0]
+                # first rebind of the path or its base after the call
+                rebind = min(
+                    (ln for ln, p in stores
+                     if ln >= call.lineno and (p == path or p == base)),
+                    default=None,
+                )
+                hits = sorted(
+                    (ln, p) for ln, p in loads
+                    if ln > call_line
+                    and (p == path or p.startswith(path + "."))
+                    and (rebind is None or ln <= rebind)
+                )
+                # one finding per donated path per call: the first
+                # post-donation read is the bug; the rest are echoes
+                for ln, p in hits[:1]:
+                    out.append(Violation(
+                        path=mod.relpath, line=ln, code=self.code,
+                        symbol=f"{fn.name}:{path}",
+                        message=(
+                            f"`{p}` read after being donated to "
+                            f"{name}() on line {call.lineno} — the "
+                            f"buffer is dead (donate_argnums aliases "
+                            f"the output onto it)"
+                        ),
+                    ))
+        return out

@@ -1,0 +1,105 @@
+"""Jit-purity checker (JP001).
+
+The historical bug class: a host-side side effect inside a ``jax.jit`` or
+``shard_map`` body executes once at TRACE time, then never again — a
+metrics increment inside a kernel counts 1 forever, a ``time.time()``
+freezes at compile, a ``random.random()`` becomes a compile-time constant,
+and a log line silently disappears. PR 2/6 audited the device programs for
+this by hand; JP001 checks it by construction for every device-program
+body in ``ops/``, ``assign/``, ``parallel/`` and ``framework/runtime.py``.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import collect_jitted, dotted, terminal_attr
+from .core import Checker, ModuleInfo, Violation, register
+
+#: module-qualified call prefixes that are host side effects
+_BANNED_PREFIXES = (
+    "time.", "random.", "np.random.", "numpy.random.", "logging.",
+    "klog.",
+)
+#: bare callables that are host side effects
+_BANNED_NAMES = {"print", "open", "input"}
+#: method names that smell like metric emission / host mutation
+_BANNED_METHODS = {"inc", "dec", "observe", "observe_n", "labels"}
+#: explicitly allowed even though they match a banned shape (jax's own
+#: debug machinery is trace-safe by design)
+_ALLOWED = {
+    "jax.debug.print", "jax.debug.callback", "host_callback.call",
+    "jax.experimental.io_callback", "io_callback",
+}
+
+_SCOPES = ("ops/", "assign/", "parallel/", "framework/runtime.py")
+
+
+@register
+class JitPurity(Checker):
+    code = "JP001"
+    title = "host side effect inside a jit/shard_map body"
+    rationale = (
+        "A jax.jit / shard_map body runs as a traced XLA program: Python "
+        "statements in it execute once at trace time and never again. "
+        "Metrics increments, logging, time.*, Python-level randomness, "
+        "print/open — any host side effect inside a device-program body "
+        "either freezes at its trace-time value or silently vanishes on "
+        "later calls. Side effects belong in the host-side caller, before "
+        "dispatch or after the sync; in-kernel debugging goes through "
+        "jax.debug.print/io_callback, which are trace-aware."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        return relpath.endswith(".py") and any(
+            s in relpath for s in _SCOPES
+        )
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        for jit in collect_jitted(mod.tree):
+            body = jit.node
+            if body is None or not isinstance(
+                body, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+            ):
+                continue
+            seen_lines: set[int] = set()
+            for node in ast.walk(body):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = dotted(node.func)
+                bad = self._classify(node, name)
+                if bad is None:
+                    continue
+                line = getattr(node, "lineno", jit.lineno)
+                if line in seen_lines:
+                    continue    # one finding per offending line
+                seen_lines.add(line)
+                out.append(Violation(
+                    path=mod.relpath,
+                    line=line,
+                    code=self.code, symbol=jit.qualname,
+                    message=(
+                        f"{bad} inside jit body {jit.qualname}() — "
+                        f"executes once at trace time, never per call"
+                    ),
+                ))
+        return out
+
+    @staticmethod
+    def _classify(node: ast.Call, name: str | None) -> str | None:
+        if name in _ALLOWED:
+            return None
+        if name is not None:
+            if name in _BANNED_NAMES:
+                return f"call to {name}()"
+            for prefix in _BANNED_PREFIXES:
+                if name.startswith(prefix):
+                    return f"host call {name}()"
+        # method-shaped metric emission: anything .inc()/.observe()/…
+        attr = terminal_attr(node.func) if isinstance(
+            node.func, ast.Attribute
+        ) else None
+        if attr in _BANNED_METHODS:
+            return f"metric emission .{attr}()"
+        return None

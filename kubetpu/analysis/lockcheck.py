@@ -1,0 +1,203 @@
+"""Lock-discipline checkers (LD001–LD003).
+
+The historical bug: PR 5 found the API dispatcher resolving calls from
+worker threads with bare ``self._executed += 1`` / ``self._errors += 1``
+while ``add`` mutated the same stats under ``self._lock`` — a torn
+read-modify-write that undercounted forever. These checkers encode the
+three shapes of that bug so no future subsystem re-introduces it.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import ClassScan, dotted, scan_classes, terminal_attr
+from .core import Checker, ModuleInfo, Violation, register
+
+#: methods exempt from lock-context checks: construction happens before
+#: the object is shared, and the ``_locked`` suffix is the project's
+#: caller-holds-the-lock convention (MemStore._update_locked etc.)
+_EXEMPT = ("__init__", "__post_init__", "__new__")
+
+
+def _exempt(method: str) -> bool:
+    return method in _EXEMPT or method.endswith("_locked")
+
+
+@register
+class LockMixedWrites(Checker):
+    code = "LD001"
+    title = "attribute written both inside and outside the owning lock"
+    rationale = (
+        "A class that owns a threading.Lock/Condition has declared its "
+        "instances shared across threads. An attribute written under "
+        "`with self._lock` in one method and bare in another is exactly "
+        "the PR-5 dispatcher race: the unlocked writer and a locked "
+        "read-modify-writer interleave, and one update is lost. Every "
+        "write to a lock-guarded attribute must hold the lock (methods "
+        "named *_locked are exempt — the caller holds it by contract, "
+        "as are __init__/__post_init__, which run before sharing)."
+    )
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        for cs in scan_classes(mod.tree):
+            if not cs.lock_attrs:
+                continue
+            for attr, sites in cs.writes.items():
+                if attr in cs.lock_attrs:
+                    continue
+                locked = [s for s in sites if s[2] and not _exempt(s[1])]
+                unlocked = [
+                    s for s in sites if not s[2] and not _exempt(s[1])
+                ]
+                if locked and unlocked:
+                    lock_names = ",".join(sorted(cs.lock_attrs))
+                    for lineno, method, _l, _aug in unlocked:
+                        out.append(Violation(
+                            path=mod.relpath, line=lineno, code=self.code,
+                            symbol=f"{cs.name}.{attr}",
+                            message=(
+                                f"{cs.name}.{attr} is written under "
+                                f"`with self.{lock_names}` elsewhere but "
+                                f"bare in {method}() — torn-write race "
+                                f"(the PR-5 dispatcher shape)"
+                            ),
+                        ))
+        return out
+
+
+@register
+class LockUnlockedRmw(Checker):
+    code = "LD002"
+    title = "unlocked read-modify-write in a lock-owning class"
+    rationale = (
+        "`self.x += 1` compiles to LOAD / ADD / STORE — three interleaving "
+        "points. In a class that owns a lock (i.e. has declared itself "
+        "concurrent), an augmented assignment outside every `with "
+        "self.<lock>` block tears under contention even when no other "
+        "method writes the attribute under the lock: two bare increments "
+        "from two threads lose one update. Counters in concurrent classes "
+        "increment under the lock, full stop."
+    )
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        for cs in scan_classes(mod.tree):
+            if not cs.lock_attrs:
+                continue
+            for attr, sites in cs.writes.items():
+                if attr in cs.lock_attrs:
+                    continue
+                has_locked = any(s[2] for s in sites)
+                for lineno, method, locked, aug in sites:
+                    if not aug or locked or _exempt(method):
+                        continue
+                    if has_locked:
+                        continue    # LD001 already carries this site
+                    out.append(Violation(
+                        path=mod.relpath, line=lineno, code=self.code,
+                        symbol=f"{cs.name}.{attr}",
+                        message=(
+                            f"read-modify-write of {cs.name}.{attr} in "
+                            f"{method}() without holding any of the "
+                            f"class's locks "
+                            f"({', '.join(sorted(cs.lock_attrs))})"
+                        ),
+                    ))
+        return out
+
+
+@register
+class CrossModuleCounterMutation(Checker):
+    code = "LD003"
+    title = "foreign-module read-modify-write of another class's counter"
+    rationale = (
+        "A counter mutated with `obj.count += 1` from a module that does "
+        "not define obj's class has no single place to add a lock, no "
+        "single owner to audit, and no way for the owning class to "
+        "guarantee its own thread contract — the informer pump bumping "
+        "Reflector.relists from client/informers.py was this shape. "
+        "Shared counters are mutated only through a method of the owning "
+        "class (which can then serialize however it likes); fires when "
+        "every project class that initializes the attribute to a numeric "
+        "literal lives in a different module than the mutation site."
+    )
+
+    def collect(self, mod: ModuleInfo):
+        # facts: (a) counter attrs each class owns, (b) foreign RMW sites
+        owners: dict[str, set[str]] = {}    # attr -> {module relpaths}
+        for cs in scan_classes(mod.tree):
+            for attr in cs.counter_attrs:
+                owners.setdefault(attr, set()).add(mod.relpath)
+        sites: list[tuple[int, str, str]] = []   # (line, attr, target-repr)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.AugAssign):
+                continue
+            tgt = node.target
+            if not isinstance(tgt, ast.Attribute):
+                continue
+            base = tgt.value
+            if isinstance(base, ast.Name) and base.id == "self":
+                continue                     # owner-side RMW: LD001/LD002
+            rendered = dotted(tgt) or f"<expr>.{tgt.attr}"
+            # locally-constructed objects are not shared state:
+            # `out = Histogram(...); out.total += n` is plain code
+            sites.append((node.lineno, tgt.attr, rendered))
+        return owners, sites
+
+    def report(self, collected):
+        owners: dict[str, set[str]] = {}
+        for _mod, (mod_owners, _sites) in collected:
+            for attr, paths in mod_owners.items():
+                owners.setdefault(attr, set()).update(paths)
+        out: list[Violation] = []
+        for mod, (_own, sites) in collected:
+            local_ctor_names = _locally_constructed_names(mod)
+            for lineno, attr, rendered in sites:
+                own = owners.get(attr)
+                if not own:
+                    continue                 # not a counter anywhere
+                if mod.relpath in own:
+                    continue                 # an owner lives here: in-module
+                base_name = rendered.split(".")[0]
+                if (base_name, lineno) in local_ctor_names:
+                    continue
+                out.append(Violation(
+                    path=mod.relpath, line=lineno, code=self.code,
+                    symbol=rendered,
+                    message=(
+                        f"`{rendered} += …` mutates a counter owned by "
+                        f"{' / '.join(sorted(own))} from a foreign module "
+                        f"— route it through a method of the owning class"
+                    ),
+                ))
+        return out
+
+
+def _locally_constructed_names(mod: ModuleInfo) -> set:
+    """(name, use-line) pairs where ``name`` was bound from a constructor
+    call in the same function scope before the use — those objects are
+    function-local, not shared state. Approximation: any name assigned
+    from a Call anywhere in the enclosing function, looked up per
+    function body."""
+    pairs: set = set()
+    for fn in ast.walk(mod.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        ctor_bound: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                node.value, ast.Call
+            ):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        ctor_bound.add(tgt.id)
+        for node in ast.walk(fn):
+            if isinstance(node, ast.AugAssign) and isinstance(
+                node.target, ast.Attribute
+            ):
+                base = node.target.value
+                if isinstance(base, ast.Name) and base.id in ctor_bound:
+                    pairs.add((base.id, node.lineno))
+    return pairs

@@ -1,0 +1,196 @@
+"""Hot-path transfer checkers (HT001, HT002).
+
+The historical work: PR 2 made the node block device-resident with
+dirty-row delta uploads, PR 3 collapsed ~30 per-cycle ``device_put``
+dispatches into one batched placement, PR 6 routed per-shard uploads.
+Those wins evaporate the moment someone adds a stray ``jax.device_put``
+(or a host fetch of a device array) on the cycle path — so host↔device
+traffic is only allowed at the blessed encode/finalize/upload seams.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .astutil import collect_jitted, dotted
+from .core import Checker, ModuleInfo, Violation, register
+
+#: the blessed seams: relpath-suffix -> function names allowed to ship
+#: bytes. Everything else in the scanned scope is hot-path by default.
+BLESSED_SEAMS: dict[str, set[str]] = {
+    "framework/runtime.py": {
+        # resident-block upload path (PR 2/6)
+        "_full_upload", "_reshard_rows", "_scatter_single",
+        "_scatter_routed", "refresh",
+        # encode/finalize seam: the ONE batched device_put per cycle
+        "encode_batch", "finalize_batch",
+    },
+    "parallel/mesh.py": {
+        # the whole-batch sharded placement and the one-shot probes
+        "shard_batch", "pod_scan_collective_ok",
+        "measure_collective_wall",
+    },
+}
+
+#: scope the checker walks (device traffic elsewhere — tests, perf
+#: harness, CLI — is not cycle-path and not checked)
+_SCOPES = (
+    "state/", "framework/runtime.py", "ops/", "assign/", "parallel/",
+    "sched/",
+)
+
+_FETCHERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
+             "jax.device_get", "device_get"}
+
+
+def _enclosing_functions(tree: ast.AST) -> "list[tuple[ast.AST, str]]":
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            out.append((node, node.name))
+    return out
+
+
+@register
+class HotPathDevicePut(Checker):
+    code = "HT001"
+    title = "jax.device_put outside the blessed transfer seams"
+    rationale = (
+        "Host→device bytes are budgeted: the encode seam ships ONE "
+        "batched device_put per cycle, the resident-block refresh ships "
+        "delta rows, and nothing else transfers on the cycle path (the "
+        "PR-2/3/6 wins the perf gates measure). A device_put anywhere "
+        "else in state/, ops/, assign/, parallel/, sched/ or "
+        "framework/runtime.py re-introduces a per-call sync + copy the "
+        "transfer counters never see. New seams are added to "
+        "analysis.transfer.BLESSED_SEAMS deliberately, with review."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        return relpath.endswith(".py") and any(
+            s in relpath for s in _SCOPES
+        )
+
+    def blessed(self, relpath: str) -> set[str]:
+        for suffix, fns in BLESSED_SEAMS.items():
+            if relpath.endswith(suffix):
+                return fns
+        return set()
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        allowed = self.blessed(mod.relpath)
+        # map: lineno ranges of allowed functions
+        spans = []
+        for fn, name in _enclosing_functions(mod.tree):
+            if name in allowed:
+                spans.append((
+                    fn.lineno, getattr(fn, "end_lineno", fn.lineno), name
+                ))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = dotted(node.func)
+            if name is None or not name.endswith("device_put"):
+                continue
+            line = node.lineno
+            if any(lo <= line <= hi for lo, hi, _n in spans):
+                continue
+            out.append(Violation(
+                path=mod.relpath, line=line, code=self.code,
+                symbol=name,
+                message=(
+                    "jax.device_put outside the blessed transfer seams "
+                    "(see analysis.transfer.BLESSED_SEAMS) — hot-path "
+                    "host→device traffic must ride the encode/refresh "
+                    "seam"
+                ),
+            ))
+        return out
+
+
+@register
+class HotPathDeviceFetch(Checker):
+    code = "HT002"
+    title = "host fetch of a jit result outside the blessed seams"
+    rationale = (
+        "np.asarray / jax.device_get on a device array blocks the host "
+        "on the device stream and copies — a hidden sync point. On the "
+        "cycle path the only blessed fetch is the engine-result readback "
+        "after the kernel wall is measured. Fires when a value produced "
+        "by a jit-wrapped call is fetched in the same function outside a "
+        "blessed seam (taint is per-function: assigned-from-jitted-call "
+        "names)."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        return relpath.endswith(".py") and any(
+            s in relpath for s in ("state/", "framework/runtime.py")
+        )
+
+    def collect(self, mod: ModuleInfo):
+        return mod.tree
+
+    def report(self, collected):
+        jitted_names: set[str] = set()
+        for _mod, tree in collected:
+            for j in collect_jitted(tree):
+                jitted_names.add(j.name)
+        out: list[Violation] = []
+        for mod, tree in collected:
+            allowed = BLESSED_SEAMS.get(
+                next(
+                    (s for s in BLESSED_SEAMS if mod.relpath.endswith(s)),
+                    "",
+                ),
+                set(),
+            )
+            for fn, name in _enclosing_functions(tree):
+                if name in allowed:
+                    continue
+                tainted: set[str] = set()
+                for node in ast.walk(fn):
+                    if isinstance(node, ast.Assign) and isinstance(
+                        node.value, ast.Call
+                    ):
+                        callee = dotted(node.value.func)
+                        if callee and callee.split(".")[-1] in jitted_names:
+                            for tgt in node.targets:
+                                t = dotted(tgt)
+                                if t:
+                                    tainted.add(t)
+                                if isinstance(tgt, ast.Tuple):
+                                    for elt in tgt.elts:
+                                        t = dotted(elt)
+                                        if t:
+                                            tainted.add(t)
+                for node in ast.walk(fn):
+                    if not isinstance(node, ast.Call):
+                        continue
+                    callee = dotted(node.func)
+                    if callee not in _FETCHERS or not node.args:
+                        continue
+                    arg = dotted(node.args[0])
+                    inner = node.args[0]
+                    if arg is None and isinstance(inner, ast.Call):
+                        # np.asarray(jitted_fn(...)) directly
+                        icallee = dotted(inner.func)
+                        if icallee and (
+                            icallee.split(".")[-1] in jitted_names
+                        ):
+                            arg = icallee
+                    if arg is None or (
+                        arg not in tainted
+                        and arg.split(".")[-1] not in jitted_names
+                    ):
+                        continue
+                    out.append(Violation(
+                        path=mod.relpath, line=node.lineno, code=self.code,
+                        symbol=f"{name}:{arg}",
+                        message=(
+                            f"host fetch {callee}({arg}) of a jit "
+                            f"result outside the blessed seams — a "
+                            f"hidden device sync on the cycle path"
+                        ),
+                    ))
+        return out

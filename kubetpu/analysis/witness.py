@@ -1,0 +1,376 @@
+"""Runtime lock-order witness — the dynamic half of the lock-discipline
+story.
+
+Static checkers prove writes hold the right lock; they cannot prove two
+locks are always taken in the same ORDER. This witness can: every wrapped
+lock records, per thread, the set of locks already held when it is
+acquired, building a global directed lock-order graph (edge A→B = "B was
+acquired while holding A"). A cycle in that graph is a potential deadlock
+— thread 1 holds A wanting B while thread 2 holds B wanting A — even if
+the unlucky interleaving never happened in this run. That is the classic
+lock-order-witness design (FreeBSD WITNESS, Go's lockrank): it turns a
+probabilistic deadlock into a deterministic test failure.
+
+Two ways in:
+
+- ``installed()``: a context manager that monkeypatches
+  ``threading.Lock`` / ``threading.RLock`` so every lock CREATED inside
+  the scope by kubetpu code (creation-site filtered) is witnessed.
+  ``threading.Condition()`` is covered transitively — its default lock
+  comes from the patched ``RLock``, and Condition drives foreign locks
+  through its documented acquire/release fallbacks. The tier-1
+  concurrency tests enable this via an autouse conftest fixture.
+- ``wrap(lock, name)``: explicit wrapping for targeted tests.
+
+On a cycle the acquiring thread raises ``LockOrderError`` AND the event
+is recorded on the state (worker threads whose exception would otherwise
+vanish are caught by the conftest ``threading.excepthook`` hook — the
+owning test fails either way).
+"""
+
+from __future__ import annotations
+
+import threading
+import _thread
+from dataclasses import dataclass, field
+
+
+class LockOrderError(RuntimeError):
+    """A lock acquisition would create a cycle in the global lock-order
+    graph — a potential deadlock."""
+
+
+@dataclass
+class _Edge:
+    src: str
+    dst: str
+    thread: str
+
+
+class WitnessState:
+    """The global lock-order graph + per-thread held stacks."""
+
+    def __init__(self) -> None:
+        # raw lock: witness bookkeeping must never itself be witnessed
+        self._mu = _thread.allocate_lock()
+        self._held = threading.local()          # .stack: list[_Witnessed]
+        self.edges: dict[tuple[int, int], _Edge] = {}
+        self.names: dict[int, str] = {}
+        self.violations: list[str] = []
+        self.locks_created = 0
+        # installed() retires its state on exit: wrapped locks that
+        # OUTLIVE the scope (a module-level lock first imported during a
+        # witnessed test) become plain pass-throughs instead of recording
+        # edges into — or raising from — a state nothing checks anymore
+        self.active = True
+
+    # ---------------------------------------------------------- per-thread
+    def _stack(self) -> list:
+        st = getattr(self._held, "stack", None)
+        if st is None:
+            st = []
+            self._held.stack = st
+        return st
+
+    # ------------------------------------------------------------- events
+    def note_acquire(self, lock: "_Witnessed") -> None:
+        st = self._stack()
+        if any(h is lock for h in st):
+            if not lock.reentrant:
+                # the simplest deadlock: re-acquiring a plain Lock the
+                # thread already holds would block forever — fail NOW
+                # instead of wedging the suite
+                msg = (
+                    f"self-deadlock: thread "
+                    f"{threading.current_thread().name!r} re-acquires "
+                    f"non-reentrant lock {lock.name} it already holds"
+                )
+                with self._mu:
+                    self.violations.append(msg)
+                raise LockOrderError(msg)
+            st.append(lock)                     # re-entrant: no new edges
+            return
+        new_edges = []
+        with self._mu:
+            self.names.setdefault(id(lock), lock.name)
+            for held in st:
+                if held is lock:
+                    continue
+                key = (id(held), id(lock))
+                if key not in self.edges:
+                    self.edges[key] = _Edge(
+                        held.name, lock.name,
+                        threading.current_thread().name,
+                    )
+                    new_edges.append(key)
+            cycle = self._find_cycle(id(lock)) if new_edges else None
+            if cycle is not None:
+                msg = (
+                    "lock-order cycle: "
+                    + " -> ".join(self.names.get(i, "?") for i in cycle)
+                    + f" (closed by thread "
+                    f"{threading.current_thread().name!r})"
+                )
+                self.violations.append(msg)
+                # drop the closing edges so one inversion reports once,
+                # not on every later acquisition
+                for key in new_edges:
+                    self.edges.pop(key, None)
+                raise LockOrderError(msg)
+        st.append(lock)
+
+    def note_release(self, lock: "_Witnessed") -> None:
+        st = self._stack()
+        for i in range(len(st) - 1, -1, -1):
+            if st[i] is lock:
+                del st[i]
+                return
+
+    def note_release_all(self, lock: "_Witnessed") -> int:
+        """Drop every recursion level of ``lock`` from this thread's
+        stack (Condition.wait releases the full RLock count at once);
+        returns how many entries were dropped so the matching restore
+        can re-establish the same depth."""
+        st = self._stack()
+        n = sum(1 for h in st if h is lock)
+        st[:] = [h for h in st if h is not lock]
+        return n
+
+    def _find_cycle(self, start: int) -> "list[int] | None":
+        """DFS from ``start`` over the edge graph; returns the node chain
+        of the first cycle through ``start``. Caller holds ``_mu``."""
+        adj: dict[int, list[int]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+        path: list[int] = [start]
+        seen: set[int] = set()
+
+        def dfs(node: int) -> "list[int] | None":
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    return path + [start]
+                if nxt in seen:
+                    continue
+                seen.add(nxt)
+                path.append(nxt)
+                found = dfs(nxt)
+                if found is not None:
+                    return found
+                path.pop()
+            return None
+
+        return dfs(start)
+
+    # ---------------------------------------------------------- reporting
+    def edge_list(self) -> list[tuple[str, str]]:
+        with self._mu:
+            return [(e.src, e.dst) for e in self.edges.values()]
+
+
+class _Witnessed:
+    """Proxy around one lock primitive. Supports the Lock/RLock protocol
+    plus Condition's documented foreign-lock fallbacks (Condition calls
+    plain acquire()/release() when the lock lacks _release_save etc. —
+    which keeps the held-stack honest across a wait())."""
+
+    def __init__(
+        self, inner, name: str, state: WitnessState,
+        reentrant: bool = False,
+    ) -> None:
+        self._inner = inner
+        self.name = name
+        self._state = state
+        self.reentrant = reentrant
+
+    def acquire(self, blocking: bool = True, timeout: float = -1):
+        if not self._state.active:
+            return self._inner.acquire(blocking, timeout)
+        # order check BEFORE blocking: the whole point is to fail instead
+        # of deadlocking. Non-blocking probes (Condition._is_owned uses
+        # acquire(0)) skip the graph — they cannot deadlock.
+        if blocking:
+            self._state.note_acquire(self)
+        try:
+            got = self._inner.acquire(blocking, timeout)
+        except BaseException:
+            if blocking:
+                self._state.note_release(self)
+            raise
+        if blocking and not got:
+            self._state.note_release(self)
+        if not blocking and got:
+            try:
+                self._state.note_acquire(self)
+            except LockOrderError:
+                self._inner.release()
+                raise
+        return got
+
+    def release(self) -> None:
+        self._inner.release()
+        if self._state.active:
+            self._state.note_release(self)
+
+    def locked(self) -> bool:
+        try:
+            return self._inner.locked()
+        except AttributeError:      # RLock has no locked() pre-3.12
+            if self._inner.acquire(False):
+                self._inner.release()
+                return False
+            return True
+
+    def __enter__(self):
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    # Condition's private lock protocol: threading.Condition binds these
+    # at construction when the lock exposes them. The acquire(0)-probe
+    # fallback it would otherwise use misreports ownership for re-entrant
+    # locks (the probe SUCCEEDS for the owner of an RLock), so delegate
+    # to the real primitive and keep the held-stack honest around waits.
+    def _is_owned(self):
+        inner = getattr(self._inner, "_is_owned", None)
+        if inner is not None:
+            return inner()
+        if self._inner.acquire(False):
+            self._inner.release()
+            return False
+        return True
+
+    def _release_save(self):
+        # wait() releases ALL recursion levels: drop every stack entry
+        # and remember HOW MANY, so restore re-establishes the same
+        # depth (an RLock held at depth 2 across a wait must come back
+        # as 2 stack entries, or the witness believes the lock is free
+        # after the first post-wait release)
+        dropped = (
+            self._state.note_release_all(self) if self._state.active
+            else 0
+        )
+        inner = getattr(self._inner, "_release_save", None)
+        token = inner() if inner is not None else self._inner.release()
+        return (dropped, token)
+
+    def _acquire_restore(self, saved):
+        dropped, token = saved
+        if self._state.active:
+            self._state.note_acquire(self)
+            st = self._state._stack()
+            for _ in range(max(dropped - 1, 0)):
+                st.append(self)
+        inner = getattr(self._inner, "_acquire_restore", None)
+        if inner is not None:
+            return inner(token)
+        return self._inner.acquire()
+
+    def __getattr__(self, name: str):
+        # anything beyond the lock protocol (e.g. _at_fork_reinit handed
+        # to os.register_at_fork) passes straight through to the real
+        # primitive — the proxy must never be the reason foreign code
+        # breaks
+        return getattr(self._inner, name)
+
+    def __repr__(self) -> str:
+        return f"<witnessed {self.name} {self._inner!r}>"
+
+
+#: stdlib wrapper modules the creation-site walk may look THROUGH: these
+#: construct locks on behalf of their caller (Condition builds its RLock
+#: inside threading.py, Queue builds its mutex inside queue.py). Any
+#: other intermediate frame means the lock belongs to that code — e.g. a
+#: module-level stdlib lock created because a kubetpu import triggered
+#: the module load (concurrent.futures.thread's _global_shutdown_lock,
+#: which is later handed to os.register_at_fork) — and wrapping it would
+#: hand foreign code a proxy it never asked for.
+_PASS_THROUGH = ("/threading.py", "/queue.py")
+
+
+def _creation_site(depth_limit: int = 12) -> "tuple[str, str] | None":
+    """(relpath-ish, qualifier) of the frame that owns the new lock:
+    walk up from the factory, looking through the known stdlib wrapper
+    frames only; wrap iff the first real frame is kubetpu code."""
+    import sys
+
+    f = sys._getframe(2)
+    for _ in range(depth_limit):
+        if f is None:
+            return None
+        fname = f.f_code.co_filename.replace("\\", "/")
+        if "/kubetpu/" in fname and "/analysis/" not in fname:
+            tail = fname.split("/kubetpu/", 1)[1]
+            owner = f.f_locals.get("self")
+            qual = (
+                type(owner).__name__ if owner is not None
+                else f.f_code.co_name
+            )
+            return f"kubetpu/{tail}", f"{qual}:{f.f_lineno}"
+        if not fname.endswith(_PASS_THROUGH):
+            return None         # some other module's lock: not ours
+        f = f.f_back
+    return None
+
+
+class _Installer:
+    def __init__(self, state: WitnessState, all_locks: bool) -> None:
+        self.state = state
+        self.all_locks = all_locks
+        self._orig_lock = None
+        self._orig_rlock = None
+
+    def _wrapping_factory(self, orig, kind: str):
+        state = self.state
+        all_locks = self.all_locks
+
+        def factory():
+            inner = orig()
+            site = _creation_site()
+            if site is None and not all_locks:
+                return inner            # stdlib-internal lock: leave it
+            where = site or ("<external>", kind)
+            state.locks_created += 1
+            return _Witnessed(
+                inner, f"{where[0]}::{where[1]}({kind})", state,
+                reentrant=(kind == "RLock"),
+            )
+
+        return factory
+
+    def __enter__(self) -> WitnessState:
+        self._orig_lock = threading.Lock
+        self._orig_rlock = threading.RLock
+        threading.Lock = self._wrapping_factory(self._orig_lock, "Lock")
+        threading.RLock = self._wrapping_factory(self._orig_rlock, "RLock")
+        return self.state
+
+    def __exit__(self, *exc) -> bool:
+        threading.Lock = self._orig_lock
+        threading.RLock = self._orig_rlock
+        # retire the state: wrapped locks that outlive this scope
+        # degrade to plain pass-throughs (no edges into a dead graph,
+        # no LockOrderError raised inside unrelated later tests)
+        self.state.active = False
+        return False
+
+
+def installed(all_locks: bool = False) -> _Installer:
+    """Context manager: witness every lock created by kubetpu code inside
+    the scope. ``all_locks=True`` drops the creation-site filter (wraps
+    stdlib-internal locks too — noisier, for targeted tests only)."""
+    return _Installer(WitnessState(), all_locks)
+
+
+def wrap(
+    lock, name: str, state: WitnessState, reentrant: bool | None = None,
+) -> _Witnessed:
+    """Explicitly wrap one existing lock object on ``state``.
+    ``reentrant`` defaults to sniffing the primitive's type name (RLock
+    re-acquisition by the holder is legal; plain Lock re-acquisition is a
+    self-deadlock the witness fails immediately)."""
+    if reentrant is None:
+        reentrant = "RLock" in type(lock).__name__
+    return _Witnessed(lock, name, state, reentrant=reentrant)

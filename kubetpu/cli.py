@@ -658,10 +658,25 @@ def build_parser() -> argparse.ArgumentParser:
     )
     perf.add_argument("rest", nargs=argparse.REMAINDER)
     perf.set_defaults(fn=None)
+
+    analyze = sub.add_parser(
+        "analyze",
+        help="graftcheck static-analysis suite "
+             "(see python -m kubetpu.analysis)",
+    )
+    analyze.add_argument("rest", nargs=argparse.REMAINDER)
+    analyze.set_defaults(fn=None)
     return p
 
 
 def main(argv: Sequence[str] | None = None) -> int:
+    raw = list(argv) if argv is not None else sys.argv[1:]
+    if raw and raw[0] == "analyze":
+        # dispatch before argparse: REMAINDER drops leading flags
+        # (`kubetpu analyze --list-checkers` must reach the sub-CLI intact)
+        from .analysis.__main__ import main as analyze_main
+
+        return analyze_main(raw[1:]) or 0
     args = build_parser().parse_args(argv)
     if args.command == "perf":
         from .perf.__main__ import main as perf_main

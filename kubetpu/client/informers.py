@@ -281,7 +281,7 @@ class SchedulerInformers:
                 continue
             if isinstance(res, CompactedError):
                 # only this kind relists (reflector.go's too-old handling)
-                r.relists += 1
+                r.note_relist()
                 r.sync()
                 total += len(r.informer.store)
                 continue

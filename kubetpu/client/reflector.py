@@ -145,6 +145,8 @@ class Reflector:
         label_selector: str = "", field_selector: str = "",
         stream: bool = False,
     ) -> None:
+        import threading
+
         self._store = store
         self.informer = informer
         self._label_selector = label_selector
@@ -152,6 +154,17 @@ class Reflector:
         self._stream = stream
         self._watcher = None
         self.relists = 0    # metrics: compaction-forced relists
+        # guards the stats counters: the pump thread increments while a
+        # diagnostics scrape reads; note_relist is the ONLY mutation
+        # point (the bulk pump used to bump relists from informers.py —
+        # the analysis suite's LD003 shape)
+        self._stats_lock = threading.Lock()
+
+    def note_relist(self) -> None:
+        """Record one compaction-forced relist (owning-class seam for the
+        ``relists`` counter — callers never mutate it directly)."""
+        with self._stats_lock:
+            self.relists += 1
 
     def _store_supports_stream(self) -> bool:
         """Explicit capability detection for the streaming watch — an
@@ -202,7 +215,7 @@ class Reflector:
             events = self._watcher.poll()
         except CompactedError:
             # reflector.go: watch too old → full relist
-            self.relists += 1
+            self.note_relist()
             self.sync()
             return len(self.informer.store)
         except ConnectionError:

@@ -366,7 +366,7 @@ def _coalesced_group_cycle(
     scheduled = unschedulable = 0
     for e, infos, (start, end) in zip(entries, groups_infos, spans):
         rows = idx[start:end]
-        sched.metrics.schedule_attempts += len(infos)
+        sched.metrics.note_attempts(len(infos))
         fitted = int((rows >= 0).sum())
         # PlacementFeasible (gang): scheduled members + this attempt's fits
         if fitted + len(e.scheduled) >= e.min_count():
@@ -432,7 +432,7 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
     )
     counts = np.asarray(jax.device_get(counts))
     assignments = np.asarray(jax.device_get(assignments))
-    sched.metrics.schedule_attempts += len(infos)
+    sched.metrics.note_attempts(len(infos))
 
     need = e.min_count() - len(e.scheduled)
     feasible = counts >= need
@@ -480,5 +480,5 @@ def _bind_member(
         sched.metrics.prom.pod_scheduling_attempts.observe(info.attempts)
     if not sched._begin_binding(info, assumed):
         return False
-    sched.metrics.scheduled += 1
+    sched.metrics.note_scheduled()
     return True

@@ -68,7 +68,7 @@ class DefaultPreemptionPostFilter:
         i = index_of.get(info.key)
         if i is None:
             return None
-        sched.metrics.preemption_attempts += 1
+        sched.metrics.note_preemption_attempt()
         sched.metrics.prom.preemption_attempts.inc()
 
         if self._ctx_token is not ctx:
@@ -101,7 +101,7 @@ class DefaultPreemptionPostFilter:
             info.nominated_node_name = None
             return None
 
-        sched.metrics.preemption_victims += len(result.victim_pods)
+        sched.metrics.note_preemption_victims(len(result.victim_pods))
         sched.metrics.prom.preemption_victims.observe(len(result.victim_pods))
         sched._preempting[info.key] = set(result.victim_uids)
         sched.nominator.add(info.pod, result.node_name)

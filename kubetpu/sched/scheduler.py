@@ -120,6 +120,27 @@ class SchedulerMetrics:
             # and device counters together, joined per cycle by cycle id
             self.tpu = TPUBackendMetrics(registry=self.prom.registry)
 
+    # The plain counters are mutated ONLY through these methods (analysis
+    # LD003: a counter bumped from a foreign module has no single place to
+    # audit or serialize; attempt_latencies is a deque — appends are
+    # atomic and not RMW, so it stays a plain field). Callers all run on
+    # the scheduler loop thread — the Scheduler's single-owner contract —
+    # so the bodies stay bare adds.
+    def note_attempts(self, n: int = 1) -> None:
+        self.schedule_attempts += n
+
+    def note_scheduled(self, n: int = 1) -> None:
+        self.scheduled += n
+
+    def note_unschedulable(self, n: int = 1) -> None:
+        self.unschedulable += n
+
+    def note_preemption_attempt(self) -> None:
+        self.preemption_attempts += 1
+
+    def note_preemption_victims(self, n: int) -> None:
+        self.preemption_victims += n
+
 
 class Scheduler:
     """See module docstring. Single-owner object: informer callbacks and the
@@ -832,7 +853,7 @@ class Scheduler:
             from .podgroup import schedule_pod_groups
 
             res = schedule_pod_groups(self, budget=limit)
-            self.metrics.unschedulable += res["unschedulable"]
+            self.metrics.note_unschedulable(res["unschedulable"])
             return res
         if self.pipeline:
             return self._schedule_batch_pipelined(batch_infos, limit)
@@ -1260,15 +1281,15 @@ class Scheduler:
         failed: list[QueuedPodInfo] = []
         for k, info in enumerate(batch_infos):
             j = int(idx[k])
-            self.metrics.schedule_attempts += 1
+            self.metrics.note_attempts()
             if 0 <= j < len(batch.node_names):
                 if self._assume_and_bind(info, batch.node_names[j]):
                     scheduled += 1
                 # a Reserve/Permit rejection already requeued the pod
             else:
                 failed.append(info)
-        self.metrics.scheduled += scheduled
-        self.metrics.unschedulable += len(failed)
+        self.metrics.note_scheduled(scheduled)
+        self.metrics.note_unschedulable(len(failed))
         # active cycle time = launch half + finish half: in pipeline mode
         # the two halves run in different loop ticks, and the idle gap
         # between them must not inflate the duration histograms
@@ -1426,7 +1447,7 @@ class Scheduler:
         """A Reserve/Permit rejection (or permit timeout): forget the assume
         and requeue — handleSchedulingFailure for the binding-path statuses."""
         self.cache.forget_pod(assumed)
-        self.metrics.unschedulable += 1
+        self.metrics.note_unschedulable()
         if self._gang_member(info.pod):
             self.podgroups.unmark_scheduled(info.pod)
             self.podgroups.requeue_member(info)

@@ -1,0 +1,20 @@
+"""Known-bad half of the LD003 pair: a foreign module bumping the
+counter directly instead of going through the owner's method."""
+
+
+def pump_all(reflectors) -> None:
+    for r in reflectors:
+        r.relists += 1  # expect: LD003
+
+
+def pump_all_well(reflectors) -> None:
+    for r in reflectors:
+        r.note_relist()
+
+
+def local_is_fine():
+    from .owner import PumpStats
+
+    s = PumpStats()
+    s.relists += 0   # locally constructed: not shared state
+    return s

@@ -1,0 +1,9 @@
+"""Known-good half of the LD003 pair: the class that owns the counter."""
+
+
+class PumpStats:
+    def __init__(self) -> None:
+        self.relists = 0
+
+    def note_relist(self) -> None:
+        self.relists += 1

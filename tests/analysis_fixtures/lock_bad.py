@@ -1,0 +1,40 @@
+"""Known-bad: lock-discipline violations (LD001, LD002).
+
+Each offending line carries an expect-marker comment naming its code;
+the fixture test asserts the suite reports exactly the marked set.
+"""
+
+import threading
+
+
+class TornDispatcher:
+    """The PR-5 dispatcher race shape: stats written under the lock in one
+    method, bare in another."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._executed = 0
+
+    def add(self) -> None:
+        with self._lock:
+            self._executed += 1
+
+    def finish_badly(self) -> None:
+        self._executed += 1  # expect: LD001
+
+
+class UnlockedCounter:
+    """Owns a lock (a concurrency claim) but bumps a counter bare —
+    the read-modify-write tears even with no locked writer elsewhere."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.entries = {}
+
+    def record(self) -> None:
+        self.hits += 1  # expect: LD002
+
+    def insert(self, key, value) -> None:
+        with self._lock:
+            self.entries[key] = value

@@ -1,0 +1,37 @@
+"""Known-good: the same shapes done right — every stat mutation holds the
+lock, __init__ and *_locked methods are exempt, and a lock-less class may
+mutate its own attributes freely (it made no concurrency claim)."""
+
+import threading
+
+
+class CleanDispatcher:
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._executed = 0
+        self._errors = 0
+        self.closed = False          # init writes are pre-sharing
+
+    def finish(self, err) -> None:
+        with self._lock:
+            self._executed += 1
+            if err is not None:
+                self._errors += 1
+
+    def _bump_locked(self) -> None:
+        # caller-holds-the-lock convention: exempt by name
+        self._executed += 1
+
+    def reconfigure(self) -> None:
+        with self._lock:
+            self.closed = True
+
+
+class PlainCounterBox:
+    """No lock, no concurrency claim — bare counters are fine."""
+
+    def __init__(self) -> None:
+        self.count = 0
+
+    def bump(self) -> None:
+        self.count += 1

@@ -1,0 +1,31 @@
+"""Known-bad: metrics-registry inconsistencies (MR001, MR002, MR003)."""
+
+
+class ServerMetrics:
+    def __init__(self, r) -> None:
+        self.request_total = r.counter(
+            "demo_request_total", "requests", labels=("verb", "code")
+        )
+        self.request_duration = r.histogram(
+            "demo_request_duration_seconds",
+            "request latency",
+            labels=("verb", "code"),
+        )
+        self.inflight = r.gauge(
+            "demo_inflight", "in-flight requests", labels=("kind",)
+        )
+
+    def track(self, verb: str, code: int, wall_s: float) -> None:
+        self.request_total.labels(verb, str(code)).inc()
+        self.request_duration.labels(verb).observe(wall_s)  # expect: MR002
+        self.inflight.inc()  # expect: MR003
+
+
+class OtherMetrics:
+    def __init__(self, r) -> None:
+        # same metric name as ServerMetrics', different label set
+        self.other_total = r.counter(
+            "demo_request_total",  # expect: MR001
+            "requests",
+            labels=("verb",),
+        )

@@ -1,0 +1,16 @@
+"""Known-good: one registration per name, arity-correct .labels(),
+bare emission only on label-less metrics."""
+
+
+class CleanMetrics:
+    def __init__(self, r) -> None:
+        self.attempt_total = r.counter(
+            "demo_attempt_total", "attempts", labels=("result", "profile")
+        )
+        self.cycle_wall = r.histogram(
+            "demo_cycle_wall_seconds", "cycle wall time"
+        )
+
+    def track(self, result: str, profile: str, wall_s: float) -> None:
+        self.attempt_total.labels(result, profile).inc()
+        self.cycle_wall.observe(wall_s)

@@ -1,0 +1,27 @@
+"""Known-bad: host side effects inside jit/shard_map bodies (JP001)."""
+
+import logging
+import random
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def noisy_kernel(x):
+    t0 = time.perf_counter()  # expect: JP001
+    logging.info("scoring %s nodes", x.shape)  # expect: JP001
+    print("tracing!")  # expect: JP001
+    jitter = random.random()  # expect: JP001
+    return x * jitter + t0
+
+
+@partial(jax.jit, static_argnames=("k",))
+def metric_kernel(x, metrics, k):
+    metrics.labels("batched").inc()  # expect: JP001
+    return jnp.sum(x) + k
+
+
+inline_noisy = jax.jit(lambda v: v + time.time())  # expect: JP001

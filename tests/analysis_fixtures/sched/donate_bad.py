@@ -1,0 +1,25 @@
+"""Known-bad: donated buffers touched after the donating call (DS001)."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def scatter_rows(alloc, requested, idx, u_alloc, u_req):
+    return (
+        alloc.at[idx].set(u_alloc, mode="drop"),
+        requested.at[idx].set(u_req, mode="drop"),
+    )
+
+
+def refresh_badly(state, idx, u_alloc, u_req):
+    alloc, requested = state.alloc, state.requested
+    new_alloc, new_req = scatter_rows(alloc, requested, idx, u_alloc, u_req)
+    total = alloc.sum()  # expect: DS001
+    return new_alloc, new_req, total
+
+
+def refresh_attr_badly(state, idx, u_alloc, u_req):
+    out = scatter_rows(state.alloc, state.requested, idx, u_alloc, u_req)
+    return out, state.alloc.nbytes  # expect: DS001

@@ -1,0 +1,26 @@
+"""Known-good: after donation the names are rebound from the result (or
+never touched again) — the resident-block refresh idiom."""
+
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def scatter_rows(alloc, requested, idx, u_alloc, u_req):
+    return (
+        alloc.at[idx].set(u_alloc, mode="drop"),
+        requested.at[idx].set(u_req, mode="drop"),
+    )
+
+
+def refresh_well(state, idx, u_alloc, u_req):
+    alloc, requested = state.alloc, state.requested
+    alloc, requested = scatter_rows(alloc, requested, idx, u_alloc, u_req)
+    return alloc, requested, alloc.sum()   # rebound: the NEW buffers
+
+
+def refresh_and_drop(state, idx, u_alloc, u_req):
+    new = scatter_rows(state.alloc, state.requested, idx, u_alloc, u_req)
+    state.alloc, state.requested = new
+    return state.alloc.sum()               # rebound via the same path

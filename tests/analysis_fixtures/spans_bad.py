@@ -1,0 +1,18 @@
+"""Known-bad: unbalanced trace spans (TS001, TS002)."""
+
+import jax
+
+
+def schedule_cycle_badly(tracer, batch):
+    sp = tracer.span("cycle", pods=len(batch))  # expect: TS001
+    ctx = sp.__enter__()
+    result = batch.run()
+    sp.__exit__(None, None, None)   # leaks if batch.run() raised
+    return result, ctx
+
+
+def profile_badly(log_dir, fn, x):
+    jax.profiler.start_trace(log_dir)  # expect: TS002
+    out = fn(x)                        # a raise leaves the profiler on
+    jax.profiler.stop_trace()
+    return out

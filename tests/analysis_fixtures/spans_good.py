@@ -1,0 +1,23 @@
+"""Known-good: spans as `with` contexts; profiler stop in a finally."""
+
+import jax
+
+
+def schedule_cycle_well(tracer, batch):
+    with tracer.span("cycle", pods=len(batch)) as sp:
+        result = batch.run()
+        sp.attrs["scheduled"] = result.count
+    return result
+
+
+def record_off_stack(tracer, t0, t1):
+    # off-stack timings go through record(): explicit start/end, no leak
+    return tracer.record("bind", start=t0, end=t1)
+
+
+def profile_well(log_dir, fn, x):
+    jax.profiler.start_trace(log_dir)
+    try:
+        return fn(x)
+    finally:
+        jax.profiler.stop_trace()

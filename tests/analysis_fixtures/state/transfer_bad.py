@@ -1,0 +1,26 @@
+"""Known-bad: hot-path device traffic outside the blessed seams
+(HT001, HT002)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def score_kernel(x):
+    return x * 2.0
+
+
+def encode_row_badly(row):
+    # a per-row device_put on the cycle path: the PR-3 bug shape (was
+    # ~30 dispatches per cycle before the single batched placement)
+    return jax.device_put(jnp.asarray(row))  # expect: HT001
+
+
+def fetch_badly(x):
+    scores = score_kernel(x)
+    return np.asarray(scores)  # expect: HT002
+
+
+def fetch_inline_badly(x):
+    return np.asarray(score_kernel(x))  # expect: HT002

@@ -1,0 +1,15 @@
+"""Known-good: host work stays on host arrays; nothing device-shaped
+moves outside a blessed seam."""
+
+import numpy as np
+
+
+def build_rows(pods):
+    rows = np.zeros((len(pods), 8), dtype=np.float32)
+    for i, pod in enumerate(pods):
+        rows[i] = pod.requests
+    return rows
+
+
+def host_only_math(rows):
+    return np.asarray(rows, dtype=np.float64).sum(axis=0)

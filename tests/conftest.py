@@ -10,9 +10,25 @@ conftest runs. Tests must never depend on the TPU tunnel.
 ``xla_force_host_platform_device_count=8``: multi-chip hardware is not
 available, so shardings are validated on a virtual 8-device CPU mesh (same
 scheme as the driver's dryrun).
+
+Concurrency hygiene (the graftcheck runtime half):
+
+- ``faulthandler`` is enabled so a hard wedge dumps every thread's stack
+  on SIGABRT/timeout instead of dying silently.
+- ``threading.excepthook`` is captured: a worker thread dying with an
+  uncaught exception (informer pump, dispatcher worker) FAILS the test
+  that owned it, instead of the test hanging or passing vacuously while
+  the thread's work never happened.
+- The lock-order witness (``kubetpu.analysis.witness``) is installed for
+  the concurrency-heavy test modules: every lock created by kubetpu code
+  during those tests joins a global lock-order graph, and any cycle —
+  a potential ABBA deadlock, even one whose losing interleaving never
+  fired in this run — raises ``LockOrderError`` on the spot.
 """
 
+import faulthandler
 import os
+import threading
 
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
@@ -24,3 +40,76 @@ os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses we spawn
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+faulthandler.enable()
+
+# ---------------------------------------------------------------------------
+# worker-thread death → owning-test failure
+# ---------------------------------------------------------------------------
+_thread_errors: list = []
+_orig_threading_hook = threading.excepthook
+
+
+def _capture_thread_exception(args) -> None:
+    # SystemExit in a thread is the documented clean-exit idiom — not a
+    # death worth failing a test over
+    if args.exc_type is not SystemExit:
+        _thread_errors.append(
+            f"thread {getattr(args.thread, 'name', '?')!r} died: "
+            f"{args.exc_type.__name__}: {args.exc_value}"
+        )
+    _orig_threading_hook(args)
+
+
+threading.excepthook = _capture_thread_exception
+
+
+@pytest.fixture(autouse=True)
+def _fail_on_thread_death():
+    """A worker thread raising after this test started fails THIS test.
+    Best-effort attribution: threads outlive joins rarely enough here
+    that charging the current test is the honest default."""
+    mark = len(_thread_errors)
+    yield
+    fresh = _thread_errors[mark:]
+    if fresh:
+        del _thread_errors[mark:]
+        pytest.fail(
+            "worker thread died during this test:\n  "
+            + "\n  ".join(fresh),
+            pytrace=False,
+        )
+
+
+# ---------------------------------------------------------------------------
+# lock-order witness for the concurrency-heavy suites
+# ---------------------------------------------------------------------------
+#: modules whose tests create MemStore/informer/dispatcher/reflector locks
+#: in-test — the witness watches their global acquisition order
+_WITNESSED_MODULES = {
+    "test_api_batching",      # dispatcher micro-batch + 4-worker stats
+    "test_client_store",      # reflector/informer pump
+    "test_apiserver",         # memstore under the threaded HTTP server
+    "test_queue",             # scheduling queue churn
+    "test_static_analysis",   # the witness's own tests
+}
+
+
+@pytest.fixture(autouse=True)
+def _lock_order_witness(request):
+    mod = request.module.__name__.rsplit(".", 1)[-1]
+    if mod not in _WITNESSED_MODULES:
+        yield None
+        return
+    from kubetpu.analysis import witness
+
+    with witness.installed() as state:
+        yield state
+    if state.violations:
+        pytest.fail(
+            "lock-order witness found potential deadlock(s):\n  "
+            + "\n  ".join(state.violations),
+            pytrace=False,
+        )

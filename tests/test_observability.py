@@ -9,6 +9,7 @@ the CronJob missed-run bound, and Reflector stream feature detection."""
 import json
 import math
 import threading
+import time
 import urllib.error
 import urllib.request
 
@@ -294,7 +295,20 @@ def test_resource_label_resists_hostile_path_segments():
         assert urllib.request.urlopen(req).status == 201
         assert _get(srv.url + "/apis/pods")[0] == 200
 
-        pm = parse_prometheus_text(_get(srv.url + "/metrics")[1])
+        # completion metrics land AFTER the response flush (track()'s
+        # finally — the reference observes at request completion too), so
+        # a scrape racing the tail of the previous request can miss its
+        # sample: re-scrape briefly until the CREATE landed
+        deadline = time.time() + 5.0
+        while True:
+            pm = parse_prometheus_text(_get(srv.url + "/metrics")[1])
+            if pm.value("apiserver_request_total", verb="CREATE",
+                        resource="pods", code="201") is not None \
+                    and pm.value("apiserver_request_total", verb="LIST",
+                                 resource="pods", code="200") is not None:
+                break
+            assert time.time() < deadline, "CREATE/LIST samples never landed"
+            time.sleep(0.02)
         assert pm.value("apiserver_request_total", verb="CREATE",
                         resource="pods", code="201") == 1
         assert pm.value("apiserver_request_total", verb="LIST",
