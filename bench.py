@@ -78,6 +78,10 @@ STAGES = [
     # the bulk/nobulk 500Nodes pair is the APIPlaneComparison evidence
     ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True, False),
     ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False, False),
+    # the flight-recorder overhead budget (<5% fullstack throughput): the
+    # SAME judged fullstack row with --flight-recorder off; the pair feeds
+    # one FlightRecorderOverhead comparison line (9th tuple slot = off)
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True, False, False),
     ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True, False),
     # the encode-cache win measured beyond the 2 classic fullstack rows:
     # spreading through the stack, and recreate-churn driving the
@@ -132,10 +136,15 @@ def run_stage(
     pipeline: bool = False,
     bulk: bool = True,
     mesh: bool = False,
+    flight_recorder: bool = True,
 ) -> dict:
     import contextlib
 
-    from kubetpu.perf.runner import run_workload, run_workload_full_stack
+    from kubetpu.perf.runner import (
+        round_latency_ms,
+        run_workload,
+        run_workload_full_stack,
+    )
 
     runner = run_workload if mode == "direct" else run_workload_full_stack
     ctx: "contextlib.AbstractContextManager" = contextlib.nullcontext()
@@ -158,6 +167,7 @@ def run_stage(
             max_batch=max_batch, artifacts_dir=artifacts_dir,
             pipeline=pipeline, bulk=bulk,
             mesh=("auto" if mesh else None),
+            flight_recorder=flight_recorder,
         )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
@@ -167,6 +177,8 @@ def run_stage(
         suffix += "_nobulk"
     if mesh:
         suffix += "_mesh"
+    if not flight_recorder:
+        suffix += "_norecorder"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -227,7 +239,21 @@ def run_stage(
     if r.threshold_note:
         out["threshold_note"] = r.threshold_note
     if r.p99_attempt_latency_ms is not None:
-        out["p99_attempt_latency_ms"] = round(r.p99_attempt_latency_ms, 1)
+        # rounded in ONE place (perf.runner.round_latency_ms), identically
+        # to WorkloadResult.to_json — benchdiff between a runner emission
+        # and a bench emission must never see a phantom rounding delta
+        out["p99_attempt_latency_ms"] = round_latency_ms(
+            r.p99_attempt_latency_ms
+        )
+    if r.staged_latency_ms is not None:
+        # the per-pod attribution vector (queue_wait/encode/kernel/dispatch/
+        # bind_rtt/e2e, + api_ingest/informer through the full stack):
+        # where the p99 went, not just what it was
+        out["staged_latency_ms"] = r.staged_latency_ms
+    if r.soak is not None:
+        out["soak"] = r.soak
+    if not flight_recorder:
+        out["flight_recorder"] = False
     if r.metrics_snapshot is not None:
         # post-run metrics snapshot (p50/p99 from the scheduler histograms,
         # schedule_attempts by result): every BENCH line carries its own
@@ -274,6 +300,9 @@ CPU_FALLBACK_STAGES = [
     # without the bulk API plane (rpcs_per_scheduled_pod before/after)
     ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True, False),
     ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, False, False),
+    # flight-recorder overhead pair-completer (<5% budget evidence): the
+    # judged fullstack row, recorder off
+    ("SchedulingBasic", "500Nodes", "greedy", "fullstack", 128, False, True, False, False),
     ("SchedulingPodAffinity", "500Nodes", "batched", "fullstack", 128, False, True, False),
     # the ShardingComparison pair-completer on the virtual 8-device CPU
     # mesh (its non-mesh twin ran above): 1-chip vs 8-shard at fixed
@@ -377,6 +406,59 @@ def _emit_api_plane_comparisons(done: dict) -> None:
         _emit(line)
 
 
+def _emit_flightrecorder_comparisons(done: dict) -> None:
+    """One FlightRecorderOverhead line per (case, workload, engine, mode)
+    that ran BOTH recorder-on and recorder-off: the <5% overhead budget's
+    acceptance evidence — throughput on/off side by side with the measured
+    overhead fraction — embedded in the bench artifact itself."""
+    for key, pair in sorted(done.items()):
+        on, off = pair.get(True), pair.get(False)
+        if not on or not off or "error" in on or "error" in off:
+            continue
+        case, workload, engine, mode = key
+        fields = ("value", "duration_s", "p99_attempt_latency_ms")
+        line = {
+            "metric": f"FlightRecorderOverhead_{case}_{workload}_{engine}",
+            "unit": "ratio",
+            "mode": mode,
+            "backend": on.get("backend"),
+            "recorder_on": {
+                k: on.get(k) for k in fields if on.get(k) is not None
+            },
+            "recorder_off": {
+                k: off.get(k) for k in fields if off.get(k) is not None
+            },
+        }
+        if on.get("value") and off.get("value"):
+            ratio = on["value"] / off["value"]
+            line["value"] = round(ratio, 3)
+            line["overhead_frac"] = round(max(1.0 - ratio, 0.0), 4)
+            # the acceptance gate: recorder + tracing on costs <5%
+            line["within_budget"] = ratio >= 0.95
+        _emit(line)
+
+
+def _emit_soak_lines(lines: list) -> None:
+    """One SustainedChurn line per churn-case stage that produced a soak
+    split: the ROADMAP-2 'p99 flat for minutes, not seconds' gate — first-
+    vs second-half p99 with the flatness verdict."""
+    for line in lines:
+        soak = line.get("soak")
+        if not soak or "Churn" not in line.get("metric", ""):
+            continue
+        _emit({
+            "metric": f"SustainedChurn_{line['metric']}",
+            "unit": "ratio",
+            "value": soak.get("ratio"),
+            "p99_first_half_ms": soak.get("p99_first_half_ms"),
+            "p99_second_half_ms": soak.get("p99_second_half_ms"),
+            "samples": soak.get("samples"),
+            "p99_flat": soak.get("p99_flat"),
+            "mode": line.get("mode"),
+            "backend": line.get("backend"),
+        })
+
+
 def _emit_sharding_comparisons(done: dict) -> None:
     """One ShardingComparison line per (case, workload, engine, mode) that
     ran BOTH single-device and mesh-sharded at the same cluster size: the
@@ -438,7 +520,16 @@ def main() -> None:
     api_pairs: dict = {}
     # (case, workload, engine, mode, pipeline, bulk) -> {mesh: result line}
     mesh_pairs: dict = {}
-    for case, workload, engine, mode, max_batch, pipeline, bulk, mesh in STAGES:
+    # (case, workload, engine, mode) -> {flight_recorder: result line}
+    fr_pairs: dict = {}
+    all_lines: list = []
+    for stage in STAGES:
+        # the optional 9th slot is flight_recorder (default on); only the
+        # overhead pair-completers carry it
+        case, workload, engine, mode, max_batch, pipeline, bulk, mesh = (
+            stage[:8]
+        )
+        flight_recorder = stage[8] if len(stage) > 8 else True
         elapsed = time.perf_counter() - t_start
         if elapsed > TOTAL_BUDGET_S:
             _status(f"budget exhausted ({elapsed:.0f}s); skipping {case}/{engine}")
@@ -446,7 +537,9 @@ def main() -> None:
         _status(f"stage start: {case}/{workload}/{engine}/{mode}"
                 f"{'/pipelined' if pipeline else ''}"
                 f"{'/nobulk' if not bulk else ''}"
-                f"{'/mesh' if mesh else ''} (t={elapsed:.0f}s)")
+                f"{'/mesh' if mesh else ''}"
+                f"{'/norecorder' if not flight_recorder else ''}"
+                f" (t={elapsed:.0f}s)")
         suffix = "" if mode == "direct" else "_fullstack"
         if pipeline:
             suffix += "_pipelined"
@@ -454,6 +547,8 @@ def main() -> None:
             suffix += "_nobulk"
         if mesh:
             suffix += "_mesh"
+        if not flight_recorder:
+            suffix += "_norecorder"
         # profile exactly ONE stage: the first quadratic TPU stage (the
         # north-star workload) — the artifact lands in ./xla_profile/
         profile_dir = None
@@ -465,7 +560,8 @@ def main() -> None:
         try:
             line = run_stage(case, workload, engine, mode, max_batch,
                              profile_dir=profile_dir, pipeline=pipeline,
-                             bulk=bulk, mesh=mesh)
+                             bulk=bulk, mesh=mesh,
+                             flight_recorder=flight_recorder)
             if profile_dir is not None:
                 line["xla_profile"] = profile_dir
         except Exception as e:
@@ -477,16 +573,22 @@ def main() -> None:
             })
             _status(f"stage FAILED: {case}/{workload}/{engine}/{mode}: {e}")
             continue
-        if not mesh:
+        if not mesh and flight_recorder:
             pairs.setdefault(
                 (case, workload, engine, mode, bulk), {}
             )[pipeline] = line
             api_pairs.setdefault(
                 (case, workload, engine, mode, pipeline), {}
             )[bulk] = line
-        mesh_pairs.setdefault(
-            (case, workload, engine, mode, pipeline, bulk), {}
-        )[mesh] = line
+        if not mesh and not pipeline and bulk:
+            fr_pairs.setdefault(
+                (case, workload, engine, mode), {}
+            )[flight_recorder] = line
+        if flight_recorder:
+            mesh_pairs.setdefault(
+                (case, workload, engine, mode, pipeline, bulk), {}
+            )[mesh] = line
+        all_lines.append(line)
         _emit(line)
         _status(f"stage done: {line['metric']} = {line['value']} pods/s "
                 f"({line['vs_baseline']}x baseline)")
@@ -501,6 +603,8 @@ def main() -> None:
     _emit_pipeline_comparisons(pairs)
     _emit_api_plane_comparisons(api_pairs)
     _emit_sharding_comparisons(mesh_pairs)
+    _emit_flightrecorder_comparisons(fr_pairs)
+    _emit_soak_lines(all_lines)
     final = best_quadratic or best_any
     if final is None:
         _emit({

@@ -268,6 +268,19 @@ class Pod:
     # the node agent winds the pod down, and the store removes the object
     # on the first update that sees finalizers empty
     deletion_timestamp: float | None = None
+    # attribution-plane stamps, set ONCE by the apiserver at REST create
+    # (sched.flightrecorder): a trace id plus the create's perf_counter
+    # second — carried through the watch frame so the scheduler can charge
+    # api_ingest/e2e latency to the right pod. Zero values = never stamped
+    # (direct-mode harnesses feed the informer seam without an apiserver).
+    # perf_counter is PROCESS/HOST-monotonic: the stamp is only comparable
+    # when apiserver and scheduler share a host (the in-process stack);
+    # the recorder sanity-gates it and degrades to delivery-based
+    # attribution for a foreign clock domain. Neither field joins the
+    # encode signatures (encoder._static_*), so unique stamps cannot
+    # break template-keyed row sharing.
+    trace_id: str = ""
+    ingest_ts: float = 0.0
 
     def labels_dict(self) -> dict[str, str]:
         return dict(self.labels)

@@ -108,6 +108,29 @@ def _op_error_result(e: Exception) -> dict:
     raise e  # unmapped: let the request-level 500 handler see it
 
 
+def _stamp_pod_ingest(kind: str, obj):
+    """The attribution plane's t0 (sched.flightrecorder): a freshly created
+    pod gets a trace id + monotonic ingest timestamp HERE, at REST create —
+    carried through the store and every watch frame so the scheduler's
+    flight recorder can attribute api_ingest/e2e latency per pod. A pod
+    arriving already stamped (a relayed create, a test fixture) keeps its
+    original stamp — t0 means FIRST ingest."""
+    if kind != "pods" or getattr(obj, "ingest_ts", 0.0):
+        return obj
+    import dataclasses
+    import time
+    import uuid
+
+    try:
+        return dataclasses.replace(
+            obj,
+            trace_id=uuid.uuid4().hex[:16],
+            ingest_ts=time.perf_counter(),
+        )
+    except TypeError:       # a pod stand-in without the stamp fields
+        return obj
+
+
 class EventEncodeCache:
     """Serialize-once watch fan-out (the reference watch cache's
     CachingObject, cacher/caching_object.go): one JSON encoding per event,
@@ -471,7 +494,7 @@ class _Handler(BaseHTTPRequestHandler):
     # registry/store.go:514) — one copy, so the two surfaces cannot drift
 
     def _apply_create(self, kind: str, key: str, payload) -> int:
-        obj = scheme.decode(payload)
+        obj = _stamp_pod_ingest(kind, scheme.decode(payload))
         # the admission chain's write locks span admit AND create so a
         # usage-counting validator (quota) cannot race a concurrent
         # create of the same scope
@@ -589,6 +612,8 @@ class _Handler(BaseHTTPRequestHandler):
                 if verb in ("create", "update", "patch"):
                     obj = scheme.decode(op.get("object") or {})
                     real = "create" if verb == "create" else "update"
+                    if real == "create":
+                        obj = _stamp_pod_ingest(kind, obj)
                     # this path only runs WITHOUT dynamic admission, so
                     # admit() is pure strategy validation — no locker to
                     # hold, no hook to feed `old`, no per-op store read

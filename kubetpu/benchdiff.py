@@ -1,0 +1,255 @@
+"""``kubetpu benchdiff old.json new.json`` — the bench-ladder regression
+gate.
+
+Compares two bench records metric-by-metric with noise-aware thresholds
+and exits non-zero on a regression, turning the growing ``BENCH_r*.json``
+ladder into CI evidence instead of archaeology. Three record shapes are
+accepted (auto-detected):
+
+- the driver wrapper ``{"tail": "<mixed stderr + JSON lines>", ...}`` —
+  every parseable JSON line carrying a ``metric`` field is a record (the
+  shape of the committed ``BENCH_r*.json`` artifacts; truncated tails
+  simply yield fewer lines);
+- a JSON array of bench lines;
+- ndjson text (one bench line per line — ``python bench.py`` output).
+
+Comparison rules (per metric name present in BOTH records):
+
+- **throughput** (``unit == "pods/s"``): regression when
+  ``new < old * (1 - throughput_tol)``. The default tolerance (25%) is
+  noise-aware for the CPU-fallback bench — the committed r04→r05 pair
+  moved −5.3% on its shared metric, well inside it — while a halved
+  throughput still trips the gate.
+- **p99 latency** (``p99_attempt_latency_ms``): regression when the new
+  p99 exceeds ``old * (1 + p99_tol)`` AND grew by more than
+  ``min_p99_delta_ms`` (small absolute wobbles on sub-ms p99s never gate).
+- **staged p99s** (``staged_latency_ms.<stage>.p99``, the per-pod
+  attribution vector every fullstack record now carries): same rule per
+  stage.
+- a metric that ERRORED in new but not old is always a regression;
+  improvements and within-tolerance moves report as ok; metrics present
+  in only one record are listed but never gate (the ladder's stage lists
+  evolve).
+
+Exit codes: 0 = no regression, 1 = regression(s), 2 = usage/parse error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from dataclasses import dataclass
+
+#: default noise tolerances (see module docstring for their calibration)
+THROUGHPUT_TOL = 0.25
+P99_TOL = 0.50
+MIN_P99_DELTA_MS = 10.0
+
+
+class BenchDiffError(ValueError):
+    pass
+
+
+def parse_bench_lines(text: str) -> dict[str, dict]:
+    """Every parseable JSON object line carrying a ``metric`` field,
+    keyed by metric name (last line wins, matching the driver's
+    last-line-rules convention)."""
+    out: dict[str, dict] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line.startswith("{"):
+            continue
+        try:
+            d = json.loads(line)
+        except json.JSONDecodeError:
+            continue        # a truncated/interleaved line is not a record
+        if isinstance(d, dict) and "metric" in d:
+            out[str(d["metric"])] = d
+    return out
+
+
+def load_record(path: str) -> dict[str, dict]:
+    """Load one bench record file into {metric: line} (shapes per module
+    docstring)."""
+    with open(path, encoding="utf-8") as f:
+        text = f.read()
+    try:
+        raw = json.loads(text)
+    except json.JSONDecodeError:
+        raw = None
+    if isinstance(raw, dict) and isinstance(raw.get("tail"), str):
+        out = parse_bench_lines(raw["tail"])
+        parsed = raw.get("parsed")
+        if isinstance(parsed, dict) and "metric" in parsed:
+            out.setdefault(str(parsed["metric"]), parsed)
+        if out:
+            return out
+        raise BenchDiffError(f"{path}: driver wrapper carries no bench lines")
+    if isinstance(raw, list):
+        out = {
+            str(d["metric"]): d
+            for d in raw
+            if isinstance(d, dict) and "metric" in d
+        }
+        if out:
+            return out
+        raise BenchDiffError(f"{path}: JSON array carries no bench lines")
+    if isinstance(raw, dict) and "metric" in raw:
+        return {str(raw["metric"]): raw}
+    out = parse_bench_lines(text)
+    if not out:
+        raise BenchDiffError(f"{path}: no bench lines found")
+    return out
+
+
+@dataclass
+class Delta:
+    metric: str
+    field: str              # "throughput" | "p99_ms" | "staged_p99_ms.<s>"
+    old: float | None
+    new: float | None
+    regression: bool
+    note: str = ""
+
+    def render(self) -> str:
+        mark = "REGRESSION" if self.regression else "ok"
+        if self.old is None or self.new is None:
+            body = self.note
+        else:
+            pct = (
+                (self.new - self.old) / self.old * 100.0 if self.old else 0.0
+            )
+            body = f"{self.old:g} -> {self.new:g} ({pct:+.1f}%)"
+            if self.note:
+                body += f" {self.note}"
+        return f"{mark:>10}  {self.metric} {self.field}: {body}"
+
+
+def _staged_p99s(line: dict) -> dict[str, float]:
+    staged = line.get("staged_latency_ms")
+    if not isinstance(staged, dict):
+        return {}
+    out = {}
+    for stage, v in staged.items():
+        if isinstance(v, dict) and isinstance(v.get("p99"), (int, float)):
+            out[stage] = float(v["p99"])
+    return out
+
+
+def compare(
+    old: dict[str, dict],
+    new: dict[str, dict],
+    throughput_tol: float = THROUGHPUT_TOL,
+    p99_tol: float = P99_TOL,
+    min_p99_delta_ms: float = MIN_P99_DELTA_MS,
+) -> tuple[list[Delta], list[str], list[str]]:
+    """Returns (deltas over the common metrics, metrics only in old,
+    metrics only in new)."""
+    deltas: list[Delta] = []
+    common = sorted(set(old) & set(new))
+    for name in common:
+        o, n = old[name], new[name]
+        if "error" in n and "error" not in o:
+            deltas.append(Delta(
+                name, "error", None, None, True,
+                note=f"new record errored: {n['error']}",
+            ))
+            continue
+        if "error" in o:
+            continue        # was broken before: nothing to gate against
+        if o.get("unit") == "pods/s" and isinstance(
+            o.get("value"), (int, float)
+        ) and isinstance(n.get("value"), (int, float)):
+            ov, nv = float(o["value"]), float(n["value"])
+            bad = ov > 0 and nv < ov * (1.0 - throughput_tol)
+            deltas.append(Delta(
+                name, "throughput", ov, nv, bad,
+                note=f"[tol -{throughput_tol:.0%}]" if bad else "",
+            ))
+        op99, np99 = o.get("p99_attempt_latency_ms"), n.get(
+            "p99_attempt_latency_ms"
+        )
+        if isinstance(op99, (int, float)) and isinstance(np99, (int, float)):
+            bad = (
+                np99 > op99 * (1.0 + p99_tol)
+                and (np99 - op99) > min_p99_delta_ms
+            )
+            deltas.append(Delta(
+                name, "p99_ms", float(op99), float(np99), bad,
+                note=f"[tol +{p99_tol:.0%} & >{min_p99_delta_ms:g}ms]"
+                if bad else "",
+            ))
+        os_, ns_ = _staged_p99s(o), _staged_p99s(n)
+        for stage in sorted(set(os_) & set(ns_)):
+            ov, nv = os_[stage], ns_[stage]
+            bad = nv > ov * (1.0 + p99_tol) and (nv - ov) > min_p99_delta_ms
+            deltas.append(Delta(
+                name, f"staged_p99_ms.{stage}", ov, nv, bad,
+                note=f"[tol +{p99_tol:.0%} & >{min_p99_delta_ms:g}ms]"
+                if bad else "",
+            ))
+    only_old = sorted(set(old) - set(new))
+    only_new = sorted(set(new) - set(old))
+    return deltas, only_old, only_new
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="kubetpu benchdiff",
+        description="compare two bench records metric-by-metric with "
+                    "noise-aware thresholds; non-zero exit on regression",
+    )
+    ap.add_argument("old", help="baseline bench record (e.g. BENCH_r04.json)")
+    ap.add_argument("new", help="candidate bench record (e.g. BENCH_r05.json)")
+    ap.add_argument("--throughput-tol", type=float, default=THROUGHPUT_TOL,
+                    help="fractional throughput drop tolerated "
+                         f"(default {THROUGHPUT_TOL})")
+    ap.add_argument("--p99-tol", type=float, default=P99_TOL,
+                    help="fractional p99 growth tolerated "
+                         f"(default {P99_TOL})")
+    ap.add_argument("--min-p99-delta-ms", type=float,
+                    default=MIN_P99_DELTA_MS,
+                    help="absolute p99 growth floor below which latency "
+                         f"never gates (default {MIN_P99_DELTA_MS})")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+    try:
+        old = load_record(args.old)
+        new = load_record(args.new)
+    except (OSError, BenchDiffError) as e:
+        print(f"benchdiff: {e}", file=sys.stderr)
+        return 2
+    deltas, only_old, only_new = compare(
+        old, new,
+        throughput_tol=args.throughput_tol,
+        p99_tol=args.p99_tol,
+        min_p99_delta_ms=args.min_p99_delta_ms,
+    )
+    regressions = [d for d in deltas if d.regression]
+    if args.json:
+        print(json.dumps({
+            "regressions": len(regressions),
+            "compared": len(deltas),
+            "only_in_old": only_old,
+            "only_in_new": only_new,
+            "deltas": [vars(d) for d in deltas],
+        }, indent=2))
+    else:
+        for d in deltas:
+            print(d.render())
+        if only_old:
+            print(f"only in {args.old}: {', '.join(only_old)}")
+        if only_new:
+            print(f"only in {args.new}: {', '.join(only_new)}")
+        print(
+            f"benchdiff: {len(deltas)} comparisons over "
+            f"{len(set(d.metric for d in deltas))} shared metrics, "
+            f"{len(regressions)} regression(s)"
+        )
+    return 1 if regressions else 0
+
+
+if __name__ == "__main__":  # pragma: no cover — python -m kubetpu.benchdiff
+    raise SystemExit(main())

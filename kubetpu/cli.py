@@ -17,6 +17,11 @@ Commands (the control-plane binaries + tooling):
 - ``get`` / ``apply`` / ``delete``   kubectl-style object access
 - ``check-config``        decode + validate a config file, loudly
 - ``perf``                the scheduler_perf harness (kubetpu.perf)
+- ``explain``             render a pod's scheduling flight-recorder record
+                          (timeline + why-node-won / why-filtered) from a
+                          scheduler's /debug/flightrecorder or a JSON dump
+- ``benchdiff``           compare two bench records with noise-aware
+                          thresholds; non-zero exit on regression
 - ``version``             print the framework version
 """
 
@@ -259,6 +264,7 @@ def cmd_scheduler(args) -> int:
         encode_cache=(args.encode_cache == "on"),
         bulk=(args.bulk == "on"),
         mesh=mesh,
+        flight_recorder=(args.flight_recorder == "on"),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
@@ -524,6 +530,135 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def _render_explain(rec: dict) -> str:
+    """One flight-recorder record as the ``kubetpu explain`` report:
+    staged timeline + decision reasoning (sched.flightrecorder)."""
+    from .metrics.scheduler_metrics import E2E_STAGES
+
+    lines = [
+        f"Pod {rec['pod']} — cycle {rec.get('cycle')}, "
+        f"profile {rec.get('profile')}, attempts {rec.get('attempts')}, "
+        f"status {rec.get('status')}"
+    ]
+    if rec.get("trace_id"):
+        lines.append(f"  trace id: {rec['trace_id']}")
+    stages = rec.get("stages_ms") or {}
+    if stages:
+        parts = [
+            f"{st} {stages[st]:.2f}" for st in E2E_STAGES
+            if st in stages and st != "e2e"
+        ]
+        e2e = stages.get("e2e")
+        lines.append(
+            "  timeline (ms): " + " → ".join(parts)
+            + (f"  |  e2e {e2e:.2f}" if e2e is not None else "")
+        )
+    elif rec.get("queue_wait_s") is not None:
+        lines.append(
+            f"  queue_wait {rec['queue_wait_s'] * 1000:.2f} ms, "
+            f"encode {rec.get('encode_s', 0) * 1000:.2f} ms, "
+            f"kernel {rec.get('kernel_s', 0) * 1000:.2f} ms (not yet bound)"
+        )
+    win = rec.get("win")
+    if rec.get("node"):
+        head = f"  decision: {rec['status']} on {rec['node']}"
+        if win and win.get("score") is not None:
+            head += f" (score {win['score']}"
+            if win.get("margin") is not None:
+                head += f", margin {win['margin']:+d}"
+            head += f", {rec.get('view', 'cycle-start')} view)"
+        lines.append(head)
+    else:
+        lines.append("  decision: no feasible node")
+    top = rec.get("top_nodes")
+    if top:
+        lines.append("    top nodes: " + "  ".join(
+            f"{t['node']}={t['score']}" for t in top
+        ))
+    rejected = rec.get("rejected_by")
+    if rejected is not None:
+        total = rec.get("total_nodes", 0)
+        feasible = rec.get("feasible_nodes", 0)
+        lines.append(
+            f"    filtered: {total - feasible}/{total} nodes infeasible"
+            + (
+                " — " + ", ".join(
+                    f"{plugin} {cnt}"
+                    + (
+                        f" (e.g. {', '.join(ex)})"
+                        if (ex := (rec.get('rejected_examples') or {}).get(
+                            plugin
+                        )) else ""
+                    )
+                    for plugin, cnt in sorted(rejected.items())
+                ) if rejected else ""
+            )
+        )
+    if rec.get("nominated_node"):
+        line = f"  preemption: nominated {rec['nominated_node']}"
+        victims = rec.get("preemption_victims")
+        if victims:
+            line += f" (victims: {', '.join(victims)})"
+        lines.append(line)
+    for hop in rec.get("requeue", ()):
+        lines.append(
+            f"  requeued → {hop.get('queue')}"
+            + (f" [{', '.join(hop['plugins'])}]" if hop.get("plugins") else "")
+            + (" (error status)" if hop.get("error") else "")
+        )
+    if rec.get("bind_error"):
+        lines.append(f"  bind error: {rec['bind_error']}")
+    return "\n".join(lines)
+
+
+def cmd_explain(args) -> int:
+    """``kubetpu explain pod/<ns>/<name>``: fetch the pod's decision record
+    from a running scheduler's /debug/flightrecorder (--server, the
+    diagnostics URL) or a dumped recorder JSON (--file) and render its
+    timeline + win/filter reasoning."""
+    target = args.target
+    if target.startswith("pod/"):
+        target = target[len("pod/"):]
+    if "/" not in target:
+        target = f"default/{target}"
+    if args.file:
+        with open(args.file, encoding="utf-8") as f:
+            body = json.load(f)
+    else:
+        import urllib.parse
+        import urllib.request
+
+        url = (
+            args.server.rstrip("/")
+            + "/debug/flightrecorder?pod="
+            + urllib.parse.quote(target, safe="")
+        )
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                body = json.load(resp)
+        except OSError as e:
+            print(f"cannot reach {url}: {e}", file=sys.stderr)
+            return 2
+    if not body.get("enabled", True):
+        print("flight recorder is disabled on this scheduler "
+              "(--flight-recorder off)", file=sys.stderr)
+        return 1
+    records = [
+        r for r in body.get("records", ()) if r.get("pod") == target
+    ]
+    if not records:
+        print(f"no flight-recorder record for pod {target} "
+              f"(evicted from the ring, or never scheduled here)",
+              file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(records if args.all else records[0], indent=2))
+        return 0
+    for rec in records if args.all else records[:1]:
+        print(_render_explain(rec))
+    return 0
+
+
 def cmd_version(_args) -> int:
     from . import __version__
 
@@ -594,6 +729,15 @@ def build_parser() -> argparse.ArgumentParser:
                            "collectives. 'auto' engages when >1 device is "
                            "visible; 'on' requires one; assignments are "
                            "bit-identical to single-device either way")
+    schd.add_argument("--flight-recorder", default="on",
+                      choices=["on", "off"],
+                      help="scheduling flight recorder + per-pod staged "
+                           "latency attribution: bounded ring of decision "
+                           "records at /debug/flightrecorder (rendered by "
+                           "'kubetpu explain') and the "
+                           "scheduler_e2e_scheduling_duration_seconds"
+                           "{stage} histograms; 'off' is the overhead "
+                           "escape hatch — decisions are identical")
     schd.add_argument("--prewarm", action="store_true",
                       help="compile the assign program for the full "
                            "batch-size bucket ladder at startup, so "
@@ -650,6 +794,34 @@ def build_parser() -> argparse.ArgumentParser:
     delete.add_argument("--server", required=True)
     delete.set_defaults(fn=cmd_delete)
 
+    explain = sub.add_parser(
+        "explain",
+        help="render a pod's flight-recorder record: staged latency "
+             "timeline + why node Y won / why nodes were filtered",
+    )
+    explain.add_argument("target", help="pod/<ns>/<name> (or ns/name)")
+    explain.add_argument("--server", default="http://127.0.0.1:10251",
+                         help="scheduler DIAGNOSTICS base URL "
+                              "(the --diagnostics-port listener)")
+    explain.add_argument("--file", default="",
+                         help="render from a dumped /debug/flightrecorder "
+                              "JSON instead of a live scheduler")
+    explain.add_argument("-o", "--output", default="text",
+                         choices=("text", "json"))
+    explain.add_argument("--all", action="store_true",
+                         help="render every matching record, not just the "
+                              "latest")
+    explain.set_defaults(fn=cmd_explain)
+
+    bd = sub.add_parser(
+        "benchdiff",
+        help="compare two bench records metric-by-metric; non-zero exit "
+             "on a throughput or staged-p99 regression "
+             "(see python -m kubetpu.benchdiff)",
+    )
+    bd.add_argument("rest", nargs=argparse.REMAINDER)
+    bd.set_defaults(fn=None)
+
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=cmd_version)
 
@@ -677,6 +849,12 @@ def main(argv: Sequence[str] | None = None) -> int:
         from .analysis.__main__ import main as analyze_main
 
         return analyze_main(raw[1:]) or 0
+    if raw and raw[0] == "benchdiff":
+        # dispatch before argparse: REMAINDER drops leading flags
+        # (`kubetpu benchdiff --json a b` must reach the sub-CLI intact)
+        from .benchdiff import main as benchdiff_main
+
+        return benchdiff_main(raw[1:])
     args = build_parser().parse_args(argv)
     if args.command == "perf":
         from .perf.__main__ import main as perf_main

@@ -12,7 +12,11 @@ from .registry import (  # noqa: F401
     exponential_buckets,
 )
 from .health import CheckResult, HealthChecks  # noqa: F401
-from .scheduler_metrics import SchedulerMetricsRegistry  # noqa: F401
+from .scheduler_metrics import (  # noqa: F401
+    E2E_STAGES,
+    SchedulerMetricsRegistry,
+    window_quantile_ms,
+)
 from .textparse import ParsedMetrics, parse_prometheus_text  # noqa: F401
 from .tpu import TPUBackendMetrics, batch_nbytes, jit_cache_size  # noqa: F401
 from .workqueue import (  # noqa: F401

@@ -23,16 +23,17 @@ def diagnostics_response(
     """Answer one diagnostics request: ``/metrics`` (the joined Prometheus
     text of every source), the health endpoints (delegated to
     ``health.handle``), or an ``extra`` route mapping path →
-    ``() -> (content_type, body)``. Returns (status, content_type, body),
-    or None when the path belongs to none of them (the caller keeps its
-    own 404 shape)."""
+    ``(query) -> (content_type, body)`` (the parsed query mapping is
+    passed through so routes like /debug/flightrecorder?pod=… can scope
+    their body). Returns (status, content_type, body), or None when the
+    path belongs to none of them (the caller keeps its own 404 shape)."""
     path = "/" + path.strip("/")
     if path == "/metrics":
         return 200, PROM_CONTENT_TYPE, "".join(s() for s in metrics_sources)
     if extra is not None:
         fn = extra.get(path)
         if fn is not None:
-            content_type, body = fn()
+            content_type, body = fn(query or {})
             return 200, content_type, body
     if health is not None:
         res = health.handle(path, query)

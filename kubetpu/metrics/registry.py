@@ -28,10 +28,18 @@ def exponential_buckets(start: float, factor: float, count: int) -> list[float]:
 class _Metric:
     kind = "untyped"
 
-    def __init__(self, name: str, help: str = "", labels: tuple[str, ...] = ()):
+    def __init__(self, name: str, help: str = "", labels: tuple[str, ...] = (),
+                 declared: dict[str, tuple] | None = None):
         self.name = name
         self.help = help
         self.label_names = tuple(labels)
+        # label name -> tuple of the ONLY legal values (the staged-latency
+        # {stage} contract): an unknown value raises at .labels() time, and
+        # the graftcheck MR004 checker enforces the same set at parse time
+        # for literal call sites — declared sets cannot drift silently.
+        self.declared = {
+            k: tuple(v) for k, v in (declared or {}).items()
+        }
         self._children: dict[tuple, "_Metric"] = {}
         self._lock = threading.Lock()
 
@@ -42,6 +50,13 @@ class _Metric:
                 f"{self.name}: expected labels {self.label_names}, got {values}"
             )
         key = tuple(str(v) for v in values)
+        for name, value in zip(self.label_names, key):
+            allowed = self.declared.get(name)
+            if allowed is not None and value not in allowed:
+                raise ValueError(
+                    f"{self.name}: label {name}={value!r} outside the "
+                    f"declared set {allowed}"
+                )
         with self._lock:
             child = self._children.get(key)
             if child is None:
@@ -66,8 +81,8 @@ class _Metric:
 class Counter(_Metric):
     kind = "counter"
 
-    def __init__(self, name, help="", labels=()):
-        super().__init__(name, help, labels)
+    def __init__(self, name, help="", labels=(), declared=None):
+        super().__init__(name, help, labels, declared)
         self.value = 0.0
 
     def _make_child(self):
@@ -106,8 +121,8 @@ class Gauge(Counter):
 class Histogram(_Metric):
     kind = "histogram"
 
-    def __init__(self, name, help="", labels=(), buckets=None):
-        super().__init__(name, help, labels)
+    def __init__(self, name, help="", labels=(), buckets=None, declared=None):
+        super().__init__(name, help, labels, declared)
         self.buckets = list(buckets if buckets is not None
                             else exponential_buckets(0.001, 2, 15))
         self.counts = [0] * (len(self.buckets) + 1)   # +Inf tail
@@ -228,14 +243,15 @@ class Registry:
         self.metrics[metric.name] = metric
         return metric
 
-    def counter(self, name, help="", labels=()) -> Counter:
-        return self.register(Counter(name, help, labels))
+    def counter(self, name, help="", labels=(), declared=None) -> Counter:
+        return self.register(Counter(name, help, labels, declared))
 
-    def gauge(self, name, help="", labels=()) -> Gauge:
-        return self.register(Gauge(name, help, labels))
+    def gauge(self, name, help="", labels=(), declared=None) -> Gauge:
+        return self.register(Gauge(name, help, labels, declared))
 
-    def histogram(self, name, help="", labels=(), buckets=None) -> Histogram:
-        return self.register(Histogram(name, help, labels, buckets))
+    def histogram(self, name, help="", labels=(), buckets=None,
+                  declared=None) -> Histogram:
+        return self.register(Histogram(name, help, labels, buckets, declared))
 
     def get(self, name: str) -> _Metric | None:
         return self.metrics.get(name)

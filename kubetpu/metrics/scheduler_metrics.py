@@ -22,7 +22,37 @@ from __future__ import annotations
 
 import math
 
-from .registry import Registry, exponential_buckets
+from .registry import Histogram, Registry, exponential_buckets
+
+#: the per-pod staged latency attribution vector (sched.flightrecorder):
+#: the ONLY legal values of the {stage} label on
+#: scheduler_e2e_scheduling_duration_seconds — declared at registration
+#: (runtime check) and enforced at parse time by graftcheck MR004.
+E2E_STAGES = (
+    "api_ingest",       # REST create -> informer delivery (fullstack)
+    "informer",         # delivery-handler wall (incl. pre-encode)
+    "queue_wait",       # enqueue -> pop, summed across requeue hops
+    "encode",           # owning cycle's host-encode wall
+    "kernel",           # owning cycle's device-program wall
+    "dispatch",         # bind enqueue -> micro-batch execution start
+    "bind_rtt",         # bind execution -> completion
+    "e2e",              # ingest (or delivery) -> bind ack
+)
+
+
+def window_quantile_ms(
+    hist: Histogram, baseline: Histogram | None = None, q: float = 0.99
+) -> float | None:
+    """A histogram quantile in MILLISECONDS scoped to the measurement
+    window: with ``baseline`` (an earlier ``merged()`` snapshot) the
+    quantile covers only the delta since it — a large init phase must not
+    dominate the reported p99s (the perf runner's window-scoping rule,
+    shared by both run modes and the staged percentiles). None when the
+    window observed nothing."""
+    delta = hist.since(baseline) if baseline is not None else hist.merged()
+    if delta.total > 0:
+        return float(delta.quantile(q) * 1000.0)
+    return None
 
 
 class SchedulerMetricsRegistry:
@@ -82,6 +112,15 @@ class SchedulerMetricsRegistry:
             "Number of selected preemption victims",
             buckets=exponential_buckets(1, 2, 7),
         )
+        self.e2e_scheduling_duration = r.histogram(
+            "scheduler_e2e_scheduling_duration_seconds",
+            "Per-pod staged scheduling latency: where each pod's "
+            "end-to-end time went, by attribution stage "
+            "(sched.flightrecorder; stages: " + ", ".join(E2E_STAGES) + ").",
+            labels=("stage",),
+            buckets=exponential_buckets(0.0001, 2, 20),
+            declared={"stage": E2E_STAGES},
+        )
         self.pending_pods = r.gauge(
             "scheduler_pending_pods",
             "Number of pending pods, by the queue type.",
@@ -135,7 +174,32 @@ class SchedulerMetricsRegistry:
             "sli_duration": self.pod_scheduling_sli_duration.merged(),
             "algorithm_duration": self.scheduling_algorithm_duration.merged(),
             "schedule_attempts": self._attempts_by_result(),
+            "e2e_stages": self._staged_children(),
         }
+
+    def _staged_children(self) -> dict:
+        """{stage: merged Histogram} for every stage observed so far."""
+        return {
+            key[0]: child.merged()
+            for key, child in (
+                self.e2e_scheduling_duration._children_snapshot()
+            )
+        }
+
+    def staged_percentiles(self, baseline: dict | None = None) -> dict | None:
+        """Per-stage p50/p99 (ms) of the staged latency vector, scoped to
+        the window since ``baseline`` (a ``snapshot_baseline``) — the
+        ``staged_latency_ms`` block every fullstack bench record carries.
+        None when no stage observed anything in the window."""
+        base = (baseline or {}).get("e2e_stages", {})
+        out = {}
+        for stage, child in self._staged_children().items():
+            p50 = window_quantile_ms(child, base.get(stage), 0.50)
+            p99 = window_quantile_ms(child, base.get(stage), 0.99)
+            if p99 is None:
+                continue
+            out[stage] = {"p50": round(p50, 3), "p99": round(p99, 3)}
+        return out or None
 
     def snapshot(self, baseline: dict | None = None) -> dict:
         """Post-run summary embedded in BENCH artifacts: p50/p99 from the

@@ -40,6 +40,11 @@ def main(argv=None) -> None:
                          "(Scheduler(mesh=…)): sharded resident node block "
                          "+ SPMD engines; assignments bit-identical to "
                          "single-device, 'on' requires >1 device")
+    ap.add_argument("--flight-recorder", default="on", choices=["on", "off"],
+                    help="scheduling flight recorder + per-pod staged "
+                         "latency attribution (decision records, "
+                         "staged_latency_ms/soak fields); 'off' is the "
+                         "overhead escape hatch")
     ap.add_argument("--artifacts-dir", default=None,
                     help="dump per-workload diagnosis artifacts here: the "
                          "cycle trace as Perfetto-loadable Chrome-trace "
@@ -61,6 +66,7 @@ def main(argv=None) -> None:
         encode_cache=(args.encode_cache == "on"),
         bulk=(args.bulk == "on"),
         mesh=args.mesh,   # resolve_mesh handles on/off/auto
+        flight_recorder=(args.flight_recorder == "on"),
     )
     if args.label:
         for r in run_label(args.label, **kwargs):

@@ -28,8 +28,31 @@ import numpy as np
 
 from ..api import types as t
 from ..framework import config as C
+from ..metrics.scheduler_metrics import window_quantile_ms
 from ..sched.scheduler import Scheduler
 from . import workloads as W
+
+
+def round_latency_ms(v: float | None) -> float | None:
+    """THE latency rounding for bench artifacts (2 decimals) — one place,
+    used by WorkloadResult.to_json AND bench.py's stage lines, so a
+    benchdiff between a runner emission and a bench emission never sees
+    phantom rounding deltas."""
+    return None if v is None else round(float(v), 2)
+
+
+def measured_p99_ms(sched: "Scheduler", prom_base: dict | None) -> float | None:
+    """p99 of pod_scheduling_sli_duration_seconds in MILLISECONDS, scoped
+    to the measured window (the ``_begin_measured_phase`` baseline): a
+    large init phase must not dominate the reported p99s. Shared by both
+    run modes; the staged percentiles apply the same scoping per stage."""
+    if prom_base is None:
+        return None
+    return window_quantile_ms(
+        sched.metrics.prom.pod_scheduling_sli_duration,
+        prom_base.get("sli_duration"),
+        0.99,
+    )
 
 
 @dataclass
@@ -78,6 +101,18 @@ class WorkloadResult:
     # from the histograms + schedule_attempts by result — every BENCH json
     # carries its own diagnosis
     metrics_snapshot: dict | None = None
+    # per-pod staged latency attribution, measured-window scoped
+    # (sched.flightrecorder → scheduler_e2e_scheduling_duration_seconds):
+    # {stage: {"p50": ms, "p99": ms}} for queue_wait/encode/kernel/
+    # dispatch/bind_rtt/e2e (+ api_ingest/informer through the full stack)
+    staged_latency_ms: dict | None = None
+    # SustainedChurn soak gate: p99 e2e of the measured window's first vs
+    # second half + the flatness verdict (ROADMAP item 2's "p99 flat for
+    # minutes" evidence)
+    soak: dict | None = None
+    # flight recorder + per-pod tracing state for this run (the <5%
+    # overhead budget's on/off comparison key)
+    flight_recorder: bool = True
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -101,7 +136,9 @@ class WorkloadResult:
         if self.threshold_note:
             out["threshold_note"] = self.threshold_note
         if self.p99_attempt_latency_ms is not None:
-            out["p99_attempt_latency_ms"] = round(self.p99_attempt_latency_ms, 2)
+            out["p99_attempt_latency_ms"] = round_latency_ms(
+                self.p99_attempt_latency_ms
+            )
         if self.cycles_per_sec is not None:
             out["cycles_per_sec"] = round(self.cycles_per_sec, 2)
         if self.transfer_bytes_per_cycle is not None:
@@ -129,6 +166,12 @@ class WorkloadResult:
             out["mesh_shape"] = list(self.mesh_shape)
             if self.collective_wall_s is not None:
                 out["collective_wall_s"] = round(self.collective_wall_s, 6)
+        if self.staged_latency_ms is not None:
+            out["staged_latency_ms"] = self.staged_latency_ms
+        if self.soak is not None:
+            out["soak"] = self.soak
+        if not self.flight_recorder:
+            out["flight_recorder"] = False
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -235,6 +278,10 @@ def _begin_measured_phase(sched, warmup: bool, warm_pods):
     # dispatcher baseline: mean bulk batch size + errors scoped to the
     # measured phase, not the init churn
     sched._measure_disp0 = sched.dispatcher.stats()
+    # measured-window start on the lifecycle clock (perf_counter): the
+    # soak stage splits the flight recorder's e2e samples at this
+    # window's midpoint
+    sched._measure_t0_pc = time.perf_counter()
     return (
         sched.metrics.schedule_attempts,
         sched.metrics.cycles,
@@ -270,6 +317,26 @@ def _encode_stats(sched, cycles0: int) -> dict:
         dh, dm = h - h0, m - m0
         if dh + dm:
             out["encode_cache_hit_rate"] = dh / (dh + dm)
+    return out
+
+
+def _staged_and_soak(sched, prom_base) -> dict:
+    """Measured-window staged percentiles + the SustainedChurn soak split
+    (both None when the flight recorder is off or nothing bound)."""
+    out = dict(
+        staged_latency_ms=None, soak=None,
+        flight_recorder=sched.flight_recorder is not None,
+    )
+    if sched.flight_recorder is None:
+        return out
+    out["staged_latency_ms"] = sched.metrics.prom.staged_percentiles(
+        prom_base
+    )
+    t0 = getattr(sched, "_measure_t0_pc", None)
+    if t0 is not None:
+        out["soak"] = sched.flight_recorder.soak_split(
+            t0, time.perf_counter()
+        )
     return out
 
 
@@ -481,6 +548,7 @@ def run_workload(
     encode_cache: bool = True,
     bulk: bool = True,
     mesh=None,
+    flight_recorder: bool = True,
 ) -> WorkloadResult:
     """Execute one (test case, workload) pair and return the measurement.
     ``engine`` selects the assignment engine ("greedy" scan or "batched"
@@ -501,7 +569,10 @@ def run_workload(
     hatch — the off path is pod-for-pod identical). ``mesh`` shards the
     node axis over a device mesh (Scheduler(mesh=…): None/"off", "auto",
     "on", or a jax.sharding.Mesh) — bit-identical assignments, N-chip
-    capacity."""
+    capacity. ``flight_recorder`` toggles the scheduling flight recorder +
+    per-pod staged latency attribution (``--flight-recorder off`` is the
+    overhead escape hatch; the bench's FlightRecorderOverhead line records
+    the measured on/off cost)."""
     if isinstance(case, str):
         case = W.TEST_CASES[case]
     if isinstance(workload, str):
@@ -512,7 +583,7 @@ def run_workload(
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
-        bulk=bulk, mesh=mesh,
+        bulk=bulk, mesh=mesh, flight_recorder=flight_recorder,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     client.sched = sched
@@ -796,15 +867,9 @@ def run_workload(
     client.deliver()
     sched._drain_bind_completions()
     # p99 from the pod_scheduling_sli_duration_seconds HISTOGRAM, scoped to
-    # the measured phase (the reference's perf harness reads the scheduler
-    # histograms the same way; histogram_quantile estimation)
-    lat = None
-    if prom_base is not None:
-        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(
-            prom_base["sli_duration"]
-        )
-        if delta.total > 0:
-            lat = float(delta.quantile(0.99) * 1000.0)
+    # the measured phase (measured_p99_ms — the shared window-scoping
+    # helper; histogram_quantile estimation)
+    lat = measured_p99_ms(sched, prom_base)
     artifacts: dict[str, str] = {}
     if artifacts_dir is not None:
         artifacts = dump_diagnosis_artifacts(
@@ -822,6 +887,7 @@ def run_workload(
         **_encode_stats(sched, cycles0),
         **_dispatcher_stats(sched),
         **_mesh_stats(sched),
+        **_staged_and_soak(sched, prom_base),
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops
@@ -868,6 +934,7 @@ def run_workload_full_stack(
     encode_cache: bool = True,
     bulk: bool = True,
     mesh=None,
+    flight_recorder: bool = True,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -933,7 +1000,7 @@ def run_workload_full_stack(
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
-        bulk=bulk, mesh=mesh,
+        bulk=bulk, mesh=mesh, flight_recorder=flight_recorder,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
     informers = SchedulerInformers(remote, sched, bulk=bulk)
@@ -1052,13 +1119,7 @@ def run_workload_full_stack(
         sched.close()
         srv.close()
 
-    lat = None
-    if prom_base is not None:
-        delta = sched.metrics.prom.pod_scheduling_sli_duration.since(
-            prom_base["sli_duration"]
-        )
-        if delta.total > 0:
-            lat = float(delta.quantile(0.99) * 1000.0)
+    lat = measured_p99_ms(sched, prom_base)
     artifacts: dict[str, str] = {}
     if artifacts_dir is not None:
         artifacts = dump_diagnosis_artifacts(
@@ -1076,6 +1137,7 @@ def run_workload_full_stack(
         **_encode_stats(sched, cycles0),
         **_dispatcher_stats(sched),
         **_mesh_stats(sched),
+        **_staged_and_soak(sched, prom_base),
         rpcs_per_scheduled_pod=(
             rpcs_total / measured if measured else None
         ),

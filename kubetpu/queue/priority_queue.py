@@ -72,6 +72,13 @@ class QueuedPodInfo:
     # scheduling cycle that assumed this pod — stamps the async bind span
     # so queue→score→assign→bind traces join on one cycle id
     cycle_id: int = 0
+    # staged-latency attribution (sched.flightrecorder): total enqueue→pop
+    # wall accumulated across EVERY residency — first admission, backoff,
+    # unschedulable parks, requeue hops — on perf_counter (the lifecycle
+    # clock), independent of the queue's injectable backoff clock.
+    # ``enqueued_pc`` is the open residency's start (0 = not in a queue).
+    queue_wait_s: float = 0.0
+    enqueued_pc: float = 0.0
 
     @property
     def key(self) -> str:
@@ -141,7 +148,8 @@ class PriorityQueue:
             return
         now = self._clock()
         info = QueuedPodInfo(
-            pod=pod, timestamp=now, initial_attempt_timestamp=None
+            pod=pod, timestamp=now, initial_attempt_timestamp=None,
+            enqueued_pc=_time.perf_counter(),
         )
         self._enqueue_new(info)
 
@@ -240,6 +248,7 @@ class PriorityQueue:
         arriving meanwhile are replayed for them."""
         self.flush_backoff_completed()
         out: list[QueuedPodInfo] = []
+        now_pc = _time.perf_counter()
         while self._active_heap and len(out) < max_pods:
             sort_key, _, key = heapq.heappop(self._active_heap)
             info = self._active.get(key)
@@ -249,6 +258,11 @@ class PriorityQueue:
                 continue  # stale entry from before an update; the entry
                 # matching the current sort key is still in the heap
             del self._active[key]
+            if info.enqueued_pc:
+                # close this queue residency: backoff + park time all count
+                # as queue_wait in the staged latency vector
+                info.queue_wait_s += now_pc - info.enqueued_pc
+                info.enqueued_pc = 0.0
             info.attempts += 1
             if info.initial_attempt_timestamp is None:
                 info.initial_attempt_timestamp = self._clock()
@@ -288,6 +302,7 @@ class PriorityQueue:
             return "already-queued"
         info.unschedulable_plugins = frozenset(unschedulable_plugins)
         info.pending_plugins = frozenset(pending_plugins)
+        info.enqueued_pc = _time.perf_counter()   # a new queue residency opens
         if error:
             info.consecutive_errors += 1
         else:

@@ -11,6 +11,7 @@ binds stream out through the API dispatcher off the hot loop.
 
 from .api_dispatcher import APICall, APIDispatcher, BindCall, StatusPatchCall
 from .diagnostics import DiagnosticsServer
+from .flightrecorder import FlightRecorder
 from .scheduler import Scheduler, SchedulerMetrics
 
 __all__ = [
@@ -19,6 +20,7 @@ __all__ = [
     "BindCall",
     "StatusPatchCall",
     "DiagnosticsServer",
+    "FlightRecorder",
     "Scheduler",
     "SchedulerMetrics",
 ]

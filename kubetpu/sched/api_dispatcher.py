@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import queue as _queue
 import threading
+import time as _time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Protocol
 
@@ -62,6 +63,10 @@ class BindCall:
     # overrides the client's bind — an interested binder EXTENDER owns the
     # bind API call for its pods (schedule_one.go extendersBinding)
     bind_fn: Callable[[t.Pod, str], None] | None = None
+    # staged-latency stamp (sched.flightrecorder): perf_counter at API-phase
+    # start, set by execute_api on the worker thread — splits the bind span
+    # into dispatch (micro-batch queue wait) and bind_rtt (the round trip)
+    t_exec: float = field(default=0.0, compare=False)
     call_type: str = field(default="bind", init=False)
 
     @property
@@ -79,6 +84,8 @@ class BindCall:
         """Just the API write — the slice a bulk micro-batch replaces
         (``pre``/``post`` run per-call around it either way, so PreBind
         plugin effects are never re-applied by a bulk fallback)."""
+        if not self.t_exec:
+            self.t_exec = _time.perf_counter()
         if self.bind_fn is not None:
             self.bind_fn(self.pod, self.node_name)
         else:
@@ -356,6 +363,12 @@ class APIDispatcher:
                     continue
             ready.append(call)
         if len(ready) >= 2:
+            t_bulk = _time.perf_counter()
+            for call in ready:
+                # the bulk RPC IS these calls' API phase: stamp its start
+                # (the per-call fallback restamps nothing — first write wins)
+                if getattr(call, "t_exec", None) == 0.0:
+                    call.t_exec = t_bulk
             try:
                 errs = fn([spec[1](c) for c in ready])
                 if len(errs) != len(ready):

@@ -59,8 +59,16 @@ class _DiagHandler(BaseHTTPRequestHandler):
                 metrics_sources=(diag.metrics_text,),
                 health=diag.health,
                 extra={
-                    "/trace": lambda: (
+                    # non-destructive by contract: chrome_trace() snapshots;
+                    # a scrape never erases spans a concurrent exporter or
+                    # the flight recorder still needs (Tracer.drain is the
+                    # only consuming read, and it pops only its snapshot)
+                    "/trace": lambda q: (
                         "application/json", json.dumps(diag.trace_json())
+                    ),
+                    "/debug/flightrecorder": lambda q: (
+                        "application/json",
+                        json.dumps(diag.flightrecorder_json(q)),
                     ),
                 },
             )
@@ -160,6 +168,27 @@ class DiagnosticsServer:
         if self.scheduler is None:
             return {"traceEvents": [], "displayTimeUnit": "ms"}
         return self.scheduler.tracer.chrome_trace()
+
+    def flightrecorder_json(self, query: "dict | None" = None) -> dict:
+        """GET /debug/flightrecorder[?pod=ns/name][&limit=N]: the bounded
+        ring of per-pod decision records, newest first — what ``kubetpu
+        explain pod/<ns>/<name>`` renders."""
+        fr = getattr(self.scheduler, "flight_recorder", None)
+        if fr is None:
+            return {"enabled": False, "records": [], "count": 0}
+        q = query or {}
+
+        def one(name: str, default: str = "") -> str:
+            v = q.get(name, default)
+            return v[-1] if isinstance(v, list) else v
+
+        try:
+            limit = int(one("limit") or 256)
+        except ValueError:
+            limit = 256
+        out = fr.records_json(pod=one("pod") or None, limit=limit)
+        out["enabled"] = True
+        return out
 
     # -------------------------------------------------------------- control
     @property

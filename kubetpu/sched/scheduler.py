@@ -77,6 +77,9 @@ class _InflightCycle:
     # idle gap between loop ticks
     launch_s: float = 0.0
     pipelined: bool = False
+    # the encode span's wall — the staged latency vector's "encode" stage
+    # for every pod of this cycle (sched.flightrecorder)
+    encode_s: float = 0.0
 
 
 @dataclass
@@ -165,6 +168,7 @@ class Scheduler:
         encode_cache: bool = True,
         bulk: bool = True,
         mesh=None,
+        flight_recorder: bool = True,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -206,7 +210,15 @@ class Scheduler:
         add/delete) and both engines run SPMD with XLA-inserted collectives
         for the cross-shard argmax/sort — assignments are bit-identical to
         single-device, so ``mesh=None`` is a capacity choice, not a
-        semantics one."""
+        semantics one.
+        ``flight_recorder``: the scheduling flight recorder + per-pod
+        staged latency attribution (sched.flightrecorder): bounded ring of
+        per-pod decision records (win margin, top-k scores, per-plugin
+        filter rejections, requeue history) served at
+        /debug/flightrecorder and rendered by ``kubetpu explain``, plus
+        the scheduler_e2e_scheduling_duration_seconds{stage} histograms.
+        ``False`` (``--flight-recorder off``) is the overhead escape
+        hatch — decisions are unchanged either way."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
@@ -284,6 +296,18 @@ class Scheduler:
         # cycle; >100ms cycles log their step breakdown
         # (schedule_one.go:566-567's LogIfLong)
         self.tracer = Tracer()
+        # scheduling flight recorder + staged latency attribution (see the
+        # flight_recorder docstring above); None = off
+        if flight_recorder:
+            from .flightrecorder import FlightRecorder
+
+            self.flight_recorder: "FlightRecorder | None" = FlightRecorder()
+        else:
+            self.flight_recorder = None
+        # per-stage histogram children cached once: labels() takes the
+        # metric lock per call, and the bind-ack path observes 8 stages
+        # per pod — measured at ~14ms/1000 pods saved (overhead budget)
+        self._stage_children: dict[str, object] = {}
         self._snapshot = Snapshot()
         # previous cycle's NodeTensors — encode_snapshot refreshes only the
         # rows whose generation moved (O(Δ) per-cycle host encode)
@@ -496,8 +520,17 @@ class Scheduler:
             info = QueuedPodInfo(pod=pod, timestamp=self.clock())
             self.podgroups.add_pod(info)
         else:
+            fr = self.flight_recorder
+            t_deliver = time.perf_counter() if fr is not None else 0.0
             self.queue.add(pod)
             self._pre_encode_pod(pod)
+            if fr is not None:
+                # the informer stage: delivery wall incl. the event-time
+                # pre-encode (the e2e base in direct mode, where no
+                # apiserver ingest stamp exists)
+                fr.note_delivery(
+                    pod, t_deliver, time.perf_counter() - t_deliver
+                )
 
     def on_pod_update(self, old: t.Pod | None, new: t.Pod) -> None:
         if not new.node_name and self._profile_for(new) is None:
@@ -534,13 +567,24 @@ class Scheduler:
             # pod double-schedule against its own group lane
             self.podgroups.update_pod(new)
         else:
+            fr = self.flight_recorder
+            t_deliver = time.perf_counter() if fr is not None else 0.0
             self.queue.update(old, new)
             # a mutated pod hashes to NEW signature keys — pre-build its
             # rows now; the per-uid signature memo is identity-checked, so
             # the old object's entries can never answer for the new one
             self._pre_encode_pod(new)
+            if fr is not None:
+                # a pod FIRST seen through an update (informer replayed a
+                # mutation before its add) still opens a flight; for a
+                # known pod this only accrues informer-handling wall
+                fr.note_delivery(
+                    new, t_deliver, time.perf_counter() - t_deliver
+                )
 
     def on_pod_delete(self, pod: t.Pod) -> None:
+        if self.flight_recorder is not None:
+            self.flight_recorder.drop(pod_key(pod))
         self.nominator.remove(pod.uid)
         if self.encode_cache is not None:
             self.encode_cache.drop_pod(pod.uid)
@@ -790,6 +834,17 @@ class Scheduler:
             params = rt.score_params(self.profile, batch.resource_names)
             a, _ = self._assign_device(batch.device, params)
             jax.device_get(a)  # block until compiled + executed
+            if self.flight_recorder is not None and self.mesh is None:
+                # warm the recorder's explain kernel for the same shape —
+                # the first measured cycle must not pay its compile
+                try:
+                    from .flightrecorder import _explain_kernel
+
+                    jax.block_until_ready(
+                        _explain_kernel(batch.device, params, a)[0]
+                    )
+                except Exception:
+                    pass
 
     def prewarm(self, max_pods: int | None = None) -> None:
         """Warm the bucket ladder with synthetic constraint-free pods (the
@@ -1123,9 +1178,10 @@ class Scheduler:
                         )
             # the host encode builds per-pod state ahead of filtering —
             # the PreFilter role in the reference's extension-point map
+            encode_s = time.perf_counter() - t_enc
             prom.framework_extension_point_duration.labels(
                 "PreFilter", "Success", profile.name
-            ).observe(time.perf_counter() - t_enc)
+            ).observe(encode_s)
             self._prev_nt = batch.node_tensors
             with self.tracer.span("extenders", cycle=cycle_id):
                 device_batch = self._apply_extenders(batch, pods)
@@ -1153,6 +1209,7 @@ class Scheduler:
                 ),
                 launch_s=self.clock() - t0,
                 pipelined=pipelined,
+                encode_s=encode_s,
             )
         except Exception:
             self._requeue_error(batch_infos)
@@ -1273,6 +1330,25 @@ class Scheduler:
                 batch, inflight.params, inflight.final_state,
                 {info.key: k for k, info in enumerate(batch_infos)},
             )
+            if self.flight_recorder is not None:
+                try:
+                    # one decision record per pod, with the cycle-start
+                    # score/filter breakdown (skipped under a mesh: the
+                    # sharded batch is not re-evaluated for diagnostics)
+                    self.flight_recorder.note_cycle(
+                        batch=batch,
+                        device_batch=inflight.device_batch,
+                        params=inflight.params,
+                        batch_infos=batch_infos,
+                        idx=idx,
+                        cycle_id=cycle_id,
+                        profile=profile.name,
+                        encode_s=inflight.encode_s,
+                        kernel_s=kernel_wall_s,
+                        breakdown=self.mesh is None,
+                    )
+                except Exception:
+                    pass    # diagnostics must never fail the cycle
         except Exception:
             self._requeue_error(batch_infos)
             raise
@@ -1407,16 +1483,22 @@ class Scheduler:
     def _dispatch_bind(self, info: QueuedPodInfo, assumed: t.Pod) -> None:
         node_name = assumed.node_name
         t_dispatch = time.perf_counter()
+        # the BindCall stamps its own API-phase start (t_exec) on the
+        # worker thread; on_done reads it back through this cell so the
+        # staged vector can split dispatch-wait from the bind round trip
+        call_cell: list = []
 
         def on_done(
             err: Exception | None, info=info, assumed=assumed,
-            t_dispatch=t_dispatch,
+            t_dispatch=t_dispatch, call_cell=call_cell,
         ) -> None:
             # completion time stamped HERE on the dispatcher thread — the
             # loop drains later, and drain time would inflate the bind span
             # by up to a whole loop interval
+            t_exec = call_cell[0].t_exec if call_cell else 0.0
             self._bind_completions.append(
-                (info, assumed, err, t_dispatch, time.perf_counter())
+                (info, assumed, err, t_dispatch, t_exec,
+                 time.perf_counter())
             )
 
         lifecycle = self._lifecycle_for(info.pod)
@@ -1438,10 +1520,10 @@ class Scheduler:
             if e.is_binder() and e.is_interested(info.pod):
                 bind_fn = e.bind
                 break
-        self.dispatcher.add(
-            BindCall(info.pod, node_name, on_done=on_done, pre=pre, post=post,
-                     bind_fn=bind_fn)
-        )
+        call = BindCall(info.pod, node_name, on_done=on_done, pre=pre,
+                        post=post, bind_fn=bind_fn)
+        call_cell.append(call)
+        self.dispatcher.add(call)
 
     def _reject_assumed(self, info: QueuedPodInfo, assumed: t.Pod, st) -> None:
         """A Reserve/Permit rejection (or permit timeout): forget the assume
@@ -1452,9 +1534,13 @@ class Scheduler:
             self.podgroups.unmark_scheduled(info.pod)
             self.podgroups.requeue_member(info)
         else:
-            self.queue.add_unschedulable(
+            where = self.queue.add_unschedulable(
                 info, [st.plugin] if st.plugin else ()
             )
+            if self.flight_recorder is not None:
+                self.flight_recorder.note_requeue(
+                    info.key, where, [st.plugin] if st.plugin else (),
+                )
 
     # ---------------------------------------------------------- waiting pods
     def get_waiting_pod(self, key: str):
@@ -1493,7 +1579,7 @@ class Scheduler:
         this in the per-pod binding goroutine; we serialize into the cycle)."""
         while True:
             try:
-                info, assumed, err, t_dispatch, t_done = (
+                info, assumed, err, t_dispatch, t_exec, t_done = (
                     self._bind_completions.popleft()
                 )
             except IndexError:
@@ -1507,6 +1593,22 @@ class Scheduler:
                 cycle=getattr(info, "cycle_id", 0), pod=info.key,
                 status="error" if err is not None else "bound",
             )
+            fr = self.flight_recorder
+            if fr is not None:
+                stages = fr.note_bind(info, err, t_dispatch, t_exec, t_done)
+                if stages:
+                    # the per-pod staged latency vector lands in the
+                    # {stage} histograms at bind ack — the staged p50/p99
+                    # every fullstack bench record carries
+                    children = self._stage_children
+                    for stage, seconds in stages.items():
+                        child = children.get(stage)
+                        if child is None:
+                            child = children[stage] = (
+                                self.metrics.prom.e2e_scheduling_duration
+                                .labels(stage)
+                            )
+                        child.observe(seconds)
             if err is None:
                 self.cache.finish_binding(assumed.uid)
                 self.queue.done(info.key)
@@ -1534,7 +1636,9 @@ class Scheduler:
                     self.podgroups.unmark_scheduled(info.pod)
                     self.podgroups.requeue_member(info)
                 else:
-                    self.queue.add_unschedulable(info, error=True)
+                    where = self.queue.add_unschedulable(info, error=True)
+                    if fr is not None:
+                        fr.note_requeue(info.key, where, error=True)
 
     def _handle_unschedulable(
         self, info: QueuedPodInfo, profile: C.Profile | None = None
@@ -1547,19 +1651,31 @@ class Scheduler:
         per node, schedule_one.go FitError) — over-eager wake-ups are safe;
         the leftover flush bounds staleness either way."""
         profile = profile or self._profile_for(info.pod) or self.profile
+        fr = self.flight_recorder
         if self._post_filter is not None:
             nominated = self._post_filter(self, info)
             if nominated is not None:
                 # preemption nominated a node: victims' deletes will fire
                 # hints; pod waits in backoff for the room to open
                 info.nominated_node_name = nominated
-                self.queue.add_unschedulable(
+                where = self.queue.add_unschedulable(
                     info, profile.filters.names()
                 )
+                if fr is not None:
+                    fr.note_requeue(
+                        info.key, where, profile.filters.names(),
+                        nominated=nominated,
+                    )
+                    fr.note_preemption(
+                        info.key, nominated,
+                        self._preempting.get(info.key, ()),
+                    )
                 return
         where = self.queue.add_unschedulable(
             info, profile.filters.names()
         )
+        if fr is not None:
+            fr.note_requeue(info.key, where, profile.filters.names())
         if where not in ("deleted", "already-queued"):
             # only patch status for pods that still exist and we own
             self.dispatcher.add(

@@ -164,9 +164,22 @@ class Tracer:
         return self._snapshot_spans()[-n:]
 
     def drain(self) -> list[Span]:
-        """Hand the buffered spans to an exporter and clear the buffer."""
+        """Hand the buffered spans to an exporter and remove EXACTLY those
+        spans from the buffer. A bare ``clear()`` here would erase spans
+        recorded between the snapshot and the clear (the loop thread
+        records while an exporter drains) — those must survive for the
+        next drain and for concurrent readers (``/trace``, the flight
+        recorder), so only the snapshotted prefix is popped."""
         out = self._snapshot_spans()
-        self._spans.clear()
+        drained = {id(s) for s in out}
+        while True:
+            try:
+                head = self._spans[0]
+            except IndexError:
+                break
+            if id(head) not in drained:
+                break            # a newer span reached the head: stop
+            self._spans.popleft()
         return out
 
     # ---- export ----------------------------------------------------------

@@ -1,0 +1,17 @@
+"""Known-bad: literal label value outside the declared set (MR004)."""
+
+DEMO_STAGES = ("queue_wait", "kernel", "bind_rtt")
+
+
+class StagedMetrics:
+    def __init__(self, r) -> None:
+        self.stage_duration = r.histogram(
+            "demo_staged_duration_seconds",
+            "staged latency",
+            labels=("stage",),
+            declared={"stage": DEMO_STAGES},
+        )
+
+    def track(self, wall_s: float) -> None:
+        self.stage_duration.labels("kernel").observe(wall_s)
+        self.stage_duration.labels("bind_rt").observe(wall_s)  # expect: MR004

@@ -1,0 +1,18 @@
+"""Known-good: every literal label value is a member of the declared set;
+variable values are left to the registry's runtime check."""
+
+GOOD_STAGES = ("encode", "dispatch")
+
+
+class CleanStagedMetrics:
+    def __init__(self, r) -> None:
+        self.clean_stage_duration = r.histogram(
+            "demo_clean_staged_duration_seconds",
+            "staged latency",
+            labels=("stage",),
+            declared={"stage": GOOD_STAGES},
+        )
+
+    def track(self, stage: str, wall_s: float) -> None:
+        self.clean_stage_duration.labels("encode").observe(wall_s)
+        self.clean_stage_duration.labels(stage).observe(wall_s)   # runtime-checked

@@ -84,6 +84,84 @@ def _fail_on_thread_death():
 
 
 # ---------------------------------------------------------------------------
+# /metrics scrape lint: histogram + label-shape consistency
+# ---------------------------------------------------------------------------
+
+def assert_metrics_consistent(text: str) -> None:
+    """Validate one Prometheus exposition page the way a scrape consumer
+    would: per histogram child the bucket counts are cumulative
+    (monotonically non-decreasing in ``le``), the ``+Inf`` bucket equals
+    ``_count``, ``_sum`` is present (and non-negative when every bucket
+    bound is), and within a family every sample carries the same label-name
+    set (arity vs declaration). Every observability/apiserver test that
+    scrapes /metrics runs its page through this (the ``metrics_lint``
+    fixture), so a torn histogram or label drift fails the suite instead
+    of a dashboard."""
+    import math
+
+    from kubetpu.metrics.textparse import parse_prometheus_text
+
+    pm = parse_prometheus_text(text)
+    for name, fam in pm.families.items():
+        # label arity: one name set per sample name within the family
+        # (histogram suffixes differ legitimately: _bucket adds "le")
+        arity: dict[str, set] = {}
+        for s in fam.samples:
+            keys = frozenset(k for k, _ in s.labels)
+            arity.setdefault(s.name, set()).add(keys)
+        for sample_name, shapes in arity.items():
+            assert len(shapes) == 1, (
+                f"{sample_name}: inconsistent label sets {shapes}"
+            )
+        if fam.kind != "histogram":
+            continue
+        # group _bucket/_sum/_count by their non-le label set (the child)
+        children: dict[tuple, dict] = {}
+        for s in fam.samples:
+            key = tuple(sorted((k, v) for k, v in s.labels if k != "le"))
+            child = children.setdefault(
+                key, {"buckets": [], "sum": None, "count": None}
+            )
+            if s.name == name + "_bucket":
+                le = dict(s.labels).get("le")
+                assert le is not None, f"{name}_bucket without le ({key})"
+                bound = math.inf if le == "+Inf" else float(le)
+                child["buckets"].append((bound, s.value))
+            elif s.name == name + "_sum":
+                child["sum"] = s.value
+            elif s.name == name + "_count":
+                child["count"] = s.value
+        for key, child in children.items():
+            assert child["buckets"], f"{name}{dict(key)}: no buckets"
+            assert child["sum"] is not None, f"{name}{dict(key)}: no _sum"
+            assert child["count"] is not None, f"{name}{dict(key)}: no _count"
+            ordered = sorted(child["buckets"])
+            counts = [c for _, c in ordered]
+            assert counts == sorted(counts), (
+                f"{name}{dict(key)}: bucket counts not cumulative: {ordered}"
+            )
+            assert ordered[-1][0] == math.inf, (
+                f"{name}{dict(key)}: missing +Inf bucket"
+            )
+            assert ordered[-1][1] == child["count"], (
+                f"{name}{dict(key)}: +Inf bucket {ordered[-1][1]} != "
+                f"_count {child['count']}"
+            )
+            if ordered[-1][1] > 0 and ordered[0][0] >= 0:
+                assert child["sum"] >= 0, (
+                    f"{name}{dict(key)}: negative _sum with non-negative "
+                    f"bounds"
+                )
+
+
+@pytest.fixture
+def metrics_lint():
+    """The /metrics consistency validator as a fixture — scrape-heavy
+    tests run every exposition page they fetch through it."""
+    return assert_metrics_consistent
+
+
+# ---------------------------------------------------------------------------
 # lock-order witness for the concurrency-heavy suites
 # ---------------------------------------------------------------------------
 #: modules whose tests create MemStore/informer/dispatcher/reflector locks

@@ -1,0 +1,220 @@
+"""``kubetpu benchdiff`` — the bench-ladder regression gate (tier-1):
+exits non-zero on an injected throughput or staged-p99 regression, zero on
+the committed BENCH_r04→r05 pair; parses all three record shapes; and the
+rounding/window-scoping satellites (one rounding site, one directly-tested
+p99 helper)."""
+
+import json
+import os
+
+import pytest
+
+from kubetpu.benchdiff import (
+    BenchDiffError,
+    compare,
+    load_record,
+    main,
+    parse_bench_lines,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _line(metric, value=1000.0, p99=50.0, staged=None, **extra):
+    out = {
+        "metric": metric, "value": value, "unit": "pods/s",
+        "p99_attempt_latency_ms": p99,
+    }
+    if staged is not None:
+        out["staged_latency_ms"] = staged
+    out.update(extra)
+    return out
+
+
+def _write(tmp_path, name, lines):
+    p = tmp_path / name
+    p.write_text("\n".join(json.dumps(ln) for ln in lines) + "\n")
+    return str(p)
+
+
+# ------------------------------------------------------------ tier-1 gates
+
+def test_committed_r04_r05_pair_exits_zero(capsys):
+    rc = main([
+        os.path.join(REPO, "BENCH_r04.json"),
+        os.path.join(REPO, "BENCH_r05.json"),
+    ])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "0 regression(s)" in out
+
+
+def test_injected_throughput_regression_exits_nonzero(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", [_line("A", 1000.0), _line("B", 500.0)])
+    new = _write(tmp_path, "new.json", [_line("A", 400.0), _line("B", 490.0)])
+    rc = main([old, new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "REGRESSION" in out and "A throughput" in out
+    # B moved -2%: inside the noise tolerance
+    assert "B throughput" in out
+
+
+def test_injected_staged_p99_regression_exits_nonzero(tmp_path, capsys):
+    staged_old = {"kernel": {"p50": 1.0, "p99": 20.0},
+                  "e2e": {"p50": 5.0, "p99": 40.0}}
+    staged_new = {"kernel": {"p50": 1.0, "p99": 21.0},
+                  "e2e": {"p50": 5.0, "p99": 400.0}}
+    old = _write(tmp_path, "old.json", [_line("A", staged=staged_old)])
+    new = _write(tmp_path, "new.json", [_line("A", staged=staged_new)])
+    rc = main([old, new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "staged_p99_ms.e2e" in out and "REGRESSION" in out
+    # kernel grew 5% / 1ms: below both the ratio and absolute floors
+    deltas, _, _ = compare(load_record(old), load_record(new))
+    by_field = {d.field: d for d in deltas}
+    assert not by_field["staged_p99_ms.kernel"].regression
+    assert by_field["staged_p99_ms.e2e"].regression
+
+
+def test_error_in_new_record_is_a_regression(tmp_path):
+    old = _write(tmp_path, "old.json", [_line("A")])
+    new = _write(tmp_path, "new.json", [
+        {"metric": "A", "value": 0.0, "unit": "pods/s",
+         "error": "RuntimeError: boom"},
+    ])
+    assert main([old, new]) == 1
+    # the reverse direction (was broken, still broken / now fixed) is fine
+    assert main([new, old]) == 0
+
+
+def test_p99_absolute_floor_suppresses_small_wobbles(tmp_path):
+    old = _write(tmp_path, "old.json", [_line("A", p99=2.0)])
+    new = _write(tmp_path, "new.json", [_line("A", p99=6.0)])   # +200%, 4ms
+    assert main([old, new]) == 0
+    new2 = _write(tmp_path, "new2.json", [_line("A", p99=60.0)])
+    assert main([old, new2]) == 1
+
+
+def test_cli_subcommand_dispatch(tmp_path, capsys):
+    from kubetpu.cli import main as cli_main
+
+    old = _write(tmp_path, "old.json", [_line("A")])
+    new = _write(tmp_path, "new.json", [_line("A", value=100.0)])
+    rc = cli_main(["benchdiff", "--json", old, new])
+    report = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert report["regressions"] == 1
+
+
+# ------------------------------------------------------------ record shapes
+
+def test_parses_driver_wrapper_ndjson_and_array(tmp_path):
+    lines = [_line("A"), _line("B", 2.0)]
+    # driver wrapper: JSON lines interleaved with status noise in "tail"
+    tail = "## bench: starting\n" + "\n".join(
+        json.dumps(ln) for ln in lines
+    ) + "\ngarbage {not json}\n"
+    wrapper = tmp_path / "wrap.json"
+    wrapper.write_text(json.dumps(
+        {"n": 9, "rc": 0, "tail": tail, "parsed": lines[-1]}
+    ))
+    rec = load_record(str(wrapper))
+    assert set(rec) == {"A", "B"}
+    # ndjson
+    nd = _write(tmp_path, "nd.json", lines)
+    assert set(load_record(nd)) == {"A", "B"}
+    # array
+    arr = tmp_path / "arr.json"
+    arr.write_text(json.dumps(lines))
+    assert set(load_record(str(arr))) == {"A", "B"}
+    # empty/invalid fails loudly with exit 2 through main
+    bad = tmp_path / "bad.json"
+    bad.write_text("no records here\n")
+    with pytest.raises(BenchDiffError):
+        load_record(str(bad))
+    assert main([str(bad), nd]) == 2
+
+
+def test_truncated_tail_lines_are_skipped_not_fatal():
+    text = '{"metric": "A", "value": 1.0, "unit"'   # truncated mid-line
+    assert parse_bench_lines(text) == {}
+    text2 = text + '\n{"metric": "B", "value": 2.0, "unit": "pods/s"}'
+    assert set(parse_bench_lines(text2)) == {"B"}
+
+
+# ------------------------------------------------- rounding + p99 satellites
+
+def test_single_rounding_site_for_latency():
+    """Satellite: runner.to_json and bench stage lines round through ONE
+    helper — identical inputs produce identical persisted values, so
+    benchdiff never sees phantom rounding deltas."""
+    from kubetpu.perf.runner import WorkloadResult, round_latency_ms
+
+    assert round_latency_ms(None) is None
+    assert round_latency_ms(39.6789) == 39.68
+    r = WorkloadResult(
+        case_name="c", workload_name="w", threshold=None, measure_pods=1,
+        scheduled=1, duration_s=1.0, throughput=1.0, vs_threshold=None,
+        attempts=1, cycles=1, p99_attempt_latency_ms=39.6789,
+    )
+    assert r.to_json()["p99_attempt_latency_ms"] == round_latency_ms(39.6789)
+    # bench.py routes through the same helper (source-level pin: the old
+    # second rounding site is gone)
+    import inspect
+
+    import bench
+
+    src = inspect.getsource(bench.run_stage)
+    assert "round_latency_ms" in src
+    assert "round(r.p99_attempt_latency_ms" not in src
+
+
+def test_measured_p99_helper_scopes_to_window():
+    """Satellite: the p99 window-scoping rule ('a large init phase must
+    not dominate the reported p99s') extracted into a directly-tested
+    helper shared by both runner call sites and the staged percentiles."""
+    from kubetpu.metrics import SchedulerMetricsRegistry, window_quantile_ms
+
+    m = SchedulerMetricsRegistry()
+    h = m.pod_scheduling_sli_duration
+    for _ in range(100):
+        h.labels("1").observe(10.0)        # the init phase: huge latencies
+    base = m.snapshot_baseline()
+    for _ in range(100):
+        h.labels("1").observe(0.010)       # the measured phase: 10ms
+    windowed = window_quantile_ms(h, base["sli_duration"], 0.99)
+    unscoped = window_quantile_ms(h, None, 0.99)
+    assert windowed < 100.0 < unscoped     # init excluded vs dominated
+    # empty window → None, not NaN
+    base2 = m.snapshot_baseline()
+    assert window_quantile_ms(h, base2["sli_duration"], 0.99) is None
+
+    # the runner's wrapper applies exactly this scoping
+    from kubetpu.perf.runner import measured_p99_ms
+
+    class FakeSched:
+        class metrics:
+            class prom:
+                pod_scheduling_sli_duration = h
+
+    assert measured_p99_ms(FakeSched, None) is None
+    got = measured_p99_ms(FakeSched, base)
+    assert got == pytest.approx(windowed)
+
+
+def test_staged_percentiles_window_scoped():
+    from kubetpu.metrics import SchedulerMetricsRegistry
+
+    m = SchedulerMetricsRegistry()
+    h = m.e2e_scheduling_duration
+    h.labels("kernel").observe(5.0)            # init-phase outlier
+    base = m.snapshot_baseline()
+    for _ in range(10):
+        h.labels("kernel").observe(0.001)
+        h.labels("e2e").observe(0.004)
+    staged = m.staged_percentiles(base)
+    assert set(staged) == {"kernel", "e2e"}
+    assert staged["kernel"]["p99"] < 100.0     # the 5s outlier is excluded
+    assert m.staged_percentiles(m.snapshot_baseline()) is None

@@ -60,6 +60,7 @@ def test_golden_scheduler_metric_names_and_buckets():
         "scheduler_preemption_victims",
         "scheduler_pending_pods",
         "scheduler_queue_incoming_pods_total",
+        "scheduler_e2e_scheduling_duration_seconds",
     } <= names
     assert m.scheduling_attempt_duration.buckets == exponential_buckets(0.001, 2, 15)
     assert m.pod_scheduling_sli_duration.buckets == exponential_buckets(0.01, 2, 20)
@@ -69,6 +70,15 @@ def test_golden_scheduler_metric_names_and_buckets():
         "plugin", "extension_point", "status",
     )
     assert m.preemption_victims.buckets == exponential_buckets(1, 2, 7)
+    # the staged-latency vector: {stage} label declared to exactly the
+    # attribution stages — an unknown stage value is rejected at emission
+    from kubetpu.metrics import E2E_STAGES
+
+    assert m.e2e_scheduling_duration.label_names == ("stage",)
+    assert m.e2e_scheduling_duration.declared == {"stage": E2E_STAGES}
+    m.e2e_scheduling_duration.labels("queue_wait").observe(0.01)
+    with pytest.raises(ValueError, match="declared set"):
+        m.e2e_scheduling_duration.labels("bind_rt")
 
 
 def test_golden_workqueue_and_apiserver_metric_names():
@@ -209,7 +219,7 @@ def _get(url: str) -> tuple[int, str]:
         return e.code, e.read().decode()
 
 
-def test_apiserver_serves_metrics_and_health():
+def test_apiserver_serves_metrics_and_health(metrics_lint):
     from kubetpu.api import scheme
     from kubetpu.apiserver import APIServer
 
@@ -225,6 +235,7 @@ def test_apiserver_serves_metrics_and_health():
 
         status, text = _get(srv.url + "/metrics")
         assert status == 200
+        metrics_lint(text)
         pm = parse_prometheus_text(text)
         assert pm.value(
             "apiserver_request_total", verb="CREATE", resource="pods",
@@ -392,8 +403,9 @@ def _run_cycles(n_pods: int = 3):
     return s, client
 
 
-def test_scheduler_exposes_tpu_and_plugin_metrics():
+def test_scheduler_exposes_tpu_and_plugin_metrics(metrics_lint):
     s, _ = _run_cycles()
+    metrics_lint(s.metrics_text())
     pm = parse_prometheus_text(s.metrics_text())
     assert pm.value("tpu_batch_size_count", engine="greedy") == 1
     assert pm.value("tpu_host_to_device_transfer_bytes_total",
@@ -475,7 +487,7 @@ def test_tracer_record_out_of_stack_span():
     assert ev[0]["args"]["cycle"] == 7
 
 
-def test_diagnostics_listener_serves_metrics_health_trace():
+def test_diagnostics_listener_serves_metrics_health_trace(metrics_lint):
     from kubetpu.sched import DiagnosticsServer
 
     s, _ = _run_cycles()
@@ -483,6 +495,7 @@ def test_diagnostics_listener_serves_metrics_health_trace():
     try:
         status, text = _get(diag.url + "/metrics")
         assert status == 200
+        metrics_lint(text)
         pm = parse_prometheus_text(text)
         assert "scheduler_schedule_attempts_total" in pm
         assert "tpu_batch_size" in pm
@@ -497,6 +510,10 @@ def test_diagnostics_listener_serves_metrics_health_trace():
         assert {e["name"] for e in json.loads(text)["traceEvents"]} >= {
             "scheduling-cycle"
         }
+        # satellite: a /trace scrape is NON-destructive — a second scrape
+        # (and any concurrent exporter) still sees every span
+        status, text2 = _get(diag.url + "/trace")
+        assert status == 200 and json.loads(text2) == json.loads(text)
 
         # informer-synced is a READINESS check: not ready until synced,
         # alive throughout
@@ -618,7 +635,7 @@ def test_queue_controller_wires_default_provider():
 
 # ------------------------------------------------------ perf artifacts/bench
 
-def test_perf_runner_dumps_diagnosis_artifacts(tmp_path):
+def test_perf_runner_dumps_diagnosis_artifacts(tmp_path, metrics_lint):
     from kubetpu.perf import run_workload
     from kubetpu.perf.workloads import Workload
 
@@ -628,6 +645,13 @@ def test_perf_runner_dumps_diagnosis_artifacts(tmp_path):
         timeout_s=120, artifacts_dir=str(tmp_path),
     )
     assert r.scheduled == 20
+    # staged per-pod percentiles ride every record (measured-window scoped)
+    assert r.staged_latency_ms is not None
+    assert {"queue_wait", "encode", "kernel", "bind_rtt", "e2e"} <= set(
+        r.staged_latency_ms
+    )
+    for stage, pcts in r.staged_latency_ms.items():
+        assert pcts["p50"] <= pcts["p99"] + 1e-9, stage
     # the embedded snapshot is the bench JSON's self-diagnosis
     snap = r.metrics_snapshot
     assert snap is not None
@@ -646,11 +670,16 @@ def test_perf_runner_dumps_diagnosis_artifacts(tmp_path):
     )
     assert records and {rec["cycle"] for rec in records} <= cycle_ids
     # metrics snapshot parses as exposition text with the scheduler set
-    pm = parse_prometheus_text(
-        (tmp_path / r.artifacts["metrics"].split("/")[-1]).read_text()
-    )
+    # AND passes the scrape-consistency lint (satellite: every /metrics
+    # page the suite produces is histogram-consistent)
+    metrics_text = (
+        tmp_path / r.artifacts["metrics"].split("/")[-1]
+    ).read_text()
+    metrics_lint(metrics_text)
+    pm = parse_prometheus_text(metrics_text)
     assert "scheduler_schedule_attempts_total" in pm
     assert "tpu_batch_size" in pm
+    assert "scheduler_e2e_scheduling_duration_seconds" in pm
 
 
 # ------------------------------------------------------------- satellites

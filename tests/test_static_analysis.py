@@ -85,7 +85,7 @@ def test_every_checker_registered_and_documented():
     codes = {c.code for c in all_checkers()}
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
-        "MR001", "MR002", "MR003", "TS001", "TS002",
+        "MR001", "MR002", "MR003", "MR004", "TS001", "TS002",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -116,8 +116,8 @@ def test_fixture_violations_match_markers_exactly():
 
 @pytest.mark.parametrize("good", [
     "lock_good.py", "ops/jit_good.py", "sched/donate_good.py",
-    "state/transfer_good.py", "metrics_good.py", "spans_good.py",
-    "cross/owner.py",
+    "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
+    "spans_good.py", "cross/owner.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
