@@ -102,6 +102,20 @@ STAGES = [
 TOTAL_BUDGET_S = 1500.0     # skip remaining stages past this
 STAGE_TIMEOUT_S = 300.0     # per-phase settle timeout inside the runner
 
+# --- active-active federation ladder (sched.federation) --------------------
+# N full scheduler replicas (each on its own loop thread) against ONE
+# in-process apiserver, on the r05-judged fullstack row: the HA scaling
+# curve ROADMAP item 3 has named since PR 6. The race-mode ladder measures
+# conflict rate vs throughput as overlap grows (1 replica = the ladder's
+# baseline); the recovery stage kills a replica mid-bench and measures the
+# survivors re-absorbing its partition. Runs on BOTH backends (the shape is
+# already the CPU-fallback row), AFTER every previously-judged stage — its
+# own budget so the required FederationScaling_* evidence always lands.
+FEDERATION_CASE = ("SchedulingBasic", "500Nodes", "greedy", 128)
+FEDERATION_LADDER = (1, 2, 4)
+FEDERATION_MODE = "race"
+FEDERATION_BUDGET_S = 420.0
+
 QUADRATIC = {"SchedulingPodAffinity", "TopologySpreading"}
 
 
@@ -496,6 +510,152 @@ def _emit_sharding_comparisons(done: dict) -> None:
         _emit(line)
 
 
+def _federation_record(r, case: str, workload: str, engine: str) -> dict:
+    """One bench line for a federated run (the per-N evidence rows the
+    FederationScaling lines are derived from)."""
+    out = {
+        "metric": (
+            f"{case}_{workload}_{engine}_fullstack_"
+            f"{r.replicas}sched_{r.partition}"
+        ),
+        "value": round(r.throughput, 1),
+        "unit": "pods/s",
+        "vs_baseline": (
+            round(r.vs_threshold, 2) if r.vs_threshold is not None else None
+        ),
+        "threshold": r.threshold,
+        "scheduled": r.scheduled,
+        "measure_pods": r.measure_pods,
+        "duration_s": round(r.duration_s, 2),
+        "cycles": r.cycles,
+        "engine": engine,
+        "mode": "fullstack",
+        "backend": _backend(),
+        "replicas": r.replicas,
+        "partition": r.partition,
+        "conflicts": r.conflicts,
+        "conflict_rate": round(r.conflict_rate or 0.0, 4),
+        "binding_parity": r.binding_parity,
+    }
+    if r.threshold_note:
+        out["threshold_note"] = r.threshold_note
+    if r.rpcs_per_scheduled_pod is not None:
+        out["rpcs_per_scheduled_pod"] = round(r.rpcs_per_scheduled_pod, 4)
+    if r.lease_transitions:
+        out["lease_transitions"] = r.lease_transitions
+    if r.recovery_s is not None:
+        out["recovery_s"] = round(r.recovery_s, 3)
+    return out
+
+
+def _run_federation_stages() -> None:
+    """The federation ladder + recovery stage: per-N bench rows, one
+    FederationScaling_* line per rung (throughput speedup vs 1 replica,
+    conflict rate, binding parity), and one FederationRecovery_* line from
+    the replica-kill stage."""
+    from kubetpu.perf.runner import run_workload_federated
+
+    case, workload, engine, max_batch = FEDERATION_CASE
+    t0 = time.perf_counter()
+    ladder: dict[int, dict] = {}
+    for n in FEDERATION_LADDER:
+        if time.perf_counter() - t0 > FEDERATION_BUDGET_S:
+            _status(f"federation budget exhausted; skipping {n}sched")
+            continue
+        _status(f"federation stage: {n} replica(s), {FEDERATION_MODE}")
+        try:
+            r = run_workload_federated(
+                case, workload, replicas=n, partition=FEDERATION_MODE,
+                engine=engine, max_batch=max_batch,
+                timeout_s=STAGE_TIMEOUT_S,
+            )
+        except Exception as e:
+            _emit({
+                "metric": (
+                    f"{case}_{workload}_{engine}_fullstack_"
+                    f"{n}sched_{FEDERATION_MODE}"
+                ),
+                "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                "engine": engine, "mode": "fullstack",
+                "backend": _backend(), "replicas": n,
+                "partition": FEDERATION_MODE,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            continue
+        line = _federation_record(r, case, workload, engine)
+        ladder[n] = line
+        _emit(line)
+    base = ladder.get(1)
+    for n in FEDERATION_LADDER:
+        line = ladder.get(n)
+        if line is None:
+            continue
+        scaling = {
+            "metric": (
+                f"FederationScaling_{case}_{workload}_"
+                f"{FEDERATION_MODE}_{n}sched"
+            ),
+            "unit": "ratio",
+            "replicas": n,
+            "partition": FEDERATION_MODE,
+            "backend": _backend(),
+            "throughput": line["value"],
+            "conflicts": line["conflicts"],
+            "conflict_rate": line["conflict_rate"],
+            "binding_parity": line["binding_parity"],
+            "measure_pods": line["measure_pods"],
+        }
+        if base and base.get("value"):
+            scaling["value"] = round(line["value"] / base["value"], 3)
+            scaling["throughput_speedup"] = scaling["value"]
+            scaling["baseline_throughput"] = base["value"]
+        else:
+            scaling["value"] = None
+        _emit(scaling)
+    # recovery stage: 2 replicas, hash partition (the dead replica's rank
+    # re-absorbs immediately — the recovery time measures the survivors'
+    # re-adoption + rescheduling, not a lease expiry floor), kill at 50%
+    if time.perf_counter() - t0 <= FEDERATION_BUDGET_S:
+        _status("federation stage: replica-kill recovery (2sched, hash)")
+        try:
+            r = run_workload_federated(
+                case, workload, replicas=2, partition="hash",
+                engine=engine, max_batch=max_batch,
+                timeout_s=STAGE_TIMEOUT_S, kill_replica_at=0.5,
+            )
+            _emit({
+                "metric": (
+                    f"FederationRecovery_{case}_{workload}_hash_2sched"
+                ),
+                "unit": "s",
+                "value": (
+                    round(r.recovery_s, 3)
+                    if r.recovery_s is not None else None
+                ),
+                "recovery_s": (
+                    round(r.recovery_s, 3)
+                    if r.recovery_s is not None else None
+                ),
+                "throughput": round(r.throughput, 1),
+                "scheduled": r.scheduled,
+                "measure_pods": r.measure_pods,
+                "binding_parity": r.binding_parity,
+                "all_rescheduled": r.binding_parity == r.measure_pods,
+                "conflicts": r.conflicts,
+                "replicas": 2,
+                "partition": "hash",
+                "backend": _backend(),
+            })
+        except Exception as e:
+            _emit({
+                "metric": (
+                    f"FederationRecovery_{case}_{workload}_hash_2sched"
+                ),
+                "unit": "s", "value": None, "backend": _backend(),
+                "error": f"{type(e).__name__}: {e}",
+            })
+
+
 def main() -> None:
     global STAGES
     probe, probe_s = _probe_backend()
@@ -605,6 +765,7 @@ def main() -> None:
     _emit_sharding_comparisons(mesh_pairs)
     _emit_flightrecorder_comparisons(fr_pairs)
     _emit_soak_lines(all_lines)
+    _run_federation_stages()
     final = best_quadratic or best_any
     if final is None:
         _emit({

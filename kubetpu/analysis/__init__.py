@@ -17,6 +17,7 @@ Checker catalog (``--explain CODE`` prints the full rationale):
 - HT001/HT002        hot-path transfer — device traffic only at the seams
 - MR001/MR002/MR003  metrics-registry consistency
 - TS001/TS002        trace-span balance — spans close on exception paths
+- CL001              injectable-clock discipline in lease/backoff code
 
 Import surface: ``analyze_paths`` runs the suite programmatically (the
 tier-1 test ``tests/test_static_analysis.py`` gates on it), ``CHECKERS``
@@ -41,3 +42,4 @@ from . import donation  # noqa: F401,E402
 from . import transfer  # noqa: F401,E402
 from . import metriccheck  # noqa: F401,E402
 from . import spancheck  # noqa: F401,E402
+from . import clockcheck  # noqa: F401,E402

@@ -1,0 +1,117 @@
+"""Injectable-clock discipline checker (CL001).
+
+The lease and backoff machinery is time-driven: the queue's exponential
+backoff, the pod-group gate, leader election's acquire/renew/expire, and
+the federation's partition-lease handover all judge expiry against a
+clock. Every one of those paths takes an injectable ``clock`` callable
+(defaulting to ``sched.leaderelection.default_clock``) precisely so the
+federation/lease tests can STEP time deterministically — a single bare
+``time.monotonic()`` (or ``time.time()``) inside one of these files
+splits the code onto two clocks: the stepped test clock says the lease is
+expired while the wall clock says it is fresh, and the steal/handover
+paths become untestable flakes. ``time.perf_counter()`` is exempt — it is
+the lifecycle-latency clock (flight recorder stamps), deliberately
+independent of the backoff clock (see ``QueuedPodInfo.queue_wait_s``).
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from .astutil import dotted
+from .core import Checker, ModuleInfo, Violation, register
+
+#: the lease/backoff code paths the invariant covers (basenames); the
+#: ``clock_*`` pattern admits the test fixtures
+_SCOPE_BASENAMES = {
+    "leaderelection.py",
+    "federation.py",
+    "priority_queue.py",
+    "podgroup.py",
+}
+
+#: the wall-clock functions of the ``time`` module that bypass the seam
+#: (perf_counter is the separate lifecycle clock — exempt by design)
+_WALL_FUNCS = {"monotonic", "time"}
+
+
+@register
+class BareWallClock(Checker):
+    code = "CL001"
+    title = "bare wall-clock call in lease/backoff code"
+    rationale = (
+        "Lease renewal/expiry and queue backoff are judged against an "
+        "INJECTABLE clock (the `clock` parameter threaded through "
+        "PriorityQueue, PodGroupManager, LeaderElector, "
+        "PartitionLeaseManager and SchedulerFederation, defaulting to "
+        "sched.leaderelection.default_clock). Calling time.monotonic() "
+        "or time.time() directly inside these files splits the logic "
+        "onto two clocks: a federation test stepping the injected clock "
+        "past the lease duration would see the bare-clock half still "
+        "reading fresh wall time — acquire/renew/expire/steal and the "
+        "bounded handover window become untestable, and a real "
+        "deployment mixing the two clocks mis-times backoff under clock "
+        "adjustment. Referencing the function as a DEFAULT "
+        "(`clock: Callable = time.monotonic`) is the seam itself and is "
+        "fine; time.perf_counter() is the separate lifecycle-latency "
+        "clock and is exempt by design."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        base = posixpath.basename(relpath)
+        return base in _SCOPE_BASENAMES or (
+            base.startswith("clock_") and base.endswith(".py")
+        )
+
+    def collect(self, mod: ModuleInfo):
+        # resolve how this module can reach the time module: plain and
+        # aliased `import time` (incl. the conventional `_time`), and
+        # from-imports of the wall-clock functions themselves — an alias
+        # (`import time as tm` / `from time import monotonic as mono`)
+        # must not evade the gate
+        module_aliases = {"time", "_time"}
+        from_imports: dict[str, str] = {}   # local name -> time.<func>
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "time":
+                        module_aliases.add(a.asname or "time")
+            elif isinstance(node, ast.ImportFrom) and node.module == "time":
+                for a in node.names:
+                    if a.name in _WALL_FUNCS:
+                        from_imports[a.asname or a.name] = f"time.{a.name}"
+        out: list[Violation] = []
+        # enclosing function names for the violation symbol
+        parents: dict[int, str] = {}
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    parents.setdefault(id(sub), fn.name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = ""
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in module_aliases
+                and f.attr in _WALL_FUNCS
+            ):
+                name = dotted(f) or f"{f.value.id}.{f.attr}"
+            elif isinstance(f, ast.Name) and f.id in from_imports:
+                name = from_imports[f.id]
+            if not name:
+                continue
+            out.append(Violation(
+                path=mod.relpath, line=node.lineno, code=self.code,
+                symbol=parents.get(id(node), ""),
+                message=(
+                    f"bare {name}() in lease/backoff code — read time "
+                    "through the injected clock (the seam defaulting to "
+                    "sched.leaderelection.default_clock) so stepped-"
+                    "clock tests stay deterministic"
+                ),
+            ))
+        return out

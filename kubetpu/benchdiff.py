@@ -26,6 +26,16 @@ Comparison rules (per metric name present in BOTH records):
 - **staged p99s** (``staged_latency_ms.<stage>.p99``, the per-pod
   attribution vector every fullstack record now carries): same rule per
   stage.
+- **federation conflict rate** (``conflict_rate`` on federation records —
+  the per-N ladder rows and the ``FederationScaling_*`` lines): regression
+  when the new rate exceeds ``old * (1 + conflict_tol)`` AND grew by more
+  than ``min_conflict_delta`` absolute (a 0→0.01 wobble on a
+  conflict-free mode never gates; a hash/lease mode that STARTS
+  conflicting, or a race mode whose contention doubled, does).
+- **replica-kill recovery** (``recovery_s`` on ``FederationRecovery_*``
+  lines): regression when recovery takes over ``old * (1 + recovery_tol)``
+  AND grew by more than ``min_recovery_delta_s`` (absolute floor for the
+  sub-second recoveries a small bench shape produces).
 - a metric that ERRORED in new but not old is always a regression;
   improvements and within-tolerance moves report as ok; metrics present
   in only one record are listed but never gate (the ladder's stage lists
@@ -45,6 +55,14 @@ from dataclasses import dataclass
 THROUGHPUT_TOL = 0.25
 P99_TOL = 0.50
 MIN_P99_DELTA_MS = 10.0
+#: federation gates: conflict rate is a FRACTION (0..1), so the absolute
+#: floor matters more than the relative one — a mode measured conflict-free
+#: must stay (effectively) conflict-free, while race-mode noise on a loaded
+#: host stays inside +50%
+CONFLICT_TOL = 0.50
+MIN_CONFLICT_DELTA = 0.05
+RECOVERY_TOL = 1.00
+MIN_RECOVERY_DELTA_S = 5.0
 
 
 class BenchDiffError(ValueError):
@@ -143,6 +161,10 @@ def compare(
     throughput_tol: float = THROUGHPUT_TOL,
     p99_tol: float = P99_TOL,
     min_p99_delta_ms: float = MIN_P99_DELTA_MS,
+    conflict_tol: float = CONFLICT_TOL,
+    min_conflict_delta: float = MIN_CONFLICT_DELTA,
+    recovery_tol: float = RECOVERY_TOL,
+    min_recovery_delta_s: float = MIN_RECOVERY_DELTA_S,
 ) -> tuple[list[Delta], list[str], list[str]]:
     """Returns (deltas over the common metrics, metrics only in old,
     metrics only in new)."""
@@ -189,6 +211,32 @@ def compare(
                 note=f"[tol +{p99_tol:.0%} & >{min_p99_delta_ms:g}ms]"
                 if bad else "",
             ))
+        ocr, ncr = o.get("conflict_rate"), n.get("conflict_rate")
+        if isinstance(ocr, (int, float)) and isinstance(ncr, (int, float)):
+            bad = (
+                ncr > ocr * (1.0 + conflict_tol)
+                and (ncr - ocr) > min_conflict_delta
+            )
+            deltas.append(Delta(
+                name, "conflict_rate", float(ocr), float(ncr), bad,
+                note=(
+                    f"[tol +{conflict_tol:.0%} & >{min_conflict_delta:g}]"
+                    if bad else ""
+                ),
+            ))
+        orec, nrec = o.get("recovery_s"), n.get("recovery_s")
+        if isinstance(orec, (int, float)) and isinstance(nrec, (int, float)):
+            bad = (
+                nrec > orec * (1.0 + recovery_tol)
+                and (nrec - orec) > min_recovery_delta_s
+            )
+            deltas.append(Delta(
+                name, "recovery_s", float(orec), float(nrec), bad,
+                note=(
+                    f"[tol +{recovery_tol:.0%} & "
+                    f">{min_recovery_delta_s:g}s]" if bad else ""
+                ),
+            ))
     only_old = sorted(set(old) - set(new))
     only_new = sorted(set(new) - set(old))
     return deltas, only_old, only_new
@@ -212,6 +260,21 @@ def main(argv=None) -> int:
                     default=MIN_P99_DELTA_MS,
                     help="absolute p99 growth floor below which latency "
                          f"never gates (default {MIN_P99_DELTA_MS})")
+    ap.add_argument("--conflict-tol", type=float, default=CONFLICT_TOL,
+                    help="fractional federation conflict-rate growth "
+                         f"tolerated (default {CONFLICT_TOL})")
+    ap.add_argument("--min-conflict-delta", type=float,
+                    default=MIN_CONFLICT_DELTA,
+                    help="absolute conflict-rate growth floor below which "
+                         f"it never gates (default {MIN_CONFLICT_DELTA})")
+    ap.add_argument("--recovery-tol", type=float, default=RECOVERY_TOL,
+                    help="fractional replica-kill recovery-time growth "
+                         f"tolerated (default {RECOVERY_TOL})")
+    ap.add_argument("--min-recovery-delta-s", type=float,
+                    default=MIN_RECOVERY_DELTA_S,
+                    help="absolute recovery growth floor (seconds) below "
+                         f"which it never gates (default "
+                         f"{MIN_RECOVERY_DELTA_S})")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -226,6 +289,10 @@ def main(argv=None) -> int:
         throughput_tol=args.throughput_tol,
         p99_tol=args.p99_tol,
         min_p99_delta_ms=args.min_p99_delta_ms,
+        conflict_tol=args.conflict_tol,
+        min_conflict_delta=args.min_conflict_delta,
+        recovery_tol=args.recovery_tol,
+        min_recovery_delta_s=args.min_recovery_delta_s,
     )
     regressions = [d for d in deltas if d.regression]
     if args.json:

@@ -265,6 +265,8 @@ def cmd_scheduler(args) -> int:
         bulk=(args.bulk == "on"),
         mesh=mesh,
         flight_recorder=(args.flight_recorder == "on"),
+        replica_id=args.replica_id,
+        federation_mode=("race" if args.replica_id else ""),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
@@ -539,6 +541,11 @@ def _render_explain(rec: dict) -> str:
         f"Pod {rec['pod']} — cycle {rec.get('cycle')}, "
         f"profile {rec.get('profile')}, attempts {rec.get('attempts')}, "
         f"status {rec.get('status')}"
+        # federation attribution: which replica made this decision
+        # (absent/empty in single-scheduler mode — render nothing)
+        + (
+            f", replica {rec['replica']}" if rec.get("replica") else ""
+        )
     ]
     if rec.get("trace_id"):
         lines.append(f"  trace id: {rec['trace_id']}")
@@ -743,6 +750,17 @@ def build_parser() -> argparse.ArgumentParser:
                            "batch-size bucket ladder at startup, so "
                            "steady state never pays XLA compilation "
                            "mid-cycle")
+    schd.add_argument("--replica-id", default="",
+                      help="active-active federation stamp (e.g. r0): "
+                           "marks this process as one of N replicas racing "
+                           "the same apiserver — cycle records, flight-"
+                           "recorder entries and the federation conflict "
+                           "counter carry it, and the CAS bind path "
+                           "arbitrates overlap (409 losers requeue with "
+                           "conflict backoff). Empty = single scheduler. "
+                           "Contrast --leader-elect, which is "
+                           "active/PASSIVE (one leader runs, the rest "
+                           "stand by)")
     schd.add_argument("--leader-elect", action="store_true")
     schd.add_argument("--diagnostics-port", type=int, default=10251,
                       help="side port for /metrics /healthz /readyz /livez "

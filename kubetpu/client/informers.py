@@ -55,7 +55,11 @@ class StoreClient:
         current, rv = self.store.get(PODS, key)
         if current is None:
             raise RuntimeError(f"bind conflict: pod {key} is gone")
-        if current.node_name and current.node_name != node_name:
+        if current.node_name:
+            # ANY already-bound pod conflicts, same node included — the
+            # reference's binding subresource 409s regardless of target,
+            # and federation's race mode depends on it: a same-node
+            # "bind" from a losing replica must not read as a win
             raise RuntimeError(
                 f"bind conflict: pod {key} already on {current.node_name}"
             )
@@ -88,7 +92,8 @@ class StoreClient:
                     f"bind conflict: pod {keys[i]} is gone"
                 )
                 continue
-            if current.node_name and current.node_name != node_name:
+            if current.node_name:
+                # same strictness as the single-op bind above
                 errs[i] = RuntimeError(
                     f"bind conflict: pod {keys[i]} already on "
                     f"{current.node_name}"
@@ -187,20 +192,41 @@ class SchedulerInformers:
     cursor in one batched round trip instead of one poll per kind, each
     kind's frame delivered to its informer under a single lock acquisition.
     Deliveries are event-for-event identical to per-kind polling — the
-    ``--bulk off`` escape hatch restores the per-kind path."""
+    ``--bulk off`` escape hatch restores the per-kind path.
 
-    def __init__(self, store: MemStore, sched: Any, bulk: bool = True) -> None:
+    ``pod_filter`` (scheduler federation's per-replica filtered pump,
+    sched.federation): a predicate consulted for PENDING pods only — a
+    pending pod another replica owns is dropped at delivery time, before
+    it can enter this scheduler's queue. ASSIGNED pods and deletes always
+    flow (every replica's cache must account every node's load, and a
+    bound-elsewhere echo must still evict the loser's queue entry). The
+    predicate reads live ownership state, so a membership rebalance
+    changes routing without informer surgery — the federation re-delivers
+    the newly-owned backlog itself."""
+
+    def __init__(
+        self, store: MemStore, sched: Any, bulk: bool = True,
+        pod_filter: "Any | None" = None,
+    ) -> None:
         self.store = store
         self.sched = sched
         self._bulk = bulk and hasattr(store, "watch_bulk")
         self._reflectors: list[Reflector] = []
         s = sched
+        on_pod_add: Any = s.on_pod_add
+        on_pod_update: Any = lambda old, new: s.on_pod_update(old, new)
+        if pod_filter is not None:
+            def on_pod_add(pod, _raw=s.on_pod_add):
+                if pod.node_name or pod_filter(pod):
+                    _raw(pod)
+
+            def on_pod_update(old, new, _raw=s.on_pod_update):
+                if new.node_name or pod_filter(new):
+                    _raw(old, new)
         self._bind(NODES, s.on_node_add,
                    lambda old, new: s.on_node_update(old, new),
                    s.on_node_delete)
-        self._bind(PODS, s.on_pod_add,
-                   lambda old, new: s.on_pod_update(old, new),
-                   s.on_pod_delete)
+        self._bind(PODS, on_pod_add, on_pod_update, s.on_pod_delete)
         # slices + classes sync BEFORE claims: a pre-allocated claim
         # consumed while the device catalog is still empty would bucket
         # network-attached devices under the claim's node (see

@@ -131,6 +131,29 @@ class SchedulerMetricsRegistry:
             "Number of pods added to scheduling queues by event and queue type.",
             labels=("queue", "event"),
         )
+        # --- active-active federation (sched.federation) ------------------
+        # conflicts: CAS-bind 409 losses + epoch-fenced stale-owner binds,
+        # labeled by partition mode and replica id — the numerator of the
+        # conflict/throughput curve ("none"/"r0" in single-scheduler mode)
+        self.federation_conflicts = r.counter(
+            "scheduler_federation_conflicts_total",
+            "CAS-bind conflicts lost to another scheduler replica "
+            "(409 losers and epoch-fenced stale-owner binds), by "
+            "federation partition mode and replica id.",
+            labels=("mode", "replica"),
+        )
+        self.federation_lease_transitions = r.counter(
+            "scheduler_federation_lease_transitions_total",
+            "Partition-lease ownership changes (acquisitions + losses) "
+            "observed by this replica's lease manager.",
+            labels=("mode", "replica"),
+        )
+        self.federation_partitions_owned = r.gauge(
+            "scheduler_federation_partitions_owned",
+            "Partition leases currently owned by this replica "
+            "(lease mode; the ownership rebalance evidence).",
+            labels=("mode", "replica"),
+        )
         # API dispatcher lifetime counts, set at scrape time from
         # APIDispatcher.stats() (a gauge because the dispatcher owns the
         # monotonic counters; "errors" is the satellite's failed-API-write

@@ -78,6 +78,10 @@ class CycleRecord:
     # cross-shard reduction probe for this scheduler's mesh (seconds; None
     # when unsharded) — the collective tax the kernel walls include
     collective_wall_s: "float | None" = None
+    # federation stamp: which scheduler replica ran this cycle ("" =
+    # single-scheduler mode) — multi-replica cycle streams against one
+    # cluster stay attributable per record
+    replica: str = ""
 
     def to_json(self) -> dict:
         out = asdict(self)
@@ -209,6 +213,7 @@ class TPUBackendMetrics:
         shard_transfer_bytes: "list[int] | None" = None,
         shard_resident_bytes: "list[int] | None" = None,
         collective_wall_s: "float | None" = None,
+        replica: str = "",
     ) -> CycleRecord:
         self.batch_size.labels(engine).observe(batch_size)
         self.transfer_bytes.labels(engine).inc(transfer_bytes)
@@ -244,6 +249,7 @@ class TPUBackendMetrics:
             mesh_shape=tuple(mesh_shape),
             shard_transfer_bytes=shard_transfer_bytes,
             collective_wall_s=collective_wall_s,
+            replica=replica,
         )
         self.records.append(rec)
         return rec

@@ -1,7 +1,13 @@
 """scheduler_perf analog: op-list workloads driving the real scheduler loop
 (test/integration/scheduler_perf)."""
 
-from .runner import WorkloadResult, run_label, run_workload
+from .runner import (
+    WorkloadResult,
+    run_label,
+    run_workload,
+    run_workload_federated,
+    run_workload_full_stack,
+)
 from .workloads import TEST_CASES, TestCase, Workload
 
 __all__ = [
@@ -11,4 +17,6 @@ __all__ = [
     "WorkloadResult",
     "run_label",
     "run_workload",
+    "run_workload_federated",
+    "run_workload_full_stack",
 ]

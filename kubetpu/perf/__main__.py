@@ -8,7 +8,12 @@ from __future__ import annotations
 import argparse
 import json
 
-from . import TEST_CASES, run_label, run_workload
+from . import (
+    TEST_CASES,
+    run_label,
+    run_workload,
+    run_workload_federated,
+)
 
 
 def main(argv=None) -> None:
@@ -45,6 +50,24 @@ def main(argv=None) -> None:
                          "latency attribution (decision records, "
                          "staged_latency_ms/soak fields); 'off' is the "
                          "overhead escape hatch")
+    ap.add_argument("--replicas", type=int, default=1,
+                    help="run N full scheduler replicas against one "
+                         "in-process apiserver (active-active federation, "
+                         "sched.federation) — each replica on its own loop "
+                         "thread; 1 = the ordinary single scheduler")
+    ap.add_argument("--partition", default="race",
+                    choices=["hash", "race", "lease"],
+                    help="federation partition mode (with --replicas > 1): "
+                         "hash = pods split by key hash (no overlap), race "
+                         "= all replicas race on every pod (CAS bind "
+                         "arbitrates, 409 losers requeue with conflict "
+                         "backoff), lease = epoch-fenced renewable "
+                         "partition leases over the pod keyspace")
+    ap.add_argument("--kill-replica-at", type=float, default=None,
+                    help="fraction of the measured pods (0..1) at which to "
+                         "kill the last replica mid-bench; the record then "
+                         "carries recovery_s (time for the survivors to "
+                         "re-absorb its partition)")
     ap.add_argument("--artifacts-dir", default=None,
                     help="dump per-workload diagnosis artifacts here: the "
                          "cycle trace as Perfetto-loadable Chrome-trace "
@@ -68,6 +91,30 @@ def main(argv=None) -> None:
         mesh=args.mesh,   # resolve_mesh handles on/off/auto
         flight_recorder=(args.flight_recorder == "on"),
     )
+    if args.kill_replica_at is not None and args.replicas < 2:
+        # a 1-replica "kill" can never fire — a recovery measurement with
+        # no kill would be silently meaningless
+        ap.error("--kill-replica-at requires --replicas >= 2")
+    if args.replicas > 1 or args.kill_replica_at is not None:
+        # federated fullstack: N in-process schedulers, one apiserver
+        case = TEST_CASES[args.case]
+        workloads = (
+            [w for w in case.workloads if w.name == args.workload]
+            if args.workload else list(case.workloads)
+        )
+        for wl in workloads:
+            r = run_workload_federated(
+                case, wl,
+                replicas=max(args.replicas, 1),
+                partition=args.partition,
+                kill_replica_at=args.kill_replica_at,
+                max_batch=args.max_batch, timeout_s=args.timeout,
+                engine=args.engine,
+                bulk=(args.bulk == "on"),
+                flight_recorder=(args.flight_recorder == "on"),
+            )
+            print(json.dumps(r.to_json()))
+        return
     if args.label:
         for r in run_label(args.label, **kwargs):
             print(json.dumps(r.to_json()))

@@ -113,6 +113,20 @@ class WorkloadResult:
     # flight recorder + per-pod tracing state for this run (the <5%
     # overhead budget's on/off comparison key)
     flight_recorder: bool = True
+    # active-active federation (sched.federation; --replicas N
+    # --partition hash|race|lease): replica count, partition mode, total
+    # CAS-bind conflicts + conflict rate (conflicted attempts / all bind
+    # attempts), binding_parity (store-verified pods bound exactly once —
+    # must equal measure_pods for a lossless run), lease transitions, and
+    # the replica-kill recovery time (kill → survivors re-absorbed the
+    # dead replica's partition and every pod bound)
+    replicas: int = 1
+    partition: str = ""
+    conflicts: int = 0
+    conflict_rate: float | None = None
+    binding_parity: int | None = None
+    lease_transitions: int = 0
+    recovery_s: float | None = None
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -172,6 +186,18 @@ class WorkloadResult:
             out["soak"] = self.soak
         if not self.flight_recorder:
             out["flight_recorder"] = False
+        if self.replicas > 1 or self.partition:
+            out["replicas"] = self.replicas
+            out["partition"] = self.partition
+            out["conflicts"] = self.conflicts
+            if self.conflict_rate is not None:
+                out["conflict_rate"] = round(self.conflict_rate, 4)
+            if self.binding_parity is not None:
+                out["binding_parity"] = self.binding_parity
+            if self.lease_transitions:
+                out["lease_transitions"] = self.lease_transitions
+            if self.recovery_s is not None:
+                out["recovery_s"] = round(self.recovery_s, 3)
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -1157,6 +1183,298 @@ def run_workload_full_stack(
         p99_attempt_latency_ms=lat,
         metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
         artifacts=artifacts,
+    )
+
+
+def run_workload_federated(
+    case: W.TestCase | str,
+    workload: W.Workload | str,
+    replicas: int = 2,
+    partition: str = "race",
+    profile: C.Profile | None = None,
+    max_batch: int = 1024,
+    timeout_s: float = 1800.0,
+    engine: str = "greedy",
+    stall_s: float = 15.0,
+    warmup: bool = True,
+    bulk: bool = True,
+    flight_recorder: bool = True,
+    partitions: int | None = None,
+    kill_replica_at: float | None = None,
+) -> WorkloadResult:
+    """The fullstack measurement under ACTIVE-ACTIVE FEDERATION: N full
+    scheduler replicas (each with its own RemoteStore connection, informer
+    bundle and dispatcher) race one in-process REST apiserver, each on its
+    own loop thread — the ``--replicas N --partition hash|race|lease``
+    deployment mode (sched.federation). ``replicas=1`` is the scaling
+    ladder's baseline (one scheduler through the identical harness).
+
+    ``kill_replica_at`` (0..1): when that fraction of the measured pods
+    has bound, the highest-index replica is killed mid-bench; the
+    measurement then ALSO reports ``recovery_s`` — kill → every remaining
+    pod bound by the survivors (the dead replica's partition re-absorbed).
+
+    Reported federation evidence: ``conflicts`` / ``conflict_rate``
+    (CAS-bind 409 losses + fenced stale-owner binds over all bind
+    attempts), ``binding_parity`` (store-verified count of measured pods
+    bound exactly once — the CAS store makes twice impossible, so parity
+    == measure_pods means none lost either), and ``lease_transitions``.
+    Supports the createNodes/createNamespaces/createPods/barrier op set
+    (SchedulingBasic's shape); richer ops raise."""
+    import threading as _threading
+
+    from ..apiserver import APIServer, RemoteStore
+    from ..client import StoreClient
+    from ..client.informers import NAMESPACES, NODES, PODS
+    from ..sched.federation import SchedulerFederation
+
+    if isinstance(case, str):
+        case = W.TEST_CASES[case]
+    if isinstance(workload, str):
+        workload = next(w for w in case.workloads if w.name == workload)
+    params = dict(workload.params)
+    supported = (
+        W.CreateNodesOp, W.CreateNamespacesOp, W.CreatePodsOp, W.BarrierOp,
+    )
+    for op in case.ops:
+        if not isinstance(op, supported):
+            raise NotImplementedError(
+                f"federated mode does not drive {type(op).__name__}"
+            )
+
+    srv = APIServer().start()
+    admin = RemoteStore(srv.url)
+
+    # one bound-count board shared by every replica's client: the monitor
+    # thread reads it, dispatcher worker threads of N replicas write it
+    board_lock = _threading.Lock()
+    bound_by_ns: dict[str, int] = {}
+
+    class _BoardClient(StoreClient):
+        def bind(self, pod, node_name) -> None:
+            super().bind(pod, node_name)
+            with board_lock:
+                bound_by_ns[pod.namespace] = (
+                    bound_by_ns.get(pod.namespace, 0) + 1
+                )
+
+        def bulk_bind(self, pairs) -> list:
+            errs = super().bulk_bind(pairs)
+            with board_lock:
+                for (pod, _node), err in zip(pairs, errs):
+                    if err is None:
+                        bound_by_ns[pod.namespace] = (
+                            bound_by_ns.get(pod.namespace, 0) + 1
+                        )
+            return errs
+
+    fed = SchedulerFederation(
+        lambda i: RemoteStore(srv.url),
+        replicas=replicas,
+        partition=partition,
+        partitions=partitions,
+        scheduler_kwargs=dict(
+            profile=profile or C.Profile(), max_batch=max_batch,
+            engine=engine, bulk=bulk, flight_recorder=flight_recorder,
+            feature_gates=(
+                dict(case.feature_gates) if case.feature_gates else None
+            ),
+        ),
+        client_factory=lambda s: _BoardClient(s),
+        informer_bulk=bulk,
+    )
+
+    def bound_now(namespaces: tuple[str, ...]) -> int:
+        with board_lock:
+            return sum(bound_by_ns.get(ns, 0) for ns in namespaces)
+
+    measured = 0
+    duration = 0.0
+    requests0 = 0
+    rpcs_total = 0
+    attempts0 = cycles0 = 0
+    recovery_s: float | None = None
+    killed = False
+    parity: int | None = None
+    measure_namespaces: tuple[str, ...] = ()
+    op_ns_counter = 0
+    stop = _threading.Event()
+    threads: list = []
+
+    def settle(
+        target: int, namespaces: tuple[str, ...], allow_kill: bool = False,
+    ) -> tuple[int, float]:
+        """Monitor the shared board until ``target`` pods of
+        ``namespaces`` bound (the replica threads do the work), firing the
+        mid-bench kill when requested. The kill arms ONLY in the measured
+        phase (``allow_kill``) — an init-phase settle must not consume it,
+        or recovery would measure the init tail and the whole measured
+        phase would run a replica short."""
+        nonlocal recovery_s, killed
+        start = bound_now(namespaces)
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        last_progress = t0
+        done = 0
+        t_kill = None
+        kill_at = (
+            int(kill_replica_at * target)
+            if (kill_replica_at is not None and allow_kill) else None
+        )
+        while done < target:
+            now = time.perf_counter()
+            if now > deadline:
+                break
+            before = done
+            done = bound_now(namespaces) - start
+            if (
+                kill_at is not None and not killed and done >= kill_at
+                and len(fed.live()) > 1
+            ):
+                idx = fed.live()[-1].index
+                fed.kill(idx, close=False)
+                killed = True
+                t_kill = now
+            if done > before:
+                last_progress = now
+            elif now - last_progress > stall_s:
+                break
+            else:
+                time.sleep(0.005)
+        t_end = time.perf_counter()
+        if t_kill is not None and done >= target:
+            recovery_s = t_end - t_kill
+        return done, t_end - t0
+
+    try:
+        for op_i, op in enumerate(case.ops):
+            if isinstance(op, W.CreateNodesOp):
+                n = op.count or params[op.count_param]
+                factory = op.template or W.node_default
+                nodes = [factory(i, op.zones) for i in range(n)]
+                _bulk_create(
+                    admin, NODES, [(nd.name, nd) for nd in nodes], bulk=bulk,
+                )
+            elif isinstance(op, W.CreateNamespacesOp):
+                n = params[op.count_param] if op.count_param else op.count
+                _bulk_create(admin, NAMESPACES, [
+                    (f"{op.prefix}-{i}", t.Namespace(
+                        name=f"{op.prefix}-{i}", labels=op.labels,
+                    ))
+                    for i in range(n)
+                ], bulk=bulk)
+            elif isinstance(op, W.BarrierOp):
+                continue   # phases already settle to completion below
+            elif isinstance(op, W.CreatePodsOp):
+                count = params[op.count_param]
+                template = op.template or case.default_pod_template
+                ns = op.namespace or f"namespace-{op_ns_counter}"
+                op_ns_counter += 1
+                prefix = (
+                    f"{'measure' if op.collect_metrics else 'init'}-{op_i}"
+                )
+                if not threads:
+                    # first pod op: sync + (optionally) compile every
+                    # replica BEFORE its loop thread exists — warmup and
+                    # the loop must share the single-owner thread
+                    fed.start()
+                    for h in fed.live():
+                        h.informers.pump()
+                        if warmup:
+                            h.sched.warmup([
+                                template(f"warmup-{op_i}-{j}", ns)
+                                for j in range(
+                                    min(count, h.sched.max_batch)
+                                )
+                            ])
+                    threads = fed.run_threads(stop)
+                if op.collect_metrics:
+                    # accumulate: a case may carry several measured ops,
+                    # and parity must count every measured namespace
+                    measure_namespaces = measure_namespaces + (ns,)
+                    attempts0 = sum(
+                        h.sched.metrics.schedule_attempts
+                        for h in fed.handles
+                    )
+                    cycles0 = sum(
+                        h.sched.metrics.cycles for h in fed.handles
+                    )
+                    requests0 = srv.metrics.total_requests()
+                items = []
+                for j in range(count):
+                    pod = template(f"{prefix}-{ns}-{j}", ns)
+                    items.append((f"{ns}/{pod.name}", pod))
+                _bulk_create(admin, PODS, items, bulk=bulk)
+                if op.skip_wait:
+                    continue
+                done, secs = settle(
+                    count, (ns,), allow_kill=op.collect_metrics,
+                )
+                if op.collect_metrics:
+                    measured += done
+                    duration += secs
+                    rpcs_total += srv.metrics.total_requests() - requests0
+        # store-verified binding parity: every measured pod bound exactly
+        # once (the CAS bind makes twice impossible; parity ==
+        # measure_pods means none were lost to a dead replica or a
+        # conflict loop either). Inside the try: the server must still be
+        # up, and a failed parity read should surface, not mask.
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        if measure_namespaces:
+            items, _rv = admin.list(PODS)
+            parity = sum(
+                1 for key, pod in items
+                if pod.node_name
+                and key.split("/", 1)[0] in measure_namespaces
+            )
+    finally:
+        # teardown runs on EVERY path — an exception mid-ladder must not
+        # leak the apiserver thread/socket into the rest of the bench
+        stop.set()
+        for th in threads:
+            th.join(timeout=10)
+        for h in fed.handles:
+            if not h.alive:
+                fed.close_replica(h.index)
+        fed.close()
+        srv.close()
+
+    throughput = measured / duration if duration > 0 else 0.0
+    return WorkloadResult(
+        case_name=case.name,
+        workload_name=(
+            f"{workload.name}_fullstack_{replicas}sched_{partition}"
+        ),
+        threshold=workload.threshold,
+        threshold_note=workload.threshold_note,
+        measure_pods=sum(
+            params[op.count_param]
+            for op in case.ops
+            if isinstance(op, W.CreatePodsOp) and op.collect_metrics
+        ),
+        scheduled=measured,
+        duration_s=duration,
+        throughput=throughput,
+        vs_threshold=(
+            throughput / workload.threshold if workload.threshold else None
+        ),
+        attempts=sum(
+            h.sched.metrics.schedule_attempts for h in fed.handles
+        ) - attempts0,
+        cycles=sum(h.sched.metrics.cycles for h in fed.handles) - cycles0,
+        rpcs_per_scheduled_pod=(
+            rpcs_total / measured if measured else None
+        ),
+        flight_recorder=flight_recorder,
+        replicas=replicas,
+        partition=partition,
+        conflicts=fed.conflicts(),
+        conflict_rate=fed.conflict_rate(),
+        binding_parity=parity,
+        lease_transitions=fed.lease_transitions(),
+        recovery_s=recovery_s,
     )
 
 

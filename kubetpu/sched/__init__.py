@@ -9,8 +9,20 @@ Filter+Score+assign program, assume lands synchronously in the cache, and
 binds stream out through the API dispatcher off the hot loop.
 """
 
-from .api_dispatcher import APICall, APIDispatcher, BindCall, StatusPatchCall
+from .api_dispatcher import (
+    APICall,
+    APIDispatcher,
+    BindCall,
+    StatusPatchCall,
+    is_bind_conflict,
+)
 from .diagnostics import DiagnosticsServer
+from .federation import (
+    PartitionLeaseManager,
+    SchedulerFederation,
+    StaleOwnerError,
+    pod_partition,
+)
 from .flightrecorder import FlightRecorder
 from .scheduler import Scheduler, SchedulerMetrics
 
@@ -21,6 +33,11 @@ __all__ = [
     "StatusPatchCall",
     "DiagnosticsServer",
     "FlightRecorder",
+    "PartitionLeaseManager",
     "Scheduler",
+    "SchedulerFederation",
     "SchedulerMetrics",
+    "StaleOwnerError",
+    "is_bind_conflict",
+    "pod_partition",
 ]

@@ -34,6 +34,27 @@ class CallSkipped(Exception):
     from success (None) and from an execution error."""
 
 
+def is_bind_conflict(err: BaseException | None) -> bool:
+    """Classify an API-write failure as a CAS-bind conflict: the store's
+    409 (``ConflictError``, single-op or positional in a bulk reply), the
+    client's already-bound/gone refusals (``"bind conflict"``), or a
+    federation partition-lease fence rejection (``StaleOwnerError``).
+    Conflicts are the EXPECTED arbitration outcome when N scheduler
+    replicas overlap — accounted separately from transport errors so the
+    conflict/throughput curve is measurable."""
+    if err is None:
+        return False
+    try:
+        from ..store.memstore import ConflictError
+
+        if isinstance(err, ConflictError):
+            return True
+    except Exception:  # pragma: no cover — store layer absent
+        pass
+    name = type(err).__name__
+    return name == "StaleOwnerError" or "bind conflict" in str(err)
+
+
 class APICall(Protocol):
     """One queued API write (the reference's fwk.APICall)."""
 
@@ -230,6 +251,8 @@ class APIDispatcher:
         self._added = 0
         self._executed = 0
         self._errors = 0
+        self._conflicts = 0        # errors that were CAS-bind conflicts
+        #                            (bulk partial-409s land here per op)
         self._batches = 0          # bulk RPCs issued
         self._batched_calls = 0    # calls that rode a bulk RPC
         self._closed = False
@@ -298,6 +321,12 @@ class APIDispatcher:
             self._executed += 1
             if err is not None:
                 self._errors += 1
+                if is_bind_conflict(err):
+                    # per-dispatcher (= per-replica) conflict accounting:
+                    # a bulk bind's partial 409s fall back through
+                    # _execute_api and resolve here one by one, so the
+                    # count is per-op exact either way
+                    self._conflicts += 1
         on_done = getattr(call, "on_done", None)
         if on_done is not None:
             try:
@@ -447,6 +476,7 @@ class APIDispatcher:
                 "added": self._added,
                 "executed": self._executed,
                 "errors": self._errors,
+                "conflicts": self._conflicts,
                 "batches": self._batches,
                 "batched_calls": self._batched_calls,
             }

@@ -180,8 +180,13 @@ class FlightRecorder:
         max_records: int = 4096,
         max_e2e_samples: int = 65536,
         top_k: int = 3,
+        replica: str = "",
     ) -> None:
         self.top_k = top_k
+        # federation stamp: every decision record carries the scheduler
+        # replica that made it ("" in single-scheduler mode) so a
+        # multi-replica bind history is attributable per record
+        self.replica = replica
         self._records: collections.deque[dict] = collections.deque(
             maxlen=max_records
         )
@@ -289,6 +294,7 @@ class FlightRecorder:
                 "uid": info.pod.uid,
                 "cycle": cycle_id,
                 "profile": profile,
+                "replica": self.replica,
                 "attempts": info.attempts,
                 "status": (
                     "scheduled" if 0 <= j < len(node_names)

@@ -33,6 +33,14 @@ from typing import Any, Callable
 
 from ..api.types import LeaderElectionRecord  # noqa: E402  (wire type)
 
+#: THE injectable-clock seam for every lease/backoff code path — the same
+#: monotonic default the queue's backoff machinery uses
+#: (queue.priority_queue.PriorityQueue(clock=…)). Elector/lease code reads
+#: time ONLY through an injected clock defaulting to this, so federation
+#: tests step acquire/renew/expire deterministically; graftcheck CL001
+#: rejects bare ``time.monotonic()``/``time.time()`` calls in these files.
+default_clock: Callable[[], float] = time.monotonic
+
 
 class InMemoryLeaseClient:
     """Lease storage with resourceVersion CAS — the fake-clientset
@@ -124,7 +132,7 @@ class LeaderElector:
     lease_duration_s: float = 15.0
     renew_deadline_s: float = 10.0
     retry_period_s: float = 2.0
-    clock: Callable[[], float] = time.monotonic
+    clock: Callable[[], float] = default_clock
     on_started_leading: Callable[[], None] | None = None
     on_stopped_leading: Callable[[], None] | None = None
     on_new_leader: Callable[[str], None] | None = None
@@ -139,6 +147,34 @@ class LeaderElector:
     @property
     def is_leader(self) -> bool:
         return self._is_leader
+
+    # -------------------------------------------------- observation accessors
+    # Foreign modules (sched.federation's partition-lease manager) read
+    # election state ONLY through these owner methods — never the private
+    # observation fields — so the elector keeps a single auditable surface
+    # (the LD003 ownership discipline, applied to reads as well).
+
+    def observed_record(self) -> LeaderElectionRecord | None:
+        """The last lease record this elector observed (None before the
+        first get)."""
+        return self._observed
+
+    def observed_holder(self) -> str:
+        """Identity currently holding the lease, per the last observation
+        ("" = unheld/unobserved)."""
+        return self._observed.holder_identity if self._observed else ""
+
+    def observed_epoch(self) -> int:
+        """``leader_transitions`` of the last observed record — the fencing
+        epoch: it bumps on every ownership change, so a holder that captured
+        it at acquisition can detect a steal (-1 = never observed)."""
+        return (
+            self._observed.leader_transitions if self._observed else -1
+        )
+
+    def last_renew(self) -> float:
+        """Elector-clock time of the last successful acquire/renew."""
+        return self._last_renew
 
     # ------------------------------------------------------------- stepping
     def tick(self) -> bool:

@@ -42,7 +42,13 @@ from ..queue.events import (
     node_update_event,
 )
 from .. import names as N
-from .api_dispatcher import APIDispatcher, BindCall, CallSkipped, StatusPatchCall
+from .api_dispatcher import (
+    APIDispatcher,
+    BindCall,
+    CallSkipped,
+    StatusPatchCall,
+    is_bind_conflict,
+)
 
 import jax
 import numpy as np
@@ -93,6 +99,11 @@ class SchedulerMetrics:
     unschedulable: int = 0              # result "unschedulable"
     errors: int = 0                     # result "error"
     bind_errors: int = 0
+    # bind errors that were CAS-bind conflicts (another scheduler replica
+    # won the pod, or a partition-lease fence rejected a stale owner) —
+    # the federation conflict/throughput curve's numerator; also counted
+    # in bind_errors (a conflict IS a failed bind)
+    bind_conflicts: int = 0
     cycles: int = 0
     # pipelined cycles whose dispatched device result had to be discarded
     # and recomputed because cluster state changed under them (node update /
@@ -144,6 +155,9 @@ class SchedulerMetrics:
     def note_preemption_victims(self, n: int) -> None:
         self.preemption_victims += n
 
+    def note_bind_conflict(self) -> None:
+        self.bind_conflicts += 1
+
 
 class Scheduler:
     """See module docstring. Single-owner object: informer callbacks and the
@@ -169,6 +183,8 @@ class Scheduler:
         bulk: bool = True,
         mesh=None,
         flight_recorder: bool = True,
+        replica_id: str = "",
+        federation_mode: str = "",
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -218,10 +234,18 @@ class Scheduler:
         /debug/flightrecorder and rendered by ``kubetpu explain``, plus
         the scheduler_e2e_scheduling_duration_seconds{stage} histograms.
         ``False`` (``--flight-recorder off``) is the overhead escape
-        hatch — decisions are unchanged either way."""
+        hatch — decisions are unchanged either way.
+        ``replica_id``/``federation_mode``: active-active federation
+        stamps (sched.federation) — the replica id rides every cycle
+        record and flight-recorder entry so multi-replica bind histories
+        stay attributable, and the pair labels
+        ``scheduler_federation_conflicts_total{mode,replica}``. Empty in
+        single-scheduler mode."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
+        self.replica_id = replica_id
+        self.federation_mode = federation_mode
 
         self.cfg = cfg or C.SchedulerConfiguration()
         self.profile = profile or self.cfg.profile()
@@ -301,7 +325,9 @@ class Scheduler:
         if flight_recorder:
             from .flightrecorder import FlightRecorder
 
-            self.flight_recorder: "FlightRecorder | None" = FlightRecorder()
+            self.flight_recorder: "FlightRecorder | None" = FlightRecorder(
+                replica=replica_id
+            )
         else:
             self.flight_recorder = None
         # per-stage histogram children cached once: labels() takes the
@@ -1298,6 +1324,7 @@ class Scheduler:
                     if self.mesh_shape else None
                 ),
                 collective_wall_s=self._collective_wall_s,
+                replica=self.replica_id,
             )
             if self.mesh_shape:
                 # per-shard routed-delta attribution, joined by cycle id
@@ -1624,6 +1651,19 @@ class Scheduler:
                 # (handleSchedulingFailure, schedule_one.go:1190 analog)
                 self.metrics.bind_errors += 1
                 self.metrics.errors += 1
+                if is_bind_conflict(err):
+                    # a CAS-bind race lost to another scheduler replica
+                    # (or a fenced stale-owner bind): the federation
+                    # arbitration path, distinct from a transport error.
+                    # The error-status requeue below IS the conflict
+                    # backoff — the loser won't re-fight the pod before
+                    # the winner's bind echoes through the informer and
+                    # deletes the queue entry.
+                    self.metrics.note_bind_conflict()
+                    self.metrics.prom.federation_conflicts.labels(
+                        self.federation_mode or "none",
+                        self.replica_id or "r0",
+                    ).inc()
                 self.cache.forget_pod(assumed)
                 # binding-cycle failure runs Unreserve (schedule_one.go:391
                 # bindingCycle's deferred unreserve-on-failure)

@@ -1,0 +1,28 @@
+"""CL001 known-bad: bare wall-clock calls in lease/backoff-shaped code
+(the ``clock_*`` basename puts this file in the checker's scope)."""
+
+import time
+import time as _time
+import time as tmod
+from time import monotonic as mono
+from time import time as wallclock
+
+
+def renew_lease(record):
+    now = time.monotonic()  # expect: CL001
+    return now - record.renew_time
+
+
+class BackoffPool:
+    def expired(self, deadline):
+        return time.time() > deadline  # expect: CL001
+
+    def aliased(self):
+        return _time.monotonic()  # expect: CL001
+
+    def import_aliased(self):
+        return tmod.monotonic()  # expect: CL001
+
+    def from_imported(self):
+        t0 = mono()  # expect: CL001
+        return t0 + wallclock()  # expect: CL001

@@ -1,0 +1,25 @@
+"""CL001 known-good: the injectable-clock seam (a default REFERENCE, not
+a call), reads through the injected clock, and the exempt lifecycle
+clock (perf_counter)."""
+
+import time
+from dataclasses import dataclass
+from time import monotonic as default_tick       # reference for a default
+from time import perf_counter
+from typing import Callable
+
+
+@dataclass
+class Elector:
+    clock: Callable[[], float] = time.monotonic   # the seam: a reference
+    tick: Callable[[], float] = default_tick      # aliased seam: also fine
+
+    def renew(self, record):
+        now = self.clock()                        # read via the seam
+        return now - record.renew_time
+
+    def stamp(self):
+        return time.perf_counter()                # lifecycle clock: exempt
+
+    def stamp2(self):
+        return perf_counter()                     # from-imported: exempt

@@ -97,6 +97,71 @@ def test_p99_absolute_floor_suppresses_small_wobbles(tmp_path):
     assert main([old, new2]) == 1
 
 
+def _fed_line(metric, value, conflict_rate, **extra):
+    return {
+        "metric": metric, "value": value, "unit": "ratio",
+        "throughput": 900.0, "conflict_rate": conflict_rate,
+        "binding_parity": 1000, "measure_pods": 1000, **extra,
+    }
+
+
+def test_federation_records_pass_against_themselves(tmp_path):
+    """The acceptance gate: FederationScaling_*/FederationRecovery_*
+    records diffed against themselves are regression-free."""
+    lines = [
+        _line("SchedulingBasic_500Nodes_greedy_fullstack_2sched_race",
+              900.0, conflict_rate=0.31, replicas=2, partition="race"),
+        _fed_line("FederationScaling_SchedulingBasic_500Nodes_race_2sched",
+                  1.4, 0.31),
+        {"metric": "FederationRecovery_SchedulingBasic_500Nodes_hash_2sched",
+         "unit": "s", "value": 0.8, "recovery_s": 0.8,
+         "binding_parity": 1000, "all_rescheduled": True},
+    ]
+    rec = _write(tmp_path, "fed.json", lines)
+    assert main([rec, rec]) == 0
+
+
+def test_conflict_rate_regression_gates(tmp_path, capsys):
+    old = _write(tmp_path, "old.json", [
+        _fed_line("FederationScaling_A_race_2sched", 1.4, 0.30),
+    ])
+    new = _write(tmp_path, "new.json", [
+        _fed_line("FederationScaling_A_race_2sched", 1.4, 0.70),
+    ])
+    rc = main([old, new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "conflict_rate" in out and "REGRESSION" in out
+
+
+def test_conflict_rate_small_absolute_wobble_never_gates(tmp_path):
+    # 0 → 0.03: a huge relative move but under the absolute floor — a
+    # conflict-free hash run picking up a stray handover conflict must
+    # not page anyone
+    old = _write(tmp_path, "old.json", [
+        _fed_line("FederationScaling_A_hash_2sched", 1.9, 0.0),
+    ])
+    new = _write(tmp_path, "new.json", [
+        _fed_line("FederationScaling_A_hash_2sched", 1.9, 0.03),
+    ])
+    assert main([old, new]) == 0
+
+
+def test_recovery_time_regression_gates(tmp_path, capsys):
+    def rec(v):
+        return {"metric": "FederationRecovery_A_hash_2sched", "unit": "s",
+                "value": v, "recovery_s": v}
+
+    old = _write(tmp_path, "old.json", [rec(2.0)])
+    ok = _write(tmp_path, "ok.json", [rec(3.5)])     # +75%, under 5s floor
+    bad = _write(tmp_path, "bad.json", [rec(12.0)])  # +500% and +10s
+    assert main([old, ok]) == 0
+    rc = main([old, bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "recovery_s" in out and "REGRESSION" in out
+
+
 def test_cli_subcommand_dispatch(tmp_path, capsys):
     from kubetpu.cli import main as cli_main
 

@@ -1,0 +1,321 @@
+"""Active-active scheduler federation (sched/federation.py) — tier-1.
+
+The acceptance contract (ISSUE 9): pod-for-pod binding parity vs a single
+scheduler in ``hash`` and ``lease`` modes; ``race`` mode binds every pod
+exactly once under injected overlap (409 losers requeue with conflict
+backoff, no double-bind, no lost pod); a replica killed mid-run has all
+its pending pods rescheduled by the survivors within a bounded number of
+rounds; and an epoch-fenced stale-owner bind is rejected. Everything runs
+in deterministic LOCKSTEP on a stepped clock: ``SchedulerFederation.step``
+pumps every replica before any replica schedules, so race-mode overlap is
+injected by construction, not by thread timing.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.client import SchedulerInformers, StoreClient
+from kubetpu.sched import Scheduler
+from kubetpu.sched.federation import (
+    SchedulerFederation,
+    StaleOwnerError,
+    pod_partition,
+)
+from kubetpu.sched.leaderelection import LeaderElector, StoreLeaseClient
+from kubetpu.store.memstore import MemStore
+
+NODES = 8
+PODS = 24
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, s: float) -> None:
+        self.t += s
+
+
+def make_store(pods: int = PODS, nodes: int = NODES) -> MemStore:
+    store = MemStore()
+    for i in range(nodes):
+        n = make_node(f"n{i}", cpu_milli=8000, memory=32 * 1024**3)
+        store.create("nodes", n.name, n)
+    for j in range(pods):
+        p = make_pod(
+            f"p{j}", namespace="default", cpu_milli=100,
+            memory=100 * 1024**2, creation_index=j,
+        )
+        store.create("pods", f"default/{p.name}", p)
+    return store
+
+
+def bound_pods(store: MemStore) -> dict[str, str]:
+    items, _rv = store.list("pods")
+    return {k: p.node_name for k, p in items if p.node_name}
+
+
+def make_federation(store, replicas=2, mode="race", clock=None, **kw):
+    clock = clock or FakeClock()
+    fed = SchedulerFederation(
+        store, replicas=replicas, partition=mode,
+        scheduler_kwargs=dict(dispatcher_workers=0, **kw),
+        clock=clock,
+    )
+    return fed, clock
+
+
+def run_single_scheduler(store: MemStore) -> dict[str, str]:
+    """The singleton baseline for parity: one Scheduler through the same
+    informer seam over an identical store."""
+    sched = Scheduler(StoreClient(store), dispatcher_workers=0)
+    sched.enable_preemption()
+    informers = SchedulerInformers(store, sched)
+    informers.start()
+    idle = 0
+    for _ in range(200):
+        moved = informers.pump()
+        res = sched.schedule_batch()
+        sched.dispatcher.sync()
+        sched._drain_bind_completions()
+        if not moved and not res["scheduled"] and not res["unschedulable"]:
+            idle += 1
+            if idle >= 2:
+                break
+        else:
+            idle = 0
+    sched.close()
+    return bound_pods(store)
+
+
+@pytest.mark.parametrize("mode", ["hash", "lease"])
+def test_binding_parity_with_single_scheduler(mode):
+    """Pod-for-pod parity: the federation binds exactly the pods the
+    single scheduler binds, each exactly once, with zero conflicts —
+    hash/lease partitions are overlap-free by construction."""
+    single = run_single_scheduler(make_store())
+    store = make_store()
+    fed, clock = make_federation(store, replicas=2, mode=mode)
+    fed.start()
+    fed.run_until_idle(max_rounds=60, advance_clock=clock.advance)
+    federated = bound_pods(store)
+    try:
+        assert sorted(federated) == sorted(single)      # the same pod SET
+        assert len(federated) == PODS                    # all, exactly once
+        assert fed.conflicts() == 0
+        assert fed.bound() == PODS
+        # both replicas actually worked (the partition is real, not one
+        # replica doing everything while the other idles)
+        per_replica = [h.sched.metrics.scheduled for h in fed.handles]
+        assert all(n > 0 for n in per_replica), per_replica
+        assert sum(per_replica) == PODS
+    finally:
+        fed.close()
+
+
+def test_lease_mode_partitions_are_owned_disjointly():
+    store = make_store()
+    fed, clock = make_federation(store, replicas=2, mode="lease")
+    fed.start()
+    try:
+        owned = [h.leases.owned() for h in fed.handles]
+        assert all(owned)                                # both own shares
+        assert not (owned[0] & owned[1])
+        assert owned[0] | owned[1] == set(range(fed.partitions))
+        # every replica's queue only ever sees its own partitions' pods
+        fed.step()
+        for h in fed.handles:
+            for info in h.sched.queue.pending_pods():
+                part = pod_partition(
+                    f"{info.namespace}/{info.name}", fed.partitions
+                )
+                assert h.leases.owns(part)
+    finally:
+        fed.close()
+
+
+def test_race_mode_binds_every_pod_exactly_once_under_overlap():
+    """The lockstep round pumps BOTH replicas before either schedules, so
+    both race on all 24 pods: the CAS bind arbitrates — one winner per
+    pod, every loser 409s, requeues with the conflict backoff, and is
+    evicted by the winner's bind echo. No pod is double-bound or lost."""
+    store = make_store()
+    fed, clock = make_federation(store, replicas=2, mode="race")
+    fed.start()
+    try:
+        fed.run_until_idle(max_rounds=60, advance_clock=clock.advance)
+        federated = bound_pods(store)
+        assert len(federated) == PODS                    # no lost pod
+        assert fed.bound() == PODS                       # no double-bind
+        # the injected overlap: the round-ordered loser conflicted on
+        # every pod the winner took first
+        assert fed.conflicts() == PODS
+        assert 0.0 < fed.conflict_rate() <= 0.5
+        # losers' queues drained (requeued entries evicted by the
+        # winner's bind echo, not re-fought)
+        for h in fed.handles:
+            assert len(h.sched.queue) == 0
+        # the per-replica conflict evidence: dispatcher partial-409
+        # accounting and the labeled federation counter
+        disp_conflicts = sum(
+            h.sched.dispatcher.stats()["conflicts"] for h in fed.handles
+        )
+        assert disp_conflicts == PODS
+        loser = max(
+            fed.handles, key=lambda h: h.sched.metrics.bind_conflicts
+        )
+        text = loser.sched.metrics_text()
+        assert (
+            "scheduler_federation_conflicts_total"
+            f'{{mode="race",replica="{loser.replica_id}"}}'
+        ) in text
+    finally:
+        fed.close()
+
+
+@pytest.mark.parametrize("mode", ["hash", "lease"])
+def test_replica_kill_pending_pods_rescheduled_by_survivors(mode):
+    """Kill a replica while its partition still has pending pods: the
+    survivor re-absorbs the partition (hash: ranks recompute immediately;
+    lease: after the dead replica's leases expire — the bounded handover
+    window) and binds everything, within a bounded number of rounds."""
+    store = make_store()
+    fed, clock = make_federation(
+        store, replicas=2, mode=mode, max_batch=4,
+    )
+    fed.start()
+    try:
+        fed.step()                                       # partial progress
+        before = len(bound_pods(store))
+        assert 0 < before < PODS
+        fed.kill(1)
+        assert len(fed.live()) == 1
+        fed.run_until_idle(max_rounds=60, advance_clock=clock.advance)
+        assert len(bound_pods(store)) == PODS
+        assert fed.bound() == PODS
+        if mode == "lease":
+            # the survivor absorbed the dead replica's partitions
+            assert fed.handles[0].leases.owned() == frozenset(
+                range(fed.partitions)
+            )
+            assert fed.lease_transitions() > 0
+    finally:
+        fed.close()
+
+
+def test_epoch_fenced_stale_owner_bind_rejected():
+    """A replica whose partition lease was stolen between its informer
+    delivery and its bind dispatch is FENCED: the bind is rejected
+    against the shared lease record, counted as a conflict, and the pod
+    stays unbound by the stale owner."""
+    store = make_store(pods=0)
+    fed, clock = make_federation(store, replicas=2, mode="lease")
+    fed.start()
+    h0 = fed.handles[0]
+    try:
+        # a pod landing in one of r0's partitions
+        p = min(h0.leases.owned())
+        pod = next(
+            make_pod(f"fenced-{i}", namespace="default", cpu_milli=100,
+                     memory=100 * 1024**2)
+            for i in range(1000)
+            if pod_partition(f"default/fenced-{i}", fed.partitions) == p
+        )
+        store.create("pods", f"default/{pod.name}", pod)
+        h0.informers.pump()                  # pod enters r0's queue
+        # an intruder usurps partition p after expiry; r0 does NOT tick
+        # its leases (the stale-belief window)
+        intruder = LeaderElector(
+            client=StoreLeaseClient(store), identity="intruder",
+            name=f"kubetpu-partition-{p}", namespace="kube-system",
+            lease_duration_s=2.0, retry_period_s=0.0, clock=clock,
+        )
+        intruder.tick()
+        clock.advance(3.0)
+        assert intruder.tick()
+        # direct fence: the wrapped client rejects before the store write
+        with pytest.raises(StaleOwnerError):
+            h0.client.bind(pod, "n0")
+        # full scheduler path: assume → dispatch → fence → conflict →
+        # forget → error-status requeue; the pod is NOT bound
+        res = h0.sched.schedule_batch()
+        h0.sched.dispatcher.sync()
+        h0.sched._drain_bind_completions()
+        assert res["scheduled"] == 1          # assumed before the fence
+        assert h0.sched.metrics.bind_conflicts == 1
+        assert f"default/{pod.name}" not in bound_pods(store)
+        assert h0.sched.dispatcher.stats()["conflicts"] == 1
+    finally:
+        fed.close()
+
+
+def test_flight_recorder_records_carry_the_replica_id():
+    """Satellite: multi-replica bind histories are attributable — every
+    decision record carries its replica ("" in single-scheduler mode) and
+    ``kubetpu explain`` renders it."""
+    store = make_store(pods=4)
+    fed, clock = make_federation(store, replicas=2, mode="hash")
+    fed.start()
+    try:
+        fed.run_until_idle(max_rounds=40, advance_clock=clock.advance)
+        recs = [
+            r
+            for h in fed.handles
+            for r in h.sched.flight_recorder.records_json(limit=64)[
+                "records"
+            ]
+        ]
+        assert recs
+        assert {r["replica"] for r in recs} <= {"r0", "r1"}
+        assert all(r["replica"] for r in recs)
+        from kubetpu.cli import _render_explain
+
+        rec = recs[0]
+        assert f"replica {rec['replica']}" in _render_explain(rec)
+    finally:
+        fed.close()
+    # single-scheduler mode: the field exists and is empty
+    store2 = make_store(pods=2)
+    sched = Scheduler(StoreClient(store2), dispatcher_workers=0)
+    informers = SchedulerInformers(store2, sched)
+    informers.start()
+    for _ in range(6):
+        informers.pump()
+        sched.schedule_batch()
+        sched._drain_bind_completions()
+    recs = sched.flight_recorder.records_json(limit=8)["records"]
+    sched.close()
+    assert recs and all(r["replica"] == "" for r in recs)
+    from kubetpu.cli import _render_explain
+
+    assert "replica" not in _render_explain(recs[0])
+
+
+def test_cycle_records_carry_the_replica_id():
+    store = make_store(pods=4)
+    fed, clock = make_federation(store, replicas=2, mode="race")
+    fed.start()
+    try:
+        fed.run_until_idle(max_rounds=40, advance_clock=clock.advance)
+        for h in fed.handles:
+            recs = h.sched.metrics.tpu.records
+            assert recs
+            assert all(r.replica == h.replica_id for r in recs)
+            assert all(
+                r["replica"] == h.replica_id
+                for r in h.sched.metrics.tpu.records_json()
+            )
+    finally:
+        fed.close()
+
+
+def test_rejects_unknown_partition_mode_and_zero_replicas():
+    with pytest.raises(ValueError):
+        SchedulerFederation(MemStore(), replicas=2, partition="mystery")
+    with pytest.raises(ValueError):
+        SchedulerFederation(MemStore(), replicas=0)

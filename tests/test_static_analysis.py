@@ -85,7 +85,7 @@ def test_every_checker_registered_and_documented():
     codes = {c.code for c in all_checkers()}
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
-        "MR001", "MR002", "MR003", "MR004", "TS001", "TS002",
+        "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -117,7 +117,7 @@ def test_fixture_violations_match_markers_exactly():
 @pytest.mark.parametrize("good", [
     "lock_good.py", "ops/jit_good.py", "sched/donate_good.py",
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
-    "spans_good.py", "cross/owner.py",
+    "spans_good.py", "cross/owner.py", "clock_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -148,6 +148,21 @@ def test_donation_and_transfer_checkers_cover_audited_files():
             assert f in res.coverage[code], (
                 f"{code} no longer covers {f}"
             )
+
+
+def test_clock_checker_covers_lease_backoff_files():
+    """CL001 (injectable-clock discipline) actually walks every
+    lease/backoff file federation's stepped-clock tests depend on — a
+    rename that drops one out of scope fails here, not silently."""
+    res = _repo_result()
+    covered = set(res.coverage.get("CL001", ()))
+    for f in (
+        "kubetpu/sched/leaderelection.py",
+        "kubetpu/sched/federation.py",
+        "kubetpu/sched/podgroup.py",
+        "kubetpu/queue/priority_queue.py",
+    ):
+        assert f in covered, f"CL001 no longer covers {f}"
 
 
 def test_audited_files_still_contain_what_the_checkers_guard():
