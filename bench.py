@@ -116,6 +116,23 @@ FEDERATION_LADDER = (1, 2, 4)
 FEDERATION_MODE = "race"
 FEDERATION_BUDGET_S = 420.0
 
+# --- binary wire-protocol ladder (kubetpu.api.codec) ------------------------
+# The fullstack 1k/2k/5k-node ladder under heavy watch fan-out (hundreds of
+# concurrent watchers — the big-cluster load the serialize-once body ring +
+# binary codec exist for), each rung run with --wire json AND --wire binary:
+# per-rung records embed wire_codec/wire_bytes_per_pod, and each pair feeds
+# one WireCodecComparison_* line (wire-byte reduction — acceptance ≥60% —
+# plus fullstack throughput speedup and the PR-8 soak p99_flat verdict).
+# Runs on BOTH backends (the workload is control-plane-bound; the kernel is
+# tiny), with its own budget so the required evidence always lands.
+WIRE_LADDER = (
+    ("SchedulingBasic", "1000Nodes", "greedy", 256),
+    ("SchedulingBasic", "2000Nodes", "greedy", 256),
+    ("SchedulingBasic", "5000Nodes_1000Pods", "greedy", 256),
+)
+WIRE_FANOUT = 200
+WIRE_BUDGET_S = 900.0
+
 QUADRATIC = {"SchedulingPodAffinity", "TopologySpreading"}
 
 
@@ -151,6 +168,8 @@ def run_stage(
     bulk: bool = True,
     mesh: bool = False,
     flight_recorder: bool = True,
+    wire: str = "binary",
+    watch_fanout: int = 0,
 ) -> dict:
     import contextlib
 
@@ -174,6 +193,11 @@ def run_stage(
     artifacts_dir = os.environ.get(
         "BENCH_ARTIFACTS_DIR", "bench_artifacts"
     ) or None
+    extra = {}
+    if mode != "direct":
+        # the wire seam exists only on the REST hop: direct mode has no
+        # apiserver, so the flags stay out of its runner call
+        extra = {"wire": wire, "watch_fanout": watch_fanout}
     t0 = time.perf_counter()
     with ctx:
         r = runner(
@@ -182,6 +206,7 @@ def run_stage(
             pipeline=pipeline, bulk=bulk,
             mesh=("auto" if mesh else None),
             flight_recorder=flight_recorder,
+            **extra,
         )
     wall = time.perf_counter() - t0
     suffix = "" if mode == "direct" else "_fullstack"
@@ -193,6 +218,10 @@ def run_stage(
         suffix += "_mesh"
     if not flight_recorder:
         suffix += "_norecorder"
+    if mode != "direct" and wire != "binary":
+        suffix += "_jsonwire"
+    if watch_fanout:
+        suffix += f"_{watch_fanout}watchers"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -228,6 +257,15 @@ def run_stage(
         # 4 decimals: the best bulk runs land WELL under 0.01 RPCs/pod and
         # a 2-decimal round would zero out the comparison's denominator
         out["rpcs_per_scheduled_pod"] = round(r.rpcs_per_scheduled_pod, 4)
+    # the wire-protocol acceptance metrics (fullstack): the codec the
+    # client actually NEGOTIATED (a fallback shows as "json", not as a
+    # silently slow binary run) + apiserver payload bytes per scheduled pod
+    if r.wire_codec:
+        out["wire_codec"] = r.wire_codec
+    if r.wire_bytes_per_pod is not None:
+        out["wire_bytes_per_pod"] = round(r.wire_bytes_per_pod, 1)
+    if r.watch_fanout:
+        out["watch_fanout"] = r.watch_fanout
     if r.dispatcher_batch_mean is not None:
         out["dispatcher_batch_mean"] = round(r.dispatcher_batch_mean, 1)
     if r.dispatcher_errors:
@@ -548,6 +586,86 @@ def _federation_record(r, case: str, workload: str, engine: str) -> dict:
     return out
 
 
+def _run_wire_stages() -> None:
+    """The binary-wire fullstack ladder (ROADMAP item 2): each rung runs
+    the SAME workload through the REST apiserver with WIRE_FANOUT extra
+    concurrent watchers, once per codec — binary (the negotiated compact
+    wire) and json (the escape hatch) — and emits one
+    WireCodecComparison_* line per rung: apiserver payload bytes per pod
+    side by side (wire_bytes_reduction, acceptance ≥0.60), fullstack
+    throughput speedup, and both runs' soak p99_flat verdicts."""
+    t0 = time.perf_counter()
+    for case, workload, engine, max_batch in WIRE_LADDER:
+        if time.perf_counter() - t0 > WIRE_BUDGET_S:
+            _status(f"wire budget exhausted; skipping {workload}")
+            continue
+        pair: dict[str, dict] = {}
+        for wire in ("json", "binary"):
+            elapsed = time.perf_counter() - t0
+            if elapsed > WIRE_BUDGET_S:
+                _status(f"wire budget exhausted; skipping {workload}/{wire}")
+                continue
+            _status(f"wire stage: {case}/{workload}/{engine} wire={wire} "
+                    f"fanout={WIRE_FANOUT} (t={elapsed:.0f}s)")
+            try:
+                line = run_stage(
+                    case, workload, engine, "fullstack", max_batch,
+                    wire=wire, watch_fanout=WIRE_FANOUT,
+                )
+            except Exception as e:
+                _emit({
+                    "metric": (
+                        f"{case}_{workload}_{engine}_fullstack"
+                        f"{'_jsonwire' if wire != 'binary' else ''}"
+                        f"_{WIRE_FANOUT}watchers"
+                    ),
+                    "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                    "engine": engine, "mode": "fullstack",
+                    "backend": _backend(), "wire_codec": wire,
+                    "watch_fanout": WIRE_FANOUT,
+                    "error": f"{type(e).__name__}: {e}",
+                })
+                _status(f"wire stage FAILED: {workload}/{wire}: {e}")
+                continue
+            pair[wire] = line
+            _emit(line)
+            _status(f"wire stage done: {line['metric']} = {line['value']} "
+                    f"pods/s ({line.get('wire_bytes_per_pod')} B/pod)")
+        jsonl, binl = pair.get("json"), pair.get("binary")
+        if not jsonl or not binl:
+            continue
+        fields = (
+            "value", "wire_codec", "wire_bytes_per_pod", "duration_s",
+            "p99_attempt_latency_ms",
+        )
+        comp = {
+            "metric": f"WireCodecComparison_{case}_{workload}_{engine}",
+            "unit": "ratio",
+            "mode": "fullstack",
+            "backend": binl.get("backend"),
+            "watch_fanout": WIRE_FANOUT,
+            "json": {k: jsonl.get(k) for k in fields
+                     if jsonl.get(k) is not None},
+            "binary": {k: binl.get(k) for k in fields
+                       if binl.get(k) is not None},
+            "soak_p99_flat": {
+                "json": (jsonl.get("soak") or {}).get("p99_flat"),
+                "binary": (binl.get("soak") or {}).get("p99_flat"),
+            },
+        }
+        jb = jsonl.get("wire_bytes_per_pod")
+        bb = binl.get("wire_bytes_per_pod")
+        if jb and bb is not None:
+            # the ≥60% acceptance number: payload bytes saved per pod
+            comp["wire_bytes_reduction"] = round(1.0 - bb / jb, 4)
+        if jsonl.get("value") and binl.get("value"):
+            comp["throughput_speedup"] = round(
+                binl["value"] / jsonl["value"], 3
+            )
+            comp["value"] = comp["throughput_speedup"]
+        _emit(comp)
+
+
 def _run_federation_stages() -> None:
     """The federation ladder + recovery stage: per-N bench rows, one
     FederationScaling_* line per rung (throughput speedup vs 1 replica,
@@ -685,11 +803,14 @@ def main() -> None:
     all_lines: list = []
     for stage in STAGES:
         # the optional 9th slot is flight_recorder (default on); only the
-        # overhead pair-completers carry it
+        # overhead pair-completers carry it. The optional 10th slot is the
+        # wire codec ("binary" default — fullstack stages negotiate the
+        # compact binary wire; "json" pins the escape hatch)
         case, workload, engine, mode, max_batch, pipeline, bulk, mesh = (
             stage[:8]
         )
         flight_recorder = stage[8] if len(stage) > 8 else True
+        wire = stage[9] if len(stage) > 9 else "binary"
         elapsed = time.perf_counter() - t_start
         if elapsed > TOTAL_BUDGET_S:
             _status(f"budget exhausted ({elapsed:.0f}s); skipping {case}/{engine}")
@@ -699,6 +820,7 @@ def main() -> None:
                 f"{'/nobulk' if not bulk else ''}"
                 f"{'/mesh' if mesh else ''}"
                 f"{'/norecorder' if not flight_recorder else ''}"
+                f"{'/jsonwire' if wire != 'binary' else ''}"
                 f" (t={elapsed:.0f}s)")
         suffix = "" if mode == "direct" else "_fullstack"
         if pipeline:
@@ -709,6 +831,8 @@ def main() -> None:
             suffix += "_mesh"
         if not flight_recorder:
             suffix += "_norecorder"
+        if mode != "direct" and wire != "binary":
+            suffix += "_jsonwire"
         # profile exactly ONE stage: the first quadratic TPU stage (the
         # north-star workload) — the artifact lands in ./xla_profile/
         profile_dir = None
@@ -721,7 +845,7 @@ def main() -> None:
             line = run_stage(case, workload, engine, mode, max_batch,
                              profile_dir=profile_dir, pipeline=pipeline,
                              bulk=bulk, mesh=mesh,
-                             flight_recorder=flight_recorder)
+                             flight_recorder=flight_recorder, wire=wire)
             if profile_dir is not None:
                 line["xla_profile"] = profile_dir
         except Exception as e:
@@ -765,6 +889,7 @@ def main() -> None:
     _emit_sharding_comparisons(mesh_pairs)
     _emit_flightrecorder_comparisons(fr_pairs)
     _emit_soak_lines(all_lines)
+    _run_wire_stages()
     _run_federation_stages()
     final = best_quadratic or best_any
     if final is None:

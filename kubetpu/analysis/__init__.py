@@ -43,3 +43,4 @@ from . import transfer  # noqa: F401,E402
 from . import metriccheck  # noqa: F401,E402
 from . import spancheck  # noqa: F401,E402
 from . import clockcheck  # noqa: F401,E402
+from . import wirecheck  # noqa: F401,E402

@@ -39,10 +39,28 @@ from . import types as t
 # object of the framework (the "API types" layer).
 _KINDS: dict[str, type] = {}
 
+# bumped on every registration: the binary codec (kubetpu.api.codec)
+# derives its schema tables from this registry and caches them per
+# generation, so a late registration rebuilds the tables (and changes
+# the negotiated schema fingerprint) instead of silently missing a kind
+_GENERATION = 0
+
 
 def register(cls: type, kind: str | None = None) -> type:
+    global _GENERATION
     _KINDS[kind or cls.__name__] = cls
+    _GENERATION += 1
     return cls
+
+
+def kind_registry() -> dict[str, type]:
+    """The live kind → dataclass map (read-only view for the codec's
+    schema-table derivation)."""
+    return _KINDS
+
+
+def registry_generation() -> int:
+    return _GENERATION
 
 
 for _cls in (
@@ -150,6 +168,11 @@ def _coerce(value: Any, hint: Any) -> Any:
     """Rebuild tuples/enums/nested dataclasses from the field annotation."""
     if value is None:
         return None
+    if dataclasses.is_dataclass(value) and not isinstance(value, type):
+        # already typed: the binary codec materializes nested objects
+        # before coercion (its object tag carries the kind), so a typed
+        # value passes straight through the same strict path
+        return value
     if isinstance(value, dict) and "kind" in value:
         return decode(value)
     origin = typing.get_origin(hint)
@@ -207,6 +230,25 @@ def _coerce(value: Any, hint: Any) -> Any:
             raise SchemeError(f"expected str, got {value!r}")
         return value
     return value
+
+
+def coerce_value(value: Any, hint: Any) -> Any:
+    """Public face of the field-coercion rules (tuple rebuild, enum
+    reconstruction, strict primitive checks) — the binary codec decodes
+    through the SAME rules as the JSON path, so the two codecs cannot
+    drift on what a field accepts."""
+    return _coerce(value, hint)
+
+
+def apply_defaults(obj: Any) -> Any:
+    """Run the kind's registered defaulting hook (every decode path —
+    JSON and binary — must apply the same defaults)."""
+    return _apply_defaults(obj)
+
+
+def type_hints(cls: type) -> dict[str, Any]:
+    """Resolved field annotations for a registered class (cached)."""
+    return _resolve_hints(cls)
 
 
 def _decode_into(cls: type, data: dict) -> Any:

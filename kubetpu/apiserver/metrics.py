@@ -85,6 +85,39 @@ class APIServerMetrics:
             "(watch streams).",
             labels=("verb", "resource"),
         )
+        # the wire-protocol evidence counter: payload bytes by codec and
+        # direction (request bodies in, reply/stream bodies out) — the
+        # bench ladder's wire_bytes_per_pod numerator and the ≥60%
+        # byte-reduction acceptance read from here
+        self.wire_bytes = r.counter(
+            "apiserver_wire_bytes_total",
+            "Request and response wire payload bytes by codec and "
+            "direction.",
+            labels=("codec", "direction"),
+            declared={
+                "codec": ("json", "binary"),
+                "direction": ("in", "out"),
+            },
+        )
+
+    def count_wire(self, codec: str, direction: str, n: int) -> None:
+        """Record ``n`` payload bytes moving through the wire seam."""
+        if n:
+            self.wire_bytes.labels(codec, direction).inc(n)
+
+    def wire_bytes_total(self, codec: str | None = None,
+                         direction: str | None = None) -> int:
+        """Lifetime wire payload bytes, optionally filtered by codec
+        and/or direction — the perf harness's wire-traffic numerator."""
+        total = 0
+        for key, child in self.wire_bytes._children_snapshot():
+            c, d = key
+            if codec is not None and c != codec:
+                continue
+            if direction is not None and d != direction:
+                continue
+            total += int(child.value)
+        return total
 
     def admit_resource(self, resource: str) -> str:
         """Admit ``resource`` as a label value once the caller has PROOF

@@ -10,17 +10,25 @@ shape (components talk only to the apiserver, SURVEY §1).
 Watch is the pull form: ``RemoteWatcher.poll`` GETs
 ``?watch=1&resourceVersion=<cursor>`` with a short long-poll; HTTP 410 maps
 back to ``CompactedError`` so the reflector's relist path fires.
+
+WIRE NEGOTIATION (kubetpu.api.codec): with ``wire="binary"`` (the default)
+every request carries ``Accept: application/x-kubetpu-bin; v=…;
+schema=<fp>``; the server replies binary only when the fingerprint matches
+its own, and the first binary-typed response CONFIRMS the dialect — only
+then do request bodies switch to binary (a body is never sent in a format
+the server has not proven it decodes). A 415 at any point (schema drift, a
+JSON-only server) drops this client to JSON permanently and re-issues the
+request once — mixed-version client/server pairs keep working in both
+directions. Responses always decode by their Content-Type, so the two
+sides never have to agree in advance.
 """
 
 from __future__ import annotations
 
 import http.client
-import json
-import urllib.error
-import urllib.request
 from typing import Any
 
-from ..api import scheme
+from ..api import codec
 from ..store.memstore import CompactedError, ConflictError, WatchEvent
 
 BULK_SUFFIX = ":bulk"
@@ -38,14 +46,29 @@ class RemoteUnavailableError(ConnectionError):
 
 
 class RemoteStore:
-    def __init__(self, base_url: str, timeout_s: float = 30.0) -> None:
+    def __init__(self, base_url: str, timeout_s: float = 30.0,
+                 wire: str = "binary") -> None:
         import threading
 
+        if wire not in ("binary", "json"):
+            raise ValueError(f"wire must be binary|json, got {wire!r}")
         self.base = base_url.rstrip("/")
         self.timeout_s = timeout_s
         # persistent per-THREAD connections (client-go's transport reuse):
         # a fresh TCP handshake per request would dominate the bind path
         self._local = threading.local()
+        # negotiation state: None = undetermined (Accept advertises binary,
+        # bodies still ride JSON), True = server confirmed our dialect
+        # (bodies go binary), False = JSON only (wire="json", or a 415
+        # dropped us there permanently). Plain attribute: worst case two
+        # threads re-confirm/re-fall-back — both idempotent.
+        self._wire_ok: "bool | None" = None if wire == "binary" else False
+
+    @property
+    def wire_codec(self) -> str:
+        """The codec request BODIES currently ride ("binary" only after
+        the server confirmed the dialect) — the bench's wire_codec tag."""
+        return codec.BINARY if self._wire_ok else codec.JSON
 
     # ------------------------------------------------------------ plumbing
     def _connection(self):
@@ -80,53 +103,33 @@ class RemoteStore:
                 pass
         self._local.conn = None
 
-    def _request(self, method: str, path: str, body: dict | None = None):
-        """One request with ONE safe retry. Blindly resending a non-
-        idempotent verb after a transport error could double-apply it (a
-        create whose response was lost resends → 409 for a create that
-        SUCCEEDED), so the retry is limited to failures that prove the
-        server never processed the request: a send-phase error, or the
-        keep-alive idle-close race (RemoteDisconnected on a REUSED socket —
-        the server dropped the idle connection before reading). GETs retry
-        on any transport error; everything else surfaces as
-        RemoteUnavailableError for the caller to decide."""
-        data = json.dumps(body).encode() if body is not None else None
-        status, raw = 0, b""
-        last: Exception | None = None
-        for attempt in range(2):
-            conn, reused = self._connection()
-            try:
-                conn.request(
-                    method, path, body=data,
-                    headers={"Content-Type": "application/json"},
-                )
-            except (ConnectionError, TimeoutError, OSError,
-                    http.client.HTTPException) as e:
-                # send never completed: safe to retry any verb once
-                self._drop_connection()
-                last = e
+    def _request(self, method: str, path: str, body: Any = None):
+        """One request through the wire seam. ``body`` is the reply-shaped
+        TREE (may contain live registered dataclasses) — the negotiated
+        codec encodes it here, so no caller pre-serializes. A 415 response
+        means the server cannot decode our binary dialect: fall back to
+        JSON permanently and re-issue once (the mixed-version path)."""
+        for _wire_attempt in range(2):
+            status, raw, resp_ct = self._request_transport(
+                method, path, body
+            )
+            if status == 415 and self._wire_ok is not False:
+                self._wire_ok = False
                 continue
-            try:
-                resp = conn.getresponse()
-                status, raw = resp.status, resp.read()
-                break
-            except (ConnectionError, TimeoutError, OSError,
-                    http.client.HTTPException) as e:
-                self._drop_connection()
-                last = e
-                idle_close = reused and isinstance(
-                    e, (http.client.RemoteDisconnected, ConnectionResetError)
-                )
-                if attempt == 0 and (method == "GET" or idle_close):
-                    continue
-                raise RemoteUnavailableError(str(e)) from None
-        else:
-            raise RemoteUnavailableError(str(last)) from None
+            break
         if status < 400:
-            return json.loads(raw or b"{}")
+            try:
+                return codec.loads(
+                    raw or b"{}", codec.codec_for_content_type(resp_ct)
+                )
+            except codec.UnsupportedWireError as e:
+                raise RemoteStoreError(f"undecodable response: {e}") \
+                    from None
         payload = {}
         try:
-            payload = json.loads(raw or b"{}")
+            payload = codec.loads(
+                raw or b"{}", codec.codec_for_content_type(resp_ct)
+            )
         except Exception:
             pass
         reason = payload.get("error", f"HTTP {status}")
@@ -145,13 +148,74 @@ class RemoteStore:
             raise PermissionError(reason)
         raise RemoteStoreError(f"{status}: {reason}")
 
+    def _request_headers(self, wire_out: str) -> dict:
+        headers = {"Content-Type": codec.content_type_for(wire_out)}
+        if self._wire_ok is not False:
+            # advertise our binary dialect (media type + schema
+            # fingerprint); a server that matches replies binary and
+            # thereby confirms it
+            headers["Accept"] = codec.binary_content_type()
+        return headers
+
+    def _note_response_ct(self, resp_ct: "str | None") -> None:
+        """First binary-typed response confirms the dialect — request
+        bodies switch to binary from here on."""
+        if (
+            self._wire_ok is None and resp_ct
+            and codec.CT_BINARY in resp_ct
+        ):
+            self._wire_ok = True
+
+    def _request_transport(self, method: str, path: str, body: Any):
+        """The transport half with ONE safe retry. Blindly resending a
+        non-idempotent verb after a transport error could double-apply it
+        (a create whose response was lost resends → 409 for a create that
+        SUCCEEDED), so the retry is limited to failures that prove the
+        server never processed the request: a send-phase error, or the
+        keep-alive idle-close race (RemoteDisconnected on a REUSED socket —
+        the server dropped the idle connection before reading). GETs retry
+        on any transport error; everything else surfaces as
+        RemoteUnavailableError for the caller to decide. Returns
+        (status, raw body, response content type)."""
+        wire_out = codec.BINARY if self._wire_ok else codec.JSON
+        data = codec.dumps(body, wire_out) if body is not None else None
+        headers = self._request_headers(wire_out)
+        last: Exception | None = None
+        for attempt in range(2):
+            conn, reused = self._connection()
+            try:
+                conn.request(method, path, body=data, headers=headers)
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                # send never completed: safe to retry any verb once
+                self._drop_connection()
+                last = e
+                continue
+            try:
+                resp = conn.getresponse()
+                status, raw = resp.status, resp.read()
+                resp_ct = resp.getheader("Content-Type")
+                self._note_response_ct(resp_ct)
+                return status, raw, resp_ct
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException) as e:
+                self._drop_connection()
+                last = e
+                idle_close = reused and isinstance(
+                    e, (http.client.RemoteDisconnected, ConnectionResetError)
+                )
+                if attempt == 0 and (method == "GET" or idle_close):
+                    continue
+                raise RemoteUnavailableError(str(e)) from None
+        raise RemoteUnavailableError(str(last)) from None
+
     # ------------------------------------------------------ store protocol
     def get(self, kind: str, key: str):
         try:
             res = self._request("GET", f"/apis/{kind}/{key}")
         except KeyError:
             return None, 0
-        return scheme.decode(res["object"]), res["resourceVersion"]
+        return codec.as_object(res["object"]), res["resourceVersion"]
 
     def list(
         self, kind: str,
@@ -161,23 +225,19 @@ class RemoteStore:
             "GET", f"/apis/{kind}{_sel_qs('?', label_selector, field_selector)}"
         )
         return (
-            [(i["key"], scheme.decode(i["object"])) for i in res["items"]],
+            [(i["key"], codec.as_object(i["object"])) for i in res["items"]],
             res["resourceVersion"],
         )
 
     def create(self, kind: str, key: str, obj: Any) -> int:
-        res = self._request(
-            "POST", f"/apis/{kind}/{key}", scheme.encode(obj)
-        )
+        res = self._request("POST", f"/apis/{kind}/{key}", obj)
         return res["resourceVersion"]
 
     def update(
         self, kind: str, key: str, obj: Any, expect_rv: int | None = None
     ) -> int:
         q = f"?resourceVersion={expect_rv}" if expect_rv is not None else ""
-        res = self._request(
-            "PUT", f"/apis/{kind}/{key}{q}", scheme.encode(obj)
-        )
+        res = self._request("PUT", f"/apis/{kind}/{key}{q}", obj)
         return res["resourceVersion"]
 
     def delete(self, kind: str, key: str) -> int:
@@ -196,7 +256,7 @@ class RemoteStore:
         for op in ops:
             w = {"op": op["op"], "key": op["key"]}
             if "object" in op:
-                w["object"] = scheme.encode(op["object"])
+                w["object"] = op["object"]    # live; the codec encodes it
             if op.get("expect_rv") is not None:
                 w["resourceVersion"] = op["expect_rv"]
             wire.append(w)
@@ -205,7 +265,7 @@ class RemoteStore:
         out = []
         for r in res["results"]:
             if r.get("object") is not None:
-                r = dict(r, object=scheme.decode(r["object"]))
+                r = dict(r, object=codec.as_object(r["object"]))
             out.append(r)
         return out
 
@@ -231,7 +291,7 @@ class RemoteStore:
                 [
                     WatchEvent(
                         type=e["type"], kind=kind, key=e["key"],
-                        obj=scheme.decode(e["object"]),
+                        obj=codec.as_object(e["object"]),
                         resource_version=e["resourceVersion"],
                     )
                     for e in bucket["events"]
@@ -314,7 +374,7 @@ class RemoteWatcher:
         return [
             WatchEvent(
                 type=e["type"], kind=self._kind, key=e["key"],
-                obj=scheme.decode(e["object"]),
+                obj=codec.as_object(e["object"]),
                 resource_version=e["resourceVersion"],
             )
             for e in res["events"]
@@ -356,8 +416,11 @@ class RemoteStreamWatcher:
         return self._rv
 
     def _reader(self, start_rv: int) -> None:
-        """One connection's lifetime: connect, decode lines, enqueue.
-        Ends on EOF/error; poll() restarts it from the current cursor."""
+        """One connection's lifetime: connect, decode frames, enqueue.
+        The stream's framing follows the response Content-Type — ndjson
+        lines, or u32-length-prefixed binary frames when the server
+        negotiated our binary dialect (the Accept header below). Ends on
+        EOF/error; poll() restarts it from the current cursor."""
         from urllib.parse import urlsplit
 
         conn = resp = None
@@ -367,11 +430,15 @@ class RemoteStreamWatcher:
                 u.hostname, u.port,
                 timeout=self._stream_timeout_s + self._store.timeout_s,
             )
+            headers = {}
+            if self._store._wire_ok is not False:
+                headers["Accept"] = codec.binary_stream_content_type()
             conn.request(
                 "GET",
                 f"/apis/{self._kind}?watch=1&stream=1"
                 f"&resourceVersion={start_rv}"
                 f"&timeoutSeconds={self._stream_timeout_s}{self._sel}",
+                headers=headers,
             )
             resp = conn.getresponse()
             self._sock = conn.sock   # close() shutdowns this to wake us
@@ -384,20 +451,11 @@ class RemoteStreamWatcher:
                     else RemoteStoreError(f"{resp.status}: {body[:200]!r}"),
                 ))
                 return
-            for raw in resp:
-                line = raw.strip()
-                if not line:
-                    continue
-                try:
-                    msg = json.loads(line)
-                except json.JSONDecodeError:
-                    continue
-                if msg.get("code") == 410:
-                    self._queue.append(
-                        ("error", CompactedError(msg.get("error", "compacted")))
-                    )
-                    return
-                self._queue.append(("event", msg))
+            ct = resp.getheader("Content-Type") or ""
+            if codec.CT_BINARY in ct:
+                self._read_binary_frames(resp)
+            else:
+                self._read_ndjson(resp)
         except (ConnectionError, TimeoutError, OSError,
                 http.client.HTTPException,
                 AttributeError, ValueError):
@@ -413,6 +471,55 @@ class RemoteStreamWatcher:
                 except OSError:
                     pass
 
+    def _enqueue(self, msg: dict) -> bool:
+        """One decoded frame → the queue; False ends the stream (410)."""
+        if msg.get("code") == 410:
+            self._queue.append(
+                ("error", CompactedError(msg.get("error", "compacted")))
+            )
+            return False
+        self._queue.append(("event", msg))
+        return True
+
+    def _read_ndjson(self, resp) -> None:
+        for raw in resp:
+            line = raw.strip()
+            if not line:
+                continue
+            try:
+                msg = codec.loads(line, codec.JSON)
+            except codec.UnsupportedWireError:
+                continue
+            if not self._enqueue(msg):
+                return
+
+    def _read_binary_frames(self, resp) -> None:
+        """u32-LE length prefix + one self-contained binary value per
+        frame (codec.stream_frame's negotiated form)."""
+        def read_exact(n: int) -> bytes:
+            chunks = []
+            while n:
+                got = resp.read(n)
+                if not got:
+                    return b""
+                chunks.append(got)
+                n -= len(got)
+            return b"".join(chunks)
+
+        while True:
+            head = read_exact(4)
+            if len(head) < 4:
+                return                      # EOF between frames
+            body = read_exact(int.from_bytes(head, "little"))
+            if not body:
+                return
+            try:
+                msg = codec.loads(body, codec.BINARY)
+            except codec.UnsupportedWireError:
+                return                      # torn frame: reconnect
+            if not self._enqueue(msg):
+                return
+
     def poll(self) -> list[WatchEvent]:
         import threading
 
@@ -424,7 +531,7 @@ class RemoteStreamWatcher:
             self._rv = payload["resourceVersion"]
             out.append(WatchEvent(
                 type=payload["type"], kind=self._kind, key=payload["key"],
-                obj=scheme.decode(payload["object"]),
+                obj=codec.as_object(payload["object"]),
                 resource_version=payload["resourceVersion"],
             ))
         if not self._closed and (

@@ -47,29 +47,35 @@ envelope here:
     DELETE /apis/<kind>/<key…>          delete (404 when absent)
 
 Watch responses are assembled from a serialize-once event cache (the
-reference watch cache's CachingObject): each event's JSON is encoded once
-per (kind, resourceVersion) and the cached bytes are shared across every
-watcher poll, batched poll, and stream frame — N watchers pay one encode,
-not N. Staleness is impossible by construction: every store write mints a
-fresh resourceVersion, so a mutated object can never be served from an old
-entry.
+reference watch cache's CachingObject): each event's wire body is encoded
+once per (kind, resourceVersion, codec) and the cached bytes are shared
+across every watcher poll, batched poll, and stream frame — N watchers pay
+one encode, not N. Staleness is impossible by construction: every store
+write mints a fresh resourceVersion, so a mutated object can never be
+served from an old entry. When the store exposes its per-event body ring
+(``MemStore.events_body_since`` — backed by the native core), the unscoped
+watch paths serve cached bodies STRAIGHT from the ring without ever
+materializing a WatchEvent.
 
-Objects ride the Scheme codec (kubetpu.api.scheme — the "kind"-tagged JSON
-serializer), so any registered type round-trips. The watch response is the
-pull form of the reference's chunked watch stream: clients poll with their
-cursor, the server long-polls against the store's condition variable —
-the Reflector's ListAndWatch maps onto exactly these two endpoints
-(see kubetpu.apiserver.remote.RemoteStore).
+Every reply rides the wire-codec seam (kubetpu.api.codec — the negotiated
+serializer): the reply codec is negotiated per request from the ``Accept``
+header (binary only when the client's schema fingerprint matches ours),
+request bodies decode by their ``Content-Type`` (an unknown/mismatched
+binary dialect 415s — the client's fall-back-to-JSON signal), and NO
+handler hand-rolls serialization. The watch response is the pull form of
+the reference's chunked watch stream: clients poll with their cursor, the
+server long-polls against the store's condition variable — the Reflector's
+ListAndWatch maps onto exactly these two endpoints (see
+kubetpu.apiserver.remote.RemoteStore).
 """
 
 from __future__ import annotations
 
-import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
-from ..api import scheme
+from ..api import codec, scheme
 from ..metrics.health import HealthChecks
 from ..store.memstore import CompactedError, ConflictError, MemStore
 from .admission import AdmissionDenied, Registry, ValidationError
@@ -133,14 +139,24 @@ def _stamp_pod_ingest(kind: str, obj):
 
 class EventEncodeCache:
     """Serialize-once watch fan-out (the reference watch cache's
-    CachingObject, cacher/caching_object.go): one JSON encoding per event,
-    keyed by (kind, resourceVersion) — unique per event because every
-    store write bumps the global revision exactly once — and shared by
-    every long-poll reply, batched poll bucket, and stream frame. Bounded
-    LRU sized to the store's event history; hit/miss counters feed the
-    apiserver metric set."""
+    CachingObject, cacher/caching_object.go): one wire encoding per event
+    PER CODEC, keyed by (kind, resourceVersion, codec, tombstone) — unique
+    per event because every store write bumps the global revision exactly
+    once — and shared by every long-poll reply, batched poll bucket, and
+    stream frame. The ``tombstone`` key dimension is the selector-scoped
+    view: a scoped DELETED (including a selector REWRITE of an
+    ADDED/MODIFIED) ships no object body, and because the tombstone's
+    bytes depend only on (key, rv) — never on WHICH selector scoped it —
+    one cached tombstone serves every scoped watcher (scoped fan-out used
+    to bypass the cache entirely and re-serialize per watcher per event).
+    Bounded LRU sized to the store's event history TIMES the key-space
+    growth (2 codecs x body/tombstone = up to 4 entries per ring event —
+    an 8192-entry LRU would cover as little as a quarter of the history
+    under mixed-codec scoped fan-out, quietly reintroducing per-poll
+    re-encodes); hit/miss counters (merged with the store body ring's,
+    when one is bound) feed the codec-labeled apiserver metric set."""
 
-    def __init__(self, maxsize: int = 8192) -> None:
+    def __init__(self, maxsize: int = 4 * 8192, store=None) -> None:
         import collections
         import threading
 
@@ -149,16 +165,27 @@ class EventEncodeCache:
         self._entries: "collections.OrderedDict[tuple, bytes]" = (
             collections.OrderedDict()
         )
-        self.hits = 0
-        self.misses = 0
+        # the store whose native body ring ALSO serves cached event bodies
+        # (the unscoped fast path bypasses this LRU entirely) — its
+        # hit/miss counters merge into ours so "serialize-once" reads as
+        # one number regardless of which cache carried the bytes
+        self._store = store
+        self._hits = {codec.JSON: 0, codec.BINARY: 0}
+        self._misses = {codec.JSON: 0, codec.BINARY: 0}
 
-    def event_bytes(self, e) -> bytes:
-        key = (e.kind, e.resource_version)
+    def event_bytes(self, e, wire: str = codec.JSON,
+                    tombstone: bool = False) -> bytes:
+        # the registry generation keys the entry too: binary bodies embed
+        # schema-table ids, so a kind registered after an entry was cached
+        # must never let that entry splice into a new-fingerprint reply
+        # (old-generation entries just age out of the LRU)
+        key = (e.kind, e.resource_version, wire, tombstone,
+               scheme.registry_generation())
         with self._lock:
             cached = self._entries.get(key)
             if cached is not None:
                 self._entries.move_to_end(key)
-                self.hits += 1
+                self._hits[wire] += 1
                 return cached
         # encode OUTSIDE the lock, last-writer-wins on insert: when a
         # write wakes N long-poll watchers at once, the worst case is a
@@ -166,17 +193,41 @@ class EventEncodeCache:
         # ever blocking a request thread on another's encode. The steady
         # win (every later poll/stream frame reuses the bytes) is carried
         # by the LRU.
-        body = json.dumps({
-            "type": e.type, "key": e.key,
-            "object": scheme.encode(e.obj),
-            "resourceVersion": e.resource_version,
-        }, separators=(",", ":")).encode()
+        body = codec.event_wire_bytes(
+            "DELETED" if tombstone else e.type,
+            e.key,
+            None if tombstone else e.obj,
+            e.resource_version,
+            wire,
+        )
         with self._lock:
-            self.misses += 1
+            self._misses[wire] += 1
             self._entries[key] = body
             while len(self._entries) > self._maxsize:
                 self._entries.popitem(last=False)
         return body
+
+    def _ring_stats(self) -> dict:
+        stats = getattr(self._store, "body_cache_stats", None)
+        return stats() if stats is not None else {}
+
+    def stats_by_codec(self) -> "dict[str, tuple[int, int]]":
+        """{codec: (hits, misses)} — this LRU plus the store body ring."""
+        ring = self._ring_stats()
+        out = {}
+        with self._lock:
+            for c in (codec.JSON, codec.BINARY):
+                rh, rm = ring.get(c, (0, 0))
+                out[c] = (self._hits[c] + rh, self._misses[c] + rm)
+        return out
+
+    @property
+    def hits(self) -> int:
+        return sum(h for h, _m in self.stats_by_codec().values())
+
+    @property
+    def misses(self) -> int:
+        return sum(m for _h, m in self.stats_by_codec().values())
 
 
 class _Handler(BaseHTTPRequestHandler):
@@ -186,21 +237,58 @@ class _Handler(BaseHTTPRequestHandler):
     health: HealthChecks        # /healthz /readyz /livez (bound by factory)
     event_cache: EventEncodeCache   # serialize-once fan-out (bound by factory)
     metrics_sources: tuple = ()  # extra Prometheus-text providers
+    wire_enabled: bool = True    # False = JSON-only server (--wire json):
+    #                              ignores binary Accept, 415s binary bodies
     protocol_version = "HTTP/1.1"
 
     def log_message(self, *args) -> None:
         pass
 
     # ------------------------------------------------------------ plumbing
-    def _reply(self, obj, status: int = 200) -> None:
-        self._reply_bytes(json.dumps(obj).encode(), status=status)
+    def _reply_codec(self) -> str:
+        """The negotiated REPLY codec for this request: binary only when
+        the Accept header names our exact binary dialect (media type +
+        schema fingerprint) and the server has binary enabled — anything
+        else degrades to JSON, never to an undecodable reply."""
+        if not self.wire_enabled:
+            return codec.JSON
+        return (
+            codec.BINARY
+            if codec.accepts_binary(self.headers.get("Accept"))
+            else codec.JSON
+        )
 
-    def _reply_bytes(self, body: bytes, status: int = 200) -> None:
-        """Pre-serialized JSON reply — the serialize-once watch paths hand
-        cached event bytes straight to the socket."""
+    def _body_codec(self) -> str:
+        """The codec this request's BODY is encoded in (Content-Type).
+        Raises UnsupportedWireError — the 415 — for a binary dialect we
+        cannot decode, or any binary body when the server is JSON-only.
+        The JSON-only check parses the media type (same normalization as
+        codec_for_content_type) so a mixed-case binary Content-Type
+        cannot slip a binary body past --wire json."""
+        ct = self.headers.get("Content-Type")
+        media, _params = codec.parse_content_type(ct)
+        if not self.wire_enabled and media in (
+            codec.CT_BINARY, codec.CT_BINARY_STREAM,
+        ):
+            raise codec.UnsupportedWireError(
+                "binary wire disabled on this server (negotiate JSON)"
+            )
+        return codec.codec_for_content_type(ct)
+
+    def _reply(self, obj, status: int = 200) -> None:
+        """One reply through the wire seam — ``obj`` may contain live
+        registered dataclasses; the negotiated codec encodes them in
+        place (no handler pre-serializes)."""
+        wire = self._reply_codec()
+        self._reply_wire(codec.dumps(obj, wire), wire, status=status)
+
+    def _reply_wire(self, body: bytes, wire: str, status: int = 200) -> None:
+        """Pre-serialized reply in ``wire`` — the serialize-once watch
+        paths hand cached event bytes straight to the socket."""
+        self.metrics.count_wire(wire, "out", len(body))
         self._status = status
         self.send_response(status)
-        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Type", codec.content_type_for(wire))
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()
         self.wfile.write(body)
@@ -232,8 +320,12 @@ class _Handler(BaseHTTPRequestHandler):
 
     def _read_body(self):
         length = int(self.headers.get("Content-Length", 0))
-        raw = self.rfile.read(length) if length else b"{}"
-        return json.loads(raw or b"{}")
+        raw = self.rfile.read(length) if length else b""
+        wire = self._body_codec()   # may raise the 415
+        self.metrics.count_wire(wire, "in", len(raw))
+        if not raw:
+            return {}
+        return codec.loads(raw, wire)
 
     # -------------------------------------------------------- diagnostics
     def _serve_diagnostics(self) -> None:
@@ -318,8 +410,7 @@ class _Handler(BaseHTTPRequestHandler):
                     self.metrics.admit_resource(kind)
                 self._reply({
                     "items": [
-                        {"key": k, "object": scheme.encode(o)}
-                        for k, o in items
+                        {"key": k, "object": o} for k, o in items
                     ],
                     "resourceVersion": rv,
                 })
@@ -328,9 +419,7 @@ class _Handler(BaseHTTPRequestHandler):
                 if obj is None:
                     self._error(404, f"{kind}/{key} not found")
                 else:
-                    self._reply({
-                        "object": scheme.encode(obj), "resourceVersion": rv,
-                    })
+                    self._reply({"object": obj, "resourceVersion": rv})
         except ValueError as e:
             # malformed selector / resourceVersion: the CLIENT's error —
             # a retry-on-5xx loop must not hammer a permanently-bad request
@@ -351,46 +440,83 @@ class _Handler(BaseHTTPRequestHandler):
         fs = q.get("fieldSelector", "")
         return SelectorView(ls, fs) if (ls or fs) else None
 
-    def _event_bytes(self, e, scoped: bool) -> bytes:
-        """One event's wire JSON. Unscoped (and scoped non-DELETED) events
-        ride the serialize-once cache; a scoped DELETED is a per-view
-        tombstone — possibly a selector REWRITE sharing the original
-        event's (kind, rv) — so it must never touch the shared cache."""
+    def _event_bytes(self, e, scoped: bool, wire: str) -> bytes:
+        """One event's wire body, always through the serialize-once cache.
+        A scoped DELETED (including a selector REWRITE of the original
+        event) ships no object body — the cache's ``tombstone`` key
+        dimension keeps it distinct from the unscoped full-body entry
+        while still sharing ONE encoding across every scoped watcher."""
         if scoped and e.type == "DELETED":
             # selector-scoped stream: never ship a body on DELETED (the
             # informer deletes by key; a tombstoned object may not even
             # match the selector)
-            return json.dumps({
-                "type": "DELETED", "key": e.key, "object": None,
-                "resourceVersion": e.resource_version,
-            }, separators=(",", ":")).encode()
-        return self.event_cache.event_bytes(e)
+            return self.event_cache.event_bytes(e, wire, tombstone=True)
+        return self.event_cache.event_bytes(e, wire)
 
-    def _events_body(self, events, cursor: int, scoped: bool) -> bytes:
-        """The long-poll reply (and a batched-poll bucket) assembled from
-        cached event bytes."""
-        return (
-            b'{"events":['
-            + b",".join(self._event_bytes(e, scoped) for e in events)
-            + b'],"resourceVersion":' + str(cursor).encode() + b"}"
+    def _events_body(self, events, cursor: int, scoped: bool,
+                     wire: str) -> bytes:
+        """The long-poll reply (and a batched-poll bucket) assembled by
+        SPLICING cached event bytes — no event re-encodes on fan-out."""
+        return codec.events_envelope(
+            [self._event_bytes(e, scoped, wire) for e in events],
+            cursor, wire,
         )
 
     def _watch(self, kind: str, q: dict) -> None:
+        wire = self._reply_codec()
         rv = int(q.get("resourceVersion", 0))
         timeout = min(float(q.get("timeoutSeconds", 10)), 60.0)
         view = self._selector_view(q)
+        # unscoped fast path: the store's per-event body ring hands back
+        # cached wire bodies directly — no WatchEvent is ever materialized
+        # on the fan-out path (the native core's list/watch hot loop)
+        body_since = (
+            getattr(self.store, "events_body_since", None)
+            if view is None else None
+        )
         try:
-            events, cursor = self.store._events_since(kind, rv)
-            if not events and timeout > 0:
-                self.store.wait_for(rv, timeout=timeout)
+            if body_since is not None:
+                parts, cursor = body_since(kind, rv, wire)
+                if not parts and timeout > 0:
+                    self.store.wait_for(rv, timeout=timeout)
+                    parts, cursor = body_since(kind, rv, wire)
+                body = codec.events_envelope(parts, cursor, wire)
+            else:
                 events, cursor = self.store._events_since(kind, rv)
+                if not events and timeout > 0:
+                    self.store.wait_for(rv, timeout=timeout)
+                    events, cursor = self.store._events_since(kind, rv)
+                if view is not None:
+                    events = view.filter(events)
+                body = self._events_body(events, cursor, view is not None,
+                                         wire)
         except CompactedError as e:
             # the watch cache's "too old resource version" → HTTP 410
             self._error(410, str(e))
             return
-        if view is not None:
-            events = view.filter(events)
-        self._reply_bytes(self._events_body(events, cursor, view is not None))
+        self._reply_wire(body, wire)
+
+    def _drain_buckets(self, buckets: dict, wire: str):
+        """One drain of every bucket's cursor → ({kind: (event bodies,
+        cursor) | CompactedError}, drain revision). Uses the store's
+        body-ring bulk drain when it has one (ONE lock round, cached
+        bodies, zero WatchEvent churn); otherwise materializes through
+        ``events_since_bulk`` + the serialize-once cache."""
+        bulk_bodies = getattr(self.store, "events_body_since_bulk", None)
+        if bulk_bodies is not None:
+            return bulk_bodies(buckets, wire)
+        results, drain_rv = self.store.events_since_bulk(buckets)
+        out: dict = {}
+        for kind, res in results.items():
+            if isinstance(res, CompactedError):
+                out[kind] = res
+                continue
+            events, cursor = res
+            out[kind] = (
+                [self._event_bytes(e, False, wire) for e in events],
+                cursor,
+            )
+        return out, drain_rv
 
     def _watch_bulk(self, q: dict) -> None:
         """Batched watch poll: ``buckets=pods:12,nodes:7`` drains every
@@ -398,6 +524,7 @@ class _Handler(BaseHTTPRequestHandler):
         with per-kind results (a compacted cursor 410s only its own
         bucket). Selectors are not supported on the batched poll (the
         per-kind endpoint remains for scoped watchers)."""
+        wire = self._reply_codec()
         buckets: dict[str, int] = {}
         for part in q["buckets"].split(","):
             kind, sep, rv = part.rpartition(":")
@@ -405,7 +532,7 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError(f"malformed bucket {part!r} (want kind:rv)")
             buckets[kind] = int(rv)
         timeout = min(float(q.get("timeoutSeconds", 0)), 60.0)
-        results, drain_rv = self.store.events_since_bulk(buckets)
+        results, drain_rv = self._drain_buckets(buckets, wire)
         if timeout > 0 and not any(
             isinstance(r, CompactedError) or r[0]
             for r in results.values()
@@ -413,28 +540,29 @@ class _Handler(BaseHTTPRequestHandler):
             # wait on the revision captured AT the drain (same lock round):
             # a write landing after the drain wakes this immediately
             self.store.wait_for(drain_rv, timeout=timeout)
-            results, _ = self.store.events_since_bulk(buckets)
+            results, _ = self._drain_buckets(buckets, wire)
         parts = []
         for kind in buckets:
             res = results[kind]
             if isinstance(res, CompactedError):
-                body = json.dumps(
-                    {"error": str(res), "code": 410},
-                    separators=(",", ":"),
-                ).encode()
+                body = codec.dumps({"error": str(res), "code": 410}, wire)
             else:
-                events, cursor = res
-                body = self._events_body(events, cursor, scoped=False)
-            parts.append(json.dumps(kind).encode() + b":" + body)
-        self._reply_bytes(b'{"buckets":{' + b",".join(parts) + b"}}")
+                bodies, cursor = res
+                body = codec.events_envelope(bodies, cursor, wire)
+            parts.append((kind, body))
+        self._reply_wire(codec.buckets_envelope(parts, wire), wire)
 
     def _watch_stream(self, kind: str, q: dict) -> None:
-        """Chunked ndjson stream: events written as they happen, connection
+        """Chunked watch stream: events written as they happen, connection
         held open up to ``timeoutSeconds`` (capped) — the watch-stream form
-        of the same cursor protocol. A compaction mid-stream emits an error
-        line with code 410 and ends the stream (client relists)."""
+        of the same cursor protocol. JSON streams are ndjson (one event
+        per line); a negotiated binary stream is u32-length-prefixed
+        frames (``application/x-kubetpu-bin-seq``). A compaction
+        mid-stream emits an error frame with code 410 and ends the stream
+        (client relists)."""
         import time as _time
 
+        wire = self._reply_codec()
         rv = int(q.get("resourceVersion", 0))
         timeout = min(float(q.get("timeoutSeconds", 30)), 300.0)
         try:
@@ -442,14 +570,22 @@ class _Handler(BaseHTTPRequestHandler):
         except ValueError as e:
             self._error(400, str(e))
             return
+        body_since = (
+            getattr(self.store, "events_body_since", None)
+            if view is None else None
+        )
         deadline = _time.monotonic() + timeout
         self._status = 200
         self.send_response(200)
-        self.send_header("Content-Type", "application/x-ndjson")
+        self.send_header("Content-Type", (
+            codec.binary_stream_content_type()
+            if wire == codec.BINARY else "application/x-ndjson"
+        ))
         self.send_header("Transfer-Encoding", "chunked")
         self.end_headers()
 
         def chunk_bytes(data: bytes) -> bool:
+            self.metrics.count_wire(wire, "out", len(data))
             try:
                 self.wfile.write(f"{len(data):x}\r\n".encode())
                 self.wfile.write(data + b"\r\n")
@@ -458,23 +594,30 @@ class _Handler(BaseHTTPRequestHandler):
             except (BrokenPipeError, ConnectionResetError, OSError):
                 return False
 
-        def chunk(line: dict) -> bool:
-            return chunk_bytes((json.dumps(line) + "\n").encode())
+        def frame(body: bytes) -> bool:
+            return chunk_bytes(codec.stream_frame(body, wire))
         try:
             while True:
                 try:
-                    events, cursor = self.store._events_since(kind, rv)
+                    if body_since is not None:
+                        # unscoped: cached bodies straight off the store's
+                        # body ring — no WatchEvent materialization
+                        bodies, cursor = body_since(kind, rv, wire)
+                    else:
+                        events, cursor = self.store._events_since(kind, rv)
+                        if view is not None:
+                            events = view.filter(events)
+                        bodies = [
+                            self._event_bytes(e, view is not None, wire)
+                            for e in events
+                        ]
                 except CompactedError as e:
-                    chunk({"error": str(e), "code": 410})
+                    frame(codec.dumps({"error": str(e), "code": 410}, wire))
                     break
-                if view is not None:
-                    events = view.filter(events)
-                for e in events:
+                for body in bodies:
                     # stream frames share the serialize-once cache with the
                     # poll paths — one encode serves every watcher
-                    if not chunk_bytes(
-                        self._event_bytes(e, view is not None) + b"\n"
-                    ):
+                    if not frame(body):
                         return   # client hung up: no terminator possible
                 rv = cursor
                 remaining = deadline - _time.monotonic()
@@ -494,7 +637,9 @@ class _Handler(BaseHTTPRequestHandler):
     # registry/store.go:514) — one copy, so the two surfaces cannot drift
 
     def _apply_create(self, kind: str, key: str, payload) -> int:
-        obj = _stamp_pod_ingest(kind, scheme.decode(payload))
+        # as_object: a binary body already materialized the typed object;
+        # a JSON body left the kind-tagged dict — one normalization point
+        obj = _stamp_pod_ingest(kind, codec.as_object(payload))
         # the admission chain's write locks span admit AND create so a
         # usage-counting validator (quota) cannot race a concurrent
         # create of the same scope
@@ -505,7 +650,7 @@ class _Handler(BaseHTTPRequestHandler):
     def _apply_update(
         self, kind: str, key: str, payload, expect_rv: int | None
     ) -> int:
-        obj = scheme.decode(payload)
+        obj = codec.as_object(payload)
         with self.registry.locked(kind, key, obj, verb="update"):
             old, _old_rv = self.store.get(kind, key)
             obj = self.registry.admit(kind, key, obj, old=old, verb="update")
@@ -520,6 +665,8 @@ class _Handler(BaseHTTPRequestHandler):
             ):
                 try:
                     self._do_bulk(resource)
+                except codec.UnsupportedWireError as e:
+                    self._error(415, str(e))
                 except Exception as e:
                     self._error(500, f"{type(e).__name__}: {e}")
             return
@@ -538,6 +685,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(422, str(e))
             except AdmissionDenied as e:
                 self._error(403, str(e))
+            except codec.UnsupportedWireError as e:
+                self._error(415, str(e))
             except scheme.SchemeError as e:
                 self._error(400, str(e))
             except Exception as e:
@@ -564,6 +713,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(422, str(e))
             except AdmissionDenied as e:
                 self._error(403, str(e))
+            except codec.UnsupportedWireError as e:
+                self._error(415, str(e))
             except scheme.SchemeError as e:
                 self._error(400, str(e))
             except Exception as e:
@@ -610,7 +761,7 @@ class _Handler(BaseHTTPRequestHandler):
                         "create/update/patch/delete/get"
                     )
                 if verb in ("create", "update", "patch"):
-                    obj = scheme.decode(op.get("object") or {})
+                    obj = codec.as_object(op.get("object") or {})
                     real = "create" if verb == "create" else "update"
                     if real == "create":
                         obj = _stamp_pod_ingest(kind, obj)
@@ -634,9 +785,9 @@ class _Handler(BaseHTTPRequestHandler):
         out = []
         for res, prep in zip(results, prepared):
             if res is None:
+                # result objects stay LIVE — the negotiated reply codec
+                # encodes them in _reply (no per-op pre-serialization)
                 res = dict(next(store_res))
-                if "object" in res:
-                    res["object"] = scheme.encode(res["object"])
             if res.get("status", 500) < 400:
                 any_ok = True
             res.setdefault("resourceVersion", 0)
@@ -679,10 +830,7 @@ class _Handler(BaseHTTPRequestHandler):
                     "status": 404, "resourceVersion": 0,
                     "error": f"{kind}/{key} not found",
                 }
-            return {
-                "status": 200, "resourceVersion": rv,
-                "object": scheme.encode(obj),
-            }
+            return {"status": 200, "resourceVersion": rv, "object": obj}
         except _OP_ERRORS as e:
             return _op_error_result(e)
 
@@ -711,9 +859,17 @@ class APIServer:
         host: str = "127.0.0.1", port: int = 0,
         registry: Registry | None = None,
         metrics_sources: tuple = (),
+        wire: str = "binary",
     ) -> None:
         """``metrics_sources``: extra Prometheus-text providers appended to
-        GET /metrics (e.g. a co-hosted controller family's workqueue set)."""
+        GET /metrics (e.g. a co-hosted controller family's workqueue set).
+        ``wire``: "binary" (default) negotiates the compact binary codec
+        per request via Accept/Content-Type; "json" is the escape hatch —
+        a JSON-only server that ignores binary Accept headers and 415s
+        binary bodies (exactly what a pre-binary server build does, so
+        mixed-version client/server pairs are testable)."""
+        if wire not in ("binary", "json"):
+            raise ValueError(f"wire must be binary|json, got {wire!r}")
         self.store = store if store is not None else MemStore()
         self.registry = registry if registry is not None else Registry()
         self.metrics = APIServerMetrics()
@@ -732,27 +888,35 @@ class APIServer:
         self.health.add_check(
             "store", _store_check, endpoints=("healthz", "readyz")
         )
-        # serialize-once watch fan-out: one JSON encode per event, shared
-        # across every watcher poll, batched poll, and stream frame
-        self.event_cache = EventEncodeCache()
+        # serialize-once watch fan-out: one wire encode per event per
+        # codec, shared across every watcher poll, batched poll, and
+        # stream frame (the store binding merges the native body ring's
+        # hit/miss counters into the exposed numbers)
+        self.event_cache = EventEncodeCache(store=self.store)
 
         def _event_cache_metrics() -> str:
-            c = self.event_cache
-            return (
+            stats = self.event_cache.stats_by_codec()
+            lines = [
                 "# HELP apiserver_watch_event_encodings_total Watch event "
-                "JSON serializations by outcome (hit = cached bytes "
-                "reused across watchers).\n"
+                "wire serializations by outcome and codec (hit = cached "
+                "bytes reused across watchers).\n"
                 "# TYPE apiserver_watch_event_encodings_total counter\n"
-                "apiserver_watch_event_encodings_total{result=\"hit\"} "
-                f"{c.hits}\n"
-                "apiserver_watch_event_encodings_total{result=\"miss\"} "
-                f"{c.misses}\n"
-            )
+            ]
+            for c in sorted(stats):
+                h, m = stats[c]
+                lines.append(
+                    "apiserver_watch_event_encodings_total"
+                    f"{{result=\"hit\",codec=\"{c}\"}} {h}\n"
+                    "apiserver_watch_event_encodings_total"
+                    f"{{result=\"miss\",codec=\"{c}\"}} {m}\n"
+                )
+            return "".join(lines)
 
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
             "metrics": self.metrics, "health": self.health,
             "event_cache": self.event_cache,
+            "wire_enabled": wire == "binary",
             "metrics_sources": (
                 _event_cache_metrics, *metrics_sources,
             ),

@@ -114,6 +114,7 @@ def cmd_apiserver(args) -> int:
     install_quota_admission(registry, store)
     server = APIServer(
         store, host=args.host, port=args.port, registry=registry,
+        wire=getattr(args, "wire", "binary"),
     ).start()
     print(f"kubetpu apiserver serving on {server.url} "
           f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N; "
@@ -257,7 +258,7 @@ def cmd_scheduler(args) -> int:
         # silent single-chip run misreported as multichip
         print(f"invalid --mesh: {e}", file=sys.stderr)
         return 1
-    store = RemoteStore(args.server)
+    store = RemoteStore(args.server, wire=getattr(args, "wire", "binary"))
     sched = Scheduler(
         StoreClient(store), cfg=cfg, engine=args.engine,
         pipeline=(args.pipeline == "on"),
@@ -695,6 +696,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     api.add_argument("--host", default="127.0.0.1")
     api.add_argument("--port", type=int, default=10250)
+    api.add_argument("--wire", default="binary", choices=["binary", "json"],
+                     help="wire protocol: 'binary' negotiates the compact "
+                          "binary codec per request via Accept/Content-Type "
+                          "(JSON clients keep working unchanged); 'json' is "
+                          "the escape hatch — a JSON-only server that 415s "
+                          "binary bodies, exactly what a pre-binary build "
+                          "does")
     api.set_defaults(fn=cmd_apiserver)
 
     check = sub.add_parser("check-config", help="validate a config file")
@@ -761,6 +769,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "Contrast --leader-elect, which is "
                            "active/PASSIVE (one leader runs, the rest "
                            "stand by)")
+    schd.add_argument("--wire", default="binary", choices=["binary", "json"],
+                      help="client wire protocol: 'binary' advertises the "
+                           "compact binary codec and switches to it once "
+                           "the server confirms the dialect (a 415 falls "
+                           "back to JSON permanently — mixed-version pairs "
+                           "keep working); 'json' pins the original JSON "
+                           "wire")
     schd.add_argument("--leader-elect", action="store_true")
     schd.add_argument("--diagnostics-port", type=int, default=10251,
                       help="side port for /metrics /healthz /readyz /livez "

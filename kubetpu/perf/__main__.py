@@ -50,6 +50,22 @@ def main(argv=None) -> None:
                          "latency attribution (decision records, "
                          "staged_latency_ms/soak fields); 'off' is the "
                          "overhead escape hatch")
+    ap.add_argument("--fullstack", action="store_true",
+                    help="drive the workload through the FULL stack: an "
+                         "in-process REST apiserver + RemoteStore + "
+                         "informers + HTTP binds (the direct-vs-fullstack "
+                         "delta is the apiserver tax)")
+    ap.add_argument("--wire", default="binary", choices=["binary", "json"],
+                    help="fullstack wire protocol: 'binary' negotiates the "
+                         "compact binary codec via Accept/Content-Type "
+                         "(bindings pod-for-pod identical to JSON); 'json' "
+                         "is the escape hatch. The record embeds the codec "
+                         "actually negotiated plus wire_bytes_per_pod")
+    ap.add_argument("--watch-fanout", type=int, default=0,
+                    help="fullstack only: N extra concurrent pod watchers "
+                         "against the apiserver (the big-cluster watch "
+                         "fan-out load the serialize-once body ring "
+                         "exists for)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run N full scheduler replicas against one "
                          "in-process apiserver (active-active federation, "
@@ -112,6 +128,21 @@ def main(argv=None) -> None:
                 engine=args.engine,
                 bulk=(args.bulk == "on"),
                 flight_recorder=(args.flight_recorder == "on"),
+            )
+            print(json.dumps(r.to_json()))
+        return
+    if args.fullstack:
+        from . import run_workload_full_stack
+
+        case = TEST_CASES[args.case]
+        workloads = (
+            [w for w in case.workloads if w.name == args.workload]
+            if args.workload else list(case.workloads)
+        )
+        for wl in workloads:
+            r = run_workload_full_stack(
+                case, wl, wire=args.wire, watch_fanout=args.watch_fanout,
+                **kwargs,
             )
             print(json.dumps(r.to_json()))
         return

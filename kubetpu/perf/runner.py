@@ -113,6 +113,14 @@ class WorkloadResult:
     # flight recorder + per-pod tracing state for this run (the <5%
     # overhead budget's on/off comparison key)
     flight_recorder: bool = True
+    # wire-protocol view of the measured phase (fullstack only): the codec
+    # request bodies actually NEGOTIATED to ("binary" means the server
+    # confirmed the dialect — a fallback shows up as "json" here, not as a
+    # silently slow run), apiserver payload bytes per scheduled pod, and
+    # how many extra concurrent watchers hammered the fan-out path
+    wire_codec: str = ""
+    wire_bytes_per_pod: float | None = None
+    watch_fanout: int = 0
     # active-active federation (sched.federation; --replicas N
     # --partition hash|race|lease): replica count, partition mode, total
     # CAS-bind conflicts + conflict rate (conflicted attempts / all bind
@@ -186,6 +194,12 @@ class WorkloadResult:
             out["soak"] = self.soak
         if not self.flight_recorder:
             out["flight_recorder"] = False
+        if self.wire_codec:
+            out["wire_codec"] = self.wire_codec
+        if self.wire_bytes_per_pod is not None:
+            out["wire_bytes_per_pod"] = round(self.wire_bytes_per_pod, 1)
+        if self.watch_fanout:
+            out["watch_fanout"] = self.watch_fanout
         if self.replicas > 1 or self.partition:
             out["replicas"] = self.replicas
             out["partition"] = self.partition
@@ -946,6 +960,63 @@ def run_workload(
     return result
 
 
+class _WatchFanout:
+    """N extra concurrent pod watchers against the apiserver — the heavy
+    fan-out load of a big cluster (hundreds of kubelets/controllers each
+    holding a watch). Each watcher is its own RemoteStore connection on
+    its own thread, long-polling the pods bucket; a compaction relists
+    and resumes. The load is the POINT (every store write wakes every
+    watcher, each draining the same events — the serialize-once body ring
+    pays one encode for all of them), so the threads run for the whole
+    workload and stop at teardown."""
+
+    def __init__(self, url: str, wire: str, n: int) -> None:
+        import threading
+
+        from ..apiserver import RemoteStore
+        from ..client.informers import PODS
+
+        self._stop = threading.Event()
+        self._threads: list[threading.Thread] = []
+
+        def loop() -> None:
+            try:
+                rs = RemoteStore(url, wire=wire)
+                w = rs.watch(PODS, 0)
+                # 2s long-poll: a write still wakes the watcher instantly
+                # through the store's condition variable — the timeout
+                # only bounds IDLE churn (hundreds of watchers at 0.5s
+                # would burn the host on empty polls, starving the very
+                # scheduler the fan-out is supposed to load)
+                w.poll_timeout_s = 2.0
+                while not self._stop.is_set():
+                    try:
+                        w.poll()
+                    except Exception:
+                        if self._stop.is_set():
+                            return
+                        # compacted cursor or transient transport error:
+                        # re-anchor at the current head and keep watching
+                        try:
+                            _items, rv = rs.list(PODS)
+                            w = rs.watch(PODS, rv)
+                            w.poll_timeout_s = 2.0
+                        except Exception:
+                            time.sleep(0.05)
+            except Exception:
+                pass    # a dead extra watcher must not kill the bench
+
+        for _ in range(n):
+            t = threading.Thread(target=loop, daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def stop(self) -> None:
+        self._stop.set()
+        for t in self._threads:
+            t.join(timeout=5)
+
+
 def run_workload_full_stack(
     case: W.TestCase | str,
     workload: W.Workload | str,
@@ -961,6 +1032,8 @@ def run_workload_full_stack(
     bulk: bool = True,
     mesh=None,
     flight_recorder: bool = True,
+    wire: str = "binary",
+    watch_fanout: int = 0,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -974,7 +1047,13 @@ def run_workload_full_stack(
     still raise.
 
     The direct-vs-full-stack delta is the apiserver tax: run both modes on
-    one workload to measure what the REST hop costs."""
+    one workload to measure what the REST hop costs.
+
+    ``wire`` selects the negotiated wire codec ("binary" default, "json"
+    the escape hatch — bindings are pod-for-pod identical); the record
+    embeds the codec actually negotiated plus wire_bytes_per_pod.
+    ``watch_fanout`` adds N extra concurrent pod watchers (the big-
+    cluster fan-out load the serialize-once body ring exists for)."""
     import collections
 
     from ..apiserver import APIServer, RemoteStore
@@ -997,7 +1076,10 @@ def run_workload_full_stack(
             )
 
     srv = APIServer().start()
-    remote = RemoteStore(srv.url)
+    remote = RemoteStore(srv.url, wire=wire)
+    fanout = (
+        _WatchFanout(srv.url, wire, watch_fanout) if watch_fanout else None
+    )
 
     class _CountingClient(StoreClient):
         def __init__(self, store) -> None:
@@ -1039,6 +1121,8 @@ def run_workload_full_stack(
     op_ns_counter = 0
     requests0 = 0
     rpcs_total = 0        # measured-phase apiserver round trips
+    wire0 = 0
+    wire_total = 0        # measured-phase apiserver payload bytes
     churns: list[_FsChurn] = []
     deleters: list[_FsDeleter] = []
     created_keys_by_ns: dict[str, list[str]] = {}
@@ -1122,6 +1206,7 @@ def run_workload_full_stack(
                         ],
                     )
                     requests0 = srv.metrics.total_requests()
+                    wire0 = srv.metrics.wire_bytes_total()
                 items = []
                 for j in range(count):
                     pod = template(f"{prefix}-{ns}-{j}", ns)
@@ -1138,10 +1223,13 @@ def run_workload_full_stack(
                     # everything the measured phase cost the API plane:
                     # pod creates, informer polls, binds, status patches
                     rpcs_total += srv.metrics.total_requests() - requests0
+                    wire_total += srv.metrics.wire_bytes_total() - wire0
         informers.pump()
         sched.dispatcher.sync()
         sched._drain_bind_completions()
     finally:
+        if fanout is not None:
+            fanout.stop()
         sched.close()
         srv.close()
 
@@ -1167,6 +1255,11 @@ def run_workload_full_stack(
         rpcs_per_scheduled_pod=(
             rpcs_total / measured if measured else None
         ),
+        wire_codec=remote.wire_codec,
+        wire_bytes_per_pod=(
+            wire_total / measured if measured else None
+        ),
+        watch_fanout=watch_fanout,
         measure_pods=sum(
             params[op.count_param]
             for op in case.ops

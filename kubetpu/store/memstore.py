@@ -45,6 +45,29 @@ DELETED = "DELETED"
 
 _EVENT_TYPES = (ADDED, MODIFIED, DELETED)
 
+_WIRE_ENCODERS: dict[str, Any] = {}
+
+
+def _wire_ids() -> dict:
+    """codec name → dense slot id in the cores' per-event body ring.
+    ONE authoritative Python-side table (kubetpu.api.codec.WIRE_CODEC_IDS
+    — the native Event struct's fixed kNumCodecs array must stay aligned
+    with it), imported lazily so layer 0 imports stay light."""
+    from ..api.codec import WIRE_CODEC_IDS
+
+    return WIRE_CODEC_IDS
+
+
+def _wire_encoder(codec: str):
+    """(encoder, codec id) for the body ring's miss path."""
+    got = _WIRE_ENCODERS.get(codec)
+    if got is None:
+        from ..api.codec import event_body_encoder
+
+        got = (event_body_encoder(codec), _wire_ids()[codec])
+        _WIRE_ENCODERS[codec] = got
+    return got
+
 
 class CompactedError(Exception):
     """The requested resourceVersion predates the event buffer (the watch
@@ -88,20 +111,28 @@ class WatchEvent:
 
 class _PyCore:
     """Pure-Python core: the same micro-interface as the native StoreCore
-    (create/update/delete/get/list/events_since/resource_version), same
-    exception types (KeyError/ValueError/LookupError — mapped by the
-    wrapper)."""
+    (create/update/delete/get/list/events_since[+bulk]/
+    event_bodies_since[+bulk]/resource_version), same exception types
+    (KeyError/ValueError/LookupError — mapped by the wrapper).
+
+    Ring entries are 6-slot lists — the 6th slot is the per-event WIRE
+    BODY cache ({codec id: bytes}, the serialize-once body ring): an
+    event's wire encoding is immutable (store writes replace objects,
+    never mutate them), so a cached body can never go stale and dies with
+    its ring entry."""
 
     def __init__(self, history: int = 8192) -> None:
         self._rv = 0
         self._objects: dict[tuple[str, str], tuple[Any, int]] = {}
         self._events: collections.deque = collections.deque(maxlen=history)
         self._compacted_through = 0
+        self._body_hits = [0, 0]      # per codec id (0 json, 1 binary)
+        self._body_misses = [0, 0]
 
     def _emit(self, ev_type: int, kind: str, key: str, obj: Any) -> None:
         if len(self._events) == self._events.maxlen:
             self._compacted_through = self._events[0][4]
-        self._events.append((ev_type, kind, key, obj, self._rv))
+        self._events.append([ev_type, kind, key, obj, self._rv, {}])
 
     def create(self, kind: str, key: str, obj: Any) -> int:
         if (kind, key) in self._objects:
@@ -137,21 +168,25 @@ class _PyCore:
         got = self._objects.get((kind, key))
         return (None, 0) if got is None else got
 
-    def list(self, kind: str):
-        return (
-            [
-                (key, obj)
-                for (k, key), (obj, _rv) in self._objects.items()
-                if k == kind
-            ],
-            self._rv,
-        )
+    def list(self, kind: str, label_terms: tuple = (),
+             field_terms: tuple = ()):
+        items = [
+            (key, obj)
+            for (k, key), (obj, _rv) in self._objects.items()
+            if k == kind
+        ]
+        if label_terms or field_terms:
+            from ..api.selectors import object_matches_selectors
 
-    def events_since(self, kind: str | None, rv: int):
-        if rv < self._compacted_through:
-            raise LookupError(
-                f"rv {rv} compacted (through {self._compacted_through})"
-            )
+            items = [
+                (k, o) for k, o in items
+                if object_matches_selectors(o, label_terms, field_terms)
+            ]
+        return items, self._rv
+
+    def _collect_since(self, kind: str | None, rv: int):
+        """Ring entries newer than ``rv`` for ``kind`` + the new cursor
+        (oldest first)."""
         if not self._events or self._events[-1][4] <= rv:
             return [], rv
         cursor = self._events[-1][4]
@@ -163,6 +198,73 @@ class _PyCore:
                 out.append(e)
         out.reverse()
         return out, cursor
+
+    def events_since(self, kind: str | None, rv: int):
+        if rv < self._compacted_through:
+            raise LookupError(
+                f"rv {rv} compacted (through {self._compacted_through})"
+            )
+        hits, cursor = self._collect_since(kind, rv)
+        return [tuple(e[:5]) for e in hits], cursor
+
+    def events_since_bulk(self, cursors: dict):
+        """Every kind's cursor drained in one call (None marks a
+        compacted kind); second value is the revision at the drain."""
+        out: dict = {}
+        for kind, rv in cursors.items():
+            if rv < self._compacted_through:
+                out[kind] = None
+                continue
+            out[kind] = self.events_since(kind, rv)
+        return out, self._rv
+
+    def _event_body(self, e: list, codec_id: int, encoder) -> bytes:
+        body = e[5].get(codec_id)
+        if body is not None:
+            self._body_hits[codec_id] += 1
+            return body
+        body = encoder(e[0], e[2], e[3], e[4])
+        e[5][codec_id] = body
+        self._body_misses[codec_id] += 1
+        return body
+
+    def event_bodies_since(self, kind: str | None, rv: int,
+                           codec_id: int, encoder):
+        """The serialize-once fan-out path: cached wire bodies for every
+        event newer than ``rv`` (encoded once per event per codec via
+        ``encoder(type_id, key, obj, rv) -> bytes`` on first sight)."""
+        if rv < self._compacted_through:
+            raise LookupError(
+                f"rv {rv} compacted (through {self._compacted_through})"
+            )
+        hits, cursor = self._collect_since(kind, rv)
+        return (
+            [self._event_body(e, codec_id, encoder) for e in hits],
+            cursor,
+        )
+
+    def event_bodies_since_bulk(self, cursors: dict, codec_id: int,
+                                encoder):
+        out: dict = {}
+        for kind, rv in cursors.items():
+            if rv < self._compacted_through:
+                out[kind] = None
+                continue
+            out[kind] = self.event_bodies_since(kind, rv, codec_id, encoder)
+        return out, self._rv
+
+    def clear_event_bodies(self) -> None:
+        """Drop every cached wire body (the ring events stay) — the
+        registry-generation flush: binary bodies embed schema-table ids
+        that shift when a kind registers late."""
+        for e in self._events:
+            e[5].clear()
+
+    def body_cache_stats(self) -> dict:
+        return {
+            cid: (self._body_hits[cid], self._body_misses[cid])
+            for cid in (0, 1)
+        }
 
     def resource_version(self) -> int:
         return self._rv
@@ -186,6 +288,9 @@ class MemStore:
             raise RuntimeError("native store core unavailable")
         self._core = core_cls(history) if core_cls is not None else _PyCore(history)
         self.native = core_cls is not None
+        # scheme-registry generation the cached wire bodies were encoded
+        # under (None until the first body drain); a move flushes the ring
+        self._body_gen: "int | None" = None
 
     # ------------------------------------------------------------- writes
     def create(self, kind: str, key: str, obj: Any) -> int:
@@ -328,26 +433,23 @@ class MemStore:
         self, cursors: dict[str, int]
     ) -> tuple[dict, int]:
         """Drain several kinds' watch cursors under ONE lock acquisition
-        (the server half of the batched watch poll): per kind, the same
-        (events, new cursor) a ``_events_since`` would return — or a
-        CompactedError value (not raised: one compacted kind relists,
-        the others' deliveries still land). The second return value is the
-        store's revision AT THE DRAIN, captured under the same lock — the
-        long-poll must wait on this, not on a revision read afterwards, or
-        a write landing between drain and wait stalls for the full
-        timeout."""
-        raw: dict[str, Any] = {}
+        AND one core call (the server half of the batched watch poll):
+        per kind, the same (events, new cursor) a ``_events_since`` would
+        return — or a CompactedError value (not raised: one compacted
+        kind relists, the others' deliveries still land). The second
+        return value is the store's revision AT THE DRAIN, captured under
+        the same lock — the long-poll must wait on this, not on a
+        revision read afterwards, or a write landing between drain and
+        wait stalls for the full timeout."""
         with self._lock:
-            drain_rv = self._core.resource_version()
-            for kind, rv in cursors.items():
-                try:
-                    raw[kind] = self._core.events_since(kind, rv)
-                except LookupError as e:
-                    raw[kind] = CompactedError(str(e))
+            raw, drain_rv = self._core.events_since_bulk(cursors)
+            compacted = self._core.compacted_through()
         out: dict[str, Any] = {}
         for kind, res in raw.items():
-            if isinstance(res, CompactedError):
-                out[kind] = res
+            if res is None:
+                out[kind] = CompactedError(
+                    f"rv {cursors[kind]} compacted (through {compacted})"
+                )
                 continue
             events, cursor = res
             out[kind] = (
@@ -358,6 +460,69 @@ class MemStore:
                 cursor,
             )
         return out, drain_rv
+
+    # --------------------------------------------- serialize-once bodies
+    # The fan-out hot path: pre-encoded event WIRE BODIES straight off the
+    # core's per-event body ring — the apiserver's unscoped watch paths
+    # splice these into reply envelopes without ever materializing a
+    # WatchEvent (kubetpu.api.codec's splice-safe encoding). Bodies are
+    # encoded ON MISS under the store lock — once per event per codec,
+    # against an encoder that never re-enters the store — so steady-state
+    # fan-out is all hits.
+
+    def _check_body_gen_locked(self) -> None:
+        """Binary bodies embed schema-table ids derived from the scheme
+        registry — a kind registered AFTER bodies were cached shifts the
+        ids (and the negotiated fingerprint), so a generation move
+        flushes every cached body before the next drain can splice a
+        stale encoding into a new-fingerprint reply."""
+        from ..api.scheme import registry_generation
+
+        gen = registry_generation()
+        if self._body_gen != gen:
+            if self._body_gen is not None:
+                self._core.clear_event_bodies()
+            self._body_gen = gen
+
+    def events_body_since(
+        self, kind: str | None, rv: int, codec: str = "json"
+    ) -> tuple[list[bytes], int]:
+        enc, cid = _wire_encoder(codec)
+        with self._lock:
+            self._check_body_gen_locked()
+            try:
+                return self._core.event_bodies_since(kind, rv, cid, enc)
+            except LookupError as e:
+                raise CompactedError(str(e)) from None
+
+    def events_body_since_bulk(
+        self, cursors: dict[str, int], codec: str = "json"
+    ) -> tuple[dict, int]:
+        """Bulk form: ({kind: (bodies, cursor) | CompactedError}, drain
+        revision) — the batched watch poll's one-lock-round body drain."""
+        enc, cid = _wire_encoder(codec)
+        with self._lock:
+            self._check_body_gen_locked()
+            raw, drain_rv = self._core.event_bodies_since_bulk(
+                cursors, cid, enc
+            )
+            compacted = self._core.compacted_through()
+        out: dict[str, Any] = {}
+        for kind, res in raw.items():
+            out[kind] = (
+                CompactedError(
+                    f"rv {cursors[kind]} compacted (through {compacted})"
+                )
+                if res is None else res
+            )
+        return out, drain_rv
+
+    def body_cache_stats(self) -> dict:
+        """{codec name: (hits, misses)} from the core's body ring."""
+        with self._lock:
+            stats = self._core.body_cache_stats()
+        names = {v: k for k, v in _wire_ids().items()}
+        return {names[cid]: tuple(hm) for cid, hm in stats.items()}
 
     # -------------------------------------------------------------- reads
     def get(self, kind: str, key: str):
@@ -371,22 +536,19 @@ class MemStore:
         """GetList: items + the revision the list is consistent at.
         ``label_selector``/``field_selector`` are the reference's list
         options (``k=v,k2!=v2`` strings) applied server-side — an informer
-        with a selector never receives the objects it filtered out."""
-        with self._lock:
-            items, rv = self._core.list(kind)
+        with a selector never receives the objects it filtered out.
+        Selector matching runs INSIDE the core (the native list filter):
+        the terms are parsed here (a malformed selector 400s before the
+        lock) and evaluated per object in the core's list walk."""
+        lt: tuple = ()
+        ft: tuple = ()
         if label_selector or field_selector:
-            from ..api.selectors import (
-                object_matches_selectors,
-                parse_simple_selector,
-            )
+            from ..api.selectors import parse_simple_selector
 
             lt = parse_simple_selector(label_selector)
             ft = parse_simple_selector(field_selector)
-            items = [
-                (k, o) for k, o in items
-                if object_matches_selectors(o, lt, ft)
-            ]
-        return items, rv
+        with self._lock:
+            return self._core.list(kind, lt, ft)
 
     @property
     def resource_version(self) -> int:

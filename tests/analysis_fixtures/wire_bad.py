@@ -1,0 +1,24 @@
+"""WP001 known-bad: bare json serialization in wire-hot-path-shaped code
+(the ``wire_*`` basename puts this file in the checker's scope)."""
+
+import json
+import json as j
+from json import dumps as jd
+from json import loads
+
+
+def reply(handler, obj):
+    body = json.dumps(obj).encode()  # expect: WP001
+    handler.wfile.write(body)
+
+
+class Handler:
+    def read_body(self, raw):
+        return json.loads(raw or b"{}")  # expect: WP001
+
+    def aliased(self, obj):
+        return j.dumps(obj)  # expect: WP001
+
+    def from_imported(self, obj, raw):
+        head = jd(obj)  # expect: WP001
+        return head, loads(raw)  # expect: WP001

@@ -1,0 +1,25 @@
+"""WP001 known-good: object bodies ride the codec seam; json appears
+only as an import for non-wire uses (referencing the module is fine —
+the invariant is about CALLS that serialize wire bodies)."""
+
+import json  # noqa: F401  (a bare import is not a wire body)
+
+from kubetpu.api import codec
+
+
+def reply(handler, obj, wire):
+    body = codec.dumps(obj, wire)          # the seam: negotiated codec
+    handler.wfile.write(body)
+
+
+class Handler:
+    def read_body(self, raw, wire):
+        return codec.loads(raw, wire)      # decode via the seam
+
+    def event(self, e, wire):
+        return codec.event_wire_bytes(     # serialize-once unit
+            e.type, e.key, e.obj, e.resource_version, wire,
+        )
+
+    def envelope(self, parts, cursor, wire):
+        return codec.events_envelope(parts, cursor, wire)

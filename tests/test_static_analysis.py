@@ -86,6 +86,7 @@ def test_every_checker_registered_and_documented():
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
+        "WP001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -117,7 +118,7 @@ def test_fixture_violations_match_markers_exactly():
 @pytest.mark.parametrize("good", [
     "lock_good.py", "ops/jit_good.py", "sched/donate_good.py",
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
-    "spans_good.py", "cross/owner.py", "clock_good.py",
+    "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -163,6 +164,32 @@ def test_clock_checker_covers_lease_backoff_files():
         "kubetpu/queue/priority_queue.py",
     ):
         assert f in covered, f"CL001 no longer covers {f}"
+
+
+def test_wire_checker_covers_hot_path_modules_not_exempt_surfaces():
+    """WP001 (wire-codec seam discipline) walks every module that touches
+    request/reply/watch bodies — and does NOT walk the seam itself or the
+    human-facing diagnostics/CLI surfaces, whose json use is legitimate.
+    Pinned against the ACTUAL walk so a file move fails here, not
+    silently."""
+    res = _repo_result()
+    covered = set(res.coverage.get("WP001", ()))
+    for f in (
+        "kubetpu/apiserver/server.py",
+        "kubetpu/apiserver/remote.py",
+        "kubetpu/store/memstore.py",
+        "kubetpu/client/informers.py",
+        "kubetpu/client/reflector.py",
+        "kubetpu/sched/api_dispatcher.py",
+    ):
+        assert f in covered, f"WP001 no longer covers {f}"
+    for f in (
+        "kubetpu/api/codec.py",         # the seam encodes by design
+        "kubetpu/cli.py",               # human-facing CLI output
+        "kubetpu/sched/diagnostics.py",  # debug endpoints
+        "kubetpu/benchdiff.py",         # bench-record tooling
+    ):
+        assert f not in covered, f"WP001 wrongly covers exempt {f}"
 
 
 def test_audited_files_still_contain_what_the_checkers_guard():
