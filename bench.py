@@ -133,6 +133,17 @@ WIRE_LADDER = (
 WIRE_FANOUT = 200
 WIRE_BUDGET_S = 900.0
 
+# --- durable control plane (kubetpu.store.wal) ------------------------------
+# ROADMAP item 2's scenarios: crash/restart recovery at 5k nodes x 50k pods
+# (half bound — the exactly-once parity check runs after recovery), the
+# 200-watcher reconnect relist storm, and the steady-state WAL on/off
+# overhead. Control-plane-bound (no device work), so the shapes run full
+# size on both backends; own budget so the evidence always lands.
+# benchdiff gates recovery_s and wal_overhead_frac.
+DURABILITY_SHAPE = (5000, 50000)        # nodes, pods
+DURABILITY_WATCHERS = 200
+DURABILITY_BUDGET_S = 240.0
+
 QUADRATIC = {"SchedulingPodAffinity", "TopologySpreading"}
 
 
@@ -774,6 +785,62 @@ def _run_federation_stages() -> None:
             })
 
 
+def _run_durability_stages() -> None:
+    """CrashRecovery_* (recovery wall + reconnect relist storm + binding
+    parity after a simulated kill) and WALOverhead_* (steady-state
+    durability tax, on/off) — the durable-control-plane evidence."""
+    from kubetpu.perf.runner import run_crash_recovery, run_wal_overhead
+
+    t0 = time.perf_counter()
+    n_nodes, n_pods = DURABILITY_SHAPE
+    _status(f"durability stage: crash recovery {n_nodes}x{n_pods}, "
+            f"{DURABILITY_WATCHERS} reconnecting watchers")
+    try:
+        r = run_crash_recovery(
+            n_nodes=n_nodes, n_pods=n_pods, watchers=DURABILITY_WATCHERS,
+        )
+        _emit({
+            "metric": f"CrashRecovery_{n_nodes}Nodes_{n_pods}Pods",
+            "unit": "s",
+            "value": r["recovery_s"],
+            "backend": _backend(),
+            **r,
+        })
+        _status(f"durability stage done: recovered rv {r['rv']} in "
+                f"{r['recovery_s']}s (parity_ok={r['parity_ok']}, relist "
+                f"storm {r['relist_storm_s']}s)")
+    except Exception as e:
+        _emit({
+            "metric": f"CrashRecovery_{n_nodes}Nodes_{n_pods}Pods",
+            "unit": "s", "value": None, "backend": _backend(),
+            "error": f"{type(e).__name__}: {e}",
+        })
+        _status(f"durability stage FAILED: {e}")
+    if time.perf_counter() - t0 > DURABILITY_BUDGET_S:
+        _status("durability budget exhausted; skipping WALOverhead")
+        return
+    _status("durability stage: steady-state WAL overhead (on/off)")
+    try:
+        o = run_wal_overhead()
+        _emit({
+            "metric": "WALOverhead_bulk_writes",
+            "unit": "ratio",
+            "value": o["throughput_ratio"],
+            "backend": _backend(),
+            **o,
+        })
+        _status(f"durability stage done: WAL on/off ratio "
+                f"{o['throughput_ratio']} "
+                f"(overhead_frac={o['wal_overhead_frac']})")
+    except Exception as e:
+        _emit({
+            "metric": "WALOverhead_bulk_writes",
+            "unit": "ratio", "value": None, "backend": _backend(),
+            "error": f"{type(e).__name__}: {e}",
+        })
+        _status(f"durability stage FAILED: {e}")
+
+
 def main() -> None:
     global STAGES
     probe, probe_s = _probe_backend()
@@ -891,6 +958,7 @@ def main() -> None:
     _emit_soak_lines(all_lines)
     _run_wire_stages()
     _run_federation_stages()
+    _run_durability_stages()
     final = best_quadratic or best_any
     if final is None:
         _emit({

@@ -18,6 +18,8 @@ Checker catalog (``--explain CODE`` prints the full rationale):
 - MR001/MR002/MR003  metrics-registry consistency
 - TS001/TS002        trace-span balance — spans close on exception paths
 - CL001              injectable-clock discipline in lease/backoff code
+- WP001              wire-codec seam discipline on API hot paths
+- WL001              WAL append-seam discipline for store-core mutations
 
 Import surface: ``analyze_paths`` runs the suite programmatically (the
 tier-1 test ``tests/test_static_analysis.py`` gates on it), ``CHECKERS``
@@ -44,3 +46,4 @@ from . import metriccheck  # noqa: F401,E402
 from . import spancheck  # noqa: F401,E402
 from . import clockcheck  # noqa: F401,E402
 from . import wirecheck  # noqa: F401,E402
+from . import walcheck  # noqa: F401,E402

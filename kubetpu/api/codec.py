@@ -132,14 +132,21 @@ class _Tables:
         # the fingerprint covers everything decode depends on: the wire
         # version, the kind table, and each kind's (field, default) set —
         # a default change alters what an ABSENT field decodes to, so it
-        # is a schema change
+        # is a schema change. MISSING (required field) gets a FIXED token:
+        # repr(MISSING) embeds a memory address, which would make the
+        # fingerprint process-specific — two identical builds could never
+        # negotiate binary across a process boundary, and a WAL written
+        # by one process would refuse to decode in any other
         spec = [WIRE_VERSION, self.field_names]
         for kind in self.kind_names:
             plan = self.plans_by_kind[kind]
             spec.append([
                 kind,
-                [(name, repr(default)) for _fid, name, default
-                 in plan.fields],
+                [
+                    (name, "<required>" if default is dataclasses.MISSING
+                     else repr(default))
+                    for _fid, name, default in plan.fields
+                ],
             ])
         self.fingerprint = hashlib.sha1(
             repr(spec).encode()

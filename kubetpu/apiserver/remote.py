@@ -46,6 +46,15 @@ class RemoteUnavailableError(ConnectionError):
 
 
 class RemoteStore:
+    #: watch-path reconnect policy (see ``_watch_request``): capped
+    #: jittered exponential backoff with a retry budget — a restarting
+    #: apiserver is a BOUNDED stall for the informer pump, not informer
+    #: death, and not an unthrottled hammer on the returning server
+    WATCH_RETRY_BUDGET = 6
+    BACKOFF_BASE_S = 0.05
+    BACKOFF_CAP_S = 2.0
+    BACKOFF_JITTER = 0.25       # +/- fraction of the delay
+
     def __init__(self, base_url: str, timeout_s: float = 30.0,
                  wire: str = "binary") -> None:
         import threading
@@ -63,6 +72,78 @@ class RemoteStore:
         # dropped us there permanently). Plain attribute: worst case two
         # threads re-confirm/re-fall-back — both idempotent.
         self._wire_ok: "bool | None" = None if wire == "binary" else False
+        # apiserver_client_reconnects_total{reason}: every watch-path
+        # retry taken after a transport failure, by failure class — the
+        # restart-visibility counter (guarded: watcher threads + a
+        # diagnostics scrape share it)
+        self._reconnect_lock = threading.Lock()
+        self.reconnect_counts: dict[str, int] = {}
+
+    # ------------------------------------------------- reconnect policy
+    @staticmethod
+    def _failure_reason(e: Exception) -> str:
+        """Coarse failure class for the reconnect counter's label."""
+        msg = str(e).lower()
+        if "refused" in msg:
+            return "refused"
+        if "reset" in msg or "disconnected" in msg or "aborted" in msg:
+            return "reset"
+        if "timed out" in msg or "timeout" in msg:
+            return "timeout"
+        return "other"
+
+    def _count_reconnect(self, reason: str) -> None:
+        with self._reconnect_lock:
+            self.reconnect_counts[reason] = (
+                self.reconnect_counts.get(reason, 0) + 1
+            )
+
+    def reconnect_metrics_text(self) -> str:
+        """Prometheus text for the reconnect counter — mountable as a
+        diagnostics metrics source next to the scheduler set."""
+        with self._reconnect_lock:
+            counts = dict(self.reconnect_counts)
+        lines = [
+            "# HELP apiserver_client_reconnects_total Watch/long-poll "
+            "retries taken after a transport failure, by failure class.\n"
+            "# TYPE apiserver_client_reconnects_total counter\n"
+        ]
+        for reason in sorted(counts):
+            lines.append(
+                "apiserver_client_reconnects_total"
+                f"{{reason=\"{reason}\"}} {counts[reason]}\n"
+            )
+        return "".join(lines)
+
+    def _watch_request(self, path: str):
+        """One watch/long-poll GET hardened for apiserver restarts: a
+        transient transport failure (past ``_request``'s single provably-
+        safe retry) backs off — capped, jittered, exponential — and
+        retries within ``WATCH_RETRY_BUDGET``, counting each reconnect by
+        reason. Watch polls are idempotent reads (the cursor only moves on
+        a delivered reply), so the aggressive retry that would be unsafe
+        for writes is safe here. A budget exhausted raises the last
+        RemoteUnavailableError — the informer pump's catch-and-retry
+        keeps the component alive at its own cadence."""
+        import random
+        import time
+
+        for attempt in range(self.WATCH_RETRY_BUDGET + 1):
+            if attempt:
+                delay = min(
+                    self.BACKOFF_BASE_S * (2 ** (attempt - 1)),
+                    self.BACKOFF_CAP_S,
+                )
+                delay *= 1.0 + random.uniform(
+                    -self.BACKOFF_JITTER, self.BACKOFF_JITTER
+                )
+                time.sleep(delay)
+            try:
+                return self._request("GET", path)
+            except RemoteUnavailableError as e:
+                if attempt >= self.WATCH_RETRY_BUDGET:
+                    raise       # budget spent: no retry follows, no count
+                self._count_reconnect(self._failure_reason(e))
 
     @property
     def wire_codec(self) -> str:
@@ -182,12 +263,13 @@ class RemoteStore:
         headers = self._request_headers(wire_out)
         last: Exception | None = None
         for attempt in range(2):
-            conn, reused = self._connection()
             try:
+                conn, reused = self._connection()
                 conn.request(method, path, body=data, headers=headers)
             except (ConnectionError, TimeoutError, OSError,
                     http.client.HTTPException) as e:
-                # send never completed: safe to retry any verb once
+                # connect or send never completed: the server never saw
+                # the request, safe to retry any verb once
                 self._drop_connection()
                 last = e
                 continue
@@ -278,8 +360,7 @@ class RemoteStore:
         relists just that kind — the other buckets' deliveries still
         land)."""
         qs = ",".join(f"{k}:{rv}" for k, rv in cursors.items())
-        res = self._request(
-            "GET",
+        res = self._watch_request(
             f"/apis/?watch=1&buckets={qs}&timeoutSeconds={timeout_s}",
         )
         out: dict = {}
@@ -363,10 +444,10 @@ class RemoteWatcher:
 
     def poll(self) -> list[WatchEvent]:
         # the long-poll must stay under the transport timeout or a quiet
-        # bucket reads as a (retryable) timeout every poll
+        # bucket reads as a (retryable) timeout every poll; the backoff-
+        # hardened watch request rides out an apiserver restart
         wait = min(self.poll_timeout_s, max(self._store.timeout_s - 5.0, 0.0))
-        res = self._store._request(
-            "GET",
+        res = self._store._watch_request(
             f"/apis/{self._kind}?watch=1&resourceVersion={self._rv}"
             f"&timeoutSeconds={wait}{self._sel}",
         )

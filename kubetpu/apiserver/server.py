@@ -860,6 +860,7 @@ class APIServer:
         registry: Registry | None = None,
         metrics_sources: tuple = (),
         wire: str = "binary",
+        persistence: "str | None" = None,
     ) -> None:
         """``metrics_sources``: extra Prometheus-text providers appended to
         GET /metrics (e.g. a co-hosted controller family's workqueue set).
@@ -867,10 +868,21 @@ class APIServer:
         per request via Accept/Content-Type; "json" is the escape hatch —
         a JSON-only server that ignores binary Accept headers and 415s
         binary bodies (exactly what a pre-binary server build does, so
-        mixed-version client/server pairs are testable)."""
+        mixed-version client/server pairs are testable).
+        ``persistence``: a directory path makes the server's store durable
+        (``--persistence dir``): recover-on-start replays the WAL +
+        snapshot, every committed write is logged-then-applied, and
+        ``close()`` flushes the log so a graceful stop never leaves a
+        torn tail. Ignored when an existing ``store`` is passed in — its
+        durability is the caller's choice."""
         if wire not in ("binary", "json"):
             raise ValueError(f"wire must be binary|json, got {wire!r}")
-        self.store = store if store is not None else MemStore()
+        # close() tears down only a store THIS server created — a passed-in
+        # store's lifecycle (and durability) stays the caller's
+        self._owns_store = store is None
+        self.store = (
+            store if store is not None else MemStore(persistence=persistence)
+        )
         self.registry = registry if registry is not None else Registry()
         self.metrics = APIServerMetrics()
         self.health = HealthChecks()
@@ -953,3 +965,13 @@ class APIServer:
         self._httpd.shutdown()
         self._httpd.server_close()
         self._thread.join(timeout=5)
+        # AFTER the listener is down (no request can append mid-close):
+        # flush + fsync + close an OWNED store's WAL, so a graceful stop
+        # never leaves a torn tail for the next boot's recovery to
+        # truncate. A caller-provided store stays open — its durability
+        # and lifecycle are the caller's (writes after OUR close must not
+        # silently stop reaching its log)
+        if self._owns_store:
+            close_store = getattr(self.store, "close", None)
+            if callable(close_store):
+                close_store()

@@ -32,10 +32,17 @@ Comparison rules (per metric name present in BOTH records):
   than ``min_conflict_delta`` absolute (a 0→0.01 wobble on a
   conflict-free mode never gates; a hash/lease mode that STARTS
   conflicting, or a race mode whose contention doubled, does).
-- **replica-kill recovery** (``recovery_s`` on ``FederationRecovery_*``
-  lines): regression when recovery takes over ``old * (1 + recovery_tol)``
-  AND grew by more than ``min_recovery_delta_s`` (absolute floor for the
-  sub-second recoveries a small bench shape produces).
+- **replica-kill / crash recovery** (``recovery_s`` on
+  ``FederationRecovery_*`` and ``CrashRecovery_*`` lines): regression when
+  recovery takes over ``old * (1 + recovery_tol)`` AND grew by more than
+  ``min_recovery_delta_s`` (absolute floor for the sub-second recoveries a
+  small bench shape produces).
+- **WAL steady-state overhead** (``wal_overhead_frac`` on
+  ``WALOverhead_*`` lines — the fraction of write throughput durability
+  costs): regression when the new fraction exceeds
+  ``old * (1 + wal_tol)`` AND grew by more than ``min_wal_delta``
+  absolute (host-noise wobble on a cheap WAL never gates; a durability
+  hot path that started copying per watcher does).
 - a metric that ERRORED in new but not old is always a regression;
   improvements and within-tolerance moves report as ok; metrics present
   in only one record are listed but never gate (the ladder's stage lists
@@ -63,6 +70,11 @@ CONFLICT_TOL = 0.50
 MIN_CONFLICT_DELTA = 0.05
 RECOVERY_TOL = 1.00
 MIN_RECOVERY_DELTA_S = 5.0
+#: WAL overhead is a FRACTION (0..1) measured on a shared host — same
+#: calibration shape as conflict rate: generous relative tolerance,
+#: meaningful absolute floor
+WAL_TOL = 0.50
+MIN_WAL_DELTA = 0.10
 
 
 class BenchDiffError(ValueError):
@@ -165,6 +177,8 @@ def compare(
     min_conflict_delta: float = MIN_CONFLICT_DELTA,
     recovery_tol: float = RECOVERY_TOL,
     min_recovery_delta_s: float = MIN_RECOVERY_DELTA_S,
+    wal_tol: float = WAL_TOL,
+    min_wal_delta: float = MIN_WAL_DELTA,
 ) -> tuple[list[Delta], list[str], list[str]]:
     """Returns (deltas over the common metrics, metrics only in old,
     metrics only in new)."""
@@ -237,6 +251,16 @@ def compare(
                     f">{min_recovery_delta_s:g}s]" if bad else ""
                 ),
             ))
+        ow, nw = o.get("wal_overhead_frac"), n.get("wal_overhead_frac")
+        if isinstance(ow, (int, float)) and isinstance(nw, (int, float)):
+            bad = nw > ow * (1.0 + wal_tol) and (nw - ow) > min_wal_delta
+            deltas.append(Delta(
+                name, "wal_overhead_frac", float(ow), float(nw), bad,
+                note=(
+                    f"[tol +{wal_tol:.0%} & >{min_wal_delta:g}]"
+                    if bad else ""
+                ),
+            ))
     only_old = sorted(set(old) - set(new))
     only_new = sorted(set(new) - set(old))
     return deltas, only_old, only_new
@@ -275,6 +299,12 @@ def main(argv=None) -> int:
                     help="absolute recovery growth floor (seconds) below "
                          f"which it never gates (default "
                          f"{MIN_RECOVERY_DELTA_S})")
+    ap.add_argument("--wal-tol", type=float, default=WAL_TOL,
+                    help="fractional WAL-overhead growth tolerated "
+                         f"(default {WAL_TOL})")
+    ap.add_argument("--min-wal-delta", type=float, default=MIN_WAL_DELTA,
+                    help="absolute WAL-overhead growth floor below which "
+                         f"it never gates (default {MIN_WAL_DELTA})")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -293,6 +323,8 @@ def main(argv=None) -> int:
         min_conflict_delta=args.min_conflict_delta,
         recovery_tol=args.recovery_tol,
         min_recovery_delta_s=args.min_recovery_delta_s,
+        wal_tol=args.wal_tol,
+        min_wal_delta=args.min_wal_delta,
     )
     regressions = [d for d in deltas if d.regression]
     if args.json:

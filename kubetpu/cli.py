@@ -22,6 +22,8 @@ Commands (the control-plane binaries + tooling):
                           scheduler's /debug/flightrecorder or a JSON dump
 - ``benchdiff``           compare two bench records with noise-aware
                           thresholds; non-zero exit on regression
+- ``store fsck|compact``  durable-store tooling: offline integrity report /
+                          WAL-into-snapshot compaction for a persistence dir
 - ``version``             print the framework version
 """
 
@@ -104,8 +106,19 @@ def cmd_apiserver(args) -> int:
     from .apiserver import APIServer, Registry
     from .controllers import install_quota_admission
     from .store import MemStore
+    from .store.wal import WALError
 
-    store = MemStore()
+    persistence = getattr(args, "persistence", "off")
+    try:
+        store = MemStore(
+            persistence=None if persistence == "off" else persistence,
+        )
+    except WALError as e:
+        # a corrupt persistence dir must fail LOUDLY at boot, never boot
+        # an empty cluster over a recoverable one — `kubetpu store fsck`
+        # diagnoses, deleting the dir is the explicit full-resync choice
+        print(f"persistence dir unrecoverable: {e}", file=sys.stderr)
+        return 1
     registry = Registry()
     # quota enforcement is admission-time (the reference's resourcequota
     # admission plugin): pod creates past a namespace's hard caps get 403;
@@ -116,9 +129,21 @@ def cmd_apiserver(args) -> int:
         store, host=args.host, port=args.port, registry=registry,
         wire=getattr(args, "wire", "binary"),
     ).start()
+    recovered = ""
+    if store.recovery_info is not None:
+        ri = store.recovery_info
+        recovered = (
+            f"; recovered rv {ri.resource_version} "
+            f"(snapshot {ri.snapshot_objects} objects @ rv "
+            f"{ri.snapshot_rv} + {ri.replayed} replayed"
+            + (f", torn tail truncated {ri.truncated_bytes}B"
+               if ri.truncated_bytes else "")
+            + ")"
+        )
     print(f"kubetpu apiserver serving on {server.url} "
           f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N; "
-          f"diagnostics: /metrics /healthz /readyz /livez)",
+          f"diagnostics: /metrics /healthz /readyz /livez"
+          f"{recovered})",
           flush=True)
     try:
         import threading
@@ -128,6 +153,10 @@ def cmd_apiserver(args) -> int:
         pass
     finally:
         server.close()
+        # the store is OURS (passed in, so server.close leaves it alone):
+        # flush + close the WAL after the listener stops — a graceful
+        # stop never leaves a torn tail
+        store.close()
     return 0
 
 
@@ -285,7 +314,12 @@ def cmd_scheduler(args) -> int:
         from .sched.diagnostics import DiagnosticsServer
 
         try:
-            diag = DiagnosticsServer(sched, port=args.diagnostics_port)
+            diag = DiagnosticsServer(
+                sched, port=args.diagnostics_port,
+                # restart visibility: the client's watch-path reconnect
+                # counter rides the scheduler's /metrics page
+                metrics_sources=(store.reconnect_metrics_text,),
+            )
         except OSError as e:
             # a second scheduler on the host (HA standby) must not die on
             # the diagnostics side port; it just runs unobserved
@@ -667,6 +701,63 @@ def cmd_explain(args) -> int:
     return 0
 
 
+def cmd_store_fsck(args) -> int:
+    """``kubetpu store fsck --dir D``: offline integrity report for a
+    persistence dir — snapshot validity, per-segment record counts, torn
+    tail position, replay-chain continuity. Exit 0 = recovery would
+    succeed cleanly."""
+    from .api import types  # noqa: F401 — register kinds for decode
+    from .store.wal import fsck
+
+    report = fsck(args.dir)
+    if args.output == "json":
+        print(json.dumps(report, indent=2))
+    else:
+        print(f"persistence dir {report['dir']}: "
+              f"{'OK' if report['ok'] else 'PROBLEMS'} "
+              f"(replay chain reaches rv {report.get('resource_version', 0)})")
+        for s in report["snapshots"]:
+            state = (
+                f"{s['objects']} objects" if s.get("valid")
+                else f"INVALID: {s.get('error')}"
+            )
+            print(f"  snapshot {s['file']} @ rv {s['rv']}: {state}")
+        for s in report["segments"]:
+            extra = ""
+            if "torn_at" in s:
+                extra = f", torn tail at offset {s['torn_at']}"
+            if "error" in s:
+                extra += f", ERROR: {s['error']}"
+            print(f"  segment {s['file']}: {s['records']} records{extra}")
+        for e in report["errors"]:
+            print(f"  error: {e}")
+    return 0 if report["ok"] else 1
+
+
+def cmd_store_compact(args) -> int:
+    """``kubetpu store compact --dir D``: offline compaction — recover the
+    dir into a fresh core, write one snapshot at the recovered revision,
+    truncate every superseded segment/snapshot. Run it against a STOPPED
+    apiserver's dir to bound the next boot's replay."""
+    from .api import types  # noqa: F401 — register kinds for decode
+    from .store import MemStore
+    from .store.wal import WALError
+
+    try:
+        store = MemStore(persistence=args.dir)
+    except WALError as e:
+        print(f"unrecoverable: {e}", file=sys.stderr)
+        return 1
+    ri = store.recovery_info
+    n_objects = len(store.dump())
+    path = store.compact()
+    store.close()
+    print(f"compacted {args.dir} at rv {ri.resource_version}: "
+          f"snapshot {path} ({n_objects} objects; was snapshot@rv"
+          f"{ri.snapshot_rv} + {ri.replayed} tail records)")
+    return 0
+
+
 def cmd_version(_args) -> int:
     from . import __version__
 
@@ -703,6 +794,16 @@ def build_parser() -> argparse.ArgumentParser:
                           "the escape hatch — a JSON-only server that 415s "
                           "binary bodies, exactly what a pre-binary build "
                           "does")
+    api.add_argument("--persistence", default="off", metavar="DIR|off",
+                     help="durability: a directory path turns on the "
+                          "write-ahead log + compaction snapshots "
+                          "(kubetpu.store.wal) — every committed write is "
+                          "logged-then-applied and fsync'd before the ack, "
+                          "restart recovers snapshot+tail with "
+                          "resourceVersion continuity (reconnecting "
+                          "watchers take a bounded relist). 'off' (default) "
+                          "is the memory-only store, byte-identical to the "
+                          "pre-WAL behavior")
     api.set_defaults(fn=cmd_apiserver)
 
     check = sub.add_parser("check-config", help="validate a config file")
@@ -845,6 +946,30 @@ def build_parser() -> argparse.ArgumentParser:
                          help="render every matching record, not just the "
                               "latest")
     explain.set_defaults(fn=cmd_explain)
+
+    st = sub.add_parser(
+        "store",
+        help="durable-store tooling: fsck (offline integrity report for a "
+             "persistence dir) and compact (fold the WAL into one "
+             "snapshot, truncate superseded segments)",
+    )
+    st_sub = st.add_subparsers(dest="store_command", required=True)
+    st_fsck = st_sub.add_parser(
+        "fsck", help="report snapshot/segment validity, torn tails, and "
+                     "replay-chain continuity without mutating anything",
+    )
+    st_fsck.add_argument("--dir", required=True,
+                         help="the persistence directory "
+                              "(apiserver --persistence DIR)")
+    st_fsck.add_argument("-o", "--output", default="text",
+                         choices=("text", "json"))
+    st_fsck.set_defaults(fn=cmd_store_fsck)
+    st_compact = st_sub.add_parser(
+        "compact", help="offline compaction of a STOPPED apiserver's "
+                        "persistence dir (bounds the next boot's replay)",
+    )
+    st_compact.add_argument("--dir", required=True)
+    st_compact.set_defaults(fn=cmd_store_compact)
 
     bd = sub.add_parser(
         "benchdiff",

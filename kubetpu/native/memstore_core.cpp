@@ -554,6 +554,79 @@ PyObject* store_body_cache_stats(StoreObject* self, PyObject*) {
   return out;
 }
 
+// ------------------------------------------------- durability surface
+// dump() -> [(kind, key, obj, rv), ...] in insertion (seq) order, and
+// load_snapshot(items, rv): reset to a recovery snapshot — objects with
+// their per-object rvs (CAS survives recovery), store revision rv, event
+// ring EMPTY with the compaction horizon at rv. Both mirror the Python
+// twin exactly (kubetpu.store.memstore._PyCore) — the WAL recovery path
+// replays into either core through this same surface.
+
+PyObject* store_dump(StoreObject* self, PyObject*) {
+  struct Hit {
+    long long seq;
+    const std::string* key;
+    const Entry* entry;
+    bool operator<(const Hit& o) const { return seq < o.seq; }
+  };
+  std::vector<Hit> hits;
+  hits.reserve(self->objects->size());
+  for (auto& kv : *self->objects)
+    hits.push_back(Hit{kv.second.seq, &kv.first, &kv.second});
+  std::sort(hits.begin(), hits.end());
+  PyObject* out = PyList_New(0);
+  if (!out) return nullptr;
+  for (auto& h : hits) {
+    size_t sep = h.key->find('\x1f');
+    PyObject* entry = Py_BuildValue(
+        "(s#s#OL)", h.key->c_str(), (Py_ssize_t)sep,
+        h.key->c_str() + sep + 1, (Py_ssize_t)(h.key->size() - sep - 1),
+        h.entry->obj, h.entry->rv);
+    if (!entry || PyList_Append(out, entry) < 0) {
+      Py_XDECREF(entry);
+      Py_DECREF(out);
+      return nullptr;
+    }
+    Py_DECREF(entry);
+  }
+  return out;
+}
+
+PyObject* store_load_snapshot(StoreObject* self, PyObject* args) {
+  PyObject* items;
+  long long rv;
+  if (!PyArg_ParseTuple(args, "OL", &items, &rv)) return nullptr;
+  PyObject* seq = PySequence_Fast(items, "load_snapshot wants a sequence");
+  if (!seq) return nullptr;
+  for (auto& kv : *self->objects) Py_DECREF(kv.second.obj);
+  self->objects->clear();
+  for (auto& e : *self->events) {
+    Py_DECREF(e.obj);
+    for (int c = 0; c < kNumCodecs; ++c) Py_XDECREF(e.bodies[c]);
+  }
+  self->events->clear();
+  self->seq_counter = 0;
+  Py_ssize_t n = PySequence_Fast_GET_SIZE(seq);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* item = PySequence_Fast_GET_ITEM(seq, i);  // borrowed
+    const char* kind;
+    const char* key;
+    PyObject* obj;
+    long long obj_rv;
+    if (!PyArg_ParseTuple(item, "ssOL", &kind, &key, &obj, &obj_rv)) {
+      Py_DECREF(seq);
+      return nullptr;
+    }
+    Py_INCREF(obj);
+    (*self->objects)[map_key(kind, key)] = {obj, obj_rv,
+                                            ++self->seq_counter};
+  }
+  Py_DECREF(seq);
+  self->rv = rv;
+  self->compacted_through = rv;
+  Py_RETURN_NONE;
+}
+
 PyObject* store_resource_version(StoreObject* self, PyObject*) {
   return PyLong_FromLongLong(self->rv);
 }
@@ -609,6 +682,9 @@ PyMethodDef store_methods[] = {
     {"clear_event_bodies", (PyCFunction)store_clear_event_bodies,
      METH_NOARGS, nullptr},
     {"body_cache_stats", (PyCFunction)store_body_cache_stats, METH_NOARGS,
+     nullptr},
+    {"dump", (PyCFunction)store_dump, METH_NOARGS, nullptr},
+    {"load_snapshot", (PyCFunction)store_load_snapshot, METH_VARARGS,
      nullptr},
     {"resource_version", (PyCFunction)store_resource_version, METH_NOARGS,
      nullptr},

@@ -1571,6 +1571,208 @@ def run_workload_federated(
     )
 
 
+def run_crash_recovery(
+    n_nodes: int = 5000,
+    n_pods: int = 50000,
+    watchers: int = 200,
+    bind_frac: float = 0.5,
+    wal_fsync: bool = True,
+    wal_wire: str = "binary",
+    dirpath: str | None = None,
+) -> dict:
+    """The durable-store recovery bench (ROADMAP item 2's scenario): build
+    a 5k-node / 50k-pod cluster in a WAL-backed store (bulk writes — the
+    group-commit path), bind ``bind_frac`` of the pods, then CRASH the
+    process (the store is abandoned un-closed, exactly what a kill leaves
+    behind) and measure:
+
+    - ``recovery_s``: wall time for a fresh store to replay snapshot+tail
+      with resourceVersion continuity;
+    - ``relist_storm_s``: ``watchers`` reconnecting watchers each taking a
+      BOUNDED relist from a pre-crash cursor (the tail events only, off
+      the repopulated ring) — plus the 410 full-relist cost one
+      compacted-cursor watcher pays, for contrast;
+    - ``binding_parity``: store-verified pods bound EXACTLY once after
+      recovery (must equal the pre-crash bind count — the exactly-once
+      check the federation bench also asserts)."""
+    import shutil
+    import tempfile
+
+    from ..api.wrappers import make_node, make_pod
+    from ..client.informers import NODES, PODS
+    from ..store.memstore import MemStore
+
+    own_dir = dirpath is None
+    dirpath = dirpath or tempfile.mkdtemp(prefix="kubetpu-wal-bench-")
+    try:
+        st = MemStore(persistence=dirpath, wal_fsync=wal_fsync,
+                      wal_wire=wal_wire)
+        t_pop0 = time.perf_counter()
+        chunk = 512
+        for i in range(0, n_nodes, chunk):
+            st.bulk(NODES, [
+                {"op": "create", "key": f"node-{j}",
+                 "object": make_node(f"node-{j}")}
+                for j in range(i, min(i + chunk, n_nodes))
+            ])
+        for i in range(0, n_pods, chunk):
+            st.bulk(PODS, [
+                {"op": "create", "key": f"bench/pod-{j}",
+                 "object": make_pod(f"pod-{j}", namespace="bench")}
+                for j in range(i, min(i + chunk, n_pods))
+            ])
+        n_bound = int(n_pods * bind_frac)
+        for i in range(0, n_bound, chunk):
+            keys = [f"bench/pod-{j}" for j in range(i, min(i + chunk, n_bound))]
+            gets = st.bulk(PODS, [{"op": "get", "key": k} for k in keys])
+            st.bulk(PODS, [
+                {"op": "update", "key": k,
+                 "object": g["object"].with_node(f"node-{j % n_nodes}"),
+                 "expect_rv": g["resourceVersion"]}
+                for j, (k, g) in enumerate(zip(keys, gets))
+            ])
+        populate_s = time.perf_counter() - t_pop0
+        pre_rv = st.resource_version
+        wal_stats = st.wal_stats()
+        # CRASH: abandon the store un-closed — in-memory state dies, the
+        # flushed log is what a killed process leaves on disk
+        del st
+
+        t0 = time.perf_counter()
+        st2 = MemStore(persistence=dirpath, wal_fsync=wal_fsync,
+                       wal_wire=wal_wire)
+        recovery_s = time.perf_counter() - t0
+        info = st2.recovery_info
+        assert st2.resource_version == pre_rv, (
+            f"rv continuity broken: {st2.resource_version} != {pre_rv}"
+        )
+        # exactly-once binding parity, store-verified (keys are unique by
+        # construction — the CAS store makes bound-twice impossible, so
+        # parity == the pre-crash bind count means none lost either)
+        parity = sum(
+            1 for _k, pod in st2.list(PODS)[0] if pod.node_name
+        )
+        # hard gate, like the rv assert above: a recovery that loses
+        # bindings must FAIL the stage (benchdiff treats an errored
+        # metric as a regression), never emit a green line with
+        # parity_ok=false that nothing gates on
+        assert parity == n_bound, (
+            f"binding parity broken after recovery: {parity} != {n_bound}"
+        )
+        # the relist storm: every reconnecting watcher resumes from a
+        # pre-crash cursor inside the replayed tail — a BOUNDED relist
+        cursor = max(info.snapshot_rv, pre_rv - 1000)
+        t1 = time.perf_counter()
+        delivered = 0
+        for _ in range(watchers):
+            events, _cur = st2._events_since(PODS, cursor)
+            delivered += len(events)
+        relist_storm_s = time.perf_counter() - t1
+        # contrast: what ONE watcher whose cursor predates the compaction
+        # horizon pays after its 410 — a full list of the bucket
+        t2 = time.perf_counter()
+        full_items, _rv = st2.list(PODS)
+        full_relist_s = time.perf_counter() - t2
+        st2.close()
+        return {
+            "n_nodes": n_nodes,
+            "n_pods": n_pods,
+            "bound": n_bound,
+            "binding_parity": parity,
+            "parity_ok": parity == n_bound,
+            "rv": pre_rv,
+            "populate_s": round(populate_s, 3),
+            "recovery_s": round(recovery_s, 3),
+            "recovered_writes_per_s": round(
+                (info.snapshot_objects + info.replayed) / recovery_s, 1
+            ) if recovery_s > 0 else None,
+            "snapshot_rv": info.snapshot_rv,
+            "snapshot_objects": info.snapshot_objects,
+            "replayed": info.replayed,
+            "truncated_bytes": info.truncated_bytes,
+            "watchers": watchers,
+            "relist_storm_s": round(relist_storm_s, 4),
+            "relist_events_delivered": delivered,
+            "full_relist_objects": len(full_items),
+            "full_relist_s": round(full_relist_s, 4),
+            "wal_fsync": wal_fsync,
+            "wal_wire": wal_wire,
+            "wal_records": (wal_stats or {}).get("records_appended"),
+            "wal_bytes": (wal_stats or {}).get("bytes_appended"),
+            "wal_fsyncs": (wal_stats or {}).get("fsyncs"),
+        }
+    finally:
+        if own_dir:
+            shutil.rmtree(dirpath, ignore_errors=True)
+
+
+def run_wal_overhead(
+    n_writes: int = 20000,
+    chunk: int = 256,
+    wal_fsync: bool = True,
+    wal_wire: str = "binary",
+) -> dict:
+    """Steady-state WAL cost: the SAME bulk create+bind write sequence
+    against a persistent store and a memory-only one; the throughput
+    ratio (and ``wal_overhead_frac``) is the price of durability —
+    benchdiff-gated so a WAL hot-path regression trips CI."""
+    import shutil
+    import tempfile
+
+    from ..api.wrappers import make_pod
+    from ..client.informers import PODS
+    from ..store.memstore import MemStore
+
+    def drive(store) -> float:
+        t0 = time.perf_counter()
+        for i in range(0, n_writes, chunk):
+            keys = [f"ns/p-{j}" for j in range(i, min(i + chunk, n_writes))]
+            store.bulk(PODS, [
+                {"op": "create", "key": k,
+                 "object": make_pod(k.split("/", 1)[1], namespace="ns")}
+                for k in keys
+            ])
+            gets = store.bulk(PODS, [{"op": "get", "key": k} for k in keys])
+            store.bulk(PODS, [
+                {"op": "update", "key": k,
+                 "object": g["object"].with_node("node-0"),
+                 "expect_rv": g["resourceVersion"]}
+                for k, g in zip(keys, gets)
+            ])
+        return time.perf_counter() - t0
+
+    dirpath = tempfile.mkdtemp(prefix="kubetpu-wal-bench-")
+    try:
+        st_on = MemStore(persistence=dirpath, wal_fsync=wal_fsync,
+                         wal_wire=wal_wire)
+        on_s = drive(st_on)
+        stats = st_on.wal_stats()
+        st_on.close()
+    finally:
+        shutil.rmtree(dirpath, ignore_errors=True)
+    st_off = MemStore()
+    off_s = drive(st_off)
+    writes = 2 * n_writes           # one create + one bind per pod
+    on_rate = writes / on_s if on_s > 0 else 0.0
+    off_rate = writes / off_s if off_s > 0 else 0.0
+    return {
+        "writes": writes,
+        "chunk": chunk,
+        "wal_fsync": wal_fsync,
+        "wal_wire": wal_wire,
+        "on_writes_per_s": round(on_rate, 1),
+        "off_writes_per_s": round(off_rate, 1),
+        "throughput_ratio": round(on_rate / off_rate, 4) if off_rate else None,
+        "wal_overhead_frac": (
+            round(max(0.0, 1.0 - on_rate / off_rate), 4) if off_rate else None
+        ),
+        "wal_bytes_per_write": (
+            round(stats["bytes_appended"] / writes, 1) if stats else None
+        ),
+        "wal_fsyncs": stats["fsyncs"] if stats else None,
+    }
+
+
 def run_label(label: str = "performance", **kwargs) -> list[WorkloadResult]:
     """Run every workload carrying ``label`` (the reference's label selector,
     e.g. -perf-scheduling-label-filter=performance)."""

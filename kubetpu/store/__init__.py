@@ -1,3 +1,21 @@
-"""Versioned object storage + watch (the etcd3 / watch-cache layer)."""
+"""Versioned object storage + watch (the etcd3 / watch-cache layer) —
+durable behind ``MemStore(persistence=dir)``: write-ahead log + compacted
+snapshots + crash recovery (``kubetpu.store.wal``), with a deterministic
+crash-point fault harness (``kubetpu.store.faultpoints``)."""
 
 from .memstore import CompactedError, MemStore, WatchEvent, Watcher  # noqa: F401
+
+#: wal.py imports the codec seam at module top; exporting it lazily keeps
+#: `from kubetpu.store import MemStore` as light as before persistence
+#: existed (memstore defers its own codec import for the same reason)
+_WAL_EXPORTS = (
+    "RecoveryInfo", "WALError", "WriteAheadLog", "fsck", "recover_into",
+)
+
+
+def __getattr__(name: str):
+    if name in _WAL_EXPORTS:
+        from . import wal
+
+        return getattr(wal, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,147 @@
+"""Deterministic crash-point fault harness for the durable store.
+
+The WAL's correctness claims are all about WHERE a crash lands relative to
+the append/fsync/apply/snapshot/truncate boundaries — claims a wall-clock
+kill can only sample, never pin. This module gives the write path NAMED
+injection points; tests (and the perf runner's recovery stage) arm a point
+with a hit count and the instrumented site raises ``CrashPoint`` exactly
+there, simulating the process dying at that instruction. The store object
+is then abandoned (its in-memory state is the "lost" state) and recovery
+is exercised against the on-disk artifact the crash left behind.
+
+``CrashPoint`` derives from ``BaseException`` deliberately: the store and
+apiserver paths contain broad ``except Exception`` containment (a 500
+handler, a bulk-op ladder), and a simulated process death must never be
+swallowed into a 500 reply — a real SIGKILL would not be.
+
+The points (see kubetpu.store.wal for the exact sites):
+
+========================== =================================================
+``wal-pre-append``         before any record byte reaches the segment file:
+                           the write is lost entirely (never acked, never
+                           durable) — recovery must equal the pre-crash
+                           state exactly.
+``wal-mid-record``         a TORN write: half the framed record hits the
+                           file, then death. Recovery must detect the torn
+                           tail (length/checksum) and truncate it.
+``wal-post-append-pre-apply`` the record is appended AND fsync'd but the
+                           core never applied it: the one case where
+                           recovery legitimately knows MORE than the dead
+                           process's memory — replay applies the record
+                           (the write was durable; its ack was lost).
+``wal-mid-snapshot``       death halfway through writing a compaction
+                           snapshot: the temp file is abandoned, the
+                           previous snapshot + full segment chain must
+                           still recover.
+``wal-mid-truncate``       death after the new snapshot landed but midway
+                           through deleting superseded segments/snapshots:
+                           recovery must skip already-covered records
+                           idempotently (replay is rv-gated).
+========================== =================================================
+
+The harness is process-global and OFF by default: ``fire()`` is a single
+dict lookup when nothing is armed, so the production write path pays ~0.
+"""
+
+from __future__ import annotations
+
+import threading
+
+#: every named injection point, in write-path order (the torture loop in
+#: tests/test_wal.py iterates this tuple — a new point added to the WAL
+#: must be registered here or arming it raises)
+FAULT_POINTS = (
+    "wal-pre-append",
+    "wal-mid-record",
+    "wal-post-append-pre-apply",
+    "wal-mid-snapshot",
+    "wal-mid-truncate",
+)
+
+
+class CrashPoint(BaseException):
+    """A simulated process death at a named fault point. BaseException so
+    no ``except Exception`` containment on the write path can turn a
+    "crash" into a handled error (a real kill would not be handled)."""
+
+    def __init__(self, name: str) -> None:
+        super().__init__(f"simulated crash at fault point {name!r}")
+        self.point = name
+
+
+_lock = threading.Lock()
+_armed: dict[str, int] = {}     # point -> remaining traversals before firing
+_hits: dict[str, int] = {}      # point -> traversals observed (armed or not)
+_fired: list[str] = []          # points that actually crashed, in order
+
+
+def arm(name: str, at_hit: int = 1) -> None:
+    """Arm ``name`` to crash on its ``at_hit``-th traversal (1 = next)."""
+    if name not in FAULT_POINTS:
+        raise ValueError(f"unknown fault point {name!r}")
+    if at_hit < 1:
+        raise ValueError("at_hit must be >= 1")
+    with _lock:
+        _armed[name] = at_hit
+
+
+def disarm(name: str) -> None:
+    with _lock:
+        _armed.pop(name, None)
+
+
+def reset() -> None:
+    """Disarm everything and zero the counters (test teardown)."""
+    with _lock:
+        _armed.clear()
+        _hits.clear()
+        _fired.clear()
+
+
+def hits(name: str) -> int:
+    """Traversals observed WHILE the harness was armed (the unarmed fast
+    path deliberately does not count — see ``due``)."""
+    with _lock:
+        return _hits.get(name, 0)
+
+
+def fired() -> tuple:
+    with _lock:
+        return tuple(_fired)
+
+
+def due(name: str) -> bool:
+    """One traversal of ``name``; True when the armed countdown just
+    reached zero (the caller performs any pre-crash action — e.g. the torn
+    half-record write — then calls ``crash``). Sites without a pre-crash
+    action use ``fire`` instead. The unarmed path is ONE dict truthiness
+    check with no lock and no counting — these sites sit inside the
+    store's per-write critical section, so the production cost must stay
+    ~0 (``hits`` only observes traversals made while something is armed)."""
+    if not _armed:          # fast path: harness off
+        return False
+    with _lock:
+        _hits[name] = _hits.get(name, 0) + 1
+        remaining = _armed.get(name)
+        if remaining is None:
+            return False
+        remaining -= 1
+        if remaining > 0:
+            _armed[name] = remaining
+            return False
+        del _armed[name]    # one-shot: firing consumes the arming
+        return True
+
+
+def crash(name: str) -> None:
+    """Raise the simulated death for ``name`` (after ``due`` said so)."""
+    with _lock:
+        _fired.append(name)
+    raise CrashPoint(name)
+
+
+def fire(name: str) -> None:
+    """Count a traversal and crash if the point is due — the plain
+    instrumentation call for sites with no pre-crash action."""
+    if due(name):
+        crash(name)

@@ -39,6 +39,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any
 
+from . import faultpoints
+
 ADDED = "ADDED"
 MODIFIED = "MODIFIED"
 DELETED = "DELETED"
@@ -272,12 +274,48 @@ class _PyCore:
     def compacted_through(self) -> int:
         return self._compacted_through
 
+    # ------------------------------------------------- durability surface
+    def dump(self):
+        """Every object as (kind, key, obj, rv) in insertion order — the
+        compaction snapshot's input (and the recovery tests' parity
+        probe). Insertion order matters: ``load_snapshot`` must rebuild
+        the same list() ordering both cores guarantee."""
+        return [
+            (kind, key, obj, rv)
+            for (kind, key), (obj, rv) in self._objects.items()
+        ]
+
+    def load_snapshot(self, items, rv: int) -> None:
+        """Reset to a snapshot: objects with their per-object rvs (CAS
+        survives recovery), store revision ``rv``, event ring EMPTY with
+        the compaction horizon at ``rv`` — a watcher cursor below the
+        snapshot predates everything replayable and must 410 into a full
+        relist; the replayed WAL tail then repopulates the ring."""
+        self._objects = {
+            (kind, key): (obj, obj_rv) for kind, key, obj, obj_rv in items
+        }
+        self._rv = rv
+        self._events.clear()
+        self._compacted_through = rv
+
 
 class MemStore:
     """See module docstring. Thread-safe; writes are serialized under one
     Condition, which also backs the blocking ``wait_for``."""
 
-    def __init__(self, history: int = 8192, native: bool | None = None) -> None:
+    def __init__(self, history: int = 8192, native: bool | None = None,
+                 persistence: "str | None" = None,
+                 wal_wire: str = "binary", wal_fsync: bool = True,
+                 compact_every: int = 65536) -> None:
+        """``persistence``: a directory path turns on the write-ahead log
+        + snapshot durability (kubetpu.store.wal) — recover-on-start
+        replays snapshot+tail into the core, every committed write is
+        logged-then-applied, and compaction runs automatically every
+        ``compact_every`` records. None (the default, ``--persistence
+        off``) is byte-identical to the memory-only store. ``wal_wire``
+        picks the record codec (binary default — the compact wire the
+        body ring speaks); ``wal_fsync=False`` is the benchmark escape
+        hatch (flush-to-OS only)."""
         self._lock = threading.Condition()
         core_cls = None
         if native is not False and not os.environ.get("KUBETPU_NO_NATIVE"):
@@ -291,14 +329,101 @@ class MemStore:
         # scheme-registry generation the cached wire bodies were encoded
         # under (None until the first body drain); a move flushes the ring
         self._body_gen: "int | None" = None
+        self._wal = None
+        self._wal_closed = False
+        self._wal_lock = None
+        self.recovery_info = None
+        if persistence:
+            from .wal import DirLock, WriteAheadLog, recover_into
+
+            # single-writer guard FIRST (a concurrent opener would rotate
+            # + truncate the live log), then recover (torn tails
+            # truncated, snapshot+tail replayed into the core with rv
+            # continuity), then open a fresh append segment; a replay
+            # longer than the compaction interval compacts immediately so
+            # boot chains stay bounded
+            os.makedirs(persistence, exist_ok=True)
+            self._wal_lock = DirLock(persistence)
+            try:
+                self.recovery_info = recover_into(self._core, persistence)
+                self._wal = WriteAheadLog(
+                    persistence, wire=wal_wire, fsync=wal_fsync,
+                    compact_every=compact_every,
+                    base_rv=self._core.resource_version(),
+                )
+                if self.recovery_info.replayed >= compact_every:
+                    self._wal.snapshot(
+                        self._core.dump(), self._core.resource_version()
+                    )
+            except BaseException:
+                self._wal_lock.release()
+                raise
 
     # ------------------------------------------------------------- writes
+    # THE WAL append seam: every core mutation — single verbs, the bulk
+    # verb, the finalizer/soft-delete sub-writes — routes through
+    # ``_commit_locked``, which appends the write's record to the WAL
+    # (flushed, write-AHEAD) before the core applies it. graftcheck WL001
+    # pins this: a core mutation outside the seam is a durability hole.
+
+    def _commit_locked(self, verb: str, kind: str, key: str,
+                       obj: Any = None, expect: int = -1) -> int:
+        """Apply ONE write to the core, WAL-logged first when persistence
+        is on. The peek mirrors the core's own failure rules exactly so a
+        doomed write raises the CANONICAL core error without ever being
+        logged (a logged-but-failed write would corrupt the replay
+        chain); caller holds the store lock."""
+        if self._wal_closed:
+            # the WAL was flushed and closed (graceful shutdown): an ack'd
+            # write from here on would be silently non-durable — refuse
+            # loudly instead of punching a hole in the recovery chain
+            raise RuntimeError(
+                "persistent store is closed — writes after close() would "
+                "never reach the WAL"
+            )
+        core = self._core
+        wal = self._wal
+        if wal is not None:
+            cur, cur_rv = core.get(kind, key)
+            if verb == "create":
+                if cur is not None:
+                    return core.create(kind, key, obj)   # canonical raise
+                ev = 0
+            elif verb == "update":
+                if expect >= 0 and (cur is None or cur_rv != expect):
+                    return core.update(kind, key, obj, expect)
+                ev = 0 if cur is None else 1
+            else:                                        # delete
+                if cur is None:
+                    return core.delete(kind, key)        # canonical raise
+                ev, obj = 2, cur
+            wal.append(ev, kind, key, obj, core.resource_version() + 1)
+            faultpoints.fire("wal-post-append-pre-apply")
+        if verb == "create":
+            return core.create(kind, key, obj)
+        if verb == "update":
+            return core.update(kind, key, obj, expect)
+        return core.delete(kind, key)
+
+    def _wal_commit_locked(self) -> None:
+        """Group commit at the end of one lock round — fsync everything
+        appended (one write = one fsync; a bulk batch shares one), BEFORE
+        any caller is acked/notified — then compact when the record
+        budget since the last snapshot is spent."""
+        wal = self._wal
+        if wal is None:
+            return
+        wal.commit()
+        if wal.wants_compaction:
+            wal.snapshot(self._core.dump(), self._core.resource_version())
+
     def create(self, kind: str, key: str, obj: Any) -> int:
         with self._lock:
             try:
-                rv = self._core.create(kind, key, obj)
+                rv = self._commit_locked("create", kind, key, obj)
             except KeyError as e:
                 raise ConflictError(str(e).strip("'\"")) from None
+            self._wal_commit_locked()
             self._lock.notify_all()
             return rv
 
@@ -314,6 +439,7 @@ class MemStore:
         and a DELETED event fires instead of MODIFIED."""
         with self._lock:
             rv = self._update_locked(kind, key, obj, expect_rv)
+            self._wal_commit_locked()
             self._lock.notify_all()
             return rv
 
@@ -333,10 +459,11 @@ class MemStore:
                 raise ConflictError(
                     f"{kind}/{key}: expected rv {expect_rv}, have {have_rv}"
                 )
-            return self._core.delete(kind, key)
+            return self._commit_locked("delete", kind, key)
         try:
-            return self._core.update(
-                kind, key, obj, -1 if expect_rv is None else expect_rv
+            return self._commit_locked(
+                "update", kind, key, obj,
+                -1 if expect_rv is None else expect_rv,
             )
         except ValueError as e:
             raise ConflictError(str(e)) from None
@@ -350,6 +477,7 @@ class MemStore:
         current revision."""
         with self._lock:
             rv = self._delete_locked(kind, key)
+            self._wal_commit_locked()
             self._lock.notify_all()
             return rv
 
@@ -366,8 +494,8 @@ class MemStore:
             doomed = dataclasses.replace(
                 current, deletion_timestamp=_time.time()
             )
-            return self._core.update(kind, key, doomed, -1)
-        return self._core.delete(kind, key)   # KeyError propagates
+            return self._commit_locked("update", kind, key, doomed, -1)
+        return self._commit_locked("delete", kind, key)  # KeyError propagates
 
     # --------------------------------------------------------------- bulk
     def bulk(self, kind: str, ops: list[dict]) -> list[dict]:
@@ -386,7 +514,9 @@ class MemStore:
                 try:
                     if verb == "create":
                         try:
-                            rv = self._core.create(kind, key, op["object"])
+                            rv = self._commit_locked(
+                                "create", kind, key, op["object"]
+                            )
                         except KeyError as e:
                             raise ConflictError(
                                 str(e).strip("'\"")
@@ -426,6 +556,9 @@ class MemStore:
                         "status": 404, "resourceVersion": 0,
                         "error": str(e).strip("'\""),
                     })
+            # one fsync for the whole batch (group commit), before any
+            # caller sees the results
+            self._wal_commit_locked()
             self._lock.notify_all()
         return out
 
@@ -601,6 +734,56 @@ class MemStore:
             return self._lock.wait_for(
                 lambda: self._core.resource_version() > rv, timeout=timeout
             )
+
+    # --------------------------------------------------------- durability
+    @property
+    def persistent(self) -> bool:
+        return self._wal is not None
+
+    def dump(self) -> list:
+        """Every object as (kind, key, obj, rv), insertion order — the
+        recovery tests' parity probe and ``compact``'s snapshot input."""
+        with self._lock:
+            return self._core.dump()
+
+    def compact(self) -> "str | None":
+        """Force a compaction snapshot now (snapshot at the current rv,
+        segment rotation, truncation of superseded files). No-op without
+        persistence. Returns the snapshot path."""
+        with self._lock:
+            if self._wal is None:
+                return None
+            return self._wal.snapshot(
+                self._core.dump(), self._core.resource_version()
+            )
+
+    def close(self) -> None:
+        """Flush + fsync + close the WAL — the graceful-shutdown path
+        (apiserver close, perf-runner finally): a clean stop never leaves
+        a torn tail for the next boot's recovery to truncate. A
+        persistent store refuses writes after close (they could never be
+        logged); a memory-only store is unaffected."""
+        with self._lock:
+            if self._wal is not None:
+                self._wal.close()
+                self._wal = None
+                self._wal_closed = True
+            if self._wal_lock is not None:
+                self._wal_lock.release()
+                self._wal_lock = None
+
+    def wal_stats(self) -> "dict | None":
+        """Append-side counters for metrics/bench (None when off)."""
+        with self._lock:
+            wal = self._wal
+            if wal is None:
+                return None
+            return {
+                "records_appended": wal.records_appended,
+                "bytes_appended": wal.bytes_appended,
+                "fsyncs": wal.fsyncs,
+                "records_since_snapshot": wal.records_since_snapshot,
+            }
 
 
 class SelectorView:

@@ -1,0 +1,29 @@
+"""WL001 known-bad: store-core mutations that bypass the WAL append seam
+(the ``wal_*`` basename puts this file in the checker's scope)."""
+
+
+class Store:
+    def __init__(self, core):
+        self._core = core
+
+    def _commit_locked(self, verb, kind, key, obj=None, expect=-1):
+        # the blessed seam: log-then-apply (mutations here are fine)
+        if verb == "create":
+            return self._core.create(kind, key, obj)
+        if verb == "update":
+            return self._core.update(kind, key, obj, expect)
+        return self._core.delete(kind, key)
+
+    def fast_create(self, kind, key, obj):
+        return self._core.create(kind, key, obj)  # expect: WL001
+
+    def patch(self, kind, key, obj):
+        return self._core.update(kind, key, obj, -1)  # expect: WL001
+
+    def purge(self, kind, key):
+        core = self._core
+        return core.delete(kind, key)  # expect: WL001
+
+    def reads_are_fine(self, kind, key):
+        obj, rv = self._core.get(kind, key)     # reads never gate
+        return obj, rv, self._core.resource_version()

@@ -1,0 +1,33 @@
+"""WL001 known-good: every mutation routes through the append seam; core
+reads and non-core receivers stay unrestricted."""
+
+
+class Store:
+    def __init__(self, core, wal):
+        self._core = core
+        self._wal = wal
+
+    def _commit_locked(self, verb, kind, key, obj=None, expect=-1):
+        # the seam itself: append the record, then apply to the core
+        self._wal.append(0, kind, key, obj, self._core.resource_version() + 1)
+        if verb == "create":
+            return self._core.create(kind, key, obj)
+        if verb == "update":
+            return self._core.update(kind, key, obj, expect)
+        return self._core.delete(kind, key)
+
+    def create(self, kind, key, obj):
+        return self._commit_locked("create", kind, key, obj)
+
+    def delete(self, kind, key):
+        return self._commit_locked("delete", kind, key)
+
+    def lookup(self, kind, key):
+        obj, rv = self._core.get(kind, key)     # reads are unrestricted
+        return obj, rv
+
+    def unrelated_receivers(self, registry, kind, key, obj):
+        # create/update/delete on NON-core receivers are not the seam's
+        # business (e.g. a client or registry object)
+        registry.create(kind, key, obj)
+        registry.delete(kind, key)

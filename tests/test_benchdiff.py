@@ -162,6 +162,47 @@ def test_recovery_time_regression_gates(tmp_path, capsys):
     assert "recovery_s" in out and "REGRESSION" in out
 
 
+def test_wal_overhead_regression_gates(tmp_path, capsys):
+    def rec(frac):
+        return {"metric": "WALOverhead_bulk_writes", "unit": "ratio",
+                "value": round(1.0 - frac, 4), "wal_overhead_frac": frac}
+
+    old = _write(tmp_path, "old.json", [rec(0.20)])
+    ok = _write(tmp_path, "ok.json", [rec(0.28)])   # +40%, +0.08 < floor
+    bad = _write(tmp_path, "bad.json", [rec(0.55)])  # +175% and +0.35
+    assert main([old, ok]) == 0
+    capsys.readouterr()
+    rc = main([old, bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "wal_overhead_frac" in out and "REGRESSION" in out
+
+
+def test_durability_records_pass_against_themselves(tmp_path):
+    """Self-diff pinned green: the CrashRecovery_* and WALOverhead_*
+    lines the bench now emits gate recovery_s and wal_overhead_frac
+    without ever tripping on an identical record."""
+    lines = [
+        {
+            "metric": "CrashRecovery_5000Nodes_50000Pods", "unit": "s",
+            "value": 4.1, "recovery_s": 4.1, "relist_storm_s": 0.4,
+            "watchers": 200, "binding_parity": 25000, "parity_ok": True,
+        },
+        {
+            "metric": "WALOverhead_bulk_writes", "unit": "ratio",
+            "value": 0.6, "wal_overhead_frac": 0.4,
+            "on_writes_per_s": 20000.0, "off_writes_per_s": 33000.0,
+        },
+    ]
+    rec = _write(tmp_path, "self.json", lines)
+    assert main([rec, rec]) == 0
+    deltas, _old, _new = compare(load_record(rec), load_record(rec))
+    fields = {(d.metric, d.field) for d in deltas}
+    assert ("CrashRecovery_5000Nodes_50000Pods", "recovery_s") in fields
+    assert ("WALOverhead_bulk_writes", "wal_overhead_frac") in fields
+    assert not any(d.regression for d in deltas)
+
+
 def test_cli_subcommand_dispatch(tmp_path, capsys):
     from kubetpu.cli import main as cli_main
 

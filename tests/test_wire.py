@@ -121,6 +121,32 @@ def test_binary_roundtrips_every_registered_kind():
         assert via_binary == via_json, kind
 
 
+def test_schema_fingerprint_is_process_stable():
+    """Two fresh interpreters with identical imports derive the SAME
+    fingerprint. A required field's MISSING default once leaked
+    ``repr(<_MISSING_TYPE at 0x…>)`` — a memory address — into the spec,
+    making the fingerprint process-specific: cross-process binary
+    negotiation silently always fell back to JSON, and a binary WAL
+    written by one process refused to decode in any other."""
+    import os
+    import subprocess
+    import sys
+
+    prog = (
+        "from kubetpu.api import types, codec; "
+        "print(codec.schema_fingerprint())"
+    )
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    fps = [
+        subprocess.run(
+            [sys.executable, "-c", prog], env=env,
+            capture_output=True, text=True, timeout=120,
+        ).stdout.strip()
+        for _ in range(2)
+    ]
+    assert fps[0] and fps[0] == fps[1], fps
+
+
 def test_rich_fixtures_cross_decode_identically():
     """Deep nested objects (affinity/tolerations/spread/stamps) decode to
     the SAME typed value from either wire — JSON↔binary cross-decode."""
