@@ -144,6 +144,15 @@ DURABILITY_SHAPE = (5000, 50000)        # nodes, pods
 DURABILITY_WATCHERS = 200
 DURABILITY_BUDGET_S = 240.0
 
+# --- telemetry plane (kubetpu.telemetry) ------------------------------------
+# The <5% overhead budget for the FULL telemetry plane — collector over
+# HTTP, traceparent on every RPC, 1 s export cadence from both processes —
+# measured as an on/off pair on the judged 500-node fullstack row; one
+# TelemetryOverhead_* line per pair (within_budget = ratio >= 0.95,
+# spans_dropped asserted zero), benchdiff-gated via telemetry_overhead_frac.
+TELEMETRY_CASE = ("SchedulingBasic", "500Nodes", "greedy", 128)
+TELEMETRY_BUDGET_S = 240.0
+
 QUADRATIC = {"SchedulingPodAffinity", "TopologySpreading"}
 
 
@@ -181,6 +190,7 @@ def run_stage(
     flight_recorder: bool = True,
     wire: str = "binary",
     watch_fanout: int = 0,
+    telemetry: bool = False,
 ) -> dict:
     import contextlib
 
@@ -208,7 +218,8 @@ def run_stage(
     if mode != "direct":
         # the wire seam exists only on the REST hop: direct mode has no
         # apiserver, so the flags stay out of its runner call
-        extra = {"wire": wire, "watch_fanout": watch_fanout}
+        extra = {"wire": wire, "watch_fanout": watch_fanout,
+                 "telemetry": telemetry}
     t0 = time.perf_counter()
     with ctx:
         r = runner(
@@ -233,6 +244,8 @@ def run_stage(
         suffix += "_jsonwire"
     if watch_fanout:
         suffix += f"_{watch_fanout}watchers"
+    if telemetry:
+        suffix += "_telemetry"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -317,6 +330,10 @@ def run_stage(
         out["soak"] = r.soak
     if not flight_recorder:
         out["flight_recorder"] = False
+    if r.telemetry is not None:
+        # the telemetry-plane evidence: span totals + the drop counter
+        # the TelemetryOverhead gate asserts stayed zero
+        out["telemetry"] = r.telemetry
     if r.metrics_snapshot is not None:
         # post-run metrics snapshot (p50/p99 from the scheduler histograms,
         # schedule_attempts by result): every BENCH line carries its own
@@ -841,6 +858,82 @@ def _run_durability_stages() -> None:
         _status(f"durability stage FAILED: {e}")
 
 
+def _run_telemetry_stages() -> None:
+    """The telemetry-plane overhead pair: the judged fullstack row with
+    the WHOLE plane on (HTTP collector + traceparent propagation + both
+    exporters) vs off, one TelemetryOverhead_* line — throughput side by
+    side, overhead fraction, the <5% within_budget verdict, and the
+    collector's span-drop counter (must be zero for the on-run's trace
+    to count as complete evidence)."""
+    case, workload, engine, max_batch = TELEMETRY_CASE
+    t0 = time.perf_counter()
+    pair: dict[bool, dict] = {}
+    for on in (True, False):
+        if time.perf_counter() - t0 > TELEMETRY_BUDGET_S:
+            _status("telemetry budget exhausted; skipping pair half")
+            continue
+        _status(f"telemetry stage: {case}/{workload}/{engine} "
+                f"telemetry={'on' if on else 'off'}")
+        # the off-half gets its OWN suffix: run_stage's defaults would
+        # otherwise reuse the judged STAGES row's exact metric name, and
+        # a duplicate (or an error line under the judged name) would
+        # shadow the real acceptance row in benchdiff
+        metric_suffix = "_telemetry" if on else "_notelemetry"
+        try:
+            line = run_stage(
+                case, workload, engine, "fullstack", max_batch,
+                telemetry=on,
+            )
+        except Exception as e:
+            _emit({
+                "metric": (
+                    f"{case}_{workload}_{engine}_fullstack{metric_suffix}"
+                ),
+                "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                "engine": engine, "mode": "fullstack",
+                "backend": _backend(),
+                "error": f"{type(e).__name__}: {e}",
+            })
+            _status(f"telemetry stage FAILED ({on=}): {e}")
+            continue
+        if not on:
+            line = dict(line, metric=line["metric"] + "_notelemetry")
+        pair[on] = line
+        _emit(line)
+    on_l, off_l = pair.get(True), pair.get(False)
+    if not on_l or not off_l:
+        return
+    fields = ("value", "duration_s", "p99_attempt_latency_ms")
+    tele = on_l.get("telemetry") or {}
+    comp = {
+        "metric": f"TelemetryOverhead_{case}_{workload}_{engine}",
+        "unit": "ratio",
+        "mode": "fullstack",
+        "backend": on_l.get("backend"),
+        "telemetry_on": {
+            k: on_l.get(k) for k in fields if on_l.get(k) is not None
+        },
+        "telemetry_off": {
+            k: off_l.get(k) for k in fields if off_l.get(k) is not None
+        },
+        "spans": tele.get("spans"),
+        "spans_dropped": tele.get("spans_dropped", 0),
+        # complete-evidence assert: a drop would mean the merged trace is
+        # lying by omission — the stage itself flags it, not just a reader
+        "spans_dropped_zero": tele.get("spans_dropped", 0) == 0,
+    }
+    if on_l.get("value") and off_l.get("value"):
+        ratio = on_l["value"] / off_l["value"]
+        comp["value"] = round(ratio, 3)
+        comp["telemetry_overhead_frac"] = round(max(1.0 - ratio, 0.0), 4)
+        # the acceptance gate: the whole plane costs <5% throughput
+        comp["within_budget"] = ratio >= 0.95
+    _emit(comp)
+    _status(f"telemetry stage done: overhead_frac="
+            f"{comp.get('telemetry_overhead_frac')} "
+            f"(dropped={comp['spans_dropped']})")
+
+
 def main() -> None:
     global STAGES
     probe, probe_s = _probe_backend()
@@ -959,6 +1052,7 @@ def main() -> None:
     _run_wire_stages()
     _run_federation_stages()
     _run_durability_stages()
+    _run_telemetry_stages()
     final = best_quadratic or best_any
     if final is None:
         _emit({

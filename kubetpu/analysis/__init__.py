@@ -20,6 +20,8 @@ Checker catalog (``--explain CODE`` prints the full rationale):
 - CL001              injectable-clock discipline in lease/backoff code
 - WP001              wire-codec seam discipline on API hot paths
 - WL001              WAL append-seam discipline for store-core mutations
+- TR003              telemetry span coverage — apiserver handlers and
+                     dispatcher call executors run under a span
 
 Import surface: ``analyze_paths`` runs the suite programmatically (the
 tier-1 test ``tests/test_static_analysis.py`` gates on it), ``CHECKERS``
@@ -47,3 +49,4 @@ from . import spancheck  # noqa: F401,E402
 from . import clockcheck  # noqa: F401,E402
 from . import wirecheck  # noqa: F401,E402
 from . import walcheck  # noqa: F401,E402
+from . import tracecheck  # noqa: F401,E402

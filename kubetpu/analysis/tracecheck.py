@@ -1,0 +1,148 @@
+"""Telemetry span-coverage checker (TR003).
+
+The telemetry plane's cross-process joins only work when BOTH halves of
+every hop actually record a span: the apiserver's request handlers (the
+server half — ``_track_span`` wraps metrics AND the server span joined
+to the client's traceparent) and the API dispatcher's call executors
+(the scheduler-side dispatch leg — ``_record_call_span``). A handler or
+executor added without its span silently punches a hole in every pod's
+merged timeline — the exact observability gap the collector exists to
+close — and nothing fails until someone stares at a trace with a
+missing lane. TR003 pins the coverage at parse time:
+
+- every HTTP verb handler (``do_GET``/``do_POST``/…) in an apiserver
+  server module must run its work under a span seam (``_track_span``,
+  or a direct ``tracer.span``/``tracer.record``);
+- every dispatcher function that executes a call type (an attribute
+  call ``<call>.execute(…)``/``<call>.execute_api(…)`` on a non-self
+  receiver) must touch the span seam (``_record_call_span`` or a direct
+  tracer call) in the same function.
+
+Alias-resolving like WP001/WL001: a seam reached through a local
+rebinding (``span = self._track_span``) still counts — and a handler
+that renames the seam away from the recognized set fails loudly rather
+than silently dropping out of coverage.
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from .core import Checker, ModuleInfo, Violation, register
+
+#: modules the invariant covers (repo-relative, forward slashes)
+_SCOPE_FILES = {
+    "kubetpu/apiserver/server.py",
+    "kubetpu/sched/api_dispatcher.py",
+}
+
+#: attribute names that ARE the span seam: the apiserver's combined
+#: metrics+span context manager, the dispatcher's per-call recorder, and
+#: the tracer primitives themselves
+_SPAN_SEAMS = {"_track_span", "track_span", "_record_call_span",
+               "span", "record", "instant"}
+
+#: call-executor attribute names (the dispatcher's call-type protocol)
+_EXECUTE_ATTRS = {"execute", "execute_api"}
+
+
+def _is_self(node: ast.AST) -> bool:
+    return isinstance(node, ast.Name) and node.id == "self"
+
+
+class _FnFacts(ast.NodeVisitor):
+    """Per-function facts: does it execute call types, does it touch the
+    span seam (directly or through a local alias of one)?"""
+
+    def __init__(self) -> None:
+        self.executes = False
+        self.spans = False
+        self._aliases: set[str] = set()
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        # alias resolution: span = self._track_span / rec = tracer.record
+        if isinstance(node.value, ast.Attribute) and (
+            node.value.attr in _SPAN_SEAMS
+        ):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Name):
+                    self._aliases.add(tgt.id)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _SPAN_SEAMS:
+                self.spans = True
+            elif f.attr in _EXECUTE_ATTRS and not _is_self(f.value):
+                # a call type being executed (self.execute_api is the
+                # call type's OWN delegation, not an execution site)
+                self.executes = True
+        elif isinstance(f, ast.Name) and f.id in self._aliases:
+            self.spans = True
+        self.generic_visit(node)
+
+
+@register
+class SpanCoverage(Checker):
+    code = "TR003"
+    title = "apiserver handler / dispatcher executor without a span"
+    rationale = (
+        "Cross-process traces are only as complete as their weakest "
+        "hop: the apiserver's server span (joined to the client's "
+        "traceparent) and the dispatcher's api.<call_type> span are the "
+        "two halves of every pod's merged timeline, and a handler or "
+        "call executor that skips the seam leaves a silent hole no test "
+        "fails on — the trace just lies by omission. Every do_<VERB> "
+        "HTTP handler in an apiserver server module must run its work "
+        "under _track_span (or a direct tracer span/record), and every "
+        "dispatcher function that executes a call type "
+        "(<call>.execute/<call>.execute_api on a non-self receiver) "
+        "must record through _record_call_span (or the tracer) in the "
+        "same function. Route new handlers through the existing seams — "
+        "they also carry the metrics window and the pod-trace linkage."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        base = posixpath.basename(relpath)
+        if base.startswith("trace_") and base.endswith(".py"):
+            return True     # the known-bad/known-good fixtures
+        return relpath in _SCOPE_FILES
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        for cls in ast.walk(mod.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            for fn in cls.body:
+                if not isinstance(
+                    fn, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    continue
+                facts = _FnFacts()
+                facts.visit(fn)
+                symbol = f"{cls.name}.{fn.name}"
+                if fn.name.startswith("do_") and not facts.spans:
+                    out.append(Violation(
+                        path=mod.relpath, line=fn.lineno, code=self.code,
+                        symbol=symbol,
+                        message=(
+                            f"HTTP handler {fn.name} runs no span seam "
+                            "(_track_span / tracer.record) — its requests "
+                            "vanish from the merged cross-process trace"
+                        ),
+                    ))
+                elif facts.executes and not facts.spans:
+                    out.append(Violation(
+                        path=mod.relpath, line=fn.lineno, code=self.code,
+                        symbol=symbol,
+                        message=(
+                            f"{fn.name} executes a dispatcher call type "
+                            "without recording its span "
+                            "(_record_call_span / tracer.record) — the "
+                            "dispatch leg disappears from every pod's "
+                            "timeline"
+                        ),
+                    ))
+        return out

@@ -180,8 +180,41 @@ def binary_stream_content_type() -> str:
     return f"{CT_BINARY_STREAM}; v={WIRE_VERSION}; schema={schema_fingerprint()}"
 
 
-def content_type_for(codec: str) -> str:
-    return binary_content_type() if codec == BINARY else CT_JSON
+#: the W3C trace-context header (JSON wire) and its binary-envelope twin:
+#: on the binary content type the traceparent rides as a media-type
+#: parameter (``tp=00-…``) next to the schema fingerprint — one envelope,
+#: negotiated and parsed by the same seam, so a 415/JSON fallback simply
+#: moves the SAME value back to the header. Both are ABSENT when telemetry
+#: is off (byte-identical wire).
+TRACEPARENT_HEADER = "traceparent"
+TRACEPARENT_PARAM = "tp"
+
+
+def content_type_for(codec: str, traceparent: str | None = None) -> str:
+    """The request/reply Content-Type for ``codec``. ``traceparent``
+    attaches the trace context to a BINARY envelope (the ``tp`` media-type
+    parameter); the JSON wire carries it in the ``traceparent`` header
+    instead (see ``traceparent_from_headers``)."""
+    if codec == BINARY:
+        ct = binary_content_type()
+        if traceparent:
+            ct += f"; {TRACEPARENT_PARAM}={traceparent}"
+        return ct
+    return CT_JSON
+
+
+def traceparent_from_headers(headers) -> str | None:
+    """Extract a propagated traceparent from one request's headers,
+    whichever envelope carried it: the binary Content-Type's ``tp``
+    parameter wins (the binary envelope field), else the W3C
+    ``traceparent`` header (the JSON wire). Returns the RAW value —
+    validation (malformed → ignored, never fatal) is the parser's job
+    (kubetpu.telemetry.context.parse_traceparent)."""
+    _media, params = parse_content_type(headers.get("Content-Type"))
+    tp = params.get(TRACEPARENT_PARAM)
+    if tp:
+        return tp
+    return headers.get(TRACEPARENT_HEADER)
 
 
 def parse_content_type(value: str | None) -> tuple[str, dict[str, str]]:

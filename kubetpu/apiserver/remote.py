@@ -56,13 +56,24 @@ class RemoteStore:
     BACKOFF_JITTER = 0.25       # +/- fraction of the delay
 
     def __init__(self, base_url: str, timeout_s: float = 30.0,
-                 wire: str = "binary") -> None:
+                 wire: str = "binary", traceparent: bool = False,
+                 tracer=None) -> None:
+        """``traceparent=True`` stamps a W3C-style trace context on every
+        RPC — the ``traceparent`` header on the JSON wire, the ``tp``
+        media-type parameter on the binary envelope (both through the
+        codec seam, so a 415/JSON fallback carries the SAME value in the
+        other slot) — and, with a ``tracer`` bound, records one client
+        span per request so the apiserver's server span joins it. False
+        (the default, ``--telemetry off``) is byte-identical to the
+        pre-telemetry wire: no header, no parameter, no span."""
         import threading
 
         if wire not in ("binary", "json"):
             raise ValueError(f"wire must be binary|json, got {wire!r}")
         self.base = base_url.rstrip("/")
         self.timeout_s = timeout_s
+        self._traceparent = traceparent
+        self._tracer = tracer
         # persistent per-THREAD connections (client-go's transport reuse):
         # a fresh TCP handshake per request would dominate the bind path
         self._local = threading.local()
@@ -190,9 +201,13 @@ class RemoteStore:
         codec encodes it here, so no caller pre-serializes. A 415 response
         means the server cannot decode our binary dialect: fall back to
         JSON permanently and re-issue once (the mixed-version path)."""
+        # ONE trace context per logical request: the 415/JSON re-issue
+        # below carries the SAME value back in the header envelope, so
+        # the two attempts correlate as one trace
+        ctx = self._trace_context()
         for _wire_attempt in range(2):
             status, raw, resp_ct = self._request_transport(
-                method, path, body
+                method, path, body, ctx
             )
             if status == 415 and self._wire_ok is not False:
                 self._wire_ok = False
@@ -229,8 +244,38 @@ class RemoteStore:
             raise PermissionError(reason)
         raise RemoteStoreError(f"{status}: {reason}")
 
-    def _request_headers(self, wire_out: str) -> dict:
-        headers = {"Content-Type": codec.content_type_for(wire_out)}
+    def set_tracer(self, tracer) -> None:
+        """Bind the span recorder client rpc spans land in (the owning
+        component's Tracer) — split from __init__ because the scheduler
+        that owns the tracer is constructed around this store."""
+        self._tracer = tracer
+
+    def _trace_context(self):
+        """A fresh per-request trace context when propagation is on
+        (telemetry); None otherwise — and None means the request's bytes
+        are identical to a pre-telemetry client's."""
+        if not self._traceparent:
+            return None
+        from ..telemetry.context import TraceContext, new_span_id, new_trace_id
+
+        return TraceContext(new_trace_id(), new_span_id())
+
+    def _request_headers(self, wire_out: str, ctx=None) -> dict:
+        tp = None
+        if ctx is not None:
+            from ..telemetry.context import format_traceparent
+
+            tp = format_traceparent(ctx)
+        if wire_out == codec.BINARY:
+            # binary envelope: the traceparent rides the media type next
+            # to the schema fingerprint (codec.TRACEPARENT_PARAM)
+            headers = {
+                "Content-Type": codec.content_type_for(wire_out, tp)
+            }
+        else:
+            headers = {"Content-Type": codec.content_type_for(wire_out)}
+            if tp:
+                headers[codec.TRACEPARENT_HEADER] = tp
         if self._wire_ok is not False:
             # advertise our binary dialect (media type + schema
             # fingerprint); a server that matches replies binary and
@@ -247,7 +292,8 @@ class RemoteStore:
         ):
             self._wire_ok = True
 
-    def _request_transport(self, method: str, path: str, body: Any):
+    def _request_transport(self, method: str, path: str, body: Any,
+                           ctx=None):
         """The transport half with ONE safe retry. Blindly resending a
         non-idempotent verb after a transport error could double-apply it
         (a create whose response was lost resends → 409 for a create that
@@ -258,9 +304,15 @@ class RemoteStore:
         on any transport error; everything else surfaces as
         RemoteUnavailableError for the caller to decide. Returns
         (status, raw body, response content type)."""
+        import time as _time
+
         wire_out = codec.BINARY if self._wire_ok else codec.JSON
         data = codec.dumps(body, wire_out) if body is not None else None
-        headers = self._request_headers(wire_out)
+        # ``ctx`` is the caller's per-LOGICAL-request trace context: the
+        # provably-safe retry below and _request's 415/JSON re-issue both
+        # re-send with the same trace + span ids
+        headers = self._request_headers(wire_out, ctx)
+        t_span = _time.perf_counter() if ctx is not None else 0.0
         last: Exception | None = None
         for attempt in range(2):
             try:
@@ -278,6 +330,16 @@ class RemoteStore:
                 status, raw = resp.status, resp.read()
                 resp_ct = resp.getheader("Content-Type")
                 self._note_response_ct(resp_ct)
+                if ctx is not None and self._tracer is not None:
+                    # the client half of the cross-process join: the
+                    # server span opened for this request carries the
+                    # same trace id + this span id as its parent
+                    self._tracer.record(
+                        f"rpc.{method}", start=t_span,
+                        end=_time.perf_counter(),
+                        path=path.partition("?")[0], status=status,
+                        trace_id=ctx.trace_id, span_id=ctx.span_id,
+                    )
                 return status, raw, resp_ct
             except (ConnectionError, TimeoutError, OSError,
                     http.client.HTTPException) as e:
@@ -514,6 +576,13 @@ class RemoteStreamWatcher:
             headers = {}
             if self._store._wire_ok is not False:
                 headers["Accept"] = codec.binary_stream_content_type()
+            ctx = self._store._trace_context()
+            if ctx is not None:
+                from ..telemetry.context import format_traceparent
+
+                # a stream GET carries no body, so the header is the
+                # envelope on both wires
+                headers[codec.TRACEPARENT_HEADER] = format_traceparent(ctx)
             conn.request(
                 "GET",
                 f"/apis/{self._kind}?watch=1&stream=1"

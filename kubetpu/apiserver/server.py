@@ -72,6 +72,8 @@ kubetpu.apiserver.remote.RemoteStore).
 from __future__ import annotations
 
 import threading
+import time
+from contextlib import contextmanager
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs, urlsplit
 
@@ -236,6 +238,8 @@ class _Handler(BaseHTTPRequestHandler):
     metrics: APIServerMetrics   # request instrumentation (bound by factory)
     health: HealthChecks        # /healthz /readyz /livez (bound by factory)
     event_cache: EventEncodeCache   # serialize-once fan-out (bound by factory)
+    tracer = None       # server-span recorder (bound by factory)
+    collector = None    # embedded telemetry collector (bound when enabled)
     metrics_sources: tuple = ()  # extra Prometheus-text providers
     wire_enabled: bool = True    # False = JSON-only server (--wire json):
     #                              ignores binary Accept, 415s binary bodies
@@ -243,6 +247,60 @@ class _Handler(BaseHTTPRequestHandler):
 
     def log_message(self, *args) -> None:
         pass
+
+    # ------------------------------------------------------------- tracing
+    @contextmanager
+    def _track_span(self, verb: str, resource: str,
+                    long_running: bool = False):
+        """THE request-instrumentation seam: every handler runs under it
+        (graftcheck TR003 pins this). One ``metrics.track`` window plus
+        one server span recorded at completion — joined to the client's
+        span when the request carried a traceparent (the ``traceparent``
+        header on the JSON wire, the binary envelope's ``tp`` media-type
+        parameter; a malformed value is IGNORED, never a 4xx). Pod writes
+        stash their attribution ids via ``_note_pod_trace`` so the span
+        links the pod's cross-process timeline."""
+        from ..telemetry.context import parse_traceparent
+
+        ctx = parse_traceparent(codec.traceparent_from_headers(self.headers))
+        # per-request stash (one handler instance serves one connection's
+        # requests sequentially, so a plain attribute is race-free)
+        self._span_pod_traces: list[str] = []
+        t0 = time.perf_counter()
+        try:
+            with self.metrics.track(
+                verb, resource, lambda: getattr(self, "_status", 0),
+                long_running=long_running,
+            ):
+                yield
+        finally:
+            attrs: dict = {
+                "verb": verb, "resource": resource,
+                "code": getattr(self, "_status", 0),
+            }
+            if ctx is not None:
+                # the cross-process join: same trace id as the client's
+                # rpc span, the client span as this span's remote parent
+                attrs["trace_id"] = ctx.trace_id
+                attrs["parent_span_id"] = ctx.span_id
+            if self._span_pod_traces:
+                attrs["pod_traces"] = self._span_pod_traces[:64]
+            self.tracer.record(
+                f"apiserver.{verb}", start=t0, end=time.perf_counter(),
+                **attrs,
+            )
+
+    def _note_pod_trace(self, kind: str, obj) -> None:
+        """Link this request's server span to a pod's attribution id (the
+        16-hex ``trace_id`` stamped at ingest) — how an ingest or
+        bind-subresource span joins the pod's scheduler-side timeline."""
+        if kind != "pods":
+            return
+        tid = getattr(obj, "trace_id", "") or ""
+        if tid:
+            stash = getattr(self, "_span_pod_traces", None)
+            if stash is not None and len(stash) < 64:
+                stash.append(tid)
 
     # ------------------------------------------------------------ plumbing
     def _reply_codec(self) -> str:
@@ -342,6 +400,15 @@ class _Handler(BaseHTTPRequestHandler):
                 parts.path, parse_qs(parts.query, keep_blank_values=True),
                 metrics_sources=(self.metrics.expose, *self.metrics_sources),
                 health=self.health,
+                extra={
+                    # the apiserver's server spans as Chrome-trace JSON —
+                    # same shape as the scheduler diagnostics /trace
+                    # (non-destructive; the telemetry exporter drains)
+                    "/trace": lambda q: (
+                        "application/json",
+                        codec.dumps(self.tracer.chrome_trace()).decode(),
+                    ),
+                },
             )
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
             self._error(500, f"{type(e).__name__}: {e}")
@@ -353,19 +420,54 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply_text(body, status=status, content_type=content_type)
 
     # --------------------------------------------------------------- verbs
+    def _serve_collector(self, method: str) -> bool:
+        """Embedded-collector mode: /telemetry/* routed to the bound
+        collector (the apiserver doubles as the telemetry sink — one less
+        process for small clusters). False when the path is not ours."""
+        if self.collector is None:
+            return False
+        parts = urlsplit(self.path)
+        if not parts.path.startswith("/telemetry/"):
+            return False
+        from ..telemetry.collector import handle_collector_request
+
+        body = b""
+        if method == "POST":
+            length = int(self.headers.get("Content-Length", 0))
+            body = self.rfile.read(length) if length else b""
+        try:
+            res = handle_collector_request(
+                self.collector, method, parts.path,
+                parse_qs(parts.query, keep_blank_values=True),
+                body, self.headers.get("Content-Type"),
+            )
+        except codec.UnsupportedWireError as e:
+            self._error(415, str(e))
+            return True
+        except Exception as e:  # noqa: BLE001 — telemetry must not crash
+            self._error(500, f"{type(e).__name__}: {e}")
+            return True
+        if res is None:
+            self._error(404, "unknown telemetry path")
+            return True
+        status, content_type, data = res
+        self._reply_text(
+            data.decode() if isinstance(data, bytes) else data,
+            status=status, content_type=content_type,
+        )
+        return True
+
     def do_GET(self) -> None:  # noqa: N802
         if not urlsplit(self.path).path.startswith(PREFIX):
-            self._serve_diagnostics()
+            if not self._serve_collector("GET"):
+                self._serve_diagnostics()
             return
         kind, key, q = self._route()
         if kind is None:
             if q.get("watch") and q.get("buckets"):
                 # batched multi-kind watch poll: N informer cursors, one
                 # round trip (long-running like every watch)
-                with self.metrics.track(
-                    "WATCH", "multi", lambda: getattr(self, "_status", 0),
-                    long_running=True,
-                ):
+                with self._track_span("WATCH", "multi", long_running=True):
                     try:
                         self._watch_bulk(q)
                     except ValueError as e:
@@ -381,13 +483,10 @@ class _Handler(BaseHTTPRequestHandler):
             verb = "LIST"
         else:
             verb = "GET"
-        with self.metrics.track(
-            verb, kind, lambda: getattr(self, "_status", 0),
-            # EVERY watch is long-running (the reference's longrunning
-            # predicate covers long-polls too): a blocked wait_for must not
-            # hold the in-flight gauge
-            long_running=(verb == "WATCH"),
-        ):
+        # EVERY watch is long-running (the reference's longrunning
+        # predicate covers long-polls too): a blocked wait_for must not
+        # hold the in-flight gauge
+        with self._track_span(verb, kind, long_running=(verb == "WATCH")):
             self._do_get(kind, key, q)
 
     def _do_get(self, kind, key, q) -> None:
@@ -640,6 +739,7 @@ class _Handler(BaseHTTPRequestHandler):
         # as_object: a binary body already materialized the typed object;
         # a JSON body left the kind-tagged dict — one normalization point
         obj = _stamp_pod_ingest(kind, codec.as_object(payload))
+        self._note_pod_trace(kind, obj)     # ingest span ↔ pod timeline
         # the admission chain's write locks span admit AND create so a
         # usage-counting validator (quota) cannot race a concurrent
         # create of the same scope
@@ -651,18 +751,21 @@ class _Handler(BaseHTTPRequestHandler):
         self, kind: str, key: str, payload, expect_rv: int | None
     ) -> int:
         obj = codec.as_object(payload)
+        self._note_pod_trace(kind, obj)     # bind-subresource span ↔ pod
         with self.registry.locked(kind, key, obj, verb="update"):
             old, _old_rv = self.store.get(kind, key)
             obj = self.registry.admit(kind, key, obj, old=old, verb="update")
             return self.store.update(kind, key, obj, expect_rv=expect_rv)
 
     def do_POST(self) -> None:  # noqa: N802
+        if not urlsplit(self.path).path.startswith(PREFIX):
+            if not self._serve_collector("POST"):
+                self._error(404, "unknown path")
+            return
         kind, key, _ = self._route()
         if kind is not None and key is None and kind.endswith(BULK_SUFFIX):
             resource = kind[: -len(BULK_SUFFIX)]
-            with self.metrics.track(
-                "BULK", resource, lambda: getattr(self, "_status", 0)
-            ):
+            with self._track_span("BULK", resource):
                 try:
                     self._do_bulk(resource)
                 except codec.UnsupportedWireError as e:
@@ -673,9 +776,7 @@ class _Handler(BaseHTTPRequestHandler):
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
-        with self.metrics.track(
-            "CREATE", kind, lambda: getattr(self, "_status", 0)
-        ):
+        with self._track_span("CREATE", kind):
             try:
                 rv = self._apply_create(kind, key, self._read_body())
                 self._reply({"resourceVersion": rv}, status=201)
@@ -697,9 +798,7 @@ class _Handler(BaseHTTPRequestHandler):
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
-        with self.metrics.track(
-            "UPDATE", kind, lambda: getattr(self, "_status", 0)
-        ):
+        with self._track_span("UPDATE", kind):
             try:
                 expect = (
                     int(q["resourceVersion"])
@@ -765,6 +864,7 @@ class _Handler(BaseHTTPRequestHandler):
                     real = "create" if verb == "create" else "update"
                     if real == "create":
                         obj = _stamp_pod_ingest(kind, obj)
+                    self._note_pod_trace(kind, obj)
                     # this path only runs WITHOUT dynamic admission, so
                     # admit() is pure strategy validation — no locker to
                     # hold, no hook to feed `old`, no per-op store read
@@ -839,9 +939,7 @@ class _Handler(BaseHTTPRequestHandler):
         if kind is None or key is None:
             self._error(404, "kind and key required")
             return
-        with self.metrics.track(
-            "DELETE", kind, lambda: getattr(self, "_status", 0)
-        ):
+        with self._track_span("DELETE", kind):
             try:
                 rv = self.store.delete(kind, key)
                 self._reply({"resourceVersion": rv})
@@ -861,6 +959,7 @@ class APIServer:
         metrics_sources: tuple = (),
         wire: str = "binary",
         persistence: "str | None" = None,
+        collector: bool = False,
     ) -> None:
         """``metrics_sources``: extra Prometheus-text providers appended to
         GET /metrics (e.g. a co-hosted controller family's workqueue set).
@@ -869,6 +968,11 @@ class APIServer:
         a JSON-only server that ignores binary Accept headers and 415s
         binary bodies (exactly what a pre-binary server build does, so
         mixed-version client/server pairs are testable).
+        ``collector``: mount the embedded telemetry collector on this
+        server's listener (/telemetry/export /telemetry/clock
+        /telemetry/trace /telemetry/metrics /telemetry/flightrecorder
+        /telemetry/top) — the apiserver doubles as the cluster's span/
+        metrics sink, the ``kubetpu collector``-less deployment shape.
         ``persistence``: a directory path makes the server's store durable
         (``--persistence dir``): recover-on-start replays the WAL +
         snapshot, every committed write is logged-then-applied, and
@@ -905,6 +1009,25 @@ class APIServer:
         # stream frame (the store binding merges the native body ring's
         # hit/miss counters into the exposed numbers)
         self.event_cache = EventEncodeCache(store=self.store)
+        # server spans: one per request through the _track_span seam,
+        # joined to client spans via the propagated traceparent; drained
+        # by the telemetry exporter, browsable at /trace
+        from ..tracing import Tracer
+
+        self.tracer = Tracer(max_spans=8192)
+        self.collector = None
+        if collector:
+            from ..telemetry.collector import Collector
+
+            self.collector = Collector()
+        # durable-store observability: the WAL's fsync histogram +
+        # segment/byte/snapshot-age gauges ride this server's /metrics
+        # (a memory-only store exposes nothing)
+        wal_sources: tuple = ()
+        if getattr(self.store, "persistent", False):
+            wal_text = getattr(self.store, "wal_metrics_text", None)
+            if callable(wal_text):
+                wal_sources = (wal_text,)
 
         def _event_cache_metrics() -> str:
             stats = self.event_cache.stats_by_codec()
@@ -924,14 +1047,17 @@ class APIServer:
                 )
             return "".join(lines)
 
+        self._metrics_sources = (
+            _event_cache_metrics, *wal_sources, *metrics_sources,
+        )
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
             "metrics": self.metrics, "health": self.health,
             "event_cache": self.event_cache,
+            "tracer": self.tracer,
+            "collector": self.collector,
             "wire_enabled": wire == "binary",
-            "metrics_sources": (
-                _event_cache_metrics, *metrics_sources,
-            ),
+            "metrics_sources": self._metrics_sources,
             # responses are small; Nagle + the client's delayed ACK would
             # stall every keep-alive request ~40 ms (a handler-class knob:
             # socketserver.StreamRequestHandler.disable_nagle_algorithm)
@@ -955,6 +1081,15 @@ class APIServer:
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
+
+    def metrics_text(self) -> str:
+        """The same Prometheus text GET /metrics serves (request set +
+        event-cache counters + WAL set + extra sources) — the telemetry
+        exporter's snapshot source."""
+        chunks = [self.metrics.expose()]
+        for source in self._metrics_sources:
+            chunks.append(source())
+        return "".join(chunks)
 
     def start(self) -> "APIServer":
         self._thread.start()

@@ -75,6 +75,12 @@ MIN_RECOVERY_DELTA_S = 5.0
 #: meaningful absolute floor
 WAL_TOL = 0.50
 MIN_WAL_DELTA = 0.10
+#: telemetry overhead is a FRACTION (0..1) with a hard <5% product budget:
+#: the absolute floor is the budget itself — a run that was within budget
+#: and grew past +0.05 absolute has genuinely blown the envelope, while
+#: shared-host wobble inside it never gates
+TELEMETRY_TOL = 0.50
+MIN_TELEMETRY_DELTA = 0.05
 
 
 class BenchDiffError(ValueError):
@@ -179,6 +185,8 @@ def compare(
     min_recovery_delta_s: float = MIN_RECOVERY_DELTA_S,
     wal_tol: float = WAL_TOL,
     min_wal_delta: float = MIN_WAL_DELTA,
+    telemetry_tol: float = TELEMETRY_TOL,
+    min_telemetry_delta: float = MIN_TELEMETRY_DELTA,
 ) -> tuple[list[Delta], list[str], list[str]]:
     """Returns (deltas over the common metrics, metrics only in old,
     metrics only in new)."""
@@ -261,6 +269,30 @@ def compare(
                     if bad else ""
                 ),
             ))
+        ot, nt = (o.get("telemetry_overhead_frac"),
+                  n.get("telemetry_overhead_frac"))
+        if isinstance(ot, (int, float)) and isinstance(nt, (int, float)):
+            bad = (
+                nt > ot * (1.0 + telemetry_tol)
+                and (nt - ot) > min_telemetry_delta
+            )
+            deltas.append(Delta(
+                name, "telemetry_overhead_frac", float(ot), float(nt), bad,
+                note=(
+                    f"[tol +{telemetry_tol:.0%} & >{min_telemetry_delta:g}]"
+                    if bad else ""
+                ),
+            ))
+        # a span drop in the new record is a telemetry-evidence loss, not
+        # noise: the merged trace undercounts — flag it whenever the old
+        # record's stage ran clean
+        osd, nsd = o.get("spans_dropped"), n.get("spans_dropped")
+        if isinstance(osd, (int, float)) and isinstance(nsd, (int, float)):
+            bad = nsd > 0 and osd == 0
+            deltas.append(Delta(
+                name, "spans_dropped", float(osd), float(nsd), bad,
+                note="[collector dropped spans]" if bad else "",
+            ))
     only_old = sorted(set(old) - set(new))
     only_new = sorted(set(new) - set(old))
     return deltas, only_old, only_new
@@ -305,6 +337,14 @@ def main(argv=None) -> int:
     ap.add_argument("--min-wal-delta", type=float, default=MIN_WAL_DELTA,
                     help="absolute WAL-overhead growth floor below which "
                          f"it never gates (default {MIN_WAL_DELTA})")
+    ap.add_argument("--telemetry-tol", type=float, default=TELEMETRY_TOL,
+                    help="fractional telemetry-overhead growth tolerated "
+                         f"(default {TELEMETRY_TOL})")
+    ap.add_argument("--min-telemetry-delta", type=float,
+                    default=MIN_TELEMETRY_DELTA,
+                    help="absolute telemetry-overhead growth floor below "
+                         f"which it never gates (default "
+                         f"{MIN_TELEMETRY_DELTA})")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -325,6 +365,8 @@ def main(argv=None) -> int:
         min_recovery_delta_s=args.min_recovery_delta_s,
         wal_tol=args.wal_tol,
         min_wal_delta=args.min_wal_delta,
+        telemetry_tol=args.telemetry_tol,
+        min_telemetry_delta=args.min_telemetry_delta,
     )
     regressions = [d for d in deltas if d.regression]
     if args.json:

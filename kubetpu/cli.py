@@ -102,11 +102,38 @@ def cmd_serve(args) -> int:
     return 0
 
 
+def _make_exporter(telemetry: str, process: str, component: str,
+                   replica: str = "", tracer=None, metrics_fn=None,
+                   flight_fn=None, embedded_collector=None):
+    """One component's telemetry exporter from its ``--telemetry`` flag:
+    "off" → None (byte-identical wire, zero export work), "embed" → the
+    in-process collector transport, a URL → HTTP export to a remote
+    collector. Started on its cadence thread."""
+    if not telemetry or telemetry == "off":
+        return None
+    from .telemetry.exporter import EmbeddedCollectorClient, TelemetryExporter
+
+    client = None
+    url = telemetry
+    if telemetry == "embed":
+        if embedded_collector is None:
+            raise ValueError("--telemetry embed needs an embedded collector")
+        client = EmbeddedCollectorClient(embedded_collector)
+        url = ""
+    return TelemetryExporter(
+        url, process=process, component=component, replica=replica,
+        tracer=tracer, metrics_fn=metrics_fn, flight_fn=flight_fn,
+        client=client,
+    ).start()
+
+
 def cmd_apiserver(args) -> int:
+    import os
+
     from .apiserver import APIServer, Registry
-    from .controllers import install_quota_admission
     from .store import MemStore
     from .store.wal import WALError
+    from .controllers import install_quota_admission
 
     persistence = getattr(args, "persistence", "off")
     try:
@@ -125,10 +152,18 @@ def cmd_apiserver(args) -> int:
     # the install also takes the per-namespace write lock so concurrent
     # creates cannot race past hard
     install_quota_admission(registry, store)
+    telemetry = getattr(args, "telemetry", "off")
     server = APIServer(
         store, host=args.host, port=args.port, registry=registry,
         wire=getattr(args, "wire", "binary"),
+        collector=(telemetry == "embed"),
     ).start()
+    exporter = _make_exporter(
+        telemetry, process=f"apiserver-{os.getpid()}",
+        component="apiserver", tracer=server.tracer,
+        metrics_fn=server.metrics_text,
+        embedded_collector=server.collector,
+    )
     recovered = ""
     if store.recovery_info is not None:
         ri = store.recovery_info
@@ -142,8 +177,40 @@ def cmd_apiserver(args) -> int:
         )
     print(f"kubetpu apiserver serving on {server.url} "
           f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N; "
-          f"diagnostics: /metrics /healthz /readyz /livez"
-          f"{recovered})",
+          f"diagnostics: /metrics /healthz /readyz /livez /trace"
+          + ("; telemetry collector embedded at /telemetry/"
+             if telemetry == "embed" else "")
+          + f"{recovered})",
+          flush=True)
+    try:
+        import threading
+
+        threading.Event().wait()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        if exporter is not None:
+            exporter.close()
+        server.close()
+        # the store is OURS (passed in, so server.close leaves it alone):
+        # flush + close the WAL after the listener stops — a graceful
+        # stop never leaves a torn tail
+        store.close()
+    return 0
+
+
+def cmd_collector(args) -> int:
+    """``kubetpu collector``: the standalone telemetry sink — span/
+    metrics/flight-record ingest at /telemetry/export, the merged chrome
+    trace at /telemetry/trace, the federated /metrics view, and the
+    ``kubetpu top`` summary at /telemetry/top."""
+    from .telemetry.collector import CollectorServer
+
+    server = CollectorServer(host=args.host, port=args.port).start()
+    print(f"kubetpu collector serving on {server.url} "
+          f"(ingest: POST /telemetry/export /telemetry/clock; views: "
+          f"/telemetry/trace /telemetry/metrics /telemetry/flightrecorder "
+          f"/telemetry/top; /healthz)",
           flush=True)
     try:
         import threading
@@ -153,11 +220,96 @@ def cmd_apiserver(args) -> int:
         pass
     finally:
         server.close()
-        # the store is OURS (passed in, so server.close leaves it alone):
-        # flush + close the WAL after the listener stops — a graceful
-        # stop never leaves a torn tail
-        store.close()
     return 0
+
+
+def _fmt_top_row(name: str, p: dict) -> list[str]:
+    def num(key, suffix="", scale=1.0, digits=1):
+        v = p.get(key)
+        if v is None:
+            return "-"
+        return f"{v * scale:.{digits}f}{suffix}"
+
+    e2e = (p.get("e2e_stages_ms") or {}).get("e2e") or {}
+    return [
+        name,
+        p.get("component") or "-",
+        p.get("replica") or "-",
+        num("pods_per_s"),
+        str(int(p["queue_depth"])) if "queue_depth" in p else "-",
+        num("conflict_rate", "%", scale=100.0, digits=2),
+        num("wal_fsync_p99_ms", "ms", digits=2),
+        (f"{e2e['p99_ms']:.1f}ms" if e2e.get("p99_ms") is not None else "-"),
+        num("age_s", "s"),
+    ]
+
+
+def render_top(summary: dict) -> str:
+    """The ``kubetpu top`` console body: one row per exporting process
+    (pods/s, queue depth, conflict rate, WAL fsync p99, e2e p99) plus the
+    collector's span-drop footer."""
+    headers = ("PROCESS", "COMPONENT", "REPLICA", "PODS/S", "QUEUE",
+               "CONFLICT", "FSYNC-P99", "E2E-P99", "AGE")
+    procs = summary.get("processes") or {}
+    rows = [
+        _fmt_top_row(name, p) for name, p in sorted(procs.items())
+    ]
+    widths = [
+        max(len(h), *(len(r[i]) for r in rows)) if rows else len(h)
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(c.ljust(w) for c, w in zip(cols, widths)).rstrip()
+        for cols in [list(headers), *rows]
+    ]
+    stages: dict = {}
+    for name, p in sorted(procs.items()):
+        for stage, v in (p.get("e2e_stages_ms") or {}).items():
+            if stage != "e2e":
+                stages.setdefault(stage, []).append(v.get("p99_ms") or 0.0)
+    if stages:
+        from .metrics.scheduler_metrics import E2E_STAGES
+
+        parts = [
+            f"{st} {max(stages[st]):.1f}" for st in E2E_STAGES
+            if st in stages
+        ]
+        lines.append("staged p99 (ms, worst process): " + " → ".join(parts))
+    lines.append(
+        f"collector: {len(procs)} process(es), "
+        f"{summary.get('spans_dropped', 0)} span(s) dropped"
+    )
+    return "\n".join(lines)
+
+
+def cmd_top(args) -> int:
+    """``kubetpu top``: the live control-plane console — per-process
+    pods/s, queue depth, conflict rate, WAL fsync p99 and staged e2e
+    percentiles from a collector's /telemetry/top (``-o json`` for
+    scripts, ``--watch`` to refresh)."""
+    import time as _time
+    import urllib.request
+
+    url = args.collector.rstrip("/") + "/telemetry/top"
+    while True:
+        try:
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                summary = json.load(resp)
+        except OSError as e:
+            print(f"cannot reach {url}: {e}", file=sys.stderr)
+            return 2
+        if args.output == "json":
+            print(json.dumps(summary, indent=2), flush=True)
+        else:
+            print(render_top(summary), flush=True)
+        if not args.watch:
+            return 0
+        try:
+            _time.sleep(args.interval)
+        except KeyboardInterrupt:
+            return 0
+        if args.output != "json":
+            print("", flush=True)
 
 
 def _object_key(obj: Any) -> str:
@@ -287,7 +439,13 @@ def cmd_scheduler(args) -> int:
         # silent single-chip run misreported as multichip
         print(f"invalid --mesh: {e}", file=sys.stderr)
         return 1
-    store = RemoteStore(args.server, wire=getattr(args, "wire", "binary"))
+    telemetry = getattr(args, "telemetry", "off")
+    store = RemoteStore(
+        args.server, wire=getattr(args, "wire", "binary"),
+        # trace-context propagation rides the telemetry switch: off =
+        # byte-identical wire (no traceparent header / tp parameter)
+        traceparent=(telemetry != "off"),
+    )
     sched = Scheduler(
         StoreClient(store), cfg=cfg, engine=args.engine,
         pipeline=(args.pipeline == "on"),
@@ -300,6 +458,25 @@ def cmd_scheduler(args) -> int:
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
+    exporter = None
+    if telemetry != "off":
+        import os
+
+        store.set_tracer(sched.tracer)  # client rpc spans join server spans
+        fr = sched.flight_recorder
+        exporter = _make_exporter(
+            telemetry,
+            process=(
+                f"scheduler-{args.replica_id}" if args.replica_id
+                else f"scheduler-{os.getpid()}"
+            ),
+            component="scheduler", replica=args.replica_id,
+            tracer=sched.tracer, metrics_fn=sched.metrics_text,
+            flight_fn=(
+                (lambda: fr.records_json(limit=512))
+                if fr is not None else None
+            ),
+        )
     informers = SchedulerInformers(store, sched, bulk=(args.bulk == "on"))
     _retry_start(informers.start, "scheduler informers")
     if args.prewarm:
@@ -345,6 +522,8 @@ def cmd_scheduler(args) -> int:
     try:
         return _make_loop(once)()
     finally:
+        if exporter is not None:
+            exporter.close()
         if diag is not None:
             diag.close()
 
@@ -670,11 +849,21 @@ def cmd_explain(args) -> int:
         import urllib.parse
         import urllib.request
 
-        url = (
-            args.server.rstrip("/")
-            + "/debug/flightrecorder?pod="
-            + urllib.parse.quote(target, safe="")
-        )
+        if getattr(args, "collector", ""):
+            # the collector's merged view: a pod's record is findable
+            # whichever replica scheduled it (one process's
+            # /debug/flightrecorder only knows its own decisions)
+            url = (
+                args.collector.rstrip("/")
+                + "/telemetry/flightrecorder?pod="
+                + urllib.parse.quote(target, safe="")
+            )
+        else:
+            url = (
+                args.server.rstrip("/")
+                + "/debug/flightrecorder?pod="
+                + urllib.parse.quote(target, safe="")
+            )
         try:
             with urllib.request.urlopen(url, timeout=10) as resp:
                 body = json.load(resp)
@@ -804,6 +993,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "watchers take a bounded relist). 'off' (default) "
                           "is the memory-only store, byte-identical to the "
                           "pre-WAL behavior")
+    api.add_argument("--telemetry", default="off", metavar="URL|embed|off",
+                     help="telemetry plane: a collector URL exports this "
+                          "apiserver's server spans + /metrics there on a "
+                          "1s cadence; 'embed' mounts the collector ON this "
+                          "server (/telemetry/*) and self-ingests — the "
+                          "single-process sink; 'off' (default) exports "
+                          "nothing and the wire stays byte-identical")
     api.set_defaults(fn=cmd_apiserver)
 
     check = sub.add_parser("check-config", help="validate a config file")
@@ -881,6 +1077,15 @@ def build_parser() -> argparse.ArgumentParser:
     schd.add_argument("--diagnostics-port", type=int, default=10251,
                       help="side port for /metrics /healthz /readyz /livez "
                            "/trace (0 disables)")
+    schd.add_argument("--telemetry", default="off", metavar="URL|off",
+                      help="telemetry plane: a collector URL stamps a W3C-"
+                           "style traceparent on every RPC (binary envelope "
+                           "field or JSON header — the apiserver joins its "
+                           "server span to the client span) and exports "
+                           "spans + /metrics + flight records there on a 1s "
+                           "cadence; 'off' (default) exports nothing and "
+                           "every request is byte-identical to a pre-"
+                           "telemetry build")
     schd.set_defaults(fn=cmd_scheduler)
 
     cm = sub.add_parser(
@@ -940,6 +1145,11 @@ def build_parser() -> argparse.ArgumentParser:
     explain.add_argument("--file", default="",
                          help="render from a dumped /debug/flightrecorder "
                               "JSON instead of a live scheduler")
+    explain.add_argument("--collector", default="",
+                         help="fetch the record from a telemetry "
+                              "collector's merged view instead "
+                              "(/telemetry/flightrecorder) — finds the pod "
+                              "whichever scheduler replica decided it")
     explain.add_argument("-o", "--output", default="text",
                          choices=("text", "json"))
     explain.add_argument("--all", action="store_true",
@@ -979,6 +1189,32 @@ def build_parser() -> argparse.ArgumentParser:
     )
     bd.add_argument("rest", nargs=argparse.REMAINDER)
     bd.set_defaults(fn=None)
+
+    coll = sub.add_parser(
+        "collector",
+        help="run the telemetry collector: span/metrics/flight-record "
+             "ingest from N processes, skew-corrected merged chrome "
+             "trace, federated /metrics, and the `kubetpu top` summary",
+    )
+    coll.add_argument("--host", default="127.0.0.1")
+    coll.add_argument("--port", type=int, default=10252)
+    coll.set_defaults(fn=cmd_collector)
+
+    top = sub.add_parser(
+        "top",
+        help="live control-plane console from a collector: per-process "
+             "pods/s, queue depth, conflict rate, WAL fsync p99, staged "
+             "e2e percentiles",
+    )
+    top.add_argument("--collector", default="http://127.0.0.1:10252",
+                     help="collector base URL (kubetpu collector, or an "
+                          "apiserver running --telemetry embed)")
+    top.add_argument("-o", "--output", default="text",
+                     choices=("text", "json"))
+    top.add_argument("-w", "--watch", action="store_true",
+                     help="refresh every --interval seconds until ^C")
+    top.add_argument("--interval", type=float, default=2.0)
+    top.set_defaults(fn=cmd_top)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=cmd_version)

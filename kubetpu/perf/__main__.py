@@ -66,6 +66,13 @@ def main(argv=None) -> None:
                          "against the apiserver (the big-cluster watch "
                          "fan-out load the serialize-once body ring "
                          "exists for)")
+    ap.add_argument("--telemetry", default="off", choices=["on", "off"],
+                    help="fullstack only: run the full telemetry plane "
+                         "alongside the workload — an HTTP collector, "
+                         "traceparent on every RPC, both processes' "
+                         "exporters on their cadence; the record embeds "
+                         "span totals + the drop counter (the "
+                         "TelemetryOverhead on/off comparison's 'on' half)")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run N full scheduler replicas against one "
                          "in-process apiserver (active-active federation, "
@@ -142,6 +149,7 @@ def main(argv=None) -> None:
         for wl in workloads:
             r = run_workload_full_stack(
                 case, wl, wire=args.wire, watch_fanout=args.watch_fanout,
+                telemetry=(args.telemetry == "on"),
                 **kwargs,
             )
             print(json.dumps(r.to_json()))

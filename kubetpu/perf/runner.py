@@ -135,6 +135,10 @@ class WorkloadResult:
     binding_parity: int | None = None
     lease_transitions: int = 0
     recovery_s: float | None = None
+    # telemetry-plane view when a run exported to a collector
+    # (--telemetry): ingested span totals and the drop counter the
+    # TelemetryOverhead gate asserts stayed zero
+    telemetry: dict | None = None
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -212,6 +216,8 @@ class WorkloadResult:
                 out["lease_transitions"] = self.lease_transitions
             if self.recovery_s is not None:
                 out["recovery_s"] = round(self.recovery_s, 3)
+        if self.telemetry is not None:
+            out["telemetry"] = self.telemetry
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -1034,6 +1040,7 @@ def run_workload_full_stack(
     flight_recorder: bool = True,
     wire: str = "binary",
     watch_fanout: int = 0,
+    telemetry: bool = False,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -1053,7 +1060,13 @@ def run_workload_full_stack(
     the escape hatch — bindings are pod-for-pod identical); the record
     embeds the codec actually negotiated plus wire_bytes_per_pod.
     ``watch_fanout`` adds N extra concurrent pod watchers (the big-
-    cluster fan-out load the serialize-once body ring exists for)."""
+    cluster fan-out load the serialize-once body ring exists for).
+    ``telemetry`` runs the FULL telemetry plane alongside the workload —
+    a real HTTP collector, traceparent stamped on every RPC, both
+    processes' exporters on their 1 s cadence — so the
+    TelemetryOverhead_* comparison measures the whole tax, not a
+    cut-down one; the result carries the collector's span totals and
+    drop counter."""
     import collections
 
     from ..apiserver import APIServer, RemoteStore
@@ -1076,10 +1089,22 @@ def run_workload_full_stack(
             )
 
     srv = APIServer().start()
-    remote = RemoteStore(srv.url, wire=wire)
+    remote = RemoteStore(srv.url, wire=wire, traceparent=telemetry)
     fanout = (
         _WatchFanout(srv.url, wire, watch_fanout) if watch_fanout else None
     )
+    coll_srv = None
+    exporters: list = []
+    if telemetry:
+        from ..telemetry.collector import CollectorServer
+        from ..telemetry.exporter import TelemetryExporter
+
+        coll_srv = CollectorServer().start()
+        exporters.append(TelemetryExporter(
+            coll_srv.url, process="apiserver-bench",
+            component="apiserver", tracer=srv.tracer,
+            metrics_fn=srv.metrics_text,
+        ).start())
 
     class _CountingClient(StoreClient):
         def __init__(self, store) -> None:
@@ -1111,6 +1136,20 @@ def run_workload_full_stack(
         bulk=bulk, mesh=mesh, flight_recorder=flight_recorder,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
     )
+    if telemetry:
+        from ..telemetry.exporter import TelemetryExporter
+
+        remote.set_tracer(sched.tracer)
+        fr = sched.flight_recorder
+        exporters.append(TelemetryExporter(
+            coll_srv.url, process="scheduler-bench",
+            component="scheduler", tracer=sched.tracer,
+            metrics_fn=sched.metrics_text,
+            flight_fn=(
+                (lambda: fr.records_json(limit=512))
+                if fr is not None else None
+            ),
+        ).start())
     informers = SchedulerInformers(remote, sched, bulk=bulk)
     informers.start()
 
@@ -1230,6 +1269,17 @@ def run_workload_full_stack(
     finally:
         if fanout is not None:
             fanout.stop()
+        telemetry_stats = None
+        for exp in exporters:
+            exp.close()         # final flush so span totals are complete
+        if coll_srv is not None:
+            col = coll_srv.collector
+            telemetry_stats = {
+                "spans": col.spans_total,
+                "spans_dropped": col.spans_dropped,
+                "processes": len(col.summary()["processes"]),
+            }
+            coll_srv.close()
         sched.close()
         srv.close()
 
@@ -1274,6 +1324,7 @@ def run_workload_full_stack(
         attempts=sched.metrics.schedule_attempts - attempts0,
         cycles=sched.metrics.cycles - cycles0,
         p99_attempt_latency_ms=lat,
+        telemetry=telemetry_stats,
         metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
         artifacts=artifacts,
     )
@@ -1770,6 +1821,10 @@ def run_wal_overhead(
             round(stats["bytes_appended"] / writes, 1) if stats else None
         ),
         "wal_fsyncs": stats["fsyncs"] if stats else None,
+        # the durability tax's latency shape, not just its throughput
+        # cost: p99 of the group-commit fsync (store_wal_fsync_duration_
+        # seconds — the same histogram the apiserver's /metrics exposes)
+        "fsync_p99_ms": stats["fsync_p99_ms"] if stats else None,
     }
 
 

@@ -239,11 +239,18 @@ class APIDispatcher:
     dispatch behavior (the ``--bulk off`` escape hatch)."""
 
     def __init__(
-        self, client: Any, workers: int = 2, bulk: bool = False
+        self, client: Any, workers: int = 2, bulk: bool = False,
+        tracer=None,
     ) -> None:
+        """``tracer``: an optional span recorder (the owning scheduler's
+        Tracer) — every executed call type records one ``api.<type>``
+        span (graftcheck TR003 pins the seam), carrying the pod's
+        attribution id so the cross-process timeline includes the
+        dispatch leg. None (or a disabled tracer) costs nothing."""
         self._client = client
         self._workers = workers
         self._bulk = bulk
+        self._tracer = tracer
         self._pending: dict[tuple[str, str], APICall] = {}
         self._lock = threading.Lock()
         self._q: _queue.Queue = _queue.Queue()
@@ -334,12 +341,30 @@ class APIDispatcher:
             except Exception:
                 pass
 
+    def _record_call_span(self, call: APICall, t0: float,
+                          err: Exception | None) -> None:
+        """THE dispatcher span seam: one ``api.<call_type>`` span per
+        executed call, off-stack (worker threads record concurrently),
+        linked to the pod's cross-process timeline by its attribution id."""
+        tr = self._tracer
+        if tr is None:
+            return
+        pod = getattr(call, "pod", None)
+        tr.record(
+            f"api.{call.call_type}", start=t0, end=_time.perf_counter(),
+            key=call.object_key,
+            status="error" if err is not None else "ok",
+            pod_trace=getattr(pod, "trace_id", "") or "",
+        )
+
     def _execute(self, call: APICall) -> None:
         err: Exception | None = None
+        t0 = _time.perf_counter()
         try:
             call.execute(self._client)
         except Exception as e:  # noqa: BLE001 — surfaced via on_done
             err = e
+        self._record_call_span(call, t0, err)
         self._finish(call, err)
 
     def _execute_api(self, call: APICall) -> None:
@@ -348,6 +373,7 @@ class APIDispatcher:
         phase + ``post`` re-execute — exactly the single-op path's
         remainder."""
         err: Exception | None = None
+        t0 = _time.perf_counter()
         try:
             api = getattr(call, "execute_api", None)
             if api is not None:
@@ -359,6 +385,7 @@ class APIDispatcher:
                 post()
         except Exception as e:  # noqa: BLE001 — surfaced via on_done
             err = e
+        self._record_call_span(call, t0, err)
         self._finish(call, err)
 
     def _execute_batch(self, call_type: str, calls: list) -> None:
@@ -409,6 +436,22 @@ class APIDispatcher:
                 for call in ready:
                     self._execute_api(call)
             else:
+                tr = self._tracer
+                if tr is not None:
+                    # one span for the whole micro-batch's API phase (the
+                    # per-op fallbacks below record their own); pod
+                    # attribution rides as a capped id list like the
+                    # apiserver's bulk request span
+                    tr.record(
+                        f"api.{call_type}.bulk", start=t_bulk,
+                        end=_time.perf_counter(), n=len(ready),
+                        pod_traces=[
+                            tid for c in ready
+                            if (tid := getattr(
+                                getattr(c, "pod", None), "trace_id", ""
+                            ))
+                        ][:64],
+                    )
                 with self._lock:
                     self._batches += 1
                     self._batched_calls += len(ready)

@@ -297,8 +297,16 @@ class Scheduler:
             initial_backoff_seconds=self.cfg.pod_initial_backoff_seconds,
             max_backoff_seconds=self.cfg.pod_max_backoff_seconds,
         )
+        from ..tracing import Tracer
+
+        # cycle tracing (utiltrace analog): top-level span per profile
+        # cycle; >100ms cycles log their step breakdown
+        # (schedule_one.go:566-567's LogIfLong). Created BEFORE the
+        # dispatcher so its call-type spans land in the same buffer
+        self.tracer = Tracer()
         self.dispatcher = APIDispatcher(
-            client, workers=dispatcher_workers, bulk=bulk
+            client, workers=dispatcher_workers, bulk=bulk,
+            tracer=self.tracer,
         )
         self.metrics = SchedulerMetrics()
         # event-time incremental pod encoding (state.encode_cache): static
@@ -314,12 +322,6 @@ class Scheduler:
         # pre-encode hook (rebuilt-per-event frozensets were informer-path
         # allocation churn)
         self._prof_sets: dict[int, tuple] = {}
-        from ..tracing import Tracer
-
-        # cycle tracing (utiltrace analog): top-level span per profile
-        # cycle; >100ms cycles log their step breakdown
-        # (schedule_one.go:566-567's LogIfLong)
-        self.tracer = Tracer()
         # scheduling flight recorder + staged latency attribution (see the
         # flight_recorder docstring above); None = off
         if flight_recorder:
@@ -1619,6 +1621,10 @@ class Scheduler:
                 "bind", start=t_dispatch, end=t_done,
                 cycle=getattr(info, "cycle_id", 0), pod=info.key,
                 status="error" if err is not None else "bound",
+                # the cross-process join key: the collector stitches this
+                # span to the apiserver's ingest/bind-subresource spans
+                # (and the other replicas' attempts) by the pod's id
+                pod_trace=getattr(info.pod, "trace_id", "") or "",
             )
             fr = self.flight_recorder
             if fr is not None:

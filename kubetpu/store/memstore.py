@@ -774,16 +774,66 @@ class MemStore:
 
     def wal_stats(self) -> "dict | None":
         """Append-side counters for metrics/bench (None when off)."""
+        import math
+
         with self._lock:
             wal = self._wal
             if wal is None:
                 return None
+            p99 = wal.fsync_hist.quantile(0.99)
             return {
                 "records_appended": wal.records_appended,
                 "bytes_appended": wal.bytes_appended,
                 "fsyncs": wal.fsyncs,
                 "records_since_snapshot": wal.records_since_snapshot,
+                # the WALOverhead_* bench records embed this: the p99
+                # group-commit fsync in ms (None before the first fsync)
+                "fsync_p99_ms": (
+                    None if math.isnan(p99) else round(p99 * 1000.0, 3)
+                ),
             }
+
+    def wal_metrics_text(self) -> str:
+        """The durable store's Prometheus text — mounted on the owning
+        apiserver's /metrics: the ``store_wal_fsync_duration_seconds``
+        histogram plus segment/byte/snapshot-age gauges. Empty without
+        persistence (a memory-only scrape stays byte-identical)."""
+        import time as _time
+
+        from ..metrics.registry import Registry
+        from .wal import list_segments
+
+        with self._lock:
+            wal = self._wal
+            if wal is None:
+                return ""
+            hist = wal.fsync_hist
+            dirpath = wal.dirpath
+            bytes_total = wal.bytes_appended
+            snap_age = max(_time.time() - wal.last_snapshot_wall, 0.0)
+        # directory I/O and exposition both OUTSIDE the store lock: a 1 s
+        # exporter cadence must never park every store write behind an
+        # os.listdir (the histogram carries its own lock; dirpath is
+        # immutable for the WAL's lifetime)
+        try:
+            segments = len(list_segments(dirpath))
+        except OSError:
+            segments = 0        # dir vanished under a concurrent close
+        r = Registry()
+        r.register(hist)
+        r.gauge(
+            "store_wal_segments",
+            "WAL segment files currently on disk (compaction truncates).",
+        ).set(segments)
+        r.counter(
+            "store_wal_bytes_total",
+            "Bytes appended to the write-ahead log since open.",
+        ).inc(bytes_total)
+        r.gauge(
+            "store_snapshot_age_seconds",
+            "Seconds since the newest compaction snapshot was written.",
+        ).set(round(snap_age, 3))
+        return r.expose()
 
 
 class SelectorView:

@@ -1,0 +1,27 @@
+"""Cluster telemetry plane (layer 8) — the component-base/tracing analog.
+
+Three pieces stitch N control-plane processes into one observable system:
+
+- ``context``   — W3C-style ``traceparent`` trace context: format/parse
+  helpers the wire seam (``kubetpu.api.codec``) and the apiserver handler
+  share, so a client RPC span and the server span it caused carry the
+  same trace id across the process boundary.
+- ``collector`` — the span/metrics/flight-record collector: ingests
+  batched exports from N processes over the existing wire codec, corrects
+  per-process clock skew via a monotonic-offset handshake, and merges
+  everything into ONE chrome trace (per-process lanes), a federated
+  ``/metrics`` view (``process``/``replica`` labels), and the summary
+  ``kubetpu top`` renders.
+- ``exporter``  — the per-process side: drains the local Tracer, metrics
+  text and flight recorder on a cadence and ships batches to a collector.
+  A no-op when telemetry is off (``--telemetry off`` = byte-identical
+  wire: no traceparent is stamped, nothing is exported).
+"""
+
+from .context import (  # noqa: F401
+    TraceContext,
+    format_traceparent,
+    new_span_id,
+    new_trace_id,
+    parse_traceparent,
+)

@@ -1,0 +1,90 @@
+"""W3C-style trace context (the ``traceparent`` header, trace-context
+spec shape): ``00-<trace id 32 hex>-<span id 16 hex>-<flags 2 hex>``.
+
+The propagation rules mirror the spec's robustness requirements:
+formatting is exact, parsing is strict but NEVER fatal — a malformed
+header from a foreign client reads as "no context" (None), not as a 4xx.
+The flags byte carries only the ``sampled`` bit (0x01).
+
+Pods already carry a 16-hex ``trace_id`` stamped at REST create
+(apiserver ``_stamp_pod_ingest``); ``pod_trace_id`` widens it
+deterministically to the 32-hex trace-id space so a pod's scheduler-side
+spans and its apiserver-side ingest/bind spans can be joined under one
+trace id without a second stamp riding the wire.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+
+_VERSION = "00"
+_HEX = set("0123456789abcdef")
+
+
+@dataclass(frozen=True)
+class TraceContext:
+    """One hop's trace context: the trace it belongs to, the span that is
+    the next hop's parent, and the sampled flag."""
+
+    trace_id: str               # 32 lowercase hex, not all-zero
+    span_id: str                # 16 lowercase hex, not all-zero
+    sampled: bool = True
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh span id — the context stamped on an outgoing
+        request whose local span is ``self.span_id``'s child."""
+        return TraceContext(self.trace_id, new_span_id(), self.sampled)
+
+
+def new_trace_id() -> str:
+    return os.urandom(16).hex()
+
+
+def new_span_id() -> str:
+    return os.urandom(8).hex()
+
+
+def pod_trace_id(pod_trace: str) -> str:
+    """A pod's 16-hex attribution id widened to the 32-hex trace-id space
+    (doubled, so it is deterministic in every process that sees the pod).
+    Empty/foreign-shaped input returns "" — never a fake trace id."""
+    if len(pod_trace) == 16 and set(pod_trace) <= _HEX:
+        return pod_trace + pod_trace
+    return ""
+
+
+def format_traceparent(ctx: TraceContext) -> str:
+    return (
+        f"{_VERSION}-{ctx.trace_id}-{ctx.span_id}-"
+        f"{'01' if ctx.sampled else '00'}"
+    )
+
+
+def _hex_field(s: str, n: int) -> bool:
+    return len(s) == n and set(s) <= _HEX and set(s) != {"0"}
+
+
+def parse_traceparent(value: str | None) -> TraceContext | None:
+    """Strict parse; anything malformed — wrong arity, bad lengths,
+    non-hex, all-zero ids, a future version with a short tail — is
+    ignored (None), never an error: a broken peer must not break the
+    request it rode in on."""
+    if not value or not isinstance(value, str):
+        return None
+    parts = value.strip().lower().split("-")
+    if len(parts) < 4:
+        return None
+    version, trace_id, span_id, flags = parts[0], parts[1], parts[2], parts[3]
+    if len(version) != 2 or set(version) - _HEX:
+        return None
+    if version == "ff":
+        return None
+    if not _hex_field(trace_id, 32) or not _hex_field(span_id, 16):
+        return None
+    if len(flags) != 2 or set(flags) - _HEX:
+        return None
+    return TraceContext(
+        trace_id=trace_id, span_id=span_id,
+        sampled=bool(int(flags, 16) & 0x01),
+    )

@@ -1,0 +1,292 @@
+"""Per-process telemetry exporter — drains local observability state to
+a collector on a cadence.
+
+One ``TelemetryExporter`` rides inside each control-plane process
+(``kubetpu scheduler --telemetry URL``, ``kubetpu apiserver --telemetry
+URL|embed``): every ``interval_s`` it drains the process tracer
+(``Tracer.drain`` — the only consuming read), snapshots the ``/metrics``
+text and the flight recorder, and POSTs one batch to
+``<collector>/telemetry/export`` over the wire codec (binary first; a
+415 drops to JSON permanently — the same negotiation the RemoteStore
+runs). Before the first export it runs the clock handshake
+(``ClockSync``) so the collector can place this process's monotonic
+timestamps on its own timeline.
+
+Escape hatch by construction: a process without an exporter (telemetry
+off) performs ZERO extra work and sends ZERO extra bytes.
+"""
+
+from __future__ import annotations
+
+import http.client
+import os
+import threading
+import time
+from typing import Any, Callable
+from urllib.parse import urlsplit
+
+from ..api import codec
+
+#: clock-handshake probes (min-RTT sample wins)
+CLOCK_PROBES = 5
+
+
+class ExportError(ConnectionError):
+    pass
+
+
+class _WireClient:
+    """Tiny POST client with the 415→JSON fallback (one connection,
+    reconnect on failure — exporter batches are fire-and-forget)."""
+
+    def __init__(self, base_url: str, timeout_s: float = 10.0) -> None:
+        self.base = base_url.rstrip("/")
+        self.timeout_s = timeout_s
+        self._conn: "http.client.HTTPConnection | None" = None
+        self._wire = codec.BINARY
+
+    def _connection(self) -> http.client.HTTPConnection:
+        if self._conn is None:
+            u = urlsplit(self.base)
+            self._conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=self.timeout_s
+            )
+        return self._conn
+
+    def _drop(self) -> None:
+        if self._conn is not None:
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            self._conn = None
+
+    def post(self, path: str, tree: Any) -> dict:
+        """POST one body through the wire seam; decode the JSON reply.
+        Retries once across a dropped keep-alive; a 415 falls back to
+        JSON permanently and re-issues."""
+        for _wire_attempt in range(2):
+            data = codec.dumps(tree, self._wire)
+            headers = {"Content-Type": codec.content_type_for(self._wire)}
+            last: Exception | None = None
+            for attempt in range(2):
+                try:
+                    conn = self._connection()
+                    conn.request("POST", path, body=data, headers=headers)
+                    resp = conn.getresponse()
+                    status, raw = resp.status, resp.read()
+                except (ConnectionError, TimeoutError, OSError,
+                        http.client.HTTPException) as e:
+                    self._drop()
+                    last = e
+                    if attempt == 0:
+                        continue
+                    raise ExportError(str(e)) from None
+                if status == 415 and self._wire != codec.JSON:
+                    self._wire = codec.JSON
+                    break               # re-encode as JSON, re-issue
+                if status >= 400:
+                    raise ExportError(f"collector replied {status}")
+                try:
+                    return codec.loads(raw or b"{}", codec.JSON)
+                except codec.UnsupportedWireError as e:
+                    raise ExportError(f"undecodable reply: {e}") from None
+            else:
+                raise ExportError(str(last))
+        raise ExportError("wire negotiation failed")
+
+
+class EmbeddedCollectorClient:
+    """The embedded-mode transport: POSTs become direct method calls on
+    an in-process Collector (``kubetpu apiserver --telemetry embed`` —
+    the apiserver is its own sink, no HTTP hop, offset stays 0 because
+    exporter and collector share one clock)."""
+
+    def __init__(self, collector) -> None:
+        self._collector = collector
+
+    def post(self, path: str, tree: Any) -> dict:
+        if path == "/telemetry/clock":
+            return self._collector.clock_probe(tree.get("t0"))
+        if path == "/telemetry/export":
+            return self._collector.ingest(tree)
+        raise ExportError(f"unknown embedded route {path}")
+
+
+class ClockSync:
+    """The monotonic-offset handshake: N probes against
+    ``/telemetry/clock``, each deriving offset = server_mono − (t0+t2)/2;
+    the min-RTT probe wins (NTP's rule — the symmetric-delay assumption
+    is tightest on the fastest round trip). ``probe_fn`` is injectable
+    for the skew tests (and for the embedded, no-HTTP mode)."""
+
+    def __init__(
+        self,
+        probe_fn: Callable[[float], dict],
+        clock: Callable[[], float] = time.perf_counter,
+    ) -> None:
+        self._probe = probe_fn
+        self._clock = clock
+        self.offset_s: float = 0.0
+        self.rtt_s: "float | None" = None
+        self.synced = False
+
+    def sync(self, probes: int = CLOCK_PROBES) -> float:
+        best_rtt: "float | None" = None
+        for _ in range(max(probes, 1)):
+            t0 = self._clock()
+            reply = self._probe(t0)
+            t2 = self._clock()
+            server_mono = reply.get("server_mono")
+            if not isinstance(server_mono, (int, float)):
+                continue
+            # echoed t0 guards against a stale/crossed reply
+            if reply.get("t0") != t0:
+                continue
+            rtt = t2 - t0
+            if best_rtt is None or rtt < best_rtt:
+                best_rtt = rtt
+                self.offset_s = float(server_mono) - (t0 + t2) / 2.0
+        if best_rtt is None:
+            raise ExportError("clock handshake produced no usable probe")
+        self.rtt_s = best_rtt
+        self.synced = True
+        return self.offset_s
+
+    def to_collector(self, local_mono: float) -> float:
+        """A local monotonic stamp on the collector's timeline."""
+        return local_mono + self.offset_s
+
+    def to_local(self, collector_mono: float) -> float:
+        """The anchor round trip (tested with injected offsets)."""
+        return collector_mono - self.offset_s
+
+
+def _span_to_wire(sp) -> dict:
+    return {
+        "name": sp.name,
+        "span_id": sp.span_id,
+        "parent_id": sp.parent_id,
+        "start": sp.start,
+        "end": sp.end,
+        "off_stack": sp.off_stack,
+        "instant": sp.instant,
+        "attrs": sp.attrs,
+    }
+
+
+class TelemetryExporter:
+    """See module docstring. ``tracer`` is drained (consuming read);
+    ``metrics_fn``/``flight_fn`` are snapshot providers (may be None).
+    ``start()`` spawns the cadence thread; ``flush()`` ships one batch
+    synchronously (tests, shutdown)."""
+
+    def __init__(
+        self,
+        collector_url: str,
+        process: str,
+        component: str = "",
+        replica: str = "",
+        tracer=None,
+        metrics_fn: "Callable[[], str] | None" = None,
+        flight_fn: "Callable[[], dict] | None" = None,
+        interval_s: float = 1.0,
+        client: "_WireClient | None" = None,
+    ) -> None:
+        self.process = process
+        self.component = component
+        self.replica = replica
+        self.tracer = tracer
+        self.metrics_fn = metrics_fn
+        self.flight_fn = flight_fn
+        self.interval_s = interval_s
+        self._client = client if client is not None else _WireClient(
+            collector_url
+        )
+        self.clock = ClockSync(
+            lambda t0: self._client.post("/telemetry/clock", {"t0": t0})
+        )
+        self._stop = threading.Event()
+        self._thread: "threading.Thread | None" = None
+        # batch identity for idempotent delivery: the transport retries a
+        # POST whose reply was lost AFTER the collector ingested it, so
+        # every batch carries (epoch, seq) and the collector drops an
+        # exact repeat instead of double-counting its spans. The random
+        # epoch keeps a restarted exporter (same process name, seq back
+        # at 1) from colliding with its predecessor's counter.
+        self._epoch = os.urandom(8).hex()
+        self._seq = 0
+        self.exports = 0
+        self.export_errors = 0
+        self.last_dropped = 0
+
+    # ---------------------------------------------------------------- batch
+    def _batch(self) -> dict:
+        spans = self.tracer.drain() if self.tracer is not None else []
+        self._seq += 1
+        batch: dict[str, Any] = {
+            "process": self.process,
+            "component": self.component,
+            "replica": self.replica,
+            "pid": os.getpid(),
+            "batch": {"epoch": self._epoch, "seq": self._seq},
+            "clock": {
+                "offset_s": self.clock.offset_s,
+                "mono": time.perf_counter(),
+                "wall": time.time(),
+            },
+            "spans": [_span_to_wire(sp) for sp in spans],
+        }
+        if self.metrics_fn is not None:
+            try:
+                batch["metrics_text"] = self.metrics_fn()
+            except Exception:  # noqa: BLE001 — a scrape bug must not
+                pass           # kill the export cadence
+        if self.flight_fn is not None:
+            try:
+                batch["flight_records"] = self.flight_fn()
+            except Exception:  # noqa: BLE001
+                pass
+        return batch
+
+    def flush(self) -> dict:
+        """One synchronous export (handshaking first if needed)."""
+        if not self.clock.synced:
+            self.clock.sync()
+        reply = self._client.post("/telemetry/export", self._batch())
+        self.exports += 1
+        dropped = reply.get("dropped")
+        if isinstance(dropped, int):
+            self.last_dropped = dropped
+        return reply
+
+    # -------------------------------------------------------------- cadence
+    def _run(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            try:
+                self.flush()
+            except Exception:  # noqa: BLE001 — a collector outage is a
+                # bounded gap in the timeline, never exporter death (the
+                # next tick retries; spans keep buffering in the tracer)
+                self.export_errors += 1
+
+    def start(self) -> "TelemetryExporter":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name=f"telemetry-export-{self.process}",
+                daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        """Stop the cadence and ship one final batch (best effort)."""
+        self._stop.set()
+        th = self._thread
+        if th is not None and th.is_alive():
+            th.join(timeout=5)
+        try:
+            self.flush()
+        except Exception:  # noqa: BLE001 — shutdown is best-effort
+            self.export_errors += 1

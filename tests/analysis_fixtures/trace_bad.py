@@ -1,0 +1,34 @@
+"""TR003 known-bad: an HTTP handler without a span seam and a dispatcher
+executor that runs call types unspanned (the ``trace_`` basename puts
+this file in the checker's scope)."""
+
+
+class Handler:
+    def do_GET(self):  # expect: TR003
+        kind, key, q = self._route()
+        with self.metrics.track("GET", kind, lambda: 200):
+            self._do_get(kind, key, q)
+
+    def do_DELETE(self):  # expect: TR003
+        self.store.delete("pods", "ns/p")
+
+
+class Dispatcher:
+    def _execute(self, call):  # expect: TR003
+        err = None
+        try:
+            call.execute(self._client)
+        except Exception as e:  # noqa: BLE001
+            err = e
+        self._finish(call, err)
+
+    def _execute_fallback(self, call):  # expect: TR003
+        call.execute_api(self._client)
+        self._finish(call, None)
+
+
+class BindCall:
+    # the call type's OWN delegation is not an execution site: the
+    # dispatcher records the span, not the call — no finding here
+    def execute(self, client):
+        self.execute_api(client)

@@ -1,0 +1,36 @@
+"""TR003 known-good: handlers under the span seam, executors recording
+their call spans (incl. through a local alias)."""
+
+import time
+
+
+class Handler:
+    def do_GET(self):
+        kind, key, q = self._route()
+        with self._track_span("GET", kind):
+            self._do_get(kind, key, q)
+
+    def do_DELETE(self):
+        t0 = time.perf_counter()
+        self.store.delete("pods", "ns/p")
+        self.tracer.record("apiserver.DELETE", start=t0,
+                           end=time.perf_counter())
+
+
+class Dispatcher:
+    def _execute(self, call):
+        err = None
+        t0 = time.perf_counter()
+        try:
+            call.execute(self._client)
+        except Exception as e:  # noqa: BLE001
+            err = e
+        self._record_call_span(call, t0, err)
+        self._finish(call, err)
+
+    def _execute_aliased(self, call):
+        rec = self._record_call_span
+        t0 = time.perf_counter()
+        call.execute_api(self._client)
+        rec(call, t0, None)
+        self._finish(call, None)

@@ -86,7 +86,7 @@ def test_every_checker_registered_and_documented():
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
-        "WP001", "WL001",
+        "WP001", "WL001", "TR003",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -119,7 +119,7 @@ def test_fixture_violations_match_markers_exactly():
     "lock_good.py", "ops/jit_good.py", "sched/donate_good.py",
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
-    "wal_good.py",
+    "wal_good.py", "trace_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -227,6 +227,42 @@ def test_wal_checker_covers_the_store_wrapper_not_the_replay_side():
         and n.func.attr in ("create", "update", "delete")
     ]
     assert mutations, "_commit_locked no longer mutates the core"
+
+
+def test_trace_checker_covers_handlers_and_dispatcher():
+    """TR003 (telemetry span coverage) walks the apiserver's HTTP front
+    and the scheduler's API dispatcher — the two halves of every
+    cross-process hop — and the guarded seams really exist: the handler
+    still defines _track_span and every do_* verb runs it; the
+    dispatcher still defines _record_call_span. Pinned against the
+    ACTUAL walk so a move/rename fails here, not silently."""
+    res = _repo_result()
+    covered = set(res.coverage.get("TR003", ()))
+    for f in (
+        "kubetpu/apiserver/server.py",
+        "kubetpu/sched/api_dispatcher.py",
+    ):
+        assert f in covered, f"TR003 no longer covers {f}"
+    src = open(
+        os.path.join(REPO, "kubetpu", "apiserver", "server.py"),
+        encoding="utf-8",
+    ).read()
+    tree = ast.parse(src)
+    fns = {
+        n.name for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+    }
+    assert "_track_span" in fns, "server.py lost _track_span — TR003 " \
+        "guards air"
+    handlers = {n for n in fns if n.startswith("do_")}
+    assert {"do_GET", "do_POST", "do_PUT", "do_DELETE"} <= handlers
+    src = open(
+        os.path.join(REPO, "kubetpu", "sched", "api_dispatcher.py"),
+        encoding="utf-8",
+    ).read()
+    assert "_record_call_span" in src, (
+        "api_dispatcher.py lost _record_call_span — TR003 guards air"
+    )
 
 
 def test_audited_files_still_contain_what_the_checkers_guard():
