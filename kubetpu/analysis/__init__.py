@@ -20,6 +20,8 @@ Checker catalog (``--explain CODE`` prints the full rationale):
 - CL001              injectable-clock discipline in lease/backoff code
 - WP001              wire-codec seam discipline on API hot paths
 - WL001              WAL append-seam discipline for store-core mutations
+- PS001              process-spawn seam discipline — long-lived children
+                     only through the launch supervisor
 - TR003              telemetry span coverage — apiserver handlers and
                      dispatcher call executors run under a span
 
@@ -50,3 +52,4 @@ from . import clockcheck  # noqa: F401,E402
 from . import wirecheck  # noqa: F401,E402
 from . import walcheck  # noqa: F401,E402
 from . import tracecheck  # noqa: F401,E402
+from . import proccheck  # noqa: F401,E402

@@ -1,0 +1,122 @@
+"""Process-spawn seam discipline checker (PS001).
+
+The multi-process control plane spawns children through ONE seam —
+``kubetpu.launch.supervisor`` — so every child gets the full lifecycle
+contract: ephemeral-port readiness banners (parallel runs never collide),
+/readyz health polling, log capture with tail-on-failure, a declarative
+restart policy, SIGTERM-cascade shutdown riding the graceful-close paths,
+and per-child resource accounting. A bare ``subprocess.Popen`` anywhere
+else in ``kubetpu/`` re-grows exactly the ad-hoc spawn/sleep/poll pattern
+the launch subsystem replaced: a child that dies before its banner hangs
+the caller instead of failing loudly with its log tail, a hard-coded port
+collides in parallel CI, an orphaned process leaks past the test run, and
+a killed replica stays dead because nobody owns its restart policy.
+
+``subprocess.run`` (bounded, reaped, capture-complete — the probe shape
+``kubetpu.native``'s compiler check uses) is deliberately NOT covered: the
+invariant is about LONG-LIVED children, which is what ``Popen`` creates.
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from .core import Checker, ModuleInfo, Violation, register
+
+#: the seam itself — the one module allowed to Popen
+_EXEMPT = {
+    "kubetpu/launch/supervisor.py",
+}
+
+_SPAWN_FUNCS = {"Popen"}
+
+
+@register
+class BareProcessSpawn(Checker):
+    code = "PS001"
+    title = "bare subprocess.Popen outside the launch supervisor seam"
+    rationale = (
+        "Long-lived child processes are owned by ONE seam — "
+        "kubetpu.launch.supervisor (Supervisor/ChildSpec) — which is "
+        "where the lifecycle invariants live: children bind port 0 and "
+        "publish the real address via the KUBETPU-READY banner (parallel "
+        "CI runs never collide), readiness is banner + /readyz polling "
+        "with a loud log-tail error when a child dies first, output is "
+        "captured into a bounded ring, the never|on-failure[:max] "
+        "restart policy answers crashes, and shutdown is a SIGTERM "
+        "cascade that rides every component's graceful-close path (the "
+        "apiserver's WAL flush included). A bare subprocess.Popen "
+        "elsewhere in kubetpu/ silently re-grows the pre-PR-13 ad-hoc "
+        "spawn/sleep/poll harness: hung starts, port collisions, "
+        "orphaned children, unrestartable replicas. Spawn through "
+        "kubetpu.launch (Supervisor.spawn / Cluster). Bounded one-shot "
+        "probes via subprocess.run are out of scope by design — the "
+        "invariant covers processes that OUTLIVE the call."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        if relpath in _EXEMPT:
+            return False
+        base = posixpath.basename(relpath)
+        if base.startswith("proc_") and base.endswith(".py"):
+            return True     # the known-bad/known-good fixtures
+        return relpath.startswith("kubetpu/") and relpath.endswith(".py")
+
+    def collect(self, mod: ModuleInfo):
+        # resolve every way this module can reach Popen: plain/aliased
+        # `import subprocess` and from-imports of Popen itself — `import
+        # subprocess as sp` / `from subprocess import Popen as P` must
+        # not evade the gate (the WP001/WL001 alias-resolution shape)
+        module_aliases = set()
+        from_imports: dict[str, str] = {}   # local name -> subprocess.<fn>
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "subprocess":
+                        module_aliases.add(a.asname or "subprocess")
+            elif (
+                isinstance(node, ast.ImportFrom)
+                and node.module == "subprocess"
+            ):
+                for a in node.names:
+                    if a.name in _SPAWN_FUNCS:
+                        from_imports[a.asname or a.name] = (
+                            f"subprocess.{a.name}"
+                        )
+        if not module_aliases and not from_imports:
+            return []
+        out: list[Violation] = []
+        parents: dict[int, str] = {}
+        for fn in ast.walk(mod.tree):
+            if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for sub in ast.walk(fn):
+                    parents.setdefault(id(sub), fn.name)
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            name = ""
+            if (
+                isinstance(f, ast.Attribute)
+                and isinstance(f.value, ast.Name)
+                and f.value.id in module_aliases
+                and f.attr in _SPAWN_FUNCS
+            ):
+                name = f"subprocess.{f.attr}"
+            elif isinstance(f, ast.Name) and f.id in from_imports:
+                name = from_imports[f.id]
+            if not name:
+                continue
+            out.append(Violation(
+                path=mod.relpath, line=node.lineno, code=self.code,
+                symbol=parents.get(id(node), ""),
+                message=(
+                    f"bare {name}() outside the launch seam — spawn "
+                    "long-lived children through kubetpu.launch "
+                    "(Supervisor.spawn/Cluster) so they get the readiness-"
+                    "banner, restart-policy, log-capture and SIGTERM-"
+                    "cascade lifecycle"
+                ),
+            ))
+        return out

@@ -37,6 +37,12 @@ Comparison rules (per metric name present in BOTH records):
   recovery takes over ``old * (1 + recovery_tol)`` AND grew by more than
   ``min_recovery_delta_s`` (absolute floor for the sub-second recoveries a
   small bench shape produces).
+- **scaling speedup** (``throughput_speedup`` on comparison lines —
+  ``FederationScaling_mp_*``'s real N-process speedup, the wire/sharding/
+  pipeline speedups): regression when the new speedup falls under
+  ``old * (1 - speedup_tol)`` AND shrank by more than
+  ``min_speedup_delta`` absolute (a 1.02→0.98 wobble on a flat curve
+  never gates; a 2-replica speedup that halved does).
 - **WAL steady-state overhead** (``wal_overhead_frac`` on
   ``WALOverhead_*`` lines — the fraction of write throughput durability
   costs): regression when the new fraction exceeds
@@ -70,6 +76,12 @@ CONFLICT_TOL = 0.50
 MIN_CONFLICT_DELTA = 0.05
 RECOVERY_TOL = 1.00
 MIN_RECOVERY_DELTA_S = 5.0
+#: scaling-speedup gate (throughput_speedup on comparison lines): a RATIO
+#: around 1.0, so both tolerances are meaningful — the relative one rides
+#: out shared-host noise, the absolute floor keeps a flat curve's wobble
+#: (0.98 vs 1.02) from ever gating
+SPEEDUP_TOL = 0.25
+MIN_SPEEDUP_DELTA = 0.15
 #: WAL overhead is a FRACTION (0..1) measured on a shared host — same
 #: calibration shape as conflict rate: generous relative tolerance,
 #: meaningful absolute floor
@@ -183,6 +195,8 @@ def compare(
     min_conflict_delta: float = MIN_CONFLICT_DELTA,
     recovery_tol: float = RECOVERY_TOL,
     min_recovery_delta_s: float = MIN_RECOVERY_DELTA_S,
+    speedup_tol: float = SPEEDUP_TOL,
+    min_speedup_delta: float = MIN_SPEEDUP_DELTA,
     wal_tol: float = WAL_TOL,
     min_wal_delta: float = MIN_WAL_DELTA,
     telemetry_tol: float = TELEMETRY_TOL,
@@ -259,6 +273,19 @@ def compare(
                     f">{min_recovery_delta_s:g}s]" if bad else ""
                 ),
             ))
+        osp, nsp = o.get("throughput_speedup"), n.get("throughput_speedup")
+        if isinstance(osp, (int, float)) and isinstance(nsp, (int, float)):
+            bad = (
+                nsp < osp * (1.0 - speedup_tol)
+                and (osp - nsp) > min_speedup_delta
+            )
+            deltas.append(Delta(
+                name, "throughput_speedup", float(osp), float(nsp), bad,
+                note=(
+                    f"[tol -{speedup_tol:.0%} & >{min_speedup_delta:g}]"
+                    if bad else ""
+                ),
+            ))
         ow, nw = o.get("wal_overhead_frac"), n.get("wal_overhead_frac")
         if isinstance(ow, (int, float)) and isinstance(nw, (int, float)):
             bad = nw > ow * (1.0 + wal_tol) and (nw - ow) > min_wal_delta
@@ -331,6 +358,13 @@ def main(argv=None) -> int:
                     help="absolute recovery growth floor (seconds) below "
                          f"which it never gates (default "
                          f"{MIN_RECOVERY_DELTA_S})")
+    ap.add_argument("--speedup-tol", type=float, default=SPEEDUP_TOL,
+                    help="fractional scaling-speedup shrink tolerated "
+                         f"(default {SPEEDUP_TOL})")
+    ap.add_argument("--min-speedup-delta", type=float,
+                    default=MIN_SPEEDUP_DELTA,
+                    help="absolute speedup shrink floor below which it "
+                         f"never gates (default {MIN_SPEEDUP_DELTA})")
     ap.add_argument("--wal-tol", type=float, default=WAL_TOL,
                     help="fractional WAL-overhead growth tolerated "
                          f"(default {WAL_TOL})")
@@ -363,6 +397,8 @@ def main(argv=None) -> int:
         min_conflict_delta=args.min_conflict_delta,
         recovery_tol=args.recovery_tol,
         min_recovery_delta_s=args.min_recovery_delta_s,
+        speedup_tol=args.speedup_tol,
+        min_speedup_delta=args.min_speedup_delta,
         wal_tol=args.wal_tol,
         min_wal_delta=args.min_wal_delta,
         telemetry_tol=args.telemetry_tol,

@@ -127,6 +127,41 @@ def _make_exporter(telemetry: str, process: str, component: str,
     ).start()
 
 
+def _install_stop_event():
+    """SIGTERM/SIGINT → a threading.Event. SIGTERM matters — the launch
+    supervisor's shutdown cascade is TERM-based, and a default-action TERM
+    would skip the ``finally`` blocks that close exporters and (for the
+    apiserver) flush+close the WAL through the PR-11 graceful path.
+    Falls back to an unarmed event when handlers cannot be installed
+    (non-main thread — in-process tests; ^C still raises there)."""
+    import signal
+    import threading
+
+    stop = threading.Event()
+
+    def _stop(_signum, _frame) -> None:
+        stop.set()
+
+    try:
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
+    except ValueError:
+        pass
+    return stop
+
+
+def _serve_until_signal(stop=None) -> None:
+    """Serve-loop park for the no-work commands (apiserver, collector,
+    watch-driver): block until SIGTERM/SIGINT. Pass a pre-installed
+    ``stop`` event (``_install_stop_event()`` called BEFORE the serving
+    work began) so a TERM arriving during startup is never lost to the
+    default disposition."""
+    try:
+        (stop if stop is not None else _install_stop_event()).wait()
+    except KeyboardInterrupt:
+        pass
+
+
 def cmd_apiserver(args) -> int:
     import os
 
@@ -135,6 +170,9 @@ def cmd_apiserver(args) -> int:
     from .store.wal import WALError
     from .controllers import install_quota_admission
 
+    # handlers BEFORE any serving work: a supervisor TERM that lands
+    # mid-startup must still run the graceful close, not the default kill
+    stop = _install_stop_event()
     persistence = getattr(args, "persistence", "off")
     try:
         store = MemStore(
@@ -175,6 +213,17 @@ def cmd_apiserver(args) -> int:
                if ri.truncated_bytes else "")
             + ")"
         )
+    # the machine-readable readiness banner FIRST (one line, the launch
+    # supervisor's contract — --port 0 publishes the real address here),
+    # then the human serving line
+    from .launch.banner import emit_banner
+
+    emit_banner(
+        "apiserver", url=server.url, readyz=server.url + "/readyz",
+        wire=getattr(args, "wire", "binary"),
+        persistence=("" if persistence == "off" else persistence),
+        telemetry=telemetry,
+    )
     print(f"kubetpu apiserver serving on {server.url} "
           f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N; "
           f"diagnostics: /metrics /healthz /readyz /livez /trace"
@@ -183,11 +232,7 @@ def cmd_apiserver(args) -> int:
           + f"{recovered})",
           flush=True)
     try:
-        import threading
-
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        pass
+        _serve_until_signal(stop)
     finally:
         if exporter is not None:
             exporter.close()
@@ -206,20 +251,99 @@ def cmd_collector(args) -> int:
     ``kubetpu top`` summary at /telemetry/top."""
     from .telemetry.collector import CollectorServer
 
+    from .launch.banner import emit_banner
+
+    stop = _install_stop_event()
     server = CollectorServer(host=args.host, port=args.port).start()
+    emit_banner(
+        "collector", url=server.url, readyz=server.url + "/readyz",
+    )
     print(f"kubetpu collector serving on {server.url} "
           f"(ingest: POST /telemetry/export /telemetry/clock; views: "
           f"/telemetry/trace /telemetry/metrics /telemetry/flightrecorder "
-          f"/telemetry/top; /healthz)",
+          f"/telemetry/top; /healthz /readyz)",
           flush=True)
     try:
-        import threading
-
-        threading.Event().wait()
-    except KeyboardInterrupt:
-        pass
+        _serve_until_signal(stop)
     finally:
         server.close()
+    return 0
+
+
+def cmd_watch_driver(args) -> int:
+    """``kubetpu watch-driver``: N concurrent pod watchers against an
+    apiserver, as ONE dedicated process — the unit the mp wire ladder
+    spreads its 200-watcher fan-out load over (M driver processes instead
+    of 200 threads sharing the measuring process's GIL)."""
+    from .launch.banner import emit_banner
+    from .perf.runner import _WatchFanout
+
+    stop = _install_stop_event()
+    fanout = _WatchFanout(args.server, args.wire, args.watchers)
+    emit_banner(
+        "watch-driver", server=args.server, watchers=args.watchers,
+        wire=args.wire,
+    )
+    print(f"kubetpu watch-driver: {args.watchers} watcher(s) against "
+          f"{args.server} (wire {args.wire})", flush=True)
+    try:
+        _serve_until_signal(stop)
+    finally:
+        fanout.stop()
+    return 0
+
+
+def cmd_up(args) -> int:
+    """``kubetpu up``: the whole control plane as real OS processes — one
+    apiserver + N scheduler replicas (+ optional collector / watch-fanout
+    drivers) under the launch supervisor: ephemeral ports published via
+    readiness banners, /readyz-polled starts, declarative restart policy,
+    SIGTERM-cascade shutdown riding every component's graceful-close
+    path. ^C (or a TERM from the caller) tears the whole topology down."""
+    from .launch import Cluster, SupervisorError
+    from .launch.banner import emit_banner
+
+    # handlers BEFORE the children exist: a TERM landing mid-startup must
+    # still cascade — an orphaned control plane is the one unforgivable
+    # supervisor failure
+    stop = _install_stop_event()
+    persistence = args.persistence if args.persistence != "off" else None
+    cluster = Cluster(
+        replicas=args.replicas,
+        partition=args.partition,
+        wire=args.wire,
+        engine=args.engine,
+        max_batch=args.max_batch,
+        persistence=persistence,
+        telemetry=args.telemetry,
+        fanout_procs=args.fanout_procs,
+        fanout_watchers=args.watch_fanout,
+        restart=args.restart,
+        prewarm=args.prewarm,
+    )
+    try:
+        cluster.start()
+    except (SupervisorError, ValueError) as e:
+        print(f"kubetpu up failed: {e}", file=sys.stderr)
+        cluster.shutdown()
+        return 1
+    try:
+        fields = dict(apiserver=cluster.api_url, replicas=args.replicas,
+                      partition=args.partition, wire=args.wire)
+        if cluster.collector_url:
+            fields["collector"] = cluster.collector_url
+        emit_banner("cluster", **fields)
+        for child in cluster.supervisor.children:
+            url = child.url()
+            print(f"  {child.name:<16} pid {child.pid}"
+                  + (f"  {url}" if url else ""), flush=True)
+        print(f"kubetpu up: {cluster.n_processes()} process(es) ready — "
+              f"apiserver {cluster.api_url} "
+              f"({args.replicas} replica(s), {args.partition}, "
+              f"restart {args.restart}); ^C to stop", flush=True)
+        _serve_until_signal(stop)
+    finally:
+        cluster.shutdown()
     return 0
 
 
@@ -370,12 +494,15 @@ def _retry_start(fn, what: str) -> None:
             time.sleep(2.0)
 
 
-def _make_loop(run_once, period_s: float = 0.05):
+def _make_loop(run_once, period_s: float = 0.05, stop=None):
+    """Component work loop; ``stop`` (an Event from
+    ``_install_stop_event``) makes SIGTERM a graceful exit through the
+    caller's ``finally`` instead of a mid-cycle kill."""
     import time
 
     def loop() -> int:
         try:
-            while True:
+            while stop is None or not stop.is_set():
                 try:
                     run_once()
                 except ConnectionError as e:
@@ -387,7 +514,8 @@ def _make_loop(run_once, period_s: float = 0.05):
                     continue
                 time.sleep(period_s)
         except KeyboardInterrupt:
-            return 0
+            pass
+        return 0
     return loop
 
 
@@ -439,6 +567,21 @@ def cmd_scheduler(args) -> int:
         # silent single-chip run misreported as multichip
         print(f"invalid --mesh: {e}", file=sys.stderr)
         return 1
+    # flag validation BEFORE any real work: --diagnostics-port lost
+    # argparse's type=int when it grew the ephemeral/off keywords, so a
+    # typo must still die here with a usage error, not mid-startup
+    diag_raw = str(getattr(args, "diagnostics_port", "off")).strip()
+    if diag_raw not in ("off", "0", "ephemeral", "auto"):
+        try:
+            int(diag_raw)
+        except ValueError:
+            print(f"invalid --diagnostics-port {diag_raw!r} "
+                  f"(a port number, 'ephemeral', or 'off')",
+                  file=sys.stderr)
+            return 1
+    # handlers BEFORE the (possibly retrying) startup: a supervisor TERM
+    # mid-boot must run the graceful teardown, not the default kill
+    stop = _install_stop_event()
     telemetry = getattr(args, "telemetry", "off")
     store = RemoteStore(
         args.server, wire=getattr(args, "wire", "binary"),
@@ -446,15 +589,40 @@ def cmd_scheduler(args) -> int:
         # byte-identical wire (no traceparent header / tp parameter)
         traceparent=(telemetry != "off"),
     )
+    # cross-process federation: --partition declares this process one of
+    # --replica-count replicas (hash rank / lease fair share / race); the
+    # bare --replica-id backcompat stays race mode
+    partition = getattr(args, "partition", "")
+    membership = None
+    if partition:
+        from .sched.federation import ReplicaMembership
+
+        try:
+            membership = ReplicaMembership(
+                store,
+                replica_id=args.replica_id or "r0",
+                partition=partition,
+                replica_count=max(getattr(args, "replica_count", 0) or 1, 1),
+                partitions=getattr(args, "partitions", 0) or None,
+            )
+        except ValueError as e:
+            print(f"invalid federation flags: {e}", file=sys.stderr)
+            return 1
+    client = StoreClient(store)
+    if membership is not None:
+        client = membership.wrap_client(client)
     sched = Scheduler(
-        StoreClient(store), cfg=cfg, engine=args.engine,
+        client, cfg=cfg, engine=args.engine,
+        max_batch=getattr(args, "max_batch", 1024),
         pipeline=(args.pipeline == "on"),
         encode_cache=(args.encode_cache == "on"),
         bulk=(args.bulk == "on"),
         mesh=mesh,
         flight_recorder=(args.flight_recorder == "on"),
         replica_id=args.replica_id,
-        federation_mode=("race" if args.replica_id else ""),
+        federation_mode=(
+            partition or ("race" if args.replica_id else "")
+        ),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
     )
     sched.enable_preemption()
@@ -477,7 +645,12 @@ def cmd_scheduler(args) -> int:
                 if fr is not None else None
             ),
         )
-    informers = SchedulerInformers(store, sched, bulk=(args.bulk == "on"))
+    informers = SchedulerInformers(
+        store, sched, bulk=(args.bulk == "on"),
+        pod_filter=(
+            membership.pod_filter() if membership is not None else None
+        ),
+    )
     _retry_start(informers.start, "scheduler informers")
     if args.prewarm:
         # pay the XLA bucket ladder up front so the first real cycles never
@@ -486,13 +659,17 @@ def cmd_scheduler(args) -> int:
         informers.pump()
         sched.prewarm()
     is_leader = _maybe_elect(args, store, "kube-scheduler")
+    # --diagnostics-port: a number, 'off' (no listener), or 'ephemeral'
+    # (bind port 0 — the launch supervisor's no-collision default; the
+    # real address is published in the readiness banner; validated above)
     diag = None
-    if getattr(args, "diagnostics_port", 0):
+    if diag_raw not in ("off", "0"):
         from .sched.diagnostics import DiagnosticsServer
 
+        diag_port = 0 if diag_raw in ("ephemeral", "auto") else int(diag_raw)
         try:
             diag = DiagnosticsServer(
-                sched, port=args.diagnostics_port,
+                sched, port=diag_port,
                 # restart visibility: the client's watch-path reconnect
                 # counter rides the scheduler's /metrics page
                 metrics_sources=(store.reconnect_metrics_text,),
@@ -501,13 +678,26 @@ def cmd_scheduler(args) -> int:
             # a second scheduler on the host (HA standby) must not die on
             # the diagnostics side port; it just runs unobserved
             print(
-                f"diagnostics port {args.diagnostics_port} unavailable "
+                f"diagnostics port {diag_raw} unavailable "
                 f"({e}); continuing without the diagnostics listener",
                 file=sys.stderr, flush=True,
             )
         else:
             diag.add_informers(informers)
             diag.start()
+    # the machine-readable readiness banner (launch supervisor contract):
+    # printed only once the informers synced, so "banner seen" already
+    # means "connected to the apiserver and caches listed"
+    from .launch.banner import emit_banner
+
+    banner_fields = dict(
+        server=args.server, engine=args.engine,
+        replica=args.replica_id, partition=partition,
+    )
+    if diag is not None:
+        banner_fields["url"] = diag.url
+        banner_fields["readyz"] = diag.url + "/readyz"
+    emit_banner("scheduler", **banner_fields)
     print(f"kubetpu scheduler running against {args.server} "
           f"(engine {args.engine}"
           + (f"; diagnostics on {diag.url}" if diag is not None else "")
@@ -516,14 +706,18 @@ def cmd_scheduler(args) -> int:
     def once():
         if not is_leader():
             return
+        if membership is not None:
+            membership.tick(sched)
         informers.pump()
         sched.schedule_batch()
         sched._drain_bind_completions()
     try:
-        return _make_loop(once)()
+        return _make_loop(once, stop=stop)()
     finally:
         if exporter is not None:
             exporter.close()
+        if membership is not None:
+            membership.release()
         if diag is not None:
             diag.close()
 
@@ -1073,10 +1267,35 @@ def build_parser() -> argparse.ArgumentParser:
                            "back to JSON permanently — mixed-version pairs "
                            "keep working); 'json' pins the original JSON "
                            "wire")
+    schd.add_argument("--partition", default="",
+                      choices=["", "race", "hash", "lease"],
+                      help="cross-process federation partition mode (with "
+                           "--replica-count N): 'race' = every replica "
+                           "sees every pod, the CAS bind arbitrates; "
+                           "'hash' = static crc32 rank of --replica-count "
+                           "(no overlap; a supervisor respawn re-adopts "
+                           "the rank's backlog via the informer relist); "
+                           "'lease' = epoch-fenced renewable partition "
+                           "leases in the SHARED store (expiry/fair-share/"
+                           "fencing work across processes). Empty with "
+                           "--replica-id = race (backcompat)")
+    schd.add_argument("--replica-count", type=int, default=0,
+                      help="declared replica count for --partition "
+                           "hash|lease (cross-process membership is "
+                           "supervisor-declared, not gossiped)")
+    schd.add_argument("--partitions", type=int, default=0,
+                      help="lease-mode keyspace partitions (default "
+                           "2x replica count)")
+    schd.add_argument("--max-batch", type=int, default=1024,
+                      help="max pods per scheduling cycle batch")
     schd.add_argument("--leader-elect", action="store_true")
-    schd.add_argument("--diagnostics-port", type=int, default=10251,
+    schd.add_argument("--diagnostics-port", default="10251",
+                      metavar="N|ephemeral|off",
                       help="side port for /metrics /healthz /readyz /livez "
-                           "/trace (0 disables)")
+                           "/trace; 'ephemeral' binds port 0 and publishes "
+                           "the real address in the readiness banner (the "
+                           "supervisor default — parallel runs never "
+                           "collide); 'off' (or 0) disables")
     schd.add_argument("--telemetry", default="off", metavar="URL|off",
                       help="telemetry plane: a collector URL stamps a W3C-"
                            "style traceparent on every RPC (binary envelope "
@@ -1215,6 +1434,60 @@ def build_parser() -> argparse.ArgumentParser:
                      help="refresh every --interval seconds until ^C")
     top.add_argument("--interval", type=float, default=2.0)
     top.set_defaults(fn=cmd_top)
+
+    wd = sub.add_parser(
+        "watch-driver",
+        help="run N concurrent pod watchers against an apiserver as one "
+             "dedicated process (the mp wire ladder's fan-out unit)",
+    )
+    wd.add_argument("--server", required=True, help="API server base URL")
+    wd.add_argument("--watchers", type=int, default=50)
+    wd.add_argument("--wire", default="binary", choices=["binary", "json"])
+    wd.set_defaults(fn=cmd_watch_driver)
+
+    up = sub.add_parser(
+        "up",
+        help="run the whole control plane as real OS processes under the "
+             "launch supervisor: apiserver + N scheduler replicas "
+             "(+ collector / watch-fanout drivers), ephemeral ports via "
+             "readiness banners, restart policy, SIGTERM-cascade shutdown",
+    )
+    up.add_argument("--replicas", type=int, default=1,
+                    help="scheduler replica processes")
+    up.add_argument("--partition", default="race",
+                    choices=["race", "hash", "lease"],
+                    help="federation partition mode across the replica "
+                         "processes (see kubetpu scheduler --partition)")
+    up.add_argument("--wire", default="binary", choices=["binary", "json"],
+                    help="wire codec for every child (and the 415-fallback "
+                         "escape hatch)")
+    up.add_argument("--engine", default="greedy",
+                    choices=["greedy", "batched"])
+    up.add_argument("--max-batch", type=int, default=1024)
+    up.add_argument("--persistence", default="off", metavar="DIR|off",
+                    help="apiserver durability dir (WAL + snapshots); the "
+                         "SIGTERM cascade rides the graceful close — "
+                         "`kubetpu store fsck` passes afterwards")
+    up.add_argument("--telemetry", default="off",
+                    metavar="off|embed|collector|URL",
+                    help="'embed' mounts the collector ON the apiserver "
+                         "and points every scheduler's exporter there; "
+                         "'collector' spawns a collector child; a URL "
+                         "uses an external collector; 'off' exports "
+                         "nothing")
+    up.add_argument("--watch-fanout", type=int, default=0,
+                    help="total extra pod watchers, spread over "
+                         "--fanout-procs driver processes")
+    up.add_argument("--fanout-procs", type=int, default=0,
+                    help="watch-driver processes carrying --watch-fanout")
+    up.add_argument("--restart", default="on-failure:2",
+                    metavar="never|on-failure[:max]",
+                    help="per-scheduler restart policy: a killed replica "
+                         "is respawned and re-federates (hash re-adopts "
+                         "its rank's backlog, lease re-acquires)")
+    up.add_argument("--prewarm", action="store_true",
+                    help="schedulers compile the bucket ladder at startup")
+    up.set_defaults(fn=cmd_up)
 
     ver = sub.add_parser("version", help="print version")
     ver.set_defaults(fn=cmd_version)

@@ -1,0 +1,75 @@
+"""The machine-readable readiness banner — one line, one contract.
+
+Every long-running ``kubetpu`` binary (apiserver, scheduler, collector,
+watch-driver) binds port 0 by default under the supervisor and publishes
+the REAL address it landed on as the FIRST stdout line, before entering
+its serve loop:
+
+    KUBETPU-READY {"component": "apiserver", "url": "http://127.0.0.1:40321",
+                   "readyz": "http://127.0.0.1:40321/readyz", "pid": 12345}
+
+The prefix is fixed, the payload is one compact JSON object, and the line
+is flushed before any other output — so a supervisor (or a shell script
+with ``head -1``) can always parse where a child is serving without
+pre-allocating ports. Parallel CI runs never collide: nobody picks a port,
+the kernel does, and the banner carries the answer back.
+
+Fields (``component`` is the only required one):
+
+- ``component``   "apiserver" | "scheduler" | "collector" | "watch-driver"
+- ``url``         the component's own serving base URL (absent for a
+                  scheduler with diagnostics disabled)
+- ``readyz``      full URL the supervisor health-polls until 200 (absent =
+                  the banner itself is the readiness signal)
+- ``pid``         the child's own PID (cross-checked against the Popen)
+- anything else the component wants to advertise (replica id, wire codec,
+  persistence dir, watcher count, …)
+
+``parse_banner`` is never-fatal: a non-banner line (klog noise, a human
+serving line) reads as ``None``, and a corrupt banner payload reads as
+``None`` rather than crashing the supervisor's reader thread.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+#: the fixed first-token contract; everything after it is one JSON object
+READY_PREFIX = "KUBETPU-READY "
+
+
+def format_banner(component: str, **fields) -> str:
+    """One banner line for ``component``. ``pid`` is stamped automatically
+    (override by passing it); key order is stable (component first) so the
+    line is diffable across runs."""
+    payload: dict = {"component": component}
+    payload.update(fields)
+    payload.setdefault("pid", os.getpid())
+    return READY_PREFIX + json.dumps(payload, separators=(", ", ": "))
+
+
+def emit_banner(component: str, **fields) -> str:
+    """Format AND print-with-flush — the one call a CLI serve command
+    makes right before its serve loop. Returns the line for logging."""
+    line = format_banner(component, **fields)
+    print(line, flush=True)
+    return line
+
+
+def parse_banner(line: str) -> dict | None:
+    """The banner payload of ``line``, or ``None`` when the line is not a
+    (well-formed) banner. Tolerates leading whitespace and trailing
+    newline; anything else must match exactly."""
+    if line is None:
+        return None
+    line = line.strip()
+    if not line.startswith(READY_PREFIX):
+        return None
+    try:
+        payload = json.loads(line[len(READY_PREFIX):])
+    except (json.JSONDecodeError, ValueError):
+        return None
+    if not isinstance(payload, dict) or "component" not in payload:
+        return None
+    return payload

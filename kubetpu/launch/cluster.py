@@ -1,0 +1,239 @@
+"""The standard multi-process control-plane topology on the Supervisor.
+
+One ``Cluster`` = one apiserver + N scheduler replicas (+ optional
+collector and M watch-fanout driver processes), each a real OS process
+spawned from this interpreter's ``python -m kubetpu`` entry points, wired
+together through readiness banners (nobody pre-picks a port):
+
+    collector?  ──►  apiserver  ──►  scheduler r0..r{N-1}  ──►  drivers
+
+``kubetpu up`` serves this topology interactively; the perf runner's
+``run_workload_multiprocess`` drives a workload against it and joins on
+the store-verified binding parity. Both go through the same ChildSpec
+builders, so the tier-1 smoke, the CLI, and the bench ladder exercise ONE
+spawn/readiness/shutdown path (the PR-13 dedup contract).
+"""
+
+from __future__ import annotations
+
+import sys
+from dataclasses import dataclass, field
+
+from .supervisor import Child, ChildSpec, Supervisor
+
+
+def kubetpu_argv(*args: str, python: str | None = None) -> list[str]:
+    """argv for a ``kubetpu`` subcommand run by THIS interpreter — the
+    children run the same build as the supervisor (the cross-process
+    schema fingerprint makes a drifted build refuse loudly anyway)."""
+    return [python or sys.executable, "-m", "kubetpu", *args]
+
+
+def apiserver_spec(
+    *,
+    name: str = "apiserver",
+    wire: str = "binary",
+    persistence: str | None = None,
+    telemetry: str = "off",
+    restart: str = "never",
+    env: dict | None = None,
+    ready_timeout_s: float = 120.0,
+) -> ChildSpec:
+    args = ["apiserver", "--port", "0", "--wire", wire]
+    if persistence:
+        args += ["--persistence", persistence]
+    if telemetry and telemetry != "off":
+        args += ["--telemetry", telemetry]
+    return ChildSpec(
+        name=name, argv=kubetpu_argv(*args), restart=restart,
+        env=env, shutdown_phase=1, ready_timeout_s=ready_timeout_s,
+    )
+
+
+def collector_spec(
+    *, name: str = "collector", env: dict | None = None,
+    ready_timeout_s: float = 60.0,
+) -> ChildSpec:
+    return ChildSpec(
+        name=name, argv=kubetpu_argv("collector", "--port", "0"),
+        env=env, shutdown_phase=1, ready_timeout_s=ready_timeout_s,
+    )
+
+
+def scheduler_spec(
+    *,
+    name: str,
+    server: str,
+    replica_id: str = "",
+    partition: str = "",
+    replica_count: int = 0,
+    partitions: int = 0,
+    wire: str = "binary",
+    engine: str = "greedy",
+    max_batch: int = 0,
+    telemetry: str = "off",
+    prewarm: bool = False,
+    diagnostics: str = "ephemeral",
+    restart: str = "never",
+    env: dict | None = None,
+    ready_timeout_s: float = 180.0,
+    extra_args: tuple = (),
+) -> ChildSpec:
+    args = [
+        "scheduler", "--server", server, "--engine", engine,
+        "--wire", wire, "--diagnostics-port", diagnostics,
+    ]
+    if replica_id:
+        args += ["--replica-id", replica_id]
+    if partition:
+        args += ["--partition", partition]
+    if replica_count:
+        args += ["--replica-count", str(replica_count)]
+    if partitions:
+        args += ["--partitions", str(partitions)]
+    if max_batch:
+        args += ["--max-batch", str(max_batch)]
+    if telemetry and telemetry != "off":
+        args += ["--telemetry", telemetry]
+    if prewarm:
+        args += ["--prewarm"]
+    args += list(extra_args)
+    return ChildSpec(
+        name=name, argv=kubetpu_argv(*args), restart=restart,
+        env=env, shutdown_phase=0, ready_timeout_s=ready_timeout_s,
+    )
+
+
+def watch_driver_spec(
+    *,
+    name: str,
+    server: str,
+    watchers: int,
+    wire: str = "binary",
+    env: dict | None = None,
+    ready_timeout_s: float = 60.0,
+) -> ChildSpec:
+    return ChildSpec(
+        name=name,
+        argv=kubetpu_argv(
+            "watch-driver", "--server", server,
+            "--watchers", str(watchers), "--wire", wire,
+        ),
+        env=env, shutdown_phase=0, ready_timeout_s=ready_timeout_s,
+    )
+
+
+@dataclass
+class Cluster:
+    """See module docstring. ``telemetry``: "off" | "embed" (collector ON
+    the apiserver, schedulers export to it) | "collector" (a spawned
+    collector child) | a collector URL. ``fanout_watchers`` total watchers
+    are spread over ``fanout_procs`` driver processes."""
+
+    replicas: int = 1
+    partition: str = "race"
+    wire: str = "binary"
+    engine: str = "greedy"
+    max_batch: int = 0
+    persistence: str | None = None
+    telemetry: str = "off"
+    fanout_procs: int = 0
+    fanout_watchers: int = 0
+    restart: str = "on-failure:2"
+    prewarm: bool = False
+    env: dict | None = None
+    cwd: str | None = None
+    ready_timeout_s: float = 180.0
+
+    supervisor: Supervisor = field(init=False, default=None)
+    schedulers: list = field(init=False, default_factory=list)
+    drivers: list = field(init=False, default_factory=list)
+    api_url: str = field(init=False, default="")
+    collector_url: str = field(init=False, default="")
+
+    def start(self) -> "Cluster":
+        self.supervisor = Supervisor(env=self.env, cwd=self.cwd)
+        try:
+            self._start_children()
+        except BaseException:
+            self.supervisor.shutdown()
+            raise
+        self.supervisor.start_monitor()
+        return self
+
+    def _start_children(self) -> None:
+        sup = self.supervisor
+        api_telemetry = self.telemetry
+        if self.telemetry == "collector":
+            coll = sup.spawn(collector_spec(env=self.env))
+            self.collector_url = coll.url()
+            api_telemetry = self.collector_url
+        api = sup.spawn(apiserver_spec(
+            wire=self.wire, persistence=self.persistence,
+            telemetry=api_telemetry, env=self.env,
+            ready_timeout_s=self.ready_timeout_s,
+        ))
+        self.api_url = api.url()
+        if self.telemetry == "embed":
+            # the embedded collector serves on the apiserver's own port
+            self.collector_url = self.api_url
+        sched_telemetry = self.collector_url or (
+            self.telemetry if self.telemetry.startswith("http") else ""
+        )
+        for i in range(self.replicas):
+            rid = f"r{i}"
+            self.schedulers.append(sup.spawn(scheduler_spec(
+                name=f"scheduler-{rid}", server=self.api_url,
+                replica_id=rid, partition=self.partition,
+                replica_count=self.replicas,
+                wire=self.wire, engine=self.engine,
+                max_batch=self.max_batch,
+                telemetry=sched_telemetry or "off",
+                prewarm=self.prewarm, restart=self.restart, env=self.env,
+                ready_timeout_s=self.ready_timeout_s,
+            )))
+        procs = self.fanout_procs or (1 if self.fanout_watchers else 0)
+        if procs and self.fanout_watchers:
+            per = -(-self.fanout_watchers // procs)               # ceil
+            left = self.fanout_watchers
+            for i in range(procs):
+                n = min(per, left)
+                left -= n
+                if n <= 0:
+                    break
+                self.drivers.append(sup.spawn(watch_driver_spec(
+                    name=f"watch-driver-{i}", server=self.api_url,
+                    watchers=n, wire=self.wire, env=self.env,
+                )))
+
+    # ------------------------------------------------------------- accessors
+    def scheduler_diag_urls(self) -> list[str]:
+        """Each live replica's diagnostics base URL (its banner's
+        ``url``) — the /metrics the mp runner scrapes for conflict
+        evidence. Restarted replicas re-banner, so this is always the
+        CURRENT address."""
+        return [c.url() for c in self.schedulers if c.url()]
+
+    def n_processes(self) -> int:
+        return len(self.supervisor.children)
+
+    # ------------------------------------------------------------- lifecycle
+    def kill_replica(self, index: int) -> str:
+        """SIGKILL scheduler replica ``index`` (the crash the restart
+        policy answers). Returns the child name for event matching."""
+        name = self.schedulers[index].name
+        self.supervisor.kill(name)
+        return name
+
+    def join(self, verify=None) -> None:
+        self.supervisor.join(verify=verify)
+
+    def shutdown(self) -> None:
+        if self.supervisor is not None:
+            self.supervisor.shutdown()
+
+    def __enter__(self) -> "Cluster":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.shutdown()

@@ -7,6 +7,7 @@ from .runner import (
     run_workload,
     run_workload_federated,
     run_workload_full_stack,
+    run_workload_multiprocess,
 )
 from .workloads import TEST_CASES, TestCase, Workload
 
@@ -19,4 +20,5 @@ __all__ = [
     "run_workload",
     "run_workload_federated",
     "run_workload_full_stack",
+    "run_workload_multiprocess",
 ]

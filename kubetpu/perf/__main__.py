@@ -13,6 +13,7 @@ from . import (
     run_label,
     run_workload,
     run_workload_federated,
+    run_workload_multiprocess,
 )
 
 
@@ -73,6 +74,28 @@ def main(argv=None) -> None:
                          "exporters on their cadence; the record embeds "
                          "span totals + the drop counter (the "
                          "TelemetryOverhead on/off comparison's 'on' half)")
+    ap.add_argument("--processes", type=int, default=0,
+                    help="with --fullstack: run the apiserver and N "
+                         "scheduler replicas as separate OS PROCESSES "
+                         "under the launch supervisor "
+                         "(kubetpu.launch.Cluster) — no shared GIL, "
+                         "components talk only through the apiserver, and "
+                         "the run joins on the store-verified exactly-"
+                         "once binding parity (a miss FAILS the run). "
+                         "0 = in-process modes below")
+    ap.add_argument("--fanout-procs", type=int, default=0,
+                    help="multi-process only: spread --watch-fanout over "
+                         "M dedicated watch-driver processes (default: "
+                         "one driver process when --watch-fanout > 0)")
+    ap.add_argument("--persistence", default="off", metavar="DIR|off",
+                    help="multi-process only: run the apiserver child "
+                         "with --persistence DIR (WAL + snapshots); the "
+                         "SIGTERM cascade rides the graceful close")
+    ap.add_argument("--restart", default="on-failure:2",
+                    metavar="never|on-failure[:max]",
+                    help="multi-process only: per-scheduler supervisor "
+                         "restart policy — a replica killed by "
+                         "--kill-replica-at is respawned and re-federates")
     ap.add_argument("--replicas", type=int, default=1,
                     help="run N full scheduler replicas against one "
                          "in-process apiserver (active-active federation, "
@@ -114,6 +137,40 @@ def main(argv=None) -> None:
         mesh=args.mesh,   # resolve_mesh handles on/off/auto
         flight_recorder=(args.flight_recorder == "on"),
     )
+    if args.processes:
+        # the honest deployment shape: real OS processes (acceptance:
+        # python -m kubetpu.perf --fullstack --processes N)
+        if not args.fullstack:
+            ap.error("--processes requires --fullstack (there is no "
+                     "direct-mode multi-process deployment)")
+        if args.kill_replica_at is not None and args.processes < 2:
+            ap.error("--kill-replica-at requires --processes >= 2")
+        case = TEST_CASES[args.case]
+        workloads = (
+            [w for w in case.workloads if w.name == args.workload]
+            if args.workload else list(case.workloads)
+        )
+        for wl in workloads:
+            r = run_workload_multiprocess(
+                case, wl,
+                replicas=args.processes,
+                partition=args.partition,
+                wire=args.wire,
+                engine=args.engine,
+                max_batch=args.max_batch,
+                timeout_s=args.timeout,
+                bulk=(args.bulk == "on"),
+                persistence=(
+                    None if args.persistence == "off" else args.persistence
+                ),
+                telemetry=(args.telemetry == "on"),
+                watch_fanout=args.watch_fanout,
+                fanout_procs=args.fanout_procs,
+                kill_replica_at=args.kill_replica_at,
+                restart=args.restart,
+            )
+            print(json.dumps(r.to_json()))
+        return
     if args.kill_replica_at is not None and args.replicas < 2:
         # a 1-replica "kill" can never fire — a recovery measurement with
         # no kill would be silently meaningless

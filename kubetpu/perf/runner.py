@@ -139,6 +139,14 @@ class WorkloadResult:
     # (--telemetry): ingested span totals and the drop counter the
     # TelemetryOverhead gate asserts stayed zero
     telemetry: dict | None = None
+    # multi-process deployment view (run_workload_multiprocess): how many
+    # REAL OS processes carried the run (apiserver + schedulers +
+    # collector + watch drivers), each child's peak RSS / CPU seconds /
+    # restart count from the supervisor's /proc sampling, and how many
+    # supervisor respawns fired mid-run — 0 processes = in-process mode
+    n_processes: int = 0
+    child_stats: dict | None = None
+    restarts: int = 0
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -218,6 +226,11 @@ class WorkloadResult:
                 out["recovery_s"] = round(self.recovery_s, 3)
         if self.telemetry is not None:
             out["telemetry"] = self.telemetry
+        if self.n_processes:
+            out["n_processes"] = self.n_processes
+            out["restarts"] = self.restarts
+            if self.child_stats is not None:
+                out["child_stats"] = self.child_stats
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -1619,6 +1632,321 @@ def run_workload_federated(
         binding_parity=parity,
         lease_transitions=fed.lease_transitions(),
         recovery_s=recovery_s,
+    )
+
+
+def _scrape_metrics(url: str):
+    """Parse one component's /metrics scrape (None on any failure — a
+    restarting replica mid-scrape must not kill the run; the caller
+    reports what it could read)."""
+    import urllib.request
+
+    from ..metrics.textparse import parse_prometheus_text
+
+    try:
+        with urllib.request.urlopen(url.rstrip("/") + "/metrics",
+                                    timeout=10) as resp:
+            return parse_prometheus_text(resp.read().decode())
+    except Exception:
+        return None
+
+
+def _sum_samples(parsed, name: str, **labels) -> float:
+    """Sum of every sample of family ``name`` whose label set contains
+    ``labels`` (a sum() over a PromQL instant selector)."""
+    if parsed is None:
+        return 0.0
+    want = {(k, str(v)) for k, v in labels.items()}
+    return sum(
+        s.value for s in parsed.samples(name)
+        if s.name == name and want <= set(s.labels)
+    )
+
+
+class ParityError(AssertionError):
+    """The store-verified exactly-once binding check failed: a measured
+    pod is unbound (lost to a dead replica / conflict loop) after the run
+    claimed completion. Raised — never just a field — so a lossy mp run
+    FAILS its bench stage and benchdiff treats it as a regression."""
+
+
+def run_workload_multiprocess(
+    case: W.TestCase | str,
+    workload: W.Workload | str,
+    replicas: int = 2,
+    partition: str = "race",
+    wire: str = "binary",
+    engine: str = "greedy",
+    max_batch: int = 1024,
+    timeout_s: float = 1800.0,
+    stall_s: float = 30.0,
+    bulk: bool = True,
+    persistence: str | None = None,
+    telemetry: bool = False,
+    watch_fanout: int = 0,
+    fanout_procs: int = 0,
+    kill_replica_at: float | None = None,
+    restart: str = "on-failure:2",
+    child_env: dict | None = None,
+) -> WorkloadResult:
+    """THE honest deployment shape: apiserver + N scheduler replicas
+    (+ optional collector and watch-fanout drivers) as REAL OS processes
+    under the launch supervisor (``kubetpu.launch.Cluster``) — no shared
+    GIL, components talk ONLY through the apiserver, exactly the
+    reference's independent-binaries layer map. The measuring parent
+    drives the op list through an admin RemoteStore and observes binding
+    progress from the STORE (not from in-process counters it cannot
+    have), then joins through ``Supervisor.join`` with the store-verified
+    exactly-once parity check — a parity miss raises ``ParityError`` and
+    fails the stage, never just a field.
+
+    ``kill_replica_at`` (0..1): at that fraction of the measured pods
+    bound, the last replica is SIGKILLed; the supervisor's ``restart``
+    policy respawns it (the respawned process re-federates — hash
+    re-adopts its rank's backlog via the informer relist, lease
+    re-acquires through the shared store) and ``recovery_s`` measures
+    kill → every measured pod bound.
+
+    Evidence scraped over HTTP before shutdown: apiserver request/wire
+    deltas for the measured window, per-replica federation conflicts +
+    schedule attempts from the diagnostics pages (counters of the
+    CURRENTLY live processes — a restarted replica restarts its
+    counters; ``restarts`` says when that happened), and per-child peak
+    RSS / CPU seconds from the supervisor's /proc sampling.
+
+    Supports the createNodes/createNamespaces/createPods/barrier op set
+    (the fullstack SchedulingBasic shape); richer ops raise."""
+    import os as _os
+
+    from ..apiserver import RemoteStore
+    from ..client.informers import NAMESPACES, NODES, PODS
+    from ..launch import Cluster
+
+    if isinstance(case, str):
+        case = W.TEST_CASES[case]
+    if isinstance(workload, str):
+        workload = next(w for w in case.workloads if w.name == workload)
+    params = dict(workload.params)
+    supported = (
+        W.CreateNodesOp, W.CreateNamespacesOp, W.CreatePodsOp, W.BarrierOp,
+    )
+    for op in case.ops:
+        if not isinstance(op, supported):
+            raise NotImplementedError(
+                f"multi-process mode does not drive {type(op).__name__}"
+            )
+    if kill_replica_at is not None and replicas < 2:
+        raise ValueError("--kill-replica-at requires --replicas >= 2")
+
+    import kubetpu as _pkg
+
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(
+        _pkg.__file__
+    )))
+    cluster = Cluster(
+        replicas=replicas, partition=partition, wire=wire, engine=engine,
+        max_batch=max_batch, persistence=persistence,
+        telemetry=("collector" if telemetry else "off"),
+        fanout_procs=fanout_procs, fanout_watchers=watch_fanout,
+        restart=restart, env=child_env, cwd=repo_root,
+    )
+    measured = 0
+    duration = 0.0
+    measure_target = 0
+    recovery_s: float | None = None
+    killed = False
+    requests0 = wire0 = 0.0
+    rpcs_total = wire_total = 0.0
+    measure_namespaces: tuple[str, ...] = ()
+    op_ns_counter = 0
+
+    cluster.start()
+    try:
+        admin = RemoteStore(cluster.api_url, wire=wire)
+
+        def bound_now(namespaces: tuple[str, ...]) -> int:
+            items, _rv = admin.list(PODS)
+            return sum(
+                1 for key, pod in items
+                if pod.node_name and key.split("/", 1)[0] in namespaces
+            )
+
+        def settle(
+            target: int, namespaces: tuple[str, ...], start: int,
+            allow_kill: bool = False,
+        ) -> tuple[int, float]:
+            """``start`` is the namespaces' bound count captured BEFORE
+            the creating bulk RPCs: the scheduler processes run
+            concurrently with the chunked create, so pods from chunk 1
+            can already be bound when settle begins — a baseline taken
+            here would make ``target`` unreachable and every mp
+            throughput row would silently absorb a full stall wait."""
+            nonlocal recovery_s, killed
+            t0 = time.perf_counter()
+            deadline = t0 + timeout_s
+            last_progress = t0
+            done = 0
+            t_kill = None
+            kill_at = (
+                int(kill_replica_at * target)
+                if (kill_replica_at is not None and allow_kill) else None
+            )
+            while done < target:
+                now = time.perf_counter()
+                if now > deadline:
+                    break
+                before = done
+                done = bound_now(namespaces) - start
+                if kill_at is not None and not killed and done >= kill_at:
+                    cluster.kill_replica(len(cluster.schedulers) - 1)
+                    killed = True
+                    t_kill = time.perf_counter()
+                if done > before:
+                    last_progress = now
+                elif now - last_progress > stall_s:
+                    break
+                else:
+                    time.sleep(0.1)
+            t_end = time.perf_counter()
+            if t_kill is not None and done >= target:
+                recovery_s = t_end - t_kill
+            return done, t_end - t0
+
+        for op_i, op in enumerate(case.ops):
+            if isinstance(op, W.CreateNodesOp):
+                n = op.count or params[op.count_param]
+                factory = op.template or W.node_default
+                nodes = [factory(i, op.zones) for i in range(n)]
+                _bulk_create(
+                    admin, NODES, [(nd.name, nd) for nd in nodes], bulk=bulk,
+                )
+            elif isinstance(op, W.CreateNamespacesOp):
+                n = params[op.count_param] if op.count_param else op.count
+                _bulk_create(admin, NAMESPACES, [
+                    (f"{op.prefix}-{i}", t.Namespace(
+                        name=f"{op.prefix}-{i}", labels=op.labels,
+                    ))
+                    for i in range(n)
+                ], bulk=bulk)
+            elif isinstance(op, W.BarrierOp):
+                continue   # phases settle to completion below
+            elif isinstance(op, W.CreatePodsOp):
+                count = params[op.count_param]
+                template = op.template or case.default_pod_template
+                ns = op.namespace or f"namespace-{op_ns_counter}"
+                op_ns_counter += 1
+                prefix = (
+                    f"{'measure' if op.collect_metrics else 'init'}-{op_i}"
+                )
+                if op.collect_metrics:
+                    measure_namespaces = measure_namespaces + (ns,)
+                    measure_target += count
+                    api_metrics = _scrape_metrics(cluster.api_url)
+                    requests0 = _sum_samples(
+                        api_metrics, "apiserver_request_total"
+                    )
+                    wire0 = _sum_samples(
+                        api_metrics, "apiserver_wire_bytes_total"
+                    )
+                start = bound_now((ns,))   # BEFORE the creates — see settle
+                items = []
+                for j in range(count):
+                    pod = template(f"{prefix}-{ns}-{j}", ns)
+                    items.append((f"{ns}/{pod.name}", pod))
+                _bulk_create(admin, PODS, items, bulk=bulk)
+                if op.skip_wait:
+                    continue
+                done, secs = settle(
+                    count, (ns,), start, allow_kill=op.collect_metrics,
+                )
+                if op.collect_metrics:
+                    measured += done
+                    duration += secs
+                    api_metrics = _scrape_metrics(cluster.api_url)
+                    rpcs_total += _sum_samples(
+                        api_metrics, "apiserver_request_total"
+                    ) - requests0
+                    wire_total += _sum_samples(
+                        api_metrics, "apiserver_wire_bytes_total"
+                    ) - wire0
+
+        # federation evidence off the live replicas' diagnostics pages
+        # (scraped BEFORE the join stops them)
+        conflicts = 0.0
+        attempts = 0.0
+        for diag_url in cluster.scheduler_diag_urls():
+            parsed = _scrape_metrics(diag_url)
+            conflicts += _sum_samples(
+                parsed, "scheduler_federation_conflicts_total"
+            )
+            attempts += _sum_samples(
+                parsed, "scheduler_schedule_attempts_total",
+                result="scheduled",
+            )
+        wire_codec = admin.wire_codec
+        n_processes = cluster.n_processes()
+        restarts = cluster.supervisor.restarts_total()
+
+        parity_read: dict[str, int] = {}
+
+        def verify_parity() -> None:
+            """The join contract: store-verified exactly-once binding of
+            EVERY measured pod, checked while the apiserver still serves.
+            (The CAS bind makes bound-twice impossible, so parity ==
+            target means none were lost to a dead replica or a conflict
+            loop either.) The count READ from the store is what the
+            record carries — never a value derived from the target."""
+            parity = bound_now(measure_namespaces)
+            parity_read["bound"] = parity
+            if parity != measure_target:
+                raise ParityError(
+                    f"binding parity miss: {parity}/{measure_target} "
+                    f"measured pods bound "
+                    f"(replicas={replicas}, partition={partition}, "
+                    f"killed={killed}, restarts={restarts})"
+                )
+
+        cluster.join(verify=verify_parity if measure_namespaces else None)
+        child_stats = cluster.supervisor.child_stats()
+    finally:
+        cluster.shutdown()
+
+    throughput = measured / duration if duration > 0 else 0.0
+    return WorkloadResult(
+        case_name=case.name,
+        workload_name=(
+            f"{workload.name}_mp_{replicas}sched_{partition}"
+        ),
+        threshold=workload.threshold,
+        threshold_note=workload.threshold_note,
+        measure_pods=measure_target,
+        scheduled=measured,
+        duration_s=duration,
+        throughput=throughput,
+        vs_threshold=(
+            throughput / workload.threshold if workload.threshold else None
+        ),
+        attempts=int(attempts),
+        cycles=0,
+        rpcs_per_scheduled_pod=(
+            rpcs_total / measured if measured else None
+        ),
+        wire_codec=wire_codec,
+        wire_bytes_per_pod=(
+            wire_total / measured if measured else None
+        ),
+        watch_fanout=watch_fanout,
+        replicas=replicas,
+        partition=partition,
+        conflicts=int(conflicts),
+        conflict_rate=(conflicts / attempts) if attempts else 0.0,
+        binding_parity=parity_read.get("bound"),   # the store-READ count
+        #                   (join raised ParityError on any miss, so a
+        #                    record only exists when it equals the target)
+        recovery_s=recovery_s,
+        n_processes=n_processes,
+        child_stats=child_stats,
+        restarts=restarts,
     )
 
 

@@ -563,6 +563,137 @@ class SchedulerFederation:
         )
 
 
+class ReplicaMembership:
+    """ONE process's slice of the federation — what ``SchedulerFederation``
+    wires for N in-process replicas, rebuilt here for a replica that is a
+    separate OS process (``kubetpu scheduler --partition hash|race|lease
+    --replica-count N``, spawned by the launch supervisor).
+
+    Cross-process membership is SUPERVISOR-driven, not gossip-driven: the
+    replica count is declared at spawn, a dead replica is answered by the
+    restart policy (the respawned process re-federates — hash re-adopts
+    its rank's backlog through the informer's initial list, lease re-
+    acquires its fair share through the shared store), and hash ranks are
+    therefore STATIC (``replica_index`` of ``replica_count``), unlike the
+    in-process federation's live re-ranking. Lease mode keeps its full
+    dynamic behavior because the leases live in the shared store: expiry,
+    fair-share rebalancing, and epoch fencing all work across processes
+    exactly as they do across threads.
+    """
+
+    def __init__(
+        self,
+        store: Any,
+        replica_id: str,
+        partition: str,
+        replica_count: int,
+        replica_index: int | None = None,
+        partitions: int | None = None,
+        clock: Callable[[], float] = default_clock,
+        lease_duration_s: float = 2.0,
+    ) -> None:
+        if partition not in PARTITION_MODES:
+            raise ValueError(
+                f"unknown partition mode {partition!r} "
+                f"(one of {PARTITION_MODES})"
+            )
+        if replica_count < 1:
+            raise ValueError("--partition needs --replica-count >= 1")
+        if replica_index is None:
+            # the launch convention: replica ids are r0..r{N-1}
+            digits = "".join(c for c in replica_id if c.isdigit())
+            replica_index = int(digits) if digits else 0
+        if not 0 <= replica_index < replica_count:
+            raise ValueError(
+                f"replica index {replica_index} outside 0..{replica_count - 1}"
+            )
+        self.store = store
+        self.replica_id = replica_id
+        self.mode = partition
+        self.replica_count = replica_count
+        self.replica_index = replica_index
+        self.partitions = partitions or (
+            2 * replica_count if partition == "lease" else replica_count
+        )
+        self.leases: PartitionLeaseManager | None = None
+        if partition == "lease":
+            self.leases = PartitionLeaseManager(
+                StoreLeaseClient(store),
+                identity=replica_id,
+                partitions=self.partitions,
+                clock=clock,
+                lease_duration_s=lease_duration_s,
+                renew_deadline_s=0.75 * lease_duration_s,
+                start=replica_index * self.partitions // replica_count,
+            )
+
+    # ----------------------------------------------------------- federation
+    def _owns(self, key: str) -> bool:
+        if self.mode == "race":
+            return True
+        if self.mode == "lease":
+            assert self.leases is not None
+            return self.leases.owns(pod_partition(key, self.partitions))
+        return (
+            pod_partition(key, self.replica_count) == self.replica_index
+        )
+
+    def pod_filter(self):
+        """The per-replica informer filter (None in race mode — everyone
+        sees everything and the CAS bind arbitrates)."""
+        if self.mode == "race":
+            return None
+
+        def owns(pod) -> bool:
+            return self._owns(f"{pod.namespace}/{pod.name}")
+
+        return owns
+
+    def wrap_client(self, client: Any) -> Any:
+        """Lease mode's correctness backstop: every bind epoch-fenced
+        against the shared lease record. Hash/race pass through (the
+        strict CAS bind is their arbitration)."""
+        if self.leases is None:
+            return client
+        return _fenced_client(client, self.leases, self.partitions)
+
+    def _target_share(self) -> int:
+        return -(-self.partitions // self.replica_count)        # ceil
+
+    def tick(self, sched: Any) -> None:
+        """One membership round, called from the scheduler's loop: renew/
+        acquire/release leases at the declared fair share and — when the
+        owned set changed — re-adopt the pending pods that now fall to
+        this replica (their informer events were filtered away while a
+        previous owner held them; ``queue.add`` dedupes re-deliveries).
+        Hash mode is static: the initial informer list already delivered
+        this rank's backlog, including after a supervisor respawn."""
+        if self.leases is None:
+            return
+        changed = self.leases.tick(self._target_share())
+        prom = sched.metrics.prom
+        prom.federation_partitions_owned.labels(
+            self.mode, self.replica_id
+        ).set(len(self.leases.owned()))
+        if not changed:
+            return
+        from ..client.informers import PODS
+
+        try:
+            items, _rv = self.store.list(PODS)
+        except Exception:
+            return          # transient: the next tick retries
+        for key, pod in items:
+            if getattr(pod, "node_name", ""):
+                continue
+            if self._owns(key):
+                sched.on_pod_add(pod)
+
+    def release(self) -> None:
+        if self.leases is not None:
+            self.leases.release_all()
+
+
 def _fenced_client(client: Any, leases: PartitionLeaseManager,
                    partitions: int):
     """Wrap a store client so every bind is epoch-fenced against the

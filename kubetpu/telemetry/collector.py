@@ -544,7 +544,7 @@ class CollectorServer:
             def _handle(self, method: str) -> None:
                 parts = urlsplit(self.path)
                 path = parts.path
-                if method == "GET" and path == "/healthz":
+                if method == "GET" and path in ("/healthz", "/readyz"):
                     self._send(200, "text/plain; charset=utf-8", "ok\n")
                     return
                 if method == "GET" and path == "/metrics":

@@ -1,0 +1,18 @@
+"""Known-bad fixture for PS001: bare subprocess.Popen outside the launch
+seam, through every import shape the alias resolution must catch."""
+
+import subprocess
+import subprocess as sp
+from subprocess import Popen as launch_proc
+
+
+def spawn_plain():
+    return subprocess.Popen(["sleep", "60"])  # expect: PS001
+
+
+def spawn_aliased_module():
+    return sp.Popen(["python", "-m", "kubetpu", "apiserver"])  # expect: PS001
+
+
+def spawn_from_import():
+    return launch_proc(["kubetpu", "scheduler"])  # expect: PS001

@@ -1,0 +1,20 @@
+"""Known-good fixture for PS001: bounded one-shot probes via
+subprocess.run are fine (the kubetpu.native compiler-probe shape), and
+long-lived children go through the launch seam."""
+
+import subprocess
+
+
+def bounded_probe() -> bool:
+    # run() is reaped and bounded — not a long-lived child; out of scope
+    proc = subprocess.run(
+        ["python", "-c", "import jax"], capture_output=True, timeout=60,
+    )
+    return proc.returncode == 0
+
+
+def spawn_through_the_seam(spec):
+    from kubetpu.launch import Supervisor
+
+    sup = Supervisor()
+    return sup.spawn(spec)

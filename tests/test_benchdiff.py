@@ -121,6 +121,62 @@ def test_federation_records_pass_against_themselves(tmp_path):
     assert main([rec, rec]) == 0
 
 
+def test_mp_records_pass_against_themselves(tmp_path):
+    """The PR-13 acceptance gate: the multi-process ladder's records —
+    per-N rows with child stats, FederationScaling_mp_* speedup lines,
+    FederationRecovery_mp_*, WireCodecComparison_mp_* — diffed against
+    themselves are regression-free (the pinned-green self-diff)."""
+    lines = [
+        _line("SchedulingBasic_500Nodes_greedy_mp_2sched_race",
+              600.0, conflict_rate=0.35, replicas=2, partition="race",
+              binding_parity=1000, n_processes=3, restarts=0,
+              child_stats={"apiserver": {"peak_rss_bytes": 120000000,
+                                         "cpu_seconds": 2.1}}),
+        {"metric": ("FederationScaling_mp_SchedulingBasic_500Nodes_"
+                    "race_2sched"),
+         "unit": "ratio", "value": 1.3, "throughput_speedup": 1.3,
+         "conflict_rate": 0.35, "binding_parity": 1000, "n_processes": 3},
+        {"metric": ("FederationRecovery_mp_SchedulingBasic_500Nodes_"
+                    "hash_2sched"),
+         "unit": "s", "value": 2.5, "recovery_s": 2.5, "restarts": 1,
+         "binding_parity": 1000, "all_rescheduled": True},
+        {"metric": ("WireCodecComparison_mp_SchedulingBasic_"
+                    "5000Nodes_1000Pods_greedy"),
+         "unit": "ratio", "value": 1.8, "throughput_speedup": 1.8,
+         "wire_bytes_reduction": 0.66, "watch_fanout": 200,
+         "n_processes": 7},
+    ]
+    rec = _write(tmp_path, "mp.json", lines)
+    assert main([rec, rec]) == 0
+
+
+def test_throughput_speedup_regression_gates(tmp_path, capsys):
+    def sp(v):
+        return {"metric": "FederationScaling_mp_A_race_4sched",
+                "unit": "ratio", "value": v, "throughput_speedup": v}
+
+    old = _write(tmp_path, "old.json", [sp(2.0)])
+    ok = _write(tmp_path, "ok.json", [sp(1.9)])    # small shrink: noise
+    bad = _write(tmp_path, "bad.json", [sp(1.0)])  # halved: the real thing
+    assert main([old, ok]) == 0
+    rc = main([old, bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "throughput_speedup" in out and "REGRESSION" in out
+
+
+def test_throughput_speedup_flat_curve_wobble_never_gates(tmp_path):
+    # 1.02 -> 0.97: a flat mp curve on a loaded host — a big relative
+    # fraction of nothing, under the absolute floor
+    def sp(v):
+        return {"metric": "FederationScaling_mp_A_race_2sched",
+                "unit": "ratio", "value": v, "throughput_speedup": v}
+
+    old = _write(tmp_path, "old.json", [sp(1.02)])
+    new = _write(tmp_path, "new.json", [sp(0.97)])
+    assert main([old, new]) == 0
+
+
 def test_conflict_rate_regression_gates(tmp_path, capsys):
     old = _write(tmp_path, "old.json", [
         _fed_line("FederationScaling_A_race_2sched", 1.4, 0.30),

@@ -86,7 +86,7 @@ def test_every_checker_registered_and_documented():
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
-        "WP001", "WL001", "TR003",
+        "WP001", "WL001", "TR003", "PS001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -119,7 +119,7 @@ def test_fixture_violations_match_markers_exactly():
     "lock_good.py", "ops/jit_good.py", "sched/donate_good.py",
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
-    "wal_good.py", "trace_good.py",
+    "wal_good.py", "trace_good.py", "proc_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -227,6 +227,39 @@ def test_wal_checker_covers_the_store_wrapper_not_the_replay_side():
         and n.func.attr in ("create", "update", "delete")
     ]
     assert mutations, "_commit_locked no longer mutates the core"
+
+
+def test_proc_checker_covers_kubetpu_but_not_the_launch_seam():
+    """PS001 (process-spawn seam discipline) walks all of kubetpu/ — the
+    modules that historically grew ad-hoc subprocess harnesses (perf,
+    cli, bench entry points) included — and does NOT walk the seam
+    itself. Pinned against the ACTUAL walk, and against the seam still
+    SPAWNING: a supervisor refactored away from Popen would leave PS001
+    guarding air while nothing in the repo could start a child."""
+    res = _repo_result()
+    covered = set(res.coverage.get("PS001", ()))
+    for f in (
+        "kubetpu/perf/runner.py",
+        "kubetpu/cli.py",
+        "kubetpu/launch/cluster.py",    # topology builds specs, never spawns
+        "kubetpu/native/__init__.py",   # run() probes stay in scope (and ok)
+    ):
+        assert f in covered, f"PS001 no longer covers {f}"
+    assert "kubetpu/launch/supervisor.py" not in covered, (
+        "PS001 wrongly covers the spawn seam itself"
+    )
+    # the seam still spawns: supervisor.py really calls subprocess.Popen
+    src = open(
+        os.path.join(REPO, "kubetpu", "launch", "supervisor.py"),
+        encoding="utf-8",
+    ).read()
+    tree = ast.parse(src)
+    popens = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "Popen"
+    ]
+    assert popens, "supervisor.py lost its Popen — PS001 guards air"
 
 
 def test_trace_checker_covers_handlers_and_dispatcher():

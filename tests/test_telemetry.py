@@ -10,8 +10,6 @@ replicas' labeled series."""
 import json
 import os
 import re
-import subprocess
-import sys
 import time
 import urllib.request
 
@@ -623,57 +621,32 @@ def test_explain_fetches_from_the_collector(capsys):
 
 
 # ---------------------------------------------------------------------------
-# the multi-process smoke: the ROADMAP-1 slice
+# the multi-process smoke: the ROADMAP-1 slice, on the PR-13 launch
+# subsystem — the tier-1 smoke and the mp bench ladder exercise the SAME
+# spawn/banner/readiness/cascade code (kubetpu.launch.Supervisor)
 # ---------------------------------------------------------------------------
-
-def _spawn(args, **kw):
-    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONUNBUFFERED="1")
-    return subprocess.Popen(
-        [sys.executable, "-m", "kubetpu", *args],
-        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        env=env, cwd=REPO, **kw,
-    )
-
-
-def _read_url(proc, pattern, timeout_s=60.0):
-    """First stdout line matching ``pattern`` (the serving banner)."""
-    deadline = time.monotonic() + timeout_s
-    while time.monotonic() < deadline:
-        line = proc.stdout.readline()
-        if not line:
-            if proc.poll() is not None:
-                raise AssertionError(
-                    f"process died (rc={proc.returncode}) before banner"
-                )
-            time.sleep(0.05)
-            continue
-        m = re.search(pattern, line)
-        if m:
-            return m.group(1)
-    raise AssertionError("no serving banner before timeout")
-
 
 def test_multiprocess_stitched_trace_and_federated_scrape():
     """THE acceptance smoke: apiserver + 2 scheduler replicas as real OS
-    processes, all exporting to one collector. A single pod's spans must
-    cross all three processes in the merged trace with skew-corrected,
-    monotonically ordered stage boundaries (ingest ≤ scheduler bind ≤
-    apiserver bind-subresource), and the federated /metrics must carry
-    BOTH replicas' labeled series."""
+    processes under the launch Supervisor, all exporting to one
+    collector. A single pod's spans must cross all three processes in the
+    merged trace with skew-corrected, monotonically ordered stage
+    boundaries (ingest ≤ scheduler bind ≤ apiserver bind-subresource),
+    and the federated /metrics must carry BOTH replicas' labeled
+    series."""
+    from kubetpu.launch import Supervisor, apiserver_spec, scheduler_spec
+
     coll = CollectorServer().start()
-    procs = []
+    sup = Supervisor(env={"JAX_PLATFORMS": "cpu"}, cwd=REPO)
     try:
-        api = _spawn([
-            "apiserver", "--port", "0", "--telemetry", coll.url,
-        ])
-        procs.append(api)
-        api_url = _read_url(api, r"serving on (http://[0-9.:]+)")
+        api = sup.spawn(apiserver_spec(telemetry=coll.url))
+        api_url = api.url()
+        assert api_url, api.banner    # the banner carries the real port
         for rid in ("r0", "r1"):
-            procs.append(_spawn([
-                "scheduler", "--server", api_url,
-                "--replica-id", rid, "--telemetry", coll.url,
-                "--diagnostics-port", "0",
-            ]))
+            sup.spawn(scheduler_spec(
+                name=f"scheduler-{rid}", server=api_url,
+                replica_id=rid, telemetry=coll.url,
+            ))
         remote = RemoteStore(api_url)
         for i in range(4):
             node = make_node(f"n{i}", cpu_milli=64000, pods=110)
@@ -692,10 +665,9 @@ def test_multiprocess_stitched_trace_and_federated_scrape():
             bound = [o for _k, o in items if o.node_name]
             if len(bound) == n_pods:
                 break
-            for p in procs:
-                assert p.poll() is None, (
-                    f"component died: rc={p.returncode}\n"
-                    + (p.stdout.read() or "")[-4000:]
+            for child in sup.children:
+                assert child.alive(), (
+                    f"{child.name} died: {child.tail()}"
                 )
             time.sleep(0.25)
         assert len(bound) == n_pods, f"only {len(bound)}/{n_pods} bound"
@@ -769,11 +741,8 @@ def test_multiprocess_stitched_trace_and_federated_scrape():
         # nothing was dropped: the merged trace is complete evidence
         assert coll.collector.spans_dropped == 0
     finally:
-        for p in procs:
-            p.terminate()
-        for p in procs:
-            try:
-                p.wait(timeout=10)
-            except subprocess.TimeoutExpired:
-                p.kill()
+        # the supervisor's SIGTERM cascade replaces the hand-rolled
+        # terminate/wait/kill loop this test used to carry
+        sup.shutdown()
         coll.close()
+    assert not any(c.alive() for c in sup.children), "orphaned child"
