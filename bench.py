@@ -175,6 +175,44 @@ MP_WIRE_FANOUT = 200
 MP_WIRE_FANOUT_PROCS = 4
 MP_WIRE_BUDGET_S = 900.0
 
+# --- scale frontier: trace-shaped workloads (ROADMAP item 5) ----------------
+# Seeded deterministic traces (perf.workloads.TRACE_PROFILES) replayed
+# against the real loop in DIRECT mode: diurnal arrivals + flash-crowd
+# bursts, autoscaler node add/drain waves (append-incremental encode +
+# scoped cache extension + incremental reshard), rolling-update trains, and
+# the mixed multi-tenant profile — each record carries admission_p99_ms vs
+# its declared SLO budget, peak_rss_bytes, encode-cache hit rate and the
+# re-encode accounting, all benchdiff-gated. The 50k/100k rungs are the
+# first bench evidence past 15k nodes; every rung has a HARD wall budget —
+# a rung that blows it emits a TRUNCATED but parseable record instead of
+# eating the bench wall (benchdiff flags newly-truncated stages).
+# (profile, suffix, {nodes + param overrides}, max_batch, engine, wall_s)
+TRACE_STAGES = [
+    ("diurnal-burst", "5k", dict(nodes=5000), 128, "greedy", 180.0),
+    ("node-wave", "5k", dict(nodes=5000, wave_nodes=512, ramp_s=3.0),
+     128, "greedy", 180.0),
+    ("rolling-update", "2k", dict(nodes=2000), 128, "greedy", 150.0),
+    ("multitenant", "2k", dict(nodes=2000), 128, "greedy", 180.0),
+    # the scale rungs: 50k direct (burst + node-wave — the acceptance
+    # pair), then the 100k attempt (expected to brush its wall on small
+    # hosts; the truncated record is the honest evidence). Budgets are
+    # per-RUNG, calibrated ~2x this host's first measured p99 so slo_ok
+    # flags real decay, not run noise
+    ("diurnal-burst", "50k",
+     dict(nodes=50000, duration_s=20.0, base_rate=15.0, peak_rate=80.0,
+          bursts=2, burst_pods=100, slo_budget_ms=8000.0),
+     128, "greedy", 420.0),
+    ("node-wave", "50k",
+     dict(nodes=50000, duration_s=20.0, pod_rate=25.0, waves=1,
+          wave_nodes=1000, ramp_s=4.0, slo_budget_ms=6000.0),
+     128, "greedy", 420.0),
+    ("diurnal-burst", "100k",
+     dict(nodes=100000, duration_s=15.0, base_rate=10.0, peak_rate=50.0,
+          bursts=1, burst_pods=100, slo_budget_ms=12000.0),
+     128, "greedy", 420.0),
+]
+TRACE_BUDGET_S = 1500.0
+
 # --- telemetry plane (kubetpu.telemetry) ------------------------------------
 # The <5% overhead budget for the FULL telemetry plane — collector over
 # HTTP, traceparent on every RPC, 1 s export cadence from both processes —
@@ -873,6 +911,8 @@ def _mp_record(r, case: str, workload: str, engine: str,
         out["wire_bytes_per_pod"] = round(r.wire_bytes_per_pod, 1)
     if r.watch_fanout:
         out["watch_fanout"] = r.watch_fanout
+    if r.lease_transitions:
+        out["lease_transitions"] = r.lease_transitions
     if r.recovery_s is not None:
         out["recovery_s"] = round(r.recovery_s, 3)
     return out
@@ -948,6 +988,67 @@ def _run_mp_federation_stages() -> None:
         else:
             scaling["value"] = None
         _emit(scaling)
+    # lease-mode rung (ROADMAP item 1b): the SAME workload with the pod
+    # keyspace partitioned by store-backed epoch-fenced leases across 2
+    # REAL scheduler processes — measures the lease-handover cost (lease
+    # acquisition/renewal riding the shared store) side by side with the
+    # race/hash rungs above; conflict_rate should be ~0 (fenced keyspaces
+    # don't race) and the delta vs the 2sched race rung is the price of
+    # coordination
+    if time.perf_counter() - t0 <= MP_FEDERATION_BUDGET_S:
+        _status("mp federation stage: 2 scheduler processes, lease "
+                "partition (handover-cost rung)")
+        metric = f"{case}_{workload}_{engine}_mp_2sched_lease"
+        try:
+            r = run_workload_multiprocess(
+                case, workload, replicas=2, partition="lease",
+                engine=engine, max_batch=max_batch,
+                timeout_s=STAGE_TIMEOUT_S, child_env=MP_CHILD_ENV,
+            )
+            line = _mp_record(r, case, workload, engine, metric)
+            _emit(line)
+            scaling = {
+                "metric": (
+                    f"FederationScaling_mp_{case}_{workload}_lease_2sched"
+                ),
+                "unit": "ratio",
+                "mode": "multiprocess",
+                "replicas": 2,
+                "partition": "lease",
+                "backend": "cpu",
+                "throughput": line["value"],
+                "conflicts": line["conflicts"],
+                "conflict_rate": line["conflict_rate"],
+                "lease_transitions": line.get("lease_transitions", 0),
+                "binding_parity": line["binding_parity"],
+                "measure_pods": line["measure_pods"],
+                "n_processes": line["n_processes"],
+            }
+            if base and base.get("value"):
+                scaling["value"] = round(line["value"] / base["value"], 3)
+                scaling["throughput_speedup"] = scaling["value"]
+                scaling["baseline_throughput"] = base["value"]
+                race2 = ladder.get(2)
+                if race2 and race2.get("value"):
+                    # the handover cost headline: lease vs race at N=2
+                    scaling["vs_race_2sched"] = round(
+                        line["value"] / race2["value"], 3
+                    )
+            else:
+                scaling["value"] = None
+            _emit(scaling)
+            _status(f"mp lease rung done: {metric} = {line['value']} "
+                    f"pods/s (lease_transitions="
+                    f"{line.get('lease_transitions', 0)})")
+        except Exception as e:
+            _emit({
+                "metric": metric, "value": 0.0, "unit": "pods/s",
+                "vs_baseline": 0.0, "engine": engine,
+                "mode": "multiprocess", "backend": "cpu", "replicas": 2,
+                "partition": "lease",
+                "error": f"{type(e).__name__}: {e}",
+            })
+            _status(f"mp lease rung FAILED: {e}")
     # recovery stage: 2 scheduler processes, hash partition (static ranks
     # — the SUPERVISOR answers the death: SIGKILL at 50% of the measured
     # pods, the restart policy respawns the victim, the respawned process
@@ -1134,6 +1235,78 @@ def _run_durability_stages() -> None:
             "error": f"{type(e).__name__}: {e}",
         })
         _status(f"durability stage FAILED: {e}")
+
+
+def _run_trace_stages() -> None:
+    """The scale-frontier ladder (see TRACE_STAGES): one record per rung
+    plus one AdmissionSLO_* line (p99 enqueue→bind vs the profile's
+    declared budget — the benchdiff-gated SLO evidence)."""
+    from kubetpu.perf.runner import run_workload_trace
+    from kubetpu.perf.workloads import TRACE_PROFILES
+
+    t0 = time.perf_counter()
+    for name, suffix, overrides, max_batch, engine, wall in TRACE_STAGES:
+        elapsed = time.perf_counter() - t0
+        if elapsed > TRACE_BUDGET_S:
+            _status(f"trace budget exhausted; skipping {name}-{suffix}")
+            continue
+        ov = dict(overrides)
+        nodes = ov.pop("nodes", None)
+        prof = TRACE_PROFILES[name].scaled(suffix, nodes=nodes, **ov)
+        metric = f"Trace_{prof.name}_{prof.nodes}Nodes_{engine}"
+        _status(f"trace stage: {prof.name} nodes={prof.nodes} "
+                f"wall_budget={wall:.0f}s (t={elapsed:.0f}s)")
+        t_stage = time.perf_counter()
+        try:
+            r = run_workload_trace(
+                prof, mode="direct", engine=engine, max_batch=max_batch,
+                timeout_s=wall + 120.0, wall_budget_s=wall,
+            )
+        except Exception as e:
+            _emit({
+                "metric": metric, "value": 0.0, "unit": "pods/s",
+                "engine": engine, "mode": "trace-direct",
+                "backend": _backend(), "slo_budget_ms": prof.slo_budget_ms,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            _status(f"trace stage FAILED: {prof.name}: {e}")
+            continue
+        j = r.to_json()
+        for drop in ("case", "workload", "metric"):
+            j.pop(drop, None)
+        line = {
+            "metric": metric,
+            "unit": "pods/s",
+            "engine": engine,
+            "mode": "trace-direct",
+            "backend": _backend(),
+            "nodes": prof.nodes,
+            "wall_s": round(time.perf_counter() - t_stage, 1),
+            **j,
+        }
+        _emit(line)
+        _status(
+            f"trace stage done: {metric} = {line['value']} pods/s "
+            f"(admission_p99={line.get('admission_p99_ms')}ms vs "
+            f"{prof.slo_budget_ms}ms budget, "
+            f"rss={line.get('peak_rss_bytes', 0) // (1024**2)}MB"
+            f"{', TRUNCATED' if line.get('truncated') else ''})"
+        )
+        _emit({
+            "metric": f"AdmissionSLO_{prof.name}_{prof.nodes}Nodes",
+            "unit": "ms",
+            "value": line.get("admission_p99_ms"),
+            "admission_p99_ms": line.get("admission_p99_ms"),
+            "admission_p50_ms": line.get("admission_p50_ms"),
+            "slo_budget_ms": prof.slo_budget_ms,
+            "slo_ok": line.get("slo_ok"),
+            "peak_rss_bytes": line.get("peak_rss_bytes"),
+            "truncated": line.get("truncated", False),
+            "scheduled": line.get("scheduled"),
+            "nodes": prof.nodes,
+            "backend": _backend(),
+            "mode": "trace-direct",
+        })
 
 
 def _run_telemetry_stages() -> None:
@@ -1327,6 +1500,10 @@ def main() -> None:
     _emit_sharding_comparisons(mesh_pairs)
     _emit_flightrecorder_comparisons(fr_pairs)
     _emit_soak_lines(all_lines)
+    # the scale-frontier trace ladder right after the judged in-process
+    # rows: its own budget, and every rung is wall-capped so the 100k
+    # attempt can never eat the later ladders
+    _run_trace_stages()
     _run_wire_stages()
     _run_federation_stages()
     _run_durability_stages()

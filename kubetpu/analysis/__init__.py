@@ -22,6 +22,8 @@ Checker catalog (``--explain CODE`` prints the full rationale):
 - WL001              WAL append-seam discipline for store-core mutations
 - PS001              process-spawn seam discipline — long-lived children
                      only through the launch supervisor
+- EC001              encode-cache invalidation scope — bare full-epoch
+                     flushes only in the blessed node-event handlers
 - TR003              telemetry span coverage — apiserver handlers and
                      dispatcher call executors run under a span
 
@@ -53,3 +55,4 @@ from . import wirecheck  # noqa: F401,E402
 from . import walcheck  # noqa: F401,E402
 from . import tracecheck  # noqa: F401,E402
 from . import proccheck  # noqa: F401,E402
+from . import cachecheck  # noqa: F401,E402

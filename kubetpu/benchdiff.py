@@ -49,6 +49,15 @@ Comparison rules (per metric name present in BOTH records):
   ``old * (1 + wal_tol)`` AND grew by more than ``min_wal_delta``
   absolute (host-noise wobble on a cheap WAL never gates; a durability
   hot path that started copying per watcher does).
+- **admission SLO** (``admission_p99_ms`` on trace records): a stage that
+  WAS within its declared ``slo_budget_ms`` and now violates it always
+  gates; within-budget drift gates on the p99-style relative+absolute
+  rule (``admission_tol`` / ``min_admission_delta_ms``).
+- **peak RSS** (``peak_rss_bytes``): regression only when BOTH +50%
+  relative AND >256MB absolute — host allocator noise never gates, a
+  node-axis layout that regressed into gigabytes at 100k nodes does.
+- a stage ``truncated`` in new but not old (newly blew its wall budget)
+  is a regression;
 - a metric that ERRORED in new but not old is always a regression;
   improvements and within-tolerance moves report as ok; metrics present
   in only one record are listed but never gate (the ladder's stage lists
@@ -93,6 +102,17 @@ MIN_WAL_DELTA = 0.10
 #: shared-host wobble inside it never gates
 TELEMETRY_TOL = 0.50
 MIN_TELEMETRY_DELTA = 0.05
+#: admission-latency SLO (admission_p99_ms on trace records): the primary
+#: gate is the record's own declared budget (slo_budget_ms) — a stage that
+#: WAS within budget and now violates it regresses regardless of relative
+#: noise; on top of that, the p99-style relative rule catches large
+#: within-budget drift
+ADMISSION_TOL = 0.50
+MIN_ADMISSION_DELTA_MS = 50.0
+#: peak RSS is host-noise-prone (allocator, import order): gate only a
+#: move that is BOTH +50% relative AND >256MB absolute
+RSS_TOL = 0.50
+MIN_RSS_DELTA_BYTES = 256 * 1024 * 1024
 
 
 class BenchDiffError(ValueError):
@@ -201,6 +221,10 @@ def compare(
     min_wal_delta: float = MIN_WAL_DELTA,
     telemetry_tol: float = TELEMETRY_TOL,
     min_telemetry_delta: float = MIN_TELEMETRY_DELTA,
+    admission_tol: float = ADMISSION_TOL,
+    min_admission_delta_ms: float = MIN_ADMISSION_DELTA_MS,
+    rss_tol: float = RSS_TOL,
+    min_rss_delta_bytes: float = MIN_RSS_DELTA_BYTES,
 ) -> tuple[list[Delta], list[str], list[str]]:
     """Returns (deltas over the common metrics, metrics only in old,
     metrics only in new)."""
@@ -310,6 +334,59 @@ def compare(
                     if bad else ""
                 ),
             ))
+        # admission-latency SLO (trace records): budget violation is the
+        # primary rule — a stage that WAS within its declared budget and
+        # now violates it gates regardless of relative tolerance; large
+        # within-budget drift gates via the p99-style relative rule
+        oa, na_ = o.get("admission_p99_ms"), n.get("admission_p99_ms")
+        if isinstance(oa, (int, float)) and isinstance(na_, (int, float)):
+            obud, nbud = o.get("slo_budget_ms"), n.get("slo_budget_ms")
+            entered_violation = (
+                isinstance(nbud, (int, float)) and na_ > nbud
+                and not (isinstance(obud, (int, float)) and oa > obud)
+            )
+            drifted = (
+                na_ > oa * (1.0 + admission_tol)
+                and (na_ - oa) > min_admission_delta_ms
+            )
+            bad = entered_violation or drifted
+            note = ""
+            if entered_violation:
+                note = f"[violates SLO budget {nbud:g}ms]"
+            elif drifted:
+                note = (
+                    f"[tol +{admission_tol:.0%} & "
+                    f">{min_admission_delta_ms:g}ms]"
+                )
+            deltas.append(Delta(
+                name, "admission_p99_ms", float(oa), float(na_), bad,
+                note=note,
+            ))
+        # peak RSS: both +50% relative AND >256MB absolute (host noise on
+        # small stages never gates; a 100k-node rung whose node-axis
+        # layout regressed into gigabytes does)
+        orss, nrss = o.get("peak_rss_bytes"), n.get("peak_rss_bytes")
+        if isinstance(orss, (int, float)) and isinstance(nrss, (int, float)):
+            bad = (
+                nrss > orss * (1.0 + rss_tol)
+                and (nrss - orss) > min_rss_delta_bytes
+            )
+            deltas.append(Delta(
+                name, "peak_rss_bytes", float(orss), float(nrss), bad,
+                note=(
+                    f"[tol +{rss_tol:.0%} & "
+                    f">{min_rss_delta_bytes / (1024**2):g}MB]"
+                    if bad else ""
+                ),
+            ))
+        # a stage that finished in old but TRUNCATED in new stopped making
+        # its wall budget — that is a slowdown, not noise
+        otr, ntr = bool(o.get("truncated")), bool(n.get("truncated"))
+        if ntr and not otr:
+            deltas.append(Delta(
+                name, "truncated", 0.0, 1.0, True,
+                note="[stage newly exceeded its wall budget]",
+            ))
         # a span drop in the new record is a telemetry-evidence loss, not
         # noise: the merged trace undercounts — flag it whenever the old
         # record's stage ran clean
@@ -379,6 +456,22 @@ def main(argv=None) -> int:
                     help="absolute telemetry-overhead growth floor below "
                          f"which it never gates (default "
                          f"{MIN_TELEMETRY_DELTA})")
+    ap.add_argument("--admission-tol", type=float, default=ADMISSION_TOL,
+                    help="fractional admission-p99 growth tolerated for "
+                         "within-budget drift (budget violations always "
+                         f"gate; default {ADMISSION_TOL})")
+    ap.add_argument("--min-admission-delta-ms", type=float,
+                    default=MIN_ADMISSION_DELTA_MS,
+                    help="absolute admission-p99 growth floor below which "
+                         "within-budget drift never gates (default "
+                         f"{MIN_ADMISSION_DELTA_MS})")
+    ap.add_argument("--rss-tol", type=float, default=RSS_TOL,
+                    help="fractional peak-RSS growth tolerated "
+                         f"(default {RSS_TOL})")
+    ap.add_argument("--min-rss-delta-bytes", type=float,
+                    default=MIN_RSS_DELTA_BYTES,
+                    help="absolute peak-RSS growth floor below which it "
+                         f"never gates (default {MIN_RSS_DELTA_BYTES:g})")
     ap.add_argument("--json", action="store_true",
                     help="machine-readable report on stdout")
     args = ap.parse_args(argv)
@@ -403,6 +496,10 @@ def main(argv=None) -> int:
         min_wal_delta=args.min_wal_delta,
         telemetry_tol=args.telemetry_tol,
         min_telemetry_delta=args.min_telemetry_delta,
+        admission_tol=args.admission_tol,
+        min_admission_delta_ms=args.min_admission_delta_ms,
+        rss_tol=args.rss_tol,
+        min_rss_delta_bytes=args.min_rss_delta_bytes,
     )
     regressions = [d for d in deltas if d.regression]
     if args.json:

@@ -409,18 +409,24 @@ class ResidentNodeState:
             rows = self._reshard_rows(nt, num_nodes)
             if rows is None:
                 return self._full_upload(nt, num_nodes)
-        elif pending is None or self._num_nodes != num_nodes:
-            # same tensors object but no delta bookkeeping (or a real-node
-            # count drift, which a node-set change should have rebuilt
-            # away): be safe, not clever
+        elif pending is None:
+            # same tensors object but no delta bookkeeping: be safe
             return self._full_upload(nt, num_nodes)
         else:
-            if not pending:
+            rows_set = set(pending)
+            if self._num_nodes != num_nodes:
+                # the append-incremental encode grew the node count IN
+                # PLACE (same tensors object): the boundary rows flip
+                # validity and ride the same delta scatter as any dirty
+                # row — an add-wave must not force a full re-upload
+                lo, hi = sorted((self._num_nodes, num_nodes))
+                rows_set.update(range(lo, hi))
+            if not rows_set:
                 self.last_upload_bytes = 0
                 self.last_upload_bytes_per_shard = [0] * self._n_shards
                 self.last_rows_per_shard = [0] * self._n_shards
                 return self.device
-            rows = sorted(pending)
+            rows = sorted(rows_set)
         nt.pending_device_rows = set()
         self._nt_token = nt
         if not rows:
@@ -744,8 +750,8 @@ def encode_batch_static(
     multiples of 8, so this only bites past 8 shards on tiny clusters)."""
     N, P = snapshot.num_nodes(), len(pods)
     NP = enc.round_up(N) if pad else N
-    if pad and pad_multiple > 1:
-        NP = (NP + pad_multiple - 1) // pad_multiple * pad_multiple
+    if pad:
+        NP = enc.shard_aligned(NP, pad_multiple)
     PP = enc.round_up(P) if pad else P
     folded: frozenset = frozenset()
     if resource_names is None:
@@ -900,14 +906,17 @@ def encode_batch_static(
 def refresh_static(sb: StaticBatch, snapshot: Snapshot) -> bool:
     """Re-encode the node resource rows of a pre-encoded StaticBatch on its
     own axis (stage-2 entry: fold in the assumes that landed since stage 1).
-    Returns False when the incremental encode could not keep the same
-    NodeTensors (node set/order changed) — the StaticBatch is then unusable
-    and the caller must re-encode from scratch."""
+    Returns False when the node SET changed since stage 1 — the StaticBatch
+    is then unusable (its num_nodes/node_valid/static_mask are pinned at
+    the stage-1 node count) and the caller must re-encode from scratch.
+    Object identity alone no longer detects that: the append-incremental
+    encoder extends the SAME NodeTensors in place on a pure node add, so
+    the node count is checked explicitly."""
     nt = enc.encode_snapshot(
         snapshot, resource_names=sb.resource_names, pods=(),
         pad_nodes=sb.pad_nodes, prev=sb.nt,
     )
-    if nt is not sb.nt:
+    if nt is not sb.nt or nt.num_nodes != sb.num_nodes:
         return False
     if nt.last_dirty_rows:
         # node accounting moved (the assumes this refresh folds in) — the

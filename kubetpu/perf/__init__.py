@@ -8,12 +8,21 @@ from .runner import (
     run_workload_federated,
     run_workload_full_stack,
     run_workload_multiprocess,
+    run_workload_trace,
 )
-from .workloads import TEST_CASES, TestCase, Workload
+from .workloads import (
+    TEST_CASES,
+    TRACE_PROFILES,
+    TestCase,
+    TraceProfile,
+    Workload,
+)
 
 __all__ = [
     "TEST_CASES",
+    "TRACE_PROFILES",
     "TestCase",
+    "TraceProfile",
     "Workload",
     "WorkloadResult",
     "run_label",
@@ -21,4 +30,5 @@ __all__ = [
     "run_workload_federated",
     "run_workload_full_stack",
     "run_workload_multiprocess",
+    "run_workload_trace",
 ]

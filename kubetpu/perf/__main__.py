@@ -119,6 +119,21 @@ def main(argv=None) -> None:
                          "cycle trace as Perfetto-loadable Chrome-trace "
                          "JSON, a /metrics snapshot, and the device-side "
                          "per-cycle counter records (joined by cycle id)")
+    ap.add_argument("--trace", default=None, metavar="PROFILE",
+                    help="replay a trace-shaped workload profile "
+                         "(perf.workloads.TRACE_PROFILES; see --list) "
+                         "instead of an op-list case: the record carries "
+                         "admission_p99_ms vs the profile's SLO budget, "
+                         "peak_rss_bytes, and the encode-cache re-encode "
+                         "accounting. Honors --fullstack/--engine/"
+                         "--max-batch/--wire")
+    ap.add_argument("--trace-nodes", type=int, default=None,
+                    help="override the trace profile's initial node count "
+                         "(the 50k/100k scale-frontier rungs)")
+    ap.add_argument("--trace-wall-budget", type=float, default=None,
+                    help="hard wall budget (s) for the trace stage: past "
+                         "it the replay stops and emits a TRUNCATED but "
+                         "parseable record")
     args = ap.parse_args(argv)
 
     if args.list:
@@ -126,6 +141,31 @@ def main(argv=None) -> None:
             for wl in case.workloads:
                 extra = f" threshold={wl.threshold}" if wl.threshold else ""
                 print(f"{case.name}/{wl.name}{extra} {list(wl.labels)}")
+        from .workloads import TRACE_PROFILES
+
+        for tp in TRACE_PROFILES.values():
+            print(f"trace:{tp.name} nodes={tp.nodes} "
+                  f"slo={tp.slo_budget_ms}ms — {tp.description}")
+        return
+
+    if args.trace:
+        from . import TRACE_PROFILES, run_workload_trace
+
+        tp = TRACE_PROFILES[args.trace]
+        if args.trace_nodes is not None:
+            tp = tp.scaled(f"{args.trace_nodes}n", nodes=args.trace_nodes)
+        r = run_workload_trace(
+            tp,
+            mode=("fullstack" if args.fullstack else "direct"),
+            engine=args.engine,
+            max_batch=args.max_batch,
+            timeout_s=args.timeout,
+            wall_budget_s=args.trace_wall_budget,
+            encode_cache=(args.encode_cache == "on"),
+            wire=args.wire,
+            artifacts_dir=args.artifacts_dir,
+        )
+        print(json.dumps(r.to_json()))
         return
 
     kwargs = dict(

@@ -21,6 +21,7 @@ util.go:468 samples scheduled-pod deltas every second and averages).
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 
@@ -147,6 +148,24 @@ class WorkloadResult:
     n_processes: int = 0
     child_stats: dict | None = None
     restarts: int = 0
+    # --- trace-shaped workloads (run_workload_trace) ---------------------
+    # admission-latency SLO: p50/p99 of enqueue→bind over every pod the
+    # trace created, judged against the profile's declared budget — the
+    # scale-frontier metric benchdiff gates (slo_ok = p99 <= budget)
+    admission_p50_ms: float | None = None
+    admission_p99_ms: float | None = None
+    slo_budget_ms: float | None = None
+    slo_ok: bool | None = None
+    # host-memory ceiling of the stage: max RSS sampled per cycle during
+    # the measured window (benchdiff gates +50% AND >256MB absolute)
+    peak_rss_bytes: int = 0
+    # the stage hit its wall budget and emitted a TRUNCATED-but-parseable
+    # record instead of eating the whole bench wall (the 100k-node rungs)
+    truncated: bool = False
+    # trace bookkeeping: events replayed / pods created / deleted by the
+    # trace / still unbound at the end / node count when it finished, and
+    # the encode-cache re-encode accounting (scoped-invalidation evidence)
+    trace_stats: dict | None = None
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -224,6 +243,21 @@ class WorkloadResult:
                 out["lease_transitions"] = self.lease_transitions
             if self.recovery_s is not None:
                 out["recovery_s"] = round(self.recovery_s, 3)
+        if self.admission_p99_ms is not None:
+            out["admission_p99_ms"] = round_latency_ms(self.admission_p99_ms)
+            if self.admission_p50_ms is not None:
+                out["admission_p50_ms"] = round_latency_ms(
+                    self.admission_p50_ms
+                )
+        if self.slo_budget_ms is not None:
+            out["slo_budget_ms"] = self.slo_budget_ms
+            out["slo_ok"] = self.slo_ok
+        if self.peak_rss_bytes:
+            out["peak_rss_bytes"] = self.peak_rss_bytes
+        if self.truncated:
+            out["truncated"] = True
+        if self.trace_stats is not None:
+            out["trace"] = self.trace_stats
         if self.telemetry is not None:
             out["telemetry"] = self.telemetry
         if self.n_processes:
@@ -977,6 +1011,464 @@ def run_workload(
     )
     sched.close()
     return result
+
+
+class _RssSampler:
+    """Per-stage peak-RSS tracker: samples /proc/self/statm once per
+    scheduling cycle (a few µs) and keeps the max. Stage-local on purpose
+    — ru_maxrss is process-monotone and would attribute an earlier 100k
+    stage's peak to every later record."""
+
+    def __init__(self) -> None:
+        self.peak = 0
+        self._page = 4096
+        self._f = None
+        try:
+            self._page = os.sysconf("SC_PAGE_SIZE")
+            self._f = open("/proc/self/statm", "rb")
+        except (OSError, ValueError, AttributeError):
+            pass    # no procfs: sample() falls back to the monotone
+            #         ru_maxrss (coarser semantics beat a zero)
+
+    def sample(self) -> int:
+        if self._f is not None:
+            self._f.seek(0)
+            rss = int(self._f.read().split()[1]) * self._page
+        else:
+            import resource
+
+            rss = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        if rss > self.peak:
+            self.peak = rss
+        return rss
+
+    def close(self) -> None:
+        if self._f is not None:
+            self._f.close()
+
+
+class _TraceDirectDriver:
+    """Direct-mode I/O for the trace replay: events land straight on the
+    scheduler's informer handlers; bind times come off the in-process
+    client."""
+
+    def __init__(self, sched, client) -> None:
+        self.sched = sched
+        self.client = client
+        self._nodes: dict[str, t.Node] = {}
+
+    def add_node(self, node: t.Node) -> None:
+        self._nodes[node.name] = node
+        self.sched.on_node_add(node)
+
+    def drain_node(self, name: str) -> None:
+        node = self._nodes.pop(name, None)
+        if node is not None:
+            self.sched.on_node_delete(node)
+
+    def create_pod(self, pod: t.Pod) -> None:
+        self.sched.on_pod_add(pod)
+
+    def delete_pod(self, key: str, pod: t.Pod) -> None:
+        self.sched.on_pod_delete(pod)
+
+    def create_group(self, ev) -> None:
+        from ..api.wrappers import make_pod_group
+
+        self.sched.on_pod_group_add(make_pod_group(
+            ev.name, namespace=ev.namespace, min_count=ev.min_count,
+        ))
+
+    def pump(self) -> bool:
+        self.client.deliver()
+        return False
+
+    def bind_times(self) -> dict:
+        return self.client.bind_times
+
+    def close(self) -> None:
+        pass
+
+
+class _TraceFullstackDriver:
+    """Fullstack I/O for the trace replay: pod/node events go through the
+    REST apiserver (bulk creates per tick) and come back through the
+    informer seam — enqueue→bind spans the whole control plane. PodGroups
+    have no REST kind; group events land on the scheduler directly (the
+    one documented direct injection)."""
+
+    def __init__(self, sched, remote, informers, client) -> None:
+        self.sched = sched
+        self.remote = remote
+        self.informers = informers
+        self.client = client
+
+    def add_node(self, node: t.Node) -> None:
+        from ..client.informers import NODES
+
+        self.remote.create(NODES, node.name, node)
+
+    def drain_node(self, name: str) -> None:
+        from ..client.informers import NODES
+
+        try:
+            self.remote.delete(NODES, name)
+        except Exception:
+            pass
+
+    def create_pod(self, pod: t.Pod) -> None:
+        from ..client.informers import PODS
+
+        self.remote.create(PODS, f"{pod.namespace}/{pod.name}", pod)
+
+    def delete_pod(self, key: str, pod: t.Pod) -> None:
+        from ..client.informers import PODS
+
+        try:
+            self.remote.delete(PODS, key)
+        except Exception:
+            pass    # already gone / rebound — the trace goes on
+
+    def create_group(self, ev) -> None:
+        from ..api.wrappers import make_pod_group
+
+        self.sched.on_pod_group_add(make_pod_group(
+            ev.name, namespace=ev.namespace, min_count=ev.min_count,
+        ))
+
+    def pump(self) -> bool:
+        return bool(self.informers.pump())
+
+    def bind_times(self) -> dict:
+        return self.client.bind_times
+
+    def close(self) -> None:
+        pass
+
+
+def run_workload_trace(
+    profile,
+    mode: str = "direct",
+    engine: str = "greedy",
+    max_batch: int = 128,
+    timeout_s: float = 600.0,
+    stall_s: float = 15.0,
+    warmup: bool = True,
+    speed: float = 1.0,
+    wall_budget_s: float | None = None,
+    encode_cache: bool = True,
+    scoped_invalidation: bool = True,
+    wire: str = "binary",
+    artifacts_dir: str | None = None,
+) -> WorkloadResult:
+    """Replay a ``workloads.TraceProfile`` against the real scheduler loop
+    and measure the admission-latency SLO: p50/p99 of enqueue→bind over
+    every pod the trace created, judged against the profile's declared
+    budget (``slo_ok``), plus per-stage peak RSS, device-resident bytes,
+    and the encode-cache re-encode accounting — the scale-frontier record
+    shape.
+
+    ``mode``: "direct" (events on the informer handlers — the engine-bound
+    number) or "fullstack" (through the REST apiserver + informers —
+    enqueue→bind spans the control plane). ``speed`` scales the trace
+    clock (2.0 = replay twice as fast). ``wall_budget_s``: hard stage wall
+    — when exceeded the replay stops firing, the settle is skipped, and
+    the record is emitted TRUNCATED but parseable (a hung 100k-node rung
+    must never eat the whole bench wall). ``scoped_invalidation=False``
+    pins the encode cache's pre-PR-14 full-epoch flush (the A/B control
+    the node-wave evidence is measured against)."""
+    from ..sched.scheduler import Scheduler
+    from . import workloads as W
+
+    if isinstance(profile, str):
+        profile = W.TRACE_PROFILES[profile]
+    events = profile.events()
+
+    srv = remote = informers = None
+    if mode == "direct":
+        client = _TraceClient()
+        sched = Scheduler(
+            client, profile=C.Profile(), max_batch=max_batch, engine=engine,
+            encode_cache=encode_cache,
+            feature_gates={"GenericWorkload": True, "GangScheduling": True},
+        )
+        client.sched = sched
+        driver = _TraceDirectDriver(sched, client)
+    elif mode == "fullstack":
+        from ..apiserver import APIServer, RemoteStore
+        from ..client import SchedulerInformers
+        from ..client.informers import NODES
+
+        srv = APIServer().start()
+        remote = RemoteStore(srv.url, wire=wire)
+        client = _make_trace_store_client(remote)
+        sched = Scheduler(
+            client, profile=C.Profile(), max_batch=max_batch, engine=engine,
+            encode_cache=encode_cache,
+            feature_gates={"GenericWorkload": True, "GangScheduling": True},
+        )
+        informers = SchedulerInformers(remote, sched)
+        informers.start()
+        driver = _TraceFullstackDriver(sched, remote, informers, client)
+    else:
+        raise ValueError(f"unknown trace mode {mode!r}")
+    if sched.encode_cache is not None and not scoped_invalidation:
+        sched.encode_cache.scoped = False
+    sched.enable_preemption()
+
+    rss = _RssSampler()
+    created_at: dict[str, float] = {}
+    deleted: set[str] = set()
+    pods_by_key: dict[str, t.Pod] = {}
+    truncated = False
+    try:
+        # initial cluster
+        if mode == "direct":
+            for i in range(profile.nodes):
+                driver.add_node(W.node_default(i, profile.zones))
+        else:
+            nodes = [
+                W.node_default(i, profile.zones)
+                for i in range(profile.nodes)
+            ]
+            _bulk_create(
+                remote, NODES, [(nd.name, nd) for nd in nodes],
+            )
+            driver.pump()
+        if warmup:
+            sched.warmup([
+                W.build_trace_pod(W.TraceEvent(
+                    0.0, "create_pod", f"warm-{j}", "trace-warm",
+                ))
+                for j in range(min(max_batch, 64))
+            ])
+        attempts0, cycles0, prom_base = _begin_measured_phase(
+            sched, False, [],
+        )
+        rss.sample()
+
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        wall_deadline = (
+            t0 + wall_budget_s if wall_budget_s is not None else None
+        )
+        i = 0
+        last_progress = t0
+        bound_prev = 0
+
+        def live_unbound() -> int:
+            bt = driver.bind_times()
+            return sum(
+                1 for k in created_at if k not in deleted and k not in bt
+            )
+
+        while True:
+            now = time.perf_counter()
+            if wall_deadline is not None and now > wall_deadline:
+                truncated = True
+                break
+            if now > deadline:
+                truncated = True
+                break
+            trace_now = (now - t0) * speed
+            fired = 0
+            while i < len(events) and events[i].at_s <= trace_now:
+                ev = events[i]
+                i += 1
+                fired += 1
+                if ev.kind == "create_pod":
+                    pod = W.build_trace_pod(ev)
+                    key = f"{ev.namespace}/{ev.name}"
+                    created_at[key] = time.perf_counter()
+                    pods_by_key[key] = pod
+                    driver.create_pod(pod)
+                elif ev.kind == "delete_pod":
+                    key = f"{ev.namespace}/{ev.name}"
+                    deleted.add(key)
+                    pod = pods_by_key.get(key)
+                    if pod is not None:
+                        driver.delete_pod(key, pod)
+                elif ev.kind == "add_node":
+                    driver.add_node(make_trace_node(ev.name, profile.zones))
+                elif ev.kind == "drain_node":
+                    driver.drain_node(ev.name)
+                elif ev.kind == "create_group":
+                    driver.create_group(ev)
+            moved = driver.pump()
+            res = sched.schedule_batch()
+            driver.pump()
+            sched.dispatcher.sync()
+            sched._drain_bind_completions()
+            rss.sample()
+            bound_now = len(driver.bind_times())
+            progressed = (
+                fired or moved or res["scheduled"]
+                or bound_now > bound_prev
+            )
+            bound_prev = bound_now
+            if i >= len(events):
+                # replay done: settle until every live pod bound or stall
+                if live_unbound() == 0:
+                    break
+                if progressed:
+                    last_progress = now
+                elif now - last_progress > stall_s:
+                    break
+                else:
+                    time.sleep(0.002)
+            elif progressed:
+                last_progress = now
+            else:
+                # idle until the next event is due (bounded nap)
+                time.sleep(min(0.002, max(0.0, (
+                    events[i].at_s / speed + t0 - now
+                ))))
+        duration = time.perf_counter() - t0
+        sched.dispatcher.sync()
+        driver.pump()
+        sched._drain_bind_completions()
+
+        # admission latencies: enqueue→bind per created pod
+        bt = driver.bind_times()
+        lats = [
+            (bt[k] - created_at[k]) * 1000.0
+            for k in created_at if k in bt
+        ]
+        p50 = float(np.percentile(lats, 50)) if lats else None
+        p99 = float(np.percentile(lats, 99)) if lats else None
+        unbound = live_unbound()
+        ec = sched.encode_cache
+        trace_stats = {
+            "profile": profile.name,
+            "seed": profile.seed,
+            "events": len(events),
+            "fired": i,
+            "created": len(created_at),
+            "deleted": len(deleted),
+            "unbound": unbound,
+            "nodes_final": sched.cache.update_snapshot().num_nodes(),
+            "samples": len(lats),
+        }
+        if ec is not None:
+            st = ec.stats()
+            trace_stats["encode_rebuilt_bytes"] = st["rebuilt_bytes"]
+            trace_stats["encode_extended_bytes"] = st["extended_bytes"]
+            trace_stats["encode_scoped_extensions"] = st["scoped_extensions"]
+            trace_stats["encode_invalidations"] = st["invalidations"]
+            trace_stats["scoped_invalidation"] = bool(ec.scoped)
+        artifacts: dict[str, str] = {}
+        if artifacts_dir is not None and not truncated:
+            artifacts = dump_diagnosis_artifacts(
+                sched, artifacts_dir,
+                f"Trace_{profile.name}_{mode}_{engine}",
+            )
+        measured = len(lats)
+        throughput = measured / duration if duration > 0 else 0.0
+        traffic = _device_traffic_stats(sched, cycles0, duration)
+        return WorkloadResult(
+            case_name=f"Trace_{profile.name}",
+            workload_name=(
+                f"{profile.nodes}Nodes" + ("" if mode == "direct"
+                                           else "_fullstack")
+            ),
+            threshold=None,
+            **traffic,
+            **_encode_stats(sched, cycles0),
+            **_dispatcher_stats(sched),
+            **_mesh_stats(sched),
+            **_staged_and_soak(sched, prom_base),
+            measure_pods=len(created_at),
+            scheduled=measured,
+            duration_s=duration,
+            throughput=throughput,
+            vs_threshold=None,
+            attempts=sched.metrics.schedule_attempts - attempts0,
+            cycles=sched.metrics.cycles - cycles0,
+            p99_attempt_latency_ms=measured_p99_ms(sched, prom_base),
+            admission_p50_ms=p50,
+            admission_p99_ms=p99,
+            slo_budget_ms=profile.slo_budget_ms,
+            slo_ok=(
+                p99 is not None and p99 <= profile.slo_budget_ms
+                and unbound == 0 and not truncated
+            ),
+            peak_rss_bytes=rss.peak,
+            truncated=truncated,
+            trace_stats=trace_stats,
+            metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
+            artifacts=artifacts,
+        )
+    finally:
+        rss.close()
+        sched.close()
+        if srv is not None:
+            srv.close()
+
+
+def make_trace_node(name: str, zones: tuple[str, ...] = ()) -> t.Node:
+    """A wave node: default scheduler-perf shape under the trace's own
+    name (drains address nodes by name). Zone assignment uses a STABLE
+    hash — builtin hash() is salted per process, which would break the
+    trace determinism contract across runs."""
+    import zlib
+
+    from ..api.wrappers import make_node
+
+    labels = {W.HOSTNAME_KEY: name}
+    if zones:
+        labels[W.ZONE_KEY] = zones[zlib.crc32(name.encode()) % len(zones)]
+    return make_node(
+        name, cpu_milli=4000, memory=32 * 1024**3, pods=110, labels=labels,
+    )
+
+
+def _make_trace_store_client(remote):
+    """Fullstack trace client: StoreClient + per-pod bind wall stamps
+    (dispatcher workers bind concurrently, hence the lock)."""
+    import threading
+
+    from ..client import StoreClient
+
+    class _C(StoreClient):
+        def __init__(self, store) -> None:
+            super().__init__(store)
+            self.bind_times: dict[str, float] = {}
+            self._bt_lock = threading.Lock()
+
+        def bind(self, pod, node_name) -> None:
+            super().bind(pod, node_name)
+            with self._bt_lock:
+                self.bind_times.setdefault(
+                    f"{pod.namespace}/{pod.name}", time.perf_counter()
+                )
+
+        def bulk_bind(self, pairs) -> list:
+            errs = super().bulk_bind(pairs)
+            now = time.perf_counter()
+            with self._bt_lock:
+                for (pod, _node), err in zip(pairs, errs):
+                    if err is None:
+                        self.bind_times.setdefault(
+                            f"{pod.namespace}/{pod.name}", now
+                        )
+            return errs
+
+    return _C(remote)
+
+
+class _TraceClient(_Client):
+    """Direct-mode client that stamps per-pod bind wall times (the
+    admission-latency denominator)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.bind_times: dict[str, float] = {}
+
+    def bind(self, pod: t.Pod, node_name: str) -> None:
+        super().bind(pod, node_name)
+        self.bind_times.setdefault(
+            f"{pod.namespace}/{pod.name}", time.perf_counter()
+        )
 
 
 class _WatchFanout:
@@ -1874,6 +2366,7 @@ def run_workload_multiprocess(
         # (scraped BEFORE the join stops them)
         conflicts = 0.0
         attempts = 0.0
+        lease_transitions = 0.0
         for diag_url in cluster.scheduler_diag_urls():
             parsed = _scrape_metrics(diag_url)
             conflicts += _sum_samples(
@@ -1882,6 +2375,9 @@ def run_workload_multiprocess(
             attempts += _sum_samples(
                 parsed, "scheduler_schedule_attempts_total",
                 result="scheduled",
+            )
+            lease_transitions += _sum_samples(
+                parsed, "scheduler_federation_lease_transitions_total"
             )
         wire_codec = admin.wire_codec
         n_processes = cluster.n_processes()
@@ -1940,6 +2436,7 @@ def run_workload_multiprocess(
         partition=partition,
         conflicts=int(conflicts),
         conflict_rate=(conflicts / attempts) if attempts else 0.0,
+        lease_transitions=int(lease_transitions),
         binding_parity=parity_read.get("bound"),   # the store-READ count
         #                   (join raised ParityError on any miss, so a
         #                    record only exists when it equals the target)

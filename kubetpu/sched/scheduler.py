@@ -498,10 +498,20 @@ class Scheduler:
         return None
 
     def on_node_add(self, node: t.Node) -> None:
+        known = self.cache.has_node(node.name)
         self.cache.add_node(node)
         if self.encode_cache is not None:
-            # node labels/taints/features feed every cached static row
-            self.encode_cache.invalidate_nodes()
+            if known:
+                # resync-duplicate Add REPLACES the node object (labels /
+                # taints may differ at an interior index): full-epoch seam
+                self.encode_cache.invalidate_nodes()
+            else:
+                # SCOPED invalidation: a genuine add appends to the node
+                # axis, so the cache extends its rows with the new node's
+                # columns at the next sync instead of flushing every
+                # node-dependent store (at 100k nodes an add-wave flush
+                # was a re-encode storm)
+                self.encode_cache.invalidate_nodes(added=node)
         self.queue.on_event(
             ClusterEvent(EventResource.NODE, ActionType.ADD), None, node
         )

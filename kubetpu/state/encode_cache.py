@@ -151,16 +151,44 @@ def build_node_ctx(nt) -> NodeCtx:
     )
 
 
+#: past this many cached rows a scoped extension costs more python than the
+#: full-miss storm it avoids — wholesale clear instead (templates in real
+#: workloads number in the dozens, so the cap only bites pathological keys)
+EXTEND_MAX_ENTRIES = 1024
+
+
 class EncodeCache:
     """See module docstring. Single-owner like the scheduler loop: informer
-    callbacks and the encode path run on the loop thread."""
+    callbacks and the encode path run on the loop thread.
 
-    def __init__(self, max_entries: int = 8192, metrics=None) -> None:
+    ``scoped=True`` (default) keeps node-epoch invalidation SCOPED: a node
+    ADD (``invalidate_nodes(added=...)``) extends every cached row with the
+    appended nodes' columns at the next sync — O(templates × Δnodes) —
+    instead of clearing all node-dependent stores (at 100k nodes under an
+    autoscaler add-wave the wholesale clear was a full re-encode storm per
+    event). Updates and deletes still take the full-epoch flush through the
+    bare ``invalidate_nodes()`` seam (delete reindexes rows; update changes
+    facts at an interior index). ``scoped=False`` is the escape hatch /
+    A-B control: every epoch bump clears wholesale, the pre-PR-14
+    behavior."""
+
+    def __init__(
+        self, max_entries: int = 8192, metrics=None, scoped: bool = True,
+    ) -> None:
         self.max_entries = max_entries
+        self.scoped = scoped
+        self.extend_max_entries = EXTEND_MAX_ENTRIES
         # --- node-fact versioning ---------------------------------------
         # bumped by the scheduler on EVERY node add/update/delete; rows are
         # valid only while built against (this epoch, this NodeTensors)
         self.node_epoch = 0
+        # bumped only on FULL flushes (bare invalidate_nodes, or scoped
+        # off): the template-group index keys on this, so an add-wave
+        # extends its count vectors instead of rebuilding them wholesale
+        self._full_epoch = 0
+        self._pending_adds = 0        # scoped adds since the last sync
+        self._pending_full = False    # a full flush is owed at next sync
+        self._nt_len = -1             # node count rows were built against
         self._nt_token: object | None = None   # adopted NodeTensors
         self._nt_epoch = -1                    # epoch rows were built at
         self._ctx: NodeCtx | None = None
@@ -203,33 +231,137 @@ class EncodeCache:
         self.hits: collections.Counter = collections.Counter()
         self.misses: collections.Counter = collections.Counter()
         self.invalidations = 0
+        # re-encode work accounting (the node-wave evidence): bytes of rows
+        # built from scratch on a miss vs bytes of delta columns appended
+        # by scoped extensions, and how many syncs extended vs flushed
+        self.rebuilt_bytes = 0
+        self.extended_bytes = 0
+        self.scoped_extensions = 0
         self._flushed_hits: collections.Counter = collections.Counter()
         self._flushed_misses: collections.Counter = collections.Counter()
         self._flushed_invalidations = 0
         self.metrics = metrics   # TPUBackendMetrics | None
 
     # ------------------------------------------------------------ epochs
-    def invalidate_nodes(self) -> None:
-        """A node was added/updated/removed: every node-dependent row is
-        suspect. O(1) — stores are cleared lazily at the next sync."""
+    def invalidate_nodes(self, added=None) -> None:
+        """A node event landed. Bare call (``added=None``) — the BLESSED
+        full-epoch seam for updates/deletes: every node-dependent row is
+        suspect and the next sync clears wholesale. ``added=<node>`` — a
+        scoped node ADD: the next sync EXTENDS cached rows with the
+        appended nodes' columns instead of clearing (graftcheck EC001 pins
+        bare calls to the scheduler's update/delete handlers so this
+        scoping can't silently regress to a flush-per-event storm).
+        O(1) either way — all real work is deferred to the next sync."""
         self.node_epoch += 1
+        if added is not None and self.scoped:
+            self._pending_adds += 1
+        else:
+            self._pending_full = True
+            self._full_epoch += 1
 
     def sync_nodes(self, nt) -> bool:
         """Adopt ``nt`` (the NodeTensors the current encode runs against).
-        Clears the node-dependent stores when the epoch moved or the
-        tensors were rebuilt since the rows were built. Returns True when
-        an invalidation happened (for the encode span's trace attrs)."""
-        if self._nt_token is nt and self._nt_epoch == self.node_epoch:
+        When every epoch bump since the last sync was a scoped ADD and the
+        encoder extended the SAME tensors object in place, cached rows are
+        extended with the appended nodes' columns (O(templates × Δ));
+        otherwise the node-dependent stores clear wholesale. Returns True
+        when a wholesale invalidation happened (for the encode span's
+        trace attrs)."""
+        if (
+            self._nt_token is nt
+            and self._nt_epoch == self.node_epoch
+            and self._nt_len == nt.num_nodes
+        ):
             return False
+        # same-object growth is append-only BY CONSTRUCTION: the encoder
+        # mutates tensors in place only when the old rows are a preserved
+        # prefix. Gating on observed growth (not just the pending-add
+        # counter) also covers appends that bypass the node informer —
+        # e.g. a placeholder node born from an assigned pod on an
+        # unknown node.
+        if (
+            self.scoped
+            and not self._pending_full
+            and self._nt_token is nt
+            and 0 <= self._nt_len < nt.num_nodes
+            and (len(self._filter_rows) + len(self._score_rows))
+            <= self.extend_max_entries
+        ):
+            self._extend_rows(nt, self._nt_len)
+            self._nt_epoch = self.node_epoch
+            self._nt_len = nt.num_nodes
+            self._pending_adds = 0
+            self.scoped_extensions += 1
+            return False    # rows stayed valid — not an invalidation
         self._filter_rows.clear()
         self._score_rows.clear()
         self._ctx = None
         invalidated = self._nt_token is not None
         self._nt_token = nt
         self._nt_epoch = self.node_epoch
+        self._nt_len = nt.num_nodes if nt is not None else -1
+        self._pending_adds = 0
+        self._pending_full = False
         if invalidated:
             self.invalidations += 1
         return invalidated
+
+    def _extend_rows(self, nt, start: int) -> None:
+        """Append the columns for nodes [start:) to every cached filter /
+        score row: each row is a pure function of (node facts, stored
+        pod's signature), so the delta columns are built against a VIEW of
+        only the appended nodes and concatenated — bit-identical to a
+        fresh full-width build, at O(templates × Δnodes) cost."""
+        from . import encoder as enc
+
+        d_nt = _delta_tensors(nt, start)
+        d_ctx = build_node_ctx(d_nt)
+        ctx = self._ctx
+        if ctx is not None:
+            ctx.node_taints.extend(d_ctx.node_taints)
+            ctx.tainted_nodes.extend(
+                (start + i, tt) for i, tt in d_ctx.tainted_nodes
+            )
+            ctx.node_unsched = np.concatenate(
+                [ctx.node_unsched, d_ctx.node_unsched]
+            )
+            ctx.any_unsched = bool(ctx.any_unsched or d_ctx.any_unsched)
+            if d_ctx.node_feature_sets is not None and (
+                ctx.node_feature_sets is None
+            ):
+                # first declaring node arrived in the delta: the hoist
+                # needs per-node sets for the OLD nodes too — rebuild
+                self._ctx = build_node_ctx(nt)
+            elif ctx.node_feature_sets is not None:
+                ctx.node_feature_sets.extend(
+                    d_ctx.node_feature_sets
+                    if d_ctx.node_feature_sets is not None
+                    else [set() for _ in range(nt.num_nodes - start)]
+                )
+        fd = self._filter_rows._d
+        for key in list(fd.keys()):
+            row, trivial, pod = fd[key]
+            _fsig, feat_req, _nn, unknown, f = key
+            delta = enc.build_static_filter_row(
+                d_nt, d_ctx, pod, f, feat_req, unknown
+            )
+            fd[key] = (
+                np.concatenate([row, delta]),
+                bool(trivial and delta.all()),
+                pod,
+            )
+            self.extended_bytes += delta.nbytes
+        sd = self._score_rows._d
+        for key in list(sd.keys()):
+            na, tt, pod = sd[key]
+            _ssig, want_na, want_tt = key
+            dna, dtt = enc.build_static_score_rows(
+                d_nt, d_ctx, pod, want_na, want_tt
+            )
+            sd[key] = (
+                np.concatenate([na, dna]), np.concatenate([tt, dtt]), pod,
+            )
+            self.extended_bytes += dna.nbytes + dtt.nbytes
 
     def fresh_for(self, nt) -> bool:
         """May event-time precompute build rows against ``nt`` right now?
@@ -240,6 +372,7 @@ class EncodeCache:
             nt is not None
             and self._nt_token is nt
             and self._nt_epoch == self.node_epoch
+            and self._nt_len == nt.num_nodes
         )
 
     def node_ctx(self, nt) -> NodeCtx:
@@ -269,26 +402,32 @@ class EncodeCache:
             self._req_token = token
 
     # ----------------------------------------------------- row accessors
-    def filter_row(self, key, build: Callable[[], np.ndarray]):
+    # Entries carry a representative POD alongside the row: any pod whose
+    # signature hashes to the key rebuilds the identical row (rows are pure
+    # functions of the key + node facts), which is what lets a scoped node
+    # ADD extend cached rows with freshly built delta columns.
+    def filter_row(self, key, build: Callable[[], np.ndarray], pod=None):
         """(row, trivial) for a pure-static filter signature key."""
         got = self._filter_rows.get(key)
         if got is not None:
             self.hits["filter"] += 1
-            return got
+            return got[0], got[1]
         self.misses["filter"] += 1
         row = build()
+        self.rebuilt_bytes += row.nbytes
         entry = (row, bool(row.all()))
-        self._filter_rows.put(key, entry)
+        self._filter_rows.put(key, entry + (pod,))
         return entry
 
-    def score_row(self, key, build: Callable[[], tuple]):
+    def score_row(self, key, build: Callable[[], tuple], pod=None):
         got = self._score_rows.get(key)
         if got is not None:
             self.hits["score"] += 1
-            return got
+            return got[0], got[1]
         self.misses["score"] += 1
         entry = build()
-        self._score_rows.put(key, entry)
+        self.rebuilt_bytes += entry[0].nbytes + entry[1].nbytes
+        self._score_rows.put(key, entry + (pod,))
         return entry
 
     def request_row(self, key, build: Callable[[], tuple]):
@@ -362,6 +501,7 @@ class EncodeCache:
             lambda: enc.build_static_filter_row(
                 nt, ctx, pod, f, feat_req, fkey[3]
             ),
+            pod,
         )
         sc = (
             enc.DEFAULT_SCORES if enabled_scores is None else enabled_scores
@@ -375,6 +515,7 @@ class EncodeCache:
                 lambda: enc.build_static_score_rows(
                     nt, ctx, pod, want_na, want_tt
                 ),
+                pod,
             )
         return True
 
@@ -401,8 +542,9 @@ class EncodeCache:
         whose generation moved since the last call re-derive their
         per-template counts (O(Δ nodes × pods-per-node) per cycle instead
         of O(all assigned pods)). Rebuilt wholesale when the tensors were
-        replaced or a node event landed. Returned vectors are LIVE index
-        state — callers must not mutate them."""
+        replaced or a FULL-epoch flush landed (update/delete); scoped node
+        ADDS just grow the count vectors in place. Returned vectors are
+        LIVE index state — callers must not mutate them."""
         if len(self._group_keys) > (1 << 16):
             # template-id interning ran away (per-pod-unique labels): reset
             # the whole index — gids are invalidated with it
@@ -410,15 +552,23 @@ class EncodeCache:
             self._group_keys = []
             self._pod_group_ids.clear()
             self._groups_nt = None
-        if self._groups_nt is not nt or self._groups_epoch != self.node_epoch:
+        if self._groups_nt is not nt or self._groups_epoch != self._full_epoch:
             self._group_vecs = {}
             self._group_node = {}
             self._group_gens = {}
             self._groups_nt = nt
-            self._groups_epoch = self.node_epoch
+            self._groups_epoch = self._full_epoch
         N = nt.num_nodes
         gens = nt.node_gens
         vecs = self._group_vecs
+        # scoped node ADDS grow the node axis in place: extend the count
+        # vectors with zeros (appended nodes' pods fold in via the gens
+        # loop below — their generations are unseen)
+        for gid, vec in list(vecs.items()):
+            if len(vec) < N:
+                vecs[gid] = np.concatenate(
+                    [vec, np.zeros(N - len(vec), dtype=np.int64)]
+                )
         for i, info in enumerate(nt.infos):
             name = nt.node_names[i]
             g = gens.get(name)
@@ -456,6 +606,12 @@ class EncodeCache:
             "entries": len(self._filter_rows) + len(self._score_rows)
             + len(self._request_rows),
             "invalidations": self.invalidations,
+            # re-encode work: bytes built from scratch on misses vs bytes
+            # appended by scoped extensions (the node-wave evidence the
+            # tier-1 scoped-vs-flush test and trace records assert on)
+            "rebuilt_bytes": self.rebuilt_bytes,
+            "extended_bytes": self.extended_bytes,
+            "scoped_extensions": self.scoped_extensions,
         }
 
     def hit_rate(self, kinds=("filter", "score", "request")) -> float | None:
@@ -489,6 +645,35 @@ class EncodeCache:
         if self.metrics is not None:
             self.metrics.encode_cache_entries.set(self.stats()["entries"])
         return delta
+
+
+def _delta_tensors(nt, start: int):
+    """A minimal NodeTensors VIEW over only the appended nodes
+    [start:num_nodes) — just what the static row builders consume (names,
+    infos, label machinery; resource arrays are not read by them). Fresh
+    vocabs: the view is self-contained, ids never leak into ``nt``."""
+    from .encoder import NodeTensors
+
+    d = nt.num_nodes - start
+    z2 = np.zeros((d, 0), dtype=np.int64)
+    sub = NodeTensors(
+        resource_names=[],
+        node_names=list(nt.node_names[start:]),
+        alloc=z2,
+        requested=z2,
+        nonzero_requested=z2,
+        pod_count=np.zeros(d, dtype=np.int32),
+        allowed_pods=np.zeros(d, dtype=np.int32),
+        infos=list(nt.infos[start:]),
+    )
+    # intern the appended nodes' labels (the full build does this too) —
+    # requirement_mask treats an un-interned key as absent-on-every-node,
+    # which would extend selector/affinity rows with all-False columns
+    for info in sub.infos:
+        for k, v in info.node.labels:
+            sub.key_vocab.intern(k)
+            sub.val_vocab.intern(v)
+    return sub
 
 
 def groups_for(nt, cache, groups: dict | None = None) -> dict:

@@ -67,6 +67,19 @@ def round_up(n: int, minimum: int = 8) -> int:
     return (n + 1023) // 1024 * 1024
 
 
+def shard_aligned(n: int, multiple: int) -> int:
+    """Round a padded node capacity up to a per-shard bucket boundary: a
+    mesh of ``multiple`` shards needs capacity % multiple == 0 or the
+    sharded resident block degrades to replication. ONE place computes
+    this (runtime.encode_batch_static and the bench's capacity planner
+    both call it), so a mesh's bucket padding can never disagree with the
+    encoder's — at 100k nodes a mismatched bucket re-pads ~100 MB of
+    node-axis tensors per cycle."""
+    if multiple <= 1:
+        return n
+    return (n + multiple - 1) // multiple * multiple
+
+
 def bucket_ladder(n: int, minimum: int = 8) -> list[int]:
     """Every padded size ``round_up`` can produce for inputs in [1, n] —
     the compile-cache bucket ladder. Warming all of them at startup means a
@@ -162,6 +175,21 @@ class NodeTensors:
     # incremental ``encode_snapshot(…, prev=…)`` refresh (only rows whose
     # generation moved are rewritten, the UpdateSnapshot O(Δ) philosophy)
     node_gens: dict = field(repr=False, default_factory=dict)
+    # node name → row index (maintained across the append-incremental
+    # branch so dirty-candidate names resolve in O(1))
+    name_to_idx: dict = field(repr=False, default_factory=dict)
+    # --- O(Δ) informer-to-tensor sync bookkeeping ------------------------
+    # the backing Cache these tensors were encoded from (snapshot.
+    # cache_token), the cache's order epoch at that time, and the highest
+    # cache generation folded in: together they let the incremental
+    # refresh (a) skip the O(N) node-name list compare (order epoch pins
+    # set+order), (b) scan only the recency index's Δ instead of all N
+    # rows, and (c) extend in place when every structural change since was
+    # an append (an autoscaler add-wave at 100k nodes must not pay a full
+    # O(N) re-encode per cycle)
+    src_token: object = field(repr=False, default=None)
+    src_order_epoch: int = field(repr=False, default=-1)
+    gens_watermark: int = field(repr=False, default=0)
     # --- delta-upload + pipeline-staleness bookkeeping -------------------
     # row indices re-encoded but not yet shipped to the device-resident
     # node block (runtime.ResidentNodeState consumes + clears); None means
@@ -184,6 +212,12 @@ class NodeTensors:
     last_pods_mutated: bool = field(repr=False, default=False)
     # per-node content signature backing the check above
     pod_content_sigs: dict = field(repr=False, default_factory=dict)
+    # row indices of nodes with any in-use host-port triple, maintained by
+    # ``_encode_node_row`` (a pod add/remove touches its node's generation,
+    # so every port change re-encodes the row) — the per-cycle port encode
+    # walks THIS set, not all N nodes (an O(N)-python-per-cycle wall at
+    # 100k nodes for the port-free steady state)
+    nodes_with_ports: set = field(repr=False, default_factory=set)
 
     @property
     def num_nodes(self) -> int:
@@ -218,7 +252,10 @@ class NodeTensors:
     def _ensure_label_matrix(self) -> np.ndarray:
         if self.node_label is None or self.node_label.shape[1] < len(self.key_vocab):
             K = len(self.key_vocab)
-            mat = np.full((self.num_nodes, K), -1, dtype=np.int32)
+            # allocated at the padded node CAPACITY (like the resource
+            # arrays) so the append-incremental branch writes new rows in
+            # place instead of forcing an O(N·K) rebuild per add-wave cycle
+            mat = np.full((self.alloc.shape[0], K), -1, dtype=np.int32)
             for i, info in enumerate(self.infos):
                 for k, v in info.node.labels:
                     mat[i, self.key_vocab.get(k)] = self.val_vocab.intern(v)
@@ -233,7 +270,7 @@ class NodeTensors:
             # NotIn/DoesNotExist succeed everywhere.
             ok = req.operator in (t.Operator.NOT_IN, t.Operator.DOES_NOT_EXIST)
             return np.full(self.num_nodes, ok, dtype=bool)
-        col = self._ensure_label_matrix()[:, kid]
+        col = self._ensure_label_matrix()[: self.num_nodes, kid]
         op = req.operator
         if op == t.Operator.EXISTS:
             return col >= 0
@@ -285,7 +322,7 @@ class NodeTensors:
         kid = self.key_vocab.get(topo_key)
         if kid < 0:
             return np.full(self.num_nodes, -1, dtype=np.int32)
-        return self._ensure_label_matrix()[:, kid].copy()
+        return self._ensure_label_matrix()[: self.num_nodes, kid].copy()
 
 
 def _encode_node_row(
@@ -312,6 +349,10 @@ def _encode_node_row(
         if j is not None:
             nt.nonzero_requested[i, j] = v
     nt.pod_count[i] = len(info.pods)
+    if info.port_triples:
+        nt.nodes_with_ports.add(i)
+    else:
+        nt.nodes_with_ports.discard(i)
 
 
 def _pod_content_sig(info: NodeInfo) -> int:
@@ -354,79 +395,46 @@ def encode_snapshot(
     infos = snapshot.node_infos()
     N, R = len(infos), len(rnames)
     NP = max(pad_nodes or N, N)
-    node_names = [info.node.name for info in infos]
+    node_names: list[str] | None = None
 
     if (
         prev is not None
         and prev.resource_names == rnames
         and prev.alloc.shape[0] >= NP
         and prev.alloc.shape[1] == R
-        and prev.node_names == node_names
     ):
-        ridx = {r: i for i, r in enumerate(rnames)}
-        gens = prev.node_gens
-        dirty: list[int] = []
-        values_changed = False
-        nodes_replaced = False
-        pods_mutated = False
-        for i, info in enumerate(infos):
-            name = node_names[i]
-            gen = snapshot.node_generation.get(name)
-            if gens.get(name) == gen:
-                continue
-            dirty.append(i)
-            old_row = None
-            if track_changes:
-                psig = _pod_content_sig(info)
-                if prev.pod_content_sigs.get(name) != psig:
-                    pods_mutated = True
-                    prev.pod_content_sigs[name] = psig
-                if not values_changed:
-                    old_row = (
-                        prev.alloc[i].copy(), prev.requested[i].copy(),
-                        prev.nonzero_requested[i].copy(),
-                        int(prev.pod_count[i]), int(prev.allowed_pods[i]),
-                    )
-            _encode_node_row(prev, i, info, ridx)
-            if old_row is not None and not (
-                int(prev.pod_count[i]) == old_row[3]
-                and int(prev.allowed_pods[i]) == old_row[4]
-                and np.array_equal(prev.alloc[i], old_row[0])
-                and np.array_equal(prev.requested[i], old_row[1])
-                and np.array_equal(prev.nonzero_requested[i], old_row[2])
+        n_prev = len(prev.node_names)
+        cache_match = (
+            prev.src_token is not None
+            and prev.src_token is snapshot.cache_token
+        )
+        same_set = appended = False
+        if N == n_prev:
+            # order epoch pins node set + order: the O(N) name-list compare
+            # only runs for cacheless (hand-built) snapshots
+            if cache_match and prev.src_order_epoch == snapshot.order_epoch:
+                same_set = True
+                node_names = prev.node_names
+            else:
+                node_names = [info.node.name for info in infos]
+                same_set = prev.node_names == node_names
+        elif N > n_prev:
+            if cache_match and snapshot.appends_only_since(
+                prev.src_order_epoch
             ):
-                values_changed = True
-            if prev.infos[i].node is not info.node:
-                nodes_replaced = True
-                # node object replaced: labels may differ — refresh vocab and
-                # the label-matrix row (new keys force a lazy full rebuild)
-                kv, vv = prev.key_vocab, prev.val_vocab
-                before = len(kv)
-                for k, v in info.node.labels:
-                    kv.intern(k)
-                    vv.intern(v)
-                if prev.node_label is not None:
-                    if len(kv) > before or len(kv) > prev.node_label.shape[1]:
-                        prev.node_label = None
-                    else:
-                        prev.node_label[i, :] = -1
-                        for k, v in info.node.labels:
-                            prev.node_label[i, kv.get(k)] = vv.intern(v)
-            gens[name] = gen
-        prev.infos = infos
-        prev.last_dirty_rows = tuple(dirty)
-        if not track_changes and dirty:
-            # flags not maintained: report "changed" so a consumer that
-            # does read them errs toward a replay, never toward staleness
-            values_changed = True
-            pods_mutated = True
-        prev.last_values_changed = values_changed
-        prev.last_nodes_replaced = nodes_replaced
-        prev.last_pods_mutated = pods_mutated
-        if prev.pending_device_rows is not None:
-            prev.pending_device_rows.update(dirty)
-        return prev
+                appended = True
+            else:
+                node_names = [info.node.name for info in infos]
+                appended = node_names[:n_prev] == prev.node_names
+        if same_set or appended:
+            return _refresh_tensors(
+                snapshot, prev, infos, rnames,
+                appended_from=n_prev if appended else None,
+                track_changes=track_changes, cache_match=cache_match,
+            )
 
+    if node_names is None:
+        node_names = [info.node.name for info in infos]
     ridx = {r: i for i, r in enumerate(rnames)}
     alloc = np.zeros((NP, R), dtype=np.int64)
     requested = np.zeros((NP, R), dtype=np.int64)
@@ -448,6 +456,10 @@ def encode_snapshot(
         node_gens={
             name: snapshot.node_generation.get(name) for name in node_names
         },
+        name_to_idx={name: i for i, name in enumerate(node_names)},
+        src_token=snapshot.cache_token,
+        src_order_epoch=snapshot.order_epoch,
+        gens_watermark=snapshot.cache_watermark,
     )
     for i, info in enumerate(infos):
         _encode_node_row(nt, i, info, ridx)
@@ -459,6 +471,145 @@ def encode_snapshot(
             key_vocab.intern(k)
             val_vocab.intern(v)
     return nt
+
+
+def _refresh_tensors(
+    snapshot: Snapshot,
+    prev: NodeTensors,
+    infos: "list[NodeInfo]",
+    rnames: list[str],
+    appended_from: int | None,
+    track_changes: bool,
+    cache_match: bool,
+) -> NodeTensors:
+    """Incremental refresh of ``prev`` in place (the returned object IS
+    ``prev``): re-encode pre-existing rows whose cache generation moved,
+    and — when ``appended_from`` is given — encode the freshly APPENDED
+    node rows into the spare padded capacity (an autoscaler add-wave
+    extends the tensors instead of paying a full O(N) rebuild per cycle).
+
+    Dirty discovery is O(Δ) when the snapshot's backing cache is the one
+    these tensors were built from: the cache's recency index names the
+    candidates (``Snapshot.dirty_since``) instead of a full O(N) gen scan
+    — each candidate is still gen-checked, so a superset is harmless."""
+    ridx = {r: i for i, r in enumerate(rnames)}
+    gens = prev.node_gens
+    dirty: list[int] = []
+    values_changed = False
+    nodes_replaced = False
+    pods_mutated = False
+    N = len(infos)
+    n_old = appended_from if appended_from is not None else N
+
+    cand: list[int] | None = None
+    if cache_match:
+        names_c = snapshot.dirty_since(prev.gens_watermark)
+        if names_c is not None:
+            idx_of = prev.name_to_idx
+            cand = sorted(
+                i for i in (idx_of.get(nm, -1) for nm in names_c)
+                if 0 <= i < n_old
+            )
+    for i in (range(n_old) if cand is None else cand):
+        info = infos[i]
+        name = info.node.name
+        gen = snapshot.node_generation.get(name)
+        if gens.get(name) == gen:
+            continue
+        dirty.append(i)
+        old_row = None
+        if track_changes:
+            psig = _pod_content_sig(info)
+            if prev.pod_content_sigs.get(name) != psig:
+                pods_mutated = True
+                prev.pod_content_sigs[name] = psig
+            if not values_changed:
+                old_row = (
+                    prev.alloc[i].copy(), prev.requested[i].copy(),
+                    prev.nonzero_requested[i].copy(),
+                    int(prev.pod_count[i]), int(prev.allowed_pods[i]),
+                )
+        _encode_node_row(prev, i, info, ridx)
+        if old_row is not None and not (
+            int(prev.pod_count[i]) == old_row[3]
+            and int(prev.allowed_pods[i]) == old_row[4]
+            and np.array_equal(prev.alloc[i], old_row[0])
+            and np.array_equal(prev.requested[i], old_row[1])
+            and np.array_equal(prev.nonzero_requested[i], old_row[2])
+        ):
+            values_changed = True
+        if prev.infos[i].node is not info.node:
+            nodes_replaced = True
+            # node object replaced: labels may differ — refresh vocab and
+            # the label-matrix row (new keys force a lazy full rebuild)
+            kv, vv = prev.key_vocab, prev.val_vocab
+            before = len(kv)
+            for k, v in info.node.labels:
+                kv.intern(k)
+                vv.intern(v)
+            if prev.node_label is not None:
+                if len(kv) > before or len(kv) > prev.node_label.shape[1]:
+                    prev.node_label = None
+                else:
+                    prev.node_label[i, :] = -1
+                    for k, v in info.node.labels:
+                        prev.node_label[i, kv.get(k)] = vv.intern(v)
+        gens[name] = gen
+
+    if appended_from is not None:
+        # the add-wave extension: encode ONLY the appended rows; existing
+        # rows, vocab ids and the label matrix stay valid (node index is
+        # position in the order, and appends preserve the prefix)
+        kv, vv = prev.key_vocab, prev.val_vocab
+        keys_before = len(kv)
+        new_names: list[str] = []
+        for i in range(appended_from, N):
+            info = infos[i]
+            name = info.node.name
+            _encode_node_row(prev, i, info, ridx)
+            gens[name] = snapshot.node_generation.get(name)
+            prev.name_to_idx[name] = i
+            new_names.append(name)
+            if track_changes:
+                prev.pod_content_sigs[name] = _pod_content_sig(info)
+            for k, v in info.node.labels:
+                kv.intern(k)
+                vv.intern(v)
+            dirty.append(i)
+        prev.node_names.extend(new_names)
+        if prev.node_label is not None:
+            if len(kv) > keys_before or len(kv) > prev.node_label.shape[1]:
+                prev.node_label = None   # new keys: lazy full rebuild
+            else:
+                for i in range(appended_from, N):
+                    prev.node_label[i, :] = -1
+                    for k, v in infos[i].node.labels:
+                        prev.node_label[i, kv.get(k)] = vv.intern(v)
+        # the node SET changed: a pipelined in-flight cycle must replay
+        nodes_replaced = True
+
+    prev.infos = infos
+    prev.src_token = snapshot.cache_token
+    prev.src_order_epoch = snapshot.order_epoch
+    if cache_match:
+        prev.gens_watermark = snapshot.cache_watermark
+    else:
+        # adopting a NEW backing cache: its generation space is unrelated
+        # to the old watermark — reset so the next O(Δ) walk cannot skip
+        # dirty rows that live below a stale-high watermark
+        prev.gens_watermark = 0
+    prev.last_dirty_rows = tuple(dirty)
+    if not track_changes and dirty:
+        # flags not maintained: report "changed" so a consumer that
+        # does read them errs toward a replay, never toward staleness
+        values_changed = True
+        pods_mutated = True
+    prev.last_values_changed = values_changed
+    prev.last_nodes_replaced = nodes_replaced
+    prev.last_pods_mutated = pods_mutated
+    if prev.pending_device_rows is not None:
+        prev.pending_device_rows.update(dirty)
+    return prev
 
 
 # --------------------------------------------------------------------------
@@ -688,11 +839,14 @@ def _encode_ports(
             row = vocab.intern_all(_pod_port_triples(p))
             if row:
                 pod_rows.append((i, row))
-    # NodeInfo refcounts its in-use triples incrementally (UsedPorts), so
-    # this is O(nodes-with-ports × triples): port-free nodes (the perf
-    # workloads' steady state) cost one truthiness check
+    # NodeInfo refcounts its in-use triples incrementally (UsedPorts), and
+    # ``nodes_with_ports`` indexes the bearing rows, so this is
+    # O(nodes-with-ports × triples) flat — the port-free steady state pays
+    # nothing per node (at 100k nodes even a truthiness sweep was a
+    # per-cycle python wall)
     node_rows: list[tuple[int, list[int]]] = []
-    for i, info in enumerate(nt.infos):
+    for i in sorted(nt.nodes_with_ports):
+        info = nt.infos[i]
         if info.port_triples:
             node_rows.append(
                 (i, [vocab.intern(tr) for tr in info.port_triples])
@@ -909,7 +1063,7 @@ def encode_pod_batch(
                 )
 
             if cache is not None:
-                base, base_trivial = cache.filter_row(base_key, build)
+                base, base_trivial = cache.filter_row(base_key, build, p)
             else:
                 base = build()
                 base_trivial = bool(base.all())
@@ -986,7 +1140,9 @@ def encode_pod_batch(
                     return build_static_score_rows(nt, ctx, p, want_na, want_tt)
 
                 if cache is not None:
-                    entry = cache.score_row((ssig, want_na, want_tt), build_sc)
+                    entry = cache.score_row(
+                        (ssig, want_na, want_tt), build_sc, p,
+                    )
                 else:
                     entry = build_sc()
                 sid = len(score_rows)

@@ -134,6 +134,35 @@ class Snapshot:
     def node_infos(self) -> list[NodeInfo]:
         return [self.nodes[n] for n in self.node_order]
 
+    def dirty_since(self, watermark: int) -> "list[str] | None":
+        """Node names touched in the backing cache past ``watermark``
+        (cache generations) — the O(Δ) candidate set the tensor encoder
+        scans instead of all N nodes (the informer-to-tensor sync was an
+        O(N)-python-per-cycle wall at 100k nodes). None when the snapshot
+        has no live cache behind it (hand-built test snapshots): callers
+        fall back to the full scan. The list may be a SUPERSET of what
+        this snapshot has folded in — consumers must still gen-check each
+        candidate, never trust membership alone."""
+        cache = self.cache_token
+        if cache is None:
+            return None
+        touched = getattr(cache, "touched_since", None)
+        if touched is None:
+            return None
+        return touched(watermark)
+
+    def appends_only_since(self, order_epoch: int) -> bool:
+        """True when every node-set change in the backing cache since
+        ``order_epoch`` appended to the order (no removals) — the
+        precondition for the encoder's append-incremental branch (a wave
+        of node ADDS extends the tensors in place instead of the full
+        O(N) rebuild per event). False without a live cache."""
+        cache = self.cache_token
+        if cache is None:
+            return False
+        fn = getattr(cache, "appends_only_since", None)
+        return bool(fn(order_epoch)) if fn is not None else False
+
     def num_nodes(self) -> int:
         return len(self.node_order)
 
@@ -161,6 +190,10 @@ class Cache:
         # bumped on every node add/remove (the snapshot fast path requires an
         # unchanged node set + order)
         self._order_epoch = 0
+        # the order epoch at the last NON-append structural change (a node
+        # removal): epochs past this are pure appends, which the encoder's
+        # append-incremental branch can extend in place
+        self._nonappend_epoch = 0
         self._ns_gen = 0
         self._ttl = ttl_seconds
         self._clock = clock
@@ -242,6 +275,24 @@ class Cache:
         self._touched[info.node.name] = info.generation
         self._touched.move_to_end(info.node.name)
 
+    def touched_since(self, watermark: int) -> list[str]:
+        """Node names touched past generation ``watermark``, newest first —
+        a backwards walk of the recency index that stops at the watermark,
+        so cost is O(Δ touched), not O(all nodes). The tensor encoder uses
+        this as its dirty-row candidate set (Snapshot.dirty_since)."""
+        out: list[str] = []
+        for name in reversed(self._touched):
+            if self._touched[name] <= watermark:
+                break
+            out.append(name)
+        return out
+
+    def appends_only_since(self, order_epoch: int) -> bool:
+        """True when every structural node-set change since ``order_epoch``
+        was an append (add_node / placeholder insert) — no removal reindexed
+        the order (Snapshot.appends_only_since)."""
+        return self._nonappend_epoch <= order_epoch
+
     # --- nodes -----------------------------------------------------------
     def add_node(self, node: t.Node) -> None:
         info = self._nodes.get(node.name)
@@ -291,6 +342,7 @@ class Cache:
             return
         self._node_order.remove(name)
         self._order_epoch += 1
+        self._nonappend_epoch = self._order_epoch   # removal reindexes order
         self._touched.pop(name, None)
         if info.pods:
             self._deleted_nodes[name] = info

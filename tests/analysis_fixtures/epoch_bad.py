@@ -1,0 +1,18 @@
+"""Known-bad fixture for EC001: a bare full-epoch flush outside the
+blessed node-event seam, and a raw node_epoch write outside the cache."""
+
+
+class SomeController:
+    def __init__(self, encode_cache):
+        self.encode_cache = encode_cache
+
+    def on_anything(self):
+        # a full flush sprinkled into a non-node handler: the add-wave
+        # path silently regresses to re-encode-per-event
+        self.encode_cache.invalidate_nodes()  # expect: EC001
+
+    def poke_epoch(self):
+        self.encode_cache.node_epoch += 1  # expect: EC001
+
+    def reset_epoch(self):
+        self.encode_cache.node_epoch = 0  # expect: EC001

@@ -1,0 +1,15 @@
+"""Known-good fixture for EC001: scoped invalidation (added=...) is fine
+anywhere, and reads of node_epoch never flag."""
+
+
+class SomeController:
+    def __init__(self, encode_cache):
+        self.encode_cache = encode_cache
+
+    def on_node_added(self, node):
+        # scoped: the cache extends rows instead of flushing
+        self.encode_cache.invalidate_nodes(added=node)
+
+    def snapshot_epoch(self) -> int:
+        # reading the epoch is not a write
+        return self.encode_cache.node_epoch

@@ -259,6 +259,107 @@ def test_durability_records_pass_against_themselves(tmp_path):
     assert not any(d.regression for d in deltas)
 
 
+def _trace_line(p99=900.0, budget=3000.0, rss=300 * 1024**2, **extra):
+    out = {
+        "metric": "Trace_node-wave-5k_5000Nodes_greedy", "unit": "pods/s",
+        "value": 120.0, "admission_p99_ms": p99, "slo_budget_ms": budget,
+        "slo_ok": p99 <= budget, "peak_rss_bytes": rss,
+    }
+    out.update(extra)
+    return out
+
+
+def test_admission_slo_budget_violation_gates(tmp_path, capsys):
+    """A stage that WAS within its declared budget and now violates it
+    regresses regardless of relative tolerance."""
+    old = _write(tmp_path, "old.json", [_trace_line(p99=2500.0)])
+    new = _write(tmp_path, "new.json", [_trace_line(p99=3200.0)])
+    rc = main([old, new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "admission_p99_ms" in out and "violates SLO budget" in out
+
+
+def test_admission_within_budget_drift_needs_both_tolerances(tmp_path):
+    # +40% and +40ms: inside both floors — never gates
+    old = load_record(_write(tmp_path, "o.json", [_trace_line(p99=100.0)]))
+    new = load_record(_write(tmp_path, "n.json", [_trace_line(p99=140.0)]))
+    deltas, _o, _n = compare(old, new)
+    adm = [d for d in deltas if d.field == "admission_p99_ms"]
+    assert adm and not adm[0].regression
+    # +100% and +900ms, still within budget: drift gates
+    new2 = load_record(_write(tmp_path, "n2.json",
+                              [_trace_line(p99=1900.0)]))
+    old2 = load_record(_write(tmp_path, "o2.json",
+                              [_trace_line(p99=950.0)]))
+    deltas2, _o, _n = compare(old2, new2)
+    adm2 = [d for d in deltas2 if d.field == "admission_p99_ms"]
+    assert adm2 and adm2[0].regression
+
+
+def test_peak_rss_gates_only_on_both_relative_and_absolute(tmp_path):
+    mb = 1024**2
+    old = load_record(_write(tmp_path, "o.json",
+                             [_trace_line(rss=100 * mb)]))
+    # +200MB (+200%) but under the 256MB absolute floor: never gates
+    new_small = load_record(_write(tmp_path, "n1.json",
+                                   [_trace_line(rss=300 * mb)]))
+    d1, _o, _n = compare(old, new_small)
+    rss1 = [d for d in d1 if d.field == "peak_rss_bytes"]
+    assert rss1 and not rss1[0].regression
+    # +400MB AND +400%: gates
+    new_big = load_record(_write(tmp_path, "n2.json",
+                                 [_trace_line(rss=500 * mb)]))
+    d2, _o, _n = compare(old, new_big)
+    rss2 = [d for d in d2 if d.field == "peak_rss_bytes"]
+    assert rss2 and rss2[0].regression
+    # big cluster wobble: +300MB on 2GB is under +50% relative — no gate
+    old_big = load_record(_write(tmp_path, "o3.json",
+                                 [_trace_line(rss=2048 * mb)]))
+    new_wob = load_record(_write(tmp_path, "n3.json",
+                                 [_trace_line(rss=2348 * mb)]))
+    d3, _o, _n = compare(old_big, new_wob)
+    rss3 = [d for d in d3 if d.field == "peak_rss_bytes"]
+    assert rss3 and not rss3[0].regression
+
+
+def test_newly_truncated_stage_gates(tmp_path, capsys):
+    old = _write(tmp_path, "o.json", [_trace_line()])
+    new = _write(tmp_path, "n.json", [_trace_line(truncated=True)])
+    rc = main([old, new])
+    assert rc == 1
+    assert "truncated" in capsys.readouterr().out
+    # truncated in BOTH records (the expected 100k rung): no gate
+    both = _write(tmp_path, "b.json", [_trace_line(truncated=True)])
+    assert main([both, both]) == 0
+
+
+def test_trace_records_pass_against_themselves(tmp_path):
+    """Self-diff pinned green: the trace + AdmissionSLO lines gate
+    admission_p99_ms and peak_rss_bytes without tripping on an identical
+    record."""
+    lines = [
+        _trace_line(),
+        {
+            "metric": "AdmissionSLO_node-wave-5k_5000Nodes", "unit": "ms",
+            "value": 900.0, "admission_p99_ms": 900.0,
+            "slo_budget_ms": 3000.0, "slo_ok": True,
+            "peak_rss_bytes": 300 * 1024**2, "truncated": False,
+        },
+    ]
+    rec = _write(tmp_path, "self.json", lines)
+    assert main([rec, rec]) == 0
+    deltas, _o, _n = compare(load_record(rec), load_record(rec))
+    fields = {(d.metric, d.field) for d in deltas}
+    assert (
+        "Trace_node-wave-5k_5000Nodes_greedy", "admission_p99_ms"
+    ) in fields
+    assert (
+        "AdmissionSLO_node-wave-5k_5000Nodes", "peak_rss_bytes"
+    ) in fields
+    assert not any(d.regression for d in deltas)
+
+
 def test_cli_subcommand_dispatch(tmp_path, capsys):
     from kubetpu.cli import main as cli_main
 

@@ -270,6 +270,28 @@ def _encode_state(num_nodes=10, num_pods=6):
     return cache, pods
 
 
+def test_refresh_static_rejects_node_set_change():
+    """The stage-2 contract: a node add between stage 1 and stage 2 makes
+    the StaticBatch unusable (its num_nodes/node_valid/static_mask are
+    pinned at the stage-1 count). The append-incremental encoder extends
+    the SAME NodeTensors object in place, so object identity alone no
+    longer detects this — refresh_static must check the node count."""
+    cache, pods = _encode_state(num_nodes=10)
+    profile = C.Profile()
+    snap = cache.update_snapshot()
+    sb = rt.encode_batch_static(snap, pods, profile)
+    # assumes-only refresh: still usable
+    assert rt.refresh_static(sb, cache.update_snapshot(snap)) is True
+    # a node ADD lands between stage 1 and stage 2 (fits the padding
+    # bucket, so the encoder extends sb.nt in place rather than rebuild)
+    cache.add_node(make_node("n10", cpu_milli=8000, memory=16 * 1024**3))
+    snap = cache.update_snapshot(snap)
+    assert rt.refresh_static(sb, snap) is False, (
+        "stale StaticBatch accepted after a node add — the dispatched "
+        "batch would treat the new node as invalid"
+    )
+
+
 def test_delta_upload_equals_full_reencode():
     """Dirty-row scatter into the resident block must produce device
     tensors identical to a from-scratch encode of the same snapshot."""

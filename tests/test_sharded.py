@@ -196,10 +196,11 @@ def test_sharded_delta_upload_routed_per_shard(mesh):
 
 @pytest.mark.parametrize("use_mesh", [False, True], ids=["single", "mesh"])
 def test_incremental_reshard_on_node_add_delete(mesh, use_mesh):
-    """A node add/delete REBUILDS the host NodeTensors (new object); within
-    the same padding bucket the resident block must incrementally reshard —
-    a row diff + dirty-row scatter, strictly fewer bytes than a full
-    re-upload — and stay bit-identical to a fresh encode."""
+    """A node ADD within the padding bucket now EXTENDS the host NodeTensors
+    in place (the PR-14 append-incremental branch: same object, appended
+    rows marked dirty) and the resident block ships only the delta rows; a
+    node DELETE still rebuilds (order reindexes) and incrementally reshards.
+    Both must stay bit-identical to a fresh encode."""
     cache, pods = _encode_state(num_nodes=10)   # pads to 16: room to grow
     profile = C.Profile()
     resident = rt.ResidentNodeState(mesh=mesh if use_mesh else None)
@@ -209,14 +210,16 @@ def test_incremental_reshard_on_node_add_delete(mesh, use_mesh):
     full = resident.last_upload_bytes
     assert full > 0
 
-    # node ADD: node_names change → encode_snapshot rebuilds (prev unusable)
+    # node ADD: appended in place — same tensors object, delta upload only
     cache.add_node(make_node("n10", cpu_milli=2000, memory=4 * 1024**3))
     snap = cache.update_snapshot(snap)
     b2 = rt.encode_batch(snap, pods, profile, prev_nt=b1.node_tensors,
                          resident=resident, mesh=mesh if use_mesh else None)
-    assert b2.node_tensors is not b1.node_tensors, "expected a rebuild"
+    assert b2.node_tensors is b1.node_tensors, (
+        "a pure node add should extend the tensors in place, not rebuild"
+    )
     assert 0 < resident.last_upload_bytes < full, (
-        "node add within the padding bucket should reshard incrementally, "
+        "node add within the padding bucket should delta-upload, "
         f"not re-upload (shipped {resident.last_upload_bytes}/{full})"
     )
     ref = rt.encode_batch(cache.update_snapshot(), pods, profile)

@@ -86,7 +86,7 @@ def test_every_checker_registered_and_documented():
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
-        "WP001", "WL001", "TR003", "PS001",
+        "WP001", "WL001", "TR003", "PS001", "EC001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -119,7 +119,7 @@ def test_fixture_violations_match_markers_exactly():
     "lock_good.py", "ops/jit_good.py", "sched/donate_good.py",
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
-    "wal_good.py", "trace_good.py", "proc_good.py",
+    "wal_good.py", "trace_good.py", "proc_good.py", "epoch_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -260,6 +260,52 @@ def test_proc_checker_covers_kubetpu_but_not_the_launch_seam():
         and n.func.attr == "Popen"
     ]
     assert popens, "supervisor.py lost its Popen — PS001 guards air"
+
+
+def test_epoch_checker_covers_kubetpu_but_not_the_cache_itself():
+    """EC001 (encode-cache invalidation scope) walks all of kubetpu/ —
+    the scheduler's event handlers included — and does NOT walk the cache
+    (the one module allowed to version itself). Pinned against the ACTUAL
+    walk, and against the seam still being SCOPED: on_node_add must call
+    invalidate_nodes with the added= keyword (a refactor back to the bare
+    flush-per-add would leave the checker guarding air while the 100k
+    add-wave path silently regressed to a re-encode storm)."""
+    res = _repo_result()
+    covered = set(res.coverage.get("EC001", ()))
+    for f in (
+        "kubetpu/sched/scheduler.py",
+        "kubetpu/client/informers.py",
+        "kubetpu/perf/runner.py",
+    ):
+        assert f in covered, f"EC001 no longer covers {f}"
+    assert "kubetpu/state/encode_cache.py" not in covered, (
+        "EC001 wrongly covers the cache's own versioning"
+    )
+    # the blessed seam still scopes: on_node_add carries a scoped call
+    # (added=...) AND only the known handlers carry bare flushes
+    src = open(
+        os.path.join(REPO, "kubetpu", "sched", "scheduler.py"),
+        encoding="utf-8",
+    ).read()
+    tree = ast.parse(src)
+    scoped, bare_fns = 0, set()
+    for fn in ast.walk(tree):
+        if not isinstance(fn, ast.FunctionDef):
+            continue
+        for n in ast.walk(fn):
+            if (
+                isinstance(n, ast.Call)
+                and isinstance(n.func, ast.Attribute)
+                and n.func.attr == "invalidate_nodes"
+            ):
+                if any(kw.arg == "added" for kw in n.keywords):
+                    scoped += 1
+                elif not n.args and not n.keywords:
+                    bare_fns.add(fn.name)
+    assert scoped >= 1, "on_node_add lost its scoped invalidate_nodes(added=)"
+    assert bare_fns <= {"on_node_add", "on_node_update", "on_node_delete"}, (
+        f"bare full-epoch flushes outside the blessed handlers: {bare_fns}"
+    )
 
 
 def test_trace_checker_covers_handlers_and_dispatcher():
