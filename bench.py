@@ -222,6 +222,24 @@ TRACE_BUDGET_S = 1500.0
 TELEMETRY_CASE = ("SchedulingBasic", "500Nodes", "greedy", 128)
 TELEMETRY_BUDGET_S = 240.0
 
+# --- anomaly sentinel (kubetpu.telemetry.sentinel) --------------------------
+# Two stages. (1) SentinelOverhead_*: the sentinel riding the judged 500-node
+# fullstack row's cycle boundary (bench-scaled rule windows, 0.25 s cadence)
+# vs off — <5% budget (within_budget = ratio >= 0.95), benchdiff-gated via
+# sentinel_overhead_frac, and the on-half's run must be CLEAN (zero alerts
+# fired — the false-positive assert; the admission burn rule stays dormant on
+# the bulk-create row because it declares no slo_budget_ms, so the verdict
+# covers the budget-free outlier/ratio rules that ARE live). (2)
+# SentinelSpike_*: a paced trace replay (declared slo_budget_ms — the honest
+# venue: bulk-create tail queue-wait blows any fixed budget even when healthy)
+# with a one-shot 6 s scheduler stall injected a third of the way through;
+# value=1.0 iff the full fire→bundle→resolve chain held.
+SENTINEL_BUDGET_S = 420.0
+SENTINEL_SPIKE_PROFILE = dict(
+    nodes=1000, duration_s=12.0, base_rate=20.0, peak_rate=60.0,
+    bursts=1, burst_pods=50, slo_budget_ms=2000.0,
+)
+
 QUADRATIC = {"SchedulingPodAffinity", "TopologySpreading"}
 
 
@@ -260,6 +278,7 @@ def run_stage(
     wire: str = "binary",
     watch_fanout: int = 0,
     telemetry: bool = False,
+    sentinel: bool = False,
 ) -> dict:
     import contextlib
 
@@ -288,7 +307,7 @@ def run_stage(
         # the wire seam exists only on the REST hop: direct mode has no
         # apiserver, so the flags stay out of its runner call
         extra = {"wire": wire, "watch_fanout": watch_fanout,
-                 "telemetry": telemetry}
+                 "telemetry": telemetry, "sentinel": sentinel}
     t0 = time.perf_counter()
     with ctx:
         r = runner(
@@ -315,6 +334,8 @@ def run_stage(
         suffix += f"_{watch_fanout}watchers"
     if telemetry:
         suffix += "_telemetry"
+    if sentinel:
+        suffix += "_sentinel"
     out = {
         "metric": f"{case}_{workload}_{engine}{suffix}",
         "value": round(r.throughput, 1),
@@ -403,6 +424,10 @@ def run_stage(
         # the telemetry-plane evidence: span totals + the drop counter
         # the TelemetryOverhead gate asserts stayed zero
         out["telemetry"] = r.telemetry
+    if r.sentinel is not None:
+        # the anomaly-sentinel evidence: lifecycle counters + the alert
+        # list the zero-false-positive gate reads (clean run => clean)
+        out["sentinel"] = r.sentinel
     if r.metrics_snapshot is not None:
         # post-run metrics snapshot (p50/p99 from the scheduler histograms,
         # schedule_attempts by result): every BENCH line carries its own
@@ -1385,6 +1410,129 @@ def _run_telemetry_stages() -> None:
             f"(dropped={comp['spans_dropped']})")
 
 
+def _run_sentinel_stages() -> None:
+    """The anomaly-sentinel acceptance pair (see the SENTINEL_* block):
+    the judged fullstack row with the sentinel on vs off (one
+    SentinelOverhead_* line: overhead fraction, the <5% within_budget
+    verdict, and the on-half's zero-false-positive assert), then the
+    SentinelSpike_* trace stage — injected stall, declared SLO budget,
+    the fire→bundle→resolve chain as one boolean value."""
+    case, workload, engine, max_batch = TELEMETRY_CASE
+    t0 = time.perf_counter()
+    pair: dict[bool, dict] = {}
+    for on in (True, False):
+        if time.perf_counter() - t0 > SENTINEL_BUDGET_S:
+            _status("sentinel budget exhausted; skipping pair half")
+            continue
+        _status(f"sentinel stage: {case}/{workload}/{engine} "
+                f"sentinel={'on' if on else 'off'}")
+        # the off-half gets its OWN suffix: a bare fullstack run would
+        # reuse the judged STAGES row's metric name and shadow it (same
+        # hazard the telemetry pair documents)
+        metric_suffix = "_sentinel" if on else "_nosentinel"
+        try:
+            line = run_stage(
+                case, workload, engine, "fullstack", max_batch,
+                sentinel=on,
+            )
+        except Exception as e:
+            _emit({
+                "metric": (
+                    f"{case}_{workload}_{engine}_fullstack{metric_suffix}"
+                ),
+                "value": 0.0, "unit": "pods/s", "vs_baseline": 0.0,
+                "engine": engine, "mode": "fullstack",
+                "backend": _backend(),
+                "error": f"{type(e).__name__}: {e}",
+            })
+            _status(f"sentinel stage FAILED ({on=}): {e}")
+            continue
+        if not on:
+            line = dict(line, metric=line["metric"] + "_nosentinel")
+        pair[on] = line
+        _emit(line)
+    on_l, off_l = pair.get(True), pair.get(False)
+    if on_l and off_l:
+        fields = ("value", "duration_s", "p99_attempt_latency_ms")
+        sent = on_l.get("sentinel") or {}
+        comp = {
+            "metric": f"SentinelOverhead_{case}_{workload}_{engine}",
+            "unit": "ratio",
+            "mode": "fullstack",
+            "backend": on_l.get("backend"),
+            "sentinel_on": {
+                k: on_l.get(k) for k in fields if on_l.get(k) is not None
+            },
+            "sentinel_off": {
+                k: off_l.get(k) for k in fields if off_l.get(k) is not None
+            },
+            "evaluations": sent.get("evaluations"),
+            "eval_wall_s": sent.get("eval_wall_s"),
+            "alerts_fired": sent.get("fired_total", 0),
+            # the zero-false-positive assert: a CLEAN judged run must not
+            # fire anything — the stage itself flags a lie, not a reader
+            "clean": bool(sent.get("clean", False)),
+        }
+        if on_l.get("value") and off_l.get("value"):
+            ratio = on_l["value"] / off_l["value"]
+            comp["value"] = round(ratio, 3)
+            comp["sentinel_overhead_frac"] = round(max(1.0 - ratio, 0.0), 4)
+            # the acceptance gate: the live sentinel costs <5% throughput
+            comp["within_budget"] = ratio >= 0.95
+        _emit(comp)
+        _status(f"sentinel stage done: overhead_frac="
+                f"{comp.get('sentinel_overhead_frac')} "
+                f"clean={comp['clean']}")
+    if time.perf_counter() - t0 > SENTINEL_BUDGET_S:
+        _status("sentinel budget exhausted; skipping spike stage")
+        return
+    from kubetpu.perf.runner import run_workload_trace
+    from kubetpu.perf.workloads import TRACE_PROFILES
+
+    prof = TRACE_PROFILES["diurnal-burst"].scaled(
+        "sentinel", **SENTINEL_SPIKE_PROFILE
+    )
+    _status(f"sentinel spike stage: trace {prof.name} nodes={prof.nodes} "
+            f"slo={prof.slo_budget_ms}ms")
+    metric = f"SentinelSpike_{prof.name}_fullstack"
+    try:
+        r = run_workload_trace(
+            prof, mode="fullstack", max_batch=128, engine="greedy",
+            sentinel=True, sentinel_spike=True,
+        )
+    except Exception as e:
+        _emit({
+            "metric": metric, "value": 0.0, "unit": "verdict",
+            "mode": "trace-fullstack", "backend": _backend(),
+            "error": f"{type(e).__name__}: {e}",
+        })
+        _status(f"sentinel spike stage FAILED: {e}")
+        return
+    j = r.to_json()
+    sent = j.get("sentinel") or {}
+    spike = sent.get("spike") or {}
+    checks = ("fired", "fired_within_interval", "bundle_captured",
+              "bundle_covers_stall", "resolved")
+    line = {
+        "metric": metric,
+        # the acceptance chain as ONE judged bit: stall → matching SLO
+        # alert within the detection bound → bundle covering the stall
+        # window → resolved after recovery
+        "value": 1.0 if all(spike.get(k) for k in checks) else 0.0,
+        "unit": "verdict",
+        "mode": "trace-fullstack",
+        "backend": _backend(),
+        "slo_budget_ms": j.get("slo_budget_ms"),
+        "admission_p99_ms": j.get("admission_p99_ms"),
+        "scheduled": j.get("scheduled"),
+        "duration_s": j.get("duration_s"),
+        "sentinel": sent,
+    }
+    _emit(line)
+    _status(f"sentinel spike stage done: verdict={line['value']} "
+            f"spike={ {k: spike.get(k) for k in checks} }")
+
+
 def main() -> None:
     global STAGES
     probe, probe_s = _probe_backend()
@@ -1508,6 +1656,7 @@ def main() -> None:
     _run_federation_stages()
     _run_durability_stages()
     _run_telemetry_stages()
+    _run_sentinel_stages()
     # the multi-process ladders LAST: every in-process judged row has
     # already landed, and the mp stages spawn their own CPU-pinned
     # children regardless of this process's backend

@@ -26,6 +26,9 @@ Checker catalog (``--explain CODE`` prints the full rationale):
                      flushes only in the blessed node-event handlers
 - TR003              telemetry span coverage — apiserver handlers and
                      dispatcher call executors run under a span
+- AL001              alert-rule threshold discipline — the sentinel's
+                     evaluators read thresholds off the rule table,
+                     never from literals at the evaluation site
 
 Import surface: ``analyze_paths`` runs the suite programmatically (the
 tier-1 test ``tests/test_static_analysis.py`` gates on it), ``CHECKERS``
@@ -56,3 +59,4 @@ from . import walcheck  # noqa: F401,E402
 from . import tracecheck  # noqa: F401,E402
 from . import proccheck  # noqa: F401,E402
 from . import cachecheck  # noqa: F401,E402
+from . import alertcheck  # noqa: F401,E402

@@ -240,6 +240,7 @@ class _Handler(BaseHTTPRequestHandler):
     event_cache: EventEncodeCache   # serialize-once fan-out (bound by factory)
     tracer = None       # server-span recorder (bound by factory)
     collector = None    # embedded telemetry collector (bound when enabled)
+    sentinel = None     # embedded anomaly sentinel (bound when enabled)
     metrics_sources: tuple = ()  # extra Prometheus-text providers
     wire_enabled: bool = True    # False = JSON-only server (--wire json):
     #                              ignores binary Accept, 415s binary bodies
@@ -408,6 +409,16 @@ class _Handler(BaseHTTPRequestHandler):
                         "application/json",
                         codec.dumps(self.tracer.chrome_trace()).decode(),
                     ),
+                    # the embedded sentinel's alert/bundle state — same
+                    # shapes as the scheduler diagnostics endpoints
+                    "/debug/alerts": lambda q: (
+                        "application/json",
+                        codec.dumps(self._alerts_body()).decode(),
+                    ),
+                    "/debug/bundle": lambda q: (
+                        "application/json",
+                        codec.dumps(self._bundle_body(q)).decode(),
+                    ),
                 },
             )
         except Exception as e:  # noqa: BLE001 — diagnostics must not crash
@@ -418,6 +429,20 @@ class _Handler(BaseHTTPRequestHandler):
             return
         status, content_type, body = res
         self._reply_text(body, status=status, content_type=content_type)
+
+    def _alerts_body(self) -> dict:
+        if self.sentinel is None:
+            return {"enabled": False, "alerts": [], "firing": 0}
+        out = self.sentinel.alerts_json()
+        out["enabled"] = True
+        return out
+
+    def _bundle_body(self, query: dict) -> dict:
+        if self.sentinel is None:
+            return {"enabled": False, "bundles": [], "count": 0}
+        out = self.sentinel.bundles_json(query)
+        out["enabled"] = True
+        return out
 
     # --------------------------------------------------------------- verbs
     def _serve_collector(self, method: str) -> bool:
@@ -960,6 +985,7 @@ class APIServer:
         wire: str = "binary",
         persistence: "str | None" = None,
         collector: bool = False,
+        sentinel: "bool | object" = False,
     ) -> None:
         """``metrics_sources``: extra Prometheus-text providers appended to
         GET /metrics (e.g. a co-hosted controller family's workqueue set).
@@ -973,6 +999,12 @@ class APIServer:
         /telemetry/trace /telemetry/metrics /telemetry/flightrecorder
         /telemetry/top) — the apiserver doubles as the cluster's span/
         metrics sink, the ``kubetpu collector``-less deployment shape.
+        ``sentinel``: embed the anomaly sentinel (telemetry.sentinel) —
+        ``True`` builds one over the default rule table (or pass a
+        pre-built ``Sentinel``), bound to THIS server's /metrics text
+        (request histograms + the WAL fsync set), evaluated by a cadence
+        thread (``start()`` spawns it), and served at /debug/alerts +
+        /debug/bundle next to the other diagnostics.
         ``persistence``: a directory path makes the server's store durable
         (``--persistence dir``): recover-on-start replays the WAL +
         snapshot, every committed write is logged-then-applied, and
@@ -1047,8 +1079,33 @@ class APIServer:
                 )
             return "".join(lines)
 
+        # embedded anomaly sentinel: watches THIS server's own scrape
+        # (request histograms + the WAL fsync set) on a cadence thread
+        self.sentinel = None
+        if sentinel:
+            from ..telemetry.sentinel import Sentinel
+
+            self.sentinel = (
+                sentinel if isinstance(sentinel, Sentinel) else Sentinel()
+            )
+            bundle_sources: dict = {}
+            wal_stats = getattr(self.store, "wal_stats", None)
+            if callable(wal_stats):
+                bundle_sources["wal"] = wal_stats
+            bundle_sources["event_cache"] = self.event_cache.stats_by_codec
+            self.sentinel.bind(
+                metrics_fn=self.metrics_text,
+                tracer=self.tracer,
+                bundle_sources=bundle_sources,
+                process="apiserver",
+                component="apiserver",
+            )
+        sentinel_sources: tuple = ()
+        if self.sentinel is not None:
+            sentinel_sources = (self.sentinel.metrics_text,)
         self._metrics_sources = (
-            _event_cache_metrics, *wal_sources, *metrics_sources,
+            _event_cache_metrics, *wal_sources, *sentinel_sources,
+            *metrics_sources,
         )
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
@@ -1056,6 +1113,7 @@ class APIServer:
             "event_cache": self.event_cache,
             "tracer": self.tracer,
             "collector": self.collector,
+            "sentinel": self.sentinel,
             "wire_enabled": wire == "binary",
             "metrics_sources": self._metrics_sources,
             # responses are small; Nagle + the client's delayed ACK would
@@ -1093,9 +1151,15 @@ class APIServer:
 
     def start(self) -> "APIServer":
         self._thread.start()
+        if self.sentinel is not None:
+            # thread-served owner: the sentinel runs its own cadence
+            # (the scheduler instead evaluates at its cycle boundary)
+            self.sentinel.start()
         return self
 
     def close(self) -> None:
+        if self.sentinel is not None:
+            self.sentinel.close()
         self._httpd.closing = True
         self._httpd.shutdown()
         self._httpd.server_close()

@@ -49,6 +49,14 @@ Comparison rules (per metric name present in BOTH records):
   ``old * (1 + wal_tol)`` AND grew by more than ``min_wal_delta``
   absolute (host-noise wobble on a cheap WAL never gates; a durability
   hot path that started copying per watcher does).
+- **sentinel overhead + false positives** (``sentinel_overhead_frac`` and
+  ``alerts_fired`` on ``SentinelOverhead_*`` lines): overhead gates on the
+  telemetry-style relative+absolute rule; any alert fired on the judged
+  CLEAN run when the baseline ran clean always gates (a false positive is
+  a product bug, a true positive is a regression — both stop the diff).
+- **acceptance verdicts** (``unit == "verdict"``, e.g. ``SentinelSpike_*``
+  — the stall → fire → bundle → resolve chain as one bit): any drop from
+  a passing baseline gates, no tolerance.
 - **admission SLO** (``admission_p99_ms`` on trace records): a stage that
   WAS within its declared ``slo_budget_ms`` and now violates it always
   gates; within-budget drift gates on the p99-style relative+absolute
@@ -102,6 +110,10 @@ MIN_WAL_DELTA = 0.10
 #: shared-host wobble inside it never gates
 TELEMETRY_TOL = 0.50
 MIN_TELEMETRY_DELTA = 0.05
+#: sentinel overhead shares the telemetry calibration — a FRACTION (0..1)
+#: with the same hard <5% product budget: the absolute floor IS the budget
+SENTINEL_TOL = 0.50
+MIN_SENTINEL_DELTA = 0.05
 #: admission-latency SLO (admission_p99_ms on trace records): the primary
 #: gate is the record's own declared budget (slo_budget_ms) — a stage that
 #: WAS within budget and now violates it regresses regardless of relative
@@ -221,6 +233,8 @@ def compare(
     min_wal_delta: float = MIN_WAL_DELTA,
     telemetry_tol: float = TELEMETRY_TOL,
     min_telemetry_delta: float = MIN_TELEMETRY_DELTA,
+    sentinel_tol: float = SENTINEL_TOL,
+    min_sentinel_delta: float = MIN_SENTINEL_DELTA,
     admission_tol: float = ADMISSION_TOL,
     min_admission_delta_ms: float = MIN_ADMISSION_DELTA_MS,
     rss_tol: float = RSS_TOL,
@@ -333,6 +347,46 @@ def compare(
                     f"[tol +{telemetry_tol:.0%} & >{min_telemetry_delta:g}]"
                     if bad else ""
                 ),
+            ))
+        ose, nse = (o.get("sentinel_overhead_frac"),
+                    n.get("sentinel_overhead_frac"))
+        if isinstance(ose, (int, float)) and isinstance(nse, (int, float)):
+            bad = (
+                nse > ose * (1.0 + sentinel_tol)
+                and (nse - ose) > min_sentinel_delta
+            )
+            deltas.append(Delta(
+                name, "sentinel_overhead_frac", float(ose), float(nse), bad,
+                note=(
+                    f"[tol +{sentinel_tol:.0%} & >{min_sentinel_delta:g}]"
+                    if bad else ""
+                ),
+            ))
+        # the zero-false-positive gate: an alert fired on the judged CLEAN
+        # run (SentinelOverhead lines carry alerts_fired) when the baseline
+        # ran clean is either a sentinel false positive or a real anomaly —
+        # both must stop the diff, not hide in a nested dict
+        oaf, naf = o.get("alerts_fired"), n.get("alerts_fired")
+        if isinstance(oaf, (int, float)) and isinstance(naf, (int, float)):
+            bad = naf > 0 and oaf == 0
+            deltas.append(Delta(
+                name, "alerts_fired", float(oaf), float(naf), bad,
+                note=(
+                    "[sentinel fired on the clean judged run]"
+                    if bad else ""
+                ),
+            ))
+        # boolean acceptance-chain records (unit "verdict", e.g.
+        # SentinelSpike_*): value 1.0 = the whole chain held; any drop
+        # from a passing baseline is a regression, no tolerance applies
+        if o.get("unit") == "verdict" and isinstance(
+            o.get("value"), (int, float)
+        ) and isinstance(n.get("value"), (int, float)):
+            ovv, nvv = float(o["value"]), float(n["value"])
+            bad = nvv < ovv
+            deltas.append(Delta(
+                name, "verdict", ovv, nvv, bad,
+                note="[acceptance chain broke]" if bad else "",
             ))
         # admission-latency SLO (trace records): budget violation is the
         # primary rule — a stage that WAS within its declared budget and
@@ -456,6 +510,14 @@ def main(argv=None) -> int:
                     help="absolute telemetry-overhead growth floor below "
                          f"which it never gates (default "
                          f"{MIN_TELEMETRY_DELTA})")
+    ap.add_argument("--sentinel-tol", type=float, default=SENTINEL_TOL,
+                    help="fractional sentinel-overhead growth tolerated "
+                         f"(default {SENTINEL_TOL})")
+    ap.add_argument("--min-sentinel-delta", type=float,
+                    default=MIN_SENTINEL_DELTA,
+                    help="absolute sentinel-overhead growth floor below "
+                         f"which it never gates (default "
+                         f"{MIN_SENTINEL_DELTA})")
     ap.add_argument("--admission-tol", type=float, default=ADMISSION_TOL,
                     help="fractional admission-p99 growth tolerated for "
                          "within-budget drift (budget violations always "
@@ -496,6 +558,8 @@ def main(argv=None) -> int:
         min_wal_delta=args.min_wal_delta,
         telemetry_tol=args.telemetry_tol,
         min_telemetry_delta=args.min_telemetry_delta,
+        sentinel_tol=args.sentinel_tol,
+        min_sentinel_delta=args.min_sentinel_delta,
         admission_tol=args.admission_tol,
         min_admission_delta_ms=args.min_admission_delta_ms,
         rss_tol=args.rss_tol,

@@ -104,7 +104,8 @@ def cmd_serve(args) -> int:
 
 def _make_exporter(telemetry: str, process: str, component: str,
                    replica: str = "", tracer=None, metrics_fn=None,
-                   flight_fn=None, embedded_collector=None):
+                   flight_fn=None, alerts_fn=None, bundles_fn=None,
+                   embedded_collector=None):
     """One component's telemetry exporter from its ``--telemetry`` flag:
     "off" → None (byte-identical wire, zero export work), "embed" → the
     in-process collector transport, a URL → HTTP export to a remote
@@ -123,6 +124,7 @@ def _make_exporter(telemetry: str, process: str, component: str,
     return TelemetryExporter(
         url, process=process, component=component, replica=replica,
         tracer=tracer, metrics_fn=metrics_fn, flight_fn=flight_fn,
+        alerts_fn=alerts_fn, bundles_fn=bundles_fn,
         client=client,
     ).start()
 
@@ -195,11 +197,20 @@ def cmd_apiserver(args) -> int:
         store, host=args.host, port=args.port, registry=registry,
         wire=getattr(args, "wire", "binary"),
         collector=(telemetry == "embed"),
+        sentinel=(getattr(args, "sentinel", "off") == "on"),
     ).start()
     exporter = _make_exporter(
         telemetry, process=f"apiserver-{os.getpid()}",
         component="apiserver", tracer=server.tracer,
         metrics_fn=server.metrics_text,
+        alerts_fn=(
+            server.sentinel.alerts_json if server.sentinel is not None
+            else None
+        ),
+        bundles_fn=(
+            server.sentinel.bundles_payload if server.sentinel is not None
+            else None
+        ),
         embedded_collector=server.collector,
     )
     recovered = ""
@@ -261,7 +272,8 @@ def cmd_collector(args) -> int:
     print(f"kubetpu collector serving on {server.url} "
           f"(ingest: POST /telemetry/export /telemetry/clock; views: "
           f"/telemetry/trace /telemetry/metrics /telemetry/flightrecorder "
-          f"/telemetry/top; /healthz /readyz)",
+          f"/telemetry/top /telemetry/alerts /telemetry/bundle; "
+          f"/healthz /readyz)",
           flush=True)
     try:
         _serve_until_signal(stop)
@@ -364,16 +376,18 @@ def _fmt_top_row(name: str, p: dict) -> list[str]:
         num("conflict_rate", "%", scale=100.0, digits=2),
         num("wal_fsync_p99_ms", "ms", digits=2),
         (f"{e2e['p99_ms']:.1f}ms" if e2e.get("p99_ms") is not None else "-"),
+        (f"{p['alerts_firing']}!" if p.get("alerts_firing") else "-"),
         num("age_s", "s"),
     ]
 
 
 def render_top(summary: dict) -> str:
     """The ``kubetpu top`` console body: one row per exporting process
-    (pods/s, queue depth, conflict rate, WAL fsync p99, e2e p99) plus the
-    collector's span-drop footer."""
+    (pods/s, queue depth, conflict rate, WAL fsync p99, e2e p99, firing
+    sentinel alerts) plus the collector's span-drop footer — firing
+    alert names print inline under the table."""
     headers = ("PROCESS", "COMPONENT", "REPLICA", "PODS/S", "QUEUE",
-               "CONFLICT", "FSYNC-P99", "E2E-P99", "AGE")
+               "CONFLICT", "FSYNC-P99", "E2E-P99", "ALERTS", "AGE")
     procs = summary.get("processes") or {}
     rows = [
         _fmt_top_row(name, p) for name, p in sorted(procs.items())
@@ -399,9 +413,15 @@ def render_top(summary: dict) -> str:
             if st in stages
         ]
         lines.append("staged p99 (ms, worst process): " + " → ".join(parts))
+    for name, p in sorted(procs.items()):
+        if p.get("firing_alerts"):
+            lines.append(
+                f"ALERTS FIRING [{name}]: " + ", ".join(p["firing_alerts"])
+            )
     lines.append(
         f"collector: {len(procs)} process(es), "
-        f"{summary.get('spans_dropped', 0)} span(s) dropped"
+        f"{summary.get('spans_dropped', 0)} span(s) dropped, "
+        f"{summary.get('alerts_firing', 0)} alert(s) firing"
     )
     return "\n".join(lines)
 
@@ -434,6 +454,149 @@ def cmd_top(args) -> int:
             return 0
         if args.output != "json":
             print("", flush=True)
+
+
+def _http_json(url: str):
+    """GET one JSON body, or (None, message) on transport failure."""
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            return json.load(resp), ""
+    except OSError as e:
+        return None, f"cannot reach {url}: {e}"
+
+
+def render_alerts(body: dict) -> str:
+    """The ``kubetpu alerts`` console body — one row per alert, the
+    per-process /debug/alerts shape and the collector's merged
+    /telemetry/alerts shape both render (the merged rows carry a
+    ``processes`` breakdown, the per-process ones a fingerprint)."""
+    rows = body.get("alerts") or []
+    if not rows:
+        return "no alerts (every watched series within budget)"
+    headers = ("STATE", "SEVERITY", "RULE", "VALUE", "FIRES", "WHERE")
+    table = []
+    for a in rows:
+        procs = a.get("processes")
+        if isinstance(procs, list):
+            where = ",".join(
+                str(p.get("process") or "?") for p in procs
+            )
+        else:
+            where = str(body.get("process") or "-")
+        value = a.get("value")
+        table.append([
+            str(a.get("state") or "-"),
+            str(a.get("severity") or "-"),
+            str(a.get("rule") or "-"),
+            f"{value:.2f}" if isinstance(value, (int, float)) else "-",
+            str(a.get("fires") or 0),
+            where,
+        ])
+    widths = [
+        max(len(h), *(len(r[i]) for r in table))
+        for i, h in enumerate(headers)
+    ]
+    lines = [
+        "  ".join(c.ljust(w) for c, w in zip(cols, widths)).rstrip()
+        for cols in [list(headers), *table]
+    ]
+    for a in rows:
+        if a.get("reason") and a.get("state") != "resolved":
+            lines.append(f"  {a.get('rule')}: {a.get('reason')}")
+    lines.append(
+        f"{body.get('firing', 0)} firing, {body.get('pending', 0)} "
+        f"pending, {body.get('resolved', 0)} resolved"
+    )
+    return "\n".join(lines)
+
+
+def cmd_alerts(args) -> int:
+    """``kubetpu alerts``: the anomaly sentinel's live alert table —
+    one process's /debug/alerts (--server, the diagnostics URL) or the
+    cluster-wide merge from a collector's /telemetry/alerts."""
+    if getattr(args, "collector", ""):
+        url = args.collector.rstrip("/") + "/telemetry/alerts"
+    else:
+        url = args.server.rstrip("/") + "/debug/alerts"
+    body, err = _http_json(url)
+    if body is None:
+        print(err, file=sys.stderr)
+        return 2
+    if not body.get("enabled", True):
+        print("anomaly sentinel is disabled on this process "
+              "(--sentinel off)", file=sys.stderr)
+        return 1
+    if args.output == "json":
+        print(json.dumps(body, indent=2))
+    else:
+        print(render_alerts(body))
+    return 0
+
+
+def cmd_bundle(args) -> int:
+    """``kubetpu bundle``: triggered diagnostic bundles — summaries
+    without --id, the full capture (py stacks, queue snapshot, WAL/cache
+    stats, trace slice) with it; --out writes the capture to a file for
+    attaching to an incident."""
+    import urllib.parse
+
+    if getattr(args, "collector", ""):
+        base = args.collector.rstrip("/") + "/telemetry/bundle"
+    else:
+        base = args.server.rstrip("/") + "/debug/bundle"
+    q = {}
+    if args.id:
+        q["id"] = args.id
+    if getattr(args, "process", "") and getattr(args, "collector", ""):
+        q["process"] = args.process
+    url = base + ("?" + urllib.parse.urlencode(q) if q else "")
+    body, err = _http_json(url)
+    if body is None:
+        print(err, file=sys.stderr)
+        return 2
+    if not body.get("enabled", True):
+        print("anomaly sentinel is disabled on this process "
+              "(--sentinel off)", file=sys.stderr)
+        return 1
+    if args.id:
+        bundle = body.get("bundle")
+        if bundle is None:
+            print(body.get("error") or f"no bundle id {args.id}",
+                  file=sys.stderr)
+            return 1
+        text = json.dumps(bundle, indent=2, default=str)
+        if args.out:
+            with open(args.out, "w", encoding="utf-8") as f:
+                f.write(text + "\n")
+            trig = bundle.get("trigger") or {}
+            print(f"bundle {bundle.get('id')} "
+                  f"({trig.get('rule') or 'manual'}, "
+                  f"{len(bundle.get('sections') or {})} section(s), "
+                  f"{len((bundle.get('trace') or {}).get('traceEvents') or ())}"
+                  f" trace event(s)) -> {args.out}")
+        else:
+            print(text)
+        return 0
+    bundles = body.get("bundles") or []
+    if args.output == "json":
+        print(json.dumps(body, indent=2))
+        return 0
+    if not bundles:
+        print("no diagnostic bundles captured (no alert has fired)")
+        return 0
+    for b in bundles:
+        proc = b.get("process")
+        print(f"bundle {b.get('id')}"
+              + (f" [{proc}]" if proc else "")
+              + f": rule={b.get('rule') or 'manual'} "
+              f"severity={b.get('severity') or '-'} "
+              f"sections={','.join(b.get('sections') or ())} "
+              f"trace_events={b.get('trace_events', 0)}")
+    print(f"{len(bundles)} bundle(s); "
+          f"--id N for the full capture, --out FILE to save it")
+    return 0
 
 
 def _object_key(obj: Any) -> str:
@@ -624,6 +787,7 @@ def cmd_scheduler(args) -> int:
             partition or ("race" if args.replica_id else "")
         ),
         recorder=EventRecorder(store, "kubetpu-scheduler"),
+        sentinel=(getattr(args, "sentinel", "off") == "on"),
     )
     sched.enable_preemption()
     exporter = None
@@ -643,6 +807,14 @@ def cmd_scheduler(args) -> int:
             flight_fn=(
                 (lambda: fr.records_json(limit=512))
                 if fr is not None else None
+            ),
+            alerts_fn=(
+                sched.sentinel.alerts_json if sched.sentinel is not None
+                else None
+            ),
+            bundles_fn=(
+                sched.sentinel.bundles_payload if sched.sentinel is not None
+                else None
             ),
         )
     informers = SchedulerInformers(
@@ -701,6 +873,10 @@ def cmd_scheduler(args) -> int:
     print(f"kubetpu scheduler running against {args.server} "
           f"(engine {args.engine}"
           + (f"; diagnostics on {diag.url}" if diag is not None else "")
+          + (
+              "; sentinel on (/debug/alerts /debug/bundle /debug/queue)"
+              if sched.sentinel is not None else ""
+          )
           + ")", flush=True)
 
     def once():
@@ -1026,11 +1202,39 @@ def _render_explain(rec: dict) -> str:
     return "\n".join(lines)
 
 
+def _pod_event_lines(api_url: str, target: str) -> list[str]:
+    """The pod's Event timeline from an apiserver ("events" bucket) —
+    what every recorder said about it (Scheduled, FailedScheduling, …),
+    ordered by last occurrence, aggregation counts shown."""
+    import time as _time
+
+    from .apiserver import RemoteStore
+
+    items, _rv = RemoteStore(api_url).list("events")
+    evs = [
+        o for _k, o in items
+        if getattr(o, "regarding", "") == f"Pod/{target}"
+    ]
+    evs.sort(key=lambda e: getattr(e, "last_timestamp", 0.0) or 0.0)
+    lines = []
+    for e in evs:
+        last = getattr(e, "last_timestamp", 0.0) or 0.0
+        ts = _time.strftime("%H:%M:%S", _time.localtime(last)) if last else "-"
+        count = getattr(e, "count", 1) or 1
+        lines.append(
+            f"  {ts}  {e.type:<8} {e.reason:<18} {e.note}"
+            + (f"  (x{count})" if count > 1 else "")
+            + f"  [{e.reporting_controller}]"
+        )
+    return lines
+
+
 def cmd_explain(args) -> int:
     """``kubetpu explain pod/<ns>/<name>``: fetch the pod's decision record
     from a running scheduler's /debug/flightrecorder (--server, the
     diagnostics URL) or a dumped recorder JSON (--file) and render its
-    timeline + win/filter reasoning."""
+    timeline + win/filter reasoning; ``--api URL`` appends the pod's
+    Event timeline from the apiserver (the recorders' view)."""
     target = args.target
     if target.startswith("pod/"):
         target = target[len("pod/"):]
@@ -1071,7 +1275,21 @@ def cmd_explain(args) -> int:
     records = [
         r for r in body.get("records", ()) if r.get("pod") == target
     ]
+    event_lines: list[str] = []
+    if getattr(args, "api", ""):
+        try:
+            event_lines = _pod_event_lines(args.api, target)
+        except (ConnectionError, OSError) as e:
+            print(f"cannot fetch events from {args.api}: {e}",
+                  file=sys.stderr)
     if not records:
+        if event_lines:
+            # no decision record here (other replica, or ring-evicted)
+            # but the recorders' Event trail still tells the story
+            print(f"no flight-recorder record for pod {target}; "
+                  f"event timeline:")
+            print("\n".join(event_lines))
+            return 0
         print(f"no flight-recorder record for pod {target} "
               f"(evicted from the ring, or never scheduled here)",
               file=sys.stderr)
@@ -1081,6 +1299,9 @@ def cmd_explain(args) -> int:
         return 0
     for rec in records if args.all else records[:1]:
         print(_render_explain(rec))
+    if event_lines:
+        print("event timeline:")
+        print("\n".join(event_lines))
     return 0
 
 
@@ -1194,6 +1415,13 @@ def build_parser() -> argparse.ArgumentParser:
                           "server (/telemetry/*) and self-ingests — the "
                           "single-process sink; 'off' (default) exports "
                           "nothing and the wire stays byte-identical")
+    api.add_argument("--sentinel", default="off", choices=["on", "off"],
+                     help="embed the anomaly sentinel: burn-rate/outlier "
+                          "rules over this apiserver's own /metrics (WAL "
+                          "fsync stalls, encode-cache collapse), alert "
+                          "state at /debug/alerts, triggered diagnostic "
+                          "bundles at /debug/bundle; 'off' (default) runs "
+                          "zero evaluation work")
     api.set_defaults(fn=cmd_apiserver)
 
     check = sub.add_parser("check-config", help="validate a config file")
@@ -1305,6 +1533,17 @@ def build_parser() -> argparse.ArgumentParser:
                            "cadence; 'off' (default) exports nothing and "
                            "every request is byte-identical to a pre-"
                            "telemetry build")
+    schd.add_argument("--sentinel", default="off", choices=["on", "off"],
+                      help="anomaly sentinel: declarative burn-rate SLO "
+                           "rules + robust outlier detection over this "
+                           "scheduler's own /metrics, evaluated at the "
+                           "cycle boundary (alert lifecycle at "
+                           "/debug/alerts, a diagnostic bundle — py "
+                           "stacks, queue snapshot, trace slice — "
+                           "captured at fire time at /debug/bundle; "
+                           "rendered by 'kubetpu alerts'/'kubetpu "
+                           "bundle'); 'off' (default) runs zero "
+                           "evaluation work")
     schd.set_defaults(fn=cmd_scheduler)
 
     cm = sub.add_parser(
@@ -1374,6 +1613,11 @@ def build_parser() -> argparse.ArgumentParser:
     explain.add_argument("--all", action="store_true",
                          help="render every matching record, not just the "
                               "latest")
+    explain.add_argument("--api", default="",
+                         help="apiserver base URL: append the pod's Event "
+                              "timeline (Scheduled / FailedScheduling "
+                              "from the recorders, with aggregation "
+                              "counts) to the explanation")
     explain.set_defaults(fn=cmd_explain)
 
     st = sub.add_parser(
@@ -1434,6 +1678,45 @@ def build_parser() -> argparse.ArgumentParser:
                      help="refresh every --interval seconds until ^C")
     top.add_argument("--interval", type=float, default=2.0)
     top.set_defaults(fn=cmd_top)
+
+    al = sub.add_parser(
+        "alerts",
+        help="the anomaly sentinel's live alert table: one process's "
+             "/debug/alerts, or the cluster-wide merge from a "
+             "collector's /telemetry/alerts",
+    )
+    al.add_argument("--server", default="http://127.0.0.1:10251",
+                    help="scheduler DIAGNOSTICS base URL "
+                         "(the --diagnostics-port listener)")
+    al.add_argument("--collector", default="",
+                    help="read the merged cluster-wide table from a "
+                         "collector instead (one row per rule, worst "
+                         "state across processes wins)")
+    al.add_argument("-o", "--output", default="text",
+                    choices=("text", "json"))
+    al.set_defaults(fn=cmd_alerts)
+
+    bu = sub.add_parser(
+        "bundle",
+        help="triggered diagnostic bundles: summaries, or one full "
+             "capture (py stacks, queue snapshot, WAL/cache stats, "
+             "chrome-trace slice) with --id",
+    )
+    bu.add_argument("--server", default="http://127.0.0.1:10251",
+                    help="scheduler DIAGNOSTICS base URL")
+    bu.add_argument("--collector", default="",
+                    help="fetch from a collector's merged store instead")
+    bu.add_argument("--id", default="",
+                    help="bundle id (from the summary list or an alert's "
+                         "bundle_id); omit to list summaries")
+    bu.add_argument("--process", default="",
+                    help="disambiguate --id by process (collector mode)")
+    bu.add_argument("--out", default="",
+                    help="write the full bundle JSON to FILE instead of "
+                         "stdout")
+    bu.add_argument("-o", "--output", default="text",
+                    choices=("text", "json"))
+    bu.set_defaults(fn=cmd_bundle)
 
     wd = sub.add_parser(
         "watch-driver",

@@ -79,3 +79,20 @@ class EventRecorder:
         except Exception:
             # an event write must never break the action it annotates
             self.dropped += 1
+
+    def metrics_text(self) -> str:
+        """``kubetpu_events_dropped_total{controller=...}`` — the
+        best-effort contract made visible: mounted on the OWNING
+        component's /metrics (the scheduler folds it into its scrape),
+        where the sentinel's events-dropped rule watches it."""
+        from ..metrics.registry import Registry
+
+        r = Registry()
+        c = r.counter(
+            "kubetpu_events_dropped_total",
+            "Best-effort Event store-writes that failed, by recording "
+            "controller.",
+            labels=("controller",),
+        )
+        c.labels(self.controller).inc(self.dropped)
+        return r.expose()

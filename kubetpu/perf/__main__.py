@@ -74,6 +74,17 @@ def main(argv=None) -> None:
                          "exporters on their cadence; the record embeds "
                          "span totals + the drop counter (the "
                          "TelemetryOverhead on/off comparison's 'on' half)")
+    ap.add_argument("--sentinel", default="off",
+                    choices=["on", "off", "spike"],
+                    help="fullstack or --trace: ride the anomaly "
+                         "sentinel on the scheduler's cycle boundary "
+                         "(bench-scaled rule windows; the record embeds "
+                         "its lifecycle stats and the clean/false-"
+                         "positive verdict); 'spike' additionally "
+                         "injects a one-shot scheduling stall mid-run "
+                         "and reports the fire→bundle→resolve verdict. "
+                         "With --trace the burn budget is the profile's "
+                         "declared slo_budget_ms")
     ap.add_argument("--processes", type=int, default=0,
                     help="with --fullstack: run the apiserver and N "
                          "scheduler replicas as separate OS PROCESSES "
@@ -164,6 +175,8 @@ def main(argv=None) -> None:
             encode_cache=(args.encode_cache == "on"),
             wire=args.wire,
             artifacts_dir=args.artifacts_dir,
+            sentinel=(args.sentinel != "off"),
+            sentinel_spike=(args.sentinel == "spike"),
         )
         print(json.dumps(r.to_json()))
         return
@@ -247,6 +260,8 @@ def main(argv=None) -> None:
             r = run_workload_full_stack(
                 case, wl, wire=args.wire, watch_fanout=args.watch_fanout,
                 telemetry=(args.telemetry == "on"),
+                sentinel=(args.sentinel != "off"),
+                sentinel_spike=(args.sentinel == "spike"),
                 **kwargs,
             )
             print(json.dumps(r.to_json()))

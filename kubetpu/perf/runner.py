@@ -140,6 +140,11 @@ class WorkloadResult:
     # (--telemetry): ingested span totals and the drop counter the
     # TelemetryOverhead gate asserts stayed zero
     telemetry: dict | None = None
+    # anomaly-sentinel view when a run rode the sentinel (--sentinel):
+    # lifecycle stats (evaluations/fired/bundles), the per-alert final
+    # states, clean (nothing fired — the false-positive gate), and in
+    # spike mode the injected-stall fire→bundle→resolve verdict
+    sentinel: dict | None = None
     # multi-process deployment view (run_workload_multiprocess): how many
     # REAL OS processes carried the run (apiserver + schedulers +
     # collector + watch drivers), each child's peak RSS / CPU seconds /
@@ -260,6 +265,8 @@ class WorkloadResult:
             out["trace"] = self.trace_stats
         if self.telemetry is not None:
             out["telemetry"] = self.telemetry
+        if self.sentinel is not None:
+            out["sentinel"] = self.sentinel
         if self.n_processes:
             out["n_processes"] = self.n_processes
             out["restarts"] = self.restarts
@@ -1160,6 +1167,9 @@ def run_workload_trace(
     scoped_invalidation: bool = True,
     wire: str = "binary",
     artifacts_dir: str | None = None,
+    sentinel: bool = False,
+    sentinel_spike: bool = False,
+    spike_stall_s: float = 6.0,
 ) -> WorkloadResult:
     """Replay a ``workloads.TraceProfile`` against the real scheduler loop
     and measure the admission-latency SLO: p50/p99 of enqueue→bind over
@@ -1176,13 +1186,35 @@ def run_workload_trace(
     the record is emitted TRUNCATED but parseable (a hung 100k-node rung
     must never eat the whole bench wall). ``scoped_invalidation=False``
     pins the encode cache's pre-PR-14 full-epoch flush (the A/B control
-    the node-wave evidence is measured against)."""
+    the node-wave evidence is measured against).
+
+    ``sentinel=True`` rides the anomaly sentinel on the loop with the
+    profile's DECLARED ``slo_budget_ms`` as the burn-rate budget — the
+    honest venue for the admission-SLO rule, because paced arrivals keep
+    a clean replay inside budget (bulk-create workloads blow any fixed
+    budget on tail queue-wait alone). ``sentinel_spike=True`` injects a
+    one-shot ``spike_stall_s`` scheduler stall a third of the way
+    through the replay: the loop keeps firing trace arrivals but skips
+    the scheduling cycle, so the backlog accrues REAL admission latency
+    — the record's ``sentinel.spike`` verdict carries the
+    fire→bundle→resolve acceptance."""
     from ..sched.scheduler import Scheduler
     from . import workloads as W
 
     if isinstance(profile, str):
         profile = W.TRACE_PROFILES[profile]
     events = profile.events()
+
+    sentinel_obj = None
+    if sentinel or sentinel_spike:
+        from ..telemetry.rules import fast_rules
+        from ..telemetry.sentinel import Sentinel as _Sentinel
+
+        sentinel_obj = _Sentinel(
+            rules=fast_rules(),
+            slo_budget_ms=profile.slo_budget_ms,
+            interval_s=0.25,
+        )
 
     srv = remote = informers = None
     if mode == "direct":
@@ -1191,6 +1223,7 @@ def run_workload_trace(
             client, profile=C.Profile(), max_batch=max_batch, engine=engine,
             encode_cache=encode_cache,
             feature_gates={"GenericWorkload": True, "GangScheduling": True},
+            sentinel=sentinel_obj if sentinel_obj is not None else False,
         )
         client.sched = sched
         driver = _TraceDirectDriver(sched, client)
@@ -1206,6 +1239,7 @@ def run_workload_trace(
             client, profile=C.Profile(), max_batch=max_batch, engine=engine,
             encode_cache=encode_cache,
             feature_gates={"GenericWorkload": True, "GangScheduling": True},
+            sentinel=sentinel_obj if sentinel_obj is not None else False,
         )
         informers = SchedulerInformers(remote, sched)
         informers.start()
@@ -1255,6 +1289,9 @@ def run_workload_trace(
         i = 0
         last_progress = t0
         bound_prev = 0
+        spike = {"stall_s": spike_stall_s, "until": None,
+                 "start_wall": None, "end_wall": None}
+        spike_armed = sentinel_spike
 
         def live_unbound() -> int:
             bt = driver.bind_times()
@@ -1294,11 +1331,29 @@ def run_workload_trace(
                     driver.drain_node(ev.name)
                 elif ev.kind == "create_group":
                     driver.create_group(ev)
+            if spike_armed and i >= max(1, len(events) // 3):
+                spike_armed = False
+                spike["start_wall"] = time.time()
+                spike["until"] = now + spike["stall_s"]
+            stalled = (
+                spike["until"] is not None and spike["end_wall"] is None
+            )
+            if stalled and now >= spike["until"]:
+                spike["end_wall"] = time.time()
+                stalled = False
             moved = driver.pump()
-            res = sched.schedule_batch()
-            driver.pump()
-            sched.dispatcher.sync()
-            sched._drain_bind_completions()
+            if stalled:
+                # the injected scheduler stall: arrivals keep landing (the
+                # pump above) while the cycle is skipped — the backlog
+                # accrues REAL admission latency, which is what makes the
+                # burn-rate fire distinguishable from a clean replay
+                res = {"scheduled": 0}
+                time.sleep(0.002)
+            else:
+                res = sched.schedule_batch()
+                driver.pump()
+                sched.dispatcher.sync()
+                sched._drain_bind_completions()
             rss.sample()
             bound_now = len(driver.bind_times())
             progressed = (
@@ -1327,6 +1382,12 @@ def run_workload_trace(
         sched.dispatcher.sync()
         driver.pump()
         sched._drain_bind_completions()
+        sentinel_report = None
+        if sentinel_obj is not None:
+            sentinel_report = _sentinel_settle(
+                sentinel_obj,
+                spike if spike["end_wall"] is not None else None,
+            )
 
         # admission latencies: enqueue→bind per created pod
         bt = driver.bind_times()
@@ -1394,6 +1455,7 @@ def run_workload_trace(
             ),
             peak_rss_bytes=rss.peak,
             truncated=truncated,
+            sentinel=sentinel_report,
             trace_stats=trace_stats,
             metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
             artifacts=artifacts,
@@ -1528,6 +1590,77 @@ class _WatchFanout:
             t.join(timeout=5)
 
 
+def _sentinel_settle(sentinel, spike: "dict | None",
+                     resolve_timeout_s: float = 30.0) -> dict:
+    """Post-run sentinel settle: keep evaluating on the real clock until
+    every alert that fired has resolved (the recovery half of the
+    fire→resolve acceptance — the rule windows slide past the spike and
+    the clean streak closes the lifecycle), then fold the evidence into
+    the record. ``spike`` carries the injected stall's wall window; with
+    it the report adds the fire-latency / bundle-coverage verdicts the
+    SentinelSpike bench stage asserts."""
+    import time as _time
+
+    deadline = _time.monotonic() + resolve_timeout_s
+    while _time.monotonic() < deadline:
+        sentinel.evaluate()
+        snap = sentinel.alerts_json()
+        if snap["firing"] == 0 and snap["pending"] == 0:
+            break
+        _time.sleep(sentinel.interval_s)
+    out = dict(sentinel.stats())
+    snap = sentinel.alerts_json()
+    out["alerts"] = [
+        {k: a[k] for k in ("rule", "state", "severity", "fires", "value")}
+        for a in snap["alerts"]
+    ]
+    # the zero-false-positive assert for the clean (no-spike) run
+    out["clean"] = out["fired_total"] == 0
+    if spike is not None:
+        target = next(
+            (a for a in snap["alerts"]
+             if a["rule"] == "admission-slo-burn"),
+            None,
+        )
+        verdict: dict = {
+            "stall_s": round(spike["end_wall"] - spike["start_wall"], 3),
+            "fired": target is not None and target["fires"] > 0,
+            "resolved": target is not None
+            and target["state"] == "resolved",
+        }
+        if target is not None and target.get("fired_at_wall"):
+            lat = target["fired_at_wall"] - spike["end_wall"]
+            verdict["fire_latency_s"] = round(lat, 3)
+            # "within one evaluation interval" — of the bad events
+            # becoming VISIBLE, which is one recovery cycle after the
+            # stall ends: the backlog's first bind wave (a full-batch
+            # encode + dispatch) has to land in the histogram before a
+            # single bad observation exists. Two intervals of cadence
+            # slack + a 3s bind-wave allowance
+            verdict["fired_within_interval"] = (
+                lat <= 2 * sentinel.interval_s + 3.0
+            )
+        bundle = next(
+            (b for b in sentinel.bundles_payload()
+             if (b.get("trigger") or {}).get("rule")
+             == "admission-slo-burn"),
+            None,
+        )
+        verdict["bundle_captured"] = bundle is not None
+        if bundle is not None:
+            # the trace slice looks back trace_window_s from capture:
+            # a capture this close to the stall has the stall in-frame
+            verdict["bundle_covers_stall"] = (
+                bundle["captured_wall"] - spike["end_wall"]
+                <= sentinel.trace_window_s
+            )
+            verdict["bundle_sections"] = sorted(
+                (bundle.get("sections") or {}).keys()
+            )
+        out["spike"] = verdict
+    return out
+
+
 def run_workload_full_stack(
     case: W.TestCase | str,
     workload: W.Workload | str,
@@ -1546,6 +1679,8 @@ def run_workload_full_stack(
     wire: str = "binary",
     watch_fanout: int = 0,
     telemetry: bool = False,
+    sentinel: bool = False,
+    sentinel_spike: bool = False,
 ) -> WorkloadResult:
     """The same measurement through the FULL STACK: an in-process REST
     apiserver + RemoteStore + informers + dispatcher binds over HTTP —
@@ -1571,7 +1706,14 @@ def run_workload_full_stack(
     processes' exporters on their 1 s cadence — so the
     TelemetryOverhead_* comparison measures the whole tax, not a
     cut-down one; the result carries the collector's span totals and
-    drop counter."""
+    drop counter.
+    ``sentinel`` rides the anomaly sentinel (telemetry.sentinel) on the
+    scheduler's cycle boundary with bench-scaled rule windows
+    (rules.fast_rules) — the SentinelOverhead_* pair's "on" half; the
+    result carries its lifecycle stats (``clean`` = nothing fired).
+    ``sentinel_spike`` additionally injects a one-shot scheduling stall
+    mid-measured-phase and reports the fire→bundle→resolve verdict
+    (the acceptance scenario — NOT a judged throughput row)."""
     import collections
 
     from ..apiserver import APIServer, RemoteStore
@@ -1635,11 +1777,27 @@ def run_workload_full_stack(
             return errs
 
     client = _CountingClient(remote)
+    sentinel_obj = None
+    if sentinel or sentinel_spike:
+        from ..telemetry.rules import fast_rules
+        from ..telemetry.sentinel import Sentinel as _Sentinel
+
+        # bench-scaled windows (seconds, not minutes) so the lifecycle
+        # completes inside a bench stage; the declared budget only
+        # exists in spike mode — a clean run keeps the admission burn
+        # rule dormant and judges the budget-less rules (outlier,
+        # cache-collapse) for false positives instead
+        sentinel_obj = _Sentinel(
+            rules=fast_rules(),
+            slo_budget_ms=250.0 if sentinel_spike else None,
+            interval_s=0.25,
+        )
     sched = Scheduler(
         client, profile=profile or C.Profile(), max_batch=max_batch,
         engine=engine, pipeline=pipeline, encode_cache=encode_cache,
         bulk=bulk, mesh=mesh, flight_recorder=flight_recorder,
         feature_gates=dict(case.feature_gates) if case.feature_gates else None,
+        sentinel=sentinel_obj if sentinel_obj is not None else False,
     )
     if telemetry:
         from ..telemetry.exporter import TelemetryExporter
@@ -1670,6 +1828,13 @@ def run_workload_full_stack(
     churns: list[_FsChurn] = []
     deleters: list[_FsDeleter] = []
     created_keys_by_ns: dict[str, list[str]] = {}
+    # one-shot injected stall (sentinel_spike): armed when the MEASURED
+    # phase starts, fired once a third of its pods have bound — the
+    # backlogged pods then bind with e2e latencies past the declared
+    # budget, which is exactly the bad-event burst the admission
+    # burn-rate rule exists to catch
+    spike = {"armed": False, "stall_s": 0.75,
+             "start_wall": None, "end_wall": None}
 
     def settle(target: int, namespaces: tuple[str, ...]) -> tuple[int, float]:
         def bound_now() -> int:
@@ -1684,6 +1849,11 @@ def run_workload_full_stack(
             now = time.perf_counter()
             if now > deadline:
                 break
+            if spike["armed"] and done >= target // 3:
+                spike["armed"] = False
+                spike["start_wall"] = time.time()
+                time.sleep(spike["stall_s"])
+                spike["end_wall"] = time.time()
             for ch in churns:
                 ch.maybe_fire(now)
             for d in deleters:
@@ -1751,6 +1921,8 @@ def run_workload_full_stack(
                     )
                     requests0 = srv.metrics.total_requests()
                     wire0 = srv.metrics.wire_bytes_total()
+                    if sentinel_spike:
+                        spike["armed"] = True
                 items = []
                 for j in range(count):
                     pod = template(f"{prefix}-{ns}-{j}", ns)
@@ -1771,6 +1943,12 @@ def run_workload_full_stack(
         informers.pump()
         sched.dispatcher.sync()
         sched._drain_bind_completions()
+        sentinel_report = None
+        if sentinel_obj is not None:
+            sentinel_report = _sentinel_settle(
+                sentinel_obj,
+                spike if spike["end_wall"] is not None else None,
+            )
     finally:
         if fanout is not None:
             fanout.stop()
@@ -1830,6 +2008,7 @@ def run_workload_full_stack(
         cycles=sched.metrics.cycles - cycles0,
         p99_attempt_latency_ms=lat,
         telemetry=telemetry_stats,
+        sentinel=sentinel_report,
         metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
         artifacts=artifacts,
     )

@@ -537,3 +537,55 @@ class PriorityQueue:
             "gated": len(self._gated),
             "in_flight": len(self._in_flight),
         }
+
+    def debug_json(self, limit: int = 512) -> dict:
+        """The ``/debug/queue`` body: per-pod pending reasons — which
+        pool, how many attempts/requeues, the unschedulable/pending
+        plugin sets, the backoff deadline (absolute + seconds remaining)
+        and accumulated queue wait. Point-in-time and best-effort: the
+        queue is single-owner by design, so a diagnostics thread reads a
+        live snapshot (list() copies per pool) — a concurrent mutation
+        can tear counts across pools, never crash the walk. The bundle
+        capture reuses this view verbatim."""
+        now = self._clock()
+        pods: list[dict] = []
+        pools = (
+            ("active", self._active), ("backoff", self._backoff),
+            ("unschedulable", self._unschedulable), ("gated", self._gated),
+            ("in_flight", self._in_flight),
+        )
+        for pool_name, pool in pools:
+            for info in list(pool.values()):
+                entry: dict = {
+                    "pod": info.key,
+                    "queue": pool_name,
+                    "attempts": info.attempts,
+                    "requeues": info.unschedulable_count,
+                    "consecutive_errors": info.consecutive_errors,
+                    "queue_wait_s": round(info.queue_wait_s, 6),
+                }
+                if info.unschedulable_plugins:
+                    entry["unschedulable_plugins"] = sorted(
+                        info.unschedulable_plugins
+                    )
+                if info.pending_plugins:
+                    entry["pending_plugins"] = sorted(info.pending_plugins)
+                if pool_name == "backoff":
+                    deadline = self._backoff_time(info)
+                    entry["backoff_deadline"] = round(deadline, 6)
+                    entry["backoff_remaining_s"] = round(
+                        max(deadline - now, 0.0), 6
+                    )
+                if info.nominated_node_name:
+                    entry["nominated_node"] = info.nominated_node_name
+                pods.append(entry)
+                if len(pods) >= limit:
+                    break
+            if len(pods) >= limit:
+                break
+        counts = self.stats()
+        return {
+            "counts": counts,
+            "pods": pods,
+            "truncated": sum(counts.values()) > len(pods),
+        }

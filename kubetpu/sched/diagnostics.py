@@ -19,6 +19,12 @@ small listener bound to one ``Scheduler``:
   not ready, the reference's install split).
 - ``GET /trace``        the tracer's buffered spans as Chrome-trace JSON
   (Perfetto-loadable; cycle ids join the device counter records).
+- ``GET /debug/queue``  per-pod pending reasons from the scheduling
+  queue: pool, attempts, unschedulable-plugin sets, backoff deadlines.
+- ``GET /debug/alerts`` the anomaly sentinel's alert state (pending →
+  firing → resolved, fingerprint-deduped) when ``--sentinel on``.
+- ``GET /debug/bundle`` triggered diagnostic bundles (summaries, or one
+  full capture with ``?id=N``).
 """
 
 from __future__ import annotations
@@ -69,6 +75,18 @@ class _DiagHandler(BaseHTTPRequestHandler):
                     "/debug/flightrecorder": lambda q: (
                         "application/json",
                         json.dumps(diag.flightrecorder_json(q)),
+                    ),
+                    "/debug/queue": lambda q: (
+                        "application/json",
+                        json.dumps(diag.queue_json(q)),
+                    ),
+                    "/debug/alerts": lambda q: (
+                        "application/json",
+                        json.dumps(diag.alerts_json()),
+                    ),
+                    "/debug/bundle": lambda q: (
+                        "application/json",
+                        json.dumps(diag.bundle_json(q), default=str),
                     ),
                 },
             )
@@ -187,6 +205,46 @@ class DiagnosticsServer:
         except ValueError:
             limit = 256
         out = fr.records_json(pod=one("pod") or None, limit=limit)
+        out["enabled"] = True
+        return out
+
+    def queue_json(self, query: "dict | None" = None) -> dict:
+        """GET /debug/queue[?limit=N]: the scheduling queue's per-pod
+        pending reasons — pool, attempts/requeues, unschedulable-plugin
+        sets, backoff deadlines, accumulated queue wait (the one major
+        subsystem that had no introspection endpoint; the sentinel's
+        bundle capture reuses it)."""
+        q = getattr(self.scheduler, "queue", None)
+        if q is None:
+            return {"enabled": False, "counts": {}, "pods": []}
+        qq = query or {}
+        raw = qq.get("limit", "")
+        raw = raw[-1] if isinstance(raw, list) else raw
+        try:
+            limit = int(raw or 512)
+        except ValueError:
+            limit = 512
+        out = q.debug_json(limit=limit)
+        out["enabled"] = True
+        return out
+
+    def alerts_json(self) -> dict:
+        """GET /debug/alerts: the sentinel's alert-lifecycle state
+        (pending/firing/resolved, fingerprint-deduped)."""
+        s = getattr(self.scheduler, "sentinel", None)
+        if s is None:
+            return {"enabled": False, "alerts": [], "firing": 0}
+        out = s.alerts_json()
+        out["enabled"] = True
+        return out
+
+    def bundle_json(self, query: "dict | None" = None) -> dict:
+        """GET /debug/bundle[?id=N]: diagnostic-bundle summaries (or one
+        full capture by id) from the sentinel's bounded ring."""
+        s = getattr(self.scheduler, "sentinel", None)
+        if s is None:
+            return {"enabled": False, "bundles": [], "count": 0}
+        out = s.bundles_json(query)
         out["enabled"] = True
         return out
 

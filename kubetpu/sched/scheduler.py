@@ -185,6 +185,7 @@ class Scheduler:
         flight_recorder: bool = True,
         replica_id: str = "",
         federation_mode: str = "",
+        sentinel: "bool | Any" = False,
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -240,7 +241,15 @@ class Scheduler:
         record and flight-recorder entry so multi-replica bind histories
         stay attributable, and the pair labels
         ``scheduler_federation_conflicts_total{mode,replica}``. Empty in
-        single-scheduler mode."""
+        single-scheduler mode.
+        ``sentinel``: the anomaly sentinel (telemetry.sentinel) — ``True``
+        builds one over the default rule table, or pass a pre-built
+        ``Sentinel`` (the perf runner does, carrying the run's declared
+        ``slo_budget_ms``); either way it is BOUND to this scheduler's
+        metrics text, tracer, queue and cycle records, evaluated at the
+        cycle boundary (``maybe_evaluate`` — no extra thread), and served
+        at /debug/alerts + /debug/bundle. ``False`` (default) runs zero
+        extra work."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
@@ -441,6 +450,27 @@ class Scheduler:
         )
         # permitted-with-Wait pods parked before binding (waitingPodsMap)
         self.waiting_pods: dict[str, lc.WaitingPod] = {}
+        # --- anomaly sentinel (telemetry.sentinel) -----------------------
+        self.sentinel = None
+        if sentinel:
+            from ..telemetry.sentinel import Sentinel
+
+            self.sentinel = (
+                sentinel if isinstance(sentinel, Sentinel) else Sentinel()
+            )
+            self.sentinel.bind(
+                metrics_fn=self.metrics_text,
+                tracer=self.tracer,
+                bundle_sources={
+                    "queue": self.queue.debug_json,
+                    "cycle_records": self.metrics.tpu.records_json,
+                    "dispatcher": self.dispatcher.stats,
+                },
+                process=(
+                    f"scheduler-{replica_id}" if replica_id else "scheduler"
+                ),
+                component="scheduler",
+            )
 
     def enable_preemption(self) -> None:
         """Wire the DefaultPreemption PostFilter
@@ -922,6 +952,11 @@ class Scheduler:
             return self._schedule_batch_inner(max_batch)
         finally:
             self.dispatcher.flush()
+            if self.sentinel is not None:
+                # the sentinel rides the cycle boundary: at most one rule
+                # evaluation per interval, on the owner's thread (the
+                # SentinelOverhead bench pair prices exactly this)
+                self.sentinel.maybe_evaluate()
 
     def _schedule_batch_inner(
         self, max_batch: int | None = None
@@ -1767,7 +1802,17 @@ class Scheduler:
         executed/errors + bulk batch counts) are folded in at scrape time
         so the DiagnosticsServer surfaces API-write failures."""
         self.metrics.prom.set_dispatcher_stats(self.dispatcher.stats())
-        return self.metrics.prom.expose()
+        text = self.metrics.prom.expose()
+        if self.recorder is not None and hasattr(
+            self.recorder, "metrics_text"
+        ):
+            # the owning component exposes its recorder's drop counter
+            # (kubetpu_events_dropped_total) — the best-effort event
+            # contract made scrape-visible
+            text += self.recorder.metrics_text()
+        if self.sentinel is not None:
+            text += self.sentinel.metrics_text()
+        return text
 
     def run_until_idle(self, max_cycles: int = 10000) -> int:
         """Drive cycles until no pod is ready (harness/test mode). Returns
@@ -1797,3 +1842,5 @@ class Scheduler:
         self._drain_bind_completions()
         if self._extender_pool is not None:
             self._extender_pool.shutdown(wait=False)
+        if self.sentinel is not None:
+            self.sentinel.close()

@@ -780,6 +780,7 @@ class MemStore:
             wal = self._wal
             if wal is None:
                 return None
+            p50 = wal.fsync_hist.quantile(0.50)
             p99 = wal.fsync_hist.quantile(0.99)
             return {
                 "records_appended": wal.records_appended,
@@ -787,7 +788,12 @@ class MemStore:
                 "fsyncs": wal.fsyncs,
                 "records_since_snapshot": wal.records_since_snapshot,
                 # the WALOverhead_* bench records embed this: the p99
-                # group-commit fsync in ms (None before the first fsync)
+                # group-commit fsync in ms (None before the first fsync).
+                # p50 rides along as the sentinel bundle's WAL stat feed —
+                # a stall diagnosis needs the baseline next to the tail
+                "fsync_p50_ms": (
+                    None if math.isnan(p50) else round(p50 * 1000.0, 3)
+                ),
                 "fsync_p99_ms": (
                     None if math.isnan(p99) else round(p99 * 1000.0, 3)
                 ),

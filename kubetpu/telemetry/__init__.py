@@ -16,6 +16,12 @@ Three pieces stitch N control-plane processes into one observable system:
   text and flight recorder on a cadence and ships batches to a collector.
   A no-op when telemetry is off (``--telemetry off`` = byte-identical
   wire: no traceparent is stamped, nothing is exported).
+- ``sentinel``/``rules`` — the ACTIVE layer: an in-process anomaly
+  sentinel evaluating a declarative rule table (multi-window burn-rate
+  SLO rules against declared budgets, EWMA/MAD outlier rules) over the
+  live metric series, with a pending → firing → resolved alert
+  lifecycle and triggered diagnostic bundles (``/debug/alerts``,
+  ``/debug/bundle``, merged by the collector at ``/telemetry/alerts``).
 """
 
 from .context import (  # noqa: F401
@@ -25,3 +31,5 @@ from .context import (  # noqa: F401
     new_trace_id,
     parse_traceparent,
 )
+from .rules import DEFAULT_RULES, Rule, default_rules, fast_rules  # noqa: F401
+from .sentinel import Sentinel  # noqa: F401

@@ -24,7 +24,13 @@ fingerprints match, JSON otherwise). The collector:
 - **serves the console**: ``/telemetry/top`` summarizes per process —
   pods/s (rate between the last two ingests), queue depth, conflict
   rate, WAL fsync p99, staged e2e percentiles — what ``kubetpu top``
-  renders.
+  renders (firing sentinel alerts ride inline).
+- **merges alerts and bundles**: each process's sentinel alert table
+  ships with its export batch; ``/telemetry/alerts`` collapses them by
+  (rule, series) into one cluster-wide row per alert (worst state
+  wins, per-process breakdown attached), and ``/telemetry/bundle``
+  serves the diagnostic bundles captured at fire time (deduped by
+  per-process id, bounded per process).
 
 Ingest is bounded: per-process span rings drop oldest-first and count
 drops (``kubetpu_collector_spans_dropped_total`` — the TelemetryOverhead
@@ -45,6 +51,11 @@ from ..metrics.textparse import ParseError, parse_prometheus_text
 MAX_SPANS_PER_PROCESS = 131072
 #: processes tracked before the oldest-idle one is evicted
 MAX_PROCESSES = 256
+#: diagnostic bundles retained per process (dedup by id, oldest evicted)
+MAX_BUNDLES_PER_PROCESS = 8
+
+#: alert-state precedence for the cluster-wide merge (worst wins)
+_ALERT_RANK = {"firing": 0, "pending": 1, "resolved": 2}
 
 
 def relabel_metrics_text(text: str, extra: "dict[str, str]") -> str:
@@ -118,6 +129,12 @@ class _ProcState:
         self.ingests = 0
         self.metrics_text = ""
         self.flight_records: list[dict] = []
+        # the process sentinel's latest alert table (replaced wholesale
+        # each ingest — alert state lives at the source, this is a view)
+        self.alerts: list[dict] = []
+        # diagnostic bundles, deduped by the sentinel's per-process id
+        # (the exporter re-ships its retained ring every batch)
+        self.bundles: "OrderedDict[Any, dict]" = OrderedDict()
         # (receive mono, {counter key: value}) of the last two ingests —
         # the rate window the console's pods/s comes from
         self.rate_prev: "tuple[float, dict] | None" = None
@@ -236,6 +253,20 @@ class Collector:
             fr = payload.get("flight_records")
             if isinstance(fr, dict) and isinstance(fr.get("records"), list):
                 st.flight_records = fr["records"]
+            av = payload.get("alerts")
+            if isinstance(av, dict):
+                av = av.get("alerts")
+            if isinstance(av, list):
+                st.alerts = [a for a in av if isinstance(a, dict)]
+            bv = payload.get("bundles")
+            if isinstance(bv, list):
+                for b in bv:
+                    if not isinstance(b, dict) or "id" not in b:
+                        continue
+                    if b["id"] not in st.bundles:
+                        st.bundles[b["id"]] = b
+                        while len(st.bundles) > MAX_BUNDLES_PER_PROCESS:
+                            st.bundles.popitem(last=False)
             return {"ok": True, "dropped": st.dropped}
 
     # ---------------------------------------------------------------- reads
@@ -387,6 +418,97 @@ class Collector:
         records = records[: max(limit, 1)]
         return {"enabled": True, "records": records, "count": len(records)}
 
+    # ---------------------------------------------------------------- alerts
+    def alerts(self) -> dict:
+        """The cluster-wide alert table (``/telemetry/alerts``): every
+        process's sentinel alerts merged by (rule, series) — per-process
+        fingerprints differ by design, the rule identity is what's
+        cluster-wide. One replica firing while another is clean collapses
+        to ONE row in the worst state (firing > pending > resolved), with
+        the per-process breakdown kept in ``processes``."""
+        with self._lock:
+            per_proc = [
+                (name, list(st.alerts)) for name, st in self._procs.items()
+            ]
+        merged: "OrderedDict[tuple, dict]" = OrderedDict()
+        for name, alerts in per_proc:
+            for a in alerts:
+                key = (a.get("rule"), a.get("series"))
+                entry = merged.get(key)
+                if entry is None:
+                    entry = merged[key] = {
+                        "rule": a.get("rule"),
+                        "series": a.get("series"),
+                        "severity": a.get("severity"),
+                        "state": a.get("state"),
+                        "value": a.get("value"),
+                        "reason": a.get("reason"),
+                        "fires": 0,
+                        "processes": [],
+                    }
+                entry["processes"].append({
+                    "process": name,
+                    "fingerprint": a.get("fingerprint"),
+                    "state": a.get("state"),
+                    "value": a.get("value"),
+                    "bundle_id": a.get("bundle_id"),
+                })
+                entry["fires"] += int(a.get("fires") or 0)
+                if _ALERT_RANK.get(str(a.get("state")), 3) < _ALERT_RANK.get(
+                    str(entry["state"]), 3
+                ):
+                    entry["state"] = a.get("state")
+                    entry["severity"] = a.get("severity")
+                    entry["value"] = a.get("value")
+                    entry["reason"] = a.get("reason")
+        rows = sorted(
+            merged.values(),
+            key=lambda e: (
+                _ALERT_RANK.get(str(e["state"]), 3), str(e["rule"])
+            ),
+        )
+        return {
+            "alerts": rows,
+            "firing": sum(e["state"] == "firing" for e in rows),
+            "pending": sum(e["state"] == "pending" for e in rows),
+            "resolved": sum(e["state"] == "resolved" for e in rows),
+        }
+
+    def bundle_list(
+        self, process: "str | None" = None,
+        bundle_id: "str | None" = None,
+    ) -> dict:
+        """``/telemetry/bundle``: summaries without an id, the full
+        capture with ``?id=N`` (``&process=`` disambiguates when two
+        replicas reused the same per-process counter)."""
+        with self._lock:
+            items = [
+                (name, b)
+                for name, st in self._procs.items()
+                if process is None or name == process
+                for b in st.bundles.values()
+            ]
+        if bundle_id:
+            for name, b in items:
+                if str(b.get("id")) == str(bundle_id):
+                    return {"bundle": b}
+            return {"bundle": None, "error": f"no bundle id {bundle_id}"}
+        return {
+            "bundles": [{
+                "id": b.get("id"),
+                "process": name,
+                "rule": (b.get("trigger") or {}).get("rule"),
+                "severity": (b.get("trigger") or {}).get("severity"),
+                "captured_wall": b.get("captured_wall"),
+                "sections": sorted((b.get("sections") or {}).keys()),
+                "trace_events": len(
+                    (b.get("trace") or {}).get("traceEvents") or ()
+                ),
+                "rss_bytes": b.get("rss_bytes"),
+            } for name, b in items],
+            "count": len(items),
+        }
+
     # --------------------------------------------------------------- console
     def _proc_summary(self, st: _ProcState, now: float) -> dict:
         out: dict[str, Any] = {
@@ -396,6 +518,12 @@ class Collector:
             "spans": len(st.spans),
             "spans_dropped": st.dropped,
         }
+        firing = [a for a in st.alerts if a.get("state") == "firing"]
+        if firing:
+            out["alerts_firing"] = len(firing)
+            out["firing_alerts"] = sorted(
+                str(a.get("rule")) for a in firing
+            )
         last, prev = st.rate_last, st.rate_prev
         if last:
             sums = last[1]
@@ -450,11 +578,16 @@ class Collector:
         with self._lock:
             procs = list(self._procs.items())
             dropped = sum(st.dropped for _n, st in procs)
+            firing = sum(
+                1 for _n, st in procs for a in st.alerts
+                if a.get("state") == "firing"
+            )
         return {
             "processes": {
                 name: self._proc_summary(st, now) for name, st in procs
             },
             "spans_dropped": dropped,
+            "alerts_firing": firing,
         }
 
 
@@ -509,6 +642,13 @@ def handle_collector_request(
         })
     if path == "/telemetry/top":
         return reply_json(collector.summary())
+    if path == "/telemetry/alerts":
+        return reply_json(collector.alerts())
+    if path == "/telemetry/bundle":
+        return reply_json(collector.bundle_list(
+            process=one("process") or None,
+            bundle_id=one("id") or None,
+        ))
     return None
 
 

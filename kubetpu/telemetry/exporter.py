@@ -177,9 +177,12 @@ def _span_to_wire(sp) -> dict:
 
 class TelemetryExporter:
     """See module docstring. ``tracer`` is drained (consuming read);
-    ``metrics_fn``/``flight_fn`` are snapshot providers (may be None).
-    ``start()`` spawns the cadence thread; ``flush()`` ships one batch
-    synchronously (tests, shutdown)."""
+    ``metrics_fn``/``flight_fn``/``alerts_fn``/``bundles_fn`` are
+    snapshot providers (may be None — ``alerts_fn`` is the sentinel's
+    ``alerts_json``, ``bundles_fn`` its ``bundles_payload``; the
+    collector merges alerts by fingerprint and dedups bundles by
+    (process, id)). ``start()`` spawns the cadence thread; ``flush()``
+    ships one batch synchronously (tests, shutdown)."""
 
     def __init__(
         self,
@@ -190,6 +193,8 @@ class TelemetryExporter:
         tracer=None,
         metrics_fn: "Callable[[], str] | None" = None,
         flight_fn: "Callable[[], dict] | None" = None,
+        alerts_fn: "Callable[[], dict] | None" = None,
+        bundles_fn: "Callable[[], list] | None" = None,
         interval_s: float = 1.0,
         client: "_WireClient | None" = None,
     ) -> None:
@@ -199,6 +204,8 @@ class TelemetryExporter:
         self.tracer = tracer
         self.metrics_fn = metrics_fn
         self.flight_fn = flight_fn
+        self.alerts_fn = alerts_fn
+        self.bundles_fn = bundles_fn
         self.interval_s = interval_s
         self._client = client if client is not None else _WireClient(
             collector_url
@@ -245,6 +252,16 @@ class TelemetryExporter:
         if self.flight_fn is not None:
             try:
                 batch["flight_records"] = self.flight_fn()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.alerts_fn is not None:
+            try:
+                batch["alerts"] = self.alerts_fn()
+            except Exception:  # noqa: BLE001
+                pass
+        if self.bundles_fn is not None:
+            try:
+                batch["bundles"] = self.bundles_fn()
             except Exception:  # noqa: BLE001
                 pass
         return batch

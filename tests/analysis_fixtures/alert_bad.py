@@ -1,0 +1,31 @@
+"""Known-bad fixture for AL001: thresholds hardcoded at evaluation
+sites instead of read off the rule table."""
+
+from dataclasses import dataclass, replace
+
+
+@dataclass
+class Rule:
+    burn_threshold: float = 6.0
+    mad_k: float = 4.0
+    threshold: float = 0.5
+
+
+def _eval_burn(rule, burns):
+    # the table says rule.burn_threshold; this forks the policy
+    return all(b > 6.0 for b in burns)          # expect: AL001
+
+
+def evaluate_cycle(rule, x, baseline):
+    if x > baseline * 1.35:                     # expect: AL001
+        return True
+    return (x - baseline) > 0.250               # expect: AL001
+
+
+def loosen_for_bench(rule):
+    # a rule-table edit hiding at an evaluation site
+    return replace(rule, burn_threshold=3.0)    # expect: AL001
+
+
+def _eval_negative(rule, z):
+    return z < -2.5                             # expect: AL001

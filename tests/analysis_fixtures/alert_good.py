@@ -1,0 +1,34 @@
+"""Known-good twin for AL001: evaluators read every threshold off the
+rule; structural 0/1/-1 literals stay legal."""
+
+from dataclasses import dataclass, replace
+
+
+@dataclass
+class Rule:
+    burn_threshold: float = 6.0
+    mad_k: float = 4.0
+    threshold: float = 0.5
+    min_events: int = 10
+
+
+def _eval_burn(rule, burns):
+    return all(b > rule.burn_threshold for b in burns)
+
+
+def evaluate_cycle(rule, x, baseline):
+    if x > baseline * rule.mad_k:
+        return True
+    return (x - baseline) > rule.threshold
+
+
+def _eval_counts(rule, items):
+    # emptiness / index arithmetic: never thresholds
+    if len(items) < rule.min_events:
+        return False
+    return len(items) > 0 and items[0] != -1
+
+
+def scale_windows(rule, time_scale):
+    # non-threshold keywords (and attribute reads) are fine anywhere
+    return replace(rule, threshold=rule.threshold)

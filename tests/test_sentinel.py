@@ -1,0 +1,274 @@
+"""Anomaly sentinel — rule lifecycle, bundle capture, collector merge,
+and the /debug/* serving surface.
+
+The lifecycle tests drive ``Sentinel.evaluate`` with an injected clock
+and synthetic Prometheus text (note the ``# TYPE`` header: the parser
+only yields histogram samples for families it has typed), so the
+pending → firing → resolved machine and the multi-window burn math run
+deterministically — no sleeps, no real scheduler.
+"""
+
+import json
+import urllib.request
+from types import SimpleNamespace
+
+from kubetpu.api.wrappers import make_pod
+from kubetpu.client.events import EventRecorder
+from kubetpu.queue import PriorityQueue
+from kubetpu.sched.diagnostics import DiagnosticsServer
+from kubetpu.telemetry.collector import Collector
+from kubetpu.telemetry.rules import default_rules, fast_rules
+from kubetpu.telemetry.sentinel import FIRING, PENDING, RESOLVED, Sentinel
+
+E2E = "scheduler_e2e_scheduling_duration_seconds"
+
+
+def e2e_text(bad: int, good: int = 100) -> str:
+    """Synthetic scrape: ``good`` observations at ~10ms, ``bad`` ones
+    ABOVE the 3.2768s bucket — past the smallest bound ≥ the 2000ms
+    budget, so the bucket-conservative bad-fraction counts them."""
+    lines = [f"# TYPE {E2E} histogram"]
+    total = good + bad
+    bound = 0.0001
+    for _ in range(20):
+        cum = total if bound >= 6.5536 else (good if bound >= 0.01 else 0)
+        lines.append(f'{E2E}_bucket{{stage="e2e",le="{bound:.6g}"}} {cum}')
+        bound *= 2
+    lines.append(f'{E2E}_bucket{{stage="e2e",le="+Inf"}} {total}')
+    lines.append(f'{E2E}_count{{stage="e2e"}} {total}')
+    lines.append(f'{E2E}_sum{{stage="e2e"}} {total * 0.01}')
+    return "\n".join(lines)
+
+
+def make_sentinel(**kw):
+    clock = {"t": 1000.0}
+    kw.setdefault("rules", default_rules())
+    kw.setdefault("slo_budget_ms", 2000.0)
+    kw.setdefault("interval_s", 1.0)
+    s = Sentinel(clock=lambda: clock["t"], **kw)
+    return s, clock
+
+
+def settle_baseline(s, clock, evals=12, step=30.0):
+    """Enough clean history to cover the 300s long window."""
+    for _ in range(evals):
+        clock["t"] += step
+        s.evaluate(e2e_text(0))
+
+
+# --------------------------------------------------------------- lifecycle
+def test_burn_rule_fires_captures_bundle_and_resolves():
+    s, clock = make_sentinel(
+        bundle_sources={"queue": lambda: {"counts": {"active": 3}}},
+    )
+    settle_baseline(s, clock)
+    assert s.alerts_json()["alerts"] == []
+
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(70))
+    assert [a["rule"] for a in out["fired"]] == ["admission-slo-burn"]
+    al = out["fired"][0]
+    assert al["state"] == FIRING and al["severity"] == "critical"
+    # the firing edge captured a bundle and linked it back to the alert
+    assert al["bundle_id"] == 1 and s.bundles_total == 1
+    bundle = s.bundles[0]
+    assert bundle["sections"]["queue"] == {"counts": {"active": 3}}
+    assert bundle["trigger"]["rule"] == "admission-slo-burn"
+
+    body = s.alerts_json()
+    assert body["firing"] == 1 and body["pending"] == 0
+
+    # recovery: resolve_intervals=3 clean evaluations, then RESOLVED
+    resolved = []
+    for _ in range(4):
+        clock["t"] += 30
+        resolved += s.evaluate(e2e_text(70))["resolved"]
+    assert [a["rule"] for a in resolved] == ["admission-slo-burn"]
+    assert s.alerts_json()["resolved"] == 1
+    assert s.fired_total == 1
+
+
+def test_refire_is_deduped_by_fingerprint_not_appended():
+    s, clock = make_sentinel()
+    settle_baseline(s, clock)
+
+    def spike_then_recover(bad):
+        # counters are cumulative: episode 2 ADDS bad events on top
+        clock["t"] += 30
+        s.evaluate(e2e_text(bad))
+        for _ in range(11):
+            clock["t"] += 30
+            s.evaluate(e2e_text(bad))
+
+    # two full episodes: the SAME alert re-fires; the table stays one row
+    spike_then_recover(bad=70)
+    spike_then_recover(bad=140)
+
+    body = s.alerts_json()
+    assert len(body["alerts"]) == 1
+    assert body["alerts"][0]["fires"] == 2
+    assert s.fired_total == 2
+
+
+def test_no_declared_budget_leaves_burn_rule_dormant():
+    s, clock = make_sentinel(slo_budget_ms=None)
+    settle_baseline(s, clock)
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(70))
+    assert out["fired"] == [] and s.alerts_json()["alerts"] == []
+
+
+def test_eval_exceptions_are_counted_never_raised():
+    def boom() -> str:
+        raise RuntimeError("scrape source died")
+
+    s, clock = make_sentinel(metrics_fn=boom, interval_s=0.0)
+    assert s.maybe_evaluate() is True
+    assert s.maybe_evaluate() is True
+    assert s.eval_errors == 2
+
+
+def test_fast_rules_scale_windows_but_not_thresholds():
+    slow = {r.name: r for r in default_rules()}
+    for r in fast_rules():
+        base = slow[r.name]
+        assert r.burn_threshold == base.burn_threshold
+        assert r.objective == base.objective
+        assert r.short_window_s < base.short_window_s
+
+
+# ---------------------------------------------------------- collector merge
+def _alert(state, fires=1, fingerprint="aa", value=9.0):
+    return {
+        "fingerprint": fingerprint, "rule": "admission-slo-burn",
+        "series": E2E, "severity": "critical", "state": state,
+        "value": value, "reason": "burn", "fires": fires,
+        "bundle_id": 1 if state == FIRING else None,
+    }
+
+
+def test_collector_merges_replicas_by_rule_worst_state_wins():
+    col = Collector()
+    col.ingest({"process": "sched-r0", "spans": [],
+                "alerts": [_alert(FIRING, fingerprint="aa")]})
+    col.ingest({"process": "sched-r1", "spans": [],
+                "alerts": [_alert(RESOLVED, fingerprint="bb", value=0.1)]})
+
+    body = col.alerts()
+    assert body["firing"] == 1 and len(body["alerts"]) == 1
+    row = body["alerts"][0]
+    assert row["state"] == FIRING and row["value"] == 9.0
+    assert row["fires"] == 2
+    assert sorted(p["process"] for p in row["processes"]) == [
+        "sched-r0", "sched-r1",
+    ]
+
+
+def test_collector_dedups_bundles_by_process_and_id():
+    col = Collector()
+    bundle = {
+        "id": 1, "process": "sched-r0", "captured_wall": 123.0,
+        "trigger": {"rule": "admission-slo-burn", "severity": "critical"},
+        "sections": {"queue": {}}, "rss_bytes": 1,
+    }
+    for _ in range(2):   # re-export of the same retained ring
+        col.ingest({"process": "sched-r0", "spans": [], "bundles": [bundle]})
+    col.ingest({"process": "sched-r1", "spans": [],
+                "bundles": [dict(bundle, process="sched-r1")]})
+
+    body = col.bundle_list()
+    assert body["count"] == 2
+    assert col.bundle_list(process="sched-r0", bundle_id="1")[
+        "bundle"]["captured_wall"] == 123.0
+    assert col.bundle_list(bundle_id="9")["bundle"] is None
+
+
+# ------------------------------------------------------------------ bundles
+def test_bundle_capture_isolates_failing_sources():
+    s, _clock = make_sentinel(bundle_sources={
+        "ok": lambda: {"depth": 4},
+        "boom": lambda: (_ for _ in ()).throw(ValueError("torn state")),
+    })
+    b = s.capture_bundle(reason="operator poke")
+    assert b["sections"]["ok"] == {"depth": 4}
+    assert b["sections"]["boom"] == {"error": "ValueError: torn state"}
+    assert b["trigger"] == {"reason": "operator poke"}
+    assert b["py_stacks"]          # at least this thread's frames
+    assert s.capture_bundle()["id"] == 2   # seq survives across captures
+
+
+# ----------------------------------------------------------- /debug surface
+def test_debug_endpoints_served_over_http():
+    s, clock = make_sentinel()
+    settle_baseline(s, clock)
+    clock["t"] += 30
+    s.evaluate(e2e_text(70))
+    fake_sched = SimpleNamespace(
+        metrics_text=lambda: "# TYPE x counter\nx 1\n",
+        dispatcher=SimpleNamespace(_closed=False),
+        queue=SimpleNamespace(debug_json=lambda limit=512: {
+            "counts": {"active": 2}, "pods": [{"pod": "ns/p0"}],
+            "truncated": False,
+        }),
+        sentinel=s,
+    )
+    srv = DiagnosticsServer(scheduler=fake_sched, port=0).start()
+    try:
+        def get(path):
+            with urllib.request.urlopen(srv.url + path, timeout=5) as resp:
+                return json.loads(resp.read().decode())
+
+        q = get("/debug/queue")
+        assert q["enabled"] and q["counts"] == {"active": 2}
+        assert q["pods"] == [{"pod": "ns/p0"}]
+
+        a = get("/debug/alerts")
+        assert a["enabled"] and a["firing"] == 1
+        assert a["alerts"][0]["rule"] == "admission-slo-burn"
+
+        b = get("/debug/bundle")
+        assert b["enabled"] and b["count"] == 1
+        full = get(f"/debug/bundle?id={b['bundles'][0]['id']}")
+        assert full["bundle"]["trigger"]["rule"] == "admission-slo-burn"
+    finally:
+        srv.close()
+
+
+def test_queue_debug_json_reports_pools_and_wait():
+    q = PriorityQueue()
+    for i in range(3):
+        q.add(make_pod(f"p{i}", creation_index=i))
+    q.pop_batch(1)
+    body = q.debug_json()
+    assert body["counts"]["active"] == 2
+    assert body["counts"]["in_flight"] == 1
+    by_pool = {e["pod"]: e["queue"] for e in body["pods"]}
+    assert list(by_pool.values()).count("active") == 2
+    assert list(by_pool.values()).count("in_flight") == 1
+    assert all("queue_wait_s" in e for e in body["pods"])
+    assert body["truncated"] is False
+    assert len(q.debug_json(limit=1)["pods"]) == 1
+    assert q.debug_json(limit=1)["truncated"] is True
+
+
+# ------------------------------------------------------------------- events
+def test_event_recorder_dropped_writes_are_metered():
+    class BrokenStore:
+        def update(self, *a, **k):
+            raise RuntimeError("store down")
+
+    rec = EventRecorder(BrokenStore(), controller="tpu-slice")
+    rec.event("default/p0", "FailedScheduling", "0/3 nodes available")
+    assert rec.dropped == 1
+    text = rec.metrics_text()
+    assert 'kubetpu_events_dropped_total{controller="tpu-slice"} 1' in text
+
+
+def test_sentinel_state_rides_the_metrics_scrape():
+    s, clock = make_sentinel()
+    settle_baseline(s, clock)
+    clock["t"] += 30
+    s.evaluate(e2e_text(70))
+    text = s.metrics_text()
+    assert "kubetpu_sentinel_alerts_fired_total 1" in text
+    assert 'kubetpu_sentinel_alerts{state="firing"} 1' in text

@@ -86,7 +86,7 @@ def test_every_checker_registered_and_documented():
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
-        "WP001", "WL001", "TR003", "PS001", "EC001",
+        "WP001", "WL001", "TR003", "PS001", "EC001", "AL001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -120,6 +120,7 @@ def test_fixture_violations_match_markers_exactly():
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
     "wal_good.py", "trace_good.py", "proc_good.py", "epoch_good.py",
+    "alert_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -305,6 +306,46 @@ def test_epoch_checker_covers_kubetpu_but_not_the_cache_itself():
     assert scoped >= 1, "on_node_add lost its scoped invalidate_nodes(added=)"
     assert bare_fns <= {"on_node_add", "on_node_update", "on_node_delete"}, (
         f"bare full-epoch flushes outside the blessed handlers: {bare_fns}"
+    )
+
+
+def test_alert_checker_covers_the_sentinel_not_the_rules_table():
+    """AL001 (alert-threshold discipline) walks the sentinel's evaluation
+    module and does NOT walk the rule table — rules.py is the literals'
+    one legitimate home. Pinned against the ACTUAL walk, and against the
+    seam still being REAL: the evaluators must still read thresholds off
+    the rule (a refactor that inlined them as locals would leave AL001
+    guarding air while the table stopped describing the live policy)."""
+    res = _repo_result()
+    covered = set(res.coverage.get("AL001", ()))
+    assert "kubetpu/telemetry/sentinel.py" in covered, (
+        "AL001 no longer covers the sentinel's evaluators"
+    )
+    assert "kubetpu/telemetry/rules.py" not in covered, (
+        "AL001 wrongly covers the rule table itself"
+    )
+    assert "kubetpu/perf/workloads.py" not in covered, (
+        "AL001 wrongly covers trace-profile budgets (declared data)"
+    )
+    src = open(
+        os.path.join(REPO, "kubetpu", "telemetry", "sentinel.py"),
+        encoding="utf-8",
+    ).read()
+    tree = ast.parse(src)
+    eval_fns = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+        and (n.name.startswith("_eval") or n.name.startswith("evaluate"))
+    ]
+    assert len(eval_fns) >= 4, "sentinel.py lost its evaluator functions"
+    threshold_reads = [
+        n for fn in eval_fns for n in ast.walk(fn)
+        if isinstance(n, ast.Attribute)
+        and n.attr in ("burn_threshold", "threshold", "mad_k",
+                       "min_events", "objective")
+    ]
+    assert threshold_reads, (
+        "evaluators no longer read rule thresholds — AL001 guards air"
     )
 
 
