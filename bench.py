@@ -143,6 +143,11 @@ WIRE_BUDGET_S = 900.0
 DURABILITY_SHAPE = (5000, 50000)        # nodes, pods
 DURABILITY_WATCHERS = 200
 DURABILITY_BUDGET_S = 240.0
+#: the durability ladder's measured cold-recovery wall, stashed for the
+#: replicated-failover stage's hot-vs-cold verdict (filled when the
+#: CrashRecovery stage runs; the failover stage re-measures inline when
+#: it ran first or the durability stage failed)
+_COLD_RECOVERY: dict = {}
 
 # --- multi-process control plane (kubetpu.launch) ---------------------------
 # THE honest deployment shape (ROADMAP item 1): apiserver + N scheduler
@@ -174,6 +179,30 @@ MP_WIRE_LADDER = (
 MP_WIRE_FANOUT = 200
 MP_WIRE_FANOUT_PROCS = 4
 MP_WIRE_BUDGET_S = 900.0
+
+# --- replicated read plane (kubetpu.store.replication) ----------------------
+# The WAL log-shipping plane's two headline claims, both under REAL OS
+# processes:
+# - ReadScaling_mp_{1,2,4}api: the judged 5k-node fullstack row with the
+#   200-watcher fan-out load, once per apiserver count — with followers
+#   present the Cluster round-robins the watch drivers over them, so the
+#   leader keeps its cycles for writers; each rung carries the PEAK
+#   follower replication lag sampled over the measured window
+#   (follower_lag_ms — the read plane's honesty counter), and each >1
+#   rung's line carries throughput_speedup vs the 1-apiserver baseline
+#   (benchdiff's speedup gate);
+# - ReplicatedFailover_* / FailoverVsColdRecovery_*: the 5k x 50k write
+#   storm through a 3-apiserver plane, leader SIGKILLed after the
+#   followers catch up — failover_to_serving_s (kill -> a follower wins
+#   the lease by log position AND serves reads AND accepts a write) must
+#   come in strictly under the durability ladder's cold CrashRecovery
+#   recovery_s wall (the verdict line benchdiff gates with no tolerance).
+# Children pin JAX_PLATFORMS=cpu like every mp ladder.
+READ_PLANE_CASE = ("SchedulingBasic", "5000Nodes_1000Pods", "greedy", 256)
+READ_PLANE_LADDER = (1, 2, 4)
+READ_PLANE_BUDGET_S = 900.0
+FAILOVER_LEASE_S = 0.5
+FAILOVER_APISERVERS = 3
 
 # --- scale frontier: trace-shaped workloads (ROADMAP item 5) ----------------
 # Seeded deterministic traces (perf.workloads.TRACE_PROFILES) replayed
@@ -940,6 +969,12 @@ def _mp_record(r, case: str, workload: str, engine: str,
         out["lease_transitions"] = r.lease_transitions
     if r.recovery_s is not None:
         out["recovery_s"] = round(r.recovery_s, 3)
+    if r.apiservers > 1:
+        out["apiservers"] = r.apiservers
+        if r.follower_lag_ms is not None:
+            out["follower_lag_ms"] = round(r.follower_lag_ms, 3)
+        if r.follower_lag_records is not None:
+            out["follower_lag_records"] = r.follower_lag_records
     return out
 
 
@@ -1206,6 +1241,162 @@ def _run_mp_wire_stages() -> None:
         _emit(comp)
 
 
+def _run_read_plane_stages() -> None:
+    """The replicated read plane's evidence (see READ_PLANE_* above):
+    the ReadScaling_mp_{1,2,4}api ladder — the judged 5k fullstack row
+    with the 200-watcher fan-out spread over followers — then the
+    leader-kill failover stage, judged against the durability ladder's
+    cold CrashRecovery wall."""
+    from kubetpu.perf.runner import (
+        run_crash_recovery,
+        run_replicated_failover,
+        run_workload_multiprocess,
+    )
+
+    case, workload, engine, max_batch = READ_PLANE_CASE
+    t0 = time.perf_counter()
+    ladder: dict[int, dict] = {}
+    for n in READ_PLANE_LADDER:
+        elapsed = time.perf_counter() - t0
+        if elapsed > READ_PLANE_BUDGET_S:
+            _status(f"read-plane budget exhausted; skipping {n}api")
+            continue
+        _status(f"read-plane stage: {n} apiserver(s), "
+                f"fanout={MP_WIRE_FANOUT} over {MP_WIRE_FANOUT_PROCS} "
+                f"procs (t={elapsed:.0f}s)")
+        metric = (
+            f"{case}_{workload}_{engine}_mp_{n}api_"
+            f"{MP_WIRE_FANOUT}watchers"
+        )
+        try:
+            r = run_workload_multiprocess(
+                case, workload, replicas=1, apiservers=n,
+                partition="race", wire="binary", engine=engine,
+                max_batch=max_batch, timeout_s=STAGE_TIMEOUT_S,
+                watch_fanout=MP_WIRE_FANOUT,
+                fanout_procs=MP_WIRE_FANOUT_PROCS,
+                child_env=MP_CHILD_ENV,
+            )
+        except Exception as e:
+            _emit({
+                "metric": metric, "value": 0.0, "unit": "pods/s",
+                "vs_baseline": 0.0, "engine": engine,
+                "mode": "multiprocess", "backend": "cpu",
+                "apiservers": n, "watch_fanout": MP_WIRE_FANOUT,
+                "error": f"{type(e).__name__}: {e}",
+            })
+            _status(f"read-plane stage FAILED ({n}api): {e}")
+            continue
+        line = _mp_record(r, case, workload, engine, metric)
+        ladder[n] = line
+        _emit(line)
+        _status(f"read-plane stage done: {metric} = {line['value']} "
+                f"pods/s (follower_lag_ms="
+                f"{line.get('follower_lag_ms')})")
+    base = ladder.get(1)
+    for n in READ_PLANE_LADDER:
+        line = ladder.get(n)
+        if line is None:
+            continue
+        scaling = {
+            "metric": f"ReadScaling_mp_{n}api",
+            "unit": "ratio",
+            "mode": "multiprocess",
+            "backend": "cpu",
+            "case": case,
+            "workload": workload,
+            "apiservers": n,
+            "watch_fanout": MP_WIRE_FANOUT,
+            "fanout_procs": MP_WIRE_FANOUT_PROCS,
+            "throughput": line["value"],
+            "binding_parity": line["binding_parity"],
+            "measure_pods": line["measure_pods"],
+            "n_processes": line["n_processes"],
+        }
+        if line.get("follower_lag_ms") is not None:
+            scaling["follower_lag_ms"] = line["follower_lag_ms"]
+            scaling["follower_lag_records"] = line.get(
+                "follower_lag_records"
+            )
+        if base and base.get("value"):
+            scaling["value"] = round(line["value"] / base["value"], 3)
+            scaling["throughput_speedup"] = scaling["value"]
+            scaling["baseline_throughput"] = base["value"]
+        else:
+            scaling["value"] = None
+        _emit(scaling)
+    # ---- leader-kill failover vs the cold-recovery wall
+    n_nodes, n_pods = DURABILITY_SHAPE
+    fo_metric = (
+        f"ReplicatedFailover_{n_nodes}Nodes_{n_pods}Pods_"
+        f"{FAILOVER_APISERVERS}api"
+    )
+    _status(f"read-plane stage: leader-kill failover "
+            f"({FAILOVER_APISERVERS} apiservers, {n_nodes}x{n_pods} "
+            f"storm, lease={FAILOVER_LEASE_S}s)")
+    try:
+        fo = run_replicated_failover(
+            n_nodes=n_nodes, n_pods=n_pods,
+            apiservers=FAILOVER_APISERVERS,
+            lease_duration_s=FAILOVER_LEASE_S,
+            child_env=MP_CHILD_ENV,
+        )
+    except Exception as e:
+        _emit({
+            "metric": fo_metric, "unit": "s", "value": None,
+            "mode": "multiprocess", "backend": "cpu",
+            "error": f"{type(e).__name__}: {e}",
+        })
+        _status(f"read-plane failover stage FAILED: {e}")
+        return
+    _emit({
+        "metric": fo_metric,
+        "unit": "s",
+        "value": fo["failover_to_serving_s"],
+        "mode": "multiprocess",
+        "backend": "cpu",
+        **fo,
+    })
+    _status(f"read-plane failover done: failover_to_serving_s="
+            f"{fo['failover_to_serving_s']} (elected_s="
+            f"{fo['elected_s']}, follower_lag_ms="
+            f"{fo['follower_lag_ms']}, parity_ok={fo['parity_ok']})")
+    cold = _COLD_RECOVERY.get("recovery_s")
+    if cold is None:
+        # the durability stage didn't run (or failed) — measure the cold
+        # wall inline so the verdict always lands
+        _status("read-plane stage: cold-recovery wall not measured yet; "
+                "running CrashRecovery inline for the verdict")
+        try:
+            cold = run_crash_recovery(
+                n_nodes=n_nodes, n_pods=n_pods,
+                watchers=DURABILITY_WATCHERS,
+            )["recovery_s"]
+        except Exception as e:
+            _status(f"inline cold-recovery FAILED: {e}")
+            return
+    verdict = {
+        "metric": f"FailoverVsColdRecovery_{n_nodes}Nodes_{n_pods}Pods",
+        "unit": "verdict",
+        "value": 1.0 if fo["failover_to_serving_s"] < cold else 0.0,
+        "mode": "multiprocess",
+        "backend": "cpu",
+        "failover_to_serving_s": fo["failover_to_serving_s"],
+        "cold_recovery_s": cold,
+        "speedup_vs_cold": (
+            round(cold / fo["failover_to_serving_s"], 2)
+            if fo["failover_to_serving_s"] > 0 else None
+        ),
+        "apiservers": FAILOVER_APISERVERS,
+        "parity_ok": fo["parity_ok"],
+    }
+    _emit(verdict)
+    _status(f"read-plane verdict: failover {fo['failover_to_serving_s']}s "
+            f"vs cold {cold}s -> "
+            f"{'BEATS' if verdict['value'] else 'LOSES TO'} cold recovery "
+            f"({verdict['speedup_vs_cold']}x)")
+
+
 def _run_durability_stages() -> None:
     """CrashRecovery_* (recovery wall + reconnect relist storm + binding
     parity after a simulated kill) and WALOverhead_* (steady-state
@@ -1220,6 +1411,7 @@ def _run_durability_stages() -> None:
         r = run_crash_recovery(
             n_nodes=n_nodes, n_pods=n_pods, watchers=DURABILITY_WATCHERS,
         )
+        _COLD_RECOVERY["recovery_s"] = r["recovery_s"]
         _emit({
             "metric": f"CrashRecovery_{n_nodes}Nodes_{n_pods}Pods",
             "unit": "s",
@@ -1662,6 +1854,10 @@ def main() -> None:
     # children regardless of this process's backend
     _run_mp_federation_stages()
     _run_mp_wire_stages()
+    # the replicated read plane last: its ladder reuses the mp wire
+    # shape, and the failover verdict wants the durability ladder's
+    # cold-recovery wall already measured
+    _run_read_plane_stages()
     final = best_quadratic or best_any
     if final is None:
         _emit({

@@ -29,6 +29,9 @@ Checker catalog (``--explain CODE`` prints the full rationale):
 - AL001              alert-rule threshold discipline — the sentinel's
                      evaluators read thresholds off the rule table,
                      never from literals at the evaluation site
+- RP001              replication apply-seam discipline — follower stores
+                     take writes only through the replication-apply
+                     seam, never a local mutation
 
 Import surface: ``analyze_paths`` runs the suite programmatically (the
 tier-1 test ``tests/test_static_analysis.py`` gates on it), ``CHECKERS``
@@ -60,3 +63,4 @@ from . import tracecheck  # noqa: F401,E402
 from . import proccheck  # noqa: F401,E402
 from . import cachecheck  # noqa: F401,E402
 from . import alertcheck  # noqa: F401,E402
+from . import replcheck  # noqa: F401,E402

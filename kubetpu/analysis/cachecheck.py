@@ -2,13 +2,15 @@
 
 PR 14 scoped the encode cache's node-epoch invalidation: a node ADD
 extends every cached row with the appended nodes' columns (O(templates ×
-Δnodes)), while only updates/deletes pay the full-epoch flush — at 100k
-nodes under an autoscaler wave, the difference is a per-event re-encode
-storm vs a per-wave delta. That scoping only survives if the full flush
-stays behind ONE seam: a bare ``invalidate_nodes()`` (or a raw
-``node_epoch`` bump) sprinkled anywhere else silently reverts the
-hot path to flush-per-event and no test notices — throughput decays, the
-cache "works", and the 50k/100k admission p99s quietly blow their SLO.
+Δnodes)), a node DELETE compacts them down to the survivors' columns by
+an old-index gather (the drain-wave twin, ROADMAP 5b), while only
+updates and mixed waves pay the full-epoch flush — at 100k nodes under
+an autoscaler wave, the difference is a per-event re-encode storm vs a
+per-wave delta. That scoping only survives if the full flush stays
+behind ONE seam: a bare ``invalidate_nodes()`` (or a raw ``node_epoch``
+bump) sprinkled anywhere else silently reverts the hot path to
+flush-per-event and no test notices — throughput decays, the cache
+"works", and the 50k/100k admission p99s quietly blow their SLO.
 
 EC001 pins two invariants across ``kubetpu/``:
 
@@ -17,7 +19,8 @@ EC001 pins two invariants across ``kubetpu/``:
 - a BARE ``invalidate_nodes()`` call — the full-epoch flush — appears
   only in the scheduler's node event handlers (``on_node_add``'s
   resync-duplicate branch, ``on_node_update``, ``on_node_delete``).
-  Scoped calls (``invalidate_nodes(added=node)``) are fine anywhere.
+  Scoped calls (``invalidate_nodes(added=node)`` /
+  ``invalidate_nodes(removed=node)``) are fine anywhere.
 """
 
 from __future__ import annotations

@@ -1,0 +1,214 @@
+"""Replication apply-seam discipline checker (RP001).
+
+The replicated read plane holds only if a follower store NEVER takes a
+local write outside the replication-apply seam: reads on a follower are
+trustworthy precisely because every byte of its state arrived through
+the leader's shipped WAL records (rv-gated, replayed through
+``_commit_locked`` under the ``_applying`` flag) or a leader snapshot.
+One local write — a helper that flips ``_applying`` around an ordinary
+commit, a "fast path" in the replicator that calls ``store.update()``
+directly, a stray ``_follower = False`` outside the election seam —
+and the replica diverges at an rv the gap check can never see (equal
+rv, different bytes): reads serve fiction, and the failover candidate
+carries the divergence into leadership. This checker moves the seam to
+parse time, alias-resolving like WL001:
+
+- ``_applying`` is written only by ``__init__`` (its declaration) and
+  ``_apply_replicated_locked`` (the seam) in the store module — the
+  flag IS the bypass capability, so nobody else may hold it;
+- ``_follower`` is written only by ``__init__`` / ``promote`` /
+  ``demote`` — role flips are the election's seam, nowhere else;
+- the replicator module (kubetpu.store.replication) never calls a
+  mutation verb (``create``/``update``/``delete``) on a store
+  reference (``self.store``, ``X.store``, or a local alias of one) —
+  it may only replay (``apply_replicated*`` / ``load_replica_snapshot``)
+  and flip roles (``promote`` / ``demote``).
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from .core import Checker, ModuleInfo, Violation, register
+
+#: the store wrapper — where the flag/role writes are seamed
+_STORE_FILES = {
+    "kubetpu/store/memstore.py",
+}
+
+#: the follower machinery — where direct store mutations are banned
+_REPLICATOR_FILES = {
+    "kubetpu/store/replication.py",
+}
+
+#: functions blessed to write the _applying flag
+_APPLYING_SEAM = {"__init__", "_apply_replicated_locked"}
+
+#: functions blessed to flip the _follower role
+_ROLE_SEAM = {"__init__", "promote", "demote"}
+
+_MUTATIONS = {"create", "update", "delete"}
+
+
+def _is_store_attr(node: ast.AST) -> bool:
+    """``X.store`` for any X — the replicator's store-reference shape."""
+    return isinstance(node, ast.Attribute) and node.attr == "store"
+
+
+def _own_nodes(fn: ast.AST):
+    """Walk ``fn``'s body without descending into nested function defs —
+    each nested function gets its own ``_functions`` pass, so stopping at
+    the boundary keeps every finding reported exactly once."""
+    stack = list(ast.iter_child_nodes(fn))
+    while stack:
+        node = stack.pop()
+        yield node
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            stack.extend(ast.iter_child_nodes(node))
+
+
+@register
+class FollowerWriteOutsideApplySeam(Checker):
+    code = "RP001"
+    title = "follower-store write outside the replication-apply seam"
+    rationale = (
+        "A follower apiserver's reads are trustworthy only because every "
+        "byte of its store arrived through the leader's shipped WAL "
+        "records — rv-gated and replayed through _commit_locked under "
+        "the _applying flag — or a leader snapshot. A local write that "
+        "skips that seam (a helper flipping _applying around an ordinary "
+        "commit, a replicator 'fast path' calling store.update() "
+        "directly, a _follower = False flip outside promote/demote) "
+        "diverges the replica at an rv the gap check can never catch: "
+        "the rv sequence stays continuous while the bytes differ, reads "
+        "serve fiction, and a failover candidate carries the divergence "
+        "into leadership where it becomes everyone's truth. The flag IS "
+        "the bypass capability, so RP001 pins who may hold it: "
+        "_applying writes only in __init__/_apply_replicated_locked, "
+        "_follower writes only in __init__/promote/demote, and the "
+        "replicator module never calls create/update/delete on a store "
+        "reference — replay through apply_replicated*/"
+        "load_replica_snapshot, flip roles through promote/demote."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        base = posixpath.basename(relpath)
+        if base.startswith("rep_") and base.endswith(".py"):
+            return True     # the known-bad/known-good fixtures
+        return relpath in _STORE_FILES or relpath in _REPLICATOR_FILES
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        base = posixpath.basename(mod.relpath)
+        is_fixture = base.startswith("rep_")
+        check_flags = is_fixture or mod.relpath in _STORE_FILES
+        check_mutations = is_fixture or mod.relpath in _REPLICATOR_FILES
+        for cls_name, fn in self._functions(mod.tree):
+            symbol = f"{cls_name}.{fn.name}" if cls_name else fn.name
+            if check_flags:
+                out.extend(self._flag_writes(mod, fn, symbol))
+            if check_mutations:
+                out.extend(self._store_mutations(mod, fn, symbol))
+        return out
+
+    # ----------------------------------------------------- flag discipline
+    def _flag_writes(self, mod: ModuleInfo, fn, symbol: str):
+        out: list[Violation] = []
+        for node in _own_nodes(fn):
+            targets: list[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                if not isinstance(tgt, ast.Attribute):
+                    continue
+                if tgt.attr == "_applying" and fn.name not in _APPLYING_SEAM:
+                    out.append(Violation(
+                        path=mod.relpath, line=node.lineno, code=self.code,
+                        symbol=symbol,
+                        message=(
+                            "_applying written outside the replication-"
+                            "apply seam — the flag is the follower "
+                            "guard's bypass capability; only "
+                            "_apply_replicated_locked may hold it"
+                        ),
+                    ))
+                elif tgt.attr == "_follower" and fn.name not in _ROLE_SEAM:
+                    out.append(Violation(
+                        path=mod.relpath, line=node.lineno, code=self.code,
+                        symbol=symbol,
+                        message=(
+                            "_follower flipped outside the election seam "
+                            "— role changes go through promote()/"
+                            "demote() so a divergence-free failover "
+                            "stays provable in one place"
+                        ),
+                    ))
+        return out
+
+    # ------------------------------------------------- replicator mutations
+    def _store_mutations(self, mod: ModuleInfo, fn, symbol: str):
+        out: list[Violation] = []
+        aliases = self._store_aliases(fn)
+        for node in _own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if not (isinstance(f, ast.Attribute) and f.attr in _MUTATIONS):
+                continue
+            recv = f.value
+            if _is_store_attr(recv) or (
+                isinstance(recv, ast.Name) and recv.id in aliases
+            ):
+                out.append(Violation(
+                    path=mod.relpath, line=node.lineno, code=self.code,
+                    symbol=symbol,
+                    message=(
+                        f"store .{f.attr}() from the replicator — a "
+                        "follower takes writes ONLY through the "
+                        "replication-apply seam (apply_replicated*/"
+                        "load_replica_snapshot); a local write diverges "
+                        "the replica at an rv the gap check cannot see"
+                    ),
+                ))
+        return out
+
+    @staticmethod
+    def _functions(tree: ast.AST):
+        """Yield (enclosing class name or '', function node) for every
+        function, innermost functions included."""
+        out = []
+
+        def walk(node, cls_name):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    out.append((cls_name, child))
+                    walk(child, cls_name)
+                else:
+                    walk(child, cls_name)
+        walk(tree, "")
+        return out
+
+    @staticmethod
+    def _store_aliases(fn: ast.AST) -> set:
+        """Local names bound (anywhere in the function) from a store
+        reference: ``store = self.store`` — flow-insensitive on purpose,
+        like WL001's core aliasing."""
+        aliases: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_store_attr(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) and (
+                node.value is not None and _is_store_attr(node.value)
+                and isinstance(node.target, ast.Name)
+            ):
+                aliases.add(node.target.id)
+        return aliases

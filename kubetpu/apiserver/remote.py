@@ -83,6 +83,11 @@ class RemoteStore:
         # dropped us there permanently). Plain attribute: worst case two
         # threads re-confirm/re-fall-back — both idempotent.
         self._wire_ok: "bool | None" = None if wire == "binary" else False
+        # replicated read plane: when ``base_url`` is a FOLLOWER apiserver
+        # its 307 names the leader — writes retarget there (and stay
+        # there), reads/watches keep riding the follower. Cleared when the
+        # leader stops answering (failover: the next 307 re-learns it).
+        self._write_base: "str | None" = None
         # apiserver_client_reconnects_total{reason}: every watch-path
         # retry taken after a transport failure, by failure class — the
         # restart-visibility counter (guarded: watcher threads + a
@@ -163,17 +168,23 @@ class RemoteStore:
         return codec.BINARY if self._wire_ok else codec.JSON
 
     # ------------------------------------------------------------ plumbing
-    def _connection(self):
+    def _connection(self, base: "str | None" = None):
         """→ (conn, reused): ``reused`` marks a kept-alive socket — the
         idle-close race (server dropped it between our requests) is the one
-        failure where resending is provably safe for any verb."""
+        failure where resending is provably safe for any verb. One
+        persistent connection per (thread, base): the write-redirect path
+        talks to the leader without tearing down the follower's socket."""
         import socket
         from urllib.parse import urlsplit
 
-        conn = getattr(self._local, "conn", None)
+        target = base or self.base
+        conns = getattr(self._local, "conns", None)
+        if conns is None:
+            conns = self._local.conns = {}
+        conn = conns.get(target)
         if conn is not None:
             return conn, True
-        u = urlsplit(self.base)
+        u = urlsplit(target)
         conn = http.client.HTTPConnection(
             u.hostname, u.port, timeout=self.timeout_s
         )
@@ -183,17 +194,18 @@ class RemoteStore:
         conn.sock.setsockopt(
             socket.IPPROTO_TCP, socket.TCP_NODELAY, 1
         )
-        self._local.conn = conn
+        conns[target] = conn
         return conn, False
 
-    def _drop_connection(self) -> None:
-        conn = getattr(self._local, "conn", None)
+    def _drop_connection(self, base: "str | None" = None) -> None:
+        target = base or self.base
+        conns = getattr(self._local, "conns", None)
+        conn = conns.pop(target, None) if conns else None
         if conn is not None:
             try:
                 conn.close()
             except OSError:
                 pass
-        self._local.conn = None
 
     def _request(self, method: str, path: str, body: Any = None):
         """One request through the wire seam. ``body`` is the reply-shaped
@@ -205,14 +217,43 @@ class RemoteStore:
         # below carries the SAME value back in the header envelope, so
         # the two attempts correlate as one trace
         ctx = self._trace_context()
-        for _wire_attempt in range(2):
-            status, raw, resp_ct = self._request_transport(
-                method, path, body, ctx
-            )
-            if status == 415 and self._wire_ok is not False:
-                self._wire_ok = False
-                continue
-            break
+        # writes ride the learned leader base (replicated read plane);
+        # reads/watches always ride self.base — that IS the offload
+        base = self._write_base if method != "GET" else None
+        for _redirect in range(3):
+            try:
+                for _wire_attempt in range(2):
+                    status, raw, resp_ct = self._request_transport(
+                        method, path, body, ctx, base=base
+                    )
+                    if status == 415 and self._wire_ok is not False:
+                        self._wire_ok = False
+                        continue
+                    break
+            except RemoteUnavailableError:
+                if base is not None:
+                    # the learned leader stopped answering (failover):
+                    # forget it — the next 307 from our replica names the
+                    # new one
+                    self._write_base = None
+                raise
+            if status != 307:
+                break
+            # follower write redirect: the reply body names the leader
+            payload = {}
+            try:
+                payload = codec.loads(
+                    raw or b"{}", codec.codec_for_content_type(resp_ct)
+                )
+            except Exception:  # noqa: BLE001 — fall through to the error below
+                pass
+            leader = (payload.get("leader") or "").rstrip("/")
+            if not leader or leader == (base or self.base):
+                raise RemoteStoreError(
+                    "follower apiserver redirected a write but named no "
+                    "usable leader"
+                )
+            self._write_base = base = leader
         if status < 400:
             try:
                 return codec.loads(
@@ -293,7 +334,7 @@ class RemoteStore:
             self._wire_ok = True
 
     def _request_transport(self, method: str, path: str, body: Any,
-                           ctx=None):
+                           ctx=None, base: "str | None" = None):
         """The transport half with ONE safe retry. Blindly resending a
         non-idempotent verb after a transport error could double-apply it
         (a create whose response was lost resends → 409 for a create that
@@ -316,13 +357,13 @@ class RemoteStore:
         last: Exception | None = None
         for attempt in range(2):
             try:
-                conn, reused = self._connection()
+                conn, reused = self._connection(base)
                 conn.request(method, path, body=data, headers=headers)
             except (ConnectionError, TimeoutError, OSError,
                     http.client.HTTPException) as e:
                 # connect or send never completed: the server never saw
                 # the request, safe to retry any verb once
-                self._drop_connection()
+                self._drop_connection(base)
                 last = e
                 continue
             try:
@@ -343,7 +384,7 @@ class RemoteStore:
                 return status, raw, resp_ct
             except (ConnectionError, TimeoutError, OSError,
                     http.client.HTTPException) as e:
-                self._drop_connection()
+                self._drop_connection(base)
                 last = e
                 idle_close = reused and isinstance(
                     e, (http.client.RemoteDisconnected, ConnectionResetError)

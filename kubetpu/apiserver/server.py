@@ -79,7 +79,9 @@ from urllib.parse import parse_qs, urlsplit
 
 from ..api import codec, scheme
 from ..metrics.health import HealthChecks
-from ..store.memstore import CompactedError, ConflictError, MemStore
+from ..store.memstore import (
+    CompactedError, ConflictError, FollowerWriteError, MemStore,
+)
 from .admission import AdmissionDenied, Registry, ValidationError
 from .metrics import APIServerMetrics
 from .remote import BULK_SUFFIX   # ONE wire constant for both sides
@@ -241,6 +243,7 @@ class _Handler(BaseHTTPRequestHandler):
     tracer = None       # server-span recorder (bound by factory)
     collector = None    # embedded telemetry collector (bound when enabled)
     sentinel = None     # embedded anomaly sentinel (bound when enabled)
+    replication = None  # LeaderLease | FollowerReplicator (when replicated)
     metrics_sources: tuple = ()  # extra Prometheus-text providers
     wire_enabled: bool = True    # False = JSON-only server (--wire json):
     #                              ignores binary Accept, 415s binary bodies
@@ -482,10 +485,132 @@ class _Handler(BaseHTTPRequestHandler):
         )
         return True
 
+    # ---------------------------------------------------------- replication
+    def _serve_replication(self, method: str) -> bool:
+        """Replicated read plane (kubetpu.store.replication):
+        /replication/log is the leader's ship feed (WAL frames off the
+        serialize-once body ring, long-polled like a watch),
+        /replication/snapshot the follower bootstrap, and
+        /replication/status the election/lag probe. Mounted only when a
+        replication role is bound — an unreplicated server keeps PR-16
+        routing exactly (the paths fall through to diagnostics' 404).
+        False when the path is not ours."""
+        if self.replication is None:
+            return False
+        parts = urlsplit(self.path)
+        if not parts.path.startswith("/replication/"):
+            return False
+        q = {k: v[-1] for k, v in parse_qs(parts.query).items()}
+        try:
+            if parts.path == "/replication/status":
+                self._reply(self.replication.status())
+            elif parts.path == "/replication/log":
+                self._serve_replication_log(q)
+            elif parts.path == "/replication/snapshot":
+                from ..store.wal import encode_snapshot_stream
+
+                items, rv = self.store.dump_with_rv()
+                self._reply_rep(
+                    encode_snapshot_stream(items, rv, self._rep_wire(q)),
+                    rv,
+                )
+            else:
+                self._error(404, "unknown replication path")
+        except ValueError as e:
+            self._error(400, str(e))
+        except Exception as e:  # noqa: BLE001 — replication must not crash
+            self._error(500, f"{type(e).__name__}: {e}")
+        return True
+
+    def _rep_wire(self, q: dict) -> str:
+        """The ship body's codec: the follower asks for one (it knows its
+        own build); default to the server's negotiated-wire stance."""
+        wire = q.get(
+            "codec", codec.BINARY if self.wire_enabled else codec.JSON
+        )
+        if wire not in (codec.JSON, codec.BINARY):
+            raise ValueError(f"codec must be json|binary, got {wire!r}")
+        if wire == codec.BINARY and not self.wire_enabled:
+            raise ValueError("binary wire disabled on this server")
+        return wire
+
+    def _serve_replication_log(self, q: dict) -> None:
+        from ..store.replication import build_log_body
+
+        after = int(q.get("after", 0))
+        timeout = min(float(q.get("timeoutSeconds", 0)), 60.0)
+        wire = self._rep_wire(q)
+        try:
+            body, cursor, n = build_log_body(self.store, after, wire)
+            if not n and timeout > 0:
+                # the long-poll: a leader with nothing new holds the
+                # follower's request on the store's condition variable —
+                # shipping latency is write-wakeup latency, not a poll
+                # interval
+                self.store.wait_for(after, timeout=timeout)
+                body, cursor, n = build_log_body(self.store, after, wire)
+        except CompactedError as e:
+            # the follower's cursor predates the body ring: 410 → it
+            # bootstraps from /replication/snapshot (recovery's contract)
+            self._error(410, str(e))
+            return
+        self._reply_rep(body, cursor, wire=wire)
+
+    def _reply_rep(self, body: bytes, cursor: int,
+                   wire: str = "") -> None:
+        """Raw replication bytes + the feed position/fencing headers."""
+        from ..store import replication as rep
+
+        self._status = 200
+        self.send_response(200)
+        self.send_header("Content-Type", rep.CT_WAL)
+        self.send_header("Content-Length", str(len(body)))
+        self.send_header(rep.H_CURSOR, str(cursor))
+        self.send_header(rep.H_EPOCH, str(self.replication.epoch))
+        if wire:
+            self.send_header(rep.H_CODEC, wire)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _redirect_to_leader(self) -> bool:
+        """Follower write redirect: a write verb landing on a follower
+        apiserver answers 307 with the leader's URL (Location header +
+        reply body) — RemoteStore retries the write there while its reads
+        stay here. False when this server takes writes itself."""
+        if not getattr(self.store, "follower", False):
+            return False
+        self._reply_redirect()
+        return True
+
+    def _reply_redirect(self) -> None:
+        leader = ""
+        if self.replication is not None:
+            leader = getattr(self.replication, "leader_url", "") or ""
+        # drain the request body first: leaving it unread would desync
+        # the keep-alive connection's framing for the next request
+        length = int(self.headers.get("Content-Length", 0) or 0)
+        if length:
+            self.rfile.read(length)
+        wire = self._reply_codec()
+        body = codec.dumps({
+            "error": "follower apiserver: writes go to the leader",
+            "leader": leader,
+        }, wire)
+        self.metrics.count_wire(wire, "out", len(body))
+        self._status = 307
+        self.send_response(307)
+        if leader:
+            self.send_header("Location", leader + self.path)
+        self.send_header("Content-Type", codec.content_type_for(wire))
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
     def do_GET(self) -> None:  # noqa: N802
         if not urlsplit(self.path).path.startswith(PREFIX):
-            if not self._serve_collector("GET"):
-                self._serve_diagnostics()
+            if not self._serve_replication("GET"):
+                if not self._serve_collector("GET"):
+                    self._serve_diagnostics()
             return
         kind, key, q = self._route()
         if kind is None:
@@ -787,6 +912,8 @@ class _Handler(BaseHTTPRequestHandler):
             if not self._serve_collector("POST"):
                 self._error(404, "unknown path")
             return
+        if self._redirect_to_leader():
+            return
         kind, key, _ = self._route()
         if kind is not None and key is None and kind.endswith(BULK_SUFFIX):
             resource = kind[: -len(BULK_SUFFIX)]
@@ -795,6 +922,10 @@ class _Handler(BaseHTTPRequestHandler):
                     self._do_bulk(resource)
                 except codec.UnsupportedWireError as e:
                     self._error(415, str(e))
+                except FollowerWriteError:
+                    # demoted mid-request (failover race): same answer as
+                    # the up-front guard — go to the leader
+                    self._reply_redirect()
                 except Exception as e:
                     self._error(500, f"{type(e).__name__}: {e}")
             return
@@ -805,6 +936,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 rv = self._apply_create(kind, key, self._read_body())
                 self._reply({"resourceVersion": rv}, status=201)
+            except FollowerWriteError:
+                self._reply_redirect()
             except ConflictError as e:
                 self._error(409, str(e))
             except ValidationError as e:
@@ -819,6 +952,8 @@ class _Handler(BaseHTTPRequestHandler):
                 self._error(500, f"{type(e).__name__}: {e}")
 
     def do_PUT(self) -> None:  # noqa: N802
+        if self._redirect_to_leader():
+            return
         kind, key, q = self._route()
         if kind is None or key is None:
             self._error(404, "kind and key required")
@@ -831,6 +966,8 @@ class _Handler(BaseHTTPRequestHandler):
                 )
                 rv = self._apply_update(kind, key, self._read_body(), expect)
                 self._reply({"resourceVersion": rv})
+            except FollowerWriteError:
+                self._reply_redirect()
             except ConflictError as e:
                 self._error(409, str(e))
             except ValidationError as e:
@@ -960,6 +1097,8 @@ class _Handler(BaseHTTPRequestHandler):
             return _op_error_result(e)
 
     def do_DELETE(self) -> None:  # noqa: N802
+        if self._redirect_to_leader():
+            return
         kind, key, _ = self._route()
         if kind is None or key is None:
             self._error(404, "kind and key required")
@@ -968,6 +1107,8 @@ class _Handler(BaseHTTPRequestHandler):
             try:
                 rv = self.store.delete(kind, key)
                 self._reply({"resourceVersion": rv})
+            except FollowerWriteError:
+                self._reply_redirect()
             except KeyError:
                 self._error(404, f"{kind}/{key} not found")
             except Exception as e:
@@ -986,6 +1127,7 @@ class APIServer:
         persistence: "str | None" = None,
         collector: bool = False,
         sentinel: "bool | object" = False,
+        replication: "object | None" = None,
     ) -> None:
         """``metrics_sources``: extra Prometheus-text providers appended to
         GET /metrics (e.g. a co-hosted controller family's workqueue set).
@@ -1010,7 +1152,15 @@ class APIServer:
         snapshot, every committed write is logged-then-applied, and
         ``close()`` flushes the log so a graceful stop never leaves a
         torn tail. Ignored when an existing ``store`` is passed in — its
-        durability is the caller's choice."""
+        durability is the caller's choice.
+        ``replication``: a pre-built replication role
+        (``store.replication.LeaderLease`` over this server's own store,
+        or a ``FollowerReplicator`` tailing a leader into it) — mounts
+        /replication/log, /replication/snapshot, /replication/status,
+        turns on the follower write redirect, and adds the role's metrics
+        to /metrics. ``start()``/``close()`` run its lifecycle. ``None``
+        (the default) leaves the server exactly as before — the
+        single-apiserver escape hatch."""
         if wire not in ("binary", "json"):
             raise ValueError(f"wire must be binary|json, got {wire!r}")
         # close() tears down only a store THIS server created — a passed-in
@@ -1103,9 +1253,18 @@ class APIServer:
         sentinel_sources: tuple = ()
         if self.sentinel is not None:
             sentinel_sources = (self.sentinel.metrics_text,)
+        # the replication role's gauges (lag/epoch/applied) ride this
+        # server's /metrics — the sentinel's replication_lag rule and the
+        # telemetry exporter both read them from here
+        self.replication = replication
+        rep_sources: tuple = ()
+        if replication is not None:
+            rep_text = getattr(replication, "metrics_text", None)
+            if callable(rep_text):
+                rep_sources = (rep_text,)
         self._metrics_sources = (
-            _event_cache_metrics, *wal_sources, *sentinel_sources,
-            *metrics_sources,
+            _event_cache_metrics, *wal_sources, *rep_sources,
+            *sentinel_sources, *metrics_sources,
         )
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,
@@ -1114,6 +1273,7 @@ class APIServer:
             "tracer": self.tracer,
             "collector": self.collector,
             "sentinel": self.sentinel,
+            "replication": self.replication,
             "wire_enabled": wire == "binary",
             "metrics_sources": self._metrics_sources,
             # responses are small; Nagle + the client's delayed ACK would
@@ -1130,6 +1290,43 @@ class APIServer:
             block_on_close = False
             closing = False
 
+            def __init__(self, *a, **kw):
+                super().__init__(*a, **kw)
+                self._conn_lock = threading.Lock()
+                self._conns: set = set()
+
+            def get_request(self):
+                sock, addr = super().get_request()
+                with self._conn_lock:
+                    self._conns.add(sock)
+                return sock, addr
+
+            def shutdown_request(self, request):
+                with self._conn_lock:
+                    self._conns.discard(request)
+                super().shutdown_request(request)
+
+            def sever(self) -> None:
+                """Half-close every live connection: a handler blocked on
+                the next keep-alive request reads EOF and exits cleanly,
+                so a closed server is DOWN for clients that already held a
+                connection — without this, keep-alive handler threads
+                outlive close() and a 'killed' leader keeps serving its
+                replication feed (failover never sees the death)."""
+                import socket as _socket
+
+                with self._conn_lock:
+                    conns = list(self._conns)
+                for sock in conns:
+                    try:
+                        sock.shutdown(_socket.SHUT_RDWR)
+                    except OSError:
+                        pass
+
+            def handle_error(self, request, client_address):
+                if not self.closing:
+                    super().handle_error(request, client_address)
+
         self._httpd = _Server((host, port), handler)
         self._thread = threading.Thread(
             target=self._httpd.serve_forever, daemon=True
@@ -1139,6 +1336,26 @@ class APIServer:
     def url(self) -> str:
         host, port = self._httpd.server_address[:2]
         return f"http://{host}:{port}"
+
+    def attach_replication(self, replication) -> None:
+        """Bind a replication role AFTER construction — the leader's lease
+        identity is its own URL, which exists only once the listener is
+        bound (``--port 0``). Must run before ``start()``: mounts the
+        /replication/* endpoints, the follower write redirect, and the
+        role's metrics, exactly as the constructor param would."""
+        self.replication = replication
+        self._httpd.RequestHandlerClass.replication = replication
+        rep_text = getattr(replication, "metrics_text", None)
+        if callable(rep_text) and rep_text not in self._metrics_sources:
+            # keep the constructor's source order: the role's gauges sit
+            # right after the store/WAL set, before the sentinel's
+            self._metrics_sources = (
+                *self._metrics_sources[:1], rep_text,
+                *self._metrics_sources[1:],
+            )
+            self._httpd.RequestHandlerClass.metrics_sources = (
+                self._metrics_sources
+            )
 
     def metrics_text(self) -> str:
         """The same Prometheus text GET /metrics serves (request set +
@@ -1151,6 +1368,11 @@ class APIServer:
 
     def start(self) -> "APIServer":
         self._thread.start()
+        if self.replication is not None:
+            # leader: take the writer lease before serving writes;
+            # follower: start the tail (the listener is already up, so a
+            # peer's status probe can reach us during bootstrap)
+            self.replication.start()
         if self.sentinel is not None:
             # thread-served owner: the sentinel runs its own cadence
             # (the scheduler instead evaluates at its cycle boundary)
@@ -1158,11 +1380,16 @@ class APIServer:
         return self
 
     def close(self) -> None:
+        if self.replication is not None:
+            # stop the renew/tail thread while the store and peers are
+            # still reachable (a leader releases the writer lease here)
+            self.replication.close()
         if self.sentinel is not None:
             self.sentinel.close()
         self._httpd.closing = True
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._httpd.sever()
         self._thread.join(timeout=5)
         # AFTER the listener is down (no request can append mid-close):
         # flush + fsync + close an OWNED store's WAL, so a graceful stop

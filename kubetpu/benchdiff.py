@@ -37,6 +37,19 @@ Comparison rules (per metric name present in BOTH records):
   recovery takes over ``old * (1 + recovery_tol)`` AND grew by more than
   ``min_recovery_delta_s`` (absolute floor for the sub-second recoveries a
   small bench shape produces).
+- **replicated-plane failover** (``failover_to_serving_s`` on
+  ``ReplicatedFailover_*`` lines — leader kill → a follower serves):
+  regression when the new wall exceeds ``old * (1 + failover_tol)`` AND
+  grew by more than ``min_failover_delta_s`` absolute (the hot-standby
+  walls are seconds-scale, so the absolute floor keeps election jitter
+  from gating; the hot-vs-cold claim itself rides the
+  ``FailoverVsColdRecovery_*`` verdict line, gated with no tolerance).
+- **follower replication lag** (``follower_lag_ms`` on the
+  ``ReadScaling_mp_*`` / ``ReplicatedFailover_*`` lines — the PEAK
+  follower lag sampled under the write storm): regression when the new
+  peak exceeds ``old * (1 + follower_lag_tol)`` AND grew by more than
+  ``min_follower_lag_delta_ms`` (peak-of-samples on a shared host is
+  noisy; a read plane that started serving seconds-stale data gates).
 - **scaling speedup** (``throughput_speedup`` on comparison lines —
   ``FederationScaling_mp_*``'s real N-process speedup, the wire/sharding/
   pipeline speedups): regression when the new speedup falls under
@@ -93,6 +106,17 @@ CONFLICT_TOL = 0.50
 MIN_CONFLICT_DELTA = 0.05
 RECOVERY_TOL = 1.00
 MIN_RECOVERY_DELTA_S = 5.0
+#: replicated-plane failover walls are seconds-scale (a hot standby
+#: already holds the state) — same relative shape as recovery, but a
+#: smaller absolute floor so a failover that ballooned from 1s to 4s
+#: gates while election jitter under 2s never does
+FAILOVER_TOL = 1.00
+MIN_FAILOVER_DELTA_S = 2.0
+#: peak follower replication lag is a max-of-samples under a write storm
+#: on a shared host — generous relative tolerance, an absolute floor big
+#: enough that only a genuinely stale read plane gates
+FOLLOWER_LAG_TOL = 1.00
+MIN_FOLLOWER_LAG_DELTA_MS = 250.0
 #: scaling-speedup gate (throughput_speedup on comparison lines): a RATIO
 #: around 1.0, so both tolerances are meaningful — the relative one rides
 #: out shared-host noise, the absolute floor keeps a flat curve's wobble
@@ -227,6 +251,10 @@ def compare(
     min_conflict_delta: float = MIN_CONFLICT_DELTA,
     recovery_tol: float = RECOVERY_TOL,
     min_recovery_delta_s: float = MIN_RECOVERY_DELTA_S,
+    failover_tol: float = FAILOVER_TOL,
+    min_failover_delta_s: float = MIN_FAILOVER_DELTA_S,
+    follower_lag_tol: float = FOLLOWER_LAG_TOL,
+    min_follower_lag_delta_ms: float = MIN_FOLLOWER_LAG_DELTA_MS,
     speedup_tol: float = SPEEDUP_TOL,
     min_speedup_delta: float = MIN_SPEEDUP_DELTA,
     wal_tol: float = WAL_TOL,
@@ -309,6 +337,33 @@ def compare(
                 note=(
                     f"[tol +{recovery_tol:.0%} & "
                     f">{min_recovery_delta_s:g}s]" if bad else ""
+                ),
+            ))
+        ofo, nfo = (o.get("failover_to_serving_s"),
+                    n.get("failover_to_serving_s"))
+        if isinstance(ofo, (int, float)) and isinstance(nfo, (int, float)):
+            bad = (
+                nfo > ofo * (1.0 + failover_tol)
+                and (nfo - ofo) > min_failover_delta_s
+            )
+            deltas.append(Delta(
+                name, "failover_to_serving_s", float(ofo), float(nfo), bad,
+                note=(
+                    f"[tol +{failover_tol:.0%} & "
+                    f">{min_failover_delta_s:g}s]" if bad else ""
+                ),
+            ))
+        ofl, nfl = o.get("follower_lag_ms"), n.get("follower_lag_ms")
+        if isinstance(ofl, (int, float)) and isinstance(nfl, (int, float)):
+            bad = (
+                nfl > ofl * (1.0 + follower_lag_tol)
+                and (nfl - ofl) > min_follower_lag_delta_ms
+            )
+            deltas.append(Delta(
+                name, "follower_lag_ms", float(ofl), float(nfl), bad,
+                note=(
+                    f"[tol +{follower_lag_tol:.0%} & "
+                    f">{min_follower_lag_delta_ms:g}ms]" if bad else ""
                 ),
             ))
         osp, nsp = o.get("throughput_speedup"), n.get("throughput_speedup")
@@ -489,6 +544,23 @@ def main(argv=None) -> int:
                     help="absolute recovery growth floor (seconds) below "
                          f"which it never gates (default "
                          f"{MIN_RECOVERY_DELTA_S})")
+    ap.add_argument("--failover-tol", type=float, default=FAILOVER_TOL,
+                    help="fractional failover-to-serving growth tolerated "
+                         f"(default {FAILOVER_TOL})")
+    ap.add_argument("--min-failover-delta-s", type=float,
+                    default=MIN_FAILOVER_DELTA_S,
+                    help="absolute failover growth floor (seconds) below "
+                         f"which it never gates (default "
+                         f"{MIN_FAILOVER_DELTA_S})")
+    ap.add_argument("--follower-lag-tol", type=float,
+                    default=FOLLOWER_LAG_TOL,
+                    help="fractional follower-lag growth tolerated "
+                         f"(default {FOLLOWER_LAG_TOL})")
+    ap.add_argument("--min-follower-lag-delta-ms", type=float,
+                    default=MIN_FOLLOWER_LAG_DELTA_MS,
+                    help="absolute follower-lag growth floor below which "
+                         f"it never gates (default "
+                         f"{MIN_FOLLOWER_LAG_DELTA_MS})")
     ap.add_argument("--speedup-tol", type=float, default=SPEEDUP_TOL,
                     help="fractional scaling-speedup shrink tolerated "
                          f"(default {SPEEDUP_TOL})")
@@ -552,6 +624,10 @@ def main(argv=None) -> int:
         min_conflict_delta=args.min_conflict_delta,
         recovery_tol=args.recovery_tol,
         min_recovery_delta_s=args.min_recovery_delta_s,
+        failover_tol=args.failover_tol,
+        min_failover_delta_s=args.min_failover_delta_s,
+        follower_lag_tol=args.follower_lag_tol,
+        min_follower_lag_delta_ms=args.min_follower_lag_delta_ms,
         speedup_tol=args.speedup_tol,
         min_speedup_delta=args.min_speedup_delta,
         wal_tol=args.wal_tol,

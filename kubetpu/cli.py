@@ -164,6 +164,24 @@ def _serve_until_signal(stop=None) -> None:
         pass
 
 
+def _attach_alert_sink(sentinel, args) -> str:
+    """Bind ``--alert-sink`` to a live sentinel. Returns an error string
+    (caller prints + exits non-zero) instead of raising — a bad sink
+    spec is an operator typo, not a traceback."""
+    spec = getattr(args, "alert_sink", "") or ""
+    if not spec:
+        return ""
+    if sentinel is None:
+        return "--alert-sink requires --sentinel on"
+    from .telemetry.sentinel import AlertSink
+
+    try:
+        sentinel.sink = AlertSink(spec)
+    except ValueError as e:
+        return str(e)
+    return ""
+
+
 def cmd_apiserver(args) -> int:
     import os
 
@@ -176,9 +194,18 @@ def cmd_apiserver(args) -> int:
     # mid-startup must still run the graceful close, not the default kill
     stop = _install_stop_event()
     persistence = getattr(args, "persistence", "off")
+    follow = getattr(args, "follow", "")
+    replicated = bool(getattr(args, "replicated", False))
+    if follow and persistence != "off":
+        # a follower's WAL is the leader's — local persistence on a
+        # replica would fork the durability story, so refuse it early
+        print("apiserver: --follow ignores --persistence "
+              "(the leader owns the WAL)", file=sys.stderr)
+        persistence = "off"
     try:
         store = MemStore(
             persistence=None if persistence == "off" else persistence,
+            follower=bool(follow),
         )
     except WALError as e:
         # a corrupt persistence dir must fail LOUDLY at boot, never boot
@@ -198,7 +225,41 @@ def cmd_apiserver(args) -> int:
         wire=getattr(args, "wire", "binary"),
         collector=(telemetry == "embed"),
         sentinel=(getattr(args, "sentinel", "off") == "on"),
-    ).start()
+    )
+    sink_err = _attach_alert_sink(server.sentinel, args)
+    if sink_err:
+        server.close()
+        store.close()
+        print(sink_err, file=sys.stderr)
+        return 2
+    # replication binds AFTER the listener exists (the lease identity /
+    # advertised self URL is this server's own address) but BEFORE
+    # start() — the first request served must already know its role
+    peers = tuple(
+        p.strip().rstrip("/")
+        for p in (getattr(args, "peers", "") or "").split(",") if p.strip()
+    )
+    lease_s = float(getattr(args, "lease_duration", 5.0) or 5.0)
+    if follow:
+        from .store.replication import FollowerReplicator
+
+        server.attach_replication(FollowerReplicator(
+            store, follow, wire=getattr(args, "wire", "binary"),
+            self_url=server.url, peers=peers,
+            replica_index=int(getattr(args, "replica_index", 0) or 0),
+            lease_duration_s=lease_s,
+            # the election grace scales with the lease so a short-lease
+            # plane fails over proportionally fast (at the 5s default
+            # this is exactly the replicator's own 6s default)
+            grace_s=1.2 * lease_s,
+        ))
+    elif replicated:
+        from .store.replication import LeaderLease
+
+        server.attach_replication(
+            LeaderLease(store, server.url, lease_duration_s=lease_s)
+        )
+    server.start()
     exporter = _make_exporter(
         telemetry, process=f"apiserver-{os.getpid()}",
         component="apiserver", tracer=server.tracer,
@@ -229,17 +290,25 @@ def cmd_apiserver(args) -> int:
     # then the human serving line
     from .launch.banner import emit_banner
 
-    emit_banner(
-        "apiserver", url=server.url, readyz=server.url + "/readyz",
+    banner_fields = dict(
+        url=server.url, readyz=server.url + "/readyz",
         wire=getattr(args, "wire", "binary"),
         persistence=("" if persistence == "off" else persistence),
         telemetry=telemetry,
     )
+    if server.replication is not None:
+        banner_fields["role"] = server.replication.role
+        if follow:
+            banner_fields["leader"] = follow
+    emit_banner("apiserver", **banner_fields)
     print(f"kubetpu apiserver serving on {server.url} "
           f"(REST: /apis/<kind>[/<key>], watch: ?watch=1&resourceVersion=N; "
           f"diagnostics: /metrics /healthz /readyz /livez /trace"
           + ("; telemetry collector embedded at /telemetry/"
              if telemetry == "embed" else "")
+          + (f"; replication: {server.replication.role}"
+             + (f" following {follow}" if follow else "")
+             if server.replication is not None else "")
           + f"{recovered})",
           flush=True)
     try:
@@ -322,6 +391,7 @@ def cmd_up(args) -> int:
     persistence = args.persistence if args.persistence != "off" else None
     cluster = Cluster(
         replicas=args.replicas,
+        apiservers=getattr(args, "apiservers", 1),
         partition=args.partition,
         wire=args.wire,
         engine=args.engine,
@@ -342,6 +412,9 @@ def cmd_up(args) -> int:
     try:
         fields = dict(apiserver=cluster.api_url, replicas=args.replicas,
                       partition=args.partition, wire=args.wire)
+        if len(cluster.api_urls) > 1:
+            fields["apiservers"] = len(cluster.api_urls)
+            fields["followers"] = ",".join(cluster.api_urls[1:])
         if cluster.collector_url:
             fields["collector"] = cluster.collector_url
         emit_banner("cluster", **fields)
@@ -790,6 +863,10 @@ def cmd_scheduler(args) -> int:
         sentinel=(getattr(args, "sentinel", "off") == "on"),
     )
     sched.enable_preemption()
+    sink_err = _attach_alert_sink(sched.sentinel, args)
+    if sink_err:
+        print(sink_err, file=sys.stderr)
+        return 2
     exporter = None
     if telemetry != "off":
         import os
@@ -1422,6 +1499,41 @@ def build_parser() -> argparse.ArgumentParser:
                           "state at /debug/alerts, triggered diagnostic "
                           "bundles at /debug/bundle; 'off' (default) runs "
                           "zero evaluation work")
+    api.add_argument("--alert-sink", default="", metavar="file:PATH|webhook:URL",
+                     help="out-of-process sentinel alert delivery: "
+                          "'file:PATH' appends one ndjson line per alert "
+                          "transition; 'webhook:URL' POSTs the transition "
+                          "JSON. Delivery failures are counted "
+                          "(sentinel_sink_errors), never fatal. Requires "
+                          "--sentinel on")
+    api.add_argument("--replicated", action="store_true",
+                     help="serve as the replicated read plane's LEADER: "
+                          "hold the apiserver-writer lease in this store "
+                          "(renewals replicate, so the lease doubles as "
+                          "the heartbeat) and serve the WAL log-shipping "
+                          "feed at /replication/log for followers")
+    api.add_argument("--follow", default="", metavar="URL",
+                     help="serve as a FOLLOWER of the given leader "
+                          "apiserver: bootstrap from its /replication/"
+                          "snapshot, tail /replication/log into a local "
+                          "replica store, serve reads/lists/watches from "
+                          "replayed state at full resourceVersion "
+                          "continuity, and 307-redirect writes to the "
+                          "leader. On leader death the most-caught-up "
+                          "follower wins the writer lease (failover by "
+                          "log position)")
+    api.add_argument("--peers", default="", metavar="URL,URL,...",
+                     help="the full apiserver electorate (leader + all "
+                          "followers) — a failing-over follower polls "
+                          "these /replication/status endpoints to defer "
+                          "to any more-caught-up peer")
+    api.add_argument("--replica-index", type=int, default=0,
+                     help="this follower's stable index (election "
+                          "tie-break: equal log position → lowest index "
+                          "wins)")
+    api.add_argument("--lease-duration", type=float, default=5.0,
+                     help="writer-lease duration in seconds — the "
+                          "failover detection floor (default 5.0)")
     api.set_defaults(fn=cmd_apiserver)
 
     check = sub.add_parser("check-config", help="validate a config file")
@@ -1544,6 +1656,13 @@ def build_parser() -> argparse.ArgumentParser:
                            "rendered by 'kubetpu alerts'/'kubetpu "
                            "bundle'); 'off' (default) runs zero "
                            "evaluation work")
+    schd.add_argument("--alert-sink", default="",
+                      metavar="file:PATH|webhook:URL",
+                      help="out-of-process sentinel alert delivery: "
+                           "'file:PATH' appends one ndjson line per alert "
+                           "transition; 'webhook:URL' POSTs the "
+                           "transition JSON. Delivery failures are "
+                           "counted, never fatal. Requires --sentinel on")
     schd.set_defaults(fn=cmd_scheduler)
 
     cm = sub.add_parser(
@@ -1737,6 +1856,15 @@ def build_parser() -> argparse.ArgumentParser:
     )
     up.add_argument("--replicas", type=int, default=1,
                     help="scheduler replica processes")
+    up.add_argument("--apiservers", type=int, default=1,
+                    help="apiserver processes: 1 (default) is the classic "
+                         "single-writer topology, byte-identical to "
+                         "before; N>1 runs one leader + N-1 WAL-log-"
+                         "shipping follower apiservers — watch-fanout "
+                         "drivers spread their read load over the "
+                         "followers, and the most-caught-up follower "
+                         "takes over on leader death (failover by log "
+                         "position)")
     up.add_argument("--partition", default="race",
                     choices=["race", "hash", "lease"],
                     help="federation partition mode across the replica "

@@ -38,12 +38,33 @@ def apiserver_spec(
     restart: str = "never",
     env: dict | None = None,
     ready_timeout_s: float = 120.0,
+    port: int = 0,
+    replicated: bool = False,
+    follow: str = "",
+    peers: tuple = (),
+    replica_index: int = 0,
+    lease_duration_s: float = 0.0,
 ) -> ChildSpec:
-    args = ["apiserver", "--port", "0", "--wire", wire]
+    """``replicated``/``follow``/``peers``: the replicated read plane —
+    a leader spec sets ``replicated=True`` (holds the writer lease), a
+    follower spec sets ``follow=<leader url>``; both carry the full
+    ``peers`` electorate for failover. All default OFF: the unreplicated
+    spec's argv is byte-identical to what it always was."""
+    args = ["apiserver", "--port", str(port), "--wire", wire]
     if persistence:
         args += ["--persistence", persistence]
     if telemetry and telemetry != "off":
         args += ["--telemetry", telemetry]
+    if replicated and not follow:
+        args += ["--replicated"]
+    if follow:
+        args += ["--follow", follow]
+    if peers:
+        args += ["--peers", ",".join(peers)]
+    if replica_index:
+        args += ["--replica-index", str(replica_index)]
+    if lease_duration_s:
+        args += ["--lease-duration", str(lease_duration_s)]
     return ChildSpec(
         name=name, argv=kubetpu_argv(*args), restart=restart,
         env=env, shutdown_phase=1, ready_timeout_s=ready_timeout_s,
@@ -131,6 +152,11 @@ class Cluster:
     are spread over ``fanout_procs`` driver processes."""
 
     replicas: int = 1
+    apiservers: int = 1
+    #: writer-lease duration handed to a REPLICATED plane's apiservers
+    #: (0 = the CLI default). The failover bench tunes this down so
+    #: failover_to_serving_s measures the protocol, not a lazy lease.
+    lease_duration_s: float = 0.0
     partition: str = "race"
     wire: str = "binary"
     engine: str = "greedy"
@@ -148,7 +174,9 @@ class Cluster:
     supervisor: Supervisor = field(init=False, default=None)
     schedulers: list = field(init=False, default_factory=list)
     drivers: list = field(init=False, default_factory=list)
+    apiserver_children: list = field(init=False, default_factory=list)
     api_url: str = field(init=False, default="")
+    api_urls: list = field(init=False, default_factory=list)
     collector_url: str = field(init=False, default="")
 
     def start(self) -> "Cluster":
@@ -168,12 +196,19 @@ class Cluster:
             coll = sup.spawn(collector_spec(env=self.env))
             self.collector_url = coll.url()
             api_telemetry = self.collector_url
-        api = sup.spawn(apiserver_spec(
-            wire=self.wire, persistence=self.persistence,
-            telemetry=api_telemetry, env=self.env,
-            ready_timeout_s=self.ready_timeout_s,
-        ))
-        self.api_url = api.url()
+        if self.apiservers > 1:
+            self._start_apiservers(sup, api_telemetry)
+        else:
+            # the single-apiserver path is UNTOUCHED: same spec, same
+            # argv, byte-for-byte (the --apiservers 1 escape hatch)
+            api = sup.spawn(apiserver_spec(
+                wire=self.wire, persistence=self.persistence,
+                telemetry=api_telemetry, env=self.env,
+                ready_timeout_s=self.ready_timeout_s,
+            ))
+            self.api_url = api.url()
+            self.api_urls = [self.api_url]
+            self.apiserver_children = [api]
         if self.telemetry == "embed":
             # the embedded collector serves on the apiserver's own port
             self.collector_url = self.api_url
@@ -194,6 +229,9 @@ class Cluster:
             )))
         procs = self.fanout_procs or (1 if self.fanout_watchers else 0)
         if procs and self.fanout_watchers:
+            # watch fan-out is the READ load — with followers present it
+            # round-robins over them, leaving the leader to its writers
+            read_urls = self.api_urls[1:] or [self.api_url]
             per = -(-self.fanout_watchers // procs)               # ceil
             left = self.fanout_watchers
             for i in range(procs):
@@ -202,9 +240,52 @@ class Cluster:
                 if n <= 0:
                     break
                 self.drivers.append(sup.spawn(watch_driver_spec(
-                    name=f"watch-driver-{i}", server=self.api_url,
+                    name=f"watch-driver-{i}",
+                    server=read_urls[i % len(read_urls)],
                     watchers=n, wire=self.wire, env=self.env,
                 )))
+
+    def _start_apiservers(self, sup, api_telemetry: str) -> None:
+        """The replicated read plane: one leader + N-1 followers. Ports
+        are pre-allocated (bind 0 → read → close) so every child can be
+        handed the FULL peer electorate up front — followers need it for
+        failover elections, and the leader's URL must be printable in a
+        follower's argv before the leader has bannered."""
+        import socket
+
+        ports = []
+        socks = []
+        try:
+            for _ in range(self.apiservers):
+                s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+                s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+                s.bind(("127.0.0.1", 0))
+                ports.append(s.getsockname()[1])
+                socks.append(s)
+        finally:
+            for s in socks:
+                s.close()
+        peer_urls = [f"http://127.0.0.1:{p}" for p in ports]
+        leader_url = peer_urls[0]
+        children = [sup.spawn(apiserver_spec(
+            name="apiserver", port=ports[0], wire=self.wire,
+            persistence=self.persistence, telemetry=api_telemetry,
+            replicated=True, peers=tuple(peer_urls),
+            lease_duration_s=self.lease_duration_s,
+            env=self.env, ready_timeout_s=self.ready_timeout_s,
+        ))]
+        for i in range(1, self.apiservers):
+            # followers never persist — their WAL is the leader's
+            children.append(sup.spawn(apiserver_spec(
+                name=f"apiserver-f{i}", port=ports[i], wire=self.wire,
+                telemetry="off", follow=leader_url,
+                peers=tuple(peer_urls), replica_index=i,
+                lease_duration_s=self.lease_duration_s,
+                env=self.env, ready_timeout_s=self.ready_timeout_s,
+            )))
+        self.apiserver_children = children
+        self.api_urls = [c.url() for c in children]
+        self.api_url = self.api_urls[0]
 
     # ------------------------------------------------------------- accessors
     def scheduler_diag_urls(self) -> list[str]:

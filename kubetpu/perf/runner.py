@@ -153,6 +153,16 @@ class WorkloadResult:
     n_processes: int = 0
     child_stats: dict | None = None
     restarts: int = 0
+    # replicated read plane (run_workload_multiprocess with
+    # ``apiservers`` > 1): how many apiservers carried the run (1 leader
+    # + N-1 followers; the watch fan-out load round-robins over the
+    # followers) and the PEAK follower replication lag sampled over the
+    # measured window — the read plane's honesty counter: a follower may
+    # serve a slightly old rv, never a wrong one, and this is how old
+    # "slightly" got under load
+    apiservers: int = 1
+    follower_lag_ms: float | None = None
+    follower_lag_records: int | None = None
     # --- trace-shaped workloads (run_workload_trace) ---------------------
     # admission-latency SLO: p50/p99 of enqueue→bind over every pod the
     # trace created, judged against the profile's declared budget — the
@@ -1415,6 +1425,8 @@ def run_workload_trace(
             trace_stats["encode_rebuilt_bytes"] = st["rebuilt_bytes"]
             trace_stats["encode_extended_bytes"] = st["extended_bytes"]
             trace_stats["encode_scoped_extensions"] = st["scoped_extensions"]
+            trace_stats["encode_scoped_removals"] = st["scoped_removals"]
+            trace_stats["encode_compacted_bytes"] = st["compacted_bytes"]
             trace_stats["encode_invalidations"] = st["invalidations"]
             trace_stats["scoped_invalidation"] = bool(ec.scoped)
         artifacts: dict[str, str] = {}
@@ -2322,6 +2334,21 @@ def _scrape_metrics(url: str):
         return None
 
 
+def _replication_status(url: str, timeout: float = 2.0) -> dict | None:
+    """One apiserver's /replication/status page (None on any failure —
+    a follower mid-crash or mid-election must not kill the sampler)."""
+    import json as _json
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+            url.rstrip("/") + "/replication/status", timeout=timeout,
+        ) as resp:
+            return _json.loads(resp.read().decode())
+    except Exception:
+        return None
+
+
 def _sum_samples(parsed, name: str, **labels) -> float:
     """Sum of every sample of family ``name`` whose label set contains
     ``labels`` (a sum() over a PromQL instant selector)."""
@@ -2345,6 +2372,7 @@ def run_workload_multiprocess(
     case: W.TestCase | str,
     workload: W.Workload | str,
     replicas: int = 2,
+    apiservers: int = 1,
     partition: str = "race",
     wire: str = "binary",
     engine: str = "greedy",
@@ -2377,6 +2405,12 @@ def run_workload_multiprocess(
     re-adopts its rank's backlog via the informer relist, lease
     re-acquires through the shared store) and ``recovery_s`` measures
     kill → every measured pod bound.
+
+    ``apiservers`` > 1 stands up the replicated read plane (1 leader +
+    N-1 follower apiservers; the Cluster round-robins the watch fan-out
+    drivers over the followers, leaving the leader to its writers) and
+    samples each follower's peak replication lag over the measured
+    window into ``follower_lag_ms`` / ``follower_lag_records``.
 
     Evidence scraped over HTTP before shutdown: apiserver request/wire
     deltas for the measured window, per-replica federation conflicts +
@@ -2415,7 +2449,8 @@ def run_workload_multiprocess(
         _pkg.__file__
     )))
     cluster = Cluster(
-        replicas=replicas, partition=partition, wire=wire, engine=engine,
+        replicas=replicas, apiservers=apiservers, partition=partition,
+        wire=wire, engine=engine,
         max_batch=max_batch, persistence=persistence,
         telemetry=("collector" if telemetry else "off"),
         fanout_procs=fanout_procs, fanout_watchers=watch_fanout,
@@ -2430,6 +2465,31 @@ def run_workload_multiprocess(
     rpcs_total = wire_total = 0.0
     measure_namespaces: tuple[str, ...] = ()
     op_ns_counter = 0
+    # peak follower replication lag over the measured window (the read
+    # plane's honesty counter) — sampled from /replication/status at most
+    # every ``_LAG_SAMPLE_S`` inside the settle loop
+    _LAG_SAMPLE_S = 0.4
+    lag_peak: dict[str, float] = {}
+    lag_last_sample = [0.0]
+
+    def sample_follower_lag() -> None:
+        if apiservers < 2:
+            return
+        now = time.perf_counter()
+        if now - lag_last_sample[0] < _LAG_SAMPLE_S:
+            return
+        lag_last_sample[0] = now
+        for url in cluster.api_urls[1:]:
+            st = _replication_status(url)
+            if not st:
+                continue
+            lag_peak["ms"] = max(
+                lag_peak.get("ms", 0.0), float(st.get("lagMs") or 0.0)
+            )
+            lag_peak["records"] = max(
+                lag_peak.get("records", 0.0),
+                float(st.get("lagRecords") or 0.0),
+            )
 
     cluster.start()
     try:
@@ -2472,6 +2532,7 @@ def run_workload_multiprocess(
                     cluster.kill_replica(len(cluster.schedulers) - 1)
                     killed = True
                     t_kill = time.perf_counter()
+                sample_follower_lag()
                 if done > before:
                     last_progress = now
                 elif now - last_progress > stall_s:
@@ -2591,6 +2652,7 @@ def run_workload_multiprocess(
         case_name=case.name,
         workload_name=(
             f"{workload.name}_mp_{replicas}sched_{partition}"
+            + (f"_{apiservers}api" if apiservers > 1 else "")
         ),
         threshold=workload.threshold,
         threshold_note=workload.threshold_note,
@@ -2623,6 +2685,11 @@ def run_workload_multiprocess(
         n_processes=n_processes,
         child_stats=child_stats,
         restarts=restarts,
+        apiservers=apiservers,
+        follower_lag_ms=lag_peak.get("ms"),
+        follower_lag_records=(
+            int(lag_peak["records"]) if "records" in lag_peak else None
+        ),
     )
 
 
@@ -2759,6 +2826,252 @@ def run_crash_recovery(
     finally:
         if own_dir:
             shutil.rmtree(dirpath, ignore_errors=True)
+
+
+def run_replicated_failover(
+    n_nodes: int = 5000,
+    n_pods: int = 50000,
+    apiservers: int = 3,
+    bind_frac: float = 0.5,
+    wire: str = "binary",
+    lease_duration_s: float = 0.5,
+    timeout_s: float = 300.0,
+    serve_timeout_s: float = 60.0,
+    child_env: dict | None = None,
+) -> dict:
+    """The replicated read plane's failover-by-log-position bench — the
+    hot-standby answer to ``run_crash_recovery``'s cold restart, on the
+    SAME 5k-node / 50k-pod durability shape but with every process REAL
+    (1 leader + N-1 follower apiservers under the launch supervisor):
+
+    - drive the write storm (bulk creates + CAS binds of ``bind_frac`` of
+      the pods) through the leader over HTTP while a sampler thread reads
+      each follower's ``/replication/status`` — the PEAK ``lagMs`` /
+      ``lagRecords`` under the storm is ``follower_lag_ms`` /
+      ``follower_lag_records`` (the read plane's honesty counter);
+    - wait for every follower to catch the leader's rv, then SIGKILL the
+      leader (restart policy "never" — nobody respawns it);
+    - ``failover_to_serving_s``: kill → a follower won the writer lease
+      by log position AND serves a successful full list AND accepts a
+      probe write. This is the number the cold ``recovery_s`` wall is
+      judged against — a hot standby that already holds the state must
+      beat a process that replays the WAL from disk;
+    - binding parity, store-verified on the NEW leader: every CAS-bound
+      pod bound exactly once across the failover (a miss raises — the
+      stage fails, never a green line nothing gates on).
+
+    The lease is tuned short (``lease_duration_s``) so the measurement is
+    the protocol — position probe, epoch-fenced CAS — not a lazy lease
+    expiry."""
+    import os as _os
+    import threading as _threading
+
+    import kubetpu as _pkg
+
+    from ..api.wrappers import make_node, make_pod
+    from ..apiserver import RemoteStore
+    from ..client.informers import NODES, PODS
+    from ..launch import Cluster
+    from ..store.memstore import bulk_result_error
+
+    if apiservers < 2:
+        raise ValueError("failover needs at least one follower apiserver")
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(
+        _pkg.__file__
+    )))
+    cluster = Cluster(
+        replicas=0, apiservers=apiservers, wire=wire,
+        lease_duration_s=lease_duration_s, env=child_env, cwd=repo_root,
+    )
+    lag_peak = {"ms": 0.0, "records": 0}
+    samples = [0]
+    stop = _threading.Event()
+
+    def _checked_bulk(admin, kind, ops):
+        for res in admin.bulk(kind, ops):
+            err = bulk_result_error(res)
+            if err is not None:
+                raise err
+
+    cluster.start()
+    try:
+        leader_url = cluster.api_url
+        follower_urls = list(cluster.api_urls[1:])
+
+        def _sampler() -> None:
+            while not stop.wait(0.3):
+                for u in follower_urls:
+                    st = _replication_status(u)
+                    if not st:
+                        continue
+                    samples[0] += 1
+                    lag_peak["ms"] = max(
+                        lag_peak["ms"], float(st.get("lagMs") or 0.0)
+                    )
+                    lag_peak["records"] = max(
+                        lag_peak["records"],
+                        int(st.get("lagRecords") or 0),
+                    )
+
+        sampler = _threading.Thread(target=_sampler, daemon=True)
+        sampler.start()
+        admin = RemoteStore(leader_url, wire=wire)
+        # ---- the write storm: the durability shape, through the leader
+        chunk = 512
+        t_pop0 = time.perf_counter()
+        for i in range(0, n_nodes, chunk):
+            _checked_bulk(admin, NODES, [
+                {"op": "create", "key": f"node-{j}",
+                 "object": make_node(f"node-{j}")}
+                for j in range(i, min(i + chunk, n_nodes))
+            ])
+        for i in range(0, n_pods, chunk):
+            _checked_bulk(admin, PODS, [
+                {"op": "create", "key": f"bench/pod-{j}",
+                 "object": make_pod(f"pod-{j}", namespace="bench")}
+                for j in range(i, min(i + chunk, n_pods))
+            ])
+        n_bound = int(n_pods * bind_frac)
+        for i in range(0, n_bound, chunk):
+            keys = [
+                f"bench/pod-{j}" for j in range(i, min(i + chunk, n_bound))
+            ]
+            gets = admin.bulk(PODS, [{"op": "get", "key": k} for k in keys])
+            _checked_bulk(admin, PODS, [
+                {"op": "update", "key": k,
+                 "object": g["object"].with_node(
+                     f"node-{int(k.rsplit('-', 1)[1]) % n_nodes}"
+                 ),
+                 "expect_rv": g["resourceVersion"]}
+                for k, g in zip(keys, gets)
+            ])
+        populate_s = time.perf_counter() - t_pop0
+        pre_rv = int(
+            (_replication_status(leader_url) or {}).get("resourceVersion")
+            or 0
+        )
+        if pre_rv <= 0:
+            raise RuntimeError("leader /replication/status unreadable")
+        # ---- every follower caught up: the failover measures the
+        # protocol, not residual shipping
+        t_catch0 = time.perf_counter()
+        deadline = t_catch0 + timeout_s
+        while True:
+            rvs = [
+                int((_replication_status(u) or {}).get("resourceVersion")
+                    or 0)
+                for u in follower_urls
+            ]
+            if all(rv >= pre_rv for rv in rvs):
+                break
+            if time.perf_counter() > deadline:
+                raise RuntimeError(
+                    f"followers never caught rv {pre_rv}: {rvs}"
+                )
+            time.sleep(0.05)
+        catch_up_s = time.perf_counter() - t_catch0
+        stop.set()
+        sampler.join(timeout=5)
+        # the bound set, read from the READ plane (a follower), pre-kill
+        items, _rv = RemoteStore(follower_urls[0], wire=wire).list(PODS)
+        pre_bound = sum(1 for _k, pod in items if pod.node_name)
+        assert pre_bound == n_bound, (
+            f"follower read plane lost binds pre-kill: "
+            f"{pre_bound} != {n_bound}"
+        )
+        # ---- SIGKILL the leader; measure kill -> a follower SERVES
+        cluster.supervisor.kill("apiserver")
+        t0 = time.perf_counter()
+        serve_deadline = t0 + serve_timeout_s
+        new_leader = None
+        while time.perf_counter() < serve_deadline and new_leader is None:
+            for u in follower_urls:
+                st = _replication_status(u)
+                if st and st.get("role") == "leader":
+                    new_leader = u
+                    break
+            if new_leader is None:
+                time.sleep(0.02)
+        if new_leader is None:
+            raise RuntimeError(
+                f"no follower promoted within {serve_timeout_s}s"
+            )
+        elected_s = time.perf_counter() - t0
+        admin2 = RemoteStore(new_leader, wire=wire)
+        post_bound = -1
+        post_rv = 0
+        while time.perf_counter() < serve_deadline:
+            try:
+                items2, post_rv = admin2.list(PODS)
+                post_bound = sum(
+                    1 for _k, pod in items2 if pod.node_name
+                )
+                break
+            except Exception:
+                time.sleep(0.02)
+        probe_ok = False
+        attempt = 0
+        while time.perf_counter() < serve_deadline and not probe_ok:
+            try:
+                admin2.create(
+                    PODS, f"failover/probe-{attempt}",
+                    make_pod(f"probe-{attempt}", namespace="failover"),
+                )
+                probe_ok = True
+            except Exception:
+                attempt += 1
+                time.sleep(0.02)
+        failover_to_serving_s = time.perf_counter() - t0
+        if not probe_ok:
+            raise RuntimeError(
+                f"new leader {new_leader} never accepted the probe write "
+                f"within {serve_timeout_s}s"
+            )
+        # hard gates, run_crash_recovery-style: a failover that lost
+        # bindings or rv continuity FAILS the stage
+        assert post_bound == n_bound, (
+            f"binding parity broken across failover: "
+            f"{post_bound} != {n_bound}"
+        )
+        assert post_rv >= pre_rv, (
+            f"rv continuity broken across failover: "
+            f"{post_rv} < {pre_rv}"
+        )
+        # the epoch fence lands at the lease CAS, which completes just
+        # after the role flip that let the probe through — wait briefly
+        # so the record carries the fenced epoch, without gating the
+        # serving wall on it
+        new_st = _replication_status(new_leader) or {}
+        fence_deadline = time.perf_counter() + 5.0
+        while (
+            not new_st.get("promotions")
+            and time.perf_counter() < fence_deadline
+        ):
+            time.sleep(0.05)
+            new_st = _replication_status(new_leader) or new_st
+        return {
+            "n_nodes": n_nodes,
+            "n_pods": n_pods,
+            "apiservers": apiservers,
+            "bound": n_bound,
+            "binding_parity": post_bound,
+            "parity_ok": post_bound == n_bound,
+            "rv": pre_rv,
+            "new_leader_rv": post_rv,
+            "populate_s": round(populate_s, 3),
+            "catch_up_s": round(catch_up_s, 3),
+            "elected_s": round(elected_s, 3),
+            "failover_to_serving_s": round(failover_to_serving_s, 3),
+            "follower_lag_ms": round(lag_peak["ms"], 3),
+            "follower_lag_records": lag_peak["records"],
+            "lag_samples": samples[0],
+            "lease_duration_s": lease_duration_s,
+            "epoch": new_st.get("epoch"),
+            "promotions": new_st.get("promotions"),
+        }
+    finally:
+        stop.set()
+        cluster.shutdown()
 
 
 def run_wal_overhead(

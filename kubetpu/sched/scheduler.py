@@ -558,7 +558,12 @@ class Scheduler:
     def on_node_delete(self, node: t.Node) -> None:
         self.cache.remove_node(node.name)
         if self.encode_cache is not None:
-            self.encode_cache.invalidate_nodes()
+            # SCOPED invalidation: a drain-wave delete compacts cached
+            # rows down to the surviving nodes' columns at the next sync
+            # (an old-index gather, bit-identical to fresh) instead of
+            # flushing every node-dependent store — the removal twin of
+            # the add-wave extension
+            self.encode_cache.invalidate_nodes(removed=node)
         self.queue.on_event(
             ClusterEvent(EventResource.NODE, ActionType.DELETE), node, None
         )

@@ -166,11 +166,16 @@ class EncodeCache:
     appended nodes' columns at the next sync — O(templates × Δnodes) —
     instead of clearing all node-dependent stores (at 100k nodes under an
     autoscaler add-wave the wholesale clear was a full re-encode storm per
-    event). Updates and deletes still take the full-epoch flush through the
-    bare ``invalidate_nodes()`` seam (delete reindexes rows; update changes
-    facts at an interior index). ``scoped=False`` is the escape hatch /
-    A-B control: every epoch bump clears wholesale, the pre-PR-14
-    behavior."""
+    event). A node DELETE (``invalidate_nodes(removed=...)``) is scoped
+    too: the next sync maps the rebuilt tensors' node names back to the
+    old indices and COMPACTS every cached row by gathering the survivor
+    columns — rows are pure per-node functions, so the gather is
+    bit-identical to a fresh build (the drain-wave twin of the add-wave
+    extension; ROADMAP 5b). Only updates (facts change at an interior
+    index) and mixed add+remove waves still pay the full-epoch flush
+    through the bare ``invalidate_nodes()`` seam. ``scoped=False`` is the
+    escape hatch / A-B control: every epoch bump clears wholesale, the
+    pre-PR-14 behavior."""
 
     def __init__(
         self, max_entries: int = 8192, metrics=None, scoped: bool = True,
@@ -187,6 +192,7 @@ class EncodeCache:
         # extends its count vectors instead of rebuilding them wholesale
         self._full_epoch = 0
         self._pending_adds = 0        # scoped adds since the last sync
+        self._pending_removes = 0     # scoped removals since the last sync
         self._pending_full = False    # a full flush is owed at next sync
         self._nt_len = -1             # node count rows were built against
         self._nt_token: object | None = None   # adopted NodeTensors
@@ -237,23 +243,31 @@ class EncodeCache:
         self.rebuilt_bytes = 0
         self.extended_bytes = 0
         self.scoped_extensions = 0
+        self.scoped_removals = 0
+        self.compacted_bytes = 0      # row bytes dropped by removal gathers
         self._flushed_hits: collections.Counter = collections.Counter()
         self._flushed_misses: collections.Counter = collections.Counter()
         self._flushed_invalidations = 0
         self.metrics = metrics   # TPUBackendMetrics | None
 
     # ------------------------------------------------------------ epochs
-    def invalidate_nodes(self, added=None) -> None:
-        """A node event landed. Bare call (``added=None``) — the BLESSED
-        full-epoch seam for updates/deletes: every node-dependent row is
-        suspect and the next sync clears wholesale. ``added=<node>`` — a
-        scoped node ADD: the next sync EXTENDS cached rows with the
-        appended nodes' columns instead of clearing (graftcheck EC001 pins
-        bare calls to the scheduler's update/delete handlers so this
-        scoping can't silently regress to a flush-per-event storm).
-        O(1) either way — all real work is deferred to the next sync."""
+    def invalidate_nodes(self, added=None, removed=None) -> None:
+        """A node event landed. Bare call — the BLESSED full-epoch seam
+        for updates: every node-dependent row is suspect and the next
+        sync clears wholesale. ``added=<node>`` — a scoped node ADD: the
+        next sync EXTENDS cached rows with the appended nodes' columns
+        instead of clearing. ``removed=<node>`` — a scoped node DELETE
+        (the drain wave): the next sync COMPACTS cached rows down to the
+        surviving nodes' columns by an old-index gather, falling back to
+        the wholesale clear when the wave turns out to be mixed
+        (graftcheck EC001 pins bare calls to the scheduler's node event
+        handlers so this scoping can't silently regress to a
+        flush-per-event storm). O(1) every way — all real work is
+        deferred to the next sync."""
         self.node_epoch += 1
-        if added is not None and self.scoped:
+        if removed is not None and self.scoped:
+            self._pending_removes += 1
+        elif added is not None and self.scoped:
             self._pending_adds += 1
         else:
             self._pending_full = True
@@ -282,6 +296,7 @@ class EncodeCache:
         if (
             self.scoped
             and not self._pending_full
+            and not self._pending_removes
             and self._nt_token is nt
             and 0 <= self._nt_len < nt.num_nodes
             and (len(self._filter_rows) + len(self._score_rows))
@@ -293,6 +308,32 @@ class EncodeCache:
             self._pending_adds = 0
             self.scoped_extensions += 1
             return False    # rows stayed valid — not an invalidation
+        # removal-only wave: deletes rebuild the tensors, so the NEW
+        # object's node names are mapped back to old indices and every
+        # cached row is compacted by a survivor gather — bit-identical to
+        # a fresh build (rows are pure per-node functions and no
+        # survivor's facts changed). Any name the old axis doesn't know
+        # (a mixed wave) falls through to the wholesale clear.
+        if (
+            self.scoped
+            and not self._pending_full
+            and self._pending_removes
+            and not self._pending_adds
+            and nt is not None
+            and self._nt_token is not None
+            and self._nt_token is not nt
+            and (len(self._filter_rows) + len(self._score_rows))
+            <= self.extend_max_entries
+        ):
+            keep = self._removal_keep(nt)
+            if keep is not None:
+                self._compact_rows(nt, keep)
+                self._nt_token = nt
+                self._nt_epoch = self.node_epoch
+                self._nt_len = nt.num_nodes
+                self._pending_removes = 0
+                self.scoped_removals += 1
+                return False    # rows stayed valid — not an invalidation
         self._filter_rows.clear()
         self._score_rows.clear()
         self._ctx = None
@@ -301,6 +342,7 @@ class EncodeCache:
         self._nt_epoch = self.node_epoch
         self._nt_len = nt.num_nodes if nt is not None else -1
         self._pending_adds = 0
+        self._pending_removes = 0
         self._pending_full = False
         if invalidated:
             self.invalidations += 1
@@ -362,6 +404,86 @@ class EncodeCache:
                 np.concatenate([na, dna]), np.concatenate([tt, dtt]), pod,
             )
             self.extended_bytes += dna.nbytes + dtt.nbytes
+
+    def _removal_keep(self, nt) -> "np.ndarray | None":
+        """Map the rebuilt tensors' node names back to old row indices:
+        ``keep[j]`` = the old index of new node j. None when the mapping
+        is not a pure survivor gather — an unknown name means the wave
+        also ADDED a node (mixed: wholesale), and a stale old token
+        (mutated past the rows' length) can't be trusted as the source
+        axis."""
+        old_names = getattr(self._nt_token, "node_names", None)
+        if old_names is None or len(old_names) != self._nt_len:
+            return None
+        if nt.num_nodes >= len(old_names):
+            return None     # nothing was removed — not a drain wave
+        pos = {name: i for i, name in enumerate(old_names)}
+        keep = np.empty(nt.num_nodes, dtype=np.int64)
+        for j, name in enumerate(nt.node_names):
+            i = pos.get(name)
+            if i is None:
+                return None
+            keep[j] = i
+        return keep
+
+    def _compact_rows(self, nt, keep: np.ndarray) -> None:
+        """Gather the survivor columns out of every cached row (and the
+        hoisted node ctx / group count vectors): ``row[keep]`` reorders
+        old columns into the new axis order, which is bit-identical to
+        rebuilding each row against the new tensors because rows are
+        pure per-node functions and a removal-only wave changes no
+        survivor's facts."""
+        old_n = self._nt_len
+        ctx = self._ctx
+        if ctx is not None:
+            ctx.node_taints = [ctx.node_taints[i] for i in keep]
+            ctx.tainted_nodes = [
+                (j, tt) for j, tt in enumerate(ctx.node_taints) if tt
+            ]
+            ctx.node_unsched = ctx.node_unsched[keep]
+            ctx.any_unsched = bool(ctx.node_unsched.any())
+            if ctx.node_feature_sets is not None:
+                nfs = [ctx.node_feature_sets[i] for i in keep]
+                # fresh build_node_ctx collapses to None when no node
+                # declares features — match it so downstream branches
+                # (feature filter on/off) stay identical
+                ctx.node_feature_sets = nfs if any(nfs) else None
+        fd = self._filter_rows._d
+        for key in list(fd.keys()):
+            row, _trivial, pod = fd[key]
+            row2 = row[keep]
+            fd[key] = (row2, bool(row2.all()), pod)
+            self.compacted_bytes += max(row.nbytes - row2.nbytes, 0)
+        sd = self._score_rows._d
+        for key in list(sd.keys()):
+            na, tt, pod = sd[key]
+            na2, tt2 = na[keep], tt[keep]
+            sd[key] = (na2, tt2, pod)
+            self.compacted_bytes += max(
+                na.nbytes + tt.nbytes - na2.nbytes - tt2.nbytes, 0
+            )
+        # the incremental template-group index rides along: gather its
+        # count vectors and drop the removed nodes' per-node entries, so
+        # the next pod_groups() stays O(Δ) instead of re-deriving every
+        # node after the drain wave
+        if (
+            self._groups_nt is self._nt_token
+            and self._groups_epoch == self._full_epoch
+        ):
+            vecs = self._group_vecs
+            for gid, vec in list(vecs.items()):
+                if len(vec) < old_n:
+                    vec = np.concatenate(
+                        [vec, np.zeros(old_n - len(vec), dtype=np.int64)]
+                    )
+                vecs[gid] = vec[keep]
+            gone = set(getattr(self._nt_token, "node_names", ())) - set(
+                nt.node_names
+            )
+            for name in gone:
+                self._group_node.pop(name, None)
+                self._group_gens.pop(name, None)
+            self._groups_nt = nt
 
     def fresh_for(self, nt) -> bool:
         """May event-time precompute build rows against ``nt`` right now?
@@ -612,6 +734,8 @@ class EncodeCache:
             "rebuilt_bytes": self.rebuilt_bytes,
             "extended_bytes": self.extended_bytes,
             "scoped_extensions": self.scoped_extensions,
+            "scoped_removals": self.scoped_removals,
+            "compacted_bytes": self.compacted_bytes,
         }
 
     def hit_rate(self, kinds=("filter", "score", "request")) -> float | None:

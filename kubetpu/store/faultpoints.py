@@ -37,6 +37,19 @@ The points (see kubetpu.store.wal for the exact sites):
                            through deleting superseded segments/snapshots:
                            recovery must skip already-covered records
                            idempotently (replay is rv-gated).
+``rep-mid-ship``           the leader dies while assembling/serving a
+                           replication batch: followers saw none or part of
+                           the batch — failover must preserve exactly-once
+                           apply of every ACKED write (the shipped-but-
+                           unacked tail is the old leader's to lose).
+``rep-post-ship-pre-apply`` the follower received a batch but dies (or the
+                           leader dies) before ``apply_replicated`` ran:
+                           the re-fetched batch must apply idempotently
+                           (replication apply is rv-gated like recovery).
+``rep-mid-election``       death between choosing to promote (log position
+                           won) and completing the promotion: the next
+                           election round must converge on A leader with
+                           the fenced epoch, never two.
 ========================== =================================================
 
 The harness is process-global and OFF by default: ``fire()`` is a single
@@ -58,6 +71,18 @@ FAULT_POINTS = (
     "wal-mid-truncate",
 )
 
+#: replication-path injection points (kubetpu.store.replication) — a
+#: SEPARATE tuple because the WAL torture loop above fires each of its
+#: points on a plain store write, which never traverses the replication
+#: path (tests/test_replication.py drives these)
+REPLICATION_FAULT_POINTS = (
+    "rep-mid-ship",
+    "rep-post-ship-pre-apply",
+    "rep-mid-election",
+)
+
+ALL_FAULT_POINTS = FAULT_POINTS + REPLICATION_FAULT_POINTS
+
 
 class CrashPoint(BaseException):
     """A simulated process death at a named fault point. BaseException so
@@ -77,7 +102,7 @@ _fired: list[str] = []          # points that actually crashed, in order
 
 def arm(name: str, at_hit: int = 1) -> None:
     """Arm ``name`` to crash on its ``at_hit``-th traversal (1 = next)."""
-    if name not in FAULT_POINTS:
+    if name not in ALL_FAULT_POINTS:
         raise ValueError(f"unknown fault point {name!r}")
     if at_hit < 1:
         raise ValueError("at_hit must be >= 1")

@@ -81,6 +81,21 @@ class ConflictError(Exception):
     Create hit an existing object."""
 
 
+class FollowerWriteError(Exception):
+    """A local write reached a replication FOLLOWER store. Followers are
+    read-only replicas — every mutation must land on the leader (the
+    apiserver answers with a redirect carrying the leader's URL); the only
+    paths that may move a follower's core are ``apply_replicated`` and
+    ``load_replica_snapshot`` (graftcheck RP001 pins the seam)."""
+
+
+class ReplicationGapError(Exception):
+    """The replication feed skipped revisions — a shipped record's rv is
+    not contiguous with the follower's store. The follower must resync
+    from a leader snapshot (the live-replay twin of recovery's WALError
+    'replay gap'; never silently applied out of order)."""
+
+
 def bulk_result_error(res: dict) -> Exception | None:
     """Map one bulk-op result (the ``{"status": …, "error": …}`` dicts
     ``MemStore.bulk``/``RemoteStore.bulk`` return) to the exception the
@@ -306,7 +321,8 @@ class MemStore:
     def __init__(self, history: int = 8192, native: bool | None = None,
                  persistence: "str | None" = None,
                  wal_wire: str = "binary", wal_fsync: bool = True,
-                 compact_every: int = 65536) -> None:
+                 compact_every: int = 65536,
+                 follower: bool = False) -> None:
         """``persistence``: a directory path turns on the write-ahead log
         + snapshot durability (kubetpu.store.wal) — recover-on-start
         replays snapshot+tail into the core, every committed write is
@@ -315,7 +331,19 @@ class MemStore:
         off``) is byte-identical to the memory-only store. ``wal_wire``
         picks the record codec (binary default — the compact wire the
         body ring speaks); ``wal_fsync=False`` is the benchmark escape
-        hatch (flush-to-OS only)."""
+        hatch (flush-to-OS only). ``follower`` makes this store a
+        replication replica: local writes raise FollowerWriteError and
+        the core moves ONLY through ``apply_replicated`` /
+        ``load_replica_snapshot`` (kubetpu.store.replication tails the
+        leader's log into this seam) until ``promote()``."""
+        if follower and persistence:
+            raise ValueError(
+                "a follower store is a memory replica — its durability is "
+                "the leader's WAL (bootstrap loads a snapshot the local "
+                "log never saw, so a follower-side WAL could not recover)"
+            )
+        self._follower = follower
+        self._applying = False      # True only inside the replication seam
         self._lock = threading.Condition()
         core_cls = None
         if native is not False and not os.environ.get("KUBETPU_NO_NATIVE"):
@@ -373,6 +401,14 @@ class MemStore:
         doomed write raises the CANONICAL core error without ever being
         logged (a logged-but-failed write would corrupt the replay
         chain); caller holds the store lock."""
+        if self._follower and not self._applying:
+            # the follower guard sits at THE choke point every mutation
+            # routes through (WL001's seam), so no write verb — present or
+            # future — can slip a local write into a replica
+            raise FollowerWriteError(
+                "store is a replication follower — writes must go to the "
+                "leader apiserver"
+            )
         if self._wal_closed:
             # the WAL was flushed and closed (graceful shutdown): an ack'd
             # write from here on would be silently non-durable — refuse
@@ -735,6 +771,132 @@ class MemStore:
                 lambda: self._core.resource_version() > rv, timeout=timeout
             )
 
+    # -------------------------------------------------------- replication
+    # Log-shipping (kubetpu.store.replication): the leader serves ordered
+    # (kind, wire body) records straight off the serialize-once body ring;
+    # a follower replays them through apply_replicated — the live twin of
+    # WAL recovery's rv-gated replay, routed through _commit_locked so the
+    # follower's ring/rv continuity is identical to having taken the
+    # writes itself.
+
+    @property
+    def follower(self) -> bool:
+        return self._follower
+
+    def replication_records(
+        self, rv: int, codec: str = "binary"
+    ) -> tuple[list[tuple[str, bytes]], int]:
+        """Ordered ``(kind, event wire body)`` for every event after
+        ``rv`` + the new cursor — the leader's ship feed. Bodies come off
+        the core's serialize-once ring (shared with watch fan-out: one
+        encode serves watchers AND replication); kinds ride the ring
+        metadata from the SAME lock round, so the two walks pair 1:1.
+        Raises CompactedError when ``rv`` predates the ring — the
+        follower must bootstrap from a snapshot instead."""
+        enc, cid = _wire_encoder(codec)
+        with self._lock:
+            self._check_body_gen_locked()
+            try:
+                meta, cursor = self._core.events_since(None, rv)
+                bodies, _ = self._core.event_bodies_since(None, rv, cid, enc)
+            except LookupError as e:
+                raise CompactedError(str(e)) from None
+        return [(m[1], b) for m, b in zip(meta, bodies)], cursor
+
+    def _apply_replicated_locked(self, ev_type: int, kind: str, key: str,
+                                 obj: Any, rv: int) -> bool:
+        """One shipped record into the core — rv-gated exactly like WAL
+        replay (at-or-below: idempotent skip; a gap: loud resync error),
+        routed through _commit_locked under the ``_applying`` flag so the
+        follower guard stands for every other caller."""
+        have = self._core.resource_version()
+        if rv <= have:
+            return False                     # double ship / re-fetch
+        if rv != have + 1:
+            raise ReplicationGapError(
+                f"shipped record rv {rv} after store rv {have} — "
+                "resync from a leader snapshot required"
+            )
+        self._applying = True
+        try:
+            if ev_type == 2:
+                got = self._commit_locked("delete", kind, key)
+            else:
+                got = self._commit_locked("update", kind, key, obj, -1)
+        finally:
+            self._applying = False
+        if got != rv:
+            raise ReplicationGapError(
+                f"replicated {kind}/{key} applied at rv {got}, "
+                f"record said {rv}"
+            )
+        return True
+
+    def apply_replicated(self, ev_type: int, kind: str, key: str,
+                         obj: Any, rv: int) -> bool:
+        """Apply ONE shipped record (``ev_type`` is the ring id: 0 ADDED /
+        1 MODIFIED / 2 DELETED). True when applied, False when rv-gated
+        away. Follower-only."""
+        with self._lock:
+            if not self._follower:
+                raise RuntimeError(
+                    "apply_replicated on a non-follower store"
+                )
+            applied = self._apply_replicated_locked(
+                ev_type, kind, key, obj, rv
+            )
+            if applied:
+                self._lock.notify_all()
+            return applied
+
+    def apply_replicated_batch(self, records) -> int:
+        """A shipped batch under ONE lock round (the tail-follow hot
+        path: a write storm's batch pays one lock acquisition and one
+        notify, like ``bulk`` on the leader). ``records`` yields
+        (ev_type, kind, key, obj, rv); returns how many applied."""
+        applied = 0
+        with self._lock:
+            if not self._follower:
+                raise RuntimeError(
+                    "apply_replicated on a non-follower store"
+                )
+            for ev_type, kind, key, obj, rv in records:
+                if self._apply_replicated_locked(ev_type, kind, key, obj, rv):
+                    applied += 1
+            if applied:
+                self._lock.notify_all()
+        return applied
+
+    def load_replica_snapshot(self, items, rv: int) -> None:
+        """Bootstrap/resync: reset the replica to a leader snapshot
+        (objects + per-object rvs, store revision ``rv``, event ring
+        empty with the compaction horizon at ``rv`` — a watcher holding
+        an older cursor takes the bounded 410 relist, exactly recovery's
+        contract)."""
+        with self._lock:
+            if not self._follower:
+                raise RuntimeError(
+                    "load_replica_snapshot on a non-follower store"
+                )
+            self._core.load_snapshot(list(items), rv)
+            self._lock.notify_all()
+
+    def promote(self) -> int:
+        """Failover: flip the replica into a writable leader store at its
+        replayed position (no recovery replay — the state is already
+        live). Returns the revision the new leader starts serving at."""
+        with self._lock:
+            self._follower = False
+            self._lock.notify_all()
+            return self._core.resource_version()
+
+    def demote(self) -> None:
+        """The inverse of ``promote`` — an election candidate that
+        promoted but lost the writer-lease CAS steps back down before
+        any local write could land."""
+        with self._lock:
+            self._follower = True
+
     # --------------------------------------------------------- durability
     @property
     def persistent(self) -> bool:
@@ -745,6 +907,13 @@ class MemStore:
         recovery tests' parity probe and ``compact``'s snapshot input."""
         with self._lock:
             return self._core.dump()
+
+    def dump_with_rv(self) -> tuple[list, int]:
+        """(dump, store revision) from ONE lock round — the consistent
+        pair a replication bootstrap snapshot needs (a dump and a
+        revision read separately could straddle a write)."""
+        with self._lock:
+            return self._core.dump(), self._core.resource_version()
 
     def compact(self) -> "str | None":
         """Force a compaction snapshot now (snapshot at the current rv,

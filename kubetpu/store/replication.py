@@ -1,0 +1,601 @@
+"""WAL log-shipping replication — leader/follower read plane for the store.
+
+The reference's deployment shape is N apiservers over ONE durable log
+(etcd): every apiserver serves reads/lists/watches from its own watch
+cache, writes funnel through the raft leader, and failover promotes the
+most-caught-up member by log position. The PR-13 wire ladder showed the
+single-process ceiling (~60 pods/s at 5k nodes with 200 watchers —
+throughput PARITY across 1..4 schedulers because every read frame funnels
+through one apiserver); this module is the fix, built on the seams PR-11
+already laid:
+
+- **The wire IS the WAL.** A shipped record is the exact frame
+  ``WriteAheadLog.append`` writes (u32 len | u32 crc | u8 kind_len | kind
+  | event wire body); the bootstrap snapshot is the exact byte layout a
+  compaction snapshot has. One copy of the format rules (kubetpu.store
+  .wal), one fingerprint refusal for drifted builds.
+- **Serialize-once, three consumers.** The leader's feed
+  (``MemStore.replication_records``) drains the SAME per-event body ring
+  that watch fan-out and the WAL share — one encode per event serves
+  every watcher, the local log, and every follower.
+- **Replay is recovery, live.** A follower applies shipped records
+  through ``MemStore.apply_replicated`` — rv-gated exactly like
+  ``recover_into`` (at-or-below: idempotent skip; a gap: loud resync),
+  routed through the ``_commit_locked`` seam so the follower's event
+  ring, resourceVersion continuity, and watch semantics are identical to
+  having taken the writes itself. A follower watcher relists (410) only
+  across a snapshot bootstrap — the same bounded contract as recovery.
+- **Failover is by log position, fenced by the writer lease.** The
+  leader holds the ``apiserver-writer`` lease IN ITS OWN STORE (the
+  sched.leaderelection machinery over StoreLeaseClient), so every lease
+  renewal replicates — the heartbeat IS a log record. On leader loss a
+  follower polls its peers' /replication/status and promotes only when
+  its position is the maximum (ties break by replica index); promotion
+  flips the store writable and takes the lease, bumping
+  ``leader_transitions`` — the fencing epoch. A ship carrying an epoch
+  below a follower's observed epoch is refused loudly
+  (``StaleEpochError``): a resurrected old leader cannot feed anyone.
+
+Fault points (kubetpu.store.faultpoints, the ``rep-*`` tuple) instrument
+the ship/apply/election boundaries; tests/test_replication.py kills the
+leader at each and asserts exactly-once binding parity on the survivor.
+"""
+
+from __future__ import annotations
+
+import http.client
+import threading
+import time
+from typing import Callable
+from urllib.parse import urlsplit
+
+from ..api import codec
+from . import faultpoints
+from .memstore import MemStore, ReplicationGapError
+from .wal import WALError, decode_snapshot_stream, frame_record, \
+    iter_log_stream
+
+#: replication endpoints' media type (the body is WAL frames / a WAL
+#: snapshot stream — not a negotiated API object)
+CT_WAL = "application/x-kubetpu-wal"
+
+#: response headers carrying the feed's position + fencing state
+H_CURSOR = "X-Kubetpu-Rep-Cursor"
+H_EPOCH = "X-Kubetpu-Rep-Epoch"
+H_CODEC = "X-Kubetpu-Rep-Codec"
+
+#: the writer lease (sched.leaderelection over the replicated store):
+#: ONE name both the leader's renewer and every follower's candidate use
+LEASE_NAMESPACE = "kube-system"
+LEASE_NAME = "apiserver-writer"
+
+
+class ReplicationError(Exception):
+    """Replication protocol failure (bad response, undecodable ship)."""
+
+
+class StaleEpochError(ReplicationError):
+    """A ship arrived from a leader whose epoch is BELOW the observed
+    fencing epoch — a deposed leader still feeding. Refused loudly,
+    never applied (the split-brain guard)."""
+
+
+def build_log_body(store: MemStore, after_rv: int,
+                   wire: str = codec.BINARY) -> tuple[bytes, int, int]:
+    """The leader's ship: every event after ``after_rv`` as WAL frames
+    off the serialize-once body ring → (body, cursor, record count).
+    Raises CompactedError when the follower's cursor predates the ring
+    (it must bootstrap from a snapshot instead)."""
+    records, cursor = store.replication_records(after_rv, wire)
+    faultpoints.fire("rep-mid-ship")
+    return (
+        b"".join(frame_record(kind, body) for kind, body in records),
+        cursor, len(records),
+    )
+
+
+def default_clock() -> float:
+    """Injectable-clock seam (the leaderelection discipline): replication
+    timing — grace judgments, lag measurement — reads time only through
+    a clock the tests can step."""
+    return time.monotonic()
+
+
+# ---------------------------------------------------------------- leader
+
+class LeaderLease:
+    """The leader half of the failover contract: hold the writer lease in
+    the leader's OWN store and renew it on a cadence thread — every renew
+    is an ordinary store write, so the lease record REPLICATES and a
+    follower's view of it doubles as the leader heartbeat. The epoch the
+    replication endpoints stamp on every ship is
+    ``lease.leader_transitions + 1`` (first leader: transitions 0 →
+    epoch 1; each failover bumps it — the fence)."""
+
+    role = "leader"
+
+    def __init__(self, store: MemStore, identity: str,
+                 lease_duration_s: float = 5.0,
+                 clock: Callable[[], float] = default_clock) -> None:
+        from ..sched.leaderelection import LeaderElector, StoreLeaseClient
+
+        self.store = store
+        self.identity = identity
+        self.lease_duration_s = lease_duration_s
+        self._elector = LeaderElector(
+            client=StoreLeaseClient(store),
+            identity=identity,
+            name=LEASE_NAME, namespace=LEASE_NAMESPACE,
+            lease_duration_s=lease_duration_s,
+            renew_deadline_s=lease_duration_s * (2.0 / 3.0),
+            retry_period_s=lease_duration_s / 3.0,
+            clock=clock,
+        )
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="kubetpu-writer-lease", daemon=True
+        )
+
+    @property
+    def epoch(self) -> int:
+        return self._elector.observed_epoch() + 1
+
+    @property
+    def leader_url(self) -> str:
+        return self.identity
+
+    def start(self) -> "LeaderLease":
+        self._elector.tick()            # acquire before serving writes
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        period = max(self.lease_duration_s / 3.0, 0.05)
+        while not self._stop.wait(period):
+            try:
+                self._elector.tick()
+            except Exception:  # noqa: BLE001 — renew must never kill serving
+                pass
+
+    def status(self) -> dict:
+        return {
+            "role": self.role,
+            "leader": self.identity,
+            "epoch": self.epoch,
+            "resourceVersion": self.store.resource_version,
+        }
+
+    def metrics_text(self) -> str:
+        return (
+            "# HELP store_replication_epoch The writer-lease fencing "
+            "epoch this process serves under.\n"
+            "# TYPE store_replication_epoch gauge\n"
+            f"store_replication_epoch {self.epoch}\n"
+        )
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=2)
+        try:
+            self._elector.release()
+        except Exception:  # noqa: BLE001 — the store may already be closed
+            pass
+
+
+# -------------------------------------------------------------- follower
+
+class _RepClient:
+    """Minimal raw-bytes HTTP GET client for the replication endpoints
+    (one persistent connection per base; used only from the replicator
+    thread). The negotiated-codec machinery in RemoteStore is for API
+    objects — shipped bytes are opaque WAL frames, decoded by wal.py."""
+
+    def __init__(self, timeout_s: float = 10.0) -> None:
+        self.timeout_s = timeout_s
+        self._conns: dict[str, http.client.HTTPConnection] = {}
+
+    def get(self, base: str, path: str,
+            timeout_s: float | None = None):
+        """→ (status, headers, body bytes); raises ConnectionError-family
+        on transport failure (the caller's liveness signal)."""
+        base = base.rstrip("/")
+        conn = self._conns.get(base)
+        fresh = conn is None
+        if fresh:
+            u = urlsplit(base)
+            conn = http.client.HTTPConnection(
+                u.hostname, u.port, timeout=timeout_s or self.timeout_s
+            )
+            self._conns[base] = conn
+        try:
+            if timeout_s is not None and conn.sock is not None:
+                conn.sock.settimeout(timeout_s)
+            conn.request("GET", path)
+            resp = conn.getresponse()
+            return resp.status, dict(resp.getheaders()), resp.read()
+        except (ConnectionError, TimeoutError, OSError,
+                http.client.HTTPException):
+            self.drop(base)
+            if fresh:
+                raise
+            # keep-alive idle-close race: one retry on a fresh socket
+            # (GETs are idempotent here — the cursor only moves on a
+            # delivered, decoded, applied reply)
+            return self.get(base, path, timeout_s)
+
+    def drop(self, base: str) -> None:
+        conn = self._conns.pop(base.rstrip("/"), None)
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        for base in list(self._conns):
+            self.drop(base)
+
+
+class FollowerReplicator:
+    """The follower half: a daemon thread tailing the leader's log into
+    this process's follower store — bootstrap from a leader snapshot when
+    the cursor predates the leader's ring, long-poll /replication/log
+    otherwise, apply batches through the rv-gated seam, and measure lag.
+    On sustained leader silence, run the election: compare log positions
+    across peers, promote only as the most-caught-up (ties break by
+    replica index), take the writer lease (epoch bump = fence). After
+    promotion the same thread keeps renewing the lease — the object's
+    ``role`` flips to "leader" and the owning apiserver stops
+    redirecting writes."""
+
+    def __init__(self, store: MemStore, leader_url: str,
+                 wire: str = codec.BINARY,
+                 self_url: str = "", peers: tuple = (),
+                 replica_index: int = 0,
+                 poll_timeout_s: float = 2.0,
+                 grace_s: float = 6.0,
+                 lease_duration_s: float = 5.0,
+                 clock: Callable[[], float] = default_clock,
+                 elect: bool = True) -> None:
+        """``peers``: every apiserver URL in the cluster (leader +
+        followers, self included) — the election's electorate. ``elect``
+        False pins this replica as a permanent follower (it re-targets a
+        new leader but never promotes)."""
+        from ..sched.leaderelection import LeaderElector, StoreLeaseClient
+
+        if not store.follower:
+            raise ValueError("FollowerReplicator needs a follower store")
+        self.store = store
+        self.leader_url = leader_url.rstrip("/")
+        self.wire = wire
+        self.self_url = self_url.rstrip("/")
+        self.peers = tuple(p.rstrip("/") for p in peers)
+        self.replica_index = replica_index
+        self.poll_timeout_s = poll_timeout_s
+        self.grace_s = max(grace_s, lease_duration_s)
+        self.lease_duration_s = lease_duration_s
+        self.clock = clock
+        self.elect = elect
+        # the candidate elector observes the REPLICATED writer lease in
+        # this replica's own store: while the leader lives its renewals
+        # replicate and keep the observation fresh; once the record
+        # freezes past the lease duration the elector will usurp — but
+        # the usurp WRITE can only land after promote() (the follower
+        # guard refuses it before), so taking the lease is inseparable
+        # from winning by log position
+        self._elector = LeaderElector(
+            client=StoreLeaseClient(store),
+            identity=self_url or f"replica-{replica_index}",
+            name=LEASE_NAME, namespace=LEASE_NAMESPACE,
+            lease_duration_s=lease_duration_s,
+            renew_deadline_s=lease_duration_s * (2.0 / 3.0),
+            retry_period_s=lease_duration_s / 3.0,
+            clock=clock,
+        )
+        self._client = _RepClient(timeout_s=max(poll_timeout_s * 3, 10.0))
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name="kubetpu-follower-replicator",
+            daemon=True,
+        )
+        self._mu = threading.Lock()
+        # fencing + lag state (guarded: the tail thread writes, the
+        # status endpoint / metrics scrape read)
+        self.observed_epoch = 0
+        self.lag_records = 0
+        self.lag_ms = 0.0
+        self.records_applied = 0
+        self.batches = 0
+        self.resyncs = 0
+        self.stale_refusals = 0
+        self.gap_resyncs = 0
+        self.promotions = 0
+        self._last_contact = clock()
+        self._bootstrapped = False
+
+    # ---------------------------------------------------------- plumbing
+    @property
+    def role(self) -> str:
+        # the store is the source of truth: promote() flips it writable
+        return "follower" if self.store.follower else "leader"
+
+    @property
+    def epoch(self) -> int:
+        if self.role == "leader":
+            return self._elector.observed_epoch() + 1
+        with self._mu:
+            return self.observed_epoch
+
+    def start(self) -> "FollowerReplicator":
+        self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=max(self.poll_timeout_s * 3, 5.0))
+        self._client.close()
+
+    def _note_epoch(self, headers: dict) -> int:
+        """Check + adopt a response's fencing epoch. A ship below the
+        observed epoch is a deposed leader — refuse it loudly."""
+        try:
+            ep = int(headers.get(H_EPOCH, 0))
+        except (TypeError, ValueError):
+            ep = 0
+        with self._mu:
+            if ep < self.observed_epoch:
+                self.stale_refusals += 1
+                raise StaleEpochError(
+                    f"ship from epoch {ep} refused — observed fencing "
+                    f"epoch is {self.observed_epoch} (deposed leader?)"
+                )
+            self.observed_epoch = ep
+        return ep
+
+    # -------------------------------------------------------- tail follow
+    def _bootstrap(self) -> None:
+        """Full resync: load the leader's snapshot wholesale (watchers on
+        this replica take the bounded 410 relist — recovery's contract)."""
+        status, headers, body = self._client.get(
+            self.leader_url, "/replication/snapshot"
+        )
+        if status != 200:
+            raise ReplicationError(
+                f"snapshot bootstrap: HTTP {status} from {self.leader_url}"
+            )
+        self._note_epoch(headers)
+        rv, items = decode_snapshot_stream(
+            body, f"{self.leader_url}/replication/snapshot"
+        )
+        self.store.load_replica_snapshot(items, rv)
+        with self._mu:
+            self.resyncs += 1
+        self._bootstrapped = True
+
+    def _tail_once(self) -> int:
+        """One long-poll round: fetch → fence-check → decode → apply →
+        measure. Returns records applied."""
+        after = self.store.resource_version
+        status, headers, body = self._client.get(
+            self.leader_url,
+            f"/replication/log?after={after}"
+            f"&timeoutSeconds={self.poll_timeout_s}"
+            f"&codec={self.wire}",
+            timeout_s=self.poll_timeout_s + self._client.timeout_s,
+        )
+        t_recv = time.perf_counter()
+        if status == 410:
+            self._bootstrap()
+            self._last_contact = self.clock()
+            return 0
+        if status != 200:
+            raise ReplicationError(
+                f"log tail: HTTP {status} from {self.leader_url}"
+            )
+        self._note_epoch(headers)
+        self._last_contact = self.clock()
+        wire = headers.get(H_CODEC, self.wire)
+        try:
+            cursor = int(headers.get(H_CURSOR, after))
+        except (TypeError, ValueError):
+            cursor = after
+        if not body:
+            with self._mu:
+                self.lag_records = max(0, cursor - after)
+                self.lag_ms = 0.0
+            return 0
+        faultpoints.fire("rep-post-ship-pre-apply")
+        try:
+            applied = self.store.apply_replicated_batch(
+                iter_log_stream(body, wire, f"{self.leader_url}/log")
+            )
+        except ReplicationGapError:
+            # the feed skipped revisions (leader compacted under us mid-
+            # flight): resync from a snapshot, exactly recovery's answer
+            with self._mu:
+                self.gap_resyncs += 1
+            self._bootstrap()
+            return 0
+        with self._mu:
+            self.batches += 1
+            self.records_applied += applied
+            self.lag_records = max(
+                0, cursor - self.store.resource_version
+            )
+            # receipt→applied: how far behind a read served NOW is,
+            # measured on one clock (no cross-process clock needed)
+            self.lag_ms = (time.perf_counter() - t_recv) * 1000.0
+        return applied
+
+    # ----------------------------------------------------------- election
+    def _peer_positions(self) -> dict:
+        """Every reachable peer's /replication/status (self excluded)."""
+        out: dict[str, dict] = {}
+        for url in self.peers:
+            if url and url != self.self_url:
+                try:
+                    status, _h, body = self._client.get(
+                        url, "/replication/status",
+                        timeout_s=max(self.poll_timeout_s, 1.0),
+                    )
+                    if status == 200:
+                        out[url] = codec.loads(body, codec.JSON)
+                except (ConnectionError, TimeoutError, OSError,
+                        http.client.HTTPException,
+                        codec.UnsupportedWireError):
+                    continue
+        return out
+
+    def _try_election(self) -> bool:
+        """The failover decision, by log position: promote only when no
+        live peer claims a fresher epoch, no live peer is ahead of us,
+        and no tied peer outranks us (lower replica index wins). Then
+        the lease: promote() flips the store writable and the elector's
+        usurp CAS takes the writer lease, bumping leader_transitions —
+        the epoch every subsequent ship is fenced by."""
+        if not self.elect:
+            return False
+        my_rv = self.store.resource_version
+        with self._mu:
+            my_epoch = self.observed_epoch
+        peers = self._peer_positions()
+        for url, st in peers.items():
+            ep = int(st.get("epoch", 0))
+            if st.get("role") == "leader" and ep >= my_epoch:
+                # someone already won: follow them
+                self._retarget(url, ep)
+                return False
+            peer_rv = int(st.get("resourceVersion", 0))
+            peer_idx = int(st.get("replicaIndex", 1 << 30))
+            if peer_rv > my_rv:
+                return False            # log position: they win
+            if peer_rv == my_rv and peer_idx < self.replica_index:
+                return False            # tie: lower index wins
+        faultpoints.fire("rep-mid-election")
+        # the lease CAS is the commit point: promote, then take it
+        self.store.promote()
+        deadline = self.clock() + self.lease_duration_s
+        won = False
+        while not won and self.clock() < deadline and not self._stop.is_set():
+            try:
+                won = self._elector.tick()
+            except Exception:  # noqa: BLE001 — lease store hiccup: retry
+                won = False
+            if not won:
+                self._stop.wait(min(self.lease_duration_s / 10.0, 0.2))
+        if not won:
+            # could not take the lease (another candidate raced us there):
+            # step back down — and RESYNC, because any write accepted
+            # during the candidacy window diverges from the real winner's
+            # log at an equal-or-higher rv the rv-gate alone cannot see
+            self.store.demote()
+            try:
+                self._bootstrap()
+            except Exception:  # noqa: BLE001 — the tail loop retries/retargets
+                pass
+            return False
+        with self._mu:
+            self.promotions += 1
+            self.observed_epoch = self._elector.observed_epoch() + 1
+        return True
+
+    def _retarget(self, url: str, epoch: int) -> None:
+        """Follow a new leader (post-failover): adopt its epoch and point
+        the tail at it; the rv-gated apply + snapshot resync make the
+        switch safe wherever our cursor lands."""
+        self._client.drop(self.leader_url)
+        self.leader_url = url
+        with self._mu:
+            self.observed_epoch = max(self.observed_epoch, epoch)
+
+    # --------------------------------------------------------------- loop
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            if self.role == "leader":
+                # post-promotion: this thread becomes the lease renewer
+                try:
+                    self._elector.tick()
+                except Exception:  # noqa: BLE001
+                    pass
+                self._stop.wait(max(self.lease_duration_s / 3.0, 0.05))
+                continue
+            try:
+                # observe the replicated writer lease (read-only while the
+                # leader lives; the usurp write below the follower guard
+                # can only land after promote)
+                try:
+                    self._elector.tick()
+                except Exception:  # noqa: BLE001 — FollowerWriteError et al.
+                    pass
+                self._tail_once()
+            except StaleEpochError:
+                # deposed leader still feeding: find the real one
+                self._try_election()
+            except (ConnectionError, TimeoutError, OSError,
+                    http.client.HTTPException, ReplicationError,
+                    WALError):
+                if self.clock() - self._last_contact > self.grace_s:
+                    if self._try_election():
+                        continue
+                    self._last_contact = self.clock()   # re-arm the grace
+                self._stop.wait(min(self.poll_timeout_s / 4.0, 0.25))
+
+    # ----------------------------------------------------- observability
+    def status(self) -> dict:
+        with self._mu:
+            return {
+                "role": self.role,
+                "leader": (
+                    self.self_url if self.role == "leader"
+                    else self.leader_url
+                ),
+                "epoch": (
+                    self._elector.observed_epoch() + 1
+                    if self.role == "leader" else self.observed_epoch
+                ),
+                "resourceVersion": self.store.resource_version,
+                "replicaIndex": self.replica_index,
+                "lagRecords": self.lag_records,
+                "lagMs": round(self.lag_ms, 3),
+                "recordsApplied": self.records_applied,
+                "resyncs": self.resyncs,
+                "staleRefusals": self.stale_refusals,
+                "promotions": self.promotions,
+            }
+
+    def metrics_text(self) -> str:
+        """The follower's Prometheus set — mounted on the owning
+        apiserver's /metrics; the sentinel's ``replication_lag`` rule
+        watches these series (absent entirely on a non-replicated
+        server, so the rule stays dormant there)."""
+        with self._mu:
+            lines = [
+                "# HELP store_replication_lag_records Records the leader "
+                "has committed that this replica has not applied.\n"
+                "# TYPE store_replication_lag_records gauge\n"
+                f"store_replication_lag_records {self.lag_records}\n"
+                "# HELP store_replication_lag_ms Receipt-to-applied "
+                "latency of the last shipped batch in milliseconds.\n"
+                "# TYPE store_replication_lag_ms gauge\n"
+                f"store_replication_lag_ms {round(self.lag_ms, 3)}\n"
+                "# HELP store_replication_applied_total Shipped records "
+                "applied through the replication seam.\n"
+                "# TYPE store_replication_applied_total counter\n"
+                f"store_replication_applied_total {self.records_applied}\n"
+                "# HELP store_replication_resyncs_total Snapshot "
+                "bootstraps/resyncs this replica has taken.\n"
+                "# TYPE store_replication_resyncs_total counter\n"
+                f"store_replication_resyncs_total {self.resyncs}\n"
+                "# HELP store_replication_stale_refusals_total Ships "
+                "refused for carrying a fenced (stale) epoch.\n"
+                "# TYPE store_replication_stale_refusals_total counter\n"
+                f"store_replication_stale_refusals_total "
+                f"{self.stale_refusals}\n"
+                "# HELP store_replication_epoch The fencing epoch this "
+                "replica last observed (or serves under, once leader).\n"
+                "# TYPE store_replication_epoch gauge\n"
+                f"store_replication_epoch {self.observed_epoch}\n"
+            ]
+        return "".join(lines)

@@ -506,38 +506,109 @@ def _decode_wal_payload(payload: bytes, wire: str, path: str):
         msg["resourceVersion"]
 
 
+def _read_snapshot_stream(f, path: str):
+    """The snapshot format's ONE reader (file or shipped bytes): header +
+    entry frames → (rv, [(kind, key, obj, obj_rv), …]). Raises WALError
+    on anything short, torn, undecodable, or count-mismatched."""
+    wire, fp = _read_header(f, SNAPSHOT_MAGIC, path)
+    _check_fingerprint(wire, fp, path)
+    tail = _read_exact(f, 12)
+    if len(tail) < 12:
+        raise WALError(f"{path}: truncated snapshot header")
+    (rv,) = _u64.unpack(tail[:8])
+    (count,) = _u32.unpack(tail[8:])
+    items = []
+    for entry in _iter_frames(f, path):
+        if entry[0] == "torn":
+            raise WALError(f"{path}: torn snapshot entry")
+        _off, payload = entry
+        kind_len = payload[0]
+        kind = payload[1: 1 + kind_len].decode()
+        (obj_rv,) = _u64.unpack(payload[1 + kind_len: 9 + kind_len])
+        body = payload[9 + kind_len:]
+        try:
+            msg = codec.loads(body, wire)
+        except codec.UnsupportedWireError as e:
+            raise WALError(f"{path}: undecodable snapshot entry: {e}") \
+                from None
+        items.append((kind, msg["key"],
+                      codec.as_object(msg.get("object")), obj_rv))
+    if len(items) != count:
+        raise WALError(
+            f"{path}: snapshot carries {len(items)} entries, "
+            f"header promised {count}"
+        )
+    return rv, items
+
+
 def load_snapshot_items(path: str):
     """→ (rv, [(kind, key, obj, obj_rv), …]) or raises WALError."""
     with open(path, "rb") as f:
-        wire, fp = _read_header(f, SNAPSHOT_MAGIC, path)
-        _check_fingerprint(wire, fp, path)
-        tail = _read_exact(f, 12)
-        if len(tail) < 12:
-            raise WALError(f"{path}: truncated snapshot header")
-        (rv,) = _u64.unpack(tail[:8])
-        (count,) = _u32.unpack(tail[8:])
-        items = []
-        for entry in _iter_frames(f, path):
-            if entry[0] == "torn":
-                raise WALError(f"{path}: torn snapshot entry")
-            _off, payload = entry
-            kind_len = payload[0]
-            kind = payload[1: 1 + kind_len].decode()
-            (obj_rv,) = _u64.unpack(payload[1 + kind_len: 9 + kind_len])
-            body = payload[9 + kind_len:]
-            try:
-                msg = codec.loads(body, wire)
-            except codec.UnsupportedWireError as e:
-                raise WALError(f"{path}: undecodable snapshot entry: {e}") \
-                    from None
-            items.append((kind, msg["key"],
-                          codec.as_object(msg.get("object")), obj_rv))
-        if len(items) != count:
-            raise WALError(
-                f"{path}: snapshot carries {len(items)} entries, "
-                f"header promised {count}"
-            )
-    return rv, items
+        return _read_snapshot_stream(f, path)
+
+
+# ------------------------------------------------- replication streaming
+# The log-shipping wire (kubetpu.store.replication) IS the WAL format:
+# shipped records are the exact frames `append` writes, the bootstrap
+# snapshot is the exact byte layout `snapshot` writes — one copy of the
+# format rules, so a drifted build refuses a ship the same way it refuses
+# a foreign persistence dir (the fingerprint check above).
+
+def frame_record(kind: str, body: bytes) -> bytes:
+    """Frame ONE record from a kind + an event wire body
+    (``codec.event_wire_bytes`` — what the store's body ring caches) —
+    byte-identical to what ``WriteAheadLog.append`` writes."""
+    kind_b = kind.encode()
+    if len(kind_b) > 255:
+        raise WALError(f"kind too long for the WAL frame: {kind!r}")
+    return _frame(bytes((len(kind_b),)) + kind_b + body)
+
+
+def iter_log_stream(data: bytes, wire: str,
+                    source: str = "<replication>"):
+    """Decode a shipped run of record frames (a /replication/log body):
+    yields (ev_type_id, kind, key, obj, rv) in order. A torn frame is a
+    loud WALError — HTTP delivers the body whole or not at all, so unlike
+    a crashed segment there is no truncatable-tail policy here."""
+    import io
+
+    for entry in _iter_frames(io.BytesIO(data), source):
+        if entry[0] == "torn":
+            raise WALError(f"{source}: torn replication frame")
+        yield _decode_wal_payload(entry[1], wire, source)
+
+
+def encode_snapshot_stream(items, rv: int, wire: str = codec.BINARY) -> bytes:
+    """A full object map in the WAL snapshot format, as bytes — the
+    leader's /replication/snapshot body (follower bootstrap). ``items``
+    is a core dump: (kind, key, obj, obj_rv) in insertion order."""
+    import io
+
+    encoder = codec.event_body_encoder(wire)
+    fp = (
+        codec.schema_fingerprint() if wire == codec.BINARY else ""
+    ).encode()
+    f = io.BytesIO()
+    f.write(
+        SNAPSHOT_MAGIC + bytes((FORMAT_VERSION, _codec_id(wire), len(fp)))
+        + fp + _u64.pack(rv) + _u32.pack(len(items))
+    )
+    for kind, key, obj, obj_rv in items:
+        kind_b = kind.encode()
+        body = encoder(0, key, obj, obj_rv)
+        f.write(_frame(
+            bytes((len(kind_b),)) + kind_b + _u64.pack(obj_rv) + body
+        ))
+    return f.getvalue()
+
+
+def decode_snapshot_stream(data: bytes,
+                           source: str = "<replication>"):
+    """→ (rv, items) from an ``encode_snapshot_stream`` body — the same
+    walk (and the same fingerprint refusal) as ``load_snapshot_items``."""
+    import io
+
+    return _read_snapshot_stream(io.BytesIO(data), source)
 
 
 def iter_segment(path: str):

@@ -29,6 +29,11 @@ Four rule kinds:
   budget (cycle wall): each evaluation contributes the interval's mean;
   an observation is anomalous when it sits more than ``mad_k`` robust
   standard deviations (1.4826·MAD) above the EWMA baseline.
+- ``level``      a gauge's CURRENT value vs. a trip point (replication
+  lag): no windowing — the series is already a level, not a rate;
+  ``for_intervals`` is the anti-flap. A process that never emits the
+  series (an unreplicated apiserver, the leader) leaves the rule
+  dormant.
 """
 
 from __future__ import annotations
@@ -40,6 +45,7 @@ BURN_RATE = "burn_rate"
 RATIO = "ratio"
 DELTA = "delta"
 OUTLIER = "outlier"
+LEVEL = "level"
 
 #: alert severities
 WARNING = "warning"
@@ -174,6 +180,22 @@ DEFAULT_RULES: tuple[Rule, ...] = (
         for_intervals=2,
         resolve_intervals=3,
         capture_bundle=False,     # cache stats ride every OTHER bundle
+    ),
+    Rule(
+        name="replication-lag",
+        kind=LEVEL,
+        series="store_replication_lag_records",
+        severity=WARNING,
+        description="this follower's replication apply position is "
+                    "trailing the leader's ship cursor by more than 500 "
+                    "records — the read plane is serving stale state "
+                    "(dormant on unreplicated/leader apiservers: the "
+                    "series is absent there)",
+        threshold=500.0,
+        direction="above",
+        for_intervals=2,
+        resolve_intervals=3,
+        capture_bundle=False,     # the evidence IS the replication status
     ),
     Rule(
         name="collector-span-drops",

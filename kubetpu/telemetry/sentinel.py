@@ -53,6 +53,7 @@ from ..metrics.textparse import ParseError, parse_prometheus_text
 from .rules import (
     BURN_RATE,
     DELTA,
+    LEVEL,
     OUTLIER,
     RATIO,
     Rule,
@@ -151,6 +152,76 @@ def _py_stacks(max_frames: int = STACK_FRAMES) -> dict[str, list[str]]:
     return out
 
 
+class AlertSink:
+    """Out-of-process alert delivery — one record per lifecycle
+    TRANSITION (fired / resolved), never per evaluation pass. Specs:
+
+    - ``file:PATH``   — append-only ndjson, one line per transition
+      (tail -f it, or point a log shipper at it);
+    - ``webhook:URL`` — one POST per transition, JSON body.
+
+    Best-effort by contract: a full disk or a dead webhook endpoint
+    bumps ``errors`` and the lifecycle proceeds — delivery failure must
+    never take the sentinel (or its owner) down with it."""
+
+    def __init__(self, spec: str, timeout_s: float = 5.0) -> None:
+        scheme, sep, target = spec.partition(":")
+        if not sep or scheme not in ("file", "webhook") or not target:
+            raise ValueError(
+                f"alert sink spec {spec!r}: expected file:PATH or "
+                f"webhook:URL"
+            )
+        self.spec = spec
+        self.scheme = scheme
+        self.target = target
+        self.timeout_s = timeout_s
+        self.delivered = 0
+        self.errors = 0
+        self._lock = threading.Lock()
+
+    def deliver(self, transition: str, alert: dict,
+                process: str = "") -> bool:
+        record = {
+            "transition": transition,
+            "ts_wall": time.time(),
+            "process": process,
+            "alert": alert,
+        }
+        try:
+            if self.scheme == "file":
+                line = json.dumps(record, default=str) + "\n"
+                with self._lock:
+                    with open(self.target, "a", encoding="utf-8") as f:
+                        f.write(line)
+            else:
+                import urllib.request
+
+                req = urllib.request.Request(
+                    self.target,
+                    data=json.dumps(record, default=str).encode(),
+                    headers={"Content-Type": "application/json"},
+                )
+                with urllib.request.urlopen(
+                    req, timeout=self.timeout_s
+                ) as resp:
+                    resp.read()
+        except Exception:  # noqa: BLE001 — failure-counted, never fatal
+            with self._lock:
+                self.errors += 1
+            return False
+        with self._lock:
+            self.delivered += 1
+        return True
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "spec": self.spec,
+                "delivered": self.delivered,
+                "errors": self.errors,
+            }
+
+
 class Sentinel:
     """See module docstring. Thread-safe: the evaluation driver (owner
     loop or cadence thread), diagnostics readers and the exporter share
@@ -170,6 +241,7 @@ class Sentinel:
         bundle_sources: "dict[str, Callable[[], Any]] | None" = None,
         max_bundles: int = 8,
         trace_window_s: float = 30.0,
+        sink: "AlertSink | str | None" = None,
     ) -> None:
         self.metrics_fn = metrics_fn
         self.rules: tuple[Rule, ...] = (
@@ -186,6 +258,9 @@ class Sentinel:
             bundle_sources or {}
         )
         self.trace_window_s = trace_window_s
+        self.sink: "AlertSink | None" = (
+            AlertSink(sink) if isinstance(sink, str) else sink
+        )
         self._lock = threading.Lock()
         # rule.name -> deque[(t_mono, extract tuple)] of cumulative counts
         self._history: dict[str, deque] = {}
@@ -265,7 +340,7 @@ class Sentinel:
                         den += s.value
                         seen = True
             return (num, den) if seen else None
-        if rule.kind == DELTA:
+        if rule.kind in (DELTA, LEVEL):
             total = 0.0
             seen = False
             for s in parsed.samples(rule.series):
@@ -355,6 +430,14 @@ class Sentinel:
             if rule is not None and rule.capture_bundle:
                 bundle = self.capture_bundle(trigger=al)
                 al.bundle_id = bundle["id"]
+        # sink delivery also outside the lock (a webhook may block for
+        # timeout_s) and AFTER bundle capture so the record carries the
+        # bundle_id an operator would fetch next
+        if self.sink is not None:
+            for al in fired:
+                self.sink.deliver("fired", al.to_json(), self.process)
+            for al in resolved:
+                self.sink.deliver("resolved", al.to_json(), self.process)
         with self._lock:
             self.eval_wall_s += time.perf_counter() - t0
         return {
@@ -381,6 +464,9 @@ class Sentinel:
         horizon = max(rule.long_window_s, rule.window_s) + self.interval_s
         while ring and now - ring[0][0] > horizon and len(ring) > 1:
             ring.popleft()
+        if rule.kind == LEVEL:
+            # a gauge IS its judgment — no window, the first scrape counts
+            return self._eval_level(rule, ring)
         if len(ring) <= 1:
             return None
         if rule.kind == BURN_RATE:
@@ -473,6 +559,18 @@ class Sentinel:
             f"({rule.direction} {rule.threshold:g})"
         )
         return breached, round(d, 4), reason
+
+    def _eval_level(self, rule: Rule, ring):
+        value = ring[-1][1][0]
+        if rule.direction == "below":
+            breached = value < rule.threshold
+        else:
+            breached = value > rule.threshold
+        reason = (
+            f"{rule.series} at {value:g} ({rule.direction} "
+            f"trip {rule.threshold:g})"
+        )
+        return breached, round(value, 4), reason
 
     def _eval_outlier(self, rule: Rule, ring):
         end, prev = ring[-1], ring[-2]
@@ -667,7 +765,7 @@ class Sentinel:
         """The bench/runner view (WorkloadResult.sentinel)."""
         with self._lock:
             alerts = list(self._alerts.values())
-            return {
+            out = {
                 "evaluations": self.evaluations,
                 "eval_errors": self.eval_errors,
                 "eval_wall_s": round(self.eval_wall_s, 6),
@@ -678,6 +776,9 @@ class Sentinel:
                 "bundles": self.bundles_total,
                 "interval_s": self.interval_s,
             }
+        if self.sink is not None:
+            out["sink"] = self.sink.stats()
+        return out
 
     def metrics_text(self) -> str:
         """The sentinel's own counters, mounted on the owner's /metrics
@@ -714,6 +815,16 @@ class Sentinel:
         )
         for state in (PENDING, FIRING, RESOLVED):
             g.labels(state).set(sum(a.state == state for a in alerts))
+        if self.sink is not None:
+            st = self.sink.stats()
+            r.counter(
+                "kubetpu_sentinel_sink_delivered_total",
+                "Alert transitions delivered to the out-of-process sink.",
+            ).inc(st["delivered"])
+            r.counter(
+                "kubetpu_sentinel_sink_errors_total",
+                "Alert-sink delivery failures (counted, never fatal).",
+            ).inc(st["errors"])
         return r.expose()
 
     # ---------------------------------------------------------------- cadence

@@ -1,0 +1,57 @@
+"""Known-bad fixture for RP001: follower-store writes outside the
+replication-apply seam. Every marked line must be flagged."""
+
+
+class LeakyStore:
+    """A store wrapper that grows flag writes outside the seam."""
+
+    def __init__(self):
+        self._applying = False      # blessed: the declaration
+        self._follower = True       # blessed: the declaration
+
+    def _apply_replicated_locked(self, rec):
+        self._applying = True       # blessed: the seam itself
+        try:
+            self._commit_locked(rec)
+        finally:
+            self._applying = False  # blessed: the seam itself
+
+    def _commit_locked(self, rec):
+        pass
+
+    def force_local_commit(self, rec):
+        # a "helper" smuggling a local write past the follower guard
+        self._applying = True       # expect: RP001
+        try:
+            self._commit_locked(rec)
+        finally:
+            self._applying = False  # expect: RP001
+
+    def promote(self):
+        self._follower = False      # blessed: the election seam
+
+    def demote(self):
+        self._follower = True       # blessed: the election seam
+
+    def hotfix_role(self):
+        self._follower = False      # expect: RP001
+
+
+class SneakyReplicator:
+    """A replicator that mutates its store instead of replaying."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def patch_object(self, kind, ns, name, obj, rv):
+        # "fast path" around the apply seam: a bare local write
+        self.store.update(kind, ns, name, obj, rv)  # expect: RP001
+
+    def drop_object(self, kind, ns, name):
+        st = self.store
+        st.delete(kind, ns, name)                   # expect: RP001
+
+    def seed_object(self, kind, ns, name, obj):
+        def _inner():
+            self.store.create(kind, ns, name, obj)  # expect: RP001
+        _inner()

@@ -1,0 +1,66 @@
+"""Known-good fixture for RP001: seam-respecting store and replicator
+shapes that must stay silent."""
+
+
+class SeamedStore:
+    """Flag and role writes only where the seam allows them."""
+
+    def __init__(self):
+        self._applying = False
+        self._follower = True
+
+    def _apply_replicated_locked(self, rec):
+        self._applying = True
+        try:
+            self._commit_locked(rec)
+        finally:
+            self._applying = False
+
+    def _commit_locked(self, rec):
+        pass
+
+    def promote(self):
+        self._follower = False
+
+    def demote(self):
+        self._follower = True
+
+    def role(self):
+        # READS of the flags are fine anywhere
+        return "follower" if self._follower else "leader"
+
+    def guard(self):
+        if self._applying:
+            return
+        raise RuntimeError("follower store is read-only")
+
+
+class PoliteReplicator:
+    """Replays through the seam; never mutates the store directly."""
+
+    def __init__(self, store, leader):
+        self.store = store
+        self.leader = leader
+
+    def tail_once(self, records):
+        # the ONLY write path: the rv-gated apply seam
+        self.store.apply_replicated_batch(records)
+
+    def bootstrap(self, snapshot):
+        self.store.load_replica_snapshot(snapshot)
+
+    def win_election(self):
+        self.store.promote()
+
+    def lose_election(self):
+        self.store.demote()
+
+    def status(self):
+        # reads on a store reference are fine
+        return self.store.resource_version()
+
+    def update_peers(self, peers):
+        # mutation verbs on NON-store receivers are out of scope
+        self.peers = tuple(peers)
+        registry = {}
+        registry.update({"peers": self.peers})

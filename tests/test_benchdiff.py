@@ -259,6 +259,92 @@ def test_durability_records_pass_against_themselves(tmp_path):
     assert not any(d.regression for d in deltas)
 
 
+def test_failover_to_serving_regression_gates(tmp_path, capsys):
+    def rec(v):
+        return {"metric": "ReplicatedFailover_5000Nodes_50000Pods_3api",
+                "unit": "s", "value": v, "failover_to_serving_s": v,
+                "parity_ok": True}
+
+    old = _write(tmp_path, "old.json", [rec(1.2)])
+    ok = _write(tmp_path, "ok.json", [rec(2.9)])    # +142% but under 2s floor
+    bad = _write(tmp_path, "bad.json", [rec(6.0)])  # +400% and +4.8s
+    assert main([old, ok]) == 0
+    capsys.readouterr()
+    rc = main([old, bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "failover_to_serving_s" in out and "REGRESSION" in out
+
+
+def test_follower_lag_regression_gates(tmp_path, capsys):
+    def rec(lag):
+        return {"metric": "ReadScaling_mp_4api", "unit": "ratio",
+                "value": 1.4, "throughput_speedup": 1.4,
+                "follower_lag_ms": lag, "apiservers": 4}
+
+    old = _write(tmp_path, "old.json", [rec(120.0)])
+    ok = _write(tmp_path, "ok.json", [rec(230.0)])   # +92%, +110ms < floor
+    bad = _write(tmp_path, "bad.json", [rec(900.0)])  # +650% and +780ms
+    assert main([old, ok]) == 0
+    capsys.readouterr()
+    rc = main([old, bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "follower_lag_ms" in out and "REGRESSION" in out
+
+
+def test_failover_vs_cold_verdict_drop_gates(tmp_path, capsys):
+    def rec(v):
+        return {"metric": "FailoverVsColdRecovery_5000Nodes_50000Pods",
+                "unit": "verdict", "value": v,
+                "failover_to_serving_s": 1.2 if v else 9.0,
+                "cold_recovery_s": 4.0}
+
+    old = _write(tmp_path, "old.json", [rec(1.0)])
+    bad = _write(tmp_path, "bad.json", [rec(0.0)])
+    assert main([old, old]) == 0
+    capsys.readouterr()
+    rc = main([old, bad])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "verdict" in out and "REGRESSION" in out
+
+
+def test_read_plane_records_pass_against_themselves(tmp_path):
+    """Self-diff pinned green: the replicated read plane's records —
+    per-N ladder rows, ReadScaling_mp_* speedup lines with follower lag,
+    the failover wall, and the hot-vs-cold verdict — gate their new
+    fields without ever tripping on an identical record."""
+    lines = [
+        _line("SchedulingBasic_5000Nodes_1000Pods_greedy_mp_2api_"
+              "200watchers",
+              550.0, apiservers=2, follower_lag_ms=85.0,
+              follower_lag_records=310, watch_fanout=200,
+              binding_parity=1000, n_processes=8),
+        {"metric": "ReadScaling_mp_2api", "unit": "ratio", "value": 1.25,
+         "throughput_speedup": 1.25, "apiservers": 2,
+         "follower_lag_ms": 85.0, "follower_lag_records": 310,
+         "binding_parity": 1000},
+        {"metric": "ReplicatedFailover_5000Nodes_50000Pods_3api",
+         "unit": "s", "value": 1.4, "failover_to_serving_s": 1.4,
+         "follower_lag_ms": 140.0, "binding_parity": 25000,
+         "parity_ok": True, "epoch": 2},
+        {"metric": "FailoverVsColdRecovery_5000Nodes_50000Pods",
+         "unit": "verdict", "value": 1.0, "failover_to_serving_s": 1.4,
+         "cold_recovery_s": 4.1, "speedup_vs_cold": 2.93},
+    ]
+    rec = _write(tmp_path, "readplane.json", lines)
+    assert main([rec, rec]) == 0
+    deltas, _old, _new = compare(load_record(rec), load_record(rec))
+    fields = {(d.metric, d.field) for d in deltas}
+    assert ("ReplicatedFailover_5000Nodes_50000Pods_3api",
+            "failover_to_serving_s") in fields
+    assert ("ReadScaling_mp_2api", "follower_lag_ms") in fields
+    assert ("FailoverVsColdRecovery_5000Nodes_50000Pods",
+            "verdict") in fields
+    assert not any(d.regression for d in deltas)
+
+
 def _trace_line(p99=900.0, budget=3000.0, rss=300 * 1024**2, **extra):
     out = {
         "metric": "Trace_node-wave-5k_5000Nodes_greedy", "unit": "pods/s",

@@ -1,0 +1,620 @@
+"""Replicated read plane: WAL log-shipping to follower apiservers and
+failover by log position (ISSUE 17).
+
+The in-process tests build a real leader APIServer + follower APIServers
+wired through ``LeaderLease``/``FollowerReplicator`` (short lease/poll
+timings — no mocks, the actual HTTP ship path), then:
+
+- shipped writes land on every follower with full rv continuity and
+  serve reads/lists/watches there;
+- a write at a follower 307-redirects to the leader (RemoteStore follows
+  it transparently) and replicates back;
+- a cursor that predates the leader's ring bootstraps from a snapshot
+  (the bounded 410-relist contract, exactly recovery's);
+- the replication apply seam is rv-gated: a re-shipped batch applies
+  zero records and moves nothing;
+- a ship from a fenced (deposed) epoch is refused loudly;
+- kill-the-leader at each ``rep-*`` fault point (kubetpu.store
+  .faultpoints): mid-ship the most-caught-up follower wins by log
+  position and acked-and-shipped writes survive exactly once;
+  post-ship-pre-apply a restarted replicator re-fetches and the rv gate
+  applies the batch exactly once; mid-election the next round converges
+  on ONE leader with the fenced epoch — never two;
+- a watcher on the surviving follower rides the failover with at most
+  one bounded relist;
+- ``--apiservers 1`` (no replication bound) keeps PR-16 behavior
+  byte-identical: no /replication/* endpoints, no redirect, no
+  replication metrics, no new argv flags in the child spec.
+
+The launch-level test boots a REAL 3-apiserver cluster (leader +2
+followers as supervised processes) over a persistent leader WAL, binds
+pods through it, reads them back from a follower, and proves the SIGTERM
+cascade leaves a clean WAL (``store fsck`` exit 0).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+pytest.importorskip("jax")
+
+from kubetpu.api.wrappers import make_node, make_pod
+from kubetpu.apiserver import APIServer, RemoteStore
+from kubetpu.client.informers import NODES, PODS
+from kubetpu.store import faultpoints as fp
+from kubetpu.store.memstore import (
+    CompactedError,
+    FollowerWriteError,
+    MemStore,
+)
+from kubetpu.store.replication import (
+    H_EPOCH,
+    FollowerReplicator,
+    LeaderLease,
+    StaleEpochError,
+    build_log_body,
+)
+from kubetpu.store.wal import iter_log_stream
+
+# short but real timings: leader renews at lease/3, followers long-poll
+# at POLL and judge leader death after GRACE of silence
+LEASE = 0.5
+POLL = 0.2
+GRACE = 0.6
+
+
+@pytest.fixture(autouse=True)
+def _quiet_faultpoints():
+    """Reset the fault harness around every test, and keep a simulated
+    CrashPoint death of a replicator thread from spraying the captured
+    stderr (a real kill would not traceback either)."""
+    fp.reset()
+    prev_hook = threading.excepthook
+
+    def hook(args):
+        if not isinstance(args.exc_value, fp.CrashPoint):
+            prev_hook(args)
+
+    threading.excepthook = hook
+    yield
+    threading.excepthook = prev_hook
+    fp.reset()
+
+
+def wait_until(pred, timeout_s: float = 20.0, interval_s: float = 0.02):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval_s)
+    return False
+
+
+def rep_status(url: str) -> dict:
+    with urllib.request.urlopen(f"{url}/replication/status", timeout=5) as r:
+        return json.loads(r.read())
+
+
+def spin(n_followers: int = 1, elect: bool = True, history: int = 8192):
+    """A live leader + N follower apiservers on loopback, fully wired
+    (peers electorate included) → (leader, [followers])."""
+    leader_store = MemStore(history=history)
+    leader = APIServer(leader_store)
+    leader.attach_replication(
+        LeaderLease(leader_store, leader.url, lease_duration_s=LEASE)
+    )
+    leader.start()
+    followers = [
+        APIServer(MemStore(follower=True)) for _ in range(n_followers)
+    ]
+    peers = (leader.url, *[f.url for f in followers])
+    for i, f in enumerate(followers, start=1):
+        f.attach_replication(FollowerReplicator(
+            f.store, leader.url, self_url=f.url, peers=peers,
+            replica_index=i, poll_timeout_s=POLL, grace_s=GRACE,
+            lease_duration_s=LEASE, elect=elect,
+        ))
+        f.start()
+    return leader, followers
+
+
+def teardown(*servers):
+    for s in servers:
+        try:
+            s.close()
+        except Exception:  # noqa: BLE001 — hard-killed servers double-close
+            pass
+
+
+def hard_kill(server: APIServer) -> None:
+    """Simulate SIGKILL: stop the renew/tail thread WITHOUT releasing the
+    writer lease, then tear the listener down and half-close every live
+    connection — followers see silence (and dead sockets), never a
+    graceful handover."""
+    rep = server.replication
+    if rep is not None:
+        rep._stop.set()
+        if rep._thread.is_alive():
+            rep._thread.join(timeout=2)
+    server._httpd.closing = True
+    server._httpd.shutdown()
+    server._httpd.server_close()
+    server._httpd.sever()
+    server._thread.join(timeout=5)
+
+
+def promoted(*followers: APIServer):
+    """The follower that completed promotion (promote + writer-lease CAS
+    won — ``promotions`` increments only then), or None. Waiting on the
+    ``role`` property alone races the window between ``promote()`` and
+    the CAS, where the store is writable but the epoch not yet fenced."""
+    for f in followers:
+        if f.replication.promotions > 0:
+            return f
+    return None
+
+
+def synced(leader: APIServer, follower: APIServer) -> bool:
+    return (
+        follower.store.resource_version == leader.store.resource_version
+    )
+
+
+def store_keys(server: APIServer, kind: str) -> list:
+    return sorted(k for (knd, k, _o, _rv) in server.store.dump()
+                  if knd == kind)
+
+
+def pods_dump(server: APIServer) -> list:
+    """(key, rv) of every pod — the exactly-once probe: a double-applied
+    ship would shift a pod's rv, a lost one would drop the key. (Raw
+    store-rv comparisons don't work across a failover: the new leader's
+    own writer-lease writes keep bumping its revision.)"""
+    return sorted(
+        (k, rv) for (knd, k, _o, rv) in server.store.dump() if knd == PODS
+    )
+
+
+# ----------------------------------------------------------- log shipping
+
+def test_log_shipping_replicates_writes_with_rv_continuity():
+    leader, (f1,) = spin(n_followers=1, elect=False)
+    try:
+        admin = RemoteStore(leader.url)
+        for i in range(20):
+            admin.create(PODS, f"ns/p{i}", make_pod(f"p{i}", namespace="ns"))
+        assert wait_until(lambda: synced(leader, f1))
+        # byte-for-byte store parity, rv included
+        assert f1.store.dump() == leader.store.dump()
+        st = rep_status(f1.url)
+        assert st["role"] == "follower" and st["epoch"] == 1
+        assert st["leader"] == leader.url
+        assert rep_status(leader.url)["role"] == "leader"
+        # reads served AT the follower: list + get + the lag gauges
+        ro = RemoteStore(f1.url)
+        items, rv = ro.list(PODS)
+        assert len(items) == 20 and rv == leader.store.resource_version
+        obj, _rv = ro.get(PODS, "ns/p7")
+        assert obj.name == "p7"
+        assert wait_until(lambda: rep_status(f1.url)["lagRecords"] == 0)
+        assert "store_replication_lag_records" in f1.metrics_text()
+    finally:
+        teardown(leader, f1)
+
+
+def test_follower_write_redirects_to_leader_and_replicates_back():
+    leader, (f1,) = spin(n_followers=1, elect=False)
+    try:
+        # the raw protocol: a follower write answers 307 + the leader URL
+        req = urllib.request.Request(
+            f"{f1.url}/apis/{NODES}/n0", method="DELETE"
+        )
+
+        class NoRedirect(urllib.request.HTTPRedirectHandler):
+            def redirect_request(self, *a, **kw):
+                return None
+
+        opener = urllib.request.build_opener(NoRedirect)
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            opener.open(req, timeout=5)
+        assert ei.value.code == 307
+        assert ei.value.headers["Location"].startswith(leader.url)
+
+        # RemoteStore follows the redirect transparently: the write lands
+        # on the leader and ships back to the follower we wrote "at"
+        rw = RemoteStore(f1.url)
+        rw.create(NODES, "n1", make_node("n1"))
+        assert leader.store.get(NODES, "n1")[0] is not None
+        assert wait_until(lambda: synced(leader, f1))
+        assert store_keys(f1, NODES) == ["n1"]
+
+        # a DIRECT local write on the follower store is refused loudly
+        with pytest.raises(FollowerWriteError):
+            f1.store.create(NODES, "n2", make_node("n2"))
+    finally:
+        teardown(leader, f1)
+
+
+def test_follower_watch_serves_events_with_leader_rvs():
+    leader, (f1,) = spin(n_followers=1, elect=False)
+    try:
+        admin = RemoteStore(leader.url)
+        watcher = RemoteStore(f1.url).watch(PODS, 0)
+        rvs = []
+        for i in range(8):
+            rvs.append(
+                admin.create(PODS, f"ns/w{i}", make_pod(f"w{i}",
+                                                        namespace="ns"))
+            )
+        got = []
+
+        def drain():
+            got.extend(watcher.poll())
+            return len(got) >= 8
+
+        assert wait_until(drain)
+        # the follower's watch carries the LEADER's resourceVersions —
+        # replication preserved rv continuity, not just object bytes
+        assert [e.resource_version for e in got] == rvs
+        assert [e.key for e in got] == [f"ns/w{i}" for i in range(8)]
+    finally:
+        teardown(leader, f1)
+
+
+def test_stale_cursor_bootstraps_from_snapshot():
+    # a tiny event ring, filled BEFORE the follower exists: its cursor
+    # (rv 0) predates the ring, /replication/log answers 410, and the
+    # follower loads the leader's snapshot wholesale instead
+    leader_store = MemStore(history=16)
+    leader = APIServer(leader_store)
+    leader.attach_replication(
+        LeaderLease(leader_store, leader.url, lease_duration_s=LEASE)
+    )
+    leader.start()
+    admin = RemoteStore(leader.url)
+    for i in range(80):
+        admin.create(PODS, f"ns/s{i}", make_pod(f"s{i}", namespace="ns"))
+    f1 = APIServer(MemStore(follower=True))
+    f1.attach_replication(FollowerReplicator(
+        f1.store, leader.url, self_url=f1.url, peers=(leader.url, f1.url),
+        replica_index=1, poll_timeout_s=POLL, grace_s=GRACE,
+        lease_duration_s=LEASE, elect=False,
+    ))
+    f1.start()
+    try:
+        assert wait_until(lambda: synced(leader, f1))
+        assert f1.store.dump() == leader.store.dump()
+        assert rep_status(f1.url)["resyncs"] >= 1
+    finally:
+        teardown(leader, f1)
+
+
+def test_replication_apply_is_rv_gated_and_idempotent():
+    store = MemStore()
+    store.create(NODES, "n0", make_node("n0"))
+    store.create(PODS, "ns/p0", make_pod("p0", namespace="ns"))
+    body, cursor, n = build_log_body(store, 0)
+    assert n == 2 and cursor == store.resource_version
+
+    replica = MemStore(follower=True)
+    first = replica.apply_replicated_batch(
+        iter_log_stream(body, "binary", "<test>")
+    )
+    assert first == 2 and replica.resource_version == cursor
+    # the same ship again (a re-fetch after a crash): the rv gate skips
+    # every record — nothing applies, nothing moves
+    again = replica.apply_replicated_batch(
+        iter_log_stream(body, "binary", "<test>")
+    )
+    assert again == 0 and replica.resource_version == cursor
+    assert replica.dump() == store.dump()
+    store.close()
+
+
+def test_stale_epoch_ship_refused_loudly():
+    replica = MemStore(follower=True)
+    rep = FollowerReplicator(
+        replica, "http://127.0.0.1:1", peers=(), elect=False,
+    )
+    rep._note_epoch({H_EPOCH: "3"})
+    assert rep.epoch == 3
+    with pytest.raises(StaleEpochError):
+        rep._note_epoch({H_EPOCH: "2"})     # a deposed leader still feeding
+    st = rep.status()
+    assert st["staleRefusals"] == 1 and st["epoch"] == 3
+    assert "store_replication_stale_refusals_total 1" in rep.metrics_text()
+
+
+# ------------------------------------------------------------- failover
+
+def test_failover_elects_by_log_position_and_fences_the_epoch():
+    leader, (f1, f2) = spin(n_followers=2, elect=True)
+    try:
+        admin = RemoteStore(leader.url)
+        for i in range(10):
+            admin.create(PODS, f"ns/a{i}", make_pod(f"a{i}", namespace="ns"))
+        assert wait_until(lambda: synced(leader, f1) and synced(leader, f2))
+        acked = store_keys(leader, PODS)
+
+        # a watcher on f2 rides the failover below: it must need at most
+        # ONE bounded relist (410), never a wedge
+        watcher = RemoteStore(f2.url).watch(PODS, f2.store.resource_version)
+        relists = 0
+
+        hard_kill(leader)
+        assert wait_until(
+            lambda: promoted(f1, f2) is not None
+        ), "no follower promoted after leader death"
+        winner = promoted(f1, f2)
+        other = f2 if winner is f1 else f1
+        # both replicas were tied on log position — the lower replica
+        # index wins the tie
+        assert winner is f1
+        st = rep_status(winner.url)
+        assert st["role"] == "leader" and st["epoch"] == 2
+        # every write the dead leader acked AND shipped survives, exactly
+        # once, at the same rv — promotion replayed nothing twice
+        assert store_keys(winner, PODS) == acked
+
+        # the surviving follower retargets the new leader and writes flow
+        # again (307 from the follower now names the NEW leader)
+        rw = RemoteStore(other.url)
+        assert wait_until(lambda: other.replication.leader_url == winner.url)
+        rw.create(PODS, "ns/post", make_pod("post", namespace="ns"))
+        assert wait_until(
+            lambda: store_keys(other, PODS) == sorted(acked + ["ns/post"])
+        )
+
+        # drain the watcher across the failover: at most one relist
+        seen = set()
+        deadline = time.monotonic() + 20
+        while time.monotonic() < deadline:
+            try:
+                for e in watcher.poll():
+                    seen.add(e.key)
+            except CompactedError:
+                relists += 1
+                items, _rv = RemoteStore(f2.url).list(PODS)
+                seen.update(k for k, _o in items)
+                watcher = RemoteStore(f2.url).watch(
+                    PODS, f2.store.resource_version
+                )
+            if "ns/post" in seen:
+                break
+            time.sleep(0.05)
+        assert "ns/post" in seen
+        assert relists <= 1, f"watcher relisted {relists} times"
+    finally:
+        teardown(leader, f1, f2)
+
+
+def test_kill_leader_mid_ship_most_caught_up_follower_wins():
+    leader, (f1, f2) = spin(n_followers=2, elect=True)
+    try:
+        admin = RemoteStore(leader.url)
+        for i in range(30):
+            admin.create(PODS, f"ns/b{i}", make_pod(f"b{i}", namespace="ns"))
+        assert wait_until(lambda: synced(leader, f1) and synced(leader, f2))
+
+        # the leader will die assembling exactly one ship: whichever
+        # follower's poll traverses the point sees a torn connection; the
+        # OTHER follower's poll (the point is one-shot) gets the batch
+        fp.arm("rep-mid-ship")
+        for i in range(10):
+            admin.create(PODS, f"ns/c{i}", make_pod(f"c{i}", namespace="ns"))
+        final_rv = leader.store.resource_version
+        acked = pods_dump(leader)
+        assert wait_until(
+            lambda: f1.store.resource_version >= final_rv
+            or f2.store.resource_version >= final_rv
+        )
+        assert "rep-mid-ship" in fp.fired()
+        hard_kill(leader)
+
+        assert wait_until(
+            lambda: promoted(f1, f2) is not None
+        ), "no follower promoted after mid-ship leader death"
+        winner = promoted(f1, f2)
+        other = f2 if winner is f1 else f1
+        # log position decides: the winner carries EVERY acked-and-shipped
+        # write, exactly once, at the SAME rv the dead leader committed it
+        # (a double-apply would shift a pod's rv, a loss would drop it)
+        assert wait_until(lambda: pods_dump(winner) == acked)
+        assert rep_status(winner.url)["epoch"] == 2
+        # the loser converges on the winner's exact state
+        assert wait_until(
+            lambda: pods_dump(other) == acked, timeout_s=25
+        )
+    finally:
+        teardown(leader, f1, f2)
+
+
+def test_follower_crash_post_ship_pre_apply_reapplies_exactly_once():
+    leader, (f1,) = spin(n_followers=1, elect=False)
+    try:
+        admin = RemoteStore(leader.url)
+        admin.create(NODES, "n0", make_node("n0"))
+        assert wait_until(lambda: synced(leader, f1))
+        pre_rv = f1.store.resource_version
+
+        # the follower dies AFTER receiving a ship, BEFORE applying it
+        fp.arm("rep-post-ship-pre-apply")
+        for i in range(5):
+            admin.create(PODS, f"ns/d{i}", make_pod(f"d{i}", namespace="ns"))
+        assert wait_until(
+            lambda: not f1.replication._thread.is_alive()
+        ), "replicator thread survived the armed crash point"
+        assert "rep-post-ship-pre-apply" in fp.fired()
+        # the batch was shipped but never applied: the store is the dead
+        # process's lost state, parked at the pre-ship position
+        assert f1.store.resource_version == pre_rv
+
+        # "restart" the follower: a fresh replicator over the SAME store
+        # re-fetches from its cursor; the rv gate makes the re-fetched
+        # batch land exactly once
+        restarted = FollowerReplicator(
+            f1.store, leader.url, self_url=f1.url,
+            peers=(leader.url, f1.url), replica_index=1,
+            poll_timeout_s=POLL, grace_s=GRACE, lease_duration_s=LEASE,
+            elect=False,
+        )
+        f1.attach_replication(restarted)
+        restarted.start()
+        assert wait_until(lambda: synced(leader, f1))
+        assert f1.store.dump() == leader.store.dump()
+        assert restarted.status()["recordsApplied"] == 5
+    finally:
+        teardown(leader, f1)
+
+
+def test_crash_mid_election_next_round_converges_on_one_leader():
+    leader, (f1, f2) = spin(n_followers=2, elect=True)
+    try:
+        admin = RemoteStore(leader.url)
+        for i in range(6):
+            admin.create(PODS, f"ns/e{i}", make_pod(f"e{i}", namespace="ns"))
+        assert wait_until(lambda: synced(leader, f1) and synced(leader, f2))
+        acked = store_keys(leader, PODS)
+
+        # the FIRST candidate to reach the election commit point dies
+        # mid-election (before its promote could land)
+        fp.arm("rep-mid-election")
+        hard_kill(leader)
+        assert wait_until(
+            lambda: promoted(f1, f2) is not None
+            or not f1.replication._thread.is_alive()
+            or not f2.replication._thread.is_alive(),
+            timeout_s=30,
+        ), "neither a promotion nor the armed crash happened"
+        assert "rep-mid-election" in fp.fired()
+        crashed = (
+            f1 if not f1.replication._thread.is_alive() else f2
+        )
+        # a crashed candidate is a DEAD PROCESS — its listener dies with
+        # it (in-process, the CrashPoint only killed the thread, so tear
+        # the rest down the way the OS would)
+        survivor = f2 if crashed is f1 else f1
+        if promoted(f1, f2) is None:
+            hard_kill(crashed)
+        assert wait_until(
+            lambda: promoted(f1, f2) is not None, timeout_s=30
+        ), "no leader converged after the mid-election crash"
+        winner = promoted(f1, f2)
+        # ONE leader, never two: the crashed candidate never promoted
+        # (the point fires before promote()), its store is still a
+        # follower, and the winner serves under the fenced epoch
+        assert winner is survivor
+        assert crashed.store.follower
+        assert crashed.replication.promotions == 0
+        assert rep_status(winner.url)["epoch"] == 2
+        assert store_keys(winner, PODS) == acked
+        # and the new leader takes writes
+        RemoteStore(winner.url).create(
+            PODS, "ns/after", make_pod("after", namespace="ns")
+        )
+        assert store_keys(winner, PODS) == sorted(acked + ["ns/after"])
+    finally:
+        teardown(leader, f1, f2)
+
+
+# -------------------------------------------------- PR-16 parity (N = 1)
+
+def test_unreplicated_apiserver_keeps_pr16_behavior():
+    """--apiservers 1 binds no replication role: the server must be
+    byte/behavior-identical to the pre-replication build."""
+    srv = APIServer().start()
+    try:
+        # no /replication/* surface at all
+        for path in ("/replication/status", "/replication/log",
+                     "/replication/snapshot"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{srv.url}{path}", timeout=5)
+            assert ei.value.code == 404
+        # writes land directly — no redirect machinery in the path
+        remote = RemoteStore(srv.url)
+        rv = remote.create(NODES, "n0", make_node("n0"))
+        assert rv == srv.store.resource_version
+        # no replication series pollute /metrics (the sentinel's
+        # replication_lag rule stays dormant on this text)
+        assert "store_replication" not in srv.metrics_text()
+    finally:
+        srv.close()
+
+
+def test_single_apiserver_spec_argv_is_unchanged():
+    from kubetpu.launch.cluster import apiserver_spec
+
+    spec = apiserver_spec(port=12345, wire="binary")
+    for flag in ("--replicated", "--follow", "--peers", "--replica-index",
+                 "--lease-duration"):
+        assert flag not in spec.argv, (
+            f"{flag} leaked into the unreplicated apiserver spec"
+        )
+
+
+# --------------------------------------------- the launch-level cluster
+
+def test_up_multi_apiserver_cluster_serves_reads_and_cascades(tmp_path):
+    """A REAL 3-apiserver cluster as supervised processes: the leader
+    persists, two followers tail it; pods bind through the leader and
+    read back from a follower; the SIGTERM cascade reaps every child and
+    leaves a clean WAL (``store fsck`` exit 0)."""
+    import os
+
+    from kubetpu.launch import Cluster
+
+    REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    wal_dir = str(tmp_path / "wal")
+    cluster = Cluster(
+        replicas=1, apiservers=3, persistence=wal_dir,
+        env={"JAX_PLATFORMS": "cpu"}, cwd=REPO,
+    )
+    with cluster:
+        assert len(cluster.api_urls) == 3
+        assert rep_status(cluster.api_urls[0])["role"] == "leader"
+        admin = RemoteStore(cluster.api_url)
+        for i in range(2):
+            admin.create("nodes", f"n{i}",
+                         make_node(f"n{i}", cpu_milli=64000, pods=110))
+        admin.bulk("pods", [
+            {"op": "create", "key": f"ns/p{i}",
+             "object": make_pod(f"p{i}", namespace="ns")}
+            for i in range(8)
+        ])
+        deadline = time.monotonic() + 120
+        bound = 0
+        while time.monotonic() < deadline:
+            items, _rv = admin.list("pods")
+            bound = sum(1 for _k, o in items if o.node_name)
+            if bound == 8:
+                break
+            time.sleep(0.2)
+        assert bound == 8, f"only {bound}/8 bound"
+        # the read plane: a follower serves the same bound set
+        leader_rv = 0
+        for url in cluster.api_urls[1:]:
+            st = rep_status(url)
+            assert st["role"] == "follower"
+            leader_rv = rep_status(cluster.api_urls[0])["resourceVersion"]
+        follower = RemoteStore(cluster.api_urls[1])
+        assert wait_until(
+            lambda: follower.list("pods")[1] >= leader_rv, timeout_s=30
+        )
+        items, _rv = follower.list("pods")
+        assert sum(1 for _k, o in items if o.node_name) == 8
+        pids = [c.pid for c in cluster.supervisor.children]
+    # SIGTERM cascade: every child reaped, none orphaned
+    for child in cluster.supervisor.children:
+        assert not child.alive(), f"{child.name} survived the cascade"
+    for pid in pids:
+        with pytest.raises(ProcessLookupError):
+            os.kill(pid, 0)
+    # the leader's graceful close left a recoverable WAL
+    from kubetpu.cli import main as cli_main
+
+    assert cli_main(["store", "fsck", "--dir", wal_dir]) == 0

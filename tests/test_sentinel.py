@@ -10,6 +10,8 @@ deterministically — no sleeps, no real scheduler.
 
 import json
 import urllib.request
+
+import pytest
 from types import SimpleNamespace
 
 from kubetpu.api.wrappers import make_pod
@@ -272,3 +274,99 @@ def test_sentinel_state_rides_the_metrics_scrape():
     text = s.metrics_text()
     assert "kubetpu_sentinel_alerts_fired_total 1" in text
     assert 'kubetpu_sentinel_alerts{state="firing"} 1' in text
+
+
+# -------------------------------------------------- replication-lag rule
+REP = "store_replication_lag_records"
+
+
+def rep_text(lag: int) -> str:
+    """The follower replicator's gauge as /metrics exposes it — present
+    only on a replicated apiserver."""
+    return f"# TYPE {REP} gauge\n{REP} {lag}"
+
+
+def test_replication_lag_rule_fires_on_sustained_lag_and_resolves():
+    s, clock = make_sentinel()
+    settle_baseline(s, clock)
+    assert s.alerts_json()["alerts"] == []
+
+    # lag above the 500-record trip: pending on the first eval, FIRING
+    # on the second (for_intervals=2 — one slow batch must not page)
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(0) + "\n" + rep_text(1200))
+    assert out["fired"] == []
+    assert s.alerts_json()["pending"] == 1
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(0) + "\n" + rep_text(1300))
+    assert [a["rule"] for a in out["fired"]] == ["replication-lag"]
+    assert out["fired"][0]["severity"] == "warning"
+    assert "1300" in out["fired"][0]["reason"]
+
+    # the replica catches up: resolve_intervals=3 clean evals → RESOLVED
+    resolved = []
+    for _ in range(4):
+        clock["t"] += 30
+        resolved += s.evaluate(e2e_text(0) + "\n" + rep_text(0))["resolved"]
+    assert [a["rule"] for a in resolved] == ["replication-lag"]
+
+
+def test_replication_lag_rule_dormant_without_the_series():
+    """An unreplicated (or leader) apiserver exposes no replication lag
+    gauges — the rule must never leave dormancy on that scrape."""
+    s, clock = make_sentinel()
+    settle_baseline(s, clock)
+    for _ in range(5):
+        clock["t"] += 30
+        assert s.evaluate(e2e_text(0))["fired"] == []
+    assert all(
+        a["rule"] != "replication-lag" for a in s.alerts_json()["alerts"]
+    )
+
+
+# ------------------------------------------------------------ alert sink
+def test_alert_sink_file_appends_one_ndjson_line_per_transition(tmp_path):
+    path = tmp_path / "alerts.ndjson"
+    s, clock = make_sentinel(sink=f"file:{path}")
+    settle_baseline(s, clock)
+    for lag in (900, 950):
+        clock["t"] += 30
+        s.evaluate(e2e_text(0) + "\n" + rep_text(lag))
+    for _ in range(4):
+        clock["t"] += 30
+        s.evaluate(e2e_text(0) + "\n" + rep_text(0))
+
+    lines = [json.loads(ln) for ln in path.read_text().splitlines()]
+    # exactly two records: the fired edge and the resolved edge — never
+    # one per evaluation pass
+    assert [(ln["transition"], ln["alert"]["rule"]) for ln in lines] == [
+        ("fired", "replication-lag"), ("resolved", "replication-lag"),
+    ]
+    assert s.sink.stats()["delivered"] == 2
+    assert s.sink.stats()["errors"] == 0
+    # delivery counters ride the sentinel's own metrics
+    text = s.metrics_text()
+    assert "kubetpu_sentinel_sink_delivered_total 2" in text
+    assert "kubetpu_sentinel_sink_errors_total 0" in text
+
+
+def test_alert_sink_webhook_failure_is_counted_never_fatal():
+    # port 9 on loopback: nothing listens — every POST fails fast
+    s, clock = make_sentinel(sink="webhook:http://127.0.0.1:9/alerts")
+    settle_baseline(s, clock)
+    clock["t"] += 30
+    s.evaluate(e2e_text(70))
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(70))     # the lifecycle proceeded anyway
+    assert s.alerts_json()["firing"] == 1
+    assert s.sink.stats()["errors"] >= 1
+    assert s.sink.stats()["delivered"] == 0
+    assert out is not None
+
+
+def test_alert_sink_rejects_malformed_specs():
+    from kubetpu.telemetry.sentinel import AlertSink
+
+    for bad in ("file", "file:", "bogus:/tmp/x", "webhook:", ":", ""):
+        with pytest.raises(ValueError):
+            AlertSink(bad)

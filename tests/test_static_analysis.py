@@ -86,7 +86,7 @@ def test_every_checker_registered_and_documented():
     assert codes >= {
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
-        "WP001", "WL001", "TR003", "PS001", "EC001", "AL001",
+        "WP001", "WL001", "TR003", "PS001", "EC001", "AL001", "RP001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -120,7 +120,7 @@ def test_fixture_violations_match_markers_exactly():
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
     "wal_good.py", "trace_good.py", "proc_good.py", "epoch_good.py",
-    "alert_good.py",
+    "alert_good.py", "rep_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -151,6 +151,17 @@ def test_donation_and_transfer_checkers_cover_audited_files():
             assert f in res.coverage[code], (
                 f"{code} no longer covers {f}"
             )
+
+
+def test_replication_seam_checker_covers_store_and_replicator():
+    """PR 17: the replicated read plane's correctness files stay inside
+    RP001's scope — a rename/move of the store or replicator must fail
+    here instead of silently un-checking the apply seam."""
+    res = _repo_result()
+    covered = set(res.coverage.get("RP001", ()))
+    for f in ("kubetpu/store/memstore.py", "kubetpu/store/replication.py"):
+        assert f in res.files, f"{f} missing from the analysis walk"
+        assert f in covered, f"{f} dropped out of RP001 scope"
 
 
 def test_clock_checker_covers_lease_backoff_files():

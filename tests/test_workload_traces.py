@@ -294,3 +294,85 @@ def test_scoped_extension_rows_bit_identical_to_fresh_build():
         np.testing.assert_array_equal(na, fna)
         np.testing.assert_array_equal(tt, ftt)
     s.close()
+
+
+def test_scoped_removal_rows_bit_identical_to_fresh_build():
+    """Drain-wave parity (ROADMAP 5b): after node DELETES, every cached
+    row must equal a from-scratch build against the shrunken node set —
+    the compaction is a survivor gather, never a semantics change."""
+    from kubetpu.api import types as t
+    from kubetpu.api.wrappers import make_node
+    from kubetpu.framework import config as C
+    from kubetpu.perf import workloads as W
+    from kubetpu.state import encoder as enc
+    from kubetpu.state.encode_cache import build_node_ctx
+
+    from .test_scheduler import FakeClient, make_sched
+
+    client = FakeClient()
+    s, clock = make_sched(client, profile=C.Profile(), max_batch=16)
+    for i in range(10):
+        s.on_node_add(W.node_default(i, zones=("za", "zb")))
+    # a zone-labelled node the affinity row matches, and a tainted node —
+    # both SURVIVE the drain, so their non-trivial columns must gather
+    # through to the compacted rows at their new indices
+    s.on_node_add(make_node("keeper-aff", labels={W.ZONE_KEY: "zone1"}))
+    s.on_node_add(make_node(
+        "keeper-taint",
+        taints=(t.Taint("dedic", "x", t.TaintEffect.NO_SCHEDULE),),
+    ))
+    s.on_pod_add(W.pod_default("p0", "ns"))
+    s.on_pod_add(W.pod_with_node_affinity("p1", "ns"))
+    s.run_until_idle()
+    ec = s.encode_cache
+    assert len(ec._filter_rows) > 0
+    # the drain wave: delete three interior nodes (indices shift, so a
+    # correct compaction MUST remap, not truncate)
+    for name in ("scheduler-perf-1", "scheduler-perf-4", "scheduler-perf-7"):
+        s.on_node_delete(s.cache.get_node_info(name).node)
+    s.on_pod_add(W.pod_default("p2", "ns"))
+    s.run_until_idle()
+    assert ec.scoped_removals > 0, "drain did not take the compaction path"
+    assert ec.compacted_bytes > 0
+    # behavior check through the compacted rows: the affinity pod still
+    # binds to the surviving zone-matching node
+    s.on_pod_add(W.pod_with_node_affinity("p3", "ns"))
+    clock.tick(30)
+    s.run_until_idle()
+    s.dispatcher.sync()
+    s._drain_bind_completions()
+    assert client.bound.get("ns/p3") == "keeper-aff", client.bound
+    nt = s._prev_nt
+    assert "scheduler-perf-4" not in nt.node_names
+    ctx = build_node_ctx(nt)
+    for key, (row, trivial, pod) in ec._filter_rows._d.items():
+        _fsig, feat_req, _nn, unknown, flt = key
+        fresh = enc.build_static_filter_row(
+            nt, ctx, pod, flt, feat_req, unknown
+        )
+        np.testing.assert_array_equal(row, fresh, err_msg=str(key))
+        assert trivial == bool(fresh.all())
+    for key, (na, tt, pod) in ec._score_rows._d.items():
+        _ssig, want_na, want_tt = key
+        fna, ftt = enc.build_static_score_rows(nt, ctx, pod, want_na, want_tt)
+        np.testing.assert_array_equal(na, fna)
+        np.testing.assert_array_equal(tt, ftt)
+    s.close()
+
+
+def test_drain_wave_scoped_removal_less_reencode_than_flush():
+    """The drain-wave A/B: under an identical add+drain node wave, the
+    scoped cache compacts rows on the drain instead of flushing — fewer
+    from-scratch row bytes and at least one scoped removal."""
+    prof = TRACE_PROFILES["node-wave"].scaled(
+        "ab-drain", nodes=48, duration_s=5.0, pod_rate=20.0, waves=1,
+        wave_nodes=8, ramp_s=1.0, drain=True,
+    )
+    kw = dict(mode="direct", max_batch=16, timeout_s=120, warmup=False)
+    scoped = run_workload_trace(prof, scoped_invalidation=True, **kw)
+    flush = run_workload_trace(prof, scoped_invalidation=False, **kw)
+    s, f = scoped.trace_stats, flush.trace_stats
+    assert s["unbound"] == 0 and f["unbound"] == 0
+    assert s["encode_scoped_removals"] > 0, s
+    assert f["encode_scoped_removals"] == 0
+    assert s["encode_rebuilt_bytes"] < f["encode_rebuilt_bytes"], (s, f)
