@@ -64,3 +64,4 @@ from . import proccheck  # noqa: F401,E402
 from . import cachecheck  # noqa: F401,E402
 from . import alertcheck  # noqa: F401,E402
 from . import replcheck  # noqa: F401,E402
+from . import listcheck  # noqa: F401,E402

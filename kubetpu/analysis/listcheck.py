@@ -1,0 +1,157 @@
+"""List-materialization seam discipline checker (LS001).
+
+The 50k read plane holds only if EVERY full-store list materialization
+goes through ``MemStore._list_page_locked`` — the one seam that walks
+the core in seq order under the store lock with a bounded page budget.
+A core list called anywhere else — a new handler grabbing
+``self._core.list(...)`` directly, a helper that takes ``core =
+self._core`` and walks it through the alias, a "fast path" calling
+``core.list_page`` without the seam's lock/selector parsing — is an
+unbounded materialization the pagination budget never sees: at 50k
+nodes it allocates the whole result set in one go, holds the store lock
+for the full walk (stalling every write and watch delivery behind it),
+and silently un-does the tentpole this PR exists for. This checker
+moves that invariant to parse time, alias-resolving like WL001: any
+``list``/``list_page`` call whose receiver resolves to a store core
+(``self._core``, or a local name assigned from one) outside the
+blessed seam is a finding. The core implementations themselves
+(``_PyCore`` — the primitives the seam wraps) are exempt by class; the
+apiserver modules are in scope so a future handler that grows a core
+reference is caught the day it is written, not the day it melts a 50k
+list.
+"""
+
+from __future__ import annotations
+
+import ast
+import posixpath
+
+from .core import Checker, ModuleInfo, Violation, register
+
+#: the modules holding (or historically tempted to hold) a core
+#: reference on the list path: the store wrapper and the apiserver's
+#: serving/client halves
+_SCOPE_FILES = {
+    "kubetpu/store/memstore.py",
+    "kubetpu/apiserver/server.py",
+    "kubetpu/apiserver/remote.py",
+}
+
+#: the one function allowed to materialize a core list: the pagination
+#: seam (seq-ordered walk, bounded page, caller holds the store lock)
+_SEAM_FUNCS = {"_list_page_locked"}
+
+#: the classes whose methods ARE the core (self.list inside them is the
+#: primitive, not a bypass)
+_CORE_CLASSES = {"_PyCore"}
+
+_LIST_CALLS = {"list", "list_page"}
+
+
+def _is_core_attr(node: ast.AST) -> bool:
+    """``X._core`` for any X — the direct core reference shape."""
+    return isinstance(node, ast.Attribute) and node.attr == "_core"
+
+
+@register
+class ListMaterializationOutsidePageSeam(Checker):
+    code = "LS001"
+    title = "store-core list materialization outside the pagination seam"
+    rationale = (
+        "Every full-store list must go through MemStore._list_page_locked "
+        "— the one seam that walks the core in seq order under the store "
+        "lock with a bounded page budget (limit/after_seq), which is what "
+        "makes a 50k-node LIST a series of bounded pages instead of one "
+        "monolithic materialization. A core .list()/.list_page() called "
+        "anywhere else — directly as self._core.list(...), or through an "
+        "alias like core = self._core — allocates the entire result set "
+        "in one unbounded walk while holding the store lock, stalling "
+        "every write and watch delivery behind it; paginated callers "
+        "cannot bound what they never route through the seam, and the "
+        "continue-token snapshot contract (pages pinned to one rv, "
+        "expiry 410 at compaction) silently stops covering that path. "
+        "Route the materialization through _list_page_locked (or the "
+        "public list/list_page wrappers over it); the core "
+        "implementations themselves are the primitives the seam wraps "
+        "and are exempt by class."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        base = posixpath.basename(relpath)
+        if base.startswith("list_") and base.endswith(".py"):
+            return True     # the known-bad/known-good fixtures
+        return relpath in _SCOPE_FILES
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        for cls_name, fn in self._functions(mod.tree):
+            if cls_name in _CORE_CLASSES:
+                continue        # the primitive itself, not a caller
+            if fn.name in _SEAM_FUNCS:
+                continue        # the seam is the one blessed walker
+            aliases = self._core_aliases(fn)
+            for node in ast.walk(fn):
+                if not isinstance(node, ast.Call):
+                    continue
+                f = node.func
+                if not (
+                    isinstance(f, ast.Attribute) and f.attr in _LIST_CALLS
+                ):
+                    continue
+                recv = f.value
+                if _is_core_attr(recv) or (
+                    isinstance(recv, ast.Name) and recv.id in aliases
+                ):
+                    symbol = (
+                        f"{cls_name}.{fn.name}" if cls_name else fn.name
+                    )
+                    out.append(Violation(
+                        path=mod.relpath, line=node.lineno, code=self.code,
+                        symbol=symbol,
+                        message=(
+                            f"core .{f.attr}() outside the pagination "
+                            "seam — an unbounded full-store "
+                            "materialization under the store lock that "
+                            "the page budget never sees; route it "
+                            "through MemStore._list_page_locked"
+                        ),
+                    ))
+        return out
+
+    @staticmethod
+    def _functions(tree: ast.AST):
+        """Yield (enclosing class name or '', function node) for every
+        function, innermost functions included."""
+        out = []
+
+        def walk(node, cls_name):
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, ast.ClassDef):
+                    walk(child, child.name)
+                elif isinstance(
+                    child, (ast.FunctionDef, ast.AsyncFunctionDef)
+                ):
+                    out.append((cls_name, child))
+                    walk(child, cls_name)
+                else:
+                    walk(child, cls_name)
+        walk(tree, "")
+        return out
+
+    @staticmethod
+    def _core_aliases(fn: ast.AST) -> set:
+        """Local names bound (anywhere in the function) from a core
+        reference: ``core = self._core`` — assignment order is ignored
+        on purpose (flow-insensitive, no false negatives)."""
+        aliases: set[str] = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and _is_core_attr(node.value):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        aliases.add(tgt.id)
+            elif isinstance(node, ast.AnnAssign) and (
+                node.value is not None and _is_core_attr(node.value)
+                and isinstance(node.target, ast.Name)
+            ):
+                aliases.add(node.target.id)
+        return aliases

@@ -100,10 +100,51 @@ class APIServerMetrics:
             },
         )
 
+        # the read plane's pagination evidence: one increment per LIST
+        # reply, split by whether the limit/continue walk served it
+        # (ListScaling's pages/relist reads from here)
+        self.list_pages = r.counter(
+            "apiserver_list_pages_total",
+            "LIST replies served, by pagination mode (paged = a "
+            "limit/continue page, full = the unpaged monolithic reply).",
+            labels=("mode",),
+            declared={"mode": ("paged", "full")},
+        )
+        # replication-feed egress by path — the chained-shipping
+        # acceptance (leader egress ~= one follower's worth) reads the
+        # leader's log-path delta
+        self.replication_bytes = r.counter(
+            "apiserver_replication_bytes_total",
+            "Replication feed payload bytes served, by path.",
+            labels=("path",),
+            declared={"path": ("log", "snapshot")},
+        )
+        # lag (records) the last rv=0 bounded-staleness list trailed the
+        # leader by; None until one is served. Exposed as
+        # store_list_lag_records by the follower's metrics source only —
+        # unreplicated/leader servers omit the series so the sentinel's
+        # list-lag rule stays dormant there
+        self.list_lag_last: int | None = None
+
     def count_wire(self, codec: str, direction: str, n: int) -> None:
         """Record ``n`` payload bytes moving through the wire seam."""
         if n:
             self.wire_bytes.labels(codec, direction).inc(n)
+
+    def count_replication(self, path: str, n: int) -> None:
+        """Record ``n`` replication-feed payload bytes served."""
+        if n:
+            self.replication_bytes.labels(path).inc(n)
+
+    def replication_bytes_total(self, path: str | None = None) -> int:
+        """Lifetime replication-feed egress bytes, optionally by path —
+        the chained-shipping bench's leader-egress probe."""
+        total = 0
+        for key, child in self.replication_bytes._children_snapshot():
+            if path is not None and key[0] != path:
+                continue
+            total += int(child.value)
+        return total
 
     def wire_bytes_total(self, codec: str | None = None,
                          direction: str | None = None) -> int:

@@ -54,6 +54,15 @@ class RemoteStore:
     BACKOFF_BASE_S = 0.05
     BACKOFF_CAP_S = 2.0
     BACKOFF_JITTER = 0.25       # +/- fraction of the delay
+    #: default LIST page size: every relist is a limit/continue walk of
+    #: N bounded RPCs instead of one unbounded reply — at 50k nodes the
+    #: unpaged body is tens of MB in one read, the paged walk is ~100
+    #: requests that each fit in a socket buffer. 0 disables paging.
+    LIST_PAGE_LIMIT = 500
+    #: per-PAGE retry budget (the watch path's policy applied to each
+    #: page GET): a page is an idempotent snapshot-pinned read, so the
+    #: capped-jitter retry that hardens watch polls is safe here too
+    LIST_RETRY_BUDGET = 6
 
     def __init__(self, base_url: str, timeout_s: float = 30.0,
                  wire: str = "binary", traceparent: bool = False,
@@ -89,11 +98,19 @@ class RemoteStore:
         # leader stops answering (failover: the next 307 re-learns it).
         self._write_base: "str | None" = None
         # apiserver_client_reconnects_total{reason}: every watch-path
-        # retry taken after a transport failure, by failure class — the
+        # retry taken after a transport failure, by failure class, plus
+        # every list-page retry under reason="list" — the
         # restart-visibility counter (guarded: watcher threads + a
         # diagnostics scrape share it)
         self._reconnect_lock = threading.Lock()
         self.reconnect_counts: dict[str, int] = {}
+        # paged-relist evidence for the bench ladder: cumulative totals
+        # plus the last walk's shape (pages, wire bytes, largest page) —
+        # ListScaling's pages/relist and bytes/relist read from here
+        self.relist_stats: dict[str, int] = {
+            "relists": 0, "pages": 0, "bytes": 0, "max_page_bytes": 0,
+        }
+        self.last_relist: "dict[str, int] | None" = None
 
     # ------------------------------------------------- reconnect policy
     @staticmethod
@@ -121,7 +138,8 @@ class RemoteStore:
             counts = dict(self.reconnect_counts)
         lines = [
             "# HELP apiserver_client_reconnects_total Watch/long-poll "
-            "retries taken after a transport failure, by failure class.\n"
+            "retries taken after a transport failure, by failure class "
+            "(list-page retries ride reason=\"list\").\n"
             "# TYPE apiserver_client_reconnects_total counter\n"
         ]
         for reason in sorted(counts):
@@ -131,20 +149,21 @@ class RemoteStore:
             )
         return "".join(lines)
 
-    def _watch_request(self, path: str):
-        """One watch/long-poll GET hardened for apiserver restarts: a
+    def _retried_get(self, path: str, budget: int, reason_for):
+        """One idempotent GET hardened for apiserver restarts: a
         transient transport failure (past ``_request``'s single provably-
         safe retry) backs off — capped, jittered, exponential — and
-        retries within ``WATCH_RETRY_BUDGET``, counting each reconnect by
-        reason. Watch polls are idempotent reads (the cursor only moves on
-        a delivered reply), so the aggressive retry that would be unsafe
-        for writes is safe here. A budget exhausted raises the last
-        RemoteUnavailableError — the informer pump's catch-and-retry
-        keeps the component alive at its own cadence."""
+        retries within ``budget``, counting each retry under
+        ``reason_for(exc)``. Safe only for reads whose effect does not
+        move on failure (watch polls: the cursor only advances on a
+        delivered reply; list pages: snapshot-pinned by the continue
+        token). A budget exhausted raises the last
+        RemoteUnavailableError — the caller's catch-and-retry keeps the
+        component alive at its own cadence."""
         import random
         import time
 
-        for attempt in range(self.WATCH_RETRY_BUDGET + 1):
+        for attempt in range(budget + 1):
             if attempt:
                 delay = min(
                     self.BACKOFF_BASE_S * (2 ** (attempt - 1)),
@@ -157,9 +176,25 @@ class RemoteStore:
             try:
                 return self._request("GET", path)
             except RemoteUnavailableError as e:
-                if attempt >= self.WATCH_RETRY_BUDGET:
+                if attempt >= budget:
                     raise       # budget spent: no retry follows, no count
-                self._count_reconnect(self._failure_reason(e))
+                self._count_reconnect(reason_for(e))
+
+    def _watch_request(self, path: str):
+        """Watch/long-poll GET with the reconnect policy, counted by
+        failure class (``_failure_reason``)."""
+        return self._retried_get(
+            path, self.WATCH_RETRY_BUDGET, self._failure_reason
+        )
+
+    def _list_page_request(self, path: str):
+        """One LIST page GET with the same capped-jitter policy the
+        watch path rides, counted under reason="list" — a 50k relist is
+        N bounded, individually-retried RPCs, not one unbounded GET
+        whose mid-transfer failure restarts the whole transfer."""
+        return self._retried_get(
+            path, self.LIST_RETRY_BUDGET, lambda _e: "list"
+        )
 
     @property
     def wire_codec(self) -> str:
@@ -369,6 +404,9 @@ class RemoteStore:
             try:
                 resp = conn.getresponse()
                 status, raw = resp.status, resp.read()
+                # per-THREAD last-response size: the paged list walk
+                # reads it back per page for the bytes/relist evidence
+                self._local.last_raw_len = len(raw)
                 resp_ct = resp.getheader("Content-Type")
                 self._note_response_ct(resp_ct)
                 if ctx is not None and self._tracer is not None:
@@ -405,14 +443,69 @@ class RemoteStore:
     def list(
         self, kind: str,
         label_selector: str = "", field_selector: str = "",
+        limit: "int | None" = None,
     ):
-        res = self._request(
-            "GET", f"/apis/{kind}{_sel_qs('?', label_selector, field_selector)}"
-        )
-        return (
-            [(i["key"], codec.as_object(i["object"])) for i in res["items"]],
-            res["resourceVersion"],
-        )
+        """Full LIST as a limit/continue PAGED WALK (``limit=None`` →
+        ``LIST_PAGE_LIMIT``; 0 forces the legacy single unpaged GET).
+        Every page is snapshot-pinned by the server's continue token and
+        individually retried within ``LIST_RETRY_BUDGET``
+        (``_list_page_request``); the returned resourceVersion is the
+        walk's pinned snapshot rv, so a watch opened from it replays
+        exactly the mid-walk delta. A mid-walk 410 (token outlived the
+        event-log compaction window) restarts ONE fresh walk; the walk's
+        shape lands in ``relist_stats``/``last_relist``."""
+        page_limit = self.LIST_PAGE_LIMIT if limit is None else limit
+        sel = _sel_qs("&", label_selector, field_selector)
+        restarts = 0
+        while True:
+            try:
+                return self._list_walk(kind, sel, page_limit)
+            except CompactedError:
+                if page_limit <= 0 or restarts >= 1:
+                    raise
+                restarts += 1
+
+    def _list_walk(self, kind: str, sel: str, page_limit: int):
+        """One attempted walk (or the one unpaged GET when
+        ``page_limit`` ≤ 0). Raises CompactedError if a continue token
+        expires mid-walk — ``list`` restarts fresh."""
+        items: list = []
+        rv = 0
+        cont = ""
+        pages = total_bytes = max_page = 0
+        while True:
+            if page_limit > 0:
+                path = (
+                    f"/apis/{kind}?limit={page_limit}"
+                    + (f"&continue={cont}" if cont else "") + sel
+                )
+            else:
+                path = f"/apis/{kind}" + (("?" + sel[1:]) if sel else "")
+            res = self._list_page_request(path)
+            page_bytes = getattr(self._local, "last_raw_len", 0)
+            pages += 1
+            total_bytes += page_bytes
+            max_page = max(max_page, page_bytes)
+            items.extend(
+                (i["key"], codec.as_object(i["object"]))
+                for i in res["items"]
+            )
+            rv = res["resourceVersion"]
+            cont = res.get("continue", "")
+            if not cont:
+                break
+        with self._reconnect_lock:
+            self.relist_stats["relists"] += 1
+            self.relist_stats["pages"] += pages
+            self.relist_stats["bytes"] += total_bytes
+            self.relist_stats["max_page_bytes"] = max(
+                self.relist_stats["max_page_bytes"], max_page
+            )
+            self.last_relist = {
+                "pages": pages, "bytes": total_bytes,
+                "max_page_bytes": max_page,
+            }
+        return items, rv
 
     def create(self, kind: str, key: str, obj: Any) -> int:
         res = self._request("POST", f"/apis/{kind}/{key}", obj)

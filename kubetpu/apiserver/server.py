@@ -211,6 +211,30 @@ class EventEncodeCache:
                 self._entries.popitem(last=False)
         return body
 
+    def item_bytes(self, kind: str, key: str, obj, rv: int,
+                   wire: str = codec.JSON) -> bytes:
+        """One LIST item's wire body through the same serialize-once LRU
+        — the paged-list splice path: keyed by (kind, rv, codec) like an
+        event body (every store write mints a fresh rv, so a mutated
+        object can never serve from an old entry), with an "item"
+        dimension keeping list bodies distinct from watch-event bodies
+        at the same rv. A 50k-node relist walk re-encodes only the
+        objects that changed since the last walk."""
+        cache_key = (kind, rv, wire, "item", scheme.registry_generation())
+        with self._lock:
+            cached = self._entries.get(cache_key)
+            if cached is not None:
+                self._entries.move_to_end(cache_key)
+                self._hits[wire] += 1
+                return cached
+        body = codec.list_item_wire_bytes(key, obj, wire)
+        with self._lock:
+            self._misses[wire] += 1
+            self._entries[cache_key] = body
+            while len(self._entries) > self._maxsize:
+                self._entries.popitem(last=False)
+        return body
+
     def _ring_stats(self) -> dict:
         stats = getattr(self._store, "body_cache_stats", None)
         return stats() if stats is not None else {}
@@ -512,7 +536,7 @@ class _Handler(BaseHTTPRequestHandler):
                 items, rv = self.store.dump_with_rv()
                 self._reply_rep(
                     encode_snapshot_stream(items, rv, self._rep_wire(q)),
-                    rv,
+                    rv, path="snapshot",
                 )
             else:
                 self._error(404, "unknown replication path")
@@ -557,10 +581,13 @@ class _Handler(BaseHTTPRequestHandler):
         self._reply_rep(body, cursor, wire=wire)
 
     def _reply_rep(self, body: bytes, cursor: int,
-                   wire: str = "") -> None:
+                   wire: str = "", path: str = "log") -> None:
         """Raw replication bytes + the feed position/fencing headers."""
         from ..store import replication as rep
 
+        # the ship plane's egress evidence: chained fan-out is judged by
+        # this counter's delta on the leader (O(fan-out), not O(followers))
+        self.metrics.count_replication(path, len(body))
         self._status = 200
         self.send_response(200)
         self.send_header("Content-Type", rep.CT_WAL)
@@ -647,22 +674,7 @@ class _Handler(BaseHTTPRequestHandler):
                 else:
                     self._watch(kind, q)
             elif key is None:
-                items, rv = self.store.list(
-                    kind,
-                    label_selector=q.get("labelSelector", ""),
-                    field_selector=q.get("fieldSelector", ""),
-                )
-                if items:
-                    # a non-empty list proves the kind exists; an empty
-                    # 200 proves nothing (MemStore lists unknown kinds as
-                    # empty), so bare LIST successes never admit labels
-                    self.metrics.admit_resource(kind)
-                self._reply({
-                    "items": [
-                        {"key": k, "object": o} for k, o in items
-                    ],
-                    "resourceVersion": rv,
-                })
+                self._list(kind, q)
             else:
                 obj, rv = self.store.get(kind, key)
                 if obj is None:
@@ -675,6 +687,130 @@ class _Handler(BaseHTTPRequestHandler):
             self._error(400, str(e))
         except Exception as e:
             self._error(500, f"{type(e).__name__}: {e}")
+
+    def _list_lag_records(self) -> int:
+        """The replication lag (in records) a bounded-staleness read may
+        trail the leader by right now — 0 on an unreplicated server or
+        the leader itself (their watch cache IS the write path)."""
+        if not getattr(self.store, "follower", False):
+            return 0
+        status = getattr(self.replication, "status", None)
+        if status is None:
+            return 0
+        try:
+            return int(status().get("lagRecords", 0) or 0)
+        except Exception:  # noqa: BLE001 — lag surfacing must not 500 a read
+            return 0
+
+    def _list(self, kind: str, q: dict) -> None:
+        """GET /apis/<kind> — the (paged) LIST. ``limit`` caps the page
+        size; a truncated page's reply carries an opaque ``continue``
+        token pinned to the walk's resourceVersion snapshot, and a token
+        whose snapshot fell behind the event ring's compaction horizon
+        410s into a fresh walk (the reference's expired-continue
+        semantics). Pages splice cached item bodies off the
+        serialize-once cache — nothing re-encodes on a relist walk
+        unless the object changed. ``resourceVersion=0`` is the
+        bounded-staleness read: served from the local watch-ring-backed
+        cache (on a follower, the replica) with the observed replication
+        lag surfaced as ``store_list_lag_records``; ``maxLagRecords``
+        declares the client's bound (503 when exceeded). Exact/absent-rv
+        lists keep their pre-pagination semantics and bytes."""
+        ls = q.get("labelSelector", "")
+        fs = q.get("fieldSelector", "")
+        limit = int(q.get("limit", 0))
+        if limit < 0:
+            raise ValueError(f"limit must be >= 0, got {limit}")
+        token = q.get("continue", "")
+        if q.get("resourceVersion", "") == "0":
+            lag = self._list_lag_records()
+            self.metrics.list_lag_last = lag
+            max_lag = q.get("maxLagRecords")
+            if max_lag is not None and lag > int(max_lag):
+                self._error(
+                    503,
+                    f"bounded-staleness list lag {lag} records exceeds "
+                    f"declared maxLagRecords {max_lag}",
+                )
+                return
+        pager = getattr(self.store, "list_page", None)
+        if pager is None or (limit <= 0 and not token):
+            # the unpaged reply — byte-identical to the pre-pagination
+            # wire (and therefore to a lag-0 rv=0 read of the same state)
+            items, rv = self.store.list(
+                kind, label_selector=ls, field_selector=fs,
+            )
+            if items:
+                # a non-empty list proves the kind exists; an empty
+                # 200 proves nothing (MemStore lists unknown kinds as
+                # empty), so bare LIST successes never admit labels
+                self.metrics.admit_resource(kind)
+            self.metrics.list_pages.labels("full").inc()
+            self._reply({
+                "items": [
+                    {"key": k, "object": o} for k, o in items
+                ],
+                "resourceVersion": rv,
+            })
+            return
+        after_seq = 0
+        through_seq = 0
+        snapshot_rv = None
+        if token:
+            # malformed → ValueError → the caller's 400 (a retry loop
+            # must not hammer a permanently-bad token); EXPIRED → 410
+            snapshot_rv, after_seq, token_gen, through_seq = (
+                codec.decode_continue(token)
+            )
+            horizon = self.store.compacted_through
+            if snapshot_rv < horizon:
+                self._error(
+                    410,
+                    f"continue token snapshot rv {snapshot_rv} compacted "
+                    f"(through {horizon}) — restart the paged walk",
+                )
+                return
+            store_gen = getattr(self.store, "list_generation", 0)
+            if token_gen != store_gen:
+                # seqs renumbered since the token was minted (crash
+                # recovery / replica resync loaded a snapshot): the
+                # cursor would silently skip or duplicate across the
+                # renumbering, so expire it even when its rv clears the
+                # compaction horizon
+                self._error(
+                    410,
+                    "continue token predates a store snapshot load "
+                    "(seq numbering reset) — restart the paged walk",
+                )
+                return
+        wire = self._reply_codec()
+        # the first page captures the walk's seq bound (echoed back by
+        # the store) — later pages carry it in the token, so an object
+        # created mid-walk (higher seq) can never splice into the cut
+        items, store_rv, next_seq, has_more, through_seq = pager(
+            kind, label_selector=ls, field_selector=fs,
+            limit=limit, after_seq=after_seq, through_seq=through_seq,
+        )
+        if snapshot_rv is None:
+            snapshot_rv = store_rv
+        if items:
+            self.metrics.admit_resource(kind)
+        parts = [
+            self.event_cache.item_bytes(kind, k, o, orv, wire)
+            for k, o, orv in items
+        ]
+        cont = (
+            codec.encode_continue(
+                snapshot_rv, next_seq,
+                getattr(self.store, "list_generation", 0),
+                through_seq,
+            )
+            if has_more else None
+        )
+        self.metrics.list_pages.labels("paged").inc()
+        self._reply_wire(
+            codec.items_envelope(parts, snapshot_rv, wire, cont), wire,
+        )
 
     @staticmethod
     def _selector_view(q: dict):
@@ -1229,6 +1365,25 @@ class APIServer:
                 )
             return "".join(lines)
 
+        def _list_lag_metrics() -> str:
+            # bounded-staleness read plane: the replication lag (records)
+            # the last rv=0 list was served at. Emitted ONLY on a live
+            # follower — unreplicated/leader servers omit the series, so
+            # the sentinel's list-lag rule stays dormant there (same
+            # contract as store_replication_lag_records)
+            if not getattr(self.store, "follower", False):
+                return ""
+            lag = self.metrics.list_lag_last
+            if lag is None:
+                return ""
+            return (
+                "# HELP store_list_lag_records Replication records the "
+                "last rv=0 (bounded-staleness) list trailed the leader "
+                "by.\n"
+                "# TYPE store_list_lag_records gauge\n"
+                f"store_list_lag_records {lag}\n"
+            )
+
         # embedded anomaly sentinel: watches THIS server's own scrape
         # (request histograms + the WAL fsync set) on a cadence thread
         self.sentinel = None
@@ -1263,8 +1418,8 @@ class APIServer:
             if callable(rep_text):
                 rep_sources = (rep_text,)
         self._metrics_sources = (
-            _event_cache_metrics, *wal_sources, *rep_sources,
-            *sentinel_sources, *metrics_sources,
+            _event_cache_metrics, _list_lag_metrics, *wal_sources,
+            *rep_sources, *sentinel_sources, *metrics_sources,
         )
         handler = type("BoundHandler", (_Handler,), {
             "store": self.store, "registry": self.registry,

@@ -74,6 +74,16 @@ Comparison rules (per metric name present in BOTH records):
   WAS within its declared ``slo_budget_ms`` and now violates it always
   gates; within-budget drift gates on the p99-style relative+absolute
   rule (``admission_tol`` / ``min_admission_delta_ms``).
+- **paged-relist latency** (``list_p99_ms`` on ``ListScaling_*`` lines —
+  the per-relist wall p99 of K full paged informer walks): regression
+  when the new p99 exceeds ``old * (1 + list_tol)`` AND grew by more
+  than ``min_list_delta_ms`` absolute (sub-100ms wobble on the small
+  rungs never gates; a 50k walk that doubled does).
+- **relist wire volume** (``bytes_per_relist`` on the same lines):
+  regression when the new volume exceeds
+  ``old * (1 + relist_bytes_tol)`` AND grew by more than
+  ``min_relist_bytes_delta`` absolute (a codec change that re-inflated
+  the serialize-once list path gates; framing jitter never does).
 - **peak RSS** (``peak_rss_bytes``): regression only when BOTH +50%
   relative AND >256MB absolute — host allocator noise never gates, a
   node-axis layout that regressed into gigabytes at 100k nodes does.
@@ -145,6 +155,17 @@ MIN_SENTINEL_DELTA = 0.05
 #: within-budget drift
 ADMISSION_TOL = 0.50
 MIN_ADMISSION_DELTA_MS = 50.0
+#: paged-relist walls (list_p99_ms on ListScaling_* lines) are p99s over a
+#: handful of full walks on a shared host: the +50% relative rule with a
+#: 100ms absolute floor — small-rung wobble never gates, a 50k-node walk
+#: that genuinely slowed does
+LIST_TOL = 0.50
+MIN_LIST_DELTA_MS = 100.0
+#: relist wire volume (bytes_per_relist) is near-deterministic for a fixed
+#: store (codec framing is the only wobble) — +50% relative with a 64KB
+#: absolute floor catches a list path that stopped serializing once
+RELIST_BYTES_TOL = 0.50
+MIN_RELIST_BYTES_DELTA = 64 * 1024.0
 #: peak RSS is host-noise-prone (allocator, import order): gate only a
 #: move that is BOTH +50% relative AND >256MB absolute
 RSS_TOL = 0.50
@@ -265,6 +286,10 @@ def compare(
     min_sentinel_delta: float = MIN_SENTINEL_DELTA,
     admission_tol: float = ADMISSION_TOL,
     min_admission_delta_ms: float = MIN_ADMISSION_DELTA_MS,
+    list_tol: float = LIST_TOL,
+    min_list_delta_ms: float = MIN_LIST_DELTA_MS,
+    relist_bytes_tol: float = RELIST_BYTES_TOL,
+    min_relist_bytes_delta: float = MIN_RELIST_BYTES_DELTA,
     rss_tol: float = RSS_TOL,
     min_rss_delta_bytes: float = MIN_RSS_DELTA_BYTES,
 ) -> tuple[list[Delta], list[str], list[str]]:
@@ -471,6 +496,36 @@ def compare(
                 name, "admission_p99_ms", float(oa), float(na_), bad,
                 note=note,
             ))
+        # paged-relist walls + wire volume (ListScaling_* lines): the
+        # read plane's two scale gates — the p99-style relative rule
+        # with its own absolute floors
+        oli, nli = o.get("list_p99_ms"), n.get("list_p99_ms")
+        if isinstance(oli, (int, float)) and isinstance(nli, (int, float)):
+            bad = (
+                nli > oli * (1.0 + list_tol)
+                and (nli - oli) > min_list_delta_ms
+            )
+            deltas.append(Delta(
+                name, "list_p99_ms", float(oli), float(nli), bad,
+                note=(
+                    f"[tol +{list_tol:.0%} & >{min_list_delta_ms:g}ms]"
+                    if bad else ""
+                ),
+            ))
+        orb, nrb = o.get("bytes_per_relist"), n.get("bytes_per_relist")
+        if isinstance(orb, (int, float)) and isinstance(nrb, (int, float)):
+            bad = (
+                nrb > orb * (1.0 + relist_bytes_tol)
+                and (nrb - orb) > min_relist_bytes_delta
+            )
+            deltas.append(Delta(
+                name, "bytes_per_relist", float(orb), float(nrb), bad,
+                note=(
+                    f"[tol +{relist_bytes_tol:.0%} & "
+                    f">{min_relist_bytes_delta / 1024:g}KB]"
+                    if bad else ""
+                ),
+            ))
         # peak RSS: both +50% relative AND >256MB absolute (host noise on
         # small stages never gates; a 100k-node rung whose node-axis
         # layout regressed into gigabytes does)
@@ -599,6 +654,22 @@ def main(argv=None) -> int:
                     help="absolute admission-p99 growth floor below which "
                          "within-budget drift never gates (default "
                          f"{MIN_ADMISSION_DELTA_MS})")
+    ap.add_argument("--list-tol", type=float, default=LIST_TOL,
+                    help="fractional paged-relist p99 growth tolerated "
+                         f"(default {LIST_TOL})")
+    ap.add_argument("--min-list-delta-ms", type=float,
+                    default=MIN_LIST_DELTA_MS,
+                    help="absolute relist-p99 growth floor below which it "
+                         f"never gates (default {MIN_LIST_DELTA_MS})")
+    ap.add_argument("--relist-bytes-tol", type=float,
+                    default=RELIST_BYTES_TOL,
+                    help="fractional bytes-per-relist growth tolerated "
+                         f"(default {RELIST_BYTES_TOL})")
+    ap.add_argument("--min-relist-bytes-delta", type=float,
+                    default=MIN_RELIST_BYTES_DELTA,
+                    help="absolute bytes-per-relist growth floor below "
+                         "which it never gates (default "
+                         f"{MIN_RELIST_BYTES_DELTA:g})")
     ap.add_argument("--rss-tol", type=float, default=RSS_TOL,
                     help="fractional peak-RSS growth tolerated "
                          f"(default {RSS_TOL})")
@@ -638,6 +709,10 @@ def main(argv=None) -> int:
         min_sentinel_delta=args.min_sentinel_delta,
         admission_tol=args.admission_tol,
         min_admission_delta_ms=args.min_admission_delta_ms,
+        list_tol=args.list_tol,
+        min_list_delta_ms=args.min_list_delta_ms,
+        relist_bytes_tol=args.relist_bytes_tol,
+        min_relist_bytes_delta=args.min_relist_bytes_delta,
         rss_tol=args.rss_tol,
         min_rss_delta_bytes=args.min_rss_delta_bytes,
     )

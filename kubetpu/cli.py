@@ -196,6 +196,10 @@ def cmd_apiserver(args) -> int:
     persistence = getattr(args, "persistence", "off")
     follow = getattr(args, "follow", "")
     replicated = bool(getattr(args, "replicated", False))
+    if getattr(args, "replicate_from", "") and not follow:
+        print("apiserver: --replicate-from requires --follow "
+              "(the chain carries a follower's feed)", file=sys.stderr)
+        return 2
     if follow and persistence != "off":
         # a follower's WAL is the leader's — local persistence on a
         # replica would fork the durability story, so refuse it early
@@ -252,6 +256,7 @@ def cmd_apiserver(args) -> int:
             # plane fails over proportionally fast (at the 5s default
             # this is exactly the replicator's own 6s default)
             grace_s=1.2 * lease_s,
+            upstream_url=getattr(args, "replicate_from", "") or "",
         ))
     elif replicated:
         from .store.replication import LeaderLease
@@ -392,6 +397,7 @@ def cmd_up(args) -> int:
     cluster = Cluster(
         replicas=args.replicas,
         apiservers=getattr(args, "apiservers", 1),
+        replication_chain=bool(getattr(args, "replication_chain", False)),
         partition=args.partition,
         wire=args.wire,
         engine=args.engine,
@@ -1531,6 +1537,15 @@ def build_parser() -> argparse.ArgumentParser:
                      help="this follower's stable index (election "
                           "tie-break: equal log position → lowest index "
                           "wins)")
+    api.add_argument("--replicate-from", default="", metavar="URL",
+                     help="CHAINED shipping: tail the replication feed "
+                          "from this peer (another follower re-serving "
+                          "/replication/log) instead of the leader — "
+                          "leader egress stays O(direct fan-out). Writes "
+                          "still redirect to --follow's leader; a stale "
+                          "(fenced-epoch) or dead upstream falls this "
+                          "replica back to the leader's feed. Requires "
+                          "--follow")
     api.add_argument("--lease-duration", type=float, default=5.0,
                      help="writer-lease duration in seconds — the "
                           "failover detection floor (default 5.0)")
@@ -1865,6 +1880,14 @@ def build_parser() -> argparse.ArgumentParser:
                          "followers, and the most-caught-up follower "
                          "takes over on leader death (failover by log "
                          "position)")
+    up.add_argument("--replication-chain", action="store_true",
+                    help="chain the followers' replication tails (f1 "
+                         "tails the leader, f2 tails f1, …) so leader "
+                         "replication egress is one follower's worth "
+                         "regardless of --apiservers; a stale or dead "
+                         "chain link falls its downstream back to the "
+                         "leader's feed. Default: every follower tails "
+                         "the leader directly")
     up.add_argument("--partition", default="race",
                     choices=["race", "hash", "lease"],
                     help="federation partition mode across the replica "

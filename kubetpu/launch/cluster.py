@@ -44,12 +44,15 @@ def apiserver_spec(
     peers: tuple = (),
     replica_index: int = 0,
     lease_duration_s: float = 0.0,
+    replicate_from: str = "",
 ) -> ChildSpec:
     """``replicated``/``follow``/``peers``: the replicated read plane —
     a leader spec sets ``replicated=True`` (holds the writer lease), a
     follower spec sets ``follow=<leader url>``; both carry the full
-    ``peers`` electorate for failover. All default OFF: the unreplicated
-    spec's argv is byte-identical to what it always was."""
+    ``peers`` electorate for failover. ``replicate_from`` chains this
+    follower's tail off another follower's re-served feed (leader egress
+    stays O(direct fan-out)). All default OFF: the unreplicated spec's
+    argv is byte-identical to what it always was."""
     args = ["apiserver", "--port", str(port), "--wire", wire]
     if persistence:
         args += ["--persistence", persistence]
@@ -65,6 +68,8 @@ def apiserver_spec(
         args += ["--replica-index", str(replica_index)]
     if lease_duration_s:
         args += ["--lease-duration", str(lease_duration_s)]
+    if replicate_from:
+        args += ["--replicate-from", replicate_from]
     return ChildSpec(
         name=name, argv=kubetpu_argv(*args), restart=restart,
         env=env, shutdown_phase=1, ready_timeout_s=ready_timeout_s,
@@ -157,6 +162,11 @@ class Cluster:
     #: (0 = the CLI default). The failover bench tunes this down so
     #: failover_to_serving_s measures the protocol, not a lazy lease.
     lease_duration_s: float = 0.0
+    #: chained replication shipping: follower i>1 tails follower i-1's
+    #: re-served feed instead of the leader (leader ships ONE stream; a
+    #: dead/stale link falls its downstream back to the leader). False =
+    #: the PR-17 star (every follower tails the leader directly).
+    replication_chain: bool = False
     partition: str = "race"
     wire: str = "binary"
     engine: str = "greedy"
@@ -281,6 +291,12 @@ class Cluster:
                 telemetry="off", follow=leader_url,
                 peers=tuple(peer_urls), replica_index=i,
                 lease_duration_s=self.lease_duration_s,
+                # linear chain: f1 tails the leader, f2 tails f1, … —
+                # the leader's replication egress is one follower's worth
+                replicate_from=(
+                    peer_urls[i - 1] if self.replication_chain and i > 1
+                    else ""
+                ),
                 env=self.env, ready_timeout_s=self.ready_timeout_s,
             )))
         self.apiserver_children = children

@@ -342,6 +342,76 @@ PyObject* store_list(StoreObject* self, PyObject* args) {
   return out;
 }
 
+// list_page(kind[, label_terms, field_terms, limit, after_seq,
+// through_seq]) -> (items [(key, obj, rv)], store_rv, next_seq,
+// has_more, through_seq) — one bounded page of the seq-ordered list
+// walk (the pagination primitive behind MemStore._list_page_locked).
+// Seq order is insertion order and updates never reorder, so a page
+// walk resumed at next_seq can neither duplicate nor skip an object
+// that existed across the whole walk; through_seq caps the walk at a
+// seq bound so objects CREATED mid-walk never splice into later pages
+// (through_seq <= 0 captures the current max seq and echoes it back
+// for the caller's continue token); limit <= 0 means unbounded (the
+// full-list form). Selector-filtered candidates still advance
+// next_seq, so a filtered walk always makes progress; has_more reports
+// whether any in-bound candidate of the kind remains past this page.
+PyObject* store_list_page(StoreObject* self, PyObject* args) {
+  const char* kind;
+  PyObject* lterms = nullptr;
+  PyObject* fterms = nullptr;
+  long long limit = 0;
+  long long after_seq = 0;
+  long long through_seq = 0;
+  if (!PyArg_ParseTuple(args, "s|OOLLL", &kind, &lterms, &fterms, &limit,
+                        &after_seq, &through_seq))
+    return nullptr;
+  long long bound = through_seq > 0 ? through_seq : self->seq_counter;
+  std::string prefix(kind);
+  prefix.push_back('\x1f');
+  struct Hit {
+    long long seq;
+    const std::string* key;
+    const Entry* entry;
+    bool operator<(const Hit& o) const { return seq < o.seq; }
+  };
+  std::vector<Hit> hits;
+  for (auto& kv : *self->objects) {
+    if (kv.first.compare(0, prefix.size(), prefix) != 0) continue;
+    if (kv.second.seq <= after_seq || kv.second.seq > bound) continue;
+    hits.push_back(Hit{kv.second.seq, &kv.first, &kv.second});
+  }
+  std::sort(hits.begin(), hits.end());
+  PyObject* items = PyList_New(0);
+  if (!items) return nullptr;
+  long long next_seq = after_seq;
+  int has_more = 0;
+  for (auto& h : hits) {
+    if (limit > 0 && PyList_GET_SIZE(items) >= limit) {
+      has_more = 1;
+      break;
+    }
+    int ok = matches_selectors(h.entry->obj, lterms, fterms);
+    if (ok < 0) {
+      Py_DECREF(items);
+      return nullptr;
+    }
+    if (ok) {
+      PyObject* entry = Py_BuildValue(
+          "(sOL)", h.key->c_str() + prefix.size(), h.entry->obj,
+          h.entry->rv);
+      if (!entry || PyList_Append(items, entry) < 0) {
+        Py_XDECREF(entry);
+        Py_DECREF(items);
+        return nullptr;
+      }
+      Py_DECREF(entry);
+    }
+    next_seq = h.seq;
+  }
+  return Py_BuildValue("(NLLOL)", items, self->rv, next_seq,
+                       has_more ? Py_True : Py_False, bound);
+}
+
 // events_since(kind_or_None, rv) -> (list[(type, kind, key, obj, rv)], cursor)
 // raises LookupError when rv predates the ring buffer (compacted).
 PyObject* store_events_since(StoreObject* self, PyObject* args) {
@@ -672,6 +742,7 @@ PyMethodDef store_methods[] = {
     {"delete", (PyCFunction)store_delete, METH_VARARGS, nullptr},
     {"get", (PyCFunction)store_get, METH_VARARGS, nullptr},
     {"list", (PyCFunction)store_list, METH_VARARGS, nullptr},
+    {"list_page", (PyCFunction)store_list_page, METH_VARARGS, nullptr},
     {"events_since", (PyCFunction)store_events_since, METH_VARARGS, nullptr},
     {"events_since_bulk", (PyCFunction)store_events_since_bulk, METH_VARARGS,
      nullptr},

@@ -163,6 +163,13 @@ class WorkloadResult:
     apiservers: int = 1
     follower_lag_ms: float | None = None
     follower_lag_records: int | None = None
+    # chained replication shipping (``--replication-chain``): follower i
+    # tails follower i-1 instead of the leader, so the leader's egress is
+    # ONE follower's worth regardless of fan-out — the rung records the
+    # topology it ran and the leader's apiserver_replication_bytes_total
+    # over the run (the egress claim's evidence)
+    replication_chain: bool = False
+    leader_replication_bytes: float | None = None
     # --- trace-shaped workloads (run_workload_trace) ---------------------
     # admission-latency SLO: p50/p99 of enqueue→bind over every pod the
     # trace created, judged against the profile's declared budget — the
@@ -2386,6 +2393,7 @@ def run_workload_multiprocess(
     fanout_procs: int = 0,
     kill_replica_at: float | None = None,
     restart: str = "on-failure:2",
+    replication_chain: bool = False,
     child_env: dict | None = None,
 ) -> WorkloadResult:
     """THE honest deployment shape: apiserver + N scheduler replicas
@@ -2411,6 +2419,10 @@ def run_workload_multiprocess(
     drivers over the followers, leaving the leader to its writers) and
     samples each follower's peak replication lag over the measured
     window into ``follower_lag_ms`` / ``follower_lag_records``.
+    ``replication_chain`` wires follower i to tail follower i-1 instead
+    of the leader; the run records the leader's replication egress bytes
+    either way (``leader_replication_bytes``) so the chained-vs-star
+    delta is a stage-to-stage comparison, not an inference.
 
     Evidence scraped over HTTP before shutdown: apiserver request/wire
     deltas for the measured window, per-replica federation conflicts +
@@ -2454,7 +2466,8 @@ def run_workload_multiprocess(
         max_batch=max_batch, persistence=persistence,
         telemetry=("collector" if telemetry else "off"),
         fanout_procs=fanout_procs, fanout_watchers=watch_fanout,
-        restart=restart, env=child_env, cwd=repo_root,
+        restart=restart, replication_chain=replication_chain,
+        env=child_env, cwd=repo_root,
     )
     measured = 0
     duration = 0.0
@@ -2622,6 +2635,12 @@ def run_workload_multiprocess(
         wire_codec = admin.wire_codec
         n_processes = cluster.n_processes()
         restarts = cluster.supervisor.restarts_total()
+        leader_rep_bytes: float | None = None
+        if apiservers > 1:
+            leader_rep_bytes = _sum_samples(
+                _scrape_metrics(cluster.api_url),
+                "apiserver_replication_bytes_total",
+            )
 
         parity_read: dict[str, int] = {}
 
@@ -2690,6 +2709,361 @@ def run_workload_multiprocess(
         follower_lag_records=(
             int(lag_peak["records"]) if "records" in lag_peak else None
         ),
+        replication_chain=replication_chain,
+        leader_replication_bytes=leader_rep_bytes,
+    )
+
+
+def run_list_scaling(
+    n_nodes: int = 5000,
+    relists: int = 8,
+    page_limit: int | None = None,
+    wire: str = "binary",
+    wall_budget_s: float = 120.0,
+) -> dict:
+    """The read plane's LIST-at-scale evidence (the ``ListScaling_*``
+    bench rungs): one apiserver over a store pre-loaded with ``n_nodes``
+    nodes, then ``relists`` full paged walks through a RemoteStore — the
+    exact informer-relist path (limit/continue pages pinned to one
+    snapshot rv, per-page retry budget, serialize-once item bytes).
+
+    Reports the per-relist wall p50/p99 (``list_p99_ms`` is what
+    benchdiff gates), the wire bytes and page count per relist off the
+    client's relist accounting, the max single page ever shipped, and
+    one unpaged-GET wall for the before/after context. Every walk is
+    parity-checked against the node count — a paged walk that dropped or
+    duplicated a key raises (a correctness failure must fail the stage,
+    never land as a slow-but-green number). ``wall_budget_s`` caps the
+    stage: a rung that can't finish its relists returns a TRUNCATED but
+    parseable record carrying the walks it did complete."""
+    from ..apiserver import APIServer, RemoteStore
+    from ..client.informers import NODES
+    from ..store.memstore import MemStore
+
+    store = MemStore()
+    srv = APIServer(store).start()
+    try:
+        rs = RemoteStore(srv.url, wire=wire)
+        limit = rs.LIST_PAGE_LIMIT if page_limit is None else page_limit
+        nodes = [W.node_default(i) for i in range(n_nodes)]
+        _bulk_create(rs, NODES, [(nd.name, nd) for nd in nodes])
+
+        walls_ms: list[float] = []
+        stats0 = dict(rs.relist_stats)
+        t0 = time.perf_counter()
+        truncated = False
+        for _ in range(relists):
+            if time.perf_counter() - t0 > wall_budget_s:
+                truncated = True
+                break
+            t_walk = time.perf_counter()
+            items, rv = rs.list(NODES, limit=limit)
+            walls_ms.append((time.perf_counter() - t_walk) * 1000.0)
+            keys = {k for k, _ in items}
+            if len(items) != n_nodes or len(keys) != n_nodes:
+                raise AssertionError(
+                    f"paged walk parity miss: {len(items)} items / "
+                    f"{len(keys)} distinct keys over {n_nodes} nodes "
+                    f"(rv={rv})"
+                )
+        done = len(walls_ms)
+        pages = rs.relist_stats["pages"] - stats0["pages"]
+        total_bytes = rs.relist_stats["bytes"] - stats0["bytes"]
+        # snapshot BEFORE the unpaged baseline below — limit=0 rides the
+        # same walk accounting as one giant page and would clobber the max
+        max_page_bytes = rs.relist_stats["max_page_bytes"]
+        unpaged_ms = None
+        if not truncated and time.perf_counter() - t0 <= wall_budget_s:
+            t_walk = time.perf_counter()
+            rs.list(NODES, limit=0)     # the legacy single-GET baseline
+            unpaged_ms = (time.perf_counter() - t_walk) * 1000.0
+        return {
+            "nodes": n_nodes,
+            "page_limit": limit,
+            "relists": done,
+            "list_p50_ms": round_latency_ms(
+                float(np.percentile(walls_ms, 50)) if walls_ms else None
+            ),
+            "list_p99_ms": round_latency_ms(
+                float(np.percentile(walls_ms, 99)) if walls_ms else None
+            ),
+            "pages_per_relist": round(pages / done, 2) if done else None,
+            "bytes_per_relist": round(total_bytes / done) if done else None,
+            "max_page_bytes": max_page_bytes,
+            "unpaged_ms": round_latency_ms(unpaged_ms),
+            "wire_codec": rs.wire_codec,
+            "parity_ok": True,
+            "truncated": truncated,
+        }
+    finally:
+        srv.close()
+
+
+def run_trace_multiprocess(
+    profile,
+    replicas: int = 2,
+    partition: str = "lease",
+    wire: str = "binary",
+    engine: str = "greedy",
+    max_batch: int = 128,
+    timeout_s: float = 600.0,
+    stall_s: float = 30.0,
+    speed: float = 1.0,
+    wall_budget_s: float | None = None,
+    handover_at: float | None = 0.5,
+    restart: str = "on-failure:2",
+    child_env: dict | None = None,
+) -> WorkloadResult:
+    """Replay a trace profile against the REAL multi-process federation
+    (ROADMAP 5b): apiserver + ``replicas`` scheduler processes under the
+    launch supervisor, pod arrivals paced by the trace clock through an
+    admin RemoteStore, admission latency measured enqueue→bind from the
+    STORE's observed bindings (polled over the paged list walk — bind
+    timestamps carry up to one poll interval of quantization, well under
+    the seconds-scale SLO budgets these records are judged against).
+
+    ``handover_at`` (0..1 of the trace clock, lease/hash modes): at that
+    point the LAST scheduler replica is SIGKILLed mid-trace — the
+    supervisor's restart policy respawns it and its keyspace rides a
+    lease handover — so ``admission_p99_ms`` spans a forced handover,
+    which is the record's whole point: the SLO price of losing a
+    federated scheduler under live trace load. ``recovery_s`` is
+    kill → every live trace pod bound.
+
+    Supports create_pod/delete_pod/add_node/drain_node events (gang
+    create_group has no REST kind and needs the in-process seam —
+    those profiles raise)."""
+    import os as _os
+
+    from ..apiserver import RemoteStore
+    from ..client.informers import NODES, PODS
+    from ..launch import Cluster
+
+    if isinstance(profile, str):
+        profile = W.TRACE_PROFILES[profile]
+    events = profile.events()
+    unsupported = {e.kind for e in events} - {
+        "create_pod", "delete_pod", "add_node", "drain_node",
+    }
+    if unsupported:
+        raise NotImplementedError(
+            f"multi-process trace replay does not drive {unsupported}"
+        )
+    if handover_at is not None and replicas < 2:
+        raise ValueError("handover_at requires replicas >= 2")
+    trace_len_s = events[-1].at_s if events else 0.0
+
+    import kubetpu as _pkg
+
+    repo_root = _os.path.dirname(_os.path.dirname(_os.path.abspath(
+        _pkg.__file__
+    )))
+    cluster = Cluster(
+        replicas=replicas, partition=partition, wire=wire, engine=engine,
+        max_batch=max_batch, restart=restart, env=child_env,
+        cwd=repo_root,
+    )
+    cluster.start()
+    truncated = False
+    killed = False
+    t_kill: float | None = None
+    recovery_s: float | None = None
+    created_at: dict[str, float] = {}
+    deleted: set[str] = set()
+    bind_time: dict[str, float] = {}
+    try:
+        admin = RemoteStore(cluster.api_url, wire=wire)
+        nodes = [W.node_default(i, profile.zones)
+                 for i in range(profile.nodes)]
+        _bulk_create(admin, NODES, [(nd.name, nd) for nd in nodes])
+
+        _POLL_S = 0.05
+        poll_last = [0.0]
+
+        def poll_bound(now: float, force: bool = False) -> int:
+            """Stamp bind times for newly-bound trace pods off a store
+            list (rides the paged walk). Throttled — the poll is the
+            measurement's read load, not a busy loop."""
+            if not force and now - poll_last[0] < _POLL_S:
+                return 0
+            poll_last[0] = now
+            items, _rv = admin.list(PODS)
+            stamp = time.perf_counter()
+            fresh = 0
+            for key, pod in items:
+                if pod.node_name and key in created_at \
+                        and key not in bind_time:
+                    bind_time[key] = stamp
+                    fresh += 1
+            return fresh
+
+        def live_unbound() -> int:
+            return sum(
+                1 for k in created_at
+                if k not in deleted and k not in bind_time
+            )
+
+        t0 = time.perf_counter()
+        deadline = t0 + timeout_s
+        wall_deadline = (
+            t0 + wall_budget_s if wall_budget_s is not None else None
+        )
+        i = 0
+        last_progress = t0
+        while True:
+            now = time.perf_counter()
+            if (wall_deadline is not None and now > wall_deadline) \
+                    or now > deadline:
+                truncated = True
+                break
+            trace_now = (now - t0) * speed
+            fired = 0
+            while i < len(events) and events[i].at_s <= trace_now:
+                ev = events[i]
+                i += 1
+                fired += 1
+                if ev.kind == "create_pod":
+                    key = f"{ev.namespace}/{ev.name}"
+                    admin.create(PODS, key, W.build_trace_pod(ev))
+                    created_at[key] = time.perf_counter()
+                elif ev.kind == "delete_pod":
+                    key = f"{ev.namespace}/{ev.name}"
+                    deleted.add(key)
+                    try:
+                        admin.delete(PODS, key)
+                    except Exception:
+                        pass    # already gone / rebound — the trace goes on
+                elif ev.kind == "add_node":
+                    admin.create(NODES, ev.name,
+                                 make_trace_node(ev.name, profile.zones))
+                elif ev.kind == "drain_node":
+                    try:
+                        admin.delete(NODES, ev.name)
+                    except Exception:
+                        pass
+            if (
+                handover_at is not None and not killed
+                and trace_now >= handover_at * trace_len_s
+            ):
+                cluster.kill_replica(len(cluster.schedulers) - 1)
+                killed = True
+                t_kill = time.perf_counter()
+            fresh = poll_bound(now)
+            progressed = bool(fired or fresh)
+            if i >= len(events):
+                if live_unbound() == 0:
+                    break
+                if progressed:
+                    last_progress = now
+                elif now - last_progress > stall_s:
+                    break
+                else:
+                    time.sleep(0.02)
+            elif progressed:
+                last_progress = now
+            else:
+                time.sleep(min(0.02, max(0.0, (
+                    events[i].at_s / speed + t0 - now
+                ))))
+        poll_bound(time.perf_counter(), force=True)
+        t_end = time.perf_counter()
+        duration = t_end - t0
+        unbound = live_unbound()
+        if t_kill is not None and unbound == 0:
+            recovery_s = t_end - t_kill
+
+        conflicts = 0.0
+        attempts = 0.0
+        lease_transitions = 0.0
+        for diag_url in cluster.scheduler_diag_urls():
+            parsed = _scrape_metrics(diag_url)
+            conflicts += _sum_samples(
+                parsed, "scheduler_federation_conflicts_total"
+            )
+            attempts += _sum_samples(
+                parsed, "scheduler_schedule_attempts_total",
+                result="scheduled",
+            )
+            lease_transitions += _sum_samples(
+                parsed, "scheduler_federation_lease_transitions_total"
+            )
+        wire_codec = admin.wire_codec
+        n_processes = cluster.n_processes()
+        restarts = cluster.supervisor.restarts_total()
+
+        def verify_parity() -> None:
+            if live_unbound():
+                raise ParityError(
+                    f"binding parity miss: {live_unbound()} live trace "
+                    f"pods unbound (replicas={replicas}, "
+                    f"partition={partition}, killed={killed}, "
+                    f"restarts={restarts})"
+                )
+
+        # a clean full replay joins on the strict store-verified parity;
+        # a truncated/stalled one records its unbound count honestly via
+        # slo_ok=False instead of turning an SLO record into a crash
+        cluster.join(
+            verify=verify_parity if (not truncated and unbound == 0)
+            else None
+        )
+        child_stats = cluster.supervisor.child_stats()
+    finally:
+        cluster.shutdown()
+
+    lats = [
+        (bind_time[k] - created_at[k]) * 1000.0
+        for k in created_at if k in bind_time
+    ]
+    p50 = float(np.percentile(lats, 50)) if lats else None
+    p99 = float(np.percentile(lats, 99)) if lats else None
+    throughput = len(lats) / duration if duration > 0 else 0.0
+    return WorkloadResult(
+        case_name=f"TraceFederation_{profile.name}",
+        workload_name=(
+            f"{profile.nodes}Nodes_mp_{replicas}sched_{partition}"
+        ),
+        threshold=None,
+        measure_pods=len(created_at),
+        scheduled=len(lats),
+        duration_s=duration,
+        throughput=throughput,
+        vs_threshold=None,
+        attempts=int(attempts),
+        cycles=0,
+        wire_codec=wire_codec,
+        replicas=replicas,
+        partition=partition,
+        conflicts=int(conflicts),
+        conflict_rate=(conflicts / attempts) if attempts else 0.0,
+        lease_transitions=int(lease_transitions),
+        binding_parity=len(bind_time),
+        recovery_s=recovery_s,
+        n_processes=n_processes,
+        child_stats=child_stats,
+        restarts=restarts,
+        admission_p50_ms=p50,
+        admission_p99_ms=p99,
+        slo_budget_ms=profile.slo_budget_ms,
+        slo_ok=(
+            p99 is not None and p99 <= profile.slo_budget_ms
+            and unbound == 0 and not truncated
+        ),
+        truncated=truncated,
+        trace_stats={
+            "profile": profile.name,
+            "seed": profile.seed,
+            "events": len(events),
+            "fired": i,
+            "created": len(created_at),
+            "deleted": len(deleted),
+            "unbound": unbound,
+            "samples": len(lats),
+            "handover": killed,
+            "handover_at_s": (
+                round(t_kill - t0, 3) if t_kill is not None else None
+            ),
+        },
     )
 
 

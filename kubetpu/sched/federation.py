@@ -670,8 +670,16 @@ class ReplicaMembership:
         this rank's backlog, including after a supervisor respawn."""
         if self.leases is None:
             return
+        t0 = self.leases.transitions
         changed = self.leases.tick(self._target_share())
         prom = sched.metrics.prom
+        moved = self.leases.transitions - t0
+        if moved:
+            # same accounting as SchedulerFederation._tick_replica — the
+            # mp handover evidence reads this counter off /metrics
+            prom.federation_lease_transitions.labels(
+                self.mode, self.replica_id
+            ).inc(moved)
         prom.federation_partitions_owned.labels(
             self.mode, self.replica_id
         ).set(len(self.leases.owned()))

@@ -140,7 +140,11 @@ class _PyCore:
 
     def __init__(self, history: int = 8192) -> None:
         self._rv = 0
-        self._objects: dict[tuple[str, str], tuple[Any, int]] = {}
+        # (obj, rv, seq) — seq is the insertion order (stable across
+        # updates), the paged list walk's cursor axis; matches the native
+        # core's Entry.seq
+        self._objects: dict[tuple[str, str], tuple[Any, int, int]] = {}
+        self._seq = 0
         self._events: collections.deque = collections.deque(maxlen=history)
         self._compacted_through = 0
         self._body_hits = [0, 0]      # per codec id (0 json, 1 binary)
@@ -155,7 +159,8 @@ class _PyCore:
         if (kind, key) in self._objects:
             raise KeyError(f"{kind}/{key} already exists")
         self._rv += 1
-        self._objects[(kind, key)] = (obj, self._rv)
+        self._seq += 1
+        self._objects[(kind, key)] = (obj, self._rv, self._seq)
         self._emit(0, kind, key, obj)
         return self._rv
 
@@ -169,7 +174,12 @@ class _PyCore:
                     f"{have if got is not None else 'absent'}"
                 )
         self._rv += 1
-        self._objects[(kind, key)] = (obj, self._rv)
+        if got is None:
+            self._seq += 1
+            seq = self._seq
+        else:
+            seq = got[2]                 # updates do not reorder
+        self._objects[(kind, key)] = (obj, self._rv, seq)
         self._emit(0 if got is None else 1, kind, key, obj)
         return self._rv
 
@@ -183,13 +193,13 @@ class _PyCore:
 
     def get(self, kind: str, key: str):
         got = self._objects.get((kind, key))
-        return (None, 0) if got is None else got
+        return (None, 0) if got is None else (got[0], got[1])
 
     def list(self, kind: str, label_terms: tuple = (),
              field_terms: tuple = ()):
         items = [
             (key, obj)
-            for (k, key), (obj, _rv) in self._objects.items()
+            for (k, key), (obj, _rv, _seq) in self._objects.items()
             if k == kind
         ]
         if label_terms or field_terms:
@@ -200,6 +210,45 @@ class _PyCore:
                 if object_matches_selectors(o, label_terms, field_terms)
             ]
         return items, self._rv
+
+    def list_page(self, kind: str, label_terms: tuple = (),
+                  field_terms: tuple = (), limit: int = 0,
+                  after_seq: int = 0, through_seq: int = 0):
+        """One bounded page of the seq-ordered list walk — the pagination
+        primitive behind ``MemStore._list_page_locked``; returns
+        ``(items [(key, obj, rv)], store_rv, next_seq, has_more,
+        through_seq)``. Seq order is insertion order and updates never
+        reorder, so a walk resumed at ``next_seq`` can neither duplicate
+        nor skip an object that existed across the whole walk.
+        ``through_seq`` caps the walk at a seq bound so objects CREATED
+        mid-walk never splice into later pages (the snapshot-cut half of
+        the continue-token contract); ``through_seq <= 0`` captures the
+        current max seq and echoes it back for the caller's token.
+        ``limit <= 0`` is unbounded (the full-list form).
+        Selector-filtered candidates still advance ``next_seq`` (a
+        filtered walk always makes progress); ``has_more`` reports
+        whether any in-bound candidate of the kind remains past this
+        page."""
+        matcher = None
+        if label_terms or field_terms:
+            from ..api.selectors import object_matches_selectors
+
+            matcher = object_matches_selectors
+        bound = through_seq if through_seq > 0 else self._seq
+        items: list = []
+        next_seq = after_seq
+        has_more = False
+        # dict insertion order IS seq order (updates keep both), so no sort
+        for (k, key), (obj, rv, seq) in self._objects.items():
+            if k != kind or seq <= after_seq or seq > bound:
+                continue
+            if limit > 0 and len(items) >= limit:
+                has_more = True
+                break
+            if matcher is None or matcher(obj, label_terms, field_terms):
+                items.append((key, obj, rv))
+            next_seq = seq
+        return items, self._rv, next_seq, has_more, bound
 
     def _collect_since(self, kind: str | None, rv: int):
         """Ring entries newer than ``rv`` for ``kind`` + the new cursor
@@ -297,7 +346,7 @@ class _PyCore:
         the same list() ordering both cores guarantee."""
         return [
             (kind, key, obj, rv)
-            for (kind, key), (obj, rv) in self._objects.items()
+            for (kind, key), (obj, rv, _seq) in self._objects.items()
         ]
 
     def load_snapshot(self, items, rv: int) -> None:
@@ -307,8 +356,10 @@ class _PyCore:
         snapshot predates everything replayable and must 410 into a full
         relist; the replayed WAL tail then repopulates the ring."""
         self._objects = {
-            (kind, key): (obj, obj_rv) for kind, key, obj, obj_rv in items
+            (kind, key): (obj, obj_rv, seq)
+            for seq, (kind, key, obj, obj_rv) in enumerate(items, start=1)
         }
+        self._seq = len(self._objects)
         self._rv = rv
         self._events.clear()
         self._compacted_through = rv
@@ -354,6 +405,14 @@ class MemStore:
             raise RuntimeError("native store core unavailable")
         self._core = core_cls(history) if core_cls is not None else _PyCore(history)
         self.native = core_cls is not None
+        # list-walk continuity domain: seqs are only comparable within one
+        # of these. Snapshot loads (crash recovery below, replica
+        # bootstrap/resync) renumber seqs densely, so a continue token
+        # minted before a load could silently skip or duplicate entries
+        # where deletions had left gaps — the token carries this stamp and
+        # the server 410s on mismatch. Random (not monotonic) so a token
+        # that survives a process restart also misses.
+        self._list_gen = int.from_bytes(os.urandom(4), "big") or 1
         # scheme-registry generation the cached wire bodies were encoded
         # under (None until the first body drain); a move flushes the ring
         self._body_gen: "int | None" = None
@@ -698,6 +757,30 @@ class MemStore:
         with self._lock:
             return self._core.get(kind, key)
 
+    @staticmethod
+    def _parse_selectors(label_selector: str, field_selector: str):
+        lt: tuple = ()
+        ft: tuple = ()
+        if label_selector or field_selector:
+            from ..api.selectors import parse_simple_selector
+
+            lt = parse_simple_selector(label_selector)
+            ft = parse_simple_selector(field_selector)
+        return lt, ft
+
+    def _list_page_locked(self, kind: str, lt: tuple, ft: tuple,
+                          limit: int, after_seq: int,
+                          through_seq: int = 0):
+        """THE pagination seam: every full-store list materialization —
+        paged or not — walks the core through here (graftcheck LS001 pins
+        it: a ``core.list``/``core.list_page`` call anywhere else in the
+        apiserver/store modules is an unbounded read the continue-token
+        protocol cannot see). Caller holds the store lock. Returns
+        ``(items [(key, obj, rv)], store_rv, next_seq, has_more,
+        through_seq)``."""
+        return self._core.list_page(kind, lt, ft, limit, after_seq,
+                                    through_seq)
+
     def list(
         self, kind: str,
         label_selector: str = "", field_selector: str = "",
@@ -709,20 +792,54 @@ class MemStore:
         Selector matching runs INSIDE the core (the native list filter):
         the terms are parsed here (a malformed selector 400s before the
         lock) and evaluated per object in the core's list walk."""
-        lt: tuple = ()
-        ft: tuple = ()
-        if label_selector or field_selector:
-            from ..api.selectors import parse_simple_selector
-
-            lt = parse_simple_selector(label_selector)
-            ft = parse_simple_selector(field_selector)
+        lt, ft = self._parse_selectors(label_selector, field_selector)
         with self._lock:
-            return self._core.list(kind, lt, ft)
+            items, rv, _seq, _more, _bound = self._list_page_locked(
+                kind, lt, ft, 0, 0
+            )
+        return [(key, obj) for key, obj, _rv in items], rv
+
+    def list_page(
+        self, kind: str,
+        label_selector: str = "", field_selector: str = "",
+        limit: int = 0, after_seq: int = 0, through_seq: int = 0,
+    ):
+        """One bounded page of the list walk (the apiserver's
+        ``limit``/``continue`` serving path): ``(items [(key, obj, rv)],
+        store_rv, next_seq, has_more, through_seq)``. A walk resumed at
+        ``next_seq`` with the echoed ``through_seq`` bound neither
+        duplicates nor skips an object present across the whole walk AND
+        never splices in an object created after the walk's first page
+        (the bound is the snapshot cut); per-item rvs feed the
+        serialize-once list-item encode cache."""
+        lt, ft = self._parse_selectors(label_selector, field_selector)
+        with self._lock:
+            return self._list_page_locked(kind, lt, ft, limit, after_seq,
+                                          through_seq)
 
     @property
     def resource_version(self) -> int:
         with self._lock:
             return self._core.resource_version()
+
+    @property
+    def compacted_through(self) -> int:
+        """The event ring's compaction horizon — the continue-token
+        expiry watermark: a paged walk pinned to a snapshot rv below this
+        can no longer promise a gapless watch-from-snapshot resume, so
+        the server 410s the token into a fresh walk."""
+        with self._lock:
+            return self._core.compacted_through()
+
+    @property
+    def list_generation(self) -> int:
+        """The seq-continuity domain stamp continue tokens carry. A
+        snapshot load (crash recovery, replica bootstrap/resync)
+        renumbers seqs densely, so a cursor from before the load is
+        meaningless even when its snapshot rv clears the compaction
+        horizon — the server 410s a token whose stamp mismatches."""
+        with self._lock:
+            return self._list_gen
 
     # -------------------------------------------------------------- watch
     def watch(
@@ -879,6 +996,9 @@ class MemStore:
                     "load_replica_snapshot on a non-follower store"
                 )
             self._core.load_snapshot(list(items), rv)
+            # the load renumbered seqs — invalidate every outstanding
+            # continue token (they 410 into a fresh walk)
+            self._list_gen = int.from_bytes(os.urandom(4), "big") or 1
             self._lock.notify_all()
 
     def promote(self) -> int:

@@ -257,17 +257,31 @@ class FollowerReplicator:
                  grace_s: float = 6.0,
                  lease_duration_s: float = 5.0,
                  clock: Callable[[], float] = default_clock,
-                 elect: bool = True) -> None:
+                 elect: bool = True,
+                 upstream_url: str = "") -> None:
         """``peers``: every apiserver URL in the cluster (leader +
         followers, self included) — the election's electorate. ``elect``
         False pins this replica as a permanent follower (it re-targets a
-        new leader but never promotes)."""
+        new leader but never promotes). ``upstream_url``: CHAINED
+        shipping — tail this peer (another follower re-serving the feed)
+        instead of the leader, so the leader's replication egress is
+        O(direct fan-out) instead of O(followers). Writes still redirect
+        to ``leader_url`` and elections still canvas ``peers``; a stale
+        (fenced-epoch) or unreachable upstream falls this replica back to
+        tailing the leader directly — chaining is an egress optimization,
+        never a correctness dependency."""
         from ..sched.leaderelection import LeaderElector, StoreLeaseClient
 
         if not store.follower:
             raise ValueError("FollowerReplicator needs a follower store")
         self.store = store
         self.leader_url = leader_url.rstrip("/")
+        self.upstream_url = upstream_url.rstrip("/")
+        if self.upstream_url in (self.leader_url, self_url.rstrip("/")):
+            self.upstream_url = ""      # self/leader chains degenerate
+        #: where the tail/bootstrap GETs actually go (the chain link);
+        #: cleared back to the leader on a stale or dead upstream
+        self._tail_base = self.upstream_url or self.leader_url
         self.wire = wire
         self.self_url = self_url.rstrip("/")
         self.peers = tuple(p.rstrip("/") for p in peers)
@@ -311,6 +325,7 @@ class FollowerReplicator:
         self.stale_refusals = 0
         self.gap_resyncs = 0
         self.promotions = 0
+        self.upstream_fallbacks = 0
         self._last_contact = clock()
         self._bootstrapped = False
 
@@ -355,19 +370,35 @@ class FollowerReplicator:
         return ep
 
     # -------------------------------------------------------- tail follow
+    def _fallback_to_leader(self, why: str) -> None:
+        """Abandon a chained upstream and tail the leader directly (the
+        chain is an optimization — a stale or dead link must never stall
+        this replica's reads). One-way for this process's lifetime: the
+        topology degrades to a star, which is always correct."""
+        if self._tail_base == self.leader_url:
+            return
+        self._client.drop(self._tail_base)
+        self._tail_base = self.leader_url
+        with self._mu:
+            self.upstream_fallbacks += 1
+        self._last_contact = self.clock()   # re-arm the election grace
+
     def _bootstrap(self) -> None:
-        """Full resync: load the leader's snapshot wholesale (watchers on
-        this replica take the bounded 410 relist — recovery's contract)."""
+        """Full resync: load the feed's snapshot wholesale (watchers on
+        this replica take the bounded 410 relist — recovery's contract).
+        A chained replica bootstraps from its upstream too — the
+        snapshot egress rides the chain like the log does."""
+        base = self._tail_base
         status, headers, body = self._client.get(
-            self.leader_url, "/replication/snapshot"
+            base, "/replication/snapshot"
         )
         if status != 200:
             raise ReplicationError(
-                f"snapshot bootstrap: HTTP {status} from {self.leader_url}"
+                f"snapshot bootstrap: HTTP {status} from {base}"
             )
         self._note_epoch(headers)
         rv, items = decode_snapshot_stream(
-            body, f"{self.leader_url}/replication/snapshot"
+            body, f"{base}/replication/snapshot"
         )
         self.store.load_replica_snapshot(items, rv)
         with self._mu:
@@ -378,8 +409,9 @@ class FollowerReplicator:
         """One long-poll round: fetch → fence-check → decode → apply →
         measure. Returns records applied."""
         after = self.store.resource_version
+        base = self._tail_base
         status, headers, body = self._client.get(
-            self.leader_url,
+            base,
             f"/replication/log?after={after}"
             f"&timeoutSeconds={self.poll_timeout_s}"
             f"&codec={self.wire}",
@@ -392,7 +424,7 @@ class FollowerReplicator:
             return 0
         if status != 200:
             raise ReplicationError(
-                f"log tail: HTTP {status} from {self.leader_url}"
+                f"log tail: HTTP {status} from {base}"
             )
         self._note_epoch(headers)
         self._last_contact = self.clock()
@@ -409,7 +441,7 @@ class FollowerReplicator:
         faultpoints.fire("rep-post-ship-pre-apply")
         try:
             applied = self.store.apply_replicated_batch(
-                iter_log_stream(body, wire, f"{self.leader_url}/log")
+                iter_log_stream(body, wire, f"{base}/log")
             )
         except ReplicationGapError:
             # the feed skipped revisions (leader compacted under us mid-
@@ -504,9 +536,16 @@ class FollowerReplicator:
     def _retarget(self, url: str, epoch: int) -> None:
         """Follow a new leader (post-failover): adopt its epoch and point
         the tail at it; the rv-gated apply + snapshot resync make the
-        switch safe wherever our cursor lands."""
+        switch safe wherever our cursor lands. A chained upstream is
+        abandoned here — it was a link toward the OLD leader, and any
+        stale feed it still serves would be fenced anyway."""
+        self._client.drop(self._tail_base)
         self._client.drop(self.leader_url)
+        if self._tail_base != self.leader_url:
+            with self._mu:
+                self.upstream_fallbacks += 1
         self.leader_url = url
+        self._tail_base = url
         with self._mu:
             self.observed_epoch = max(self.observed_epoch, epoch)
 
@@ -531,11 +570,22 @@ class FollowerReplicator:
                     pass
                 self._tail_once()
             except StaleEpochError:
+                if self._tail_base != self.leader_url:
+                    # the CHAIN is stale (a link still serving a fenced
+                    # epoch), not necessarily the leader: drop to the
+                    # leader's feed before judging leader liveness
+                    self._fallback_to_leader("stale-epoch")
+                    continue
                 # deposed leader still feeding: find the real one
                 self._try_election()
             except (ConnectionError, TimeoutError, OSError,
                     http.client.HTTPException, ReplicationError,
                     WALError):
+                if self._tail_base != self.leader_url:
+                    # a dead upstream link must not read as leader
+                    # silence — re-tail the leader and re-arm the grace
+                    self._fallback_to_leader("unreachable")
+                    continue
                 if self.clock() - self._last_contact > self.grace_s:
                     if self._try_election():
                         continue
@@ -563,6 +613,13 @@ class FollowerReplicator:
                 "resyncs": self.resyncs,
                 "staleRefusals": self.stale_refusals,
                 "promotions": self.promotions,
+                # chained shipping: where the tail actually points ("" =
+                # the leader itself), and how often a chain link died
+                "upstream": (
+                    self._tail_base
+                    if self._tail_base != self.leader_url else ""
+                ),
+                "upstreamFallbacks": self.upstream_fallbacks,
             }
 
     def metrics_text(self) -> str:
@@ -597,5 +654,12 @@ class FollowerReplicator:
                 "replica last observed (or serves under, once leader).\n"
                 "# TYPE store_replication_epoch gauge\n"
                 f"store_replication_epoch {self.observed_epoch}\n"
+                "# HELP store_replication_upstream_fallbacks_total Times "
+                "this replica abandoned a chained upstream for the "
+                "leader's feed (stale epoch, dead link, or failover).\n"
+                "# TYPE store_replication_upstream_fallbacks_total "
+                "counter\n"
+                f"store_replication_upstream_fallbacks_total "
+                f"{self.upstream_fallbacks}\n"
             ]
         return "".join(lines)

@@ -198,6 +198,22 @@ DEFAULT_RULES: tuple[Rule, ...] = (
         capture_bundle=False,     # the evidence IS the replication status
     ),
     Rule(
+        name="list-lag",
+        kind=LEVEL,
+        series="store_list_lag_records",
+        severity=WARNING,
+        description="rv=0 (bounded-staleness) lists on this follower are "
+                    "being served more than 500 replication records "
+                    "behind the leader — cached reads are stale beyond "
+                    "the declared bound (dormant on unreplicated/leader "
+                    "apiservers: the series is absent there)",
+        threshold=500.0,
+        direction="above",
+        for_intervals=2,
+        resolve_intervals=3,
+        capture_bundle=False,     # the evidence IS the replication status
+    ),
+    Rule(
         name="collector-span-drops",
         kind=DELTA,
         series="kubetpu_collector_spans_dropped_total",

@@ -1,0 +1,42 @@
+"""Known-bad fixture for LS001: store-core list materialization outside
+the pagination seam. Every marked line must be flagged."""
+
+
+class LeakyStore:
+    """A store wrapper that grows unbounded core walks."""
+
+    def __init__(self, core):
+        self._core = core
+
+    def _list_page_locked(self, kind, lt, ft, limit, after_seq):
+        # blessed: THE pagination seam
+        return self._core.list_page(kind, lt, ft, limit, after_seq)
+
+    def dump_everything(self, kind):
+        # a "debug helper" materializing the whole store in one walk
+        return self._core.list(kind)                    # expect: LS001
+
+    def fast_scan(self, kind):
+        core = self._core
+        return core.list(kind, (), ())                  # expect: LS001
+
+    def page_without_seam(self, kind):
+        # even the paged primitive bypasses the seam's lock + budget
+        return self._core.list_page(kind, (), (), 0, 0)  # expect: LS001
+
+    def nested_walk(self, kind):
+        def _inner():
+            return self._core.list(kind)                # expect: LS001
+        return _inner()
+
+
+class _PyCore:
+    """The primitive itself — its own list calls are exempt by class."""
+
+    def list(self, kind, label_terms=(), field_terms=()):
+        return [], 0
+
+    def list_page(self, kind, label_terms=(), field_terms=(),
+                  limit=0, after_seq=0):
+        # a core may compose its own primitives freely
+        return self.list(kind, label_terms, field_terms), 0, 0, False

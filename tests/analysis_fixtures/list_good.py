@@ -1,0 +1,42 @@
+"""Known-good fixture for LS001: seam-respecting list shapes that must
+stay silent."""
+
+
+class SeamedStore:
+    """Every materialization routes through the pagination seam."""
+
+    def __init__(self, core):
+        self._core = core
+        self._lock = None
+
+    def _list_page_locked(self, kind, lt, ft, limit, after_seq):
+        # THE seam: seq-ordered bounded walk, caller holds the lock
+        return self._core.list_page(kind, lt, ft, limit, after_seq)
+
+    def list(self, kind, label_selector="", field_selector=""):
+        items, rv, _seq, _more = self._list_page_locked(
+            kind, (), (), 0, 0
+        )
+        return [(k, o) for k, o, _rv in items], rv
+
+    def list_page(self, kind, label_selector="", field_selector="",
+                  limit=0, after_seq=0):
+        return self._list_page_locked(kind, (), (), limit, after_seq)
+
+    def get(self, kind, key):
+        # non-list core reads are unrestricted
+        return self._core.get(kind, key)
+
+
+class PoliteHandler:
+    """An apiserver-side caller: pages through the PUBLIC store surface
+    (never a core reference)."""
+
+    def __init__(self, store):
+        self.store = store
+
+    def serve_list(self, kind, limit, after_seq):
+        pager = getattr(self.store, "list_page", None)
+        if pager is None:
+            return self.store.list(kind)
+        return pager(kind, limit=limit, after_seq=after_seq)

@@ -446,6 +446,88 @@ def test_trace_records_pass_against_themselves(tmp_path):
     assert not any(d.regression for d in deltas)
 
 
+def _list_line(p99=800.0, bytes_per=2_000_000.0, **extra):
+    out = {
+        "metric": "ListScaling_20000Nodes", "unit": "ms",
+        "value": p99, "list_p99_ms": p99, "list_p50_ms": p99 * 0.8,
+        "pages_per_relist": 40.0, "bytes_per_relist": bytes_per,
+        "max_page_bytes": 60000, "relists": 8, "parity_ok": True,
+        "truncated": False,
+    }
+    out.update(extra)
+    return out
+
+
+def test_list_p99_gates_on_both_relative_and_absolute(tmp_path, capsys):
+    old = load_record(_write(tmp_path, "o.json", [_list_line(p99=50.0)]))
+    # +80% but only +40ms: under the 100ms absolute floor — never gates
+    new_small = load_record(_write(tmp_path, "n1.json",
+                                   [_list_line(p99=90.0)]))
+    d1, _o, _n = compare(old, new_small)
+    l1 = [d for d in d1 if d.field == "list_p99_ms"]
+    assert l1 and not l1[0].regression
+    # +300% AND +150ms: gates (and via the CLI)
+    oldf = _write(tmp_path, "o2.json", [_list_line(p99=50.0)])
+    newf = _write(tmp_path, "n2.json", [_list_line(p99=200.0)])
+    rc = main([oldf, newf])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "list_p99_ms" in out and "REGRESSION" in out
+    # big-rung wobble: +120ms on a 1s walk is under +50% relative — no gate
+    old_big = load_record(_write(tmp_path, "o3.json",
+                                 [_list_line(p99=1000.0)]))
+    new_wob = load_record(_write(tmp_path, "n3.json",
+                                 [_list_line(p99=1120.0)]))
+    d3, _o, _n = compare(old_big, new_wob)
+    l3 = [d for d in d3 if d.field == "list_p99_ms"]
+    assert l3 and not l3[0].regression
+
+
+def test_bytes_per_relist_gates(tmp_path, capsys):
+    old = _write(tmp_path, "o.json", [_list_line(bytes_per=2_000_000.0)])
+    # 3x the wire volume: the serialize-once path broke — gates
+    new = _write(tmp_path, "n.json", [_list_line(bytes_per=6_000_000.0)])
+    rc = main([old, new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "bytes_per_relist" in out and "REGRESSION" in out
+    # +40%: inside the relative tolerance even at MB scale — no gate
+    d, _o, _n = compare(
+        load_record(old),
+        load_record(_write(tmp_path, "n2.json",
+                           [_list_line(bytes_per=2_800_000.0)])),
+    )
+    br = [x for x in d if x.field == "bytes_per_relist"]
+    assert br and not br[0].regression
+    # +60% relative but under the 64KB absolute floor: framing jitter
+    d2, _o, _n = compare(
+        load_record(_write(tmp_path, "o3.json",
+                           [_list_line(bytes_per=50_000.0)])),
+        load_record(_write(tmp_path, "n3.json",
+                           [_list_line(bytes_per=80_000.0)])),
+    )
+    br2 = [x for x in d2 if x.field == "bytes_per_relist"]
+    assert br2 and not br2[0].regression
+
+
+def test_list_scaling_records_pass_against_themselves(tmp_path):
+    """Self-diff pinned green: a ListScaling_* line compares on both
+    list gates (plus the truncated rule) without tripping on an
+    identical record."""
+    rec = _write(tmp_path, "self.json", [
+        _list_line(),
+        _list_line(p99=2400.0, bytes_per=9_000_000.0) | {
+            "metric": "ListScaling_50000Nodes",
+        },
+    ])
+    assert main([rec, rec]) == 0
+    deltas, _o, _n = compare(load_record(rec), load_record(rec))
+    fields = {(d.metric, d.field) for d in deltas}
+    assert ("ListScaling_20000Nodes", "list_p99_ms") in fields
+    assert ("ListScaling_50000Nodes", "bytes_per_relist") in fields
+    assert not any(d.regression for d in deltas)
+
+
 def test_cli_subcommand_dispatch(tmp_path, capsys):
     from kubetpu.cli import main as cli_main
 

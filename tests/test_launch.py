@@ -301,3 +301,51 @@ def test_run_workload_multiprocess_rejects_unknown_ops():
     )
     with pytest.raises(NotImplementedError):
         run_workload_multiprocess(case, case.workloads[0])
+
+
+# ---------------------------------------------------------------------------
+# trace replay against the mp federation (ROADMAP 5b): paced arrivals,
+# forced lease handover, store-observed admission latency
+# ---------------------------------------------------------------------------
+
+def test_run_trace_multiprocess_lease_handover():
+    from kubetpu.perf.runner import run_trace_multiprocess
+    from kubetpu.perf.workloads import TRACE_PROFILES
+
+    prof = TRACE_PROFILES["diurnal-burst"].scaled(
+        "mp-smoke", nodes=6, duration_s=4.0, base_rate=3.0,
+        peak_rate=6.0, bursts=1, burst_pods=4, slo_budget_ms=60000.0,
+    )
+    r = run_trace_multiprocess(
+        prof, replicas=2, partition="lease", max_batch=32,
+        timeout_s=180.0, handover_at=0.5, child_env=CPU_ENV,
+    )
+    created = r.trace_stats["created"]
+    assert created > 0
+    # every live trace pod bound, parity read off the store
+    assert r.trace_stats["unbound"] == 0
+    assert r.binding_parity == created
+    assert r.scheduled == created
+    # the forced handover actually happened: kill recorded mid-trace,
+    # the supervisor respawned the victim, recovery wall measured
+    assert r.trace_stats["handover"] is True
+    assert r.trace_stats["handover_at_s"] is not None
+    assert r.restarts >= 1
+    assert r.recovery_s is not None and r.recovery_s > 0
+    # the SLO record shape: p99 spans the handover, judged vs budget
+    assert r.admission_p99_ms is not None and r.admission_p99_ms > 0
+    assert r.slo_budget_ms == 60000.0
+    assert r.slo_ok is True and not r.truncated
+    assert r.partition == "lease" and r.replicas == 2
+
+
+def test_run_trace_multiprocess_rejects_gang_profiles():
+    from kubetpu.perf.runner import run_trace_multiprocess
+    from kubetpu.perf.workloads import TRACE_PROFILES
+
+    # multitenant emits create_group events — no REST kind, mp replay
+    # must refuse loudly before spawning anything
+    with pytest.raises(NotImplementedError):
+        run_trace_multiprocess(
+            TRACE_PROFILES["multitenant"], replicas=2, handover_at=None,
+        )

@@ -87,6 +87,7 @@ def test_every_checker_registered_and_documented():
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
         "WP001", "WL001", "TR003", "PS001", "EC001", "AL001", "RP001",
+        "LS001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -120,7 +121,7 @@ def test_fixture_violations_match_markers_exactly():
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
     "wal_good.py", "trace_good.py", "proc_good.py", "epoch_good.py",
-    "alert_good.py", "rep_good.py",
+    "alert_good.py", "rep_good.py", "list_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -162,6 +163,42 @@ def test_replication_seam_checker_covers_store_and_replicator():
     for f in ("kubetpu/store/memstore.py", "kubetpu/store/replication.py"):
         assert f in res.files, f"{f} missing from the analysis walk"
         assert f in covered, f"{f} dropped out of RP001 scope"
+
+
+def test_list_seam_checker_covers_store_and_apiserver():
+    """PR 18: the paginated read plane's materialization files stay
+    inside LS001's scope — a rename/move of the store or apiserver
+    modules must fail here instead of silently un-checking the page
+    seam — and the guarded seam is really there: _list_page_locked
+    still exists in memstore.py and still walks the core's paged
+    primitive (a refactor away from it would leave LS001 guarding
+    air while unbounded walks crept back)."""
+    res = _repo_result()
+    covered = set(res.coverage.get("LS001", ()))
+    for f in (
+        "kubetpu/store/memstore.py",
+        "kubetpu/apiserver/server.py",
+        "kubetpu/apiserver/remote.py",
+    ):
+        assert f in res.files, f"{f} missing from the analysis walk"
+        assert f in covered, f"{f} dropped out of LS001 scope"
+    src = open(
+        os.path.join(REPO, "kubetpu", "store", "memstore.py"),
+        encoding="utf-8",
+    ).read()
+    tree = ast.parse(src)
+    seam = [
+        n for n in ast.walk(tree)
+        if isinstance(n, ast.FunctionDef)
+        and n.name == "_list_page_locked"
+    ]
+    assert seam, "memstore.py lost _list_page_locked — LS001 guards air"
+    pagers = [
+        n for n in ast.walk(seam[0])
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute)
+        and n.func.attr == "list_page"
+    ]
+    assert pagers, "_list_page_locked no longer pages the core"
 
 
 def test_clock_checker_covers_lease_backoff_files():
