@@ -89,6 +89,19 @@ STAGES = [
     ("TopologySpreading", "5000Nodes_5000Pods", "greedy", "fullstack", 1024, False, True, False),
     ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "fullstack", 1024, False, True, False),
     ("SchedulingWithMixedChurn", "5000Nodes_10000Pods", "greedy", "direct", 1024, False, True, False),
+    # the utilization-vs-throughput frontier (PR 19): the skewed-size +
+    # priority-tier bin-pack workload once per engine — the three rows feed
+    # one PackingComparison_* line per (workload, mode): packing must cut
+    # nodes_used_at_steady_state ≥10% vs greedy while holding ≥0.8× the
+    # batched engine's pods/s (the acceptance frontier), with the
+    # priority_slo_hit_rate and warm-solver solver_iters_per_cycle evidence
+    # riding every packing row
+    ("BinPacking", "1000Nodes_3000Pods", "greedy", "direct", 256, False, True, False),
+    ("BinPacking", "1000Nodes_3000Pods", "batched", "direct", 256, False, True, False),
+    ("BinPacking", "1000Nodes_3000Pods", "packing", "direct", 256, False, True, False),
+    ("BinPacking", "200Nodes", "greedy", "fullstack", 128, False, True, False),
+    ("BinPacking", "200Nodes", "batched", "fullstack", 128, False, True, False),
+    ("BinPacking", "200Nodes", "packing", "fullstack", 128, False, True, False),
     # the mesh tier AFTER every previously-judged acceptance row (each 15k
     # stage can burn its full 300s timeout — it must not push judged rows
     # past the budget cutoff): 15k nodes — the cluster size one chip can't
@@ -226,6 +239,11 @@ TRACE_STAGES = [
      128, "greedy", 180.0),
     ("rolling-update", "2k", dict(nodes=2000), 128, "greedy", 150.0),
     ("multitenant", "2k", dict(nodes=2000), 128, "greedy", 180.0),
+    # the packing rung on the PR-14 mixed-tenant trace: priority tiers +
+    # gangs + spread under churn through the constraint solver — the
+    # record's solver_iters_per_cycle is the warm-start-under-churn
+    # evidence benchdiff gates (+50%)
+    ("multitenant", "2k-packing", dict(nodes=2000), 128, "packing", 180.0),
     # the scale rungs: 50k direct (burst + node-wave — the acceptance
     # pair), then the 100k attempt (expected to brush its wall on small
     # hosts; the truncated record is the honest evidence). Budgets are
@@ -481,6 +499,17 @@ def run_stage(
         out["encode_cache_hit_rate"] = round(r.encode_cache_hit_rate, 4)
     if r.threshold_note:
         out["threshold_note"] = r.threshold_note
+    # the packing-frontier evidence (PR 19): steady-state node footprint,
+    # high-priority admission rate, warm-started solver iterations, and
+    # the exact weight vector the run solved under (reproducibility)
+    if r.nodes_used_at_steady_state is not None:
+        out["nodes_used_at_steady_state"] = r.nodes_used_at_steady_state
+    if r.priority_slo_hit_rate is not None:
+        out["priority_slo_hit_rate"] = round(r.priority_slo_hit_rate, 4)
+    if r.solver_iters_per_cycle is not None:
+        out["solver_iters_per_cycle"] = round(r.solver_iters_per_cycle, 2)
+    if r.packing_weights is not None:
+        out["packing_weights"] = r.packing_weights
     if r.p99_attempt_latency_ms is not None:
         # rounded in ONE place (perf.runner.round_latency_ms), identically
         # to WorkloadResult.to_json — benchdiff between a runner emission
@@ -569,6 +598,15 @@ CPU_FALLBACK_STAGES = [
     ("SchedulingWithMixedChurn", "1000Nodes", "greedy", "direct", 128, False, True, False),
     ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, True, True, False),
     ("SchedulingPodAffinity", "500Nodes", "greedy", "direct", 128, False, True, False),
+    # the PackingComparison frontier at the reduced CPU shape: three-way
+    # direct plus the greedy/packing fullstack pair (batched fullstack is
+    # dropped on the fallback — the frontier's throughput denominator is
+    # the direct batched row)
+    ("BinPacking", "200Nodes", "greedy", "direct", 128, False, True, False),
+    ("BinPacking", "200Nodes", "batched", "direct", 128, False, True, False),
+    ("BinPacking", "200Nodes", "packing", "direct", 128, False, True, False),
+    ("BinPacking", "200Nodes", "greedy", "fullstack", 128, False, True, False),
+    ("BinPacking", "200Nodes", "packing", "fullstack", 128, False, True, False),
 ]
 
 
@@ -744,6 +782,58 @@ def _emit_sharding_comparisons(done: dict) -> None:
                 meshed["value"] / single["value"], 3
             )
             line["value"] = line["throughput_speedup"]
+        _emit(line)
+
+
+def _emit_packing_comparisons(trios: dict) -> None:
+    """One PackingComparison line per (case, workload, mode) that ran the
+    greedy baseline AND the packing engine (batched joins when its row
+    ran): the utilization-vs-throughput frontier — nodes_reduction vs
+    greedy (acceptance ≥0.10), pods/s vs the batched engine (acceptance
+    ≥0.8×), priority hit rate side by side, and the warm-started solver's
+    iterations/cycle — embedded in the bench artifact itself."""
+    fields = (
+        "value", "nodes_used_at_steady_state", "priority_slo_hit_rate",
+        "solver_iters_per_cycle", "duration_s",
+    )
+    for key, by_engine in sorted(trios.items()):
+        g, p = by_engine.get("greedy"), by_engine.get("packing")
+        if not g or not p or "error" in g or "error" in p:
+            continue
+        case, workload, mode = key
+        b = by_engine.get("batched")
+        if b is not None and "error" in b:
+            b = None
+        line = {
+            "metric": f"PackingComparison_{case}_{workload}",
+            "unit": "ratio",
+            "mode": mode,
+            "backend": p.get("backend"),
+            "greedy": {k: g.get(k) for k in fields
+                       if g.get(k) is not None},
+            "packing": {k: p.get(k) for k in fields
+                        if p.get(k) is not None},
+        }
+        if b is not None:
+            line["batched"] = {k: b.get(k) for k in fields
+                               if b.get(k) is not None}
+        if p.get("packing_weights") is not None:
+            line["packing_weights"] = p["packing_weights"]
+        g_nodes = g.get("nodes_used_at_steady_state")
+        p_nodes = p.get("nodes_used_at_steady_state")
+        if g_nodes and p_nodes is not None:
+            # the ≥10% acceptance number: steady-state nodes saved
+            line["nodes_reduction"] = round(1.0 - p_nodes / g_nodes, 4)
+            line["value"] = line["nodes_reduction"]
+        if g.get("value") and p.get("value"):
+            line["throughput_vs_greedy"] = round(
+                p["value"] / g["value"], 3
+            )
+        if b is not None and b.get("value") and p.get("value"):
+            # the ≥0.8× acceptance number: pods/s held vs the fast engine
+            line["throughput_vs_batched"] = round(
+                p["value"] / b["value"], 3
+            )
         _emit(line)
 
 
@@ -1987,6 +2077,8 @@ def main() -> None:
     mesh_pairs: dict = {}
     # (case, workload, engine, mode) -> {flight_recorder: result line}
     fr_pairs: dict = {}
+    # (case, workload, mode) -> {engine: result line} (PackingComparison)
+    packing_trios: dict = {}
     all_lines: list = []
     for stage in STAGES:
         # the optional 9th slot is flight_recorder (default on); only the
@@ -2059,6 +2151,10 @@ def main() -> None:
             mesh_pairs.setdefault(
                 (case, workload, engine, mode, pipeline, bulk), {}
             )[mesh] = line
+        if not mesh and not pipeline and bulk and flight_recorder:
+            packing_trios.setdefault(
+                (case, workload, mode), {}
+            )[engine] = line
         all_lines.append(line)
         _emit(line)
         _status(f"stage done: {line['metric']} = {line['value']} pods/s "
@@ -2075,6 +2171,7 @@ def main() -> None:
     _emit_api_plane_comparisons(api_pairs)
     _emit_sharding_comparisons(mesh_pairs)
     _emit_flightrecorder_comparisons(fr_pairs)
+    _emit_packing_comparisons(packing_trios)
     _emit_soak_lines(all_lines)
     # the scale-frontier trace ladder right after the judged in-process
     # rows: its own budget, and every rung is wall-capped so the 100k

@@ -24,11 +24,17 @@ BLESSED_SEAMS: dict[str, set[str]] = {
         "_scatter_routed", "refresh",
         # encode/finalize seam: the ONE batched device_put per cycle
         "encode_batch", "finalize_batch",
+        # packing-dual cold start (PR 19): ships a zeros λ vector once per
+        # padded node count (or after a mesh rebind); steady-state cycles
+        # keep λ resident via donation and never re-transfer it
+        "duals",
     },
     "parallel/mesh.py": {
         # the whole-batch sharded placement and the one-shot probes
         "shard_batch", "pod_scan_collective_ok",
         "measure_collective_wall",
+        # one-shot sharded packing solve (cold λ placement, PR 19)
+        "sharded_packing",
     },
 }
 

@@ -8,6 +8,9 @@ outer loop (``ScheduleOne``, schedule_one.go:67).
 - ``sinkhorn``: capacity-coupled batched assignment (LP-relaxed bin-pack via
   entropic OT) — the throughput mode; diffed against greedy by the parity
   harness.
+- ``packing``: constraint-based packing (penalized LP-relaxation of the
+  bin-pack, warm-started duals) — cluster-level objectives (nodes used,
+  priority-weighted admission); hard constraints stay exact.
 """
 
 from .greedy import greedy_assign, greedy_assign_device  # noqa: F401

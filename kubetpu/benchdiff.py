@@ -84,6 +84,20 @@ Comparison rules (per metric name present in BOTH records):
   ``old * (1 + relist_bytes_tol)`` AND grew by more than
   ``min_relist_bytes_delta`` absolute (a codec change that re-inflated
   the serialize-once list path gates; framing jitter never does).
+- **packing node footprint** (``nodes_used_at_steady_state`` on the
+  ``BinPacking_*`` rows and ``PackingComparison_*`` lines): regression
+  when the steady-state node count exceeds ``old * (1 + nodes_used_tol)``
+  AND grew by more than ``min_nodes_used_delta`` nodes absolute (a node
+  or two of small-shape wobble never gates; a packing engine that quietly
+  lost its consolidation does).
+- **priority admission** (``priority_slo_hit_rate`` on the same rows — the
+  share of high-priority measured pods that bound): a drop of more than
+  ``min_priority_rate_delta`` absolute gates, no relative rule — the
+  workloads behind it are deterministic, so the rate is not noisy.
+- **packing solver iterations** (``solver_iters_per_cycle``): regression
+  when the new mean exceeds ``old * (1 + solver_iters_tol)`` — the
+  fixed-point loop is cheap per iteration, so what gates is the warm
+  start silently degrading back to cold solves every cycle.
 - **peak RSS** (``peak_rss_bytes``): regression only when BOTH +50%
   relative AND >256MB absolute — host allocator noise never gates, a
   node-axis layout that regressed into gigabytes at 100k nodes does.
@@ -166,6 +180,20 @@ MIN_LIST_DELTA_MS = 100.0
 #: absolute floor catches a list path that stopped serializing once
 RELIST_BYTES_TOL = 0.50
 MIN_RELIST_BYTES_DELTA = 64 * 1024.0
+#: steady-state node footprint (nodes_used_at_steady_state on the packing
+#: rows): the frontier's utilization number — gate only a move that is
+#: BOTH +10% relative AND >5 nodes absolute, so a node of wobble on the
+#: small CPU shape never gates while a lost consolidation does
+NODES_USED_TOL = 0.10
+MIN_NODES_USED_DELTA = 5.0
+#: priority admission rate is a FRACTION (0..1) over a deterministic
+#: workload — any drop past 0.05 absolute is high-priority pods left
+#: pending, not host noise; no relative rule
+MIN_PRIORITY_RATE_DELTA = 0.05
+#: warm-started solver iterations per cycle: +50% relative — the loop is
+#: cheap per iteration, so the gate exists for a warm start that silently
+#: degraded back to cold solves every cycle
+SOLVER_ITERS_TOL = 0.50
 #: peak RSS is host-noise-prone (allocator, import order): gate only a
 #: move that is BOTH +50% relative AND >256MB absolute
 RSS_TOL = 0.50
@@ -290,6 +318,10 @@ def compare(
     min_list_delta_ms: float = MIN_LIST_DELTA_MS,
     relist_bytes_tol: float = RELIST_BYTES_TOL,
     min_relist_bytes_delta: float = MIN_RELIST_BYTES_DELTA,
+    nodes_used_tol: float = NODES_USED_TOL,
+    min_nodes_used_delta: float = MIN_NODES_USED_DELTA,
+    min_priority_rate_delta: float = MIN_PRIORITY_RATE_DELTA,
+    solver_iters_tol: float = SOLVER_ITERS_TOL,
     rss_tol: float = RSS_TOL,
     min_rss_delta_bytes: float = MIN_RSS_DELTA_BYTES,
 ) -> tuple[list[Delta], list[str], list[str]]:
@@ -526,6 +558,44 @@ def compare(
                     if bad else ""
                 ),
             ))
+        # the packing frontier's three gates (PR 19): steady-state node
+        # footprint (relative + absolute), high-priority admission rate
+        # (absolute only — the workload is deterministic), and the warm
+        # solver's iterations/cycle (relative only — cheap per iteration)
+        onu, nnu = (o.get("nodes_used_at_steady_state"),
+                    n.get("nodes_used_at_steady_state"))
+        if isinstance(onu, (int, float)) and isinstance(nnu, (int, float)):
+            bad = (
+                nnu > onu * (1.0 + nodes_used_tol)
+                and (nnu - onu) > min_nodes_used_delta
+            )
+            deltas.append(Delta(
+                name, "nodes_used_at_steady_state",
+                float(onu), float(nnu), bad,
+                note=(
+                    f"[tol +{nodes_used_tol:.0%} & "
+                    f">{min_nodes_used_delta:g} nodes]" if bad else ""
+                ),
+            ))
+        opr, npr = (o.get("priority_slo_hit_rate"),
+                    n.get("priority_slo_hit_rate"))
+        if isinstance(opr, (int, float)) and isinstance(npr, (int, float)):
+            bad = (opr - npr) > min_priority_rate_delta
+            deltas.append(Delta(
+                name, "priority_slo_hit_rate", float(opr), float(npr), bad,
+                note=(
+                    f"[drop >{min_priority_rate_delta:g} absolute]"
+                    if bad else ""
+                ),
+            ))
+        osi, nsi = (o.get("solver_iters_per_cycle"),
+                    n.get("solver_iters_per_cycle"))
+        if isinstance(osi, (int, float)) and isinstance(nsi, (int, float)):
+            bad = osi > 0 and nsi > osi * (1.0 + solver_iters_tol)
+            deltas.append(Delta(
+                name, "solver_iters_per_cycle", float(osi), float(nsi), bad,
+                note=f"[tol +{solver_iters_tol:.0%}]" if bad else "",
+            ))
         # peak RSS: both +50% relative AND >256MB absolute (host noise on
         # small stages never gates; a 100k-node rung whose node-axis
         # layout regressed into gigabytes does)
@@ -670,6 +740,21 @@ def main(argv=None) -> int:
                     help="absolute bytes-per-relist growth floor below "
                          "which it never gates (default "
                          f"{MIN_RELIST_BYTES_DELTA:g})")
+    ap.add_argument("--nodes-used-tol", type=float, default=NODES_USED_TOL,
+                    help="fractional steady-state node-footprint growth "
+                         f"tolerated (default {NODES_USED_TOL})")
+    ap.add_argument("--min-nodes-used-delta", type=float,
+                    default=MIN_NODES_USED_DELTA,
+                    help="absolute node-footprint growth floor below which "
+                         f"it never gates (default {MIN_NODES_USED_DELTA})")
+    ap.add_argument("--min-priority-rate-delta", type=float,
+                    default=MIN_PRIORITY_RATE_DELTA,
+                    help="absolute priority-admission-rate drop tolerated "
+                         f"(default {MIN_PRIORITY_RATE_DELTA})")
+    ap.add_argument("--solver-iters-tol", type=float,
+                    default=SOLVER_ITERS_TOL,
+                    help="fractional solver-iterations-per-cycle growth "
+                         f"tolerated (default {SOLVER_ITERS_TOL})")
     ap.add_argument("--rss-tol", type=float, default=RSS_TOL,
                     help="fractional peak-RSS growth tolerated "
                          f"(default {RSS_TOL})")
@@ -713,6 +798,10 @@ def main(argv=None) -> int:
         min_list_delta_ms=args.min_list_delta_ms,
         relist_bytes_tol=args.relist_bytes_tol,
         min_relist_bytes_delta=args.min_relist_bytes_delta,
+        nodes_used_tol=args.nodes_used_tol,
+        min_nodes_used_delta=args.min_nodes_used_delta,
+        min_priority_rate_delta=args.min_priority_rate_delta,
+        solver_iters_tol=args.solver_iters_tol,
         rss_tol=args.rss_tol,
         min_rss_delta_bytes=args.min_rss_delta_bytes,
     )

@@ -1249,6 +1249,23 @@ def _render_explain(rec: dict) -> str:
         lines.append("    top nodes: " + "  ".join(
             f"{t['node']}={t['score']}" for t in top
         ))
+    if rec.get("engine") == "packing" and rec.get("objective_value") is not None:
+        # packing rationale: the cluster objective this cycle optimized,
+        # plus the greedy counterfactual — top_nodes[0] is the cycle-start
+        # masked argmax, i.e. what the greedy scan would have picked first
+        line = (
+            f"  packing: objective {rec['objective_value']:.3f}"
+        )
+        if rec.get("solver_iters") is not None:
+            line += f", {rec['solver_iters']} solver iters"
+        counterfactual = top[0]["node"] if top else None
+        if counterfactual and rec.get("node"):
+            line += (
+                f"; greedy would pick {counterfactual}"
+                if counterfactual != rec["node"]
+                else "; greedy agrees"
+            )
+        lines.append(line)
     rejected = rec.get("rejected_by")
     if rejected is not None:
         total = rec.get("total_nodes", 0)
@@ -1561,7 +1578,7 @@ def build_parser() -> argparse.ArgumentParser:
     schd.add_argument("--server", required=True, help="API server base URL")
     schd.add_argument("--config", default="", help="KubeSchedulerConfiguration file")
     schd.add_argument("--engine", default="greedy",
-                      choices=["greedy", "batched"])
+                      choices=["greedy", "batched", "packing"])
     schd.add_argument("--pipeline", default="off", choices=["on", "off"],
                       help="two-stage pipelined cycles with a device-"
                            "resident node block and dirty-row delta "
@@ -1896,7 +1913,7 @@ def build_parser() -> argparse.ArgumentParser:
                     help="wire codec for every child (and the 415-fallback "
                          "escape hatch)")
     up.add_argument("--engine", default="greedy",
-                    choices=["greedy", "batched"])
+                    choices=["greedy", "batched", "packing"])
     up.add_argument("--max-batch", type=int, default=1024)
     up.add_argument("--persistence", default="off", metavar="DIR|off",
                     help="apiserver durability dir (WAL + snapshots); the "

@@ -110,6 +110,9 @@ class DeviceBatch:
     # computeScore), signature-compressed like the other static raws
     dra_score_raw: jnp.ndarray | None = None   # (S5, N) int64
     dra_score_sig: jnp.ndarray | None = None   # (P,) int32
+    # per-pod priority column (assign.packing admission order + objective;
+    # None only for hand-built batches — finalize_batch always sets it)
+    pod_priority: jnp.ndarray | None = None     # (P,) int32
 
     # node-block accessors (kernels read b.alloc etc. — the split into a
     # persistent node block is invisible to them)
@@ -560,6 +563,74 @@ class ResidentNodeState:
             alloc=alloc, requested=req, nonzero_requested=nz,
             pod_count=pc, allowed_pods=al, node_valid=vd,
         )
+
+
+class PackingSolverState:
+    """Device-resident dual-variable block for the packing engine — the
+    warm-start twin of :class:`ResidentNodeState`.
+
+    Holds one ``(NC,)`` float32 dual-price vector λ per padded node
+    capacity (the scheduler's warmup ladder touches several bucket sizes;
+    each keeps its own prices). ``duals(n)`` hands the current vector to
+    the solver — zeros on first sight of a capacity (a cold start,
+    counted in ``resets``) — and the solver DONATES it
+    (``packing_assign_device`` donate_argnums), so the caller must
+    ``store(n, …)`` the returned vector back; this class is the only
+    holder by contract, mirroring the resident node block's donation
+    discipline. ``carries`` counts warm handoffs — the warm-start
+    evidence rides ``solver_iters_per_cycle``, these counters attribute
+    it.
+
+    ``mesh``: when the scheduler runs node-axis sharded, λ is placed
+    sharded along the same node axis so the solver's per-node penalty
+    row stays shard-local (``bind_mesh`` — the engine is constructed
+    before the scheduler resolves its mesh, so binding is late)."""
+
+    def __init__(self, mesh=None, axis=None) -> None:
+        self._lam: dict[int, jnp.ndarray] = {}
+        self.resets = 0
+        self.carries = 0
+        self.mesh = None
+        self._sharding = None
+        self.bind_mesh(mesh, axis)
+
+    def bind_mesh(self, mesh, axis=None) -> None:
+        if mesh is self.mesh:
+            return
+        self.mesh = mesh
+        self._sharding = None
+        if mesh is not None:
+            from ..parallel.mesh import node_axes_of
+
+            if axis is None:
+                axis, _ = node_axes_of(mesh)
+            self._sharding = jax.sharding.NamedSharding(
+                mesh, jax.sharding.PartitionSpec(axis)
+            )
+        # duals placed under the old layout are stale; drop them
+        self._lam.clear()
+
+    def duals(self, n: int) -> jnp.ndarray:
+        lam = self._lam.pop(n, None)
+        if lam is None:
+            self.resets += 1
+            lam = jnp.zeros(n, dtype=jnp.float32)
+            if self._sharding is not None:
+                lam = jax.device_put(lam, self._sharding)
+        else:
+            self.carries += 1
+        return lam
+
+    def store(self, n: int, lam: jnp.ndarray) -> None:
+        self._lam[n] = lam
+
+    def reset(self) -> None:
+        """Drop every price vector (cold-start escape hatch)."""
+        self._lam.clear()
+
+    @property
+    def nbytes(self) -> int:
+        return sum(int(v.nbytes) for v in self._lam.values())
 
 
 def _resource_weights(
@@ -1154,6 +1225,7 @@ def finalize_batch(
         dra_score_sig=(
             sb.dra_score_sig if sb.dra_score_raw is not None else None
         ),
+        pod_priority=pb.priority,
     )
     if mesh is not None:
         from ..parallel.mesh import batch_shardings, node_axes_of

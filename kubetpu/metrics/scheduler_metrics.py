@@ -39,6 +39,15 @@ E2E_STAGES = (
     "e2e",              # ingest (or delivery) -> bind ack
 )
 
+#: the engine registry (Scheduler(engine=…)): the ONLY legal values of the
+#: {engine} label on the packing-objective metric family — declared at
+#: registration and enforced at parse time by graftcheck MR004.
+ENGINES = (
+    "greedy",           # exact reference-semantics per-pod scan
+    "batched",          # capacity-coupled rounds (throughput mode)
+    "packing",          # constraint-based packing (cluster objectives)
+)
+
 
 def window_quantile_ms(
     hist: Histogram, baseline: Histogram | None = None, q: float = 0.99
@@ -153,6 +162,36 @@ class SchedulerMetricsRegistry:
             "Partition leases currently owned by this replica "
             "(lease mode; the ownership rebalance evidence).",
             labels=("mode", "replica"),
+        )
+        # --- packing engine (assign.packing) ------------------------------
+        # cluster-objective telemetry, labeled by the engine that produced
+        # it (today only "packing" reports; greedy/batched leave the whole
+        # family unobserved, which keeps the sentinel's solver-iteration
+        # rule dormant for them — an absent series extracts to None)
+        self.packing_objective = r.gauge(
+            "scheduler_packing_objective",
+            "Last cycle's packing objective value: priority-weighted "
+            "admission minus the alpha*nodes-opened and beta*fragmentation "
+            "penalties (assign.packing), by engine.",
+            labels=("engine",),
+            declared={"engine": ENGINES},
+        )
+        self.nodes_used = r.gauge(
+            "scheduler_nodes_used",
+            "Nodes carrying at least one pod after the last scheduling "
+            "cycle, as seen by the device solver, by engine.",
+            labels=("engine",),
+            declared={"engine": ENGINES},
+        )
+        self.packing_solver_iters = r.histogram(
+            "scheduler_packing_solver_iters",
+            "Solver iterations (projection-loop rounds) per scheduling "
+            "cycle — the warm-start evidence: steady-state cycles should "
+            "sit in the low buckets, spikes feed the sentinel's "
+            "PackingSolverIterationSpike rule.",
+            labels=("engine",),
+            buckets=exponential_buckets(1, 2, 12),
+            declared={"engine": ENGINES},
         )
         # API dispatcher lifetime counts, set at scrape time from
         # APIDispatcher.stats() (a gauge because the dispatcher owns the

@@ -82,6 +82,11 @@ class CycleRecord:
     # single-scheduler mode) — multi-replica cycle streams against one
     # cluster stay attributable per record
     replica: str = ""
+    # packing-engine solve diagnostics (assign.packing; None for the
+    # other engines): the cycle's cluster-objective value and how many
+    # projection-loop iterations the warm-started solver needed
+    objective_value: "float | None" = None
+    solver_iters: "int | None" = None
 
     def to_json(self) -> dict:
         out = asdict(self)
@@ -214,6 +219,8 @@ class TPUBackendMetrics:
         shard_resident_bytes: "list[int] | None" = None,
         collective_wall_s: "float | None" = None,
         replica: str = "",
+        objective_value: "float | None" = None,
+        solver_iters: "int | None" = None,
     ) -> CycleRecord:
         self.batch_size.labels(engine).observe(batch_size)
         self.transfer_bytes.labels(engine).inc(transfer_bytes)
@@ -250,6 +257,8 @@ class TPUBackendMetrics:
             shard_transfer_bytes=shard_transfer_bytes,
             collective_wall_s=collective_wall_s,
             replica=replica,
+            objective_value=objective_value,
+            solver_iters=solver_iters,
         )
         self.records.append(rec)
         return rec

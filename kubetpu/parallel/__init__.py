@@ -21,4 +21,5 @@ from .mesh import (  # noqa: F401
     shard_batch,
     sharded_batched,
     sharded_greedy,
+    sharded_packing,
 )

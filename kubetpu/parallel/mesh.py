@@ -101,7 +101,7 @@ _POD_NODE = frozenset({"extender_mask", "extender_score"})
 _POD_MAJOR = frozenset({
     "requests", "nonzero_requests", "pod_valid", "static_sig", "score_sig",
     "image_sig", "image_count", "pod_ports", "nominated_gate",
-    "dra_score_sig",
+    "dra_score_sig", "pod_priority",
 })
 
 # Nested quadratic-kernel pytrees. SpreadDevice: eligible/node_domain/
@@ -353,3 +353,28 @@ def sharded_batched(
     axis, pod_axis = _axes_of(mesh, axis, pod_axis)
     sb = shard_batch(b, mesh, axis, pod_axis)
     return batched_assign_device(sb, params, max_rounds=max_rounds)
+
+
+def sharded_packing(
+    b: rt.DeviceBatch, params: rt.ScoreParams, mesh: Mesh, axis: Axis = "nodes",
+    weights=None, max_iters: int = 0, pod_axis: str | None = None,
+):
+    """Shard the batch and run one cold packing solve (assign.packing)
+    under the mesh. The per-node penalty row (α open / β emptiness / λ) is
+    node-axis aligned, so it tiles with the node shards like every other
+    node-major tensor; the same collectives as ``sharded_batched`` cover
+    the argmax and acceptance sort. Returns the full solver tuple
+    ``(assignments, final_state, lam, objective, iters, nodes_used)`` —
+    warm-start across calls is the PackingEngine's job, not this probe's."""
+    import jax.numpy as jnp
+
+    from ..assign.packing import PackingWeights, packing_assign_device
+
+    axis, pod_axis = _axes_of(mesh, axis, pod_axis)
+    sb = shard_batch(b, mesh, axis, pod_axis)
+    lam = jax.device_put(
+        jnp.zeros(sb.alloc.shape[0], dtype=jnp.float32),
+        NamedSharding(mesh, P(axis)),
+    )
+    w = (weights or PackingWeights()).tensor()
+    return packing_assign_device(sb, params, lam, w, max_iters=max_iters)

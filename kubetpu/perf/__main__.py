@@ -27,9 +27,10 @@ def main(argv=None) -> None:
     ap.add_argument("--max-batch", type=int, default=1024)
     ap.add_argument("--timeout", type=float, default=1800.0)
     ap.add_argument("--engine", default="greedy",
-                    choices=["greedy", "batched"],
-                    help="assignment engine (assign.greedy scan vs "
-                         "assign.batched capacity-coupled rounds)")
+                    choices=["greedy", "batched", "packing"],
+                    help="assignment engine (assign.greedy scan, "
+                         "assign.batched capacity-coupled rounds, or "
+                         "assign.packing constraint-based packing)")
     ap.add_argument("--pipeline", default="off", choices=["on", "off"],
                     help="two-stage pipelined cycles with device-resident "
                          "node state + delta uploads (parity with the "

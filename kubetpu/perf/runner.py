@@ -188,6 +188,18 @@ class WorkloadResult:
     # trace / still unbound at the end / node count when it finished, and
     # the encode-cache re-encode accounting (scoped-invalidation evidence)
     trace_stats: dict | None = None
+    # --- packing frontier (PR 19) ----------------------------------------
+    # utilization-vs-throughput evidence, engine-agnostic so the three-way
+    # PackingComparison ladder reads the same keys from every rung:
+    # distinct nodes carrying the measured pods once the run settled, the
+    # fraction of high-priority (priority > 0) measured pods that actually
+    # bound, and — packing cycles only — the warm-started solver's mean
+    # projection-loop iterations per measured cycle + the weight tensor
+    # that produced the frontier (reproducible from the JSON alone)
+    nodes_used_at_steady_state: int | None = None
+    priority_slo_hit_rate: float | None = None
+    solver_iters_per_cycle: float | None = None
+    packing_weights: dict | None = None
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -289,6 +301,14 @@ class WorkloadResult:
             out["restarts"] = self.restarts
             if self.child_stats is not None:
                 out["child_stats"] = self.child_stats
+        if self.nodes_used_at_steady_state is not None:
+            out["nodes_used_at_steady_state"] = self.nodes_used_at_steady_state
+        if self.priority_slo_hit_rate is not None:
+            out["priority_slo_hit_rate"] = round(self.priority_slo_hit_rate, 4)
+        if self.solver_iters_per_cycle is not None:
+            out["solver_iters_per_cycle"] = round(self.solver_iters_per_cycle, 2)
+        if self.packing_weights is not None:
+            out["packing_weights"] = self.packing_weights
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -508,6 +528,55 @@ def _device_traffic_stats(sched, cycles0: int, duration: float) -> dict:
         out["resident_bytes"] = max(r.resident_bytes for r in recs)
         if duration > 0:
             out["cycles_per_sec"] = len(recs) / duration
+    return out
+
+
+def _packing_stats(sched, cycles0: int, bound, created) -> dict:
+    """Packing-frontier evidence (engine-agnostic keys, PR 19):
+
+    - ``nodes_used_at_steady_state``: distinct nodes carrying the MEASURED
+      pods (name prefix ``measure-``) at the end of the run — the
+      utilization half of the frontier, comparable across engines.
+    - ``priority_slo_hit_rate``: among measured pods created with
+      priority > 0, the fraction that actually bound (None when the
+      workload has no priority tiers).
+    - ``solver_iters_per_cycle``: mean packing-solver iterations over the
+      measured cycles' device records (None for greedy/batched — they
+      never stamp ``solver_iters``).
+    - ``packing_weights``: the weight tensor behind the run, so a
+      measured frontier is reproducible from its JSON alone.
+
+    ``bound`` is an iterable of (pod_name, node_name); ``created`` an
+    iterable of created Pod objects."""
+    bound = list(bound)
+    measured_nodes = {
+        node for name, node in bound if name.startswith("measure-")
+    }
+    out: dict = dict(
+        nodes_used_at_steady_state=(
+            len(measured_nodes) if measured_nodes else None
+        ),
+        priority_slo_hit_rate=None,
+        solver_iters_per_cycle=None,
+        packing_weights=None,
+    )
+    bound_names = {name for name, _ in bound}
+    high = [p for p in created
+            if p.priority > 0 and p.name.startswith("measure-")]
+    if high:
+        out["priority_slo_hit_rate"] = (
+            sum(1 for p in high if p.name in bound_names) / len(high)
+        )
+    iters = [
+        r.solver_iters for r in sched.metrics.tpu.records
+        if r.cycle > cycles0 and r.solver_iters is not None
+    ]
+    if iters:
+        out["solver_iters_per_cycle"] = sum(iters) / len(iters)
+    eng = getattr(sched, "_assign_device", None)
+    weights = getattr(eng, "weights", None)
+    if weights is not None and hasattr(weights, "to_json"):
+        out["packing_weights"] = weights.to_json()
     return out
 
 
@@ -1001,6 +1070,10 @@ def run_workload(
         threshold=workload.threshold,
         threshold_note=workload.threshold_note,
         **traffic,
+        **_packing_stats(
+            sched, cycles0, client.bound,
+            [p for pods in created_by_ns.values() for p in pods],
+        ),
         **_encode_stats(sched, cycles0),
         **_dispatcher_stats(sched),
         **_mesh_stats(sched),
@@ -1457,6 +1530,10 @@ def run_workload_trace(
             **_dispatcher_stats(sched),
             **_mesh_stats(sched),
             **_staged_and_soak(sched, prom_base),
+            # trace pods are not measure-prefixed: only the solver-side
+            # packing stats (iters/weights) populate here; nodes_final in
+            # trace_stats already carries the utilization story
+            **_packing_stats(sched, cycles0, [], []),
             measure_pods=len(created_at),
             scheduled=measured,
             duration_s=duration,
@@ -1778,6 +1855,7 @@ def run_workload_full_stack(
 
             super().__init__(store)
             self.bound_by_ns: collections.Counter = collections.Counter()
+            self.bound_pairs: list[tuple[str, str]] = []
             self._count_lock = threading.Lock()   # dispatcher workers bind
             #                                       concurrently
 
@@ -1785,14 +1863,16 @@ def run_workload_full_stack(
             super().bind(pod, node_name)
             with self._count_lock:
                 self.bound_by_ns[pod.namespace] += 1
+                self.bound_pairs.append((pod.name, node_name))
 
         def bulk_bind(self, pairs) -> list:
             errs = super().bulk_bind(pairs)
             with self._count_lock:
-                for (pod, _node), err in zip(pairs, errs):
+                for (pod, node), err in zip(pairs, errs):
                     # failed ops fall back through bind(), which counts
                     if err is None:
                         self.bound_by_ns[pod.namespace] += 1
+                        self.bound_pairs.append((pod.name, node))
             return errs
 
     client = _CountingClient(remote)
@@ -1847,6 +1927,7 @@ def run_workload_full_stack(
     churns: list[_FsChurn] = []
     deleters: list[_FsDeleter] = []
     created_keys_by_ns: dict[str, list[str]] = {}
+    created_pods: list[t.Pod] = []
     # one-shot injected stall (sentinel_spike): armed when the MEASURED
     # phase starts, fired once a third of its pods have bound — the
     # backlogged pods then bind with e2e latencies past the declared
@@ -1947,6 +2028,7 @@ def run_workload_full_stack(
                     pod = template(f"{prefix}-{ns}-{j}", ns)
                     key = f"{ns}/{pod.name}"
                     created_keys_by_ns.setdefault(ns, []).append(key)
+                    created_pods.append(pod)
                     items.append((key, pod))
                 _bulk_create(remote, PODS, items, bulk=bulk)
                 if op.skip_wait:
@@ -2004,6 +2086,7 @@ def run_workload_full_stack(
         **_dispatcher_stats(sched),
         **_mesh_stats(sched),
         **_staged_and_soak(sched, prom_base),
+        **_packing_stats(sched, cycles0, client.bound_pairs, created_pods),
         rpcs_per_scheduled_pod=(
             rpcs_total / measured if measured else None
         ),

@@ -202,6 +202,37 @@ def pod_with_label(name: str, namespace: str) -> t.Pod:
     )
 
 
+#: the bin-pack workload's deterministic 10-slot size/priority cycle,
+#: keyed by the pod's trailing ``-{j}`` index: one 2-cpu latency pod
+#: (priority 10), two 1-cpu services (priority 5), three 500m and four
+#: 100m batch fillers (priority 0). One full cycle requests 5.9 cpu —
+#: ~1.5 of a 4-cpu node when packed tight, but a spreading scorer happily
+#: smears it over many part-empty nodes, which is exactly the frontier
+#: the PackingComparison ladder measures.
+_BINPACK_SLOTS: tuple[tuple[int, int], ...] = (
+    (2000, 10),
+    (1000, 5), (1000, 5),
+    (500, 0), (500, 0), (500, 0),
+    (100, 0), (100, 0), (100, 0), (100, 0),
+)
+
+
+def pod_binpack(name: str, namespace: str) -> t.Pod:
+    """The skewed-size + priority-tier bin-pack template (PR 19): the
+    pod's shape is a pure function of its trailing index, so the workload
+    is identical across engines and runs — any nodes-used delta is the
+    engine's doing, not the draw's."""
+    try:
+        j = int(name.rsplit("-", 1)[-1])
+    except ValueError:
+        j = 0
+    cpu, priority = _BINPACK_SLOTS[j % len(_BINPACK_SLOTS)]
+    return make_pod(
+        name, namespace=namespace, priority=priority,
+        cpu_milli=cpu, memory=500 * 1024**2,
+    )
+
+
 def node_with_extended_resource(i: int, zones: tuple[str, ...] = ()) -> t.Node:
     """templates/node-with-extended-resource.yaml: each node advertises ONE
     unit of a PER-NODE-UNIQUE extended resource (foo.com/bar-{i}) — the
@@ -1248,6 +1279,29 @@ _trace(TraceProfile(
                 "(the mixed-tenant admission shape)",
 ))
 
+
+_case(TestCase(
+    name="BinPacking",
+    source="PR 19: utilization-vs-throughput frontier workload (no "
+           "reference config — skewed sizes + priority tiers built for "
+           "the PackingComparison three-engine ladder)",
+    default_pod_template=pod_binpack,
+    ops=(
+        CreateNodesOp("initNodes"),
+        CreatePodsOp("initPods"),
+        CreatePodsOp("measurePods", collect_metrics=True),
+    ),
+    workloads=(
+        # no pods/s threshold: the workload's verdict is the benchdiff
+        # frontier — nodes_used_at_steady_state and priority_slo_hit_rate
+        # against the greedy baseline, not a reference throughput floor
+        Workload("200Nodes",
+                 {"initNodes": 200, "initPods": 50, "measurePods": 300}),
+        Workload("1000Nodes_3000Pods",
+                 {"initNodes": 1000, "initPods": 200, "measurePods": 3000},
+                 labels=("performance", "packing")),
+    ),
+))
 
 _case(TestCase(
     name="SchedulingWithMixedChurn",

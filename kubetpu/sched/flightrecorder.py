@@ -257,11 +257,20 @@ class FlightRecorder:
         encode_s: float,
         kernel_s: float,
         breakdown: bool = True,
+        engine: str = "",
+        objective_value: "float | None" = None,
+        solver_iters: "int | None" = None,
     ) -> None:
         """One decision record per pod of the finished cycle. ``idx`` is
         the scan's assignment vector (node index or -1). ``breakdown``
         gates the extra explain kernel (off under a mesh — the sharded
-        batch is not re-evaluated here)."""
+        batch is not re-evaluated here). ``objective_value`` /
+        ``solver_iters`` are the packing engine's solve diagnostics
+        (assign.packing; None otherwise) — stamped on every record of the
+        cycle so ``kubetpu explain`` can render the packing rationale, and
+        the breakdown's ``top_nodes[0]`` (the cycle-start masked argmax —
+        exactly what the greedy scan would have picked first) doubles as
+        the greedy counterfactual beside it."""
         self._resolve_pending()
         summary_dev = masks_dev = None
         node_names = batch.node_names
@@ -306,6 +315,12 @@ class FlightRecorder:
                 "kernel_s": kernel_s,
                 "queue_wait_s": getattr(info, "queue_wait_s", 0.0),
             }
+            if engine:
+                rec["engine"] = engine
+            if objective_value is not None:
+                rec["objective_value"] = objective_value
+            if solver_iters is not None:
+                rec["solver_iters"] = solver_iters
             fl = self._flights.get(info.key)
             if fl is not None and fl.trace_id:
                 rec["trace_id"] = fl.trace_id

@@ -275,6 +275,13 @@ class Scheduler:
             self._assign_device = batched_assign_device
         elif engine == "greedy":
             self._assign_device = greedy_assign_device
+        elif engine == "packing":
+            from ..assign.packing import PackingEngine
+
+            # stateful engine instance: carries the warm-start dual block
+            # and the objective-weight tensor across cycles; the mesh is
+            # bound after resolution below (bind_mesh)
+            self._assign_device = PackingEngine()
         else:
             raise ValueError(f"unknown engine {engine!r}")
         self.engine = engine
@@ -385,6 +392,10 @@ class Scheduler:
         # host→device traffic is O(Δ·R) regardless of pipelining. Under a
         # mesh it is the SHARDED resident block (per-shard routed deltas).
         self._resident = rt.ResidentNodeState(mesh=self.mesh)
+        if self.engine == "packing":
+            # the packing engine's dual-price block shards its (NC,) λ
+            # along the same node axis as the resident block
+            self._assign_device.bind_mesh(self.mesh)
         self._inflight: _InflightCycle | None = None
         # sticky: any host-state refresh between dispatch and sync that
         # found the cluster materially changed flips this; sync replays
@@ -1358,6 +1369,31 @@ class Scheduler:
                 # extender verdict tensors were attached post-encode: count
                 # their upload too
                 transfer_bytes += full_bytes - batch_nbytes(batch.device)
+            # packing-engine solve diagnostics: the device scalars were
+            # produced by the same program as the assignments, so fetching
+            # them here adds no extra sync point
+            objective_value = solver_iters = nodes_used = None
+            if self.engine == "packing":
+                try:
+                    eng = self._assign_device
+                    if eng.last_iters is not None:
+                        objective_value = float(
+                            jax.device_get(eng.last_objective)
+                        )
+                        solver_iters = int(jax.device_get(eng.last_iters))
+                        nodes_used = int(
+                            jax.device_get(eng.last_nodes_used)
+                        )
+                except Exception:
+                    pass    # diagnostics must never fail the cycle
+            if objective_value is not None:
+                prom.packing_objective.labels(self.engine).set(
+                    objective_value
+                )
+                prom.nodes_used.labels(self.engine).set(nodes_used)
+                prom.packing_solver_iters.labels(self.engine).observe(
+                    solver_iters
+                )
             self.metrics.tpu.record_cycle(
                 cycle=cycle_id, engine=self.engine,
                 batch_size=len(batch_infos), transfer_bytes=transfer_bytes,
@@ -1377,6 +1413,8 @@ class Scheduler:
                 ),
                 collective_wall_s=self._collective_wall_s,
                 replica=self.replica_id,
+                objective_value=objective_value,
+                solver_iters=solver_iters,
             )
             if self.mesh_shape:
                 # per-shard routed-delta attribution, joined by cycle id
@@ -1425,6 +1463,9 @@ class Scheduler:
                         encode_s=inflight.encode_s,
                         kernel_s=kernel_wall_s,
                         breakdown=self.mesh is None,
+                        engine=self.engine,
+                        objective_value=objective_value,
+                        solver_iters=solver_iters,
                     )
                 except Exception:
                     pass    # diagnostics must never fail the cycle

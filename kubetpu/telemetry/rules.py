@@ -149,6 +149,25 @@ DEFAULT_RULES: tuple[Rule, ...] = (
         resolve_intervals=3,
     ),
     Rule(
+        name="packing-solver-iteration-spike",
+        kind=OUTLIER,
+        series="scheduler_packing_solver_iters",
+        labels=(("engine", "packing"),),
+        severity=WARNING,
+        description="the packing engine's warm-started projection loop "
+                    "suddenly needs far more iterations per cycle than "
+                    "its own recent baseline — the cluster drifted away "
+                    "from the carried dual prices (churn burst, shape "
+                    "change) and cycles are paying cold-solve cost "
+                    "(dormant on greedy/batched: only packing cycles "
+                    "observe the series)",
+        ewma_alpha=0.3,
+        mad_k=8.0,
+        min_samples=8,
+        for_intervals=2,
+        resolve_intervals=3,
+    ),
+    Rule(
         name="federation-conflict-storm",
         kind=RATIO,
         series="scheduler_federation_conflicts_total",
