@@ -271,8 +271,24 @@ TRACE_STAGES = [
      dict(nodes=50000, duration_s=20.0, base_rate=15.0, peak_rate=80.0,
           bursts=2, burst_pods=100, slo_budget_ms=15000.0),
      128, "greedy", 600.0, "fullstack"),
+    # --- PR-20 topology rungs: rack/slice-labeled fleets through the
+    # gang placement stack. A "topology" override key flips the
+    # scheduler's --topology mode per rung (popped before scaled(), like
+    # nodes). Each record carries slices_free_at_steady_state,
+    # fragmentation_index and gang_admission_p99_ms (benchdiff-gated).
+    # slice-fragmentation runs as an on/off PAIR on the same seeded
+    # trace — the free-slice delta between the two records is the
+    # fragmentation-avoidance evidence.
+    ("train-serve-churn", "512", dict(nodes=512, topology="on"),
+     64, "greedy", 240.0),
+    ("slice-fragmentation", "on", dict(nodes=256, topology="on"),
+     64, "greedy", 200.0),
+    ("slice-fragmentation", "off", dict(nodes=256),
+     64, "greedy", 200.0),
+    ("gang-contention", "128", dict(nodes=128, topology="on"),
+     64, "greedy", 180.0),
 ]
-TRACE_BUDGET_S = 2400.0
+TRACE_BUDGET_S = 3200.0  # raised for the four PR-20 topology rungs
 
 # --- list/relist at scale (paginated watch-cache reads) ---------------------
 # ListScaling_{5k,20k,50k}Nodes: K full informer relists (RemoteStore paged
@@ -1696,15 +1712,18 @@ def _run_trace_stages() -> None:
             continue
         ov = dict(overrides)
         nodes = ov.pop("nodes", None)
+        topology = ov.pop("topology", "off")
         prof = TRACE_PROFILES[name].scaled(suffix, nodes=nodes, **ov)
         metric = f"Trace_{prof.name}_{prof.nodes}Nodes_{engine}"
         _status(f"trace stage: {prof.name} nodes={prof.nodes} mode={mode} "
-                f"wall_budget={wall:.0f}s (t={elapsed:.0f}s)")
+                f"topology={topology} wall_budget={wall:.0f}s "
+                f"(t={elapsed:.0f}s)")
         t_stage = time.perf_counter()
         try:
             r = run_workload_trace(
                 prof, mode=mode, engine=engine, max_batch=max_batch,
                 timeout_s=wall + 120.0, wall_budget_s=wall,
+                topology=topology,
             )
         except Exception as e:
             _emit({

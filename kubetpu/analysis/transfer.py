@@ -1,4 +1,4 @@
-"""Hot-path transfer checkers (HT001, HT002).
+"""Hot-path transfer checkers (HT001, HT002, TP001).
 
 The historical work: PR 2 made the node block device-resident with
 dirty-row delta uploads, PR 3 collapsed ~30 per-cycle ``device_put``
@@ -6,6 +6,12 @@ dispatches into one batched placement, PR 6 routed per-shard uploads.
 Those wins evaporate the moment someone adds a stray ``jax.device_put``
 (or a host fetch of a device array) on the cycle path — so host↔device
 traffic is only allowed at the blessed encode/finalize/upload seams.
+
+PR 20 added the node-topology coordinate tensors (``slice_id`` /
+``rack_id``) to the same budget: they ride the in-place-growth encode and
+ship inside the ONE batched placement, so TP001 guards the route a
+generic device_put scan cannot see — ``jnp.asarray`` / ``jnp.array`` of a
+topology coordinate silently creates a device array per call.
 """
 
 from __future__ import annotations
@@ -47,6 +53,15 @@ _SCOPES = (
 
 _FETCHERS = {"np.asarray", "numpy.asarray", "np.array", "numpy.array",
              "jax.device_get", "device_get"}
+
+#: device-shipping callees TP001 watches beyond device_put: jnp.asarray /
+#: jnp.array on a host array IS a transfer, it just doesn't say so
+_DEVICE_SHIPPERS = {"jnp.asarray", "jnp.array", "jax.numpy.asarray",
+                    "jax.numpy.array"}
+
+#: the topology coordinate surface (state.topology.TopologyTensors):
+#: attribute/name references that mark an argument as topology-shaped
+_TOPO_COORDS = {"slice_id", "rack_id"}
 
 
 def _enclosing_functions(tree: ast.AST) -> "list[tuple[ast.AST, str]]":
@@ -110,6 +125,84 @@ class HotPathDevicePut(Checker):
                     "(see analysis.transfer.BLESSED_SEAMS) — hot-path "
                     "host→device traffic must ride the encode/refresh "
                     "seam"
+                ),
+            ))
+        return out
+
+
+@register
+class TopologyTensorTransfer(Checker):
+    code = "TP001"
+    title = "topology coordinate tensor shipped to device off-seam"
+    rationale = (
+        "The node-topology coordinates (slice_id/rack_id, PR 20) are "
+        "per-node int32 tensors that grow in place with the encode and "
+        "ship inside the ONE batched placement at the blessed "
+        "encode/finalize/shard seams. A jnp.asarray/jnp.array (or "
+        "device_put) of a topology coordinate anywhere else in the "
+        "scanned scope creates a fresh device array + sync per call — "
+        "per-cycle, that is exactly the dispatch storm PR 3 removed, and "
+        "it bypasses the scoped cache invalidation that keeps the "
+        "coordinates consistent with the node axis. Host-side math on "
+        "them (np.asarray) is free and stays allowed."
+    )
+
+    def covers(self, relpath: str) -> bool:
+        return relpath.endswith(".py") and any(
+            s in relpath for s in _SCOPES
+        )
+
+    def blessed(self, relpath: str) -> set[str]:
+        for suffix, fns in BLESSED_SEAMS.items():
+            if relpath.endswith(suffix):
+                return fns
+        return set()
+
+    @staticmethod
+    def _mentions_topology(node: ast.AST) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Attribute) and sub.attr in _TOPO_COORDS:
+                return True
+            if isinstance(sub, ast.Name) and sub.id in _TOPO_COORDS:
+                return True
+            if isinstance(sub, ast.Call):
+                callee = dotted(sub.func)
+                if callee and callee.split(".")[-1] == "topology_tensors":
+                    return True
+        return False
+
+    def collect(self, mod: ModuleInfo):
+        out: list[Violation] = []
+        allowed = self.blessed(mod.relpath)
+        spans = []
+        for fn, name in _enclosing_functions(mod.tree):
+            if name in allowed:
+                spans.append((
+                    fn.lineno, getattr(fn, "end_lineno", fn.lineno), name
+                ))
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = dotted(node.func)
+            if name is None:
+                continue
+            if not (name.endswith("device_put")
+                    or name in _DEVICE_SHIPPERS):
+                continue
+            if not any(self._mentions_topology(a) for a in node.args):
+                continue
+            line = node.lineno
+            if any(lo <= line <= hi for lo, hi, _n in spans):
+                continue
+            out.append(Violation(
+                path=mod.relpath, line=line, code=self.code,
+                symbol=name,
+                message=(
+                    "topology coordinate tensor shipped to device "
+                    "outside the blessed seams — slice_id/rack_id ride "
+                    "the batched encode placement "
+                    "(analysis.transfer.BLESSED_SEAMS), never a per-call "
+                    "jnp.asarray/device_put"
                 ),
             ))
         return out

@@ -93,6 +93,13 @@ class PackingWeights:
                           (bounds how much history a price can carry; set
                           above the biggest utility gap equalization must
                           bridge).
+    ``slice_frag``      — penalty for landing on a node whose TPU slice is
+                          currently fully free (opening it fragments a
+                          slice a future aligned gang could have taken
+                          whole). Inert without a topology block.
+    ``slice_align``     — reward for landing in a slice that already
+                          carries load (concentrates the workload into
+                          fewer slices). Inert without a topology block.
 
     Serialized into bench records (``WorkloadResult.packing_weights``) so a
     measured frontier is reproducible from its JSON alone.
@@ -106,6 +113,8 @@ class PackingWeights:
     dual_decay: float = 0.9
     tie_band: float = 0.15
     lam_cap_frac: float = 2.0
+    slice_frag: float = 0.5
+    slice_align: float = 0.25
 
     def tensor(self) -> jnp.ndarray:
         """The ``(K,)`` float32 device tensor the solver consumes."""
@@ -114,6 +123,7 @@ class PackingWeights:
                 self.score_weight, self.priority_weight, self.alpha_open,
                 self.beta_frag, self.dual_step, self.dual_decay,
                 self.tie_band, self.lam_cap_frac,
+                self.slice_frag, self.slice_align,
             ],
             dtype=jnp.float32,
         )
@@ -128,6 +138,8 @@ class PackingWeights:
             "dual_decay": self.dual_decay,
             "tie_band": self.tie_band,
             "lam_cap_frac": self.lam_cap_frac,
+            "slice_frag": self.slice_frag,
+            "slice_align": self.slice_align,
         }
 
 
@@ -267,6 +279,7 @@ def packing_assign_device(
     alpha, beta = weights[2], weights[3]
     step, decay = weights[4], weights[5]
     band_f, cap_frac = weights[6], weights[7]
+    w_sfrag, w_salign = weights[8], weights[9]
     lam = lam * decay                  # forget a fraction of stale prices
     lam_cap = alpha * cap_frac
     band = jnp.round(band_f * _UTIL_SCALE).astype(jnp.int64)
@@ -322,6 +335,21 @@ def packing_assign_device(
         # the class fills in parallel.
         bias = closed * node_iota.astype(jnp.float32) * (2.0 * band_f)
         node_pen = alpha * closed + beta * emptiness(requested) + lam + bias
+        if b.topology is not None:
+            # slice terms recompute per round from the CURRENT requested
+            # rows, so the first pod admitted into a free slice flips its
+            # price for every later round — slices open one at a time
+            from ..ops.topology import slice_occupancy
+
+            sid, n_sl = b.topology.slice_id, b.topology.num_slices
+            s_active, _ = slice_occupancy(requested, b.node_valid, sid, n_sl)
+            labeled_n = sid < n_sl
+            in_free = labeled_n & ~s_active[sid]
+            in_active = labeled_n & s_active[sid]
+            node_pen = node_pen + (
+                w_sfrag * in_free.astype(jnp.float32)
+                - w_salign * in_active.astype(jnp.float32)
+            )
         util_f = w_score * norm - node_pen[None, :]
         util = jnp.where(
             mask, jnp.round(util_f * _UTIL_SCALE).astype(jnp.int64), I64_MIN
@@ -457,6 +485,18 @@ def packing_assign_device(
     nodes_used = jnp.sum(open_nodes).astype(jnp.int32)
     frag = jnp.sum(jnp.where(open_nodes, emptiness(requested), 0.0))
     objective = admission - alpha * nodes_used.astype(jnp.float32) - beta * frag
+    if b.topology is not None:
+        # slice-fragmentation spend: slices this solve opened from fully
+        # free (the recorded "why" mirrors the per-round utility terms)
+        from ..ops.topology import slice_occupancy
+
+        sid, n_sl = b.topology.slice_id, b.topology.num_slices
+        act0, _ = slice_occupancy(b.requested, b.node_valid, sid, n_sl)
+        act1, _ = slice_occupancy(requested, b.node_valid, sid, n_sl)
+        newly_opened = jnp.sum(
+            (act1[:n_sl] & ~act0[:n_sl]).astype(jnp.float32)
+        )
+        objective = objective - w_sfrag * newly_opened
     return assignments, final_state, lam, objective, iters, nodes_used
 
 

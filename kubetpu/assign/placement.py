@@ -43,9 +43,13 @@ def placement_assign_device(
 ):
     """Run the assignment engine once per placement, all on device.
 
-    Returns ``(assignments (D, P) int32, counts (D,) int32)`` where
-    ``counts[d]`` is how many batch pods placement d schedules (the
-    ProposedAssignments count the placement scorer consumes).
+    Returns ``(assignments (D, P) int32, counts (D,) int32, alignment
+    (D,) int32)`` where ``counts[d]`` is how many batch pods placement d
+    schedules (the ProposedAssignments count the placement scorer
+    consumes) and ``alignment[d]`` is the slice-alignment score of the
+    proposal (Σ c_s² over the topology coordinates — ``ops.topology``).
+    Alignment is all-zero when the batch carries no topology block, so
+    count-first selection is unchanged on a topology-off build.
     """
     if engine == "batched":
         from .batched import batched_assign_device as assign
@@ -60,10 +64,19 @@ def placement_assign_device(
             ),
         )
         assignments, _ = assign(bb, params)
-        return assignments
+        if b.topology is not None:
+            from ..ops.topology import alignment_score
 
-    assignments = jax.vmap(one)(placement_masks)              # (D, P)
+            align, _, _ = alignment_score(
+                assignments, b.pod_valid,
+                b.topology.slice_id, b.topology.num_slices,
+            )
+        else:
+            align = jnp.int32(0)
+        return assignments, align
+
+    assignments, alignment = jax.vmap(one)(placement_masks)   # (D, P), (D,)
     counts = jnp.sum(
         (assignments >= 0) & b.pod_valid[None, :], axis=1
     ).astype(jnp.int32)
-    return assignments, counts
+    return assignments, counts, alignment

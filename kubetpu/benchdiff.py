@@ -98,6 +98,21 @@ Comparison rules (per metric name present in BOTH records):
   when the new mean exceeds ``old * (1 + solver_iters_tol)`` — the
   fixed-point loop is cheap per iteration, so what gates is the warm
   start silently degrading back to cold solves every cycle.
+- **free-slice headroom** (``slices_free_at_steady_state`` on the
+  topology trace rows — fully-empty TPU slices left when the trace
+  drains): regression when the count drops below
+  ``old * (1 - slices_free_tol)`` AND by more than
+  ``min_slices_free_delta`` slices absolute (one slice of wobble on a
+  16-slice fleet never gates; topology-aware placement quietly
+  scattering gangs does).
+- **slice fragmentation** (``fragmentation_index`` on the same rows —
+  the fraction of slices partially used): regression when the new
+  fraction exceeds ``old * (1 + frag_index_tol)`` AND grew by more than
+  ``min_frag_index_delta`` absolute.
+- **gang admission p99** (``gang_admission_p99_ms``): the p99-style
+  relative+absolute rule with its own floor (``gang_admission_tol`` /
+  ``min_gang_admission_delta_ms``) — sub-100ms wobble on a quiet rung
+  never gates; gang admission under contention doubling does.
 - **peak RSS** (``peak_rss_bytes``): regression only when BOTH +50%
   relative AND >256MB absolute — host allocator noise never gates, a
   node-axis layout that regressed into gigabytes at 100k nodes does.
@@ -194,6 +209,23 @@ MIN_PRIORITY_RATE_DELTA = 0.05
 #: cheap per iteration, so the gate exists for a warm start that silently
 #: degraded back to cold solves every cycle
 SOLVER_ITERS_TOL = 0.50
+#: topology gates (PR 20): free-slice headroom is an integer COUNT of
+#: fully-empty slices on a small labeled fleet (16 slices at the bench
+#: shape) — gate only a drop that is BOTH >10% relative AND >1 slice
+#: absolute, so one slice of churn-timing wobble never gates while a
+#: placement stack that stopped concentrating gangs does
+SLICES_FREE_TOL = 0.10
+MIN_SLICES_FREE_DELTA = 1.0
+#: fragmentation index is a FRACTION (0..1) of slices partially used —
+#: same calibration shape as the other fraction gates: a relative rule
+#: plus an absolute floor wide enough for steady-state churn noise
+FRAG_INDEX_TOL = 0.25
+MIN_FRAG_INDEX_DELTA = 0.10
+#: gang admission p99 rides the p99-style rule with a 100ms floor — the
+#: histogram is over few gangs per rung, so only a real contention
+#: regression (p99 +50% AND >100ms) gates
+GANG_ADMISSION_TOL = 0.50
+MIN_GANG_ADMISSION_DELTA_MS = 100.0
 #: peak RSS is host-noise-prone (allocator, import order): gate only a
 #: move that is BOTH +50% relative AND >256MB absolute
 RSS_TOL = 0.50
@@ -322,6 +354,12 @@ def compare(
     min_nodes_used_delta: float = MIN_NODES_USED_DELTA,
     min_priority_rate_delta: float = MIN_PRIORITY_RATE_DELTA,
     solver_iters_tol: float = SOLVER_ITERS_TOL,
+    slices_free_tol: float = SLICES_FREE_TOL,
+    min_slices_free_delta: float = MIN_SLICES_FREE_DELTA,
+    frag_index_tol: float = FRAG_INDEX_TOL,
+    min_frag_index_delta: float = MIN_FRAG_INDEX_DELTA,
+    gang_admission_tol: float = GANG_ADMISSION_TOL,
+    min_gang_admission_delta_ms: float = MIN_GANG_ADMISSION_DELTA_MS,
     rss_tol: float = RSS_TOL,
     min_rss_delta_bytes: float = MIN_RSS_DELTA_BYTES,
 ) -> tuple[list[Delta], list[str], list[str]]:
@@ -596,6 +634,53 @@ def compare(
                 name, "solver_iters_per_cycle", float(osi), float(nsi), bad,
                 note=f"[tol +{solver_iters_tol:.0%}]" if bad else "",
             ))
+        # the topology frontier's three gates (PR 20): free-slice
+        # headroom (a drop is lost gang capacity — relative + absolute),
+        # the fragmentation index (fraction of slices partially used),
+        # and the gang-admission p99 under contention
+        osf, nsf = (o.get("slices_free_at_steady_state"),
+                    n.get("slices_free_at_steady_state"))
+        if isinstance(osf, (int, float)) and isinstance(nsf, (int, float)):
+            bad = (
+                nsf < osf * (1.0 - slices_free_tol)
+                and (osf - nsf) > min_slices_free_delta
+            )
+            deltas.append(Delta(
+                name, "slices_free_at_steady_state",
+                float(osf), float(nsf), bad,
+                note=(
+                    f"[tol -{slices_free_tol:.0%} & "
+                    f">{min_slices_free_delta:g} slices]" if bad else ""
+                ),
+            ))
+        ofi, nfi = (o.get("fragmentation_index"),
+                    n.get("fragmentation_index"))
+        if isinstance(ofi, (int, float)) and isinstance(nfi, (int, float)):
+            bad = (
+                nfi > ofi * (1.0 + frag_index_tol)
+                and (nfi - ofi) > min_frag_index_delta
+            )
+            deltas.append(Delta(
+                name, "fragmentation_index", float(ofi), float(nfi), bad,
+                note=(
+                    f"[tol +{frag_index_tol:.0%} & "
+                    f">{min_frag_index_delta:g}]" if bad else ""
+                ),
+            ))
+        oga, nga = (o.get("gang_admission_p99_ms"),
+                    n.get("gang_admission_p99_ms"))
+        if isinstance(oga, (int, float)) and isinstance(nga, (int, float)):
+            bad = (
+                nga > oga * (1.0 + gang_admission_tol)
+                and (nga - oga) > min_gang_admission_delta_ms
+            )
+            deltas.append(Delta(
+                name, "gang_admission_p99_ms", float(oga), float(nga), bad,
+                note=(
+                    f"[tol +{gang_admission_tol:.0%} & "
+                    f">{min_gang_admission_delta_ms:g}ms]" if bad else ""
+                ),
+            ))
         # peak RSS: both +50% relative AND >256MB absolute (host noise on
         # small stages never gates; a 100k-node rung whose node-axis
         # layout regressed into gigabytes does)
@@ -755,6 +840,31 @@ def main(argv=None) -> int:
                     default=SOLVER_ITERS_TOL,
                     help="fractional solver-iterations-per-cycle growth "
                          f"tolerated (default {SOLVER_ITERS_TOL})")
+    ap.add_argument("--slices-free-tol", type=float,
+                    default=SLICES_FREE_TOL,
+                    help="fractional free-slice-headroom drop tolerated "
+                         f"(default {SLICES_FREE_TOL})")
+    ap.add_argument("--min-slices-free-delta", type=float,
+                    default=MIN_SLICES_FREE_DELTA,
+                    help="absolute free-slice drop floor below which it "
+                         f"never gates (default {MIN_SLICES_FREE_DELTA})")
+    ap.add_argument("--frag-index-tol", type=float, default=FRAG_INDEX_TOL,
+                    help="fractional fragmentation-index growth tolerated "
+                         f"(default {FRAG_INDEX_TOL})")
+    ap.add_argument("--min-frag-index-delta", type=float,
+                    default=MIN_FRAG_INDEX_DELTA,
+                    help="absolute fragmentation-index growth floor below "
+                         f"which it never gates (default "
+                         f"{MIN_FRAG_INDEX_DELTA})")
+    ap.add_argument("--gang-admission-tol", type=float,
+                    default=GANG_ADMISSION_TOL,
+                    help="fractional gang-admission-p99 growth tolerated "
+                         f"(default {GANG_ADMISSION_TOL})")
+    ap.add_argument("--min-gang-admission-delta-ms", type=float,
+                    default=MIN_GANG_ADMISSION_DELTA_MS,
+                    help="absolute gang-admission-p99 growth floor below "
+                         f"which it never gates (default "
+                         f"{MIN_GANG_ADMISSION_DELTA_MS})")
     ap.add_argument("--rss-tol", type=float, default=RSS_TOL,
                     help="fractional peak-RSS growth tolerated "
                          f"(default {RSS_TOL})")
@@ -802,6 +912,12 @@ def main(argv=None) -> int:
         min_nodes_used_delta=args.min_nodes_used_delta,
         min_priority_rate_delta=args.min_priority_rate_delta,
         solver_iters_tol=args.solver_iters_tol,
+        slices_free_tol=args.slices_free_tol,
+        min_slices_free_delta=args.min_slices_free_delta,
+        frag_index_tol=args.frag_index_tol,
+        min_frag_index_delta=args.min_frag_index_delta,
+        gang_admission_tol=args.gang_admission_tol,
+        min_gang_admission_delta_ms=args.min_gang_admission_delta_ms,
         rss_tol=args.rss_tol,
         min_rss_delta_bytes=args.min_rss_delta_bytes,
     )

@@ -401,6 +401,7 @@ def cmd_up(args) -> int:
         partition=args.partition,
         wire=args.wire,
         engine=args.engine,
+        topology=getattr(args, "topology", "off"),
         max_batch=args.max_batch,
         persistence=persistence,
         telemetry=args.telemetry,
@@ -860,6 +861,7 @@ def cmd_scheduler(args) -> int:
         encode_cache=(args.encode_cache == "on"),
         bulk=(args.bulk == "on"),
         mesh=mesh,
+        topology=getattr(args, "topology", "off"),
         flight_recorder=(args.flight_recorder == "on"),
         replica_id=args.replica_id,
         federation_mode=(
@@ -1199,11 +1201,49 @@ def cmd_delete(args) -> int:
     return 0
 
 
+def _render_gang_explain(rec: dict) -> str:
+    """A GANG placement record: the topology rationale — the winning
+    placement, its slice-alignment score, which slices the search
+    considered, the fragmentation delta, and (preemption mode) the ONE
+    evicted gang with its member pods."""
+    lines = [
+        f"Gang {rec['pod']} — status {rec.get('status')}"
+        + (f", engine {rec['engine']}" if rec.get("engine") else "")
+        + (f", replica {rec['replica']}" if rec.get("replica") else "")
+    ]
+    lines.append(
+        f"  members {rec.get('members')}, quorum need {rec.get('need')}"
+    )
+    if rec.get("placement") is not None:
+        head = f"  decision: {rec['status']} on {rec['placement']}"
+        if rec.get("alignment_score") is not None:
+            head += f" (alignment {rec['alignment_score']})"
+        lines.append(head)
+    if rec.get("slices_considered"):
+        lines.append(
+            "    slices considered: " + ", ".join(rec["slices_considered"])
+        )
+    if rec.get("fragmentation_delta") is not None:
+        lines.append(
+            f"    fragmentation delta: {rec['fragmentation_delta']:+d} "
+            f"free slice(s) newly opened"
+        )
+    if rec.get("victim_group"):
+        victims = rec.get("preemption_victims") or ()
+        lines.append(
+            f"  preemption: evicting gang {rec['victim_group']}"
+            + (f" (victims: {', '.join(victims)})" if victims else "")
+        )
+    return "\n".join(lines)
+
+
 def _render_explain(rec: dict) -> str:
     """One flight-recorder record as the ``kubetpu explain`` report:
     staged timeline + decision reasoning (sched.flightrecorder)."""
     from .metrics.scheduler_metrics import E2E_STAGES
 
+    if rec.get("kind") == "gang":
+        return _render_gang_explain(rec)
     lines = [
         f"Pod {rec['pod']} — cycle {rec.get('cycle')}, "
         f"profile {rec.get('profile')}, attempts {rec.get('attempts')}, "
@@ -1284,6 +1324,15 @@ def _render_explain(rec: dict) -> str:
                     for plugin, cnt in sorted(rejected.items())
                 ) if rejected else ""
             )
+        )
+    elif rec.get("skipped_reason"):
+        # satellite of the mesh path: the per-plugin rejection kernel is
+        # host-gather only, so sharded cycles skip it EXPLICITLY — render
+        # the reason instead of an empty breakdown masquerading as
+        # "no rejections"
+        lines.append(
+            "    filtered: per-plugin breakdown skipped "
+            f"({rec['skipped_reason']})"
         )
     if rec.get("nominated_node"):
         line = f"  preemption: nominated {rec['nominated_node']}"
@@ -1607,6 +1656,19 @@ def build_parser() -> argparse.ArgumentParser:
                            "collectives. 'auto' engages when >1 device is "
                            "visible; 'on' requires one; assignments are "
                            "bit-identical to single-device either way")
+    schd.add_argument("--topology", default="off",
+                      choices=["on", "off", "auto"],
+                      help="node-topology axis for scoring + gang "
+                           "placement: rack/TPU-slice labels become "
+                           "per-node coordinate tensors, gangs land "
+                           "alignment-first via per-slice placement "
+                           "candidates, the packing objective gains "
+                           "slice-fragmentation terms, and preemption "
+                           "can evict ONE low-priority gang to free a "
+                           "contiguous slice. 'auto' engages only when "
+                           "nodes carry topology labels; 'off' (and "
+                           "'auto' on unlabeled clusters) is "
+                           "bit-identical to before")
     schd.add_argument("--flight-recorder", default="on",
                       choices=["on", "off"],
                       help="scheduling flight recorder + per-pod staged "
@@ -1914,6 +1976,10 @@ def build_parser() -> argparse.ArgumentParser:
                          "escape hatch)")
     up.add_argument("--engine", default="greedy",
                     choices=["greedy", "batched", "packing"])
+    up.add_argument("--topology", default="off",
+                    choices=["on", "off", "auto"],
+                    help="node-topology axis on every scheduler replica "
+                         "(see kubetpu scheduler --topology)")
     up.add_argument("--max-batch", type=int, default=1024)
     up.add_argument("--persistence", default="off", metavar="DIR|off",
                     help="apiserver durability dir (WAL + snapshots); the "

@@ -113,6 +113,11 @@ class DeviceBatch:
     # per-pod priority column (assign.packing admission order + objective;
     # None only for hand-built batches — finalize_batch always sets it)
     pod_priority: jnp.ndarray | None = None     # (P,) int32
+    # dense node-topology coordinates (state.topology) — present only when
+    # topology scoring is ACTIVE (--topology on, or auto with labeled
+    # nodes). None keeps the pytree — and therefore every compiled kernel
+    # and its outputs — bit-identical to a build without the feature.
+    topology: "TopologyDevice | None" = None
 
     # node-block accessors (kernels read b.alloc etc. — the split into a
     # persistent node block is invisible to them)
@@ -181,6 +186,21 @@ class SpreadDevice:
     ignored: jnp.ndarray         # (P, N) bool
     has_hard: bool = field(metadata=dict(static=True), default=False)
     has_soft: bool = field(metadata=dict(static=True), default=False)
+
+
+@jax.tree_util.register_dataclass
+@dataclass(frozen=True)
+class TopologyDevice:
+    """Device-side dense topology coordinates (see state.topology).
+
+    Domain counts are STATIC so alignment/fragmentation segment-sums get
+    a fixed ``num_segments`` — a new slice label retraces, exactly like a
+    spread constraint growing a domain axis."""
+
+    slice_id: jnp.ndarray  # (N,) int32; value == num_slices ⇒ unlabeled
+    rack_id: jnp.ndarray   # (N,) int32; value == num_racks ⇒ unlabeled
+    num_slices: int = field(metadata=dict(static=True), default=0)
+    num_racks: int = field(metadata=dict(static=True), default=0)
 
 
 @dataclass
@@ -745,6 +765,11 @@ class StaticBatch:
     # the EncodeCache (state.encode_cache) stage 1 encoded against; stage 2
     # reuses its persistent affinity/spread term caches
     cache: object | None = None
+    # topology mode ("off"|"auto"|"on") — finalize_batch attaches the dense
+    # coordinate block when the mode is active AND any node carries a
+    # topology label; coordinates are read fresh from the NodeTensors memo
+    # at stage 2 so a label change between stages is never baked stale
+    topology: str = "off"
 
 
 def encode_batch(
@@ -759,6 +784,7 @@ def encode_batch(
     cache=None,
     track_changes: bool = True,
     mesh=None,
+    topology: str = "off",
 ) -> EncodedBatch:
     """Snapshot + pending pods → padded device batch.
 
@@ -794,6 +820,7 @@ def encode_batch(
         snapshot, pods, profile, pad=pad, resource_names=resource_names,
         nominated=nominated, prev_nt=prev_nt, cache=cache,
         track_changes=track_changes, pad_multiple=pad_multiple,
+        topology=topology,
     )
     return finalize_batch(
         sb, snapshot, nominated=nominated, resident=resident, mesh=mesh
@@ -811,6 +838,7 @@ def encode_batch_static(
     cache=None,
     track_changes: bool = True,
     pad_multiple: int = 1,
+    topology: str = "off",
 ) -> StaticBatch:
     """Stage 1: the assume-independent host encode (see StaticBatch).
     ``track_changes=False`` (serial loop) skips the pipeline-only
@@ -971,6 +999,7 @@ def encode_batch_static(
         assume_coupled=bool(folded) or dra_state is not None
         or vol_state is not None,
         cache=cache,
+        topology=topology,
     )
 
 
@@ -1161,6 +1190,23 @@ def finalize_batch(
             for i, p_ in enumerate(pods):
                 nom_gate[i, g] = e.priority >= p_.priority and e.uid != p_.uid
 
+    # topology coordinates: attached ONLY when the mode is active and some
+    # node actually carries a slice/rack label ("auto" on an unlabeled
+    # cluster leaves the leaf absent → the pytree, the compiled kernels and
+    # their outputs are bit-identical to topology-off)
+    topo_dev = None
+    if sb.topology != "off":
+        from ..state.topology import topology_tensors
+
+        tt = topology_tensors(nt)
+        if tt.labeled:
+            topo_dev = TopologyDevice(
+                slice_id=tt.slice_id,
+                rack_id=tt.rack_id,
+                num_slices=tt.num_slices,
+                num_racks=tt.num_racks,
+            )
+
     if resident is not None:
         nodes_block = resident.refresh(nt, N)
         node_upload = resident.last_upload_bytes
@@ -1226,6 +1272,7 @@ def finalize_batch(
             sb.dra_score_sig if sb.dra_score_raw is not None else None
         ),
         pod_priority=pb.priority,
+        topology=topo_dev,
     )
     if mesh is not None:
         from ..parallel.mesh import batch_shardings, node_axes_of

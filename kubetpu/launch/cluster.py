@@ -96,6 +96,7 @@ def scheduler_spec(
     partitions: int = 0,
     wire: str = "binary",
     engine: str = "greedy",
+    topology: str = "off",
     max_batch: int = 0,
     telemetry: str = "off",
     prewarm: bool = False,
@@ -117,6 +118,8 @@ def scheduler_spec(
         args += ["--replica-count", str(replica_count)]
     if partitions:
         args += ["--partitions", str(partitions)]
+    if topology and topology != "off":
+        args += ["--topology", topology]
     if max_batch:
         args += ["--max-batch", str(max_batch)]
     if telemetry and telemetry != "off":
@@ -170,6 +173,7 @@ class Cluster:
     partition: str = "race"
     wire: str = "binary"
     engine: str = "greedy"
+    topology: str = "off"
     max_batch: int = 0
     persistence: str | None = None
     telemetry: str = "off"
@@ -232,6 +236,7 @@ class Cluster:
                 replica_id=rid, partition=self.partition,
                 replica_count=self.replicas,
                 wire=self.wire, engine=self.engine,
+                topology=self.topology,
                 max_batch=self.max_batch,
                 telemetry=sched_telemetry or "off",
                 prewarm=self.prewarm, restart=self.restart, env=self.env,

@@ -193,6 +193,22 @@ class SchedulerMetricsRegistry:
             buckets=exponential_buckets(1, 2, 12),
             declared={"engine": ENGINES},
         )
+        # --- gang admission (sched.podgroup) ------------------------------
+        # quorum-met → fully-admitted latency, observed ONCE per group at
+        # first admission. Labeled by engine like the packing family so a
+        # run with no pod groups never creates the series — the sentinel's
+        # gang-admission-stall rule stays dormant on gang-free clusters
+        # (absent series extracts to None, same shape as
+        # packing-solver-iteration-spike).
+        self.gang_admission_duration = r.histogram(
+            "scheduler_gang_admission_duration_seconds",
+            "Latency from a pod group reaching quorum to its first full "
+            "admission (all members of the winning attempt assumed), by "
+            "engine. Observed once per group.",
+            labels=("engine",),
+            buckets=exponential_buckets(0.001, 2, 16),
+            declared={"engine": ENGINES},
+        )
         # API dispatcher lifetime counts, set at scrape time from
         # APIDispatcher.stats() (a gauge because the dispatcher owns the
         # monotonic counters; "errors" is the satellite's failed-API-write

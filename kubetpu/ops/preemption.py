@@ -208,3 +208,60 @@ def dry_run_preemption(
     ok = ok & potential
     node_idx = pick_node(ok, n_pdb, max_p, sum_p, n_v, early)
     return node_idx, victims, ok, n_pdb
+
+
+# --------------------------------------------------------------------------
+# gang mode (topology-aware): evict ONE whole gang, not per-pod victims
+# --------------------------------------------------------------------------
+
+
+@partial(jax.jit, static_argnames=("params", "engine"))
+def dry_run_gang_preemption(
+    b, params, candidate_masks, freed_req, freed_count, engine="greedy",
+):
+    """Gang mode of the dry-run: each candidate is "evict one low-priority
+    gang and offer its CONTIGUOUS SLICE as the node set". ``candidate_masks``
+    is (C, N) bool — the full slice the victim gang occupies; ``freed_req``
+    (C, N, R) / ``freed_count`` (C, N) are the resources/pod counts the
+    eviction returns. The preemptor gang's whole assignment engine runs
+    under each hypothesis (vmapped — all C candidates in one program, the
+    same exhaustive-search upgrade the per-pod dry run makes over the
+    reference's sampled candidates), so admission is judged by the REAL
+    filters + scores, not a resource-sum approximation.
+
+    Returns ``(counts (C,) int32, alignment (C,) int32)`` — pods the
+    preemptor would schedule under each eviction, and the slice-alignment
+    of that proposal (``ops.topology.alignment_score``).
+    """
+    import dataclasses
+
+    if engine == "batched":
+        from ..assign.batched import batched_assign_device as assign
+    else:
+        from ..assign.greedy import greedy_assign_device as assign
+
+    def one(mask, fr, fc):
+        nodes = dataclasses.replace(
+            b.nodes,
+            requested=jnp.maximum(b.nodes.requested - fr, 0),
+            nonzero_requested=jnp.maximum(b.nodes.nonzero_requested - fr, 0),
+            pod_count=jnp.maximum(b.nodes.pod_count - fc, 0),
+            node_valid=b.node_valid & mask,
+        )
+        bb = dataclasses.replace(b, nodes=nodes)
+        assignments, _ = assign(bb, params)
+        if b.topology is not None:
+            from .topology import alignment_score
+
+            align, _, _ = alignment_score(
+                assignments, b.pod_valid,
+                b.topology.slice_id, b.topology.num_slices,
+            )
+        else:
+            align = jnp.int32(0)
+        count = jnp.sum(
+            (assignments >= 0) & b.pod_valid
+        ).astype(jnp.int32)
+        return count, align
+
+    return jax.vmap(one)(candidate_masks, freed_req, freed_count)

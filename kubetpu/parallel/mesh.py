@@ -123,6 +123,15 @@ _NESTED = {
         pod_major=("update", "fa_rows", "fa_self", "ra_rows", "ea_rows",
                    "score_rows", "score_vals"),
     ),
+    # TopologyDevice: dense per-node coordinates — (N,) node-major like the
+    # resident node block (segment-sums over them reduce cross-shard via
+    # XLA collectives, same as the spread domain counts)
+    "topology": dict(
+        node_last=(),
+        pod_node=(),
+        pod_major=(),
+        node_major=("slice_id", "rack_id"),
+    ),
 }
 
 
@@ -169,7 +178,9 @@ def batch_shardings(
         parent = names[-2] if len(names) > 1 else None
         nested = _NESTED.get(parent)
         if nested is not None:
-            if field in nested["node_last"]:
+            if field in nested.get("node_major", ()):
+                s = P(axis)
+            elif field in nested["node_last"]:
                 s = P(None, axis)
             elif field in nested["pod_node"]:
                 s = P(pod_axis, axis)

@@ -200,6 +200,18 @@ class WorkloadResult:
     priority_slo_hit_rate: float | None = None
     solver_iters_per_cycle: float | None = None
     packing_weights: dict | None = None
+    # --- node-topology axis (PR 20) --------------------------------------
+    # slice-level fragmentation evidence on labeled fleets: the topology
+    # mode the run used, total labeled TPU slices, how many were FULLY
+    # free when the trace settled (benchdiff gates a drop), the fraction
+    # of labeled slices left partially occupied (0 = perfectly defragged,
+    # benchdiff gates drift), and the p99 quorum→admitted gang latency
+    # from scheduler_gang_admission_duration_seconds
+    topology: str = "off"
+    slices_total: int | None = None
+    slices_free_at_steady_state: int | None = None
+    fragmentation_index: float | None = None
+    gang_admission_p99_ms: float | None = None
     # artifact paths written next to the bench JSON when tracing is on:
     # chrome trace, /metrics text, device-side cycle records
     artifacts: dict = field(default_factory=dict)
@@ -309,6 +321,20 @@ class WorkloadResult:
             out["solver_iters_per_cycle"] = round(self.solver_iters_per_cycle, 2)
         if self.packing_weights is not None:
             out["packing_weights"] = self.packing_weights
+        if self.topology and self.topology != "off":
+            out["topology"] = self.topology
+        if self.slices_total is not None:
+            out["slices_total"] = self.slices_total
+        if self.slices_free_at_steady_state is not None:
+            out["slices_free_at_steady_state"] = (
+                self.slices_free_at_steady_state
+            )
+        if self.fragmentation_index is not None:
+            out["fragmentation_index"] = round(self.fragmentation_index, 4)
+        if self.gang_admission_p99_ms is not None:
+            out["gang_admission_p99_ms"] = round_latency_ms(
+                self.gang_admission_p99_ms
+            )
         if self.metrics_snapshot is not None:
             out["metrics"] = self.metrics_snapshot
         if self.artifacts:
@@ -1260,6 +1286,7 @@ def run_workload_trace(
     sentinel: bool = False,
     sentinel_spike: bool = False,
     spike_stall_s: float = 6.0,
+    topology: str = "off",
 ) -> WorkloadResult:
     """Replay a ``workloads.TraceProfile`` against the real scheduler loop
     and measure the admission-latency SLO: p50/p99 of enqueue→bind over
@@ -1287,7 +1314,13 @@ def run_workload_trace(
     through the replay: the loop keeps firing trace arrivals but skips
     the scheduling cycle, so the backlog accrues REAL admission latency
     — the record's ``sentinel.spike`` verdict carries the
-    fire→bundle→resolve acceptance."""
+    fire→bundle→resolve acceptance.
+
+    ``topology``: the scheduler's ``--topology`` mode. On a profile with
+    ``slices > 0`` every node carries the shared rack/slice grammar and
+    the record gains the slice-level fragmentation evidence
+    (slices_total / slices_free_at_steady_state / fragmentation_index)
+    plus gang_admission_p99_ms from the gang-admission histogram."""
     from ..sched.scheduler import Scheduler
     from . import workloads as W
 
@@ -1314,6 +1347,7 @@ def run_workload_trace(
             encode_cache=encode_cache,
             feature_gates={"GenericWorkload": True, "GangScheduling": True},
             sentinel=sentinel_obj if sentinel_obj is not None else False,
+            topology=topology,
         )
         client.sched = sched
         driver = _TraceDirectDriver(sched, client)
@@ -1330,6 +1364,7 @@ def run_workload_trace(
             encode_cache=encode_cache,
             feature_gates={"GenericWorkload": True, "GangScheduling": True},
             sentinel=sentinel_obj if sentinel_obj is not None else False,
+            topology=topology,
         )
         informers = SchedulerInformers(remote, sched)
         informers.start()
@@ -1347,12 +1382,13 @@ def run_workload_trace(
     truncated = False
     try:
         # initial cluster
+        slices = getattr(profile, "slices", 0)
         if mode == "direct":
             for i in range(profile.nodes):
-                driver.add_node(W.node_default(i, profile.zones))
+                driver.add_node(W.node_default(i, profile.zones, slices))
         else:
             nodes = [
-                W.node_default(i, profile.zones)
+                W.node_default(i, profile.zones, slices)
                 for i in range(profile.nodes)
             ]
             _bulk_create(
@@ -1416,7 +1452,9 @@ def run_workload_trace(
                     if pod is not None:
                         driver.delete_pod(key, pod)
                 elif ev.kind == "add_node":
-                    driver.add_node(make_trace_node(ev.name, profile.zones))
+                    driver.add_node(
+                        make_trace_node(ev.name, profile.zones, slices)
+                    )
                 elif ev.kind == "drain_node":
                     driver.drain_node(ev.name)
                 elif ev.kind == "create_group":
@@ -1518,6 +1556,7 @@ def run_workload_trace(
         measured = len(lats)
         throughput = measured / duration if duration > 0 else 0.0
         traffic = _device_traffic_stats(sched, cycles0, duration)
+        topo_stats = _trace_topology_stats(sched)
         return WorkloadResult(
             case_name=f"Trace_{profile.name}",
             workload_name=(
@@ -1552,6 +1591,13 @@ def run_workload_trace(
             peak_rss_bytes=rss.peak,
             truncated=truncated,
             sentinel=sentinel_report,
+            topology=topology,
+            slices_total=topo_stats.get("slices_total"),
+            slices_free_at_steady_state=topo_stats.get(
+                "slices_free_at_steady_state"
+            ),
+            fragmentation_index=topo_stats.get("fragmentation_index"),
+            gang_admission_p99_ms=topo_stats.get("gang_admission_p99_ms"),
             trace_stats=trace_stats,
             metrics_snapshot=sched.metrics.prom.snapshot(baseline=prom_base),
             artifacts=artifacts,
@@ -1563,11 +1609,50 @@ def run_workload_trace(
             srv.close()
 
 
-def make_trace_node(name: str, zones: tuple[str, ...] = ()) -> t.Node:
+def _trace_topology_stats(sched) -> dict:
+    """Slice-level fragmentation at trace end, computed host-side from
+    the FINAL snapshot in one pass over node infos: a slice is FREE when
+    no pod sits anywhere on it; fragmentation_index is the share of
+    labeled slices left PARTIALLY occupied (some nodes busy, some free —
+    the state that blocks future aligned gangs). gang_admission_p99_ms
+    comes from the gang-admission histogram when any gang admitted.
+    Empty dict on an unlabeled fleet with no gang observations."""
+    from ..state.topology import SLICE_KEY
+
+    snap = sched.cache.update_snapshot()
+    occupancy: dict[str, list[int]] = {}
+    for info in snap.nodes.values():
+        val = info.node.labels_dict().get(SLICE_KEY)
+        if val is not None:
+            occupancy.setdefault(val, []).append(len(info.pods))
+    out: dict = {}
+    if occupancy:
+        total = len(occupancy)
+        free = sum(
+            1 for counts in occupancy.values()
+            if not any(c > 0 for c in counts)
+        )
+        partial = sum(
+            1 for counts in occupancy.values()
+            if any(c > 0 for c in counts) and any(c == 0 for c in counts)
+        )
+        out["slices_total"] = total
+        out["slices_free_at_steady_state"] = free
+        out["fragmentation_index"] = partial / total
+    h = sched.metrics.prom.gang_admission_duration.merged()
+    if h.total:
+        out["gang_admission_p99_ms"] = h.quantile(0.99) * 1000.0
+    return out
+
+
+def make_trace_node(
+    name: str, zones: tuple[str, ...] = (), slices: int = 0
+) -> t.Node:
     """A wave node: default scheduler-perf shape under the trace's own
     name (drains address nodes by name). Zone assignment uses a STABLE
     hash — builtin hash() is salted per process, which would break the
-    trace determinism contract across runs."""
+    trace determinism contract across runs. Rack/slice labels come from
+    the same ``trace_topology_labels`` grammar as the initial fleet."""
     import zlib
 
     from ..api.wrappers import make_node
@@ -1575,6 +1660,7 @@ def make_trace_node(name: str, zones: tuple[str, ...] = ()) -> t.Node:
     labels = {W.HOSTNAME_KEY: name}
     if zones:
         labels[W.ZONE_KEY] = zones[zlib.crc32(name.encode()) % len(zones)]
+    labels.update(W.trace_topology_labels(name, slices))
     return make_node(
         name, cpu_milli=4000, memory=32 * 1024**3, pods=110, labels=labels,
     )
@@ -2956,7 +3042,8 @@ def run_trace_multiprocess(
     bind_time: dict[str, float] = {}
     try:
         admin = RemoteStore(cluster.api_url, wire=wire)
-        nodes = [W.node_default(i, profile.zones)
+        nodes = [W.node_default(i, profile.zones,
+                                getattr(profile, "slices", 0))
                  for i in range(profile.nodes)]
         _bulk_create(admin, NODES, [(nd.name, nd) for nd in nodes])
 
@@ -3018,7 +3105,9 @@ def run_trace_multiprocess(
                         pass    # already gone / rebound — the trace goes on
                 elif ev.kind == "add_node":
                     admin.create(NODES, ev.name,
-                                 make_trace_node(ev.name, profile.zones))
+                                 make_trace_node(
+                                     ev.name, profile.zones,
+                                     getattr(profile, "slices", 0)))
                 elif ev.kind == "drain_node":
                     try:
                         admin.delete(NODES, ev.name)

@@ -26,6 +26,7 @@ import numpy as np
 
 from ..api import types as t
 from ..api.wrappers import make_node, make_pod, pod_affinity_term, spread_constraint
+from ..state.topology import RACK_KEY, SLICE_KEY
 
 ZONE_KEY = "topology.kubernetes.io/zone"
 HOSTNAME_KEY = "kubernetes.io/hostname"
@@ -35,14 +36,33 @@ HOSTNAME_KEY = "kubernetes.io/hostname"
 # ---------------------------------------------------------------------------
 
 
-def node_default(i: int, zones: tuple[str, ...] = ()) -> t.Node:
+def trace_topology_labels(name: str, slices: int) -> dict[str, str]:
+    """The ONE rack/TPU-slice label grammar every node generator shares
+    (initial fleet, autoscaler wave nodes, tests): a stable crc32 of the
+    node name picks the slice — builtin hash() is salted per process,
+    which would break the trace determinism contract — and racks group
+    four slices each. ``slices <= 0`` means an unlabeled fleet (the
+    ``--topology auto`` parity case)."""
+    if slices <= 0:
+        return {}
+    import zlib
+
+    s = zlib.crc32(name.encode()) % slices
+    return {SLICE_KEY: f"slice-{s:03d}", RACK_KEY: f"rack-{s // 4:02d}"}
+
+
+def node_default(
+    i: int, zones: tuple[str, ...] = (), slices: int = 0
+) -> t.Node:
     """templates/node-default.yaml: 4 cpu / 32Gi / 110 pods, plus the
-    labelNodePrepareStrategy zone label (round-robin over ``zones``) and the
-    kubelet-maintained hostname label."""
+    labelNodePrepareStrategy zone label (round-robin over ``zones``), the
+    kubelet-maintained hostname label, and — when ``slices`` — the shared
+    rack/TPU-slice grammar (trace_topology_labels)."""
     name = f"scheduler-perf-{i}"
     labels = {HOSTNAME_KEY: name}
     if zones:
         labels[ZONE_KEY] = zones[i % len(zones)]
+    labels.update(trace_topology_labels(name, slices))
     return make_node(
         name, cpu_milli=4000, memory=32 * 1024**3, pods=110, labels=labels
     )
@@ -1193,12 +1213,72 @@ def multitenant_trace(
     return _sorted_events(events)
 
 
+def train_serve_churn_trace(
+    seed: int = 0,
+    duration_s: float = 30.0,
+    serve_rate: float = 30.0,
+    gangs: int = 8,
+    gang_size: int = 4,
+    gang_lifetime_s: float = 8.0,
+    churn: float = 0.3,
+    namespace: str = "trace",
+) -> tuple:
+    """Mixed train+serve churn: latency-sensitive SERVE pods arrive at
+    ``serve_rate`` (a seeded fraction ``churn`` of them is deleted a few
+    seconds later — rolling serve churn), while TRAIN gangs (quorum
+    ``gang_size``) arrive at seeded times and DEPART ``gang_lifetime_s``
+    later, members deleted. On a sliced fleet the scheduling question is
+    whether departed train gangs leave their slices FULLY free at steady
+    state, or scattered serve pods keep every slice partially occupied —
+    the fragmentation-over-time evidence."""
+    rng = np.random.default_rng(seed)
+    events: list[TraceEvent] = []
+    total = int(duration_s * serve_rate)
+    kill = rng.random(total) < churn
+    lifetimes = rng.uniform(2.0, 6.0, size=total)
+    for j in range(total):
+        at = j / serve_rate
+        events.append(TraceEvent(
+            at_s=at, kind="create_pod", name=f"serve-{j}",
+            namespace=namespace, template="prio", priority=8,
+        ))
+        if kill[j]:
+            events.append(TraceEvent(
+                at_s=min(at + float(lifetimes[j]), 0.95 * duration_s),
+                kind="delete_pod", name=f"serve-{j}", namespace=namespace,
+            ))
+    starts = np.sort(rng.uniform(
+        0.1 * duration_s, 0.6 * duration_s, size=gangs
+    ))
+    for g, t0 in enumerate(starts):
+        events.append(TraceEvent(
+            at_s=float(t0), kind="create_group", name=f"train-{g}",
+            namespace=namespace, min_count=gang_size,
+        ))
+        for m in range(gang_size):
+            events.append(TraceEvent(
+                at_s=float(t0) + 0.05 * (m + 1), kind="create_pod",
+                name=f"train-{g}-m{m}", namespace=namespace,
+                template="gang", priority=5, group=f"train-{g}",
+            ))
+            end = float(t0) + gang_lifetime_s + 0.05 * m
+            if end < 0.9 * duration_s:
+                events.append(TraceEvent(
+                    at_s=end, kind="delete_pod", name=f"train-{g}-m{m}",
+                    namespace=namespace,
+                ))
+    return _sorted_events(events)
+
+
 @dataclass(frozen=True)
 class TraceProfile:
     """A named trace shape: generator + params + initial cluster size +
     the admission SLO budget its record is judged against. ``events()`` is
     the deterministic op sequence; ``scaled()`` derives bench rungs (the
-    50k/100k ladder) without re-declaring the shape."""
+    50k/100k ladder) without re-declaring the shape. ``slices > 0`` stamps
+    every node (initial fleet AND wave nodes — one grammar,
+    trace_topology_labels) with rack/TPU-slice labels so the scenario can
+    run with the node-topology axis engaged."""
 
     name: str
     gen: Callable[..., tuple]
@@ -1207,6 +1287,7 @@ class TraceProfile:
     slo_budget_ms: float
     seed: int = 0
     zones: tuple[str, ...] = ("zone-a", "zone-b", "zone-c")
+    slices: int = 0
     description: str = ""
 
     def events(self) -> tuple:
@@ -1275,8 +1356,46 @@ _trace(TraceProfile(
     params=dict(duration_s=30.0, rate=40.0, gangs=6, gang_size=4),
     nodes=2000,
     slo_budget_ms=5000.0,
+    slices=32,
     description="priority tiers + gangs + spread constraints interleaved "
-                "(the mixed-tenant admission shape)",
+                "(the mixed-tenant admission shape) on a sliced fleet",
+))
+
+_trace(TraceProfile(
+    name="train-serve-churn",
+    gen=train_serve_churn_trace,
+    params=dict(duration_s=30.0, serve_rate=30.0, gangs=8, gang_size=4,
+                gang_lifetime_s=8.0, churn=0.3),
+    nodes=512,
+    slo_budget_ms=5000.0,
+    slices=16,
+    description="mixed train gangs + serve churn on a sliced fleet "
+                "(topology on vs off: do train departures leave slices "
+                "fully free?)",
+))
+
+_trace(TraceProfile(
+    name="slice-fragmentation",
+    gen=train_serve_churn_trace,
+    params=dict(duration_s=30.0, serve_rate=20.0, gangs=10, gang_size=4,
+                gang_lifetime_s=6.0, churn=0.5),
+    nodes=256,
+    slo_budget_ms=5000.0,
+    slices=16,
+    description="fragmentation-over-time: heavy gang arrival/departure "
+                "churn — slices_free_at_steady_state is the gated metric",
+))
+
+_trace(TraceProfile(
+    name="gang-contention",
+    gen=multitenant_trace,
+    params=dict(duration_s=20.0, rate=60.0, gangs=12, gang_size=6),
+    nodes=128,
+    slo_budget_ms=8000.0,
+    slices=8,
+    description="gang admission latency under contention: many gangs "
+                "racing a small sliced fleet against a dense pod stream "
+                "(gang_admission_p99_ms is the gated metric)",
 ))
 
 

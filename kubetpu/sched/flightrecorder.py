@@ -260,6 +260,7 @@ class FlightRecorder:
         engine: str = "",
         objective_value: "float | None" = None,
         solver_iters: "int | None" = None,
+        skipped_reason: str | None = None,
     ) -> None:
         """One decision record per pod of the finished cycle. ``idx`` is
         the scan's assignment vector (node index or -1). ``breakdown``
@@ -270,7 +271,11 @@ class FlightRecorder:
         cycle so ``kubetpu explain`` can render the packing rationale, and
         the breakdown's ``top_nodes[0]`` (the cycle-start masked argmax —
         exactly what the greedy scan would have picked first) doubles as
-        the greedy counterfactual beside it."""
+        the greedy counterfactual beside it. ``skipped_reason`` names WHY
+        ``breakdown=False`` was passed (e.g. ``"mesh"`` — the sharded
+        batch is not re-evaluated here) so explain renders "breakdown
+        skipped: mesh" instead of an empty block reading as
+        "no rejections"."""
         self._resolve_pending()
         summary_dev = masks_dev = None
         node_names = batch.node_names
@@ -321,6 +326,8 @@ class FlightRecorder:
                 rec["objective_value"] = objective_value
             if solver_iters is not None:
                 rec["solver_iters"] = solver_iters
+            if skipped_reason and not breakdown:
+                rec["skipped_reason"] = skipped_reason
             fl = self._flights.get(info.key)
             if fl is not None and fl.trace_id:
                 rec["trace_id"] = fl.trace_id
@@ -461,6 +468,50 @@ class FlightRecorder:
         if rec is not None:
             rec["nominated_node"] = nominated
             rec["preemption_victims"] = list(victims)[:16]
+
+    def note_gang(
+        self,
+        key: str,
+        status: str,
+        engine: str = "",
+        placement: str | None = None,
+        members: int = 0,
+        need: int = 0,
+        alignment: "int | None" = None,
+        slices_considered=(),
+        fragmentation_delta: "int | None" = None,
+        victims=(),
+        victim_group: str | None = None,
+    ) -> None:
+        """One record per GANG placement decision, keyed by the group's
+        ``ns/name`` — WHY the gang landed where it did: the winning
+        placement, its slice-alignment score, which slices the search
+        considered, the fragmentation delta (slices newly opened minus
+        freed), and — for topology-aware preemption — the evicted gang +
+        its member pods. ``kubetpu explain ns/name`` renders it."""
+        rec: dict[str, Any] = {
+            "pod": key,
+            "kind": "gang",
+            "status": status,
+            "replica": self.replica,
+            "members": members,
+            "need": need,
+        }
+        if engine:
+            rec["engine"] = engine
+        if placement is not None:
+            rec["placement"] = placement
+        if alignment is not None:
+            rec["alignment_score"] = int(alignment)
+        if slices_considered:
+            rec["slices_considered"] = list(slices_considered)[:16]
+        if fragmentation_delta is not None:
+            rec["fragmentation_delta"] = int(fragmentation_delta)
+        if victims:
+            rec["preemption_victims"] = list(victims)[:16]
+        if victim_group is not None:
+            rec["victim_group"] = victim_group
+        self._insert(rec)
 
     def note_bind(
         self,

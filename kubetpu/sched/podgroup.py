@@ -62,6 +62,7 @@ class GroupEntry:
     timestamp: float = 0.0
     backoff_until: float = 0.0
     parked: bool = False                      # unschedulable pool (event-woken)
+    admitted: bool = False                    # gang admission latency observed
 
     def all_count(self) -> int:
         return len(self.pending) + len(self.scheduled)
@@ -213,6 +214,25 @@ class PodGroupManager:
 # --------------------------------------------------------------------------
 
 
+def _topology_labeled(sched: "Scheduler") -> bool:
+    """Whether the topology axis is ACTIVE for gang routing: mode is not
+    ``off`` AND at least one node carries a slice/rack label. ``auto``
+    (and even ``on``) on an unlabeled cluster resolves to inactive, so
+    unlabeled runs stay bit-identical with ``--topology off``."""
+    if getattr(sched, "topology", "off") == "off":
+        return False
+    from ..state.topology import RACK_KEY, SLICE_KEY, topology_tensors
+
+    nt = sched._prev_nt
+    if nt is not None:
+        return topology_tensors(nt).labeled
+    for info in sched._snapshot.nodes.values():
+        labels = info.node.labels_dict()
+        if SLICE_KEY in labels or RACK_KEY in labels:
+            return True
+    return False
+
+
 def generate_placements(
     sched: "Scheduler", e: GroupEntry, node_names: list[str], num_nodes: int,
     node_capacity: int,
@@ -229,6 +249,31 @@ def generate_placements(
     group = e.group
     keys = group.topology_keys if group is not None else ()
     if not keys:
+        if _topology_labeled(sched):
+            from ..state.topology import SLICE_KEY
+
+            snapshot = sched._snapshot
+            slices: dict[str, list[int]] = {}
+            for i, name in enumerate(node_names):
+                info = snapshot.nodes.get(name)
+                if info is None:
+                    continue
+                val = info.node.labels_dict().get(SLICE_KEY)
+                if val is not None:
+                    slices.setdefault(val, []).append(i)
+            if slices:
+                # one candidate per TPU slice (alignment-first), PLUS the
+                # all-nodes fallback so a gang too large for any single
+                # slice still admits; the count-then-alignment selection
+                # in _placement_group_cycle prefers a single-slice fit
+                # (ties on count, wins on alignment)
+                ordered = sorted(slices)
+                names = [f"slice:{v}" for v in ordered] + ["<all>"]
+                masks = np.zeros((len(names), node_capacity), dtype=bool)
+                for d, v in enumerate(ordered):
+                    masks[d, slices[v]] = True
+                masks[-1, :num_nodes] = True
+                return masks, names
         mask = np.zeros((1, node_capacity), dtype=bool)
         mask[0, :num_nodes] = True
         return mask, ["<all>"]
@@ -279,6 +324,10 @@ def schedule_pod_groups(sched: "Scheduler", budget: int) -> dict[str, int]:
     if not ready:
         return {"scheduled": 0, "unschedulable": 0}
 
+    # routing reads node labels, so it needs a CURRENT snapshot (the
+    # group lane can run before any per-pod cycle refreshed it);
+    # incremental update_snapshot makes the refresh O(Δ)
+    sched._snapshot = sched.cache.update_snapshot(sched._snapshot)
     scheduled = unschedulable = 0
     plain: list[tuple[str, GroupEntry]] = []
     constrained: list[tuple[str, GroupEntry]] = []
@@ -287,11 +336,15 @@ def schedule_pod_groups(sched: "Scheduler", budget: int) -> dict[str, int]:
     # (schedule_one_podgroup.go:759: non-TAS falls back to the default
     # algorithm, which ignores topology constraints)
     tas = sched.feature_gates.enabled("TopologyAwareWorkloadScheduling")
+    # the node-topology axis routes EVERY gang through the placement
+    # search on labeled clusters: per-slice candidate masks give the
+    # alignment-first landing + the slice-eviction preemption mode
+    topo = _topology_labeled(sched)
     for key, e in ready:
         if total + len(e.pending) > budget and (plain or constrained):
             break
         total += len(e.pending)
-        if tas and e.group is not None and e.group.topology_keys:
+        if (tas and e.group is not None and e.group.topology_keys) or topo:
             constrained.append((key, e))
         else:
             plain.append((key, e))
@@ -356,6 +409,7 @@ def _coalesced_group_cycle(
     batch = rt.encode_batch(
         sched._snapshot, pods, profile,
         nominated=sched.nominator.entries(), prev_nt=sched._prev_nt,
+        topology=sched.topology,
     )
     sched._prev_nt = batch.node_tensors
     params = rt.score_params(profile, batch.resource_names)
@@ -387,6 +441,14 @@ def _coalesced_group_cycle(
                 sched.podgroups.group_failed(e)
             scheduled += mgr_scheduled
             unschedulable += len(infos) - mgr_scheduled
+            if mgr_scheduled:
+                _note_gang_admitted(sched, e)
+                if sched.flight_recorder is not None:
+                    sched.flight_recorder.note_gang(
+                        _group_key(e, infos), "placed",
+                        engine=sched.engine, placement="<coalesced>",
+                        members=len(infos), need=e.min_count(),
+                    )
         else:
             # all-or-nothing rollback: nothing was assumed; park the group
             for info in infos:
@@ -413,6 +475,7 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
     batch = rt.encode_batch(
         sched._snapshot, pods, profile,
         nominated=sched.nominator.entries(), prev_nt=sched._prev_nt,
+        topology=sched.topology,
     )
     sched._prev_nt = batch.node_tensors
     gen = generate_placements(
@@ -427,22 +490,35 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
     masks, names = gen
     params = rt.score_params(profile, batch.resource_names)
     device_batch = sched._apply_extenders(batch, pods)
-    assignments, counts = placement_assign_device(
+    assignments, counts, alignment = placement_assign_device(
         device_batch, params, jnp.asarray(masks), engine=sched.engine
     )
     counts = np.asarray(jax.device_get(counts))
+    alignment = np.asarray(jax.device_get(alignment))
     assignments = np.asarray(jax.device_get(assignments))
     sched.metrics.note_attempts(len(infos))
 
     need = e.min_count() - len(e.scheduled)
     feasible = counts >= need
     if not feasible.any():
+        if _try_gang_preemption(sched, e, infos, batch, device_batch,
+                                params, need):
+            return 0, len(infos)
         for info in infos:
             e.pending[info.key] = info
         sched.podgroups.group_failed(e)
         return 0, len(infos)
-    # PodGroupPodsCount: maximize scheduled + proposed; first-best tie-break
-    best = int(np.argmax(np.where(feasible, counts, -1)))
+    # PodGroupPodsCount: maximize scheduled + proposed, then slice
+    # alignment (same-slice concentration), keeping np.argmax's
+    # first-best tie-break. alignment ≤ members² < 2^32 always, so one
+    # int64 lexicographic key is exact.
+    score = np.where(
+        feasible,
+        counts.astype(np.int64) * (np.int64(1) << 32)
+        + alignment.astype(np.int64),
+        np.int64(-1),
+    )
+    best = int(np.argmax(score))
     rows = assignments[best]
     scheduled = 0
     for k, info in enumerate(infos):
@@ -456,7 +532,193 @@ def _placement_group_cycle(sched: "Scheduler", e: GroupEntry) -> tuple[int, int]
         sched.podgroups.group_attempted(e)
     else:
         sched.podgroups.group_failed(e)   # leftovers park with backoff
+    if scheduled:
+        _note_gang_admitted(sched, e)
+        if sched.flight_recorder is not None:
+            sched.flight_recorder.note_gang(
+                _group_key(e, infos), "placed", engine=sched.engine,
+                placement=names[best], members=len(infos), need=need,
+                alignment=int(alignment[best]),
+                slices_considered=tuple(names),
+                fragmentation_delta=_frag_delta(
+                    batch.node_tensors, rows, len(batch.node_names)),
+            )
     return scheduled, len(infos) - scheduled
+
+
+def _group_key(e: GroupEntry, infos: list[QueuedPodInfo]) -> str:
+    if e.group is not None:
+        return e.group.key
+    p = infos[0].pod
+    return f"{p.namespace}/{p.scheduling_group}"
+
+
+def _note_gang_admitted(sched: "Scheduler", e: GroupEntry) -> None:
+    """First full admission of a group: observe the quorum→admitted
+    latency ONCE. The series stays absent on gang-free runs — that
+    absence keeps the sentinel's gang-admission-stall rule dormant."""
+    if e.admitted:
+        return
+    e.admitted = True
+    sched.metrics.prom.gang_admission_duration.labels(sched.engine).observe(
+        max(sched.clock() - e.timestamp, 0.0)
+    )
+
+
+def _frag_delta(nt, rows, num_nodes: int) -> int | None:
+    """How many fully-free slices this placement newly opens — the
+    fragmentation cost of the landing, rendered by ``kubetpu explain``.
+    None when the cluster carries no slice labels."""
+    from ..state.topology import topology_tensors
+
+    tt = topology_tensors(nt)
+    if not tt.num_slices:
+        return None
+    sid = np.asarray(tt.slice_id)[:num_nodes]
+    busy = np.zeros(tt.num_slices + 1, dtype=bool)
+    pc = np.asarray(nt.pod_count)[:num_nodes]
+    np.logical_or.at(busy, sid, pc > 0)
+    opened: set[int] = set()
+    for j in rows:
+        j = int(j)
+        if 0 <= j < num_nodes:
+            s = int(sid[j])
+            if s < tt.num_slices and not busy[s]:
+                opened.add(s)
+    return len(opened)
+
+
+def _try_gang_preemption(
+    sched: "Scheduler", e: GroupEntry, infos: list[QueuedPodInfo],
+    batch, device_batch, params, need: int,
+) -> bool:
+    """Topology-aware gang preemption: no placement fits, so offer each
+    low-priority victim GANG's slice as a contiguous candidate set and
+    dry-run the preemptor's whole engine under every "that gang evicted"
+    hypothesis on device (ops.preemption.dry_run_gang_preemption). A
+    feasible hypothesis evicts exactly ONE victim gang — every member via
+    DeleteVictimCall — and parks the preemptor until the deletes land
+    (assigned-pod deletes fire wake_all, which un-parks it).
+
+    Victim choice among feasible hypotheses: lowest victim priority,
+    then fewest victim pods, then highest slice alignment of the
+    resulting proposal. Returns True when victims were dispatched."""
+    import jax
+    import jax.numpy as jnp
+
+    if sched._post_filter is None or device_batch.topology is None:
+        return False
+    from ..ops.preemption import dry_run_gang_preemption
+    from ..state.topology import SLICE_KEY
+    from .api_dispatcher import DeleteVictimCall
+
+    gkey = _group_key(e, infos)
+    prior = sched._preempting.get(gkey)
+    if prior:
+        live = {u for u in prior if sched.cache.has_pod(u)}
+        if live:
+            sched._preempting[gkey] = live
+            return False          # earlier eviction still in flight
+        sched._preempting.pop(gkey, None)
+
+    pprio = max((i.pod.priority for i in infos), default=0)
+    node_index = {name: i for i, name in enumerate(batch.node_names)}
+    snapshot = sched._snapshot
+    nc, r = device_batch.nodes.requested.shape
+    ridx = {name: j for j, name in enumerate(batch.resource_names) if j < r}
+
+    cands = []   # (victim_key, victim_prio, [pods], slice_val, slice_rows)
+    for vkey, ve in sched.podgroups.entries.items():
+        if ve is e or not ve.scheduled:
+            continue
+        vpods: list[t.Pod] = []
+        vnodes: list[str] = []
+        for pk, node in ve.scheduled.items():
+            ninfo = snapshot.nodes.get(node)
+            if ninfo is None:
+                continue
+            for p in ninfo.pods.values():
+                if pod_key(p) == pk:
+                    vpods.append(p)
+                    vnodes.append(node)
+                    break
+        if not vpods:
+            continue
+        vprio = max(p.priority for p in vpods)
+        if vprio >= pprio:
+            continue              # only strictly lower-priority gangs
+        slice_vals = set()
+        for node in vnodes:
+            ninfo = snapshot.nodes.get(node)
+            val = (ninfo.node.labels_dict().get(SLICE_KEY)
+                   if ninfo is not None else None)
+            slice_vals.add(val)
+        if len(slice_vals) != 1 or None in slice_vals:
+            continue              # victims must sit on ONE labeled slice
+        sval = next(iter(slice_vals))
+        srows = [
+            i for i, name in enumerate(batch.node_names)
+            if (ni := snapshot.nodes.get(name)) is not None
+            and ni.node.labels_dict().get(SLICE_KEY) == sval
+        ]
+        if srows:
+            cands.append((vkey, vprio, vpods, sval, srows))
+    if not cands:
+        return False
+
+    c = len(cands)
+    masks = np.zeros((c, nc), dtype=bool)
+    freed_req = np.zeros((c, nc, r), dtype=np.int64)
+    freed_count = np.zeros((c, nc), dtype=np.int32)
+    for ci, (_, _, vpods, _, srows) in enumerate(cands):
+        masks[ci, srows] = True
+        for p in vpods:
+            j = node_index.get(p.node_name)
+            if j is None:
+                continue
+            freed_count[ci, j] += 1
+            for k, v in p.requests:
+                col = ridx.get(k)
+                if col is not None:
+                    freed_req[ci, j, col] += v
+    counts, alignment = dry_run_gang_preemption(
+        device_batch, params, jnp.asarray(masks), jnp.asarray(freed_req),
+        jnp.asarray(freed_count),
+        engine="batched" if sched.engine == "batched" else "greedy",
+    )
+    counts = np.asarray(jax.device_get(counts))
+    alignment = np.asarray(jax.device_get(alignment))
+
+    best = None
+    for ci, (vkey, vprio, vpods, sval, _) in enumerate(cands):
+        if int(counts[ci]) < need:
+            continue
+        key = (vprio, len(vpods), -int(alignment[ci]))
+        if best is None or key < best[0]:
+            best = (key, ci, vkey, vpods, sval)
+    if best is None:
+        return False
+
+    _, ci, vkey, vpods, sval = best
+    for p in vpods:
+        sched.dispatcher.add(DeleteVictimCall(p, preemptor_key=gkey))
+    sched._preempting[gkey] = {p.uid for p in vpods}
+    sched.metrics.prom.preemption_victims.observe(len(vpods))
+    if sched.flight_recorder is not None:
+        sched.flight_recorder.note_gang(
+            gkey, "preempting", engine=sched.engine,
+            placement=f"slice:{sval}", members=len(infos), need=need,
+            alignment=int(alignment[ci]),
+            slices_considered=tuple(f"slice:{v}" for _, _, _, v, _ in cands),
+            victims=tuple(pod_key(p) for p in vpods), victim_group=vkey,
+        )
+    # not unschedulable — WAITING on the dispatched evictions: park
+    # without backoff (the victims' assigned-pod deletes wake_all)
+    for info in infos:
+        e.pending[info.key] = info
+    e.attempts += 1
+    e.parked = True
+    return True
 
 
 def _bind_member(

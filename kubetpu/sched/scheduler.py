@@ -186,6 +186,7 @@ class Scheduler:
         replica_id: str = "",
         federation_mode: str = "",
         sentinel: "bool | Any" = False,
+        topology: str = "off",
     ) -> None:
         """``engine``: "greedy" (per-pod lax.scan, exact reference
         semantics) or "batched" (capacity-coupled rounds,
@@ -249,7 +250,16 @@ class Scheduler:
         metrics text, tracer, queue and cycle records, evaluated at the
         cycle boundary (``maybe_evaluate`` — no extra thread), and served
         at /debug/alerts + /debug/bundle. ``False`` (default) runs zero
-        extra work."""
+        extra work.
+        ``topology``: topology-aware scoring over rack/TPU-slice node
+        labels (state.topology) — ``"on"``, ``"off"`` or ``"auto"``
+        (active only when some node carries a topology label). Active
+        topology attaches the dense coordinate block to every encoded
+        batch: gang placement scores slice alignment, the packing
+        objective prices slice fragmentation, and preemption can evict
+        one whole low-priority gang to admit an aligned one. ``"off"`` —
+        and ``"auto"`` on an unlabeled cluster — is bit-identical to a
+        build without the feature (the block is an absent pytree leaf)."""
         from ..framework.featuregate import FeatureGate
 
         self.recorder = recorder
@@ -285,6 +295,9 @@ class Scheduler:
         else:
             raise ValueError(f"unknown engine {engine!r}")
         self.engine = engine
+        if topology not in ("on", "off", "auto"):
+            raise ValueError(f"unknown topology mode {topology!r}")
+        self.topology = topology
         self.cache = Cache(clock=clock)
         self.clock = clock
         self.max_batch = max_batch
@@ -913,6 +926,7 @@ class Scheduler:
                 cache=self.encode_cache,
                 track_changes=self.pipeline,
                 mesh=self.mesh,
+                topology=self.topology,
             )
             self._prev_nt = batch.node_tensors
             params = rt.score_params(self.profile, batch.resource_names)
@@ -1135,6 +1149,7 @@ class Scheduler:
                 nominated=(), prev_nt=self._prev_nt,
                 cache=self.encode_cache,
                 pad_multiple=self._pad_multiple,
+                topology=self.topology,
             )
         except Exception:
             # stage 1 is an optimization: any failure falls back to the
@@ -1252,6 +1267,7 @@ class Scheduler:
                         cache=self.encode_cache,
                         track_changes=self.pipeline,
                         mesh=self.mesh,
+                        topology=self.topology,
                     )
                 if self.encode_cache is not None and enc_sp is not None:
                     # gather-vs-fresh-vs-invalidate: how this cycle's rows
@@ -1466,6 +1482,9 @@ class Scheduler:
                         engine=self.engine,
                         objective_value=objective_value,
                         solver_iters=solver_iters,
+                        skipped_reason=(
+                            None if self.mesh is None else "mesh"
+                        ),
                     )
                 except Exception:
                     pass    # diagnostics must never fail the cycle

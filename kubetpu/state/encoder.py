@@ -218,6 +218,10 @@ class NodeTensors:
     # walks THIS set, not all N nodes (an O(N)-python-per-cycle wall at
     # 100k nodes for the port-free steady state)
     nodes_with_ports: set = field(repr=False, default_factory=set)
+    # memoized dense topology coordinates (state.topology.TopologyTensors);
+    # cleared by ``_refresh_tensors`` whenever a node object was replaced
+    # or appended, since labels may have moved under the coordinates
+    topo_memo: object = field(repr=False, default=None)
 
     @property
     def num_nodes(self) -> int:
@@ -607,6 +611,10 @@ def _refresh_tensors(
     prev.last_values_changed = values_changed
     prev.last_nodes_replaced = nodes_replaced
     prev.last_pods_mutated = pods_mutated
+    if nodes_replaced:
+        # replaced/appended node objects may carry different topology
+        # labels — the dense coordinate memo no longer describes them
+        prev.topo_memo = None
     if prev.pending_device_rows is not None:
         prev.pending_device_rows.update(dirty)
     return prev

@@ -168,6 +168,27 @@ DEFAULT_RULES: tuple[Rule, ...] = (
         resolve_intervals=3,
     ),
     Rule(
+        name="gang-admission-stall",
+        kind=BURN_RATE,
+        series="scheduler_gang_admission_duration_seconds",
+        severity=WARNING,
+        description="gang admission (quorum→fully-admitted) is burning "
+                    "its declared slo_budget_ms faster than 6x on both "
+                    "windows — pod groups are starving behind churn or "
+                    "fragmentation (dormant when no pod groups admit: "
+                    "the series is absent, and dormant without a "
+                    "declared trace budget)",
+        objective=0.99,
+        budget_ms=None,           # the run's DECLARED budget, like
+                                  # admission-slo-burn
+        short_window_s=30.0,
+        long_window_s=300.0,
+        burn_threshold=6.0,
+        min_events=5,             # gangs are rare events vs pods
+        for_intervals=1,
+        resolve_intervals=3,
+    ),
+    Rule(
         name="federation-conflict-storm",
         kind=RATIO,
         series="scheduler_federation_conflicts_total",

@@ -1,0 +1,22 @@
+"""Known-bad: topology coordinate tensors shipped to device outside the
+blessed encode/finalize/shard seams (TP001)."""
+
+import jax
+import jax.numpy as jnp
+
+
+def score_slice_badly(tt, assignments):
+    # the route a generic device_put scan cannot see: jnp.asarray of a
+    # host coordinate array IS a transfer, one fresh device array per call
+    sid = jnp.asarray(tt.slice_id)  # expect: TP001
+    return sid[assignments]
+
+
+def ship_rack_badly(rack_id):
+    return jax.device_put(rack_id)  # expect: HT001,TP001
+
+
+def ship_memo_badly(nt):
+    from kubetpu.state.topology import topology_tensors
+
+    return jnp.array(topology_tensors(nt).slice_id)  # expect: TP001

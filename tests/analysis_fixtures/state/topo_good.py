@@ -1,0 +1,19 @@
+"""Known-good: topology coordinates stay host-side numpy until the
+blessed batched placement ships them; host math on them is free."""
+
+import numpy as np
+
+
+def free_slice_count(tt, pod_count):
+    # host-side occupancy math on the numpy coordinates — no transfer
+    sid = np.asarray(tt.slice_id)
+    busy = np.zeros(tt.num_slices + 1, dtype=bool)
+    np.logical_or.at(busy, sid, pod_count > 0)
+    return int((~busy[:-1]).sum())
+
+
+def dense_remap(labels):
+    # building the dense int32 coordinates is pure host work
+    values = sorted(set(labels))
+    index = {v: i for i, v in enumerate(values)}
+    return np.array([index[v] for v in labels], dtype=np.int32)

@@ -446,6 +446,76 @@ def test_trace_records_pass_against_themselves(tmp_path):
     assert not any(d.regression for d in deltas)
 
 
+def _topo_line(free=8, frag=0.25, gang_p99=400.0, **extra):
+    out = {
+        "metric": "Trace_slice-fragmentation-on_256Nodes_greedy",
+        "unit": "pods/s", "value": 500.0, "topology": "on",
+        "slices_total": 16, "slices_free_at_steady_state": free,
+        "fragmentation_index": frag, "gang_admission_p99_ms": gang_p99,
+        "slo_budget_ms": 5000.0, "truncated": False,
+    }
+    out.update(extra)
+    return out
+
+
+def test_slices_free_gates_on_both_relative_and_absolute(tmp_path, capsys):
+    """PR 20: free-slice headroom gates only a drop that is BOTH >10%
+    relative AND >1 slice absolute."""
+    old = load_record(_write(tmp_path, "o.json", [_topo_line(free=10)]))
+    # one slice of wobble: -10% but not >1 absolute — never gates
+    d1, _o, _n = compare(old, load_record(
+        _write(tmp_path, "n1.json", [_topo_line(free=9)])))
+    sf1 = [d for d in d1 if d.field == "slices_free_at_steady_state"]
+    assert sf1 and not sf1[0].regression
+    # lost consolidation: -40% and -4 slices — gates
+    new = _write(tmp_path, "n2.json", [_topo_line(free=6)])
+    rc = main([_write(tmp_path, "o2.json", [_topo_line(free=10)]), new])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "slices_free_at_steady_state" in out and "REGRESSION" in out
+
+
+def test_fragmentation_index_drift_gates(tmp_path):
+    old = load_record(_write(tmp_path, "o.json", [_topo_line(frag=0.2)]))
+    # +25% but only +0.05 absolute: inside the floor
+    d1, _o, _n = compare(old, load_record(
+        _write(tmp_path, "n1.json", [_topo_line(frag=0.25)])))
+    f1 = [d for d in d1 if d.field == "fragmentation_index"]
+    assert f1 and not f1[0].regression
+    # +150% and +0.3 absolute: gates
+    d2, _o, _n = compare(old, load_record(
+        _write(tmp_path, "n2.json", [_topo_line(frag=0.5)])))
+    f2 = [d for d in d2 if d.field == "fragmentation_index"]
+    assert f2 and f2[0].regression
+
+
+def test_gang_admission_p99_gates_on_both_rules(tmp_path):
+    old = load_record(_write(tmp_path, "o.json",
+                             [_topo_line(gang_p99=80.0)]))
+    # +75% but only +60ms: under the 100ms floor
+    d1, _o, _n = compare(old, load_record(
+        _write(tmp_path, "n1.json", [_topo_line(gang_p99=140.0)])))
+    g1 = [d for d in d1 if d.field == "gang_admission_p99_ms"]
+    assert g1 and not g1[0].regression
+    # doubled AND +400ms: gates
+    old2 = load_record(_write(tmp_path, "o2.json",
+                              [_topo_line(gang_p99=400.0)]))
+    d2, _o, _n = compare(old2, load_record(
+        _write(tmp_path, "n2.json", [_topo_line(gang_p99=900.0)])))
+    g2 = [d for d in d2 if d.field == "gang_admission_p99_ms"]
+    assert g2 and g2[0].regression
+
+
+def test_topology_records_pass_against_themselves(tmp_path):
+    rec = _write(tmp_path, "self.json", [_topo_line()])
+    assert main([rec, rec]) == 0
+    deltas, _o, _n = compare(load_record(rec), load_record(rec))
+    fields = {d.field for d in deltas}
+    assert {"slices_free_at_steady_state", "fragmentation_index",
+            "gang_admission_p99_ms"} <= fields
+    assert not any(d.regression for d in deltas)
+
+
 def _list_line(p99=800.0, bytes_per=2_000_000.0, **extra):
     out = {
         "metric": "ListScaling_20000Nodes", "unit": "ms",

@@ -101,6 +101,65 @@ def test_restart_policy_grammar():
 
 
 # ---------------------------------------------------------------------------
+# spec argv threading: `kubetpu up --engine/--topology` → scheduler children
+# ---------------------------------------------------------------------------
+
+def test_scheduler_spec_threads_engine_into_argv():
+    """``kubetpu up --engine packing`` reaches the child argv through ONE
+    seam (scheduler_spec) — the packing engine must survive the spec
+    builder, not silently fall back to greedy in every child."""
+    from kubetpu.launch.cluster import scheduler_spec
+
+    spec = scheduler_spec(
+        name="scheduler-r0", server="http://127.0.0.1:1",
+        engine="packing",
+    )
+    i = spec.argv.index("--engine")
+    assert spec.argv[i + 1] == "packing"
+    default = scheduler_spec(
+        name="scheduler-r0", server="http://127.0.0.1:1",
+    )
+    j = default.argv.index("--engine")
+    assert default.argv[j + 1] == "greedy"
+
+
+def test_scheduler_spec_topology_argv_off_is_byte_identical():
+    """--topology on/auto appends the flag; the default "off" spec's argv
+    is byte-for-byte what it was before the topology axis existed."""
+    from kubetpu.launch.cluster import scheduler_spec
+
+    base = scheduler_spec(name="s", server="http://127.0.0.1:1")
+    off = scheduler_spec(name="s", server="http://127.0.0.1:1",
+                         topology="off")
+    assert off.argv == base.argv
+    assert "--topology" not in base.argv
+    for mode in ("on", "auto"):
+        spec = scheduler_spec(name="s", server="http://127.0.0.1:1",
+                              topology=mode)
+        i = spec.argv.index("--topology")
+        assert spec.argv[i + 1] == mode
+
+
+def test_up_parser_threads_engine_and_topology():
+    """The ``kubetpu up`` CLI accepts --engine packing and --topology and
+    lands them on the parsed args the Cluster is built from."""
+    from kubetpu.cli import build_parser
+
+    p = build_parser()
+    args = p.parse_args(["up", "--engine", "packing", "--topology", "on"])
+    assert args.engine == "packing"
+    assert args.topology == "on"
+    args = p.parse_args(["up"])
+    assert getattr(args, "topology", "off") == "off"
+    with pytest.raises(SystemExit):
+        p.parse_args(["up", "--topology", "sideways"])
+    sched = p.parse_args(
+        ["scheduler", "--server", "http://x", "--topology", "auto"]
+    )
+    assert sched.topology == "auto"
+
+
+# ---------------------------------------------------------------------------
 # supervisor failure paths (fast fake children — no scheduler boot)
 # ---------------------------------------------------------------------------
 

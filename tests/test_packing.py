@@ -375,7 +375,7 @@ def test_solver_state_resets_on_shape_change():
 def test_weights_tensor_and_json_roundtrip():
     w = PackingWeights(alpha_open=2.0, tie_band=0.2)
     tens = w.tensor()
-    assert tens.shape == (8,)
+    assert tens.shape == (10,)
     assert float(tens[2]) == pytest.approx(2.0)
     j = w.to_json()
     assert j["alpha_open"] == 2.0
@@ -383,6 +383,7 @@ def test_weights_tensor_and_json_roundtrip():
     assert set(j) == {
         "score_weight", "priority_weight", "alpha_open", "beta_frag",
         "dual_step", "dual_decay", "tie_band", "lam_cap_frac",
+        "slice_frag", "slice_align",
     }
 
 

@@ -120,6 +120,51 @@ def test_no_declared_budget_leaves_burn_rule_dormant():
     assert out["fired"] == [] and s.alerts_json()["alerts"] == []
 
 
+# ------------------------------------------------- gang-admission-stall
+GANG = "scheduler_gang_admission_duration_seconds"
+
+
+def gang_text(bad: int, good: int = 20) -> str:
+    """Synthetic gang-admission scrape, same bucket shape as e2e_text —
+    ``bad`` observations land above the declared 2000ms budget."""
+    return e2e_text(bad, good).replace(E2E, GANG)
+
+
+def test_gang_stall_dormant_when_no_gangs_admit():
+    """The engine-labeled histogram has NO series until the first gang
+    admits — a gang-free run's scrape omits the family entirely and the
+    rule stays dormant no matter how bad everything else looks."""
+    s, clock = make_sentinel()
+    settle_baseline(s, clock)
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(0))        # no gang series in the scrape
+    assert "gang-admission-stall" not in [a["rule"] for a in out["fired"]]
+    assert s.alerts_json()["alerts"] == []
+
+
+def test_gang_stall_dormant_without_declared_budget():
+    s, clock = make_sentinel(slo_budget_ms=None)
+    for _ in range(12):
+        clock["t"] += 30
+        s.evaluate(gang_text(0))
+    clock["t"] += 30
+    out = s.evaluate(gang_text(15))
+    assert out["fired"] == [] and s.alerts_json()["alerts"] == []
+
+
+def test_gang_stall_fires_on_burned_budget():
+    s, clock = make_sentinel()
+    for _ in range(12):
+        clock["t"] += 30
+        s.evaluate(e2e_text(0) + "\n" + gang_text(0))
+    clock["t"] += 30
+    out = s.evaluate(e2e_text(0) + "\n" + gang_text(15))
+    assert "gang-admission-stall" in [a["rule"] for a in out["fired"]]
+    al = next(a for a in out["fired"]
+              if a["rule"] == "gang-admission-stall")
+    assert al["severity"] == "warning"
+
+
 def test_eval_exceptions_are_counted_never_raised():
     def boom() -> str:
         raise RuntimeError("scrape source died")

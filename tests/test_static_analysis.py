@@ -87,7 +87,7 @@ def test_every_checker_registered_and_documented():
         "LD001", "LD002", "LD003", "JP001", "DS001", "HT001", "HT002",
         "MR001", "MR002", "MR003", "MR004", "TS001", "TS002", "CL001",
         "WP001", "WL001", "TR003", "PS001", "EC001", "AL001", "RP001",
-        "LS001",
+        "LS001", "TP001",
     }
     for ck in all_checkers():
         assert ck.title and len(ck.rationale) > 80, (
@@ -121,7 +121,7 @@ def test_fixture_violations_match_markers_exactly():
     "state/transfer_good.py", "metrics_good.py", "metrics_declared_good.py",
     "spans_good.py", "cross/owner.py", "clock_good.py", "wire_good.py",
     "wal_good.py", "trace_good.py", "proc_good.py", "epoch_good.py",
-    "alert_good.py", "rep_good.py", "list_good.py",
+    "alert_good.py", "rep_good.py", "list_good.py", "state/topo_good.py",
 ])
 def test_known_good_fixtures_are_silent(good):
     res = _fixture_result()
@@ -152,6 +152,24 @@ def test_donation_and_transfer_checkers_cover_audited_files():
             assert f in res.coverage[code], (
                 f"{code} no longer covers {f}"
             )
+
+
+def test_topology_transfer_checker_covers_the_coordinate_stack():
+    """PR 20: every layer that touches the slice/rack coordinate tensors
+    stays inside TP001's scope — asserted against the ACTUAL walk so a
+    file move cannot silently shrink the envelope around the one place
+    (the batched encode placement) allowed to ship them."""
+    res = _repo_result()
+    for f in (
+        "kubetpu/state/topology.py",
+        "kubetpu/ops/topology.py",
+        "kubetpu/ops/preemption.py",
+        "kubetpu/sched/podgroup.py",
+        "kubetpu/framework/runtime.py",
+        "kubetpu/parallel/mesh.py",
+    ):
+        assert f in res.files, f"{f} missing from the analysis walk"
+        assert f in res.coverage["TP001"], f"TP001 no longer covers {f}"
 
 
 def test_replication_seam_checker_covers_store_and_replicator():

@@ -1,0 +1,332 @@
+"""Node-topology axis (PR 20): dense slice/rack coordinate tensors, the
+slice-alignment kernels, bit-identical topology-off/auto parity across
+all three engines, single-slice gang concentration, topology-aware gang
+preemption with the ``kubetpu explain`` rationale, and the shared trace
+label grammar."""
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+import jax.numpy as jnp
+
+from kubetpu.api.wrappers import make_node, make_pod, make_pod_group
+from kubetpu.ops.topology import alignment_score, free_slices, slice_counts
+from kubetpu.state import Cache, encode_snapshot
+from kubetpu.state.topology import RACK_KEY, SLICE_KEY, topology_tensors
+
+from .test_podgroup import gang_pod, make_sched, settle
+from .test_scheduler import FakeClient
+
+
+class PreemptClient(FakeClient):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.deleted = []
+
+    def delete_pod(self, pod, reason=""):
+        self.deleted.append((f"{pod.namespace}/{pod.name}", reason, pod))
+
+
+def sliced_node(name, sval, cpu=1000, rack=None):
+    labels = {SLICE_KEY: sval}
+    if rack is not None:
+        labels[RACK_KEY] = rack
+    return make_node(name, cpu_milli=cpu, labels=labels)
+
+
+# ---------------------------------------------------------------------------
+# coordinate tensors: dense remap, memo, labeled signal
+# ---------------------------------------------------------------------------
+
+class TestTopologyTensors:
+    def test_dense_remap_and_unlabeled_bucket(self):
+        cache = Cache()
+        cache.add_node(sliced_node("a0", "s0", rack="r0"))
+        cache.add_node(sliced_node("a1", "s0", rack="r0"))
+        cache.add_node(sliced_node("b0", "s1", rack="r1"))
+        cache.add_node(make_node("plain"))
+        nt = encode_snapshot(cache.update_snapshot())
+        tt = topology_tensors(nt)
+        assert tt.labeled
+        assert tt.num_slices == 2 and tt.num_racks == 2
+        sid = tt.slice_id[:4]
+        assert sid[0] == sid[1] != sid[2]
+        assert sid[3] == tt.num_slices          # unlabeled bucket
+        # padded capacity rows read as unlabeled too
+        assert (tt.slice_id[4:] == tt.num_slices).all()
+        assert set(tt.slice_names) == {"s0", "s1"}
+
+    def test_unlabeled_cluster_reports_not_labeled(self):
+        cache = Cache()
+        cache.add_node(make_node("n0", labels={"zone": "z1"}))
+        nt = encode_snapshot(cache.update_snapshot())
+        tt = topology_tensors(nt)
+        assert not tt.labeled
+        assert tt.num_slices == 0 and tt.num_racks == 0
+
+    def test_memo_reused_until_node_object_changes(self):
+        cache = Cache()
+        cache.add_node(sliced_node("a0", "s0"))
+        snap = cache.update_snapshot()
+        nt = encode_snapshot(snap)
+        tt1 = topology_tensors(nt)
+        assert topology_tensors(nt) is tt1       # memo hit
+        # a replaced node object (labels may differ) drops the memo
+        cache.add_node(sliced_node("a0", "s1"))
+        snap = cache.update_snapshot(snap)
+        nt = encode_snapshot(snap, prev=nt)
+        tt2 = topology_tensors(nt)
+        assert tt2 is not tt1
+        assert set(tt2.slice_names) == {"s1"}
+
+
+# ---------------------------------------------------------------------------
+# alignment kernels
+# ---------------------------------------------------------------------------
+
+class TestAlignmentKernels:
+    # 4 nodes: slices [0, 0, 1, unlabeled]
+    SID = jnp.asarray([0, 0, 1, 2], dtype=jnp.int32)
+
+    def test_slice_counts_scatter(self):
+        assignments = jnp.asarray([0, 1, 2, -1])
+        valid = jnp.asarray([True, True, True, True])
+        counts = slice_counts(assignments, valid, self.SID, 2)
+        assert counts.tolist() == [2, 1, 0]      # unassigned → weight 0
+
+    def test_alignment_and_cut(self):
+        # whole gang on slice 0: alignment 9, cut 0, one slice used
+        a = jnp.asarray([0, 0, 1])
+        v = jnp.asarray([True, True, True])
+        align, cut, used = alignment_score(a, v, self.SID, 2)
+        assert (int(align), int(cut), int(used)) == (9, 0, 1)
+        # split 2/1 across slices: alignment 5, cut 4 (2*2 cross pairs)
+        b = jnp.asarray([0, 1, 2])
+        align, cut, used = alignment_score(b, v, self.SID, 2)
+        assert (int(align), int(cut), int(used)) == (5, 4, 2)
+        # unlabeled landings don't count toward alignment
+        c = jnp.asarray([3, 3, 3])
+        align, cut, used = alignment_score(c, v, self.SID, 2)
+        assert (int(align), int(cut), int(used)) == (0, 0, 0)
+
+    def test_free_slices_counts_fully_idle_labeled_slices(self):
+        requested = jnp.asarray(
+            [[100], [0], [0], [0]], dtype=jnp.int64
+        )
+        valid = jnp.asarray([True, True, True, True])
+        # slice 0 busy (node 0), slice 1 idle, unlabeled bucket ignored
+        assert int(free_slices(requested, valid, self.SID, 2)) == 1
+        idle = jnp.zeros((4, 1), dtype=jnp.int64)
+        assert int(free_slices(idle, valid, self.SID, 2)) == 2
+
+
+# ---------------------------------------------------------------------------
+# parity: off / auto-on-unlabeled / on-unlabeled are bit-identical
+# ---------------------------------------------------------------------------
+
+def _run_mixed_workload(engine, topology, labeled=False):
+    client = FakeClient()
+    s, _ = make_sched(client, engine=engine, topology=topology)
+    for i in range(4):
+        s.on_node_add(
+            sliced_node(f"n{i}", f"s{i % 2}") if labeled
+            else make_node(f"n{i}", cpu_milli=1000)
+        )
+    s.on_pod_group_add(make_pod_group("gang-a", min_count=2))
+    for i in range(2):
+        s.on_pod_add(gang_pod(f"g-{i}", "gang-a", cpu=300, idx=i))
+    for j in range(4):
+        s.on_pod_add(make_pod(f"p{j}", cpu_milli=400, creation_index=10 + j))
+    settle(s)
+    return dict(client.bound)
+
+
+@pytest.mark.parametrize("engine", ["greedy", "batched", "packing"])
+def test_topology_off_auto_on_parity_on_unlabeled_cluster(engine):
+    """Acceptance: with no node carrying a slice/rack label, every mode
+    is bit-identical to off — same pods, same nodes, every engine."""
+    base = _run_mixed_workload(engine, "off")
+    assert len(base) == 6
+    for mode in ("auto", "on"):
+        assert _run_mixed_workload(engine, mode) == base
+
+
+@pytest.mark.parametrize("engine", ["greedy", "batched", "packing"])
+def test_labeled_auto_matches_on(engine):
+    """auto on a LABELED cluster takes the topology path — identical
+    decisions to an explicit --topology on."""
+    on = _run_mixed_workload(engine, "on", labeled=True)
+    auto = _run_mixed_workload(engine, "auto", labeled=True)
+    assert on == auto and len(on) == 6
+
+
+# ---------------------------------------------------------------------------
+# slice concentration: gangs land on ONE slice when topology is active
+# ---------------------------------------------------------------------------
+
+def test_gang_concentrates_on_single_slice():
+    client = FakeClient()
+    s, _ = make_sched(client, topology="on")
+    for sval, names in (("s0", ("a0", "a1")), ("s1", ("b0", "b1"))):
+        for n in names:
+            s.on_node_add(sliced_node(n, sval))
+    s.on_pod_group_add(make_pod_group("gang-a", min_count=2))
+    for i in range(2):
+        s.on_pod_add(gang_pod(f"g-{i}", "gang-a", cpu=800, idx=i))
+    assert settle(s) == 2
+    slices = {client.bound[k][0] for k in client.bound}   # "a.." / "b.."
+    assert len(slices) == 1
+    rec = s.flight_recorder.lookup("default/gang-a")
+    assert rec is not None and rec["kind"] == "gang"
+    assert rec["status"] == "placed"
+    assert rec["placement"].startswith("slice:")
+    assert rec["alignment_score"] == 4            # 2 members, one slice
+    assert "<all>" in rec["slices_considered"][-1]
+
+
+def test_gang_admission_latency_observed_once():
+    client = FakeClient()
+    s, clock = make_sched(client, topology="on")
+    h = s.metrics.prom.gang_admission_duration
+    assert h.merged().total == 0                  # series absent pre-gang
+    for n in ("a0", "a1"):
+        s.on_node_add(sliced_node(n, "s0"))
+    s.on_pod_group_add(make_pod_group("gang-a", min_count=2))
+    clock.tick(3)
+    for i in range(2):
+        s.on_pod_add(gang_pod(f"g-{i}", "gang-a", cpu=300, idx=i))
+    assert settle(s) == 2
+    assert h.merged().total == 1                  # observed exactly once
+
+
+# ---------------------------------------------------------------------------
+# topology-aware gang preemption, end-to-end with the explain rationale
+# ---------------------------------------------------------------------------
+
+def test_gang_preemption_evicts_one_gang_and_admits_the_train():
+    """Acceptance: an aligned training gang that fits nowhere admits by
+    evicting exactly ONE lower-priority gang's slice — victims deleted,
+    the preemptor parks until the deletes land, then binds on the freed
+    slice; ``kubetpu explain`` renders the whole rationale."""
+    from kubetpu.cli import _render_gang_explain
+
+    client = PreemptClient()
+    s, clock = make_sched(client, topology="on")
+    s.enable_preemption()
+    for sval, names in (("s0", ("a0", "a1")), ("s1", ("b0", "b1"))):
+        for n in names:
+            s.on_node_add(sliced_node(n, sval))
+
+    # a low-priority gang occupies one full slice
+    s.on_pod_group_add(make_pod_group("low", min_count=2))
+    for i in range(2):
+        s.on_pod_add(gang_pod(f"low-{i}", "low", cpu=900, prio=0, idx=i))
+    assert settle(s) == 2
+    low_slice = {client.bound[f"default/low-{i}"] for i in range(2)}
+    assert len({n[0] for n in low_slice}) == 1
+
+    # high-priority serve pods fill the OTHER slice
+    for j in range(2):
+        s.on_pod_add(make_pod(f"serve-{j}", cpu_milli=900, priority=10,
+                              creation_index=10 + j))
+    assert settle(s) == 2
+
+    # the training gang: higher priority than "low", fits nowhere intact
+    s.on_pod_group_add(make_pod_group("train", min_count=2))
+    for i in range(2):
+        s.on_pod_add(gang_pod(f"train-{i}", "train", cpu=900, prio=8,
+                              idx=20 + i))
+    assert settle(s) == 0                          # parked on the evictions
+    assert len(client.deleted) == 2                # ONE gang, both members
+    assert {k for k, _r, _p in client.deleted} == {
+        "default/low-0", "default/low-1",
+    }
+    assert all("default/train" in r for _k, r, _p in client.deleted)
+    assert s.metrics.prom.preemption_victims.merged().total >= 1
+
+    rec = s.flight_recorder.lookup("default/train")
+    assert rec["status"] == "preempting"
+    assert rec["victim_group"] == "default/low"
+    assert sorted(rec["preemption_victims"]) == [
+        "default/low-0", "default/low-1",
+    ]
+    text = _render_gang_explain(rec)
+    assert "preemption: evicting gang default/low" in text
+    assert "default/low-0" in text and "slice:" in rec["placement"]
+
+    # a second pass while the evictions are in flight must NOT re-evict
+    s.podgroups.wake_all()
+    assert settle(s) == 0
+    assert len(client.deleted) == 2
+
+    # the victim deletes land (informer echoes) → the gang wakes + binds
+    for _k, _r, p in client.deleted:
+        s.on_pod_delete(p)
+    clock.tick(30)                                 # past the retry backoff
+    assert settle(s) == 2
+    bound = {client.bound[f"default/train-{i}"] for i in range(2)}
+    assert bound <= {"a0", "a1", "b0", "b1"}
+    assert len({n[0] for n in bound}) == 1         # aligned on ONE slice
+    assert {n[0] for n in bound} == {next(iter(low_slice))[0]}
+
+    rec = s.flight_recorder.lookup("default/train")
+    assert rec["status"] == "placed"
+    assert "decision: placed on" in _render_gang_explain(rec)
+
+
+def test_gang_preemption_needs_topology_and_postfilter():
+    """Gates: no preemption without enable_preemption(), and none when
+    the cluster carries no slice labels (device topology block absent)."""
+    client = PreemptClient()
+    s, _ = make_sched(client, topology="on")       # no enable_preemption
+    for sval, names in (("s0", ("a0",)), ("s1", ("b0",))):
+        for n in names:
+            s.on_node_add(sliced_node(n, sval))
+    s.on_pod_group_add(make_pod_group("low", min_count=1))
+    s.on_pod_add(gang_pod("low-0", "low", cpu=900, prio=0))
+    s.on_pod_add(make_pod("serve-0", cpu_milli=900, priority=10,
+                          creation_index=5))
+    settle(s)
+    s.on_pod_group_add(make_pod_group("train", min_count=1))
+    s.on_pod_add(gang_pod("train-0", "train", cpu=900, prio=8, idx=9))
+    settle(s)
+    assert client.deleted == []
+
+
+# ---------------------------------------------------------------------------
+# trace label grammar: deterministic, shared by fleet + wave nodes
+# ---------------------------------------------------------------------------
+
+class TestTraceLabels:
+    def test_crc_grammar_is_deterministic_and_dense(self):
+        from kubetpu.perf import workloads as W
+
+        a = W.trace_topology_labels("node-00042", 16)
+        assert a == W.trace_topology_labels("node-00042", 16)
+        assert a[SLICE_KEY].startswith("slice-")
+        assert a[RACK_KEY].startswith("rack-")
+        # 4 slices per rack under the shared grammar
+        s = int(a[SLICE_KEY].split("-")[1])
+        assert a[RACK_KEY] == f"rack-{s // 4:02d}"
+        assert W.trace_topology_labels("node-00042", 0) == {}
+
+    def test_node_default_and_wave_nodes_share_the_grammar(self):
+        from kubetpu.perf import workloads as W
+        from kubetpu.perf.runner import make_trace_node
+
+        fleet = W.node_default(7, slices=8)
+        wave = make_trace_node(fleet.name, slices=8)
+        assert fleet.labels_dict()[SLICE_KEY] == (
+            wave.labels_dict()[SLICE_KEY]
+        )
+
+    def test_topology_profiles_declare_slices(self):
+        from kubetpu.perf.workloads import TRACE_PROFILES
+
+        for name in ("train-serve-churn", "slice-fragmentation",
+                     "gang-contention"):
+            p = TRACE_PROFILES[name]
+            assert p.slices > 0
+            assert p.scaled("x", nodes=64).slices == p.slices
